@@ -1,0 +1,16 @@
+"""emqx_tpu — a TPU-native messaging framework with the capability surface of EMQX.
+
+The reference (surveyed in /root/repo/SURVEY.md) is EMQX, a distributed MQTT
+broker written in Erlang/OTP. This package is a ground-up redesign for TPU:
+
+- The wildcard-topic routing hot path (reference: apps/emqx/src/emqx_trie.erl,
+  emqx_router.erl, emqx_broker.erl dispatch) is a dense NFA transition table
+  matched in SPMD batches on TPU via JAX/XLA (`emqx_tpu.ops`).
+- The broker data plane (sessions, QoS, dispatch) is an asyncio host layer
+  (`emqx_tpu.broker`, `emqx_tpu.transport`) with native C++ components for the
+  codec hot path (`emqx_tpu.mqtt.codec_native`).
+- Multi-chip scaling uses `jax.sharding.Mesh` + shard_map collectives
+  (`emqx_tpu.parallel`), not per-node RPC as in the reference.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Host-side broker data plane: pub/sub kernel, sessions, dispatch."""

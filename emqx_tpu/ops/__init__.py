@@ -1,0 +1,1 @@
+"""TPU routing ops: topic algebra, NFA table compiler, batch matchers."""

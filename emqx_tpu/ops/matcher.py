@@ -1,0 +1,284 @@
+"""Batched NFA topic matching on TPU.
+
+Replaces the per-message ETS trie walk of the reference
+(apps/emqx/src/emqx_trie.erl:271-333 `match_no_compact`, driven from
+emqx_router:match_routes emqx_router.erl:128-141) with one jitted SPMD kernel
+over a *batch* of topics:
+
+- state: a fixed-width frontier of NFA node ids per topic (a trie has no
+  converging paths, so the frontier never contains duplicates);
+- one `lax.scan` step per topic level: gather `#`-terminals (they match any
+  non-empty suffix), probe the literal-edge hash table, gather `+` children,
+  then compact the doubled frontier with a cumsum+scatter;
+- end-of-scan: collect exact terminals and `#`-terminals of the surviving
+  frontier (``a/#`` matches ``a`` — 'match_#' at emqx_trie.erl:288-291);
+- `$`-topics skip root-level ``+``/``#`` (emqx_trie.erl:271-278).
+
+Everything is static-shape, data-independent control flow; matched filter ids
+accumulate into a fixed [B, K] buffer via cumsum+scatter with an overflow
+flag. Rows that overflow (frontier or matches) or exceed the level budget are
+flagged so the host can fall back to the authoritative CPU trie
+(`emqx_tpu.broker.trie.TopicTrie`) — correctness never depends on the caps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from emqx_tpu.ops import tokenizer as tok
+from emqx_tpu.ops.nfa import (
+    EDGE_H_MUL_NODE,
+    EDGE_H_MUL_SYM,
+    EDGE_H_SHIFT,
+    MAX_PROBES,
+    NfaBuilder,
+    NfaTables,
+    _next_pow2,
+)
+
+
+@dataclass(frozen=True)
+class MatcherConfig:
+    max_levels: int = 16  # topic depth budget (scan length)
+    frontier: int = 32  # max simultaneous NFA states per topic
+    max_matches: int = 64  # max matched filters per topic
+    # open-addressing probe bound; must cover the build-time bound
+    # (nfa.MAX_PROBES) or lookups would silently miss — TpuMatcher clamps.
+    probes: int = MAX_PROBES
+    max_bytes: int = 256  # topic byte budget for the device tokenizer
+
+
+def _probe_edges(tables, node, sym, probes: int):
+    """Vectorized open-addressing lookup of literal edges (node, sym)->child."""
+    import jax.numpy as jnp
+
+    E = tables["edge_node"].shape[0]
+    mask = jnp.uint32(E - 1)
+    valid = (node >= 0) & (sym >= 0)
+    h = node.astype(jnp.uint32) * jnp.uint32(EDGE_H_MUL_NODE) + sym.astype(
+        jnp.uint32
+    ) * jnp.uint32(EDGE_H_MUL_SYM)
+    h ^= h >> EDGE_H_SHIFT
+    child = jnp.full(node.shape, -1, dtype=jnp.int32)
+    found = jnp.zeros(node.shape, dtype=bool)
+    for p in range(probes):
+        idx = ((h + jnp.uint32(p)) & mask).astype(jnp.int32)
+        hit = (
+            (tables["edge_node"][idx] == node)
+            & (tables["edge_sym"][idx] == sym)
+            & valid
+            & ~found
+        )
+        child = jnp.where(hit, tables["edge_child"][idx], child)
+        found |= hit
+    return child
+
+
+def _compact(cand, width: int):
+    """Left-pack the >=0 entries of cand [B, W] into [B, width]; flag overflow."""
+    import jax.numpy as jnp
+
+    B = cand.shape[0]
+    valid = cand >= 0
+    pos = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    idx = jnp.where(valid & (pos < width), pos, width)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    out = jnp.full((B, width), -1, dtype=jnp.int32)
+    out = out.at[rows, idx].set(cand, mode="drop")
+    over = jnp.sum(valid, axis=1) > width
+    return out, over
+
+
+def _append(matched, mcount, hits, cap: int):
+    """Append the >=0 entries of hits [B, H] to matched [B, cap] at mcount."""
+    import jax.numpy as jnp
+
+    B = matched.shape[0]
+    valid = hits >= 0
+    pos = mcount[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    idx = jnp.where(valid & (pos < cap), pos, cap)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    matched = matched.at[rows, idx].set(hits, mode="drop")
+    return matched, mcount + jnp.sum(valid, axis=1, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("frontier", "max_matches", "probes"))
+def batch_match_syms(
+    tables,
+    syms,
+    nwords,
+    dollar,
+    *,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+):
+    """Match pre-tokenized topics against the NFA tables.
+
+    syms: int32 [B, L] dense word symbols (-1 = OOV/absent)
+    nwords: int32 [B]; dollar: bool [B]
+    -> matched int32 [B, K] filter ids (-1 padded), mcount int32 [B],
+       flags bool [B] (overflow or too-deep => host must fall back)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, L = syms.shape
+    F, K = frontier, max_matches
+
+    frontier0 = jnp.full((B, F), -1, dtype=jnp.int32)
+    frontier0 = frontier0.at[:, 0].set(0)  # root
+    matched0 = jnp.full((B, K), -1, dtype=jnp.int32)
+    mcount0 = jnp.zeros(B, dtype=jnp.int32)
+    fover0 = jnp.zeros(B, dtype=bool)
+
+    def step(carry, xs):
+        fr, matched, mcount, fover = carry
+        wsym, lvl = xs
+        active_row = lvl < nwords
+        act = (fr >= 0) & active_row[:, None]
+        fr_safe = jnp.maximum(fr, 0)
+        allow_wild = act & ~((lvl == 0) & dollar)[:, None]
+        # '#' children match any non-empty remaining suffix
+        hf = jnp.where(allow_wild, tables["hash_filter"][fr_safe], -1)
+        matched, mcount = _append(matched, mcount, hf, K)
+        lit = _probe_edges(
+            tables,
+            jnp.where(act, fr, -1),
+            jnp.broadcast_to(wsym[:, None], (B, F)),
+            probes,
+        )
+        plus = jnp.where(allow_wild, tables["plus_child"][fr_safe], -1)
+        newf, over = _compact(jnp.concatenate([lit, plus], axis=1), F)
+        fr = jnp.where(active_row[:, None], newf, fr)
+        fover = fover | (over & active_row)
+        return (fr, matched, mcount, fover), None
+
+    (fr, matched, mcount, fover), _ = jax.lax.scan(
+        step,
+        (frontier0, matched0, mcount0, fover0),
+        (syms.T, jnp.arange(L, dtype=jnp.int32)),
+    )
+
+    done = nwords <= L
+    fin = (fr >= 0) & done[:, None]
+    fr_safe = jnp.maximum(fr, 0)
+    term = jnp.where(fin, tables["term_filter"][fr_safe], -1)
+    matched, mcount = _append(matched, mcount, term, K)
+    endhash = jnp.where(fin, tables["hash_filter"][fr_safe], -1)
+    matched, mcount = _append(matched, mcount, endhash, K)
+
+    flags = fover | (mcount > K) | ~done
+    return matched, jnp.minimum(mcount, K), flags
+
+
+@partial(
+    jax.jit,
+    static_argnames=("salt", "max_levels", "frontier", "max_matches", "probes"),
+)
+def batch_match_bytes(
+    tables,
+    bytes_mat,
+    lengths,
+    *,
+    salt: int,
+    max_levels: int = 16,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+):
+    """Fused full-device pipeline: tokenize + vocab lookup + NFA match."""
+    h1, h2, nwords, dollar = tok.tokenize_device(
+        bytes_mat, lengths, salt, max_levels
+    )
+    syms = tok.vocab_lookup_device(tables, h1, h2, probes)
+    return batch_match_syms(
+        tables,
+        syms,
+        nwords,
+        dollar,
+        frontier=frontier,
+        max_matches=max_matches,
+        probes=probes,
+    )
+
+
+def _pad_pow2(n: int, lo: int = 256) -> int:
+    return max(lo, _next_pow2(n))
+
+
+class TpuMatcher:
+    """Host-facing wrapper: owns packed tables on device, pads batches,
+    decodes matches back to filter names, and falls back to a caller-provided
+    exact matcher for flagged rows."""
+
+    def __init__(self, builder: NfaBuilder, config: MatcherConfig = MatcherConfig()):
+        self.builder = builder
+        if config.probes < MAX_PROBES:
+            import dataclasses
+
+            config = dataclasses.replace(config, probes=MAX_PROBES)
+        self.config = config
+        self._dev_tables = None
+        self._dev_version = -1
+        self._salt = 0
+
+    def _tables(self):
+        t = self.builder.pack()
+        if self._dev_tables is None or self._dev_version != t.version:
+            self._dev_tables = t.device_arrays()
+            self._dev_version = t.version
+            self._salt = t.salt
+        return self._dev_tables
+
+    def match_batch(
+        self, topics: Sequence[str], fallback=None
+    ) -> List[List[str]]:
+        """Match a batch of topic strings -> list of matched filter names.
+
+        `fallback(topic) -> list[str]` handles rows the device flags
+        (too deep / overflow); defaults to raising if flagged.
+        """
+        cfg = self.config
+        tables = self._tables()
+        B = len(topics)
+        Bp = _pad_pow2(B, 64)
+        mat, lens, too_long = tok.encode_topics(list(topics), cfg.max_bytes)
+        if Bp != B:
+            mat = np.pad(mat, ((0, Bp - B), (0, 0)))
+            lens = np.pad(lens, (0, Bp - B))
+        matched, mcount, flags = batch_match_bytes(
+            tables,
+            mat,
+            lens,
+            salt=self._salt,
+            max_levels=cfg.max_levels,
+            frontier=cfg.frontier,
+            max_matches=cfg.max_matches,
+            probes=cfg.probes,
+        )
+        matched = np.asarray(matched[:B])
+        mcount = np.asarray(mcount[:B])
+        flags = np.asarray(flags[:B]) | too_long
+        out: List[List[str]] = []
+        for i in range(B):
+            if flags[i]:
+                if fallback is None:
+                    raise RuntimeError(
+                        f"device match overflow for topic {topics[i]!r}; "
+                        "no fallback provided"
+                    )
+                out.append(fallback(topics[i]))
+            else:
+                names = []
+                for fid in matched[i, : mcount[i]]:
+                    name = self.builder.filter_name(int(fid))
+                    if name is not None:
+                        names.append(name)
+                out.append(names)
+        return out

@@ -1,0 +1,435 @@
+"""Subscription-trie -> dense NFA table compiler.
+
+The reference walks a prefix trie in ETS per published message
+(apps/emqx/src/emqx_trie.erl:271-333). That design is pointer-chasing and
+per-message — exactly wrong for a TPU. Here the same trie is compiled into a
+set of flat arrays ("NFA tables") that a jitted JAX kernel
+(`emqx_tpu.ops.matcher`) walks for a whole *batch* of topics at once, one
+`lax.scan` step per topic level, with all lookups as vectorized gathers:
+
+- ``plus_child[node]``   -> node id of the ``+`` child, or -1
+- ``hash_filter[node]``  -> filter id of the ``#`` child, or -1 (``#`` is
+  always a terminal leaf, so it needs no node of its own; matching ``a/#``
+  against ``a`` — emqx_trie.erl 'match_#' at end of words — falls out of
+  collecting this field both when consuming a word *and* at end-of-topic)
+- ``term_filter[node]``  -> filter id ending exactly at this node, or -1
+- literal edges: open-addressing hash table ``(node, sym) -> child`` with a
+  build-time-verified probe bound, so the device probe loop is a fixed-length
+  unrolled gather (no data-dependent control flow under jit)
+- vocab: open-addressing table ``(h1, h2) -> sym`` mapping *word hash pairs*
+  to dense symbol ids, so topic tokenization is hash-based and runs entirely
+  on device (`emqx_tpu.ops.tokenizer`)
+
+Word hashing uses a 2x32-bit polynomial hash (see `word_hash_pair`) chosen so
+the device tokenizer can compute it with prefix sums instead of a per-byte
+scan. Hash-pair collisions between distinct words are detected at build time
+and resolved by bumping a salt and rebuilding (they are a ~2^-64 event).
+
+Updates: the builder mutates small Python-side structures per
+subscribe/unsubscribe (mirroring emqx_trie insert/delete:66-119 semantics,
+including refcounted nodes) and re-packs flat arrays lazily on the next
+`pack()` call. Packing is O(edges) in NumPy and amortized across batches;
+a delta-overlay scheme is the planned next step (SURVEY.md §7 stage 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from emqx_tpu.ops import topics as T
+
+# Polynomial-hash parameters; must match emqx_tpu.ops.tokenizer exactly.
+P1 = np.uint32(0x01000193)  # FNV prime, odd => invertible mod 2^32
+P2 = np.uint32(0x00BC8F6B)  # odd
+_SALT1 = np.uint32(0x9E3779B9)
+_SALT2 = np.uint32(0x85EBCA6B)
+
+MAX_PROBES = 8
+
+# Slot-hash constants shared bit-for-bit by the host packers below and the
+# device probe loops (matcher._probe_edges, tokenizer.vocab_lookup_device).
+EDGE_H_MUL_NODE = 0x9E3779B1
+EDGE_H_MUL_SYM = 0x85EBCA77
+EDGE_H_SHIFT = 15
+VOCAB_H_MUL = 0xC2B2AE3D
+VOCAB_H_SHIFT = 13
+
+PLUS_SYM = -2  # sentinel syms (never produced by vocab lookup)
+HASH_SYM = -3
+
+
+def _mix32(x: np.uint32) -> np.uint32:
+    """Murmur3-style finalizer (32-bit)."""
+    x = np.uint32(x)
+    x ^= x >> np.uint32(16)
+    x = np.uint32(x * np.uint32(0x7FEB352D))
+    x ^= x >> np.uint32(15)
+    x = np.uint32(x * np.uint32(0x846CA68B))
+    x ^= x >> np.uint32(16)
+    return x
+
+
+def _poly_raw(word: bytes, P: np.uint32) -> np.uint32:
+    h = np.uint32(1)  # == P^0; encodes length so "" hashes distinctly
+    with np.errstate(over="ignore"):
+        for c in word:
+            h = np.uint32(h * P + np.uint32(c))
+    return h
+
+
+def word_hash_pair(word: str, salt: int) -> Tuple[int, int]:
+    """(h1, h2) for one word; the device tokenizer computes the same pair."""
+    b = word.encode("utf-8", "surrogatepass")
+    with np.errstate(over="ignore"):
+        s1 = np.uint32(np.uint32(salt) * _SALT1 + np.uint32(1))
+        s2 = np.uint32(np.uint32(salt) * _SALT2 + np.uint32(7))
+        h1 = _mix32(_poly_raw(b, P1) ^ s1)
+        h2 = _mix32(_poly_raw(b, P2) ^ s2)
+    return int(h1), int(h2)
+
+
+def edge_slot_hash(node: np.ndarray, sym: np.ndarray) -> np.ndarray:
+    """Initial probe slot hash for the literal-edge table (pre-mask)."""
+    with np.errstate(over="ignore"):
+        h = np.uint32(node).astype(np.uint32) * np.uint32(EDGE_H_MUL_NODE)
+        h = h + np.uint32(sym).astype(np.uint32) * np.uint32(EDGE_H_MUL_SYM)
+        h ^= h >> np.uint32(EDGE_H_SHIFT)
+    return h
+
+
+def vocab_slot_hash(h1: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = np.uint32(h1).astype(np.uint32) * np.uint32(VOCAB_H_MUL)
+        h ^= h >> np.uint32(VOCAB_H_SHIFT)
+    return h
+
+
+@dataclass
+class NfaTables:
+    """Flat match tables; everything the device kernel needs."""
+
+    plus_child: np.ndarray  # int32 [N]
+    hash_filter: np.ndarray  # int32 [N]
+    term_filter: np.ndarray  # int32 [N]
+    edge_node: np.ndarray  # int32 [E]
+    edge_sym: np.ndarray  # int32 [E]
+    edge_child: np.ndarray  # int32 [E]
+    vocab_h1: np.ndarray  # uint32 [V]
+    vocab_h2: np.ndarray  # uint32 [V]
+    vocab_sym: np.ndarray  # int32 [V]
+    salt: int
+    num_nodes: int
+    num_filters: int
+    version: int
+
+    def device_arrays(self):
+        import jax.numpy as jnp
+
+        return {
+            "plus_child": jnp.asarray(self.plus_child),
+            "hash_filter": jnp.asarray(self.hash_filter),
+            "term_filter": jnp.asarray(self.term_filter),
+            "edge_node": jnp.asarray(self.edge_node),
+            "edge_sym": jnp.asarray(self.edge_sym),
+            "edge_child": jnp.asarray(self.edge_child),
+            "vocab_h1": jnp.asarray(self.vocab_h1),
+            "vocab_h2": jnp.asarray(self.vocab_h2),
+            "vocab_sym": jnp.asarray(self.vocab_sym),
+        }
+
+
+class _HashCollision(Exception):
+    pass
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class NfaBuilder:
+    """Incrementally maintained subscription automaton.
+
+    add/remove mirror emqx_trie:insert/delete refcount semantics
+    (emqx_trie.erl:170-199); `pack()` emits `NfaTables`.
+    """
+
+    ROOT = 0
+
+    def __init__(self) -> None:
+        # node arrays (python lists; index = node id)
+        self._plus: List[int] = [-1]
+        self._hashf: List[int] = [-1]
+        self._term: List[int] = [-1]
+        self._refs: List[int] = [0]  # filters at-or-below node
+        self._free_nodes: List[int] = []
+        # literal edges: (node, sym) -> child
+        self._edges: Dict[Tuple[int, int], int] = {}
+        # vocab: word -> (sym, refcount)
+        self._vocab: Dict[str, List[int]] = {}
+        self._sym_words: List[Optional[str]] = []
+        self._free_syms: List[int] = []
+        # filters
+        self._filter_ids: Dict[str, int] = {}
+        self._id_filters: List[Optional[str]] = []
+        self._free_filters: List[int] = []
+        self._filter_refs: List[int] = []
+        self.salt = 0
+        self.version = 0
+        self._packed: Optional[NfaTables] = None
+
+    # -- vocab -------------------------------------------------------------
+    def _sym_for(self, word: str, create: bool) -> int:
+        ent = self._vocab.get(word)
+        if ent is not None:
+            if create:
+                ent[1] += 1
+            return ent[0]
+        if not create:
+            return -1
+        if self._free_syms:
+            sym = self._free_syms.pop()
+            self._sym_words[sym] = word
+        else:
+            sym = len(self._sym_words)
+            self._sym_words.append(word)
+        self._vocab[word] = [sym, 1]
+        return sym
+
+    def _sym_release(self, word: str) -> None:
+        ent = self._vocab[word]
+        ent[1] -= 1
+        if ent[1] == 0:
+            del self._vocab[word]
+            self._sym_words[ent[0]] = None
+            self._free_syms.append(ent[0])
+
+    # -- nodes -------------------------------------------------------------
+    def _new_node(self) -> int:
+        if self._free_nodes:
+            n = self._free_nodes.pop()
+            self._plus[n] = -1
+            self._hashf[n] = -1
+            self._term[n] = -1
+            self._refs[n] = 0
+            return n
+        self._plus.append(-1)
+        self._hashf.append(-1)
+        self._term.append(-1)
+        self._refs.append(0)
+        return len(self._plus) - 1
+
+    # -- filters -----------------------------------------------------------
+    def _filter_id(self, filter_: str) -> int:
+        fid = self._filter_ids.get(filter_)
+        if fid is not None:
+            return fid
+        if self._free_filters:
+            fid = self._free_filters.pop()
+            self._id_filters[fid] = filter_
+            self._filter_refs[fid] = 0
+        else:
+            fid = len(self._id_filters)
+            self._id_filters.append(filter_)
+            self._filter_refs.append(0)
+        self._filter_ids[filter_] = fid
+        return fid
+
+    def filter_name(self, fid: int) -> Optional[str]:
+        return self._id_filters[fid] if 0 <= fid < len(self._id_filters) else None
+
+    def __len__(self) -> int:
+        return len(self._filter_ids)
+
+    @property
+    def num_filters_capacity(self) -> int:
+        return len(self._id_filters)
+
+    # -- public mutation ---------------------------------------------------
+    def add(self, filter_: str) -> int:
+        """Insert a topic filter; returns its stable filter id (refcounted)."""
+        T.validate(filter_)  # before any mutation: invalid input must not corrupt state
+        fid = self._filter_id(filter_)
+        if self._filter_refs[fid] > 0:
+            self._filter_refs[fid] += 1
+            return fid
+        self._filter_refs[fid] = 1
+        ws = T.words(filter_)
+        node = self.ROOT
+        path = [node]
+        for i, w in enumerate(ws):
+            last = i == len(ws) - 1
+            if w == "#":
+                self._hashf[node] = fid
+                break
+            if w == "+":
+                child = self._plus[node]
+                if child < 0:
+                    child = self._new_node()
+                    self._plus[node] = child
+            else:
+                sym = self._sym_for(w, create=True)
+                key = (node, sym)
+                child = self._edges.get(key, -1)
+                if child < 0:
+                    child = self._new_node()
+                    self._edges[key] = child
+            node = child
+            path.append(node)
+            if last:
+                self._term[node] = fid
+        for n in path:
+            self._refs[n] += 1
+        self._dirty()
+        return fid
+
+    def remove(self, filter_: str) -> bool:
+        """Delete one reference to a filter; True when fully removed."""
+        fid = self._filter_ids.get(filter_)
+        if fid is None or self._filter_refs[fid] == 0:
+            return False
+        self._filter_refs[fid] -= 1
+        if self._filter_refs[fid] > 0:
+            return False
+        del self._filter_ids[filter_]
+        self._id_filters[fid] = None
+        self._free_filters.append(fid)
+        ws = T.words(filter_)
+        node = self.ROOT
+        steps: List[Tuple[int, str, int]] = []  # (parent, word, child)
+        for i, w in enumerate(ws):
+            if w == "#":
+                self._hashf[node] = -1
+                break
+            child = (
+                self._plus[node]
+                if w == "+"
+                else self._edges.get((node, self._sym_for(w, create=False)), -1)
+            )
+            steps.append((node, w, child))
+            node = child
+            if i == len(ws) - 1:
+                self._term[node] = -1
+        self._refs[self.ROOT] -= 1
+        for parent, w, child in steps:
+            self._refs[child] -= 1
+            if self._refs[child] == 0:
+                if w == "+":
+                    self._plus[parent] = -1
+                else:
+                    sym = self._vocab[w][0]
+                    del self._edges[(parent, sym)]
+                self._free_nodes.append(child)
+            if w not in ("+", "#"):
+                self._sym_release(w)
+        self._dirty()
+        return True
+
+    def _dirty(self) -> None:
+        self.version += 1
+        self._packed = None
+
+    # -- packing -----------------------------------------------------------
+    def pack(self) -> NfaTables:
+        if self._packed is not None:
+            return self._packed
+        for _ in range(16):
+            try:
+                self._packed = self._pack_with_salt(self.salt)
+                return self._packed
+            except _HashCollision:
+                self.salt += 1
+        raise RuntimeError("vocab hash collisions persisted across 16 salts")
+
+    def _pack_with_salt(self, salt: int) -> NfaTables:
+        n_nodes = len(self._plus)
+        plus = np.asarray(self._plus, dtype=np.int32)
+        hashf = np.asarray(self._hashf, dtype=np.int32)
+        term = np.asarray(self._term, dtype=np.int32)
+
+        # vocab table keyed by hash pair
+        vocab_words = [(w, ent[0]) for w, ent in self._vocab.items()]
+        V = _next_pow2(max(16, 2 * len(vocab_words)))
+        for _ in range(4):
+            vh1 = np.zeros(V, dtype=np.uint32)
+            vh2 = np.zeros(V, dtype=np.uint32)
+            vsym = np.full(V, -1, dtype=np.int32)
+            seen: Dict[Tuple[int, int], str] = {}
+            ok = True
+            for w, sym in vocab_words:
+                h1, h2 = word_hash_pair(w, salt)
+                if (h1, h2) in seen:  # true 64-bit collision
+                    raise _HashCollision()
+                seen[(h1, h2)] = w
+                slot = int(vocab_slot_hash(np.uint32(h1))) & (V - 1)
+                placed = False
+                for p in range(MAX_PROBES):
+                    idx = (slot + p) & (V - 1)
+                    if vsym[idx] < 0:
+                        vh1[idx], vh2[idx], vsym[idx] = h1, h2, sym
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+            if ok:
+                break
+            V *= 2
+        else:
+            raise RuntimeError("vocab table probe bound not satisfiable")
+
+        # literal edge table
+        E = _next_pow2(max(16, 2 * len(self._edges)))
+        for _ in range(6):
+            en = np.full(E, -1, dtype=np.int32)
+            es = np.full(E, -1, dtype=np.int32)
+            ec = np.full(E, -1, dtype=np.int32)
+            ok = True
+            for (node, sym), child in self._edges.items():
+                slot = int(edge_slot_hash(np.int64(node), np.int64(sym))) & (E - 1)
+                placed = False
+                for p in range(MAX_PROBES):
+                    idx = (slot + p) & (E - 1)
+                    if en[idx] < 0:
+                        en[idx], es[idx], ec[idx] = node, sym, child
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+            if ok:
+                break
+            E *= 2
+        else:
+            raise RuntimeError("edge table probe bound not satisfiable")
+
+        return NfaTables(
+            plus_child=plus,
+            hash_filter=hashf,
+            term_filter=term,
+            edge_node=en,
+            edge_sym=es,
+            edge_child=ec,
+            vocab_h1=vh1,
+            vocab_h2=vh2,
+            vocab_sym=vsym,
+            salt=salt,
+            num_nodes=n_nodes,
+            num_filters=len(self._id_filters),
+            version=self.version,
+        )
+
+    # -- host-side tokenization (exact; used by tests and CPU fallback) ----
+    def tokenize_host(self, topic: str, max_levels: int):
+        """-> (syms int32[max_levels], nwords, is_dollar, too_deep)."""
+        ws = T.words(topic)
+        syms = np.full(max_levels, -1, dtype=np.int32)
+        for i, w in enumerate(ws[:max_levels]):
+            ent = self._vocab.get(w)
+            syms[i] = ent[0] if ent is not None else -1
+        return syms, len(ws), topic.startswith("$"), len(ws) > max_levels

@@ -1,0 +1,182 @@
+"""On-device topic tokenization.
+
+Splits a batch of topic byte-strings into level words and hashes each word —
+entirely on the TPU, with no per-byte recurrence. The trick: a polynomial
+word hash ``raw = sum_j c_j * P^(m-1-j) + P^m  (mod 2^32)`` can be computed
+from *prefix sums* over the whole padded byte matrix:
+
+    u_i  = c_i * P^(-i)          (P odd => invertible mod 2^32)
+    U    = cumsum(u)             per row
+    word [s..e]:  raw = P^e * (U[e] - U[s-1]) + P^(e-s+1)
+
+so tokenization is a handful of vectorized elementwise ops, one cumsum, and
+two gather/scatters — VPU-friendly and fully fusable by XLA. The reference
+has no analog (it splits binaries per message on the BEAM,
+apps/emqx/src/emqx_topic.erl words/1); this is the TPU-first replacement.
+
+The hash pair (two independent P's + murmur finalizer) must match
+`emqx_tpu.ops.nfa.word_hash_pair` bit-for-bit; build-time salt handling and
+collision detection live there.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Tuple
+
+import numpy as np
+
+from emqx_tpu.ops.nfa import (
+    P1,
+    P2,
+    VOCAB_H_MUL,
+    VOCAB_H_SHIFT,
+    _SALT1,
+    _SALT2,
+)
+
+SLASH = np.uint8(ord("/"))
+DOLLAR = np.uint8(ord("$"))
+
+
+def _inv_mod_2_32(p: int) -> int:
+    """Modular inverse of odd p mod 2^32 via Newton iteration."""
+    x = p  # 3-bit correct
+    for _ in range(5):
+        x = (x * (2 - p * x)) & 0xFFFFFFFF
+    assert (x * p) & 0xFFFFFFFF == 1
+    return x
+
+
+@lru_cache(maxsize=8)
+def _pow_tables(max_bytes: int) -> Tuple[np.ndarray, ...]:
+    """P^i and P^-i tables, i in [0, max_bytes], for both primes."""
+    out = []
+    for P in (int(P1), int(P2)):
+        inv = _inv_mod_2_32(P)
+        pw = np.empty(max_bytes + 1, dtype=np.uint32)
+        ipw = np.empty(max_bytes + 1, dtype=np.uint32)
+        a = b = 1
+        for i in range(max_bytes + 1):
+            pw[i] = a
+            ipw[i] = b
+            a = (a * P) & 0xFFFFFFFF
+            b = (b * inv) & 0xFFFFFFFF
+        out += [pw, ipw]
+    return tuple(out)
+
+
+def encode_topics(
+    topics: List[bytes | str], max_bytes: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack topics into a zero-padded uint8 matrix.
+
+    -> (bytes_mat uint8 [B, max_bytes], lengths int32 [B], too_long bool [B]).
+    Too-long topics are truncated and flagged (host falls back to the CPU
+    trie for those rows; cf. 64KB cap at emqx_topic.erl ?MAX_TOPIC_LEN).
+    """
+    B = len(topics)
+    mat = np.zeros((B, max_bytes), dtype=np.uint8)
+    lens = np.zeros(B, dtype=np.int32)
+    too_long = np.zeros(B, dtype=bool)
+    for i, t in enumerate(topics):
+        b = t.encode("utf-8", "surrogatepass") if isinstance(t, str) else t
+        n = len(b)
+        if n > max_bytes:
+            too_long[i] = True
+            n = max_bytes
+        mat[i, :n] = np.frombuffer(b[:n], dtype=np.uint8)
+        lens[i] = n
+    return mat, lens, too_long
+
+
+def tokenize_device(bytes_mat, lengths, salt: int, max_levels: int):
+    """jnp: (bytes [B,MB] uint8, lengths [B]) -> word hash pairs per level.
+
+    Returns (h1 [B,L] uint32, h2 [B,L] uint32, nwords [B] int32,
+    is_dollar [B] bool). Rows deeper than `max_levels` report their true
+    nwords; the matcher flags them too_deep.
+    """
+    import jax.numpy as jnp
+
+    B, MB = bytes_mat.shape
+    L = max_levels
+    pw1, ipw1, pw2, ipw2 = (jnp.asarray(t) for t in _pow_tables(MB))
+    cols = jnp.arange(MB, dtype=jnp.int32)
+    inb = cols[None, :] < lengths[:, None]
+    c = bytes_mat.astype(jnp.uint32)
+    issep = inb & (bytes_mat == SLASH)
+    ischar = inb & ~issep
+    # word index per column (separators carry the index of the word they end)
+    segex = jnp.cumsum(issep.astype(jnp.int32), axis=1) - issep.astype(jnp.int32)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+
+    # prefix sums of c_i * P^-i  (uint32, wraps mod 2^32 by construction)
+    u1 = jnp.where(ischar, c * ipw1[cols][None, :], jnp.uint32(0))
+    u2 = jnp.where(ischar, c * ipw2[cols][None, :], jnp.uint32(0))
+    U1 = jnp.cumsum(u1, axis=1, dtype=jnp.uint32)
+    U2 = jnp.cumsum(u2, axis=1, dtype=jnp.uint32)
+
+    # per-word boundaries: scatter separator columns into word slots
+    sep_slot = jnp.where(issep, segex, L)  # L => dropped
+    sepcol = jnp.full((B, L), -1, dtype=jnp.int32)
+    sepcol = sepcol.at[rows, sep_slot].set(
+        jnp.broadcast_to(cols[None, :], (B, MB)), mode="drop"
+    )
+    k = jnp.arange(L, dtype=jnp.int32)[None, :]
+    nsep = jnp.sum(issep, axis=1).astype(jnp.int32)
+    # "" splits to [''] (one empty word), matching emqx_topic:words/1 on host
+    nwords = nsep + 1
+    has_sep = sepcol >= 0
+    wend = jnp.where(has_sep, sepcol - 1, lengths[:, None] - 1)  # [B,L]
+    prev_sep = jnp.concatenate(
+        [jnp.full((B, 1), -1, dtype=jnp.int32), sepcol[:, : L - 1]], axis=1
+    )
+    wstart = prev_sep + 1
+    wlen = wend - wstart + 1  # 0 for empty words
+
+    def word_hash(U, pw, salt_mul, salt_add):
+        e = jnp.clip(wend, 0, MB - 1)
+        s0 = jnp.clip(wstart - 1, 0, MB - 1)
+        Ue = jnp.take_along_axis(U, e, axis=1)
+        Us = jnp.where(
+            wstart > 0, jnp.take_along_axis(U, s0, axis=1), jnp.uint32(0)
+        )
+        raw = (Ue - Us) * pw[e] + pw[jnp.clip(wlen, 0, MB)]
+        seed = jnp.uint32(salt) * salt_mul + salt_add
+        x = raw ^ seed
+        x ^= x >> 16
+        x = x * jnp.uint32(0x7FEB352D)
+        x ^= x >> 15
+        x = x * jnp.uint32(0x846CA68B)
+        x ^= x >> 16
+        return x
+
+    h1 = word_hash(U1, pw1, _SALT1, jnp.uint32(1))
+    h2 = word_hash(U2, pw2, _SALT2, jnp.uint32(7))
+    valid_word = k < jnp.minimum(nwords, L)[:, None]
+    h1 = jnp.where(valid_word, h1, jnp.uint32(0))
+    h2 = jnp.where(valid_word, h2, jnp.uint32(0))
+    is_dollar = (lengths > 0) & (bytes_mat[:, 0] == DOLLAR)
+    return h1, h2, nwords, is_dollar
+
+
+def vocab_lookup_device(tables, h1, h2, probes: int = 8):
+    """jnp: word hash pairs -> dense symbol ids (-1 = out-of-vocabulary)."""
+    import jax.numpy as jnp
+
+    V = tables["vocab_h1"].shape[0]
+    mask = jnp.uint32(V - 1)
+    h = h1 * jnp.uint32(VOCAB_H_MUL)
+    h ^= h >> VOCAB_H_SHIFT
+    sym = jnp.full(h1.shape, -1, dtype=jnp.int32)
+    found = jnp.zeros(h1.shape, dtype=bool)
+    for p in range(probes):
+        idx = ((h + jnp.uint32(p)) & mask).astype(jnp.int32)
+        th1 = tables["vocab_h1"][idx]
+        th2 = tables["vocab_h2"][idx]
+        tsym = tables["vocab_sym"][idx]
+        hit = (th1 == h1) & (th2 == h2) & (tsym >= 0) & ~found
+        sym = jnp.where(hit, tsym, sym)
+        found |= hit
+    return sym

@@ -1,0 +1,22 @@
+"""Node identity (reference analog: the Erlang node() name used in $SYS topics)."""
+
+from __future__ import annotations
+
+import os
+import socket
+
+_node_name: str | None = None
+
+
+def node_name() -> str:
+    global _node_name
+    if _node_name is None:
+        _node_name = os.environ.get(
+            "EMQX_TPU_NODE", f"emqx_tpu@{socket.gethostname()}"
+        )
+    return _node_name
+
+
+def set_node_name(name: str) -> None:
+    global _node_name
+    _node_name = name

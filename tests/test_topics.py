@@ -1,0 +1,90 @@
+"""Topic algebra tests (parity targets: emqx_topic_SUITE behaviors)."""
+
+import pytest
+
+from emqx_tpu.ops import topics as T
+
+
+def test_words():
+    assert T.words("a/b/c") == ["a", "b", "c"]
+    assert T.words("a//b") == ["a", "", "b"]
+    assert T.words("/a") == ["", "a"]
+    assert T.words("a/") == ["a", ""]
+    assert T.words("/") == ["", ""]
+
+
+def test_wildcard():
+    assert not T.wildcard("a/b/c")
+    assert T.wildcard("a/+/c")
+    assert T.wildcard("a/#")
+    assert T.wildcard("#")
+    assert not T.wildcard("a/b+c")  # '+' must be a whole level to be a wildcard op
+
+
+@pytest.mark.parametrize(
+    "name,filt,expect",
+    [
+        ("a/b/c", "a/b/c", True),
+        ("a/b/c", "a/+/c", True),
+        ("a/b/c", "a/#", True),
+        ("a/b/c", "#", True),
+        ("a", "a/#", True),  # '#' matches the parent level itself
+        ("a/b", "a/+", True),
+        ("a/b/c", "a/+", False),
+        ("a", "a/+", False),
+        ("a/b", "a", False),
+        ("a", "a/b", False),
+        ("a/b/c", "a/b/d", False),
+        ("a//c", "a/+/c", True),  # empty level matches '+'
+        ("a//c", "a//c", True),
+        ("$SYS/broker", "#", False),  # $ topics excluded from root wildcards
+        ("$SYS/broker", "+/broker", False),
+        ("$SYS/broker", "$SYS/#", True),
+        ("$SYS/broker", "$SYS/+", True),
+        ("$SYS", "$SYS", True),
+        ("a/$b/c", "a/+/c", True),  # '$' only special at the first level
+        ("a/b/c/d", "a/b/#", True),
+        ("a/b", "a/b/#", True),
+        ("a/b", "a/b/+", False),
+        ("ab/cd", "+/+", True),
+        ("ab/cd", "+", False),
+    ],
+)
+def test_match(name, filt, expect):
+    assert T.match(name, filt) is expect
+
+
+def test_validate():
+    T.validate("a/b/c")
+    T.validate("+/#")
+    T.validate("a/+/b")
+    T.validate("#")
+    T.validate("a//b")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("a/#/b")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("a/b#")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("a/b+")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("a/+b/c")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("a/+/c", kind="name")
+    with pytest.raises(T.TopicValidationError):
+        T.validate("x" * 70000)
+
+
+def test_parse_share():
+    assert T.parse_share("t/1") == (None, "t/1")
+    assert T.parse_share("$share/g1/t/1") == ("g1", "t/1")
+    with pytest.raises(T.TopicValidationError):
+        T.parse_share("$share/g1")
+    with pytest.raises(T.TopicValidationError):
+        T.parse_share("$share/+/t")
+
+
+def test_feed_var_and_join():
+    assert T.join(["a", "b"]) == "a/b"
+    assert T.feed_var("%c", "client1", "a/%c/b") == "a/client1/b"

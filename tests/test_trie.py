@@ -1,0 +1,76 @@
+"""CPU trie tests (parity targets: emqx_trie_SUITE behaviors)."""
+
+import random
+
+from emqx_tpu.broker.trie import TopicTrie
+from emqx_tpu.ops import topics as T
+
+
+def test_insert_match_delete():
+    t = TopicTrie()
+    assert t.insert("a/+/c")
+    assert not t.insert("a/+/c")  # duplicate
+    t.insert("a/b/#")
+    t.insert("a/b/c")
+    t.insert("#")
+    assert sorted(t.match("a/b/c")) == ["#", "a/+/c", "a/b/#", "a/b/c"]
+    assert sorted(t.match("a/b")) == ["#", "a/b/#"]  # '#' parent match
+    assert t.match("x") == ["#"]
+    assert not t.delete("a/+/c")  # still one ref
+    assert t.delete("a/+/c")
+    assert sorted(t.match("a/b/c")) == ["#", "a/b/#", "a/b/c"]
+    assert t.delete("#")
+    assert t.delete("a/b/#")
+    assert t.delete("a/b/c")
+    assert t.is_empty()
+    assert t.match("a/b/c") == []
+
+
+def test_dollar_exclusion():
+    t = TopicTrie()
+    t.insert("#")
+    t.insert("+/monitor")
+    t.insert("$SYS/#")
+    assert t.match("$SYS/monitor") == ["$SYS/#"]
+    assert sorted(t.match("node/monitor")) == ["#", "+/monitor"]
+
+
+def test_empty_levels():
+    t = TopicTrie()
+    t.insert("a/+/c")
+    t.insert("a//c")
+    t.insert("+/+/+")
+    assert sorted(t.match("a//c")) == ["+/+/+", "a/+/c", "a//c"]
+
+
+def test_filters_iter_and_random_consistency():
+    rng = random.Random(7)
+    t = TopicTrie()
+    alphabet = ["a", "b", "c", "+", "dev"]
+    filters = set()
+    for _ in range(300):
+        depth = rng.randint(1, 5)
+        ws = [rng.choice(alphabet) for _ in range(depth)]
+        if rng.random() < 0.3:
+            ws.append("#")
+        f = "/".join(ws)
+        try:
+            T.validate(f)
+        except T.TopicValidationError:
+            continue
+        if f not in filters:
+            t.insert(f)
+            filters.add(f)
+    assert sorted(t.filters()) == sorted(filters)
+    # brute-force differential match on random topics
+    for _ in range(300):
+        topic = "/".join(
+            rng.choice(["a", "b", "c", "dev", "x"])
+            for _ in range(rng.randint(1, 6))
+        )
+        expect = sorted(f for f in filters if T.match(topic, f))
+        assert sorted(t.match(topic)) == expect
+    # delete everything, trie must drain
+    for f in filters:
+        assert t.delete(f)
+    assert t.is_empty()
