@@ -1,0 +1,6 @@
+"""Routing "models": end-to-end jittable pipelines over the NFA tables.
+
+In this framework the analog of a model-family zoo is the family of routing
+pipelines — match-only, match+fanout, shared-group pick — each a pure jittable
+function over compiled tables.
+"""

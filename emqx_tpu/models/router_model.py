@@ -1,0 +1,153 @@
+"""The flagship routing pipeline: topics -> matched filters -> subscriber bitmaps.
+
+This fuses, in one jitted program, what the reference does per message across
+three modules (SURVEY.md §3.3 hot path):
+
+  emqx_router:match_routes  (emqx_router.erl:128-141)  -> NFA batch match
+  emqx_broker:subscribers    (emqx_broker.erl:505-530) -> bitmap gather
+  dispatch fan-out OR-union                            -> segment OR-reduce
+
+Subscriber state is a dense bitmap matrix ``sub_bitmaps [Fcap, W]`` (uint32):
+row = filter id, bit = local subscriber slot. The fanout output for a topic is
+the OR over its matched filters' rows — one gather + reduce, MXU-adjacent
+VPU work that scales with W, and the axis the multi-chip layout shards
+("tensor parallelism" over subscriber lanes; see emqx_tpu.parallel).
+
+Per-batch stats (routed topics, total matches, fanout bits) are computed
+on-device so multi-chip deployments can psum them over the mesh instead of
+funneling counters through a host (reference analog: emqx_metrics counter
+arrays, emqx_metrics.erl:439).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu.ops import tokenizer as tok
+from emqx_tpu.ops.matcher import batch_match_syms
+
+
+def popcount32(x):
+    """Vectorized popcount for uint32 (no TPU popcnt primitive needed)."""
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return (x * jnp.uint32(0x01010101)) >> 24
+
+
+def fanout_bitmaps(sub_bitmaps, matched):
+    """OR the bitmap rows of each topic's matched filters.
+
+    sub_bitmaps: uint32 [Fcap, W]; matched: int32 [B, K]; -> uint32 [B, W].
+    """
+    safe = jnp.maximum(matched, 0)  # [B, K]
+    rows = sub_bitmaps[safe]  # [B, K, W]
+    valid = (matched >= 0)[:, :, None]
+    rows = jnp.where(valid, rows, jnp.uint32(0))
+    # OR-reduce over K (no lax.reduce_or over axis for uint32? use bitwise.reduce)
+    return jax.lax.reduce(
+        rows, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+    )
+
+
+def route_step_impl(
+    tables: Dict,
+    sub_bitmaps,
+    bytes_mat,
+    lengths,
+    *,
+    salt: int,
+    max_levels: int = 16,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+):
+    """Full forward step: tokenize + match + fanout + stats. Jittable.
+
+    Returns dict with matched [B,K], mcount [B], flags [B], bitmaps [B,W],
+    stats {routed, matches, fanout_bits}.
+    """
+    from emqx_tpu.ops.matcher import batch_match_bytes_impl
+
+    matched, mcount, flags = batch_match_bytes_impl(
+        tables,
+        bytes_mat,
+        lengths,
+        salt=salt,
+        max_levels=max_levels,
+        frontier=frontier,
+        max_matches=max_matches,
+        probes=probes,
+    )
+    bitmaps = fanout_bitmaps(sub_bitmaps, matched)
+    stats = {
+        "routed": jnp.sum((mcount > 0).astype(jnp.int32)),
+        "matches": jnp.sum(mcount),
+        "fanout_bits": jnp.sum(popcount32(bitmaps).astype(jnp.int32)),
+    }
+    return {
+        "matched": matched,
+        "mcount": mcount,
+        "flags": flags,
+        "bitmaps": bitmaps,
+        "stats": stats,
+    }
+
+
+route_step = partial(jax.jit, static_argnames=(
+    "salt", "max_levels", "frontier", "max_matches", "probes"
+))(route_step_impl)
+
+
+class SubscriberTable:
+    """Host-side registry: (filter id, subscriber slot) -> bitmap matrix.
+
+    The reference keeps subscribers in per-node ETS bag tables
+    (emqx_broker.erl:98-110). Here each local subscriber gets a dense slot;
+    the bitmap matrix rides to the device alongside the NFA tables.
+    """
+
+    def __init__(self, max_subscribers: int = 1024):
+        self.width_words = (max_subscribers + 31) // 32
+        self._rows: Dict[int, np.ndarray] = {}
+        self._fcap = 64
+        self._dirty = True
+        self._packed: np.ndarray | None = None
+
+    def add(self, filter_id: int, slot: int) -> None:
+        row = self._rows.get(filter_id)
+        if row is None:
+            row = np.zeros(self.width_words, dtype=np.uint32)
+            self._rows[filter_id] = row
+        row[slot // 32] |= np.uint32(1 << (slot % 32))
+        self._dirty = True
+
+    def remove(self, filter_id: int, slot: int) -> None:
+        row = self._rows.get(filter_id)
+        if row is None:
+            return
+        row[slot // 32] &= np.uint32(~(1 << (slot % 32)) & 0xFFFFFFFF)
+        if not row.any():
+            del self._rows[filter_id]
+        self._dirty = True
+
+    def pack(self, filter_capacity: int) -> np.ndarray:
+        # capacity must cover every registered row — dropping one would mean
+        # silent message loss for that filter's subscribers
+        cap = max(64, filter_capacity, max(self._rows, default=0) + 1)
+        if not self._dirty and self._packed is not None and len(self._packed) >= cap:
+            return self._packed
+        while self._fcap < cap:
+            self._fcap *= 2
+        out = np.zeros((self._fcap, self.width_words), dtype=np.uint32)
+        for fid, row in self._rows.items():
+            out[fid] = row
+        self._packed = out
+        self._dirty = False
+        return out

@@ -131,11 +131,14 @@ def batch_match_syms(
     B, L = syms.shape
     F, K = frontier, max_matches
 
-    frontier0 = jnp.full((B, F), -1, dtype=jnp.int32)
-    frontier0 = frontier0.at[:, 0].set(0)  # root
-    matched0 = jnp.full((B, K), -1, dtype=jnp.int32)
-    mcount0 = jnp.zeros(B, dtype=jnp.int32)
-    fover0 = jnp.zeros(B, dtype=bool)
+    # derive carry inits from the inputs so they carry the same device-varying
+    # type as the loop body under shard_map (see shard_map scan-vma docs)
+    z = jnp.zeros_like(nwords)  # [B] int32
+    frontier0 = jnp.full((B, F), -1, dtype=jnp.int32) + z[:, None]
+    frontier0 = frontier0.at[:, 0].set(z)  # root
+    matched0 = jnp.full((B, K), -1, dtype=jnp.int32) + z[:, None]
+    mcount0 = z
+    fover0 = z < 0  # all-False, device-varying
 
     def step(carry, xs):
         fr, matched, mcount, fover = carry
@@ -177,11 +180,7 @@ def batch_match_syms(
     return matched, jnp.minimum(mcount, K), flags
 
 
-@partial(
-    jax.jit,
-    static_argnames=("salt", "max_levels", "frontier", "max_matches", "probes"),
-)
-def batch_match_bytes(
+def batch_match_bytes_impl(
     tables,
     bytes_mat,
     lengths,
@@ -206,6 +205,12 @@ def batch_match_bytes(
         max_matches=max_matches,
         probes=probes,
     )
+
+
+batch_match_bytes = partial(
+    jax.jit,
+    static_argnames=("salt", "max_levels", "frontier", "max_matches", "probes"),
+)(batch_match_bytes_impl)
 
 
 def _pad_pow2(n: int, lo: int = 256) -> int:

@@ -1,0 +1,17 @@
+"""Multi-chip scaling: device mesh, sharded route step, collectives.
+
+The reference scales by running one broker per node with replicated route
+tables and per-topic gen_rpc forwards (SURVEY.md §2.4, §5.8). The TPU-native
+design instead runs ONE logical router SPMD over a `jax.sharding.Mesh`:
+
+- axis ``dp`` — data parallelism over the topic batch (the analog of the
+  reference's hash-sharded router_pool workers, emqx_router.erl:188-189);
+- axis ``tp`` — tensor parallelism over subscriber bitmap lanes (the analog
+  of topic-shard fan-out, emqx_broker_helper.erl:82-91): each chip owns a
+  slice of the subscriber universe and fans out only to its slice;
+- stats ride XLA collectives (psum) over ICI instead of counter RPCs.
+
+NFA tables are replicated (they are read-mostly and small relative to HBM);
+subscriber bitmaps are sharded on the lane axis. Multi-host DCN distribution
+reuses the same program via jax distributed initialization.
+"""

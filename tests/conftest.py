@@ -1,14 +1,20 @@
-"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax imports.
+"""Test env: force JAX onto CPU with 8 virtual devices BEFORE any test runs.
 
 Mirrors the reference's multi-node-without-a-cluster strategy (SURVEY.md §4:
 in-CT slave nodes) — sharding/collective tests run on a virtual 8-device mesh.
+
+Note: the `axon` TPU plugin in this image overrides the JAX_PLATFORMS env
+var, so we must force the platform through jax.config after import.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
