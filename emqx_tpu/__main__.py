@@ -1,0 +1,60 @@
+"""Broker entrypoint: `python -m emqx_tpu [--port 1883]`.
+
+The `bin/emqx foreground` analog (reference: bin/emqx:75-110). Boots the
+broker kernel, channel manager, and TCP listener on one asyncio loop and
+runs until SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="emqx_tpu", description=__doc__)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument(
+        "--no-tpu", action="store_true",
+        help="route on the CPU trie only (skip JAX/TPU engine)",
+    )
+    ap.add_argument(
+        "--min-tpu-batch", type=int, default=64,
+        help="publish batch size at which routing moves to the TPU kernel",
+    )
+    args = ap.parse_args(argv)
+    return asyncio.run(serve(args))
+
+
+async def serve(args) -> int:
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.transport.listener import ListenerConfig, Listeners
+
+    router = Router(
+        enable_tpu=not args.no_tpu, min_tpu_batch=args.min_tpu_batch
+    )
+    broker = Broker(router=router)
+    cm = ChannelManager(broker)
+    listeners = Listeners(broker, cm)
+    l = await listeners.start_listener(
+        ListenerConfig(bind=args.host, port=args.port)
+    )
+    print(f"emqx_tpu broker listening on {args.host}:{l.port}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("shutting down", flush=True)
+    await listeners.stop_all()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

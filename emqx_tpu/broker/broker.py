@@ -1,0 +1,157 @@
+"""The pub/sub kernel: subscribe/unsubscribe/publish/dispatch.
+
+Parity with the reference kernel (apps/emqx/src/emqx_broker.erl):
+- subscribe/unsubscribe maintain the subscriber registry + route table
+  (emqx_broker.erl:127-160 ETS inserts + :441-454 route add)
+- publish runs the 'message.publish' fold, matches routes, and dispatches
+  to local subscribers (:204-215 publish, :505-530 do_dispatch)
+- publish_batch is the TPU-era addition: many topics matched in one device
+  kernel, then fanned out (the reference has no batch path — its hot loop
+  is per-message, which is exactly what this design replaces)
+
+Dispatch hands (session, opts, msg) triples to each subscriber's channel via
+the session's registered deliver callback. Shared-subscription groups
+($share/g/t) are delegated to SharedSub.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from emqx_tpu.broker.hooks import Hooks, default_hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.shared_sub import SharedSub
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.ops import topics as T
+
+# deliverer: called with (msg, subopts); returns True if accepted
+Deliverer = Callable[[Message, pkt.SubOpts], None]
+
+
+class Subscriber:
+    __slots__ = ("sid", "deliver", "opts", "client_id")
+
+    def __init__(self, sid: str, client_id: str, deliver: Deliverer, opts: pkt.SubOpts):
+        self.sid = sid
+        self.client_id = client_id
+        self.deliver = deliver
+        self.opts = opts
+
+
+class Broker:
+    def __init__(
+        self,
+        router: Optional[Router] = None,
+        hooks: Optional[Hooks] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.router = router or Router()
+        self.hooks = hooks or default_hooks
+        self.metrics = metrics or Metrics()
+        # filter -> {sid -> Subscriber}
+        self._subs: Dict[str, Dict[str, Subscriber]] = {}
+        self.shared = SharedSub()
+
+    # -- subscribe side ---------------------------------------------------
+    def subscribe(
+        self,
+        sid: str,
+        client_id: str,
+        filter_: str,
+        opts: pkt.SubOpts,
+        deliver: Deliverer,
+    ) -> None:
+        group, real = T.parse_share(filter_)
+        sub = Subscriber(sid, client_id, deliver, opts)
+        if group is not None:
+            self.shared.subscribe(group, real, sub)
+            route_key = self.shared.route_filter(group, real)
+        else:
+            entry = self._subs.setdefault(real, {})
+            first = not entry
+            entry[sid] = sub
+            route_key = real if first else None
+        if route_key is not None:
+            self.router.add_route(route_key)
+        self.metrics.gauge_set("subscriptions.count", self.subscription_count())
+
+    def unsubscribe(self, sid: str, filter_: str) -> bool:
+        group, real = T.parse_share(filter_)
+        if group is not None:
+            removed, empty = self.shared.unsubscribe(group, real, sid)
+            if empty:
+                self.router.delete_route(self.shared.route_filter(group, real))
+            return removed
+        entry = self._subs.get(real)
+        if not entry or sid not in entry:
+            return False
+        del entry[sid]
+        if not entry:
+            del self._subs[real]
+            self.router.delete_route(real)
+        self.metrics.gauge_set("subscriptions.count", self.subscription_count())
+        return True
+
+    def subscription_count(self) -> int:
+        return sum(len(v) for v in self._subs.values()) + self.shared.count()
+
+    def subscriptions(self) -> List[Tuple[str, str, pkt.SubOpts]]:
+        out = []
+        for f, entry in self._subs.items():
+            for sub in entry.values():
+                out.append((sub.client_id, f, sub.opts))
+        out.extend(self.shared.subscriptions())
+        return out
+
+    # -- publish side -----------------------------------------------------
+    def publish(self, msg: Message) -> int:
+        """Route + dispatch one message; returns delivery count."""
+        msg = self.hooks.run_fold("message.publish", (), msg)
+        if msg is None or msg.headers.get("allow_publish") is False:
+            self.metrics.inc("messages.dropped")
+            return 0
+        n = self._route_dispatch(msg, self.router.match(msg.topic))
+        if n == 0:
+            self.hooks.run("message.dropped", msg, "no_subscribers")
+            self.metrics.inc("messages.dropped.no_subscribers")
+        return n
+
+    def publish_batch(self, msgs: Sequence[Message]) -> int:
+        """Batch publish: one TPU kernel for all topics, then fan out."""
+        msgs2: List[Message] = []
+        for m in msgs:
+            m = self.hooks.run_fold("message.publish", (), m)
+            if m is not None and m.headers.get("allow_publish") is not False:
+                msgs2.append(m)
+        matches = self.router.match_batch([m.topic for m in msgs2])
+        total = 0
+        for m, filters in zip(msgs2, matches):
+            n = self._route_dispatch(m, filters)
+            if n == 0:
+                self.hooks.run("message.dropped", m, "no_subscribers")
+            total += n
+        return total
+
+    def _route_dispatch(self, msg: Message, filters: List[str]) -> int:
+        self.metrics.inc("messages.received")
+        n = 0
+        for f in filters:
+            # one matched filter may carry plain subscribers AND shared groups
+            entry = self._subs.get(f)
+            if entry:
+                for sub in list(entry.values()):
+                    if sub.opts.no_local and sub.client_id == msg.from_client:
+                        continue
+                    sub.deliver(msg, sub.opts)
+                    n += 1
+            n += self.shared.dispatch_groups(f, msg)
+        if n:
+            self.metrics.inc("messages.delivered", n)
+        return n
+
+    def drop_session_subs(self, sid: str, filters: Sequence[str]) -> None:
+        """Bulk cleanup when a session dies (emqx_broker_helper pmon parity)."""
+        for f in list(filters):
+            self.unsubscribe(sid, f)

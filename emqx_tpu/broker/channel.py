@@ -1,0 +1,446 @@
+"""MQTT protocol state machine, transport-agnostic.
+
+Parity with the reference's emqx_channel (apps/emqx/src/emqx_channel.erl):
+CONNECT handshake with authentication hook (:303-380), publish pipeline with
+authz + QoS1/2 acks (:567-666), SUBSCRIBE/UNSUBSCRIBE (:455-502), deliver ->
+session -> outgoing (:806-939), takeover/kick (:1015+), will message, and
+the client.*/session.*/message.* hookpoints along the way.
+
+Sans-IO: the transport provides a `sink` with send_packet(p)/close(reason);
+timers call `tick()`. The channel never touches sockets, so the same state
+machine serves TCP, TLS, WebSocket and in-process tests.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.session import Session, SessionConfig
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.ops import topics as T
+
+
+@dataclass
+class MqttCaps:
+    """Negotiable capability limits (reference: emqx_mqtt_caps.erl)."""
+
+    max_packet_size: int = 1024 * 1024
+    max_clientid_len: int = 65535
+    max_topic_levels: int = 128
+    max_qos_allowed: int = 2
+    retain_available: bool = True
+    wildcard_subscription: bool = True
+    shared_subscription: bool = True
+    max_topic_alias: int = 65535
+
+
+@dataclass
+class ChannelConfig:
+    caps: MqttCaps = field(default_factory=MqttCaps)
+    session: SessionConfig = field(default_factory=SessionConfig)
+    idle_timeout: float = 15.0
+    enable_stats: bool = True
+
+
+class Channel:
+    def __init__(
+        self,
+        broker: Broker,
+        cm,
+        sink,
+        conninfo: Optional[Dict] = None,
+        config: Optional[ChannelConfig] = None,
+    ):
+        self.broker = broker
+        self.cm = cm
+        self.sink = sink
+        self.hooks: Hooks = broker.hooks
+        self.conninfo = conninfo or {}
+        self.config = config or ChannelConfig()
+        self.state = "idle"
+        self.version = pkt.MQTT_V4
+        self.client_id = ""
+        self.username: Optional[str] = None
+        self.keepalive = 0
+        self.clean_start = True
+        self.session: Optional[Session] = None
+        self.will: Optional[pkt.Will] = None
+        self.connected_at: Optional[float] = None
+        self.disconnect_reason: Optional[str] = None
+        self.topic_aliases: Dict[int, str] = {}  # inbound alias -> topic
+
+    # -- helpers ----------------------------------------------------------
+    def _send(self, p) -> None:
+        self.sink.send_packet(p)
+        self.broker.metrics.inc("packets.sent")
+
+    def _close(self, reason: str, rc: Optional[int] = None) -> None:
+        if rc is not None and self.version == pkt.MQTT_V5 and self.state == "connected":
+            self._send(pkt.Disconnect(reason_code=rc))
+        self.disconnect_reason = reason
+        self.sink.close(reason)
+
+    def client_info(self) -> Dict:
+        return {
+            "client_id": self.client_id,
+            "username": self.username,
+            "proto_ver": self.version,
+            "clean_start": self.clean_start,
+            "keepalive": self.keepalive,
+            **self.conninfo,
+        }
+
+    # -- inbound dispatch -------------------------------------------------
+    def handle_in(self, p) -> None:
+        self.broker.metrics.inc("packets.received")
+        t = p.type
+        if self.state == "idle":
+            if t != pkt.CONNECT:
+                return self._close("protocol_error")
+            return self._in_connect(p)
+        if t == pkt.CONNECT:  # duplicate CONNECT is a protocol error
+            return self._close("protocol_error", pkt.RC_PROTOCOL_ERROR)
+        if t == pkt.PUBLISH:
+            return self._in_publish(p)
+        if t == pkt.PUBACK:
+            found, more = self.session.puback(p.packet_id)
+            if found:
+                self.hooks.run("message.acked", self.client_info(), p.packet_id)
+            for q in more:
+                self._send(q)
+            return
+        if t == pkt.PUBREC:
+            if self.session.pubrec(p.packet_id):
+                rel = pkt.PubAck(packet_id=p.packet_id)
+                rel.type = pkt.PUBREL
+                self._send(rel)
+            else:
+                rel = pkt.PubAck(
+                    packet_id=p.packet_id,
+                    reason_code=pkt.RC_PACKET_IDENTIFIER_NOT_FOUND,
+                )
+                rel.type = pkt.PUBREL
+                self._send(rel)
+            return
+        if t == pkt.PUBREL:
+            ok = self.session.release_rel(p.packet_id)
+            comp = pkt.PubAck(
+                packet_id=p.packet_id,
+                reason_code=pkt.RC_SUCCESS
+                if ok
+                else pkt.RC_PACKET_IDENTIFIER_NOT_FOUND,
+            )
+            comp.type = pkt.PUBCOMP
+            self._send(comp)
+            return
+        if t == pkt.PUBCOMP:
+            _, more = self.session.pubcomp(p.packet_id)
+            for q in more:
+                self._send(q)
+            return
+        if t == pkt.SUBSCRIBE:
+            return self._in_subscribe(p)
+        if t == pkt.UNSUBSCRIBE:
+            return self._in_unsubscribe(p)
+        if t == pkt.PINGREQ:
+            return self._send(pkt.PingResp())
+        if t == pkt.DISCONNECT:
+            return self._in_disconnect(p)
+        if t == pkt.AUTH:
+            # enhanced auth is negotiated via Authentication-Method; none
+            # configured => protocol error (emqx_channel enhanced auth parity)
+            return self._close("auth_not_supported", pkt.RC_BAD_AUTHENTICATION_METHOD)
+        self._close("unexpected_packet")
+
+    # -- CONNECT ----------------------------------------------------------
+    def _in_connect(self, p: pkt.Connect) -> None:
+        self.version = p.proto_ver
+        self.clean_start = p.clean_start
+        self.keepalive = p.keepalive
+        self.username = p.username
+        self.will = p.will
+        client_id = p.client_id
+        assigned = None
+        if not client_id:
+            if not p.clean_start and self.version < pkt.MQTT_V5:
+                return self._connack_error(pkt.RC_CLIENT_IDENTIFIER_NOT_VALID)
+            client_id = assigned = "emqx_tpu_" + secrets.token_hex(8)
+        if len(client_id) > self.config.caps.max_clientid_len:
+            return self._connack_error(pkt.RC_CLIENT_IDENTIFIER_NOT_VALID)
+        self.client_id = client_id
+
+        self.hooks.run("client.connect", self.client_info(), p)
+        # authenticate: fold over providers; None acc => allow
+        auth = self.hooks.run_fold(
+            "client.authenticate",
+            (self.client_info(), {"password": p.password}),
+            None,
+        )
+        if isinstance(auth, dict) and auth.get("result") == "deny":
+            self.hooks.run(
+                "client.connack", self.client_info(), "not_authorized"
+            )
+            return self._connack_error(
+                auth.get("reason_code", pkt.RC_NOT_AUTHORIZED)
+            )
+
+        session, present = self.cm.open_session(self)
+        self.session = session
+        if self.version == pkt.MQTT_V5:
+            # v5 default expiry is 0 unless the client asks otherwise
+            session.config.expiry_interval = p.properties.get(
+                "Session-Expiry-Interval", 0
+            )
+        elif self.clean_start:
+            session.config.expiry_interval = 0
+        self.state = "connected"
+        self.connected_at = time.time()
+        props: pkt.Properties = {}
+        if self.version == pkt.MQTT_V5:
+            if assigned:
+                props["Assigned-Client-Identifier"] = assigned
+            props["Shared-Subscription-Available"] = 1
+            props["Wildcard-Subscription-Available"] = 1
+            props["Retain-Available"] = int(self.config.caps.retain_available)
+        self.hooks.run("client.connack", self.client_info(), "success")
+        self._send(
+            pkt.Connack(
+                session_present=present,
+                reason_code=pkt.RC_SUCCESS
+                if self.version == pkt.MQTT_V5
+                else pkt.CONNACK_ACCEPT,
+                properties=props,
+            )
+        )
+        self.hooks.run("client.connected", self.client_info())
+        if present:
+            for q in self.session.replay():
+                self._send(q)
+
+    def _connack_error(self, rc: int) -> None:
+        code = rc if self.version == pkt.MQTT_V5 else pkt.connack_compat(rc)
+        self._send(pkt.Connack(session_present=False, reason_code=code))
+        self._close("connack_error_%#x" % rc)
+
+    # -- PUBLISH ----------------------------------------------------------
+    def _in_publish(self, p: pkt.Publish) -> None:
+        topic = p.topic
+        # MQTT5 topic alias resolution (emqx_channel packet pipeline :567-576)
+        alias = p.properties.get("Topic-Alias") if self.version == pkt.MQTT_V5 else None
+        if alias is not None:
+            if alias == 0 or alias > self.config.caps.max_topic_alias:
+                return self._close("topic_alias_invalid", pkt.RC_TOPIC_ALIAS_INVALID)
+            if topic:
+                self.topic_aliases[alias] = topic
+            else:
+                topic = self.topic_aliases.get(alias)
+                if topic is None:
+                    return self._close(
+                        "unknown_topic_alias", pkt.RC_PROTOCOL_ERROR
+                    )
+        try:
+            T.validate(topic, kind="name")
+        except T.TopicValidationError:
+            return self._close("invalid_topic", pkt.RC_TOPIC_NAME_INVALID)
+        if len(T.words(topic)) > self.config.caps.max_topic_levels:
+            return self._close("too_many_levels", pkt.RC_TOPIC_NAME_INVALID)
+        if p.qos > self.config.caps.max_qos_allowed:
+            return self._close("qos_not_supported", pkt.RC_QOS_NOT_SUPPORTED)
+        if p.retain and not self.config.caps.retain_available:
+            return self._close("retain_disabled", pkt.RC_RETAIN_NOT_SUPPORTED)
+
+        allowed = self.hooks.run_fold(
+            "client.authorize", (self.client_info(), "publish", topic), "allow"
+        )
+        if allowed != "allow":
+            self.broker.metrics.inc("messages.dropped.not_authorized")
+            if p.qos == 0:
+                return  # silently drop (emqx default for qos0 deny)
+            ack = pkt.PubAck(
+                packet_id=p.packet_id, reason_code=pkt.RC_NOT_AUTHORIZED
+            )
+            ack.type = pkt.PUBACK if p.qos == 1 else pkt.PUBREC
+            return self._send(ack)
+
+        msg = Message(
+            topic=topic,
+            payload=p.payload,
+            qos=p.qos,
+            retain=p.retain,
+            from_client=self.client_id,
+            from_username=self.username,
+            properties={
+                k: v for k, v in p.properties.items() if k != "Topic-Alias"
+            },
+        )
+        if p.qos == 0:
+            self.broker.publish(msg)
+            return
+        if p.qos == 1:
+            n = self.broker.publish(msg)
+            rc = pkt.RC_SUCCESS
+            if n == 0 and self.version == pkt.MQTT_V5:
+                rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
+            return self._send(pkt.PubAck(packet_id=p.packet_id, reason_code=rc))
+        # QoS2: publish on first sight of the packet id, dedupe on DUP resend
+        try:
+            fresh = self.session.await_rel(p.packet_id)
+        except OverflowError:
+            return self._close("receive_max", pkt.RC_RECEIVE_MAXIMUM_EXCEEDED)
+        rc = pkt.RC_SUCCESS
+        if fresh:
+            n = self.broker.publish(msg)
+            if n == 0 and self.version == pkt.MQTT_V5:
+                rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
+        rec = pkt.PubAck(packet_id=p.packet_id, reason_code=rc)
+        rec.type = pkt.PUBREC
+        self._send(rec)
+
+    # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
+    def _in_subscribe(self, p: pkt.Subscribe) -> None:
+        self.hooks.run("client.subscribe", self.client_info(), p.filters)
+        rcs: List[int] = []
+        for f, opts in p.filters:
+            try:
+                T.validate(f)
+                group, real = T.parse_share(f)
+                if group is not None and not self.config.caps.shared_subscription:
+                    rcs.append(pkt.RC_SHARED_SUBSCRIPTIONS_NOT_SUPPORTED)
+                    continue
+                if T.wildcard(real if group else f) and not self.config.caps.wildcard_subscription:
+                    rcs.append(pkt.RC_WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED)
+                    continue
+            except T.TopicValidationError:
+                rcs.append(pkt.RC_TOPIC_FILTER_INVALID)
+                continue
+            allowed = self.hooks.run_fold(
+                "client.authorize", (self.client_info(), "subscribe", f), "allow"
+            )
+            if allowed != "allow":
+                rcs.append(pkt.RC_NOT_AUTHORIZED)
+                continue
+            qos = min(opts.qos, self.config.caps.max_qos_allowed)
+            opts.qos = qos
+            self.broker.subscribe(
+                self.client_id, self.client_id, f, opts, self._make_deliverer(opts)
+            )
+            self.session.subscriptions[f] = opts
+            self.hooks.run(
+                "session.subscribed", self.client_info(), f, opts
+            )
+            rcs.append(qos)  # granted qos == success codes 0..2
+        self._send(pkt.Suback(packet_id=p.packet_id, reason_codes=rcs))
+
+    def _make_deliverer(self, opts: pkt.SubOpts):
+        def deliver(msg: Message, subopts: pkt.SubOpts) -> None:
+            self.handle_deliver(msg, subopts)
+
+        return deliver
+
+    def _in_unsubscribe(self, p: pkt.Unsubscribe) -> None:
+        self.hooks.run("client.unsubscribe", self.client_info(), p.filters)
+        rcs: List[int] = []
+        for f in p.filters:
+            existed = self.broker.unsubscribe(self.client_id, f)
+            self.session.subscriptions.pop(f, None)
+            if existed:
+                self.hooks.run("session.unsubscribed", self.client_info(), f)
+                rcs.append(pkt.RC_SUCCESS)
+            else:
+                rcs.append(pkt.RC_NO_SUBSCRIPTION_EXISTED)
+        self._send(pkt.Unsuback(packet_id=p.packet_id, reason_codes=rcs))
+
+    # -- DISCONNECT / close ------------------------------------------------
+    def _in_disconnect(self, p: pkt.Disconnect) -> None:
+        if p.reason_code == pkt.RC_SUCCESS:
+            self.will = None  # normal disconnect discards the will
+        expiry = p.properties.get("Session-Expiry-Interval")
+        if expiry is not None and self.session is not None:
+            self.session.config.expiry_interval = expiry
+        self.state = "disconnected"
+        self._close("normal")
+
+    def on_sock_closed(self, reason: str = "sock_closed") -> None:
+        """Transport-level close (also the abnormal path: publish will)."""
+        if self.state == "idle":
+            return
+        was_connected = self.state == "connected"
+        self.state = "disconnected"
+        if was_connected and self.will is not None:
+            self._publish_will()
+        self.hooks.run(
+            "client.disconnected", self.client_info(), self.disconnect_reason or reason
+        )
+        self.cm.on_channel_closed(self, reason)
+
+    def _publish_will(self) -> None:
+        w = self.will
+        self.will = None
+        try:
+            T.validate(w.topic, kind="name")
+        except T.TopicValidationError:
+            return
+        self.broker.publish(
+            Message(
+                topic=w.topic,
+                payload=w.payload,
+                qos=w.qos,
+                retain=w.retain,
+                from_client=self.client_id,
+                properties=dict(w.properties),
+            )
+        )
+
+    # -- outbound deliveries ----------------------------------------------
+    def handle_deliver(self, msg: Message, opts: pkt.SubOpts) -> None:
+        if self.state != "connected" or self.session is None:
+            # connection-less window (e.g. between takeover begin/end):
+            # park in the session queue for replay
+            if self.session is not None and msg.qos > 0:
+                self.session.mqueue.in_(msg)
+            return
+        out = self.session.deliver(msg, opts)
+        for q in out:
+            self.hooks.run("message.delivered", self.client_info(), msg)
+            self._send(q)
+
+    # -- timers ------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """Periodic work: QoS retry + awaiting_rel expiry."""
+        if self.session is None:
+            return
+        for q in self.session.retry():
+            self._send(q)
+        now = now or time.time()
+        timeout = self.session.config.await_rel_timeout
+        expired = [
+            pid
+            for pid, ts in self.session.awaiting_rel.items()
+            if now - ts > timeout
+        ]
+        for pid in expired:
+            del self.session.awaiting_rel[pid]
+
+    # -- takeover / kick ---------------------------------------------------
+    def kick(self, reason: str) -> Optional[Session]:
+        """Forcibly close; returns the session for takeover if requested."""
+        session = self.session
+        if self.state == "connected":
+            rc = (
+                pkt.RC_SESSION_TAKEN_OVER
+                if reason == "takenover"
+                else pkt.RC_ADMINISTRATIVE_ACTION
+            )
+            if self.version == pkt.MQTT_V5:
+                self._send(pkt.Disconnect(reason_code=rc))
+        self.state = "disconnected"
+        self.disconnect_reason = reason
+        self.session = None
+        self.sink.close(reason)
+        return session

@@ -1,0 +1,116 @@
+"""Channel manager: clientid -> channel registry, session open/takeover/discard.
+
+Parity with the reference (apps/emqx/src/emqx_cm.erl:245-273 open_session
+with clean-start discard, :346-366 takeover_session; registry tables
+:104-113). The reference serializes per-clientid races with a cluster-wide
+locker; here a single asyncio loop owns the registry, so the lock is the
+loop itself (no await points inside open_session).
+
+Detached sessions (clients gone, expiry_interval > 0) are parked for resume,
+the emqx_cm session-expiry analog; `sweep_expired` is the GC.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.session import Session, SessionConfig
+
+
+class ChannelManager:
+    def __init__(self, broker: Broker):
+        self.broker = broker
+        self._channels: Dict[str, object] = {}  # client_id -> Channel
+        self._detached: Dict[str, Tuple[Session, float]] = {}
+
+    def get_channel(self, client_id: str):
+        return self._channels.get(client_id)
+
+    def channel_count(self) -> int:
+        return len(self._channels)
+
+    def client_ids(self) -> List[str]:
+        return list(self._channels)
+
+    # -- session lifecycle -------------------------------------------------
+    def open_session(self, channel) -> Tuple[Session, bool]:
+        """-> (session, session_present). Handles discard and takeover."""
+        cid = channel.client_id
+        old = self._channels.pop(cid, None)
+        session: Optional[Session] = None
+        present = False
+        if channel.clean_start:
+            if old is not None:
+                self._discard_channel(old)
+            self._drop_detached(cid)
+        else:
+            if old is not None:
+                session = old.kick("takenover")
+                self.broker.hooks.run("session.takenover", cid)
+                present = session is not None
+            elif cid in self._detached:
+                session, _ = self._detached.pop(cid)
+                self.broker.hooks.run("session.resumed", cid)
+                present = True
+        if session is None:
+            session = Session(cid, channel.config.session)
+            self.broker.hooks.run("session.created", cid)
+        else:
+            # rebind broker deliverers from the old channel to the new one
+            for f, opts in session.subscriptions.items():
+                self.broker.subscribe(
+                    cid, cid, f, opts, channel._make_deliverer(opts)
+                )
+        self._channels[cid] = channel
+        self.broker.metrics.gauge_set("connections.count", len(self._channels))
+        return session, present
+
+    def _discard_channel(self, old) -> None:
+        sess = old.kick("discarded")
+        if sess is not None:
+            self.broker.drop_session_subs(
+                sess.client_id, list(sess.subscriptions)
+            )
+        self.broker.hooks.run("session.discarded", old.client_id)
+
+    def _drop_detached(self, cid: str) -> None:
+        ent = self._detached.pop(cid, None)
+        if ent is not None:
+            sess, _ = ent
+            self.broker.drop_session_subs(cid, list(sess.subscriptions))
+            self.broker.hooks.run("session.discarded", cid)
+
+    def on_channel_closed(self, channel, reason: str) -> None:
+        cid = channel.client_id
+        if self._channels.get(cid) is not channel:
+            return  # already replaced by takeover/discard
+        del self._channels[cid]
+        self.broker.metrics.gauge_set("connections.count", len(self._channels))
+        sess = channel.session
+        if sess is None:
+            return
+        expiry = sess.config.expiry_interval
+        if expiry > 0:
+            self._detached[cid] = (sess, time.time() + expiry)
+        else:
+            self.broker.drop_session_subs(cid, list(sess.subscriptions))
+            self.broker.hooks.run("session.terminated", cid, reason)
+
+    def kick_client(self, client_id: str) -> bool:
+        """Administrative kick (mgmt API / CLI)."""
+        ch = self._channels.pop(client_id, None)
+        if ch is None:
+            return False
+        sess = ch.kick("kicked")
+        if sess is not None:
+            self.broker.drop_session_subs(client_id, list(sess.subscriptions))
+        return True
+
+    def sweep_expired(self, now: Optional[float] = None) -> int:
+        now = now or time.time()
+        gone = [cid for cid, (_, dl) in self._detached.items() if dl <= now]
+        for cid in gone:
+            self._drop_detached(cid)
+        return len(gone)

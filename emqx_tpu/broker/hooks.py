@@ -1,0 +1,94 @@
+"""Hook registry: priority-ordered callback chains per hookpoint.
+
+Parity with the reference's extension spine (apps/emqx/src/emqx_hooks.erl:
+30-41 API, 163-196 run/run_fold with 'stop' short-circuit). Every extension
+in the reference attaches here (authn/authz, rule engine, retainer, exhook —
+SURVEY.md §2 L4); this framework keeps the same contract so extensions stay
+decoupled from the broker kernel.
+
+Hookpoint names mirror the canonical enumeration in the reference's
+exhook.proto (apps/emqx_exhook/priv/protos/exhook.proto:27-69):
+client.connect/connack/connected/disconnected/authenticate/authorize/
+subscribe/unsubscribe, session.created/subscribed/unsubscribed/resumed/
+discarded/takenover/terminated, message.publish/delivered/acked/dropped,
+delivery.dropped/completed.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class StopAndReturn(Exception):
+    """Raised by a callback to short-circuit a fold with a final value."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+STOP = object()  # sentinel return: stop the chain (keep current acc)
+
+
+class Hooks:
+    def __init__(self) -> None:
+        self._table: Dict[str, List[Tuple[int, str, Callable]]] = {}
+
+    def add(
+        self,
+        name: str,
+        callback: Callable,
+        priority: int = 0,
+        tag: Optional[str] = None,
+    ) -> None:
+        """Register; higher priority runs first (emqx_hooks.erl ordering)."""
+        chain = self._table.setdefault(name, [])
+        tag = tag or getattr(callback, "__qualname__", repr(callback))
+        chain.append((priority, tag, callback))
+        chain.sort(key=lambda e: -e[0])
+
+    def delete(self, name: str, callback_or_tag) -> None:
+        chain = self._table.get(name, [])
+        self._table[name] = [
+            e
+            for e in chain
+            if e[2] is not callback_or_tag and e[1] != callback_or_tag
+        ]
+
+    def run(self, name: str, *args) -> None:
+        """Run all callbacks; a STOP return short-circuits."""
+        for _, _, cb in self._table.get(name, ()):  # snapshot-free; small N
+            if cb(*args) is STOP:
+                return
+
+    def run_fold(self, name: str, args: tuple, acc: Any) -> Any:
+        """Fold acc through the chain.
+
+        Callback returns: None (keep acc) | ('ok', new_acc) | STOP |
+        ('stop', final_acc); or raises StopAndReturn(final).
+        """
+        for _, _, cb in self._table.get(name, ()):
+            try:
+                r = cb(*args, acc)
+            except StopAndReturn as s:
+                return s.value
+            if r is None or r is True:
+                continue
+            if r is STOP:
+                return acc
+            if isinstance(r, tuple) and len(r) == 2:
+                kind, val = r
+                if kind == "ok":
+                    acc = val
+                    continue
+                if kind == "stop":
+                    return val
+            acc = r  # plain new acc
+        return acc
+
+    def callbacks(self, name: str):
+        return list(self._table.get(name, ()))
+
+
+# process-global default registry (the reference's hooks are node-global)
+default_hooks = Hooks()

@@ -1,0 +1,60 @@
+"""Inflight window for QoS1/2 deliveries (reference: emqx_inflight.erl).
+
+Insertion-ordered dict keyed by packet id; entries carry the message, send
+timestamp, and the QoS2 state ('publish' sent vs 'pubrel' phase)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Tuple
+
+from emqx_tpu.broker.message import Message
+
+
+@dataclass
+class InflightEntry:
+    msg: Optional[Message]  # None once PUBREC received (QoS2 rel phase)
+    phase: str  # 'publish' | 'pubrel'
+    ts: float
+
+
+class Inflight:
+    def __init__(self, max_size: int = 32):
+        self.max_size = max_size
+        self._d: Dict[int, InflightEntry] = {}
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def is_full(self) -> bool:
+        return self.max_size > 0 and len(self._d) >= self.max_size
+
+    def contains(self, packet_id: int) -> bool:
+        return packet_id in self._d
+
+    def insert(self, packet_id: int, msg: Message, phase: str = "publish"):
+        self._d[packet_id] = InflightEntry(msg, phase, time.time())
+
+    def update(self, packet_id: int, phase: str) -> bool:
+        e = self._d.get(packet_id)
+        if e is None:
+            return False
+        e.phase = phase
+        e.ts = time.time()
+        if phase == "pubrel":
+            e.msg = None  # payload no longer needed after PUBREC
+        return True
+
+    def delete(self, packet_id: int) -> Optional[InflightEntry]:
+        return self._d.pop(packet_id, None)
+
+    def items(self) -> Iterator[Tuple[int, InflightEntry]]:
+        return iter(list(self._d.items()))
+
+    def retry_due(self, interval: float, now: Optional[float] = None):
+        """Entries older than `interval` seconds, for retransmission."""
+        now = now or time.time()
+        return [
+            (pid, e) for pid, e in self._d.items() if now - e.ts >= interval
+        ]

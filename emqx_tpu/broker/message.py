@@ -1,0 +1,37 @@
+"""Internal message record (reference: apps/emqx/src/emqx_message.erl #message{}).
+
+Carries GUID id, qos, origin, flags, headers (extension scratch), topic,
+payload, and creation/expiry timestamps.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from emqx_tpu.utils.guid import next_guid
+
+
+@dataclass
+class Message:
+    topic: str
+    payload: bytes = b""
+    qos: int = 0
+    retain: bool = False
+    dup: bool = False
+    from_client: str = ""
+    from_username: Optional[str] = None
+    mid: int = field(default_factory=next_guid)
+    headers: Dict = field(default_factory=dict)
+    properties: Dict = field(default_factory=dict)
+    timestamp: float = field(default_factory=time.time)
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        exp = self.properties.get("Message-Expiry-Interval")
+        if exp is None:
+            return False
+        return (now or time.time()) > self.timestamp + exp
+
+    def is_sys(self) -> bool:
+        return self.topic.startswith("$SYS/")

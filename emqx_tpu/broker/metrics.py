@@ -1,0 +1,44 @@
+"""Broker metrics: counters + gauges.
+
+Parity with the reference's counter families (apps/emqx/src/emqx_metrics.erl:
+89-104: bytes/packets/messages/deliveries; emqx_stats.erl gauges). Names use
+the reference's dotted style so the management API and Prometheus exporter
+surface familiar series."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def get(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        self._gauges[name] = value
+
+    def gauge(self, name: str) -> float:
+        return self._gauges.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+        out.update(self._gauges)
+        out["uptime_seconds"] = time.time() - self.started_at
+        return out
+
+
+default_metrics = Metrics()

@@ -1,0 +1,93 @@
+"""Route table: exact-topic index + wildcard trie + TPU batch engine.
+
+Parity with the reference's split storage (apps/emqx/src/emqx_router.erl:
+111-125: plain topics go straight into the route table via dirty insert,
+wildcard topics also enter the trie inside a transaction; match =
+trie match + direct lookup, :128-141):
+
+- exact (non-wildcard) filters: refcounted dict, O(1) lookup per topic;
+- wildcard filters: the authoritative CPU trie (`TopicTrie`);
+- BOTH feed the `NfaBuilder`, so the TPU batch path resolves every filter
+  kind in one kernel and the CPU path is only a correctness
+  fallback/small-batch shortcut.
+
+`match_batch` picks the TPU path when the batch is big enough to amortize a
+dispatch (min_tpu_batch), mirroring how the reference splits work between
+the caller process and the router worker pool (emqx_router.erl:188-189).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from emqx_tpu.broker.trie import TopicTrie
+from emqx_tpu.ops import topics as T
+from emqx_tpu.ops.matcher import MatcherConfig, TpuMatcher
+from emqx_tpu.ops.nfa import NfaBuilder
+
+
+class Router:
+    def __init__(
+        self,
+        matcher_config: Optional[MatcherConfig] = None,
+        min_tpu_batch: int = 64,
+        enable_tpu: bool = True,
+    ):
+        self._exact: Dict[str, int] = {}
+        self._trie = TopicTrie()
+        self._builder = NfaBuilder()
+        self._matcher = TpuMatcher(
+            self._builder, matcher_config or MatcherConfig()
+        )
+        self.min_tpu_batch = min_tpu_batch
+        self.enable_tpu = enable_tpu
+
+    def __len__(self) -> int:
+        return len(self._exact) + len(self._trie)
+
+    def topics(self) -> List[str]:
+        return list(self._exact) + list(self._trie.filters())
+
+    def has_route(self, filter_: str) -> bool:
+        return filter_ in self._exact or self._trie.has(filter_)
+
+    def add_route(self, filter_: str) -> None:
+        """Refcounted insert (one ref per subscriber entry)."""
+        self._builder.add(filter_)
+        if T.wildcard(filter_):
+            self._trie.insert(filter_)
+        else:
+            self._exact[filter_] = self._exact.get(filter_, 0) + 1
+
+    def delete_route(self, filter_: str) -> None:
+        self._builder.remove(filter_)
+        if T.wildcard(filter_):
+            self._trie.delete(filter_)
+        else:
+            n = self._exact.get(filter_, 0) - 1
+            if n > 0:
+                self._exact[filter_] = n
+            else:
+                self._exact.pop(filter_, None)
+
+    # -- matching ---------------------------------------------------------
+    def match(self, topic: str) -> List[str]:
+        """CPU single-topic match: direct lookup + trie walk."""
+        out = []
+        if topic in self._exact:
+            out.append(topic)
+        out.extend(self._trie.match(topic))
+        return out
+
+    def match_batch(self, topics: Sequence[str]) -> List[List[str]]:
+        if not self.enable_tpu or len(topics) < self.min_tpu_batch:
+            return [self.match(t) for t in topics]
+        return self._matcher.match_batch(topics, fallback=self.match)
+
+    @property
+    def builder(self) -> NfaBuilder:
+        return self._builder
+
+    @property
+    def matcher(self) -> TpuMatcher:
+        return self._matcher

@@ -1,0 +1,161 @@
+"""Per-client session state (reference: apps/emqx/src/emqx_session.erl).
+
+Holds subscriptions, the inflight window, the bounded mqueue, QoS2
+awaiting_rel set, and the packet-id counter. Survives connection churn:
+on takeover the whole object moves to the new channel
+(emqx_session:takeover/resume/replay, emqx_session.erl:85-90).
+
+Pure state machine — no I/O. `deliver` returns the Publish packets to send;
+acks mutate the window and release queued messages.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.inflight import Inflight
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.mqueue import MQueue
+from emqx_tpu.mqtt import packet as pkt
+
+
+@dataclass
+class SessionConfig:
+    max_inflight: int = 32
+    max_mqueue: int = 1000
+    retry_interval: float = 30.0
+    await_rel_timeout: float = 300.0
+    max_awaiting_rel: int = 100
+    # default persistence for v3.1.1 clean_session=0 clients (the reference
+    # defaults to 2h); v5 clients override via Session-Expiry-Interval, and
+    # clean-start v4 sessions are forced to 0 by the channel manager
+    expiry_interval: float = 7200.0
+
+
+class Session:
+    def __init__(self, client_id: str, config: SessionConfig = SessionConfig()):
+        import dataclasses
+
+        self.client_id = client_id
+        self.config = dataclasses.replace(config)  # per-session copy
+        self.created_at = time.time()
+        self.subscriptions: Dict[str, pkt.SubOpts] = {}
+        self.inflight = Inflight(config.max_inflight)
+        self.mqueue = MQueue(config.max_mqueue)
+        self.awaiting_rel: Dict[int, float] = {}  # incoming QoS2 packet ids
+        self._next_pid = 1
+
+    # -- packet ids -------------------------------------------------------
+    def alloc_packet_id(self) -> int:
+        while True:
+            pid = self._next_pid
+            self._next_pid = pid % 65535 + 1
+            if not self.inflight.contains(pid):
+                return pid
+
+    # -- outgoing (broker -> client) --------------------------------------
+    def deliver(
+        self, msg: Message, opts: Optional[pkt.SubOpts] = None
+    ) -> List[pkt.Publish]:
+        """Accept one routed message; return PUBLISH packets ready to send."""
+        qos = min(msg.qos, opts.qos) if opts else msg.qos
+        if qos == 0:
+            return [self._publish_packet(msg, 0, None)]
+        if self.inflight.is_full():
+            self.mqueue.in_(self._with_qos(msg, qos))
+            return []
+        pid = self.alloc_packet_id()
+        self.inflight.insert(pid, self._with_qos(msg, qos))
+        return [self._publish_packet(msg, qos, pid)]
+
+    def _with_qos(self, msg: Message, qos: int) -> Message:
+        if msg.qos == qos:
+            return msg
+        import copy
+
+        m = copy.copy(msg)
+        m.qos = qos
+        return m
+
+    def _publish_packet(
+        self, msg: Message, qos: int, pid: Optional[int], dup: bool = False
+    ) -> pkt.Publish:
+        return pkt.Publish(
+            topic=msg.topic,
+            payload=msg.payload,
+            qos=qos,
+            retain=msg.retain,
+            dup=dup,
+            packet_id=pid,
+            properties=dict(msg.properties),
+        )
+
+    def puback(self, packet_id: int) -> Tuple[bool, List[pkt.Publish]]:
+        """QoS1 ack; returns (found, replacement publishes from mqueue)."""
+        e = self.inflight.delete(packet_id)
+        return e is not None, self._drain()
+
+    def pubrec(self, packet_id: int) -> bool:
+        """QoS2 phase 1 ack'd by receiver -> move to rel phase."""
+        e = self.inflight._d.get(packet_id)
+        if e is None or e.phase != "publish":
+            return False
+        self.inflight.update(packet_id, "pubrel")
+        return True
+
+    def pubcomp(self, packet_id: int) -> Tuple[bool, List[pkt.Publish]]:
+        e = self.inflight.delete(packet_id)
+        return e is not None and e.phase == "pubrel", self._drain()
+
+    def _drain(self) -> List[pkt.Publish]:
+        out: List[pkt.Publish] = []
+        while not self.inflight.is_full():
+            msg = self.mqueue.out()
+            if msg is None:
+                break
+            pid = self.alloc_packet_id()
+            self.inflight.insert(pid, msg)
+            out.append(self._publish_packet(msg, msg.qos, pid))
+        return out
+
+    # -- incoming QoS2 (client -> broker) ---------------------------------
+    def await_rel(self, packet_id: int) -> bool:
+        """Track an incoming QoS2 publish until PUBREL; False if duplicate."""
+        if packet_id in self.awaiting_rel:
+            return False
+        if len(self.awaiting_rel) >= self.config.max_awaiting_rel:
+            raise OverflowError("max_awaiting_rel")
+        self.awaiting_rel[packet_id] = time.time()
+        return True
+
+    def release_rel(self, packet_id: int) -> bool:
+        return self.awaiting_rel.pop(packet_id, None) is not None
+
+    # -- retry ------------------------------------------------------------
+    def retry(self) -> List[pkt.Packet]:
+        """Retransmit inflight entries older than retry_interval."""
+        out: List[pkt.Packet] = []
+        for pid, e in self.inflight.retry_due(self.config.retry_interval):
+            if e.phase == "publish" and e.msg is not None:
+                out.append(self._publish_packet(e.msg, e.msg.qos, pid, dup=True))
+            else:
+                rel = pkt.PubAck(packet_id=pid)
+                rel.type = pkt.PUBREL
+                out.append(rel)
+            e.ts = time.time()
+        return out
+
+    # -- takeover ---------------------------------------------------------
+    def replay(self) -> List[pkt.Packet]:
+        """All inflight packets re-sent after takeover/resume (dup=True)."""
+        out: List[pkt.Packet] = []
+        for pid, e in self.inflight.items():
+            if e.phase == "publish" and e.msg is not None:
+                out.append(self._publish_packet(e.msg, e.msg.qos, pid, dup=True))
+            else:
+                rel = pkt.PubAck(packet_id=pid)
+                rel.type = pkt.PUBREL
+                out.append(rel)
+        return out + self._drain()

@@ -21,16 +21,14 @@ arrays, emqx_metrics.erl:439).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from emqx_tpu.ops import tokenizer as tok
-from emqx_tpu.ops.matcher import batch_match_syms
+from emqx_tpu.ops.matcher import batch_match_bytes_impl
 
 
 def popcount32(x):
@@ -73,8 +71,6 @@ def route_step_impl(
     Returns dict with matched [B,K], mcount [B], flags [B], bitmaps [B,W],
     stats {routed, matches, fanout_bits}.
     """
-    from emqx_tpu.ops.matcher import batch_match_bytes_impl
-
     matched, mcount, flags = batch_match_bytes_impl(
         tables,
         bytes_mat,
@@ -148,6 +144,7 @@ class SubscriberTable:
         out = np.zeros((self._fcap, self.width_words), dtype=np.uint32)
         for fid, row in self._rows.items():
             out[fid] = row
+        out.setflags(write=False)  # callers share the cache; freeze it
         self._packed = out
         self._dirty = False
         return out

@@ -1,0 +1,7 @@
+"""MQTT protocol layer: packet model, wire codec, client.
+
+Capability parity with the reference's protocol core:
+- packet model + helpers   (apps/emqx/src/emqx_packet.erl, emqx_message.erl)
+- incremental frame codec  (apps/emqx/src/emqx_frame.erl)
+Supports MQTT 3.1 (protocol level 3), 3.1.1 (4) and 5.0 (5).
+"""

@@ -1,0 +1,219 @@
+"""Asyncio MQTT client (the reference tests drive the broker with the real
+`emqtt` client — apps/emqx/rebar.config:36; this is that role here: a small,
+spec-honest client for conformance tests, benchmarks and tooling).
+
+Supports v3.1.1/v5: connect/subscribe/unsubscribe/publish QoS0-2 (full
+QoS2 handshake both directions), ping, will, incoming-message queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.frame import Parser, serialize
+
+
+class MqttError(Exception):
+    pass
+
+
+class Client:
+    def __init__(
+        self,
+        client_id: str = "",
+        version: int = pkt.MQTT_V4,
+        clean_start: bool = True,
+        keepalive: int = 60,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        will: Optional[pkt.Will] = None,
+        properties: Optional[dict] = None,
+    ):
+        self.client_id = client_id
+        self.version = version
+        self.clean_start = clean_start
+        self.keepalive = keepalive
+        self.username = username
+        self.password = password
+        self.will = will
+        self.conn_properties = properties or {}
+        self.messages: asyncio.Queue = asyncio.Queue()
+        self.connack: Optional[pkt.Connack] = None
+        self.disconnect_packet: Optional[pkt.Disconnect] = None
+        self._reader = None
+        self._writer = None
+        self._parser = Parser(version=version)
+        self._pid = 0
+        self._pending: Dict[Tuple[int, int], asyncio.Future] = {}
+        self._await_rel: set = set()
+        self._reader_task: Optional[asyncio.Task] = None
+        self.closed = asyncio.Event()
+
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 65535 + 1
+        return self._pid
+
+    async def connect(self, host: str = "127.0.0.1", port: int = 1883, timeout: float = 5.0):
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+        self._send(
+            pkt.Connect(
+                proto_ver=self.version,
+                clean_start=self.clean_start,
+                keepalive=self.keepalive,
+                client_id=self.client_id,
+                username=self.username,
+                password=self.password,
+                will=self.will,
+                properties=self.conn_properties,
+            )
+        )
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[(pkt.CONNACK, 0)] = fut
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        self.connack = await asyncio.wait_for(fut, timeout)
+        ok = (
+            self.connack.reason_code == 0
+        )
+        if not ok:
+            raise MqttError(f"connack error: {self.connack.reason_code:#x}")
+        return self.connack
+
+    def _send(self, p) -> None:
+        self._writer.write(serialize(p, self.version))
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self._reader.read(65536)
+                if not data:
+                    break
+                for p in self._parser.feed(data):
+                    await self._handle(p)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(MqttError("connection closed"))
+            self._pending.clear()
+
+    async def _handle(self, p) -> None:
+        t = p.type
+        if t == pkt.CONNACK:
+            self._resolve((pkt.CONNACK, 0), p)
+        elif t == pkt.PUBLISH:
+            if p.qos == 0:
+                await self.messages.put(p)
+            elif p.qos == 1:
+                await self.messages.put(p)
+                self._send(pkt.PubAck(packet_id=p.packet_id))
+            else:
+                if p.packet_id not in self._await_rel:
+                    self._await_rel.add(p.packet_id)
+                    await self.messages.put(p)
+                rec = pkt.PubAck(packet_id=p.packet_id)
+                rec.type = pkt.PUBREC
+                self._send(rec)
+        elif t == pkt.PUBREL:
+            self._await_rel.discard(p.packet_id)
+            comp = pkt.PubAck(packet_id=p.packet_id)
+            comp.type = pkt.PUBCOMP
+            self._send(comp)
+        elif t in (pkt.PUBACK, pkt.PUBCOMP):
+            self._resolve((t, p.packet_id), p)
+        elif t == pkt.PUBREC:
+            rel = pkt.PubAck(packet_id=p.packet_id)
+            rel.type = pkt.PUBREL
+            self._send(rel)
+        elif t in (pkt.SUBACK, pkt.UNSUBACK):
+            self._resolve((t, p.packet_id), p)
+        elif t == pkt.PINGRESP:
+            self._resolve((pkt.PINGRESP, 0), p)
+        elif t == pkt.DISCONNECT:
+            self.disconnect_packet = p
+
+    def _resolve(self, key, p) -> None:
+        fut = self._pending.pop(key, None)
+        if fut is not None and not fut.done():
+            fut.set_result(p)
+
+    async def _request(self, key, send_pkt, timeout: float = 5.0):
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[key] = fut
+        self._send(send_pkt)
+        return await asyncio.wait_for(fut, timeout)
+
+    async def subscribe(
+        self, filters, qos: int = 0, timeout: float = 5.0
+    ) -> pkt.Suback:
+        if isinstance(filters, str):
+            filters = [(filters, pkt.SubOpts(qos=qos))]
+        elif filters and isinstance(filters[0], str):
+            filters = [(f, pkt.SubOpts(qos=qos)) for f in filters]
+        pid = self._next_pid()
+        return await self._request(
+            (pkt.SUBACK, pid),
+            pkt.Subscribe(packet_id=pid, filters=list(filters)),
+            timeout,
+        )
+
+    async def unsubscribe(self, filters, timeout: float = 5.0) -> pkt.Unsuback:
+        if isinstance(filters, str):
+            filters = [filters]
+        pid = self._next_pid()
+        return await self._request(
+            (pkt.UNSUBACK, pid),
+            pkt.Unsubscribe(packet_id=pid, filters=list(filters)),
+            timeout,
+        )
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        qos: int = 0,
+        retain: bool = False,
+        properties: Optional[dict] = None,
+        timeout: float = 5.0,
+    ):
+        p = pkt.Publish(
+            topic=topic,
+            payload=payload,
+            qos=qos,
+            retain=retain,
+            properties=properties or {},
+        )
+        if qos == 0:
+            self._send(p)
+            await self._writer.drain()
+            return None
+        p.packet_id = self._next_pid()
+        ack_t = pkt.PUBACK if qos == 1 else pkt.PUBCOMP
+        return await self._request((ack_t, p.packet_id), p, timeout)
+
+    async def ping(self, timeout: float = 5.0):
+        return await self._request((pkt.PINGRESP, 0), pkt.PingReq(), timeout)
+
+    async def recv(self, timeout: float = 5.0) -> pkt.Publish:
+        return await asyncio.wait_for(self.messages.get(), timeout)
+
+    async def disconnect(self, reason_code: int = 0) -> None:
+        try:
+            self._send(pkt.Disconnect(reason_code=reason_code))
+            await self._writer.drain()
+        except Exception:
+            pass
+        await self.close()
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass

@@ -27,7 +27,8 @@ def make_mesh(
 ) -> Mesh:
     """Factor the first n devices into a ('dp', 'tp') mesh.
 
-    tp defaults to min(2, n) for n > 1 — subscriber-lane sharding wants
+    tp defaults to 2 when n is even and > 1, else 1 — subscriber-lane
+    sharding wants
     fewer, larger slices so each chip keeps big contiguous bitmap rows
     (HBM-bandwidth friendly), while dp soaks up the rest of the chips for
     batch throughput.

@@ -1,0 +1,7 @@
+"""Transports: asyncio TCP/TLS listeners and per-connection pumps.
+
+The reference runs one Erlang process per client over esockd/cowboy/quicer
+(apps/emqx/src/emqx_connection.erl, emqx_listeners.erl). Here each client is
+an asyncio task on the broker loop; the protocol state machine
+(emqx_tpu.broker.channel) is sans-IO, so transports stay thin.
+"""

@@ -1,0 +1,122 @@
+"""Per-connection pump: socket bytes <-> frames <-> channel.
+
+Parity with the reference connection process (apps/emqx/src/
+emqx_connection.erl: recvloop :356-390, parse->handle :462-493, serialize +
+send, keepalive enforcement). The MQTT spec's 1.5x keepalive grace is
+enforced here; an idle pre-CONNECT socket is closed after idle_timeout
+(emqx_channel idle timer parity).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from emqx_tpu.broker.channel import Channel, ChannelConfig
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.frame import FrameError, Parser, serialize
+
+
+class Connection:
+    """One connected socket; owns the parser, the channel, and timers."""
+
+    def __init__(self, broker, cm, reader, writer, config: ChannelConfig):
+        self.reader = reader
+        self.writer = writer
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        self.channel = Channel(
+            broker,
+            cm,
+            sink=self,
+            conninfo={"peerhost": peer[0], "peerport": peer[1]},
+            config=config,
+        )
+        self.parser = Parser(max_size=config.caps.max_packet_size)
+        self.last_rx = time.time()
+        self._closing = False
+        self._tasks: list = []
+
+    # -- sink interface used by the channel -------------------------------
+    def send_packet(self, p) -> None:
+        if self._closing:
+            return
+        try:
+            self.writer.write(serialize(p, self.channel.version))
+        except Exception:
+            self.close("send_error")
+
+    def close(self, reason: str) -> None:
+        if self._closing:
+            return
+        self._closing = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    # -- pump --------------------------------------------------------------
+    async def run(self) -> None:
+        keeper = asyncio.ensure_future(self._keepalive_loop())
+        ticker = asyncio.ensure_future(self._tick_loop())
+        try:
+            while not self._closing:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                self.last_rx = time.time()
+                try:
+                    for p in self.parser.feed(data):
+                        self.channel.handle_in(p)
+                except FrameError as e:
+                    self.channel.disconnect_reason = f"frame_error:{e.reason}"
+                    if self.channel.version == pkt.MQTT_V5:
+                        self.send_packet(
+                            pkt.Disconnect(reason_code=pkt.RC_MALFORMED_PACKET)
+                        )
+                    break
+                await self._drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            keeper.cancel()
+            ticker.cancel()
+            self.close("sock_closed")
+            try:
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+            self.channel.on_sock_closed()
+
+    async def _drain(self) -> None:
+        try:
+            await self.writer.drain()
+        except ConnectionError:
+            self.close("sock_error")
+
+    async def _keepalive_loop(self) -> None:
+        # pre-CONNECT idle timeout (poll so keepalive arms right after CONNECT)
+        start = time.time()
+        while self.channel.state == "idle":
+            if time.time() - start > self.channel.config.idle_timeout:
+                self.close("idle_timeout")
+                return
+            await asyncio.sleep(0.2)
+        while not self._closing:
+            ka = self.channel.keepalive
+            if ka <= 0:
+                return
+            await asyncio.sleep(ka / 2)
+            if time.time() - self.last_rx > ka * 1.5:
+                self.channel.disconnect_reason = "keepalive_timeout"
+                self.close("keepalive_timeout")
+                return
+
+    async def _tick_loop(self) -> None:
+        while not self._closing:
+            await asyncio.sleep(
+                max(1.0, self.channel.config.session.retry_interval / 2)
+            )
+            if self.channel.state == "connected":
+                self.channel.tick()
+                await self._drain()

@@ -1,0 +1,115 @@
+"""Listener lifecycle: named TCP/TLS endpoints feeding connections.
+
+Parity with emqx_listeners (apps/emqx/src/emqx_listeners.erl:230-266):
+start/stop/restart per {type, name}; TLS via ssl.SSLContext; WebSocket and
+QUIC are follow-on transports behind the same Connection pump.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl as ssl_mod
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.transport.connection import Connection
+
+
+@dataclass
+class ListenerConfig:
+    name: str = "default"
+    type: str = "tcp"  # tcp | ssl
+    bind: str = "127.0.0.1"
+    port: int = 1883
+    max_connections: int = 1_024_000
+    ssl_certfile: Optional[str] = None
+    ssl_keyfile: Optional[str] = None
+    ssl_cacertfile: Optional[str] = None
+    ssl_verify: bool = False
+
+
+class Listener:
+    def __init__(self, broker, cm, config: ListenerConfig, channel_config=None):
+        self.broker = broker
+        self.cm = cm
+        self.config = config
+        self.channel_config = channel_config or ChannelConfig()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when configured with port=0)."""
+        if self._server and self._server.sockets:
+            return self._server.sockets[0].getsockname()[1]
+        return self.config.port
+
+    async def start(self) -> None:
+        ctx = None
+        if self.config.type == "ssl":
+            ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.config.ssl_certfile, self.config.ssl_keyfile)
+            if self.config.ssl_cacertfile:
+                ctx.load_verify_locations(self.config.ssl_cacertfile)
+            if self.config.ssl_verify:
+                ctx.verify_mode = ssl_mod.CERT_REQUIRED
+        self._server = await asyncio.start_server(
+            self._on_client, self.config.bind, self.config.port, ssl=ctx
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for t in list(self._conns):
+            t.cancel()
+
+    async def _on_client(self, reader, writer) -> None:
+        if len(self._conns) >= self.config.max_connections:
+            writer.close()
+            return
+        conn = Connection(self.broker, self.cm, reader, writer, self.channel_config)
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(task)
+
+
+class Listeners:
+    """Registry of named listeners (emqx_listeners API parity)."""
+
+    def __init__(self, broker, cm):
+        self.broker = broker
+        self.cm = cm
+        self._listeners: Dict[str, Listener] = {}
+
+    async def start_listener(
+        self, config: ListenerConfig, channel_config=None
+    ) -> Listener:
+        key = f"{config.type}:{config.name}"
+        if key in self._listeners:
+            raise ValueError(f"listener {key} already running")
+        l = Listener(self.broker, self.cm, config, channel_config)
+        await l.start()
+        self._listeners[key] = l
+        return l
+
+    async def stop_listener(self, type_: str, name: str) -> bool:
+        key = f"{type_}:{name}"
+        l = self._listeners.pop(key, None)
+        if l is None:
+            return False
+        await l.stop()
+        return True
+
+    async def stop_all(self) -> None:
+        for key in list(self._listeners):
+            t, n = key.split(":", 1)
+            await self.stop_listener(t, n)
+
+    def list(self):
+        return dict(self._listeners)

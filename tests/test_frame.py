@@ -1,0 +1,249 @@
+"""MQTT codec tests: known byte vectors + randomized round-trip property
+tests (parity targets: emqx_frame_SUITE + prop_emqx_frame)."""
+
+import random
+
+import pytest
+
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.frame import FrameError, Parser, serialize
+
+
+def roundtrip(p, version):
+    wire = serialize(p, version)
+    parser = Parser(version=version)
+    out = parser.feed(wire)
+    assert len(out) == 1, out
+    return out[0]
+
+
+def test_connect_v4_wire():
+    # canonical v3.1.1 CONNECT, hand-checked against the spec layout
+    p = pkt.Connect(
+        proto_ver=4, clean_start=True, keepalive=60, client_id="c1"
+    )
+    wire = serialize(p, 4)
+    assert wire[0] == 0x10
+    assert wire[2:8] == b"\x00\x04MQTT"
+    assert wire[8] == 4
+    assert wire[9] == 0x02  # clean start only
+    q = roundtrip(p, 4)
+    assert (q.client_id, q.keepalive, q.clean_start) == ("c1", 60, True)
+
+
+def test_connect_v5_full():
+    p = pkt.Connect(
+        proto_ver=5,
+        clean_start=False,
+        keepalive=30,
+        client_id="client-x",
+        username="u",
+        password=b"secret",
+        will=pkt.Will(
+            topic="will/t",
+            payload=b"gone",
+            qos=1,
+            retain=True,
+            properties={"Will-Delay-Interval": 5},
+        ),
+        properties={"Session-Expiry-Interval": 3600, "Receive-Maximum": 10},
+    )
+    q = roundtrip(p, 5)
+    assert q == p
+
+
+def test_publish_roundtrip_versions():
+    for v in (4, 5):
+        p = pkt.Publish(topic="a/b", payload=b"hello", qos=1, packet_id=7)
+        if v == 5:
+            p.properties = {
+                "Topic-Alias": 3,
+                "User-Property": [("k", "v"), ("k2", "v2")],
+            }
+        assert roundtrip(p, v) == p
+
+
+def test_publish_qos0_no_packet_id():
+    p = pkt.Publish(topic="t", payload=b"x", qos=0)
+    assert roundtrip(p, 4) == p
+
+
+def test_puback_family_v5_reason():
+    for t in (pkt.PUBACK, pkt.PUBREC, pkt.PUBREL, pkt.PUBCOMP):
+        p = pkt.PubAck(packet_id=9, reason_code=pkt.RC_NO_MATCHING_SUBSCRIBERS)
+        p.type = t
+        q = roundtrip(p, 5)
+        assert (q.type, q.packet_id, q.reason_code) == (t, 9, 0x10)
+
+
+def test_puback_v4_omits_reason():
+    p = pkt.PubAck(packet_id=9, reason_code=pkt.RC_SUCCESS)
+    wire = serialize(p, 4)
+    assert len(wire) == 4
+    assert roundtrip(p, 4).packet_id == 9
+
+
+def test_subscribe_suback():
+    p = pkt.Subscribe(
+        packet_id=3,
+        filters=[
+            ("a/+", pkt.SubOpts(qos=1)),
+            ("b/#", pkt.SubOpts(qos=2, no_local=True, retain_handling=2)),
+        ],
+    )
+    assert roundtrip(p, 5) == p
+    s = pkt.Suback(packet_id=3, reason_codes=[1, 2])
+    assert roundtrip(s, 5) == s
+
+
+def test_unsubscribe_roundtrip():
+    p = pkt.Unsubscribe(packet_id=4, filters=["a/b", "c/#"])
+    assert roundtrip(p, 4) == p
+    u = pkt.Unsuback(packet_id=4, reason_codes=[0, 17])
+    assert roundtrip(u, 5) == u
+
+
+def test_ping_disconnect_auth():
+    assert isinstance(roundtrip(pkt.PingReq(), 4), pkt.PingReq)
+    assert isinstance(roundtrip(pkt.PingResp(), 4), pkt.PingResp)
+    d = pkt.Disconnect(reason_code=pkt.RC_SESSION_TAKEN_OVER)
+    assert roundtrip(d, 5).reason_code == 0x8E
+    a = pkt.Auth(
+        reason_code=pkt.RC_CONTINUE_AUTHENTICATION,
+        properties={"Authentication-Method": "SCRAM"},
+    )
+    q = roundtrip(a, 5)
+    assert q.reason_code == 0x18
+    assert q.properties["Authentication-Method"] == "SCRAM"
+
+
+def test_incremental_parse_byte_by_byte():
+    p1 = pkt.Publish(topic="x/y", payload=b"p1", qos=1, packet_id=1)
+    p2 = pkt.Subscribe(packet_id=2, filters=[("f", pkt.SubOpts())])
+    wire = serialize(p1, 4) + serialize(p2, 4)
+    parser = Parser(version=4)
+    got = []
+    for i in range(len(wire)):
+        got += parser.feed(wire[i : i + 1])
+    assert got == [p1, p2]
+
+
+def test_version_switch_on_connect():
+    parser = Parser()
+    c = pkt.Connect(proto_ver=5, client_id="v5c")
+    out = parser.feed(serialize(c, 5))
+    assert out[0].proto_ver == 5
+    assert parser.version == 5
+    # now a v5 PUBLISH with properties parses correctly
+    p = pkt.Publish(
+        topic="t", qos=1, packet_id=1, properties={"Topic-Alias": 1}
+    )
+    assert parser.feed(serialize(p, 5)) == [p]
+
+
+def test_errors():
+    parser = Parser(version=4)
+    with pytest.raises(FrameError):  # bad qos bits (0b0110 => qos 3)
+        parser.feed(bytes([0x36, 0x05]) + b"\x00\x01t\x00\x01")
+    parser = Parser(version=4)
+    with pytest.raises(FrameError):  # SUBSCRIBE with wrong flags
+        parser.feed(bytes([0x80, 0x00]))
+    parser = Parser(version=4, max_size=16)
+    with pytest.raises(FrameError):  # exceeds max_size
+        parser.feed(bytes([0x30, 0xFF, 0x01]))
+    parser = Parser(version=4)
+    with pytest.raises(FrameError):  # varint longer than 4 bytes
+        parser.feed(bytes([0x30, 0x80, 0x80, 0x80, 0x80, 0x01]))
+    parser = Parser(version=4)
+    with pytest.raises(FrameError):  # publish to wildcard topic
+        parser.feed(serialize(pkt.Publish(topic="a/#", payload=b""), 4))
+
+
+def test_malformed_body_is_error_not_stall():
+    # truncated varint inside a complete body must raise, not wait forever
+    parser = Parser(version=5)
+    # CONNACK with properties length varint running off the end
+    bad = bytes([0x20, 0x03, 0x00, 0x00, 0x80])
+    with pytest.raises(FrameError):
+        parser.feed(bad)
+
+
+def _rand_props(rng, for_type):
+    props = {}
+    if rng.random() < 0.5:
+        props["User-Property"] = [("a", "b")]
+    if for_type == "pub":
+        if rng.random() < 0.5:
+            props["Message-Expiry-Interval"] = rng.randrange(2**32)
+        if rng.random() < 0.3:
+            props["Content-Type"] = "text/plain"
+        if rng.random() < 0.3:
+            props["Correlation-Data"] = bytes(rng.randrange(256) for _ in range(8))
+    return props
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_random_roundtrip(seed):
+    rng = random.Random(seed)
+    for _ in range(300):
+        v = rng.choice([4, 5])
+        kind = rng.randrange(6)
+        if kind == 0:
+            qos = rng.randrange(3)
+            p = pkt.Publish(
+                topic="/".join("lv%d" % rng.randrange(5) for _ in range(rng.randrange(1, 6))),
+                payload=bytes(rng.randrange(256) for _ in range(rng.randrange(64))),
+                qos=qos,
+                retain=rng.random() < 0.5,
+                dup=qos > 0 and rng.random() < 0.5,
+                packet_id=rng.randrange(1, 65536) if qos else None,
+                properties=_rand_props(rng, "pub") if v == 5 else {},
+            )
+        elif kind == 1:
+            p = pkt.Connect(
+                proto_ver=v,
+                clean_start=rng.random() < 0.5,
+                keepalive=rng.randrange(65536),
+                client_id="c%d" % rng.randrange(1000),
+                username="user" if rng.random() < 0.5 else None,
+                password=b"pw" if rng.random() < 0.5 else None,
+            )
+        elif kind == 2:
+            p = pkt.Subscribe(
+                packet_id=rng.randrange(1, 65536),
+                filters=[
+                    ("f/%d" % i, pkt.SubOpts(qos=rng.randrange(3)))
+                    for i in range(rng.randrange(1, 5))
+                ],
+            )
+        elif kind == 3:
+            p = pkt.PubAck(packet_id=rng.randrange(1, 65536))
+            p.type = rng.choice([pkt.PUBACK, pkt.PUBREC, pkt.PUBREL, pkt.PUBCOMP])
+        elif kind == 4:
+            p = pkt.Unsubscribe(
+                packet_id=rng.randrange(1, 65536),
+                filters=["g/%d" % i for i in range(rng.randrange(1, 4))],
+            )
+        else:
+            p = pkt.Connack(
+                session_present=rng.random() < 0.5,
+                reason_code=rng.choice([0, 0x80, 0x87]),
+            )
+        assert roundtrip(p, v) == p
+
+
+def test_random_fragmentation(  ):
+    rng = random.Random(99)
+    packets = [
+        pkt.Publish(topic="a/b/c", payload=b"x" * 100, qos=1, packet_id=i + 1)
+        for i in range(20)
+    ]
+    wire = b"".join(serialize(p, 4) for p in packets)
+    parser = Parser(version=4)
+    got = []
+    i = 0
+    while i < len(wire):
+        n = rng.randrange(1, 17)
+        got += parser.feed(wire[i : i + n])
+        i += n
+    assert got == packets
