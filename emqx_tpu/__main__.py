@@ -1,8 +1,9 @@
-"""Broker entrypoint: `python -m emqx_tpu [--port 1883]`.
+"""Broker entrypoint: `python -m emqx_tpu [-c config.json] [--port 1883]`.
 
 The `bin/emqx foreground` analog (reference: bin/emqx:75-110). Boots the
-broker kernel, channel manager, and TCP listener on one asyncio loop and
-runs until SIGINT/SIGTERM.
+full application (broker kernel, extensions, listeners, management API,
+housekeeping) from a config file plus EMQX_TPU__* env overrides and runs
+until SIGINT/SIGTERM.
 """
 
 from __future__ import annotations
@@ -15,36 +16,47 @@ import sys
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="emqx_tpu", description=__doc__)
-    ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("-c", "--config", default=None, help="JSON config file")
+    ap.add_argument("--host", default=None, help="override listener bind")
+    ap.add_argument("--port", type=int, default=None, help="override listener port")
     ap.add_argument(
         "--no-tpu", action="store_true",
         help="route on the CPU trie only (skip JAX/TPU engine)",
     )
     ap.add_argument(
-        "--min-tpu-batch", type=int, default=64,
-        help="publish batch size at which routing moves to the TPU kernel",
+        "--no-dashboard", action="store_true", help="disable the REST API"
     )
     args = ap.parse_args(argv)
     return asyncio.run(serve(args))
 
 
 async def serve(args) -> int:
-    from emqx_tpu.broker.broker import Broker
-    from emqx_tpu.broker.cm import ChannelManager
-    from emqx_tpu.broker.router import Router
-    from emqx_tpu.transport.listener import ListenerConfig, Listeners
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_file
 
-    router = Router(
-        enable_tpu=not args.no_tpu, min_tpu_batch=args.min_tpu_batch
-    )
-    broker = Broker(router=router)
-    cm = ChannelManager(broker)
-    listeners = Listeners(broker, cm)
-    l = await listeners.start_listener(
-        ListenerConfig(bind=args.host, port=args.port)
-    )
-    print(f"emqx_tpu broker listening on {args.host}:{l.port}", flush=True)
+    config = load_file(args.config)
+    if args.host is not None:
+        config.listeners[0].bind = args.host
+    if args.port is not None:
+        config.listeners[0].port = args.port
+    if args.no_tpu:
+        config.router.enable_tpu = False
+    if args.no_dashboard:
+        config.dashboard.enable = False
+
+    app = BrokerApp(config)
+    await app.start()
+    for l in app.listeners.list().values():
+        print(
+            f"emqx_tpu listener {l.config.type}:{l.config.name} on "
+            f"{l.config.bind}:{l.port}",
+            flush=True,
+        )
+    if app.mgmt_server is not None:
+        print(
+            f"emqx_tpu mgmt api on {config.dashboard.bind}:{app.mgmt_server.port}",
+            flush=True,
+        )
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -52,7 +64,7 @@ async def serve(args) -> int:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     print("shutting down", flush=True)
-    await listeners.stop_all()
+    await app.stop()
     return 0
 
 

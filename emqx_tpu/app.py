@@ -1,0 +1,224 @@
+"""Application assembly + lifecycle: config -> running broker.
+
+The emqx_machine analog (apps/emqx_machine/src/emqx_machine_boot.erl:
+dependency-ordered app boot, signal handling): builds the broker kernel,
+extensions, listeners, management API and periodic housekeeping from one
+`AppConfig`, starts them in dependency order, and tears them down cleanly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional
+
+from emqx_tpu.broker.auth import AuthChain, BuiltinDatabase, JwtAuth
+from emqx_tpu.broker.authz import AclRule, Authorizer
+from emqx_tpu.broker.auto_subscribe import AutoSubscribe, AutoSubscribeTopic
+from emqx_tpu.broker.banned import Banned, Flapping
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.delayed import DelayedPublish
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.retainer import Retainer
+from emqx_tpu.broker.rewrite import RewriteRule, TopicRewrite
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.shared_sub import SharedSub
+from emqx_tpu.config.schema import AppConfig
+from emqx_tpu.ops.matcher import MatcherConfig
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+from emqx_tpu.utils.node import node_name, set_node_name
+
+
+class BrokerApp:
+    def __init__(self, config: Optional[AppConfig] = None):
+        self.config = config or AppConfig()
+        c = self.config
+        if c.node.name:
+            set_node_name(c.node.name)
+
+        self.hooks = Hooks()
+        self.router = Router(
+            matcher_config=MatcherConfig(
+                max_levels=c.router.max_levels,
+                frontier=c.router.frontier,
+                max_matches=c.router.max_matches,
+                max_bytes=c.router.max_bytes,
+            ),
+            min_tpu_batch=c.router.min_tpu_batch,
+            enable_tpu=c.router.enable_tpu,
+        )
+        self.broker = Broker(router=self.router, hooks=self.hooks)
+        self.broker.shared = SharedSub(strategy=c.shared_subscription.strategy)
+        self.cm = ChannelManager(self.broker)
+        self.channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
+        self.listeners = Listeners(self.broker, self.cm)
+
+        # extensions (reference L4, SURVEY.md §1)
+        self.banned = Banned()
+        self.banned.attach(self.hooks)
+        self.flapping = (
+            Flapping(
+                self.banned,
+                max_count=c.flapping.max_count,
+                window=c.flapping.window_time,
+                ban_time=c.flapping.ban_time,
+            )
+            if c.flapping.enable
+            else None
+        )
+        if self.flapping:
+            self.flapping.attach(self.hooks)
+
+        self.retainer = Retainer(
+            max_retained=c.retainer.max_retained_messages,
+            max_payload=c.retainer.max_payload_size,
+        )
+        self.retainer.enabled = c.retainer.enable
+        self.retainer.attach(self.hooks)
+
+        self.delayed = DelayedPublish(self.broker)
+        self.delayed.enabled = c.delayed.enable
+        self.delayed.attach(self.hooks)
+
+        if c.rewrite:
+            TopicRewrite(
+                [
+                    RewriteRule(r.action, r.source_topic, r.re, r.dest_topic)
+                    for r in c.rewrite
+                ]
+            ).attach(self.hooks)
+
+        if c.auto_subscribe:
+            AutoSubscribe(
+                [
+                    AutoSubscribeTopic(filter=s.topic, qos=s.qos)
+                    for s in c.auto_subscribe
+                ]
+            ).attach(self.hooks)
+
+        if c.authn.enable:
+            providers = []
+            if c.authn.users:
+                db = BuiltinDatabase(
+                    user_id_type=c.authn.user_id_type,
+                    algo=c.authn.password_hash,
+                )
+                for u in c.authn.users:
+                    db.add_user(u.user_id, u.password, u.is_superuser)
+                providers.append(db)
+            if c.authn.jwt_secret:
+                providers.append(
+                    JwtAuth(
+                        c.authn.jwt_secret.encode(), c.authn.jwt_verify_claims
+                    )
+                )
+            self.authn = AuthChain(
+                providers, allow_anonymous=c.authn.allow_anonymous
+            )
+            self.authn.attach(self.hooks)
+        else:
+            self.authn = None
+
+        self.authz = Authorizer(
+            rules=[self._acl_rule(r) for r in c.authz.rules],
+            no_match=c.authz.no_match,
+        )
+        self.authz.attach(self.hooks)
+
+        self.mgmt_server = None  # set by start() when dashboard.enable
+        self._tasks: List[asyncio.Task] = []
+        self.started_at: Optional[float] = None
+
+    @staticmethod
+    def _acl_rule(spec) -> AclRule:
+        who = spec.who
+        if isinstance(who, str) and ":" in who:
+            k, v = who.split(":", 1)
+            who = {k: v}
+        return AclRule(spec.permit, who, spec.action, list(spec.topics))
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        c = self.config
+        for spec in c.listeners:
+            await self.listeners.start_listener(
+                ListenerConfig(
+                    name=spec.name,
+                    type=spec.type,
+                    bind=spec.bind,
+                    port=spec.port,
+                    max_connections=spec.max_connections,
+                    ssl_certfile=spec.ssl_certfile,
+                    ssl_keyfile=spec.ssl_keyfile,
+                    ssl_cacertfile=spec.ssl_cacertfile,
+                    ssl_verify=spec.ssl_verify,
+                ),
+                self.channel_config,
+            )
+        if c.dashboard.enable:
+            from emqx_tpu.mgmt.api import MgmtApi
+
+            self.mgmt_server = MgmtApi(self)
+            await self.mgmt_server.start(c.dashboard.bind, c.dashboard.port)
+        self.started_at = time.time()
+        self._tasks = [
+            asyncio.ensure_future(self._housekeeping()),
+            asyncio.ensure_future(self._sys_heartbeat()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self.mgmt_server is not None:
+            await self.mgmt_server.stop()
+        await self.listeners.stop_all()
+
+    async def _housekeeping(self) -> None:
+        import logging
+
+        c = self.config
+        last_retainer_sweep = 0.0
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                now = time.time()
+                self.delayed.tick(now)
+                self.cm.sweep_expired(now)
+                self.banned.sweep(now)
+                if self.flapping is not None:
+                    self.flapping.sweep(now)
+                if now - last_retainer_sweep >= c.retainer.msg_clear_interval:
+                    self.retainer.clear_expired(now)
+                    last_retainer_sweep = now
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # one bad tick must not kill periodic work for the process
+                logging.getLogger("emqx_tpu").exception("housekeeping tick failed")
+
+    async def _sys_heartbeat(self) -> None:
+        """$SYS broker heartbeat topics (reference: emqx_sys.erl:70-95)."""
+        from emqx_tpu import __version__
+
+        interval = self.config.sys.sys_msg_interval
+        prefix = f"$SYS/brokers/{node_name()}"
+        while True:
+            stats = {
+                f"{prefix}/version": __version__,
+                f"{prefix}/uptime": str(int(time.time() - (self.started_at or time.time()))),
+                f"{prefix}/clients/count": str(self.cm.channel_count()),
+                f"{prefix}/subscriptions/count": str(
+                    self.broker.subscription_count()
+                ),
+                f"{prefix}/retained/count": str(len(self.retainer)),
+            }
+            for topic, payload in stats.items():
+                self.broker.publish(
+                    Message(topic=topic, payload=payload.encode(), qos=0)
+                )
+            await asyncio.sleep(interval)

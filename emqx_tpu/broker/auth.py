@@ -1,0 +1,187 @@
+"""Authentication chain (reference: apps/emqx/src/emqx_authentication.erl +
+apps/emqx_authn providers, SURVEY.md §2.2).
+
+Chain-of-providers on the 'client.authenticate' hookpoint: each provider
+returns 'ignore' (next provider), 'ok' (allow, stop), or 'deny' (reject,
+stop). Built-in providers:
+
+- `BuiltinDatabase`: in-memory credential store with pbkdf2/sha256/plain
+  password hashing (the emqx_authn_mnesia analog; bcrypt is not available
+  in this image, pbkdf2 is the strong default)
+- `JwtAuth`: HS256 JWT verification from the password field
+  (emqx_authn_jwt analog, hand-rolled HMAC — no external jwt dep)
+- HTTP/SQL/LDAP provider slots follow the same Provider protocol and are
+  async-backed (future work; the chain API already accommodates them).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.mqtt import packet as pkt
+
+IGNORE, OK, DENY = "ignore", "ok", "deny"
+
+
+class Provider:
+    def authenticate(self, client_info: Dict, credentials: Dict) -> Tuple[str, Optional[int]]:
+        """-> (ignore|ok|deny, reason_code|None)"""
+        raise NotImplementedError
+
+
+def _hash_password(password: bytes, algo: str, salt: bytes, iterations: int = 10000) -> bytes:
+    if algo == "plain":
+        return password
+    if algo == "sha256":
+        return hashlib.sha256(salt + password).digest()
+    if algo == "pbkdf2":
+        return hashlib.pbkdf2_hmac("sha256", password, salt, iterations)
+    raise ValueError(f"unknown hash algo {algo}")
+
+
+@dataclass
+class _Cred:
+    algo: str
+    salt: bytes
+    phash: bytes
+    is_superuser: bool = False
+
+
+class BuiltinDatabase(Provider):
+    """Username/clientid -> salted password hash store."""
+
+    def __init__(self, user_id_type: str = "username", algo: str = "pbkdf2"):
+        assert user_id_type in ("username", "clientid")
+        self.user_id_type = user_id_type
+        self.algo = algo
+        self._users: Dict[str, _Cred] = {}
+
+    def add_user(self, user_id: str, password: str, is_superuser: bool = False) -> None:
+        salt = os.urandom(16)
+        self._users[user_id] = _Cred(
+            self.algo,
+            salt,
+            _hash_password(password.encode(), self.algo, salt),
+            is_superuser,
+        )
+
+    def delete_user(self, user_id: str) -> bool:
+        return self._users.pop(user_id, None) is not None
+
+    def users(self) -> List[str]:
+        return list(self._users)
+
+    def authenticate(self, client_info, credentials):
+        uid = (
+            client_info.get("username")
+            if self.user_id_type == "username"
+            else client_info.get("client_id")
+        )
+        if uid is None:
+            # anonymous client: no opinion — the chain's allow_anonymous
+            # policy decides, not this provider
+            return IGNORE, None
+        cred = self._users.get(uid)
+        if cred is None:
+            return IGNORE, None
+        password = credentials.get("password") or b""
+        good = hmac.compare_digest(
+            _hash_password(password, cred.algo, cred.salt), cred.phash
+        )
+        if good:
+            client_info["is_superuser"] = cred.is_superuser
+            return OK, None
+        return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+
+
+class JwtAuth(Provider):
+    """HS256 JWT in the password field; claims may pin clientid/username."""
+
+    def __init__(self, secret: bytes, verify_claims: Optional[Dict[str, str]] = None):
+        self.secret = secret
+        # claim -> expected value with ${clientid}/${username} placeholders
+        self.verify_claims = verify_claims or {}
+
+    @staticmethod
+    def _b64d(s: str) -> bytes:
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+    def authenticate(self, client_info, credentials):
+        token = credentials.get("password")
+        if not token:
+            return IGNORE, None
+        try:
+            parts = token.decode().split(".")
+            if len(parts) != 3:
+                return IGNORE, None
+            header = json.loads(self._b64d(parts[0]))
+            if header.get("alg") != "HS256":
+                return IGNORE, None
+            signing = f"{parts[0]}.{parts[1]}".encode()
+            sig = hmac.new(self.secret, signing, hashlib.sha256).digest()
+            if not hmac.compare_digest(sig, self._b64d(parts[2])):
+                return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+            claims = json.loads(self._b64d(parts[1]))
+        except Exception:
+            return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+        if "exp" in claims and time.time() > claims["exp"]:
+            return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+        for claim, expect in self.verify_claims.items():
+            expect = expect.replace(
+                "${clientid}", client_info.get("client_id", "")
+            ).replace("${username}", client_info.get("username") or "")
+            if claims.get(claim) != expect:
+                return DENY, pkt.RC_NOT_AUTHORIZED
+        client_info["jwt_claims"] = claims
+        return OK, None
+
+    @classmethod
+    def sign(cls, secret: bytes, claims: Dict) -> str:
+        """Test/tooling helper: mint an HS256 token."""
+
+        def b64(b: bytes) -> str:
+            return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+        h = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        p = b64(json.dumps(claims).encode())
+        sig = hmac.new(secret, f"{h}.{p}".encode(), hashlib.sha256).digest()
+        return f"{h}.{p}.{b64(sig)}"
+
+
+class AuthChain:
+    """Ordered providers; 'ignore' falls through, default allow when no
+    provider claims the client (reference behavior with an empty chain)."""
+
+    def __init__(self, providers: Optional[List[Provider]] = None, allow_anonymous: bool = True):
+        self.providers = providers or []
+        self.allow_anonymous = allow_anonymous
+
+    def authenticate(self, client_info, credentials, acc=None):
+        for p in self.providers:
+            result, rc = p.authenticate(client_info, credentials)
+            if result == OK:
+                return ("stop", {"result": "allow"})
+            if result == DENY:
+                return (
+                    "stop",
+                    {"result": "deny", "reason_code": rc or pkt.RC_NOT_AUTHORIZED},
+                )
+        if not self.allow_anonymous:
+            # no provider vouched for the client: deny (even with an empty
+            # provider list — enabling auth without users must not be open)
+            return (
+                "stop",
+                {"result": "deny", "reason_code": pkt.RC_NOT_AUTHORIZED},
+            )
+        return None  # no opinion
+
+    def attach(self, hooks: Hooks) -> None:
+        hooks.add("client.authenticate", self.authenticate, priority=100)

@@ -1,0 +1,112 @@
+"""Authorization rules (reference: apps/emqx_authz rule DSL,
+emqx_authz_rule.erl + the file-ACL source; result cache as in
+apps/emqx/src/emqx_authz_cache.erl).
+
+Rule = (permit|deny, who, action, topics):
+- who: 'all' | {'clientid': x} | {'username': x} | {'ipaddr': cidr-ish}
+- action: 'publish' | 'subscribe' | 'all'
+- topics: filters with ${clientid}/${username} placeholders; an 'eq ' prefix
+  compares literally instead of wildcard-matching (reference eq semantics).
+
+Folds over 'client.authorize'; first matching rule wins; default from
+`no_match` (allow, as the reference ships). Per-client result cache keyed
+(action, topic), invalidated by rule updates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.ops import topics as T
+
+Who = Union[str, Dict[str, str]]
+
+
+@dataclass
+class AclRule:
+    permit: str  # 'allow' | 'deny'
+    who: Who = "all"
+    action: str = "all"  # 'publish' | 'subscribe' | 'all'
+    topics: List[str] = field(default_factory=list)
+
+
+class Authorizer:
+    def __init__(
+        self,
+        rules: Optional[List[AclRule]] = None,
+        no_match: str = "allow",
+        deny_action: str = "ignore",
+        cache_size: int = 1024,
+    ):
+        self.rules = rules or []
+        self.no_match = no_match
+        self.deny_action = deny_action
+        self._cache: Dict[tuple, str] = {}
+        self._cache_size = cache_size
+        self._epoch = 0
+
+    def set_rules(self, rules: List[AclRule]) -> None:
+        self.rules = rules
+        self._cache.clear()
+        self._epoch += 1
+
+    def _who_matches(self, who: Who, ci: Dict) -> bool:
+        if who == "all":
+            return True
+        if isinstance(who, dict):
+            if "clientid" in who:
+                return ci.get("client_id") == who["clientid"]
+            if "username" in who:
+                return ci.get("username") == who["username"]
+            if "ipaddr" in who:
+                return str(ci.get("peerhost", "")).startswith(
+                    who["ipaddr"].rstrip("*")
+                )
+        return False
+
+    def _topic_matches(self, topic: str, pattern: str, ci: Dict) -> bool:
+        pattern = pattern.replace("${clientid}", ci.get("client_id", ""))
+        pattern = pattern.replace("${username}", ci.get("username") or "")
+        if pattern.startswith("eq "):
+            return topic == pattern[3:]
+        return T.match(topic, pattern)
+
+    def check(self, ci: Dict, action: str, topic: str) -> str:
+        if ci.get("is_superuser"):
+            return "allow"
+        # key must capture the full client identity: rules and placeholders
+        # depend on username/peerhost too, and client_ids can be reused by
+        # different principals across connections
+        key = (
+            ci.get("client_id", ""),
+            ci.get("username"),
+            str(ci.get("peerhost", "")),
+            action,
+            topic,
+        )
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        result = self.no_match
+        for r in self.rules:
+            if r.action not in (action, "all"):
+                continue
+            if not self._who_matches(r.who, ci):
+                continue
+            if any(self._topic_matches(topic, p, ci) for p in r.topics):
+                result = r.permit
+                break
+        if len(self._cache) >= self._cache_size:
+            self._cache.clear()
+        self._cache[key] = result
+        return result
+
+    def authorize(self, ci, action, topic, acc="allow"):
+        """'client.authorize' fold callback."""
+        result = self.check(ci, action, topic)
+        return ("stop", result) if result == "deny" else None
+
+    def attach(self, hooks: Hooks) -> None:
+        hooks.add("client.authorize", self.authorize, priority=100)

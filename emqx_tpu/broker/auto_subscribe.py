@@ -1,0 +1,57 @@
+"""Server-side forced subscriptions on connect
+(reference: apps/emqx_auto_subscribe, SURVEY.md §2.2: topics with
+${clientid}/${username} placeholders subscribed for every new connection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.mqtt import packet as pkt
+
+
+@dataclass
+class AutoSubscribeTopic:
+    filter: str
+    qos: int = 0
+    no_local: bool = False
+    retain_as_published: bool = False
+    retain_handling: int = 0
+
+
+class AutoSubscribe:
+    def __init__(self, topics: List[AutoSubscribeTopic]):
+        self.topics = topics
+
+    def on_connected(self, ci, channel=None) -> None:
+        if channel is None or channel.session is None:
+            return
+        for t in self.topics:
+            f = t.filter.replace("${clientid}", ci.get("client_id", ""))
+            f = f.replace("${username}", ci.get("username") or "")
+            opts = pkt.SubOpts(
+                qos=t.qos,
+                no_local=t.no_local,
+                retain_as_published=t.retain_as_published,
+                retain_handling=t.retain_handling,
+            )
+            channel.broker.subscribe(
+                channel.client_id,
+                channel.client_id,
+                f,
+                opts,
+                channel._make_deliverer(opts),
+            )
+            channel.session.subscriptions[f] = opts
+            channel.hooks.run(
+                "session.subscribed", ci, f, opts, channel
+            )
+
+    def attach(self, hooks: Hooks) -> None:
+        hooks.add(
+            "client.connected",
+            lambda ci, channel=None: self.on_connected(ci, channel),
+            priority=50,
+        )

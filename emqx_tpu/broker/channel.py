@@ -218,7 +218,7 @@ class Channel:
                 properties=props,
             )
         )
-        self.hooks.run("client.connected", self.client_info())
+        self.hooks.run("client.connected", self.client_info(), self)
         if present:
             for q in self.session.replay():
                 self._send(q)
@@ -304,9 +304,12 @@ class Channel:
 
     # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
     def _in_subscribe(self, p: pkt.Subscribe) -> None:
-        self.hooks.run("client.subscribe", self.client_info(), p.filters)
+        # fold so extensions (topic rewrite) can transform the filter list
+        filters = self.hooks.run_fold(
+            "client.subscribe", (self.client_info(),), p.filters
+        )
         rcs: List[int] = []
-        for f, opts in p.filters:
+        for f, opts in filters:
             try:
                 T.validate(f)
                 group, real = T.parse_share(f)
@@ -327,12 +330,14 @@ class Channel:
                 continue
             qos = min(opts.qos, self.config.caps.max_qos_allowed)
             opts.qos = qos
+            existing = f in self.session.subscriptions
+            opts._existing = existing  # for retain_handling=1 semantics
             self.broker.subscribe(
                 self.client_id, self.client_id, f, opts, self._make_deliverer(opts)
             )
             self.session.subscriptions[f] = opts
             self.hooks.run(
-                "session.subscribed", self.client_info(), f, opts
+                "session.subscribed", self.client_info(), f, opts, self
             )
             rcs.append(qos)  # granted qos == success codes 0..2
         self._send(pkt.Suback(packet_id=p.packet_id, reason_codes=rcs))
@@ -344,9 +349,11 @@ class Channel:
         return deliver
 
     def _in_unsubscribe(self, p: pkt.Unsubscribe) -> None:
-        self.hooks.run("client.unsubscribe", self.client_info(), p.filters)
+        filters = self.hooks.run_fold(
+            "client.unsubscribe", (self.client_info(),), p.filters
+        )
         rcs: List[int] = []
-        for f in p.filters:
+        for f in filters:
             existed = self.broker.unsubscribe(self.client_id, f)
             self.session.subscriptions.pop(f, None)
             if existed:

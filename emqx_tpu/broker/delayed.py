@@ -1,0 +1,70 @@
+"""Delayed publish: $delayed/<seconds>/<real topic>.
+
+Parity with the reference module (apps/emqx_modules/src/emqx_delayed.erl):
+messages published to $delayed/N/t are intercepted on the 'message.publish'
+hook, held for N seconds, then republished to t. Max delay capped; store is
+a heap swept by `tick()` from the server loop (the reference uses a
+mnesia-backed timer process).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import List, Optional, Tuple
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+
+PREFIX = "$delayed/"
+MAX_DELAY = 4294967  # seconds (reference cap)
+
+
+class DelayedPublish:
+    def __init__(self, broker, max_delay: int = MAX_DELAY):
+        self.broker = broker
+        self.max_delay = max_delay
+        self._heap: List[Tuple[float, int, Message]] = []
+        self._seq = 0
+        self.enabled = True
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def intercept(self, msg: Optional[Message]):
+        """'message.publish' fold callback: swallow $delayed messages."""
+        if msg is None or not self.enabled or not msg.topic.startswith(PREFIX):
+            return None  # keep acc
+        rest = msg.topic[len(PREFIX) :]
+        delay_s, sep, real_topic = rest.partition("/")
+        try:
+            delay = int(delay_s)
+        except ValueError:
+            delay = -1
+        if not sep or delay < 0 or real_topic == "":
+            return None  # malformed: treat as a normal topic
+        delay = min(delay, self.max_delay)
+        import copy
+
+        m = copy.copy(msg)
+        m.topic = real_topic
+        self._seq += 1
+        heapq.heappush(self._heap, (time.time() + delay, self._seq, m))
+        # stop the fold with None acc => broker.publish drops the original
+        return ("stop", None)
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Publish all due messages; returns how many fired."""
+        now = now or time.time()
+        n = 0
+        while self._heap and self._heap[0][0] <= now:
+            _, _, m = heapq.heappop(self._heap)
+            self.broker.publish(m)
+            n += 1
+        return n
+
+    def pending(self) -> List[Tuple[float, Message]]:
+        return [(due, m) for due, _, m in sorted(self._heap)]
+
+    def attach(self, hooks: Hooks) -> None:
+        hooks.add("message.publish", self.intercept, priority=200)

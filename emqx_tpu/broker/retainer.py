@@ -1,0 +1,199 @@
+"""Retained messages (reference: apps/emqx_retainer, SURVEY.md §2.2).
+
+Behavior parity with emqx_retainer_mnesia.erl: store on PUBLISH with
+retain=1 (empty payload deletes, :28-65), deliver matching retained messages
+on subscribe (wildcard `match_messages` scan :146-152), expiry sweep
+(`clear_expired`), and a bounded message count.
+
+Storage is a topic trie over the *retained topics* so a wildcard
+subscription filter finds its matches by walking the trie with the filter
+(the transpose of routing: filter-vs-stored-topics instead of
+topic-vs-stored-filters). A TPU retained-replay kernel (BASELINE config #5:
+5M retained, cold subscribe storm) slots in behind the same API later.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.ops import topics as T
+
+
+class _Node:
+    __slots__ = ("children", "msg")
+
+    def __init__(self):
+        self.children: Dict[str, _Node] = {}
+        self.msg: Optional[Message] = None
+
+
+class Retainer:
+    def __init__(self, max_retained: int = 1_000_000, max_payload: int = 1024 * 1024):
+        self._root = _Node()
+        self._count = 0
+        self.max_retained = max_retained
+        self.max_payload = max_payload
+        self.enabled = True
+
+    def __len__(self) -> int:
+        return self._count
+
+    # -- store side -------------------------------------------------------
+    def on_publish(self, msg: Message) -> None:
+        """Called from the 'message.publish' pipeline for retain=1 messages."""
+        if not self.enabled or not msg.retain or msg.topic.startswith("$SYS/"):
+            return
+        if msg.payload == b"":
+            self.delete(msg.topic)
+            return
+        if len(msg.payload) > self.max_payload:
+            return
+        self._insert(msg)
+
+    def _insert(self, msg: Message) -> None:
+        node = self._root
+        for w in T.words(msg.topic):
+            node = node.children.setdefault(w, _Node())
+        if node.msg is None:
+            if self._count >= self.max_retained:
+                return
+            self._count += 1
+        node.msg = msg
+
+    def delete(self, topic: str) -> bool:
+        path: List[Tuple[_Node, str]] = []
+        node = self._root
+        for w in T.words(topic):
+            child = node.children.get(w)
+            if child is None:
+                return False
+            path.append((node, w))
+            node = child
+        if node.msg is None:
+            return False
+        node.msg = None
+        self._count -= 1
+        for parent, w in reversed(path):
+            child = parent.children[w]
+            if child.msg is None and not child.children:
+                del parent.children[w]
+            else:
+                break
+        return True
+
+    def get(self, topic: str) -> Optional[Message]:
+        node = self._root
+        for w in T.words(topic):
+            node = node.children.get(w)
+            if node is None:
+                return None
+        return node.msg
+
+    # -- read side --------------------------------------------------------
+    def match(self, filter_: str, now: Optional[float] = None) -> List[Message]:
+        """All live retained messages whose topic matches `filter_`."""
+        fw = T.words(filter_)
+        out: List[Message] = []
+        now = now or time.time()
+
+        def walk(node: _Node, i: int, root_level: bool) -> None:
+            if i == len(fw):
+                if node.msg is not None and not node.msg.is_expired(now):
+                    out.append(node.msg)
+                return
+            w = fw[i]
+            if w == "#":
+                # matches parent and every descendant; skip $-roots at top
+                def rec(n: _Node, skip_dollar: bool) -> None:
+                    if n.msg is not None and not n.msg.is_expired(now):
+                        out.append(n.msg)
+                    for cw, c in n.children.items():
+                        if skip_dollar and cw.startswith("$"):
+                            continue
+                        rec(c, False)
+
+                if i == 0:
+                    for cw, c in node.children.items():
+                        if not cw.startswith("$"):
+                            rec(c, False)
+                else:
+                    rec(node, False)
+                return
+            if w == "+":
+                for cw, c in node.children.items():
+                    if root_level and cw.startswith("$"):
+                        continue
+                    walk(c, i + 1, False)
+                return
+            c = node.children.get(w)
+            if c is not None:
+                walk(c, i + 1, False)
+
+        walk(self._root, 0, True)
+        return out
+
+    def clear_expired(self, now: Optional[float] = None) -> int:
+        now = now or time.time()
+        removed: List[str] = []
+
+        def sweep(node: _Node, prefix: List[str]) -> None:
+            if node.msg is not None and node.msg.is_expired(now):
+                removed.append("/".join(prefix))
+            for w, c in list(node.children.items()):
+                prefix.append(w)
+                sweep(c, prefix)
+                prefix.pop()
+
+        sweep(self._root, [])
+        for t in removed:
+            self.delete(t)
+        return len(removed)
+
+    def topics(self) -> List[str]:
+        out: List[str] = []
+
+        def walk(node: _Node, prefix: List[str]) -> None:
+            if node.msg is not None:
+                out.append("/".join(prefix))
+            for w, c in node.children.items():
+                prefix.append(w)
+                walk(c, prefix)
+                prefix.pop()
+
+        walk(self._root, [])
+        return out
+
+    # -- wiring -----------------------------------------------------------
+    def attach(self, hooks: Hooks) -> None:
+        """Install on the reference's hookpoints
+        ('message.publish' + 'session.subscribed', emqx_retainer.erl)."""
+
+        def on_pub(msg):
+            if msg is not None:
+                self.on_publish(msg)
+            return None
+
+        def on_sub(client_info, filter_, opts, channel=None):
+            # delivery handled by the channel integration (channel passes
+            # itself; standalone tests may not)
+            if channel is None:
+                return
+            group, real = T.parse_share(filter_)
+            if group is not None:
+                return  # no retained delivery for shared subs (spec)
+            if opts.retain_handling == 2:
+                return
+            if opts.retain_handling == 1 and getattr(opts, "_existing", False):
+                return
+            for m in self.match(real):
+                import copy
+
+                mm = copy.copy(m)
+                mm.headers = dict(m.headers, retained=True)
+                channel.handle_deliver(mm, opts)
+
+        hooks.add("message.publish", lambda msg: on_pub(msg), priority=100)
+        hooks.add("session.subscribed", on_sub)

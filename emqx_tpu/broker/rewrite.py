@@ -1,0 +1,87 @@
+"""Topic rewrite rules (reference: apps/emqx_modules/src/emqx_rewrite.erl).
+
+Each rule: (action pub|sub|all, source topic filter, regex, dest template).
+A topic matching BOTH the filter and the regex is rewritten to the template
+with \\1..\\9 regex groups and ${clientid}/${username} placeholders.
+Publish rewrites run on 'message.publish'; subscribe rewrites fold over the
+filter list on 'client.subscribe'/'client.unsubscribe'.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.ops import topics as T
+
+
+@dataclass
+class RewriteRule:
+    action: str  # 'pub' | 'sub' | 'all'
+    source: str  # topic filter
+    regex: str
+    dest: str
+
+    def __post_init__(self):
+        self._re = re.compile(self.regex)
+
+
+class TopicRewrite:
+    def __init__(self, rules: Optional[List[RewriteRule]] = None):
+        self.rules = rules or []
+
+    def _apply(self, topic: str, action: str, client_info=None) -> str:
+        for r in self.rules:
+            if r.action not in (action, "all"):
+                continue
+            if not T.match(topic, r.source):
+                continue
+            m = r._re.match(topic)
+            if not m:
+                continue
+            dest = r.dest
+            for i, g in enumerate(m.groups(), start=1):
+                dest = dest.replace(f"${i}", g or "")
+            if client_info:
+                dest = dest.replace("${clientid}", client_info.get("client_id", ""))
+                dest = dest.replace("${username}", client_info.get("username") or "")
+            return dest
+        return topic
+
+    def rewrite_publish(self, msg):
+        if msg is None:
+            return None
+        new = self._apply(msg.topic, "pub")
+        if new != msg.topic:
+            import copy
+
+            m = copy.copy(msg)
+            m.topic = new
+            return ("ok", m)
+        return None
+
+    def rewrite_subscribe(self, client_info, filters):
+        """Fold callback for 'client.subscribe': filters is the acc."""
+        out = []
+        changed = False
+        for item in filters:
+            f, opts = item if isinstance(item, tuple) else (item, None)
+            nf = self._apply(f, "sub", client_info)
+            changed = changed or nf != f
+            out.append((nf, opts) if opts is not None else nf)
+        return ("ok", out) if changed else None
+
+    def attach(self, hooks: Hooks) -> None:
+        hooks.add("message.publish", self.rewrite_publish, priority=150)
+        hooks.add(
+            "client.subscribe",
+            lambda ci, acc: self.rewrite_subscribe(ci, acc),
+            priority=150,
+        )
+        hooks.add(
+            "client.unsubscribe",
+            lambda ci, acc: self.rewrite_subscribe(ci, acc),
+            priority=150,
+        )

@@ -61,22 +61,31 @@ class Session:
     ) -> List[pkt.Publish]:
         """Accept one routed message; return PUBLISH packets ready to send."""
         qos = min(msg.qos, opts.qos) if opts else msg.qos
+        # MQTT spec: forwarded messages carry retain=0 unless the subscription
+        # set retain-as-published; retained-store replays keep retain=1
+        retain = (
+            msg.retain
+            if (opts and opts.retain_as_published)
+            else bool(msg.headers.get("retained"))
+        )
+        msg = self._adjust(msg, qos, retain)
         if qos == 0:
             return [self._publish_packet(msg, 0, None)]
         if self.inflight.is_full():
-            self.mqueue.in_(self._with_qos(msg, qos))
+            self.mqueue.in_(msg)
             return []
         pid = self.alloc_packet_id()
-        self.inflight.insert(pid, self._with_qos(msg, qos))
+        self.inflight.insert(pid, msg)
         return [self._publish_packet(msg, qos, pid)]
 
-    def _with_qos(self, msg: Message, qos: int) -> Message:
-        if msg.qos == qos:
+    def _adjust(self, msg: Message, qos: int, retain: bool) -> Message:
+        if msg.qos == qos and msg.retain == retain:
             return msg
         import copy
 
         m = copy.copy(msg)
         m.qos = qos
+        m.retain = retain
         return m
 
     def _publish_packet(

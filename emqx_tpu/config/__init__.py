@@ -1,0 +1,7 @@
+"""Typed configuration system."""
+
+from emqx_tpu.config.schema import (  # noqa: F401
+    AppConfig,
+    load_config,
+    load_file,
+)

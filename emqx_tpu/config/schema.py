@@ -1,0 +1,285 @@
+"""Config schema: one typed dataclass tree, one loader, env overrides.
+
+The reference's config plane is HOCON text checked against typerefl schemas
+and stored in persistent_term with env overrides under `EMQX_`
+(apps/emqx/src/emqx_config.erl:199-218, emqx_schema.erl,
+bin/emqx:31 HOCON_ENV_OVERRIDE_PREFIX). Here the single source of truth is
+this dataclass tree: it gives defaults, types, validation, JSON round-trip,
+and (in emqx_tpu.mgmt.api) the /configs REST payload — one schema feeding
+validation and the API, as emqx_dashboard_swagger does from HOCON.
+
+Files are JSON (optionally with #-comments). Env overrides use
+EMQX_TPU__SECTION__FIELD=value paths, e.g.
+EMQX_TPU__MQTT__MAX_PACKET_SIZE=2097152.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Dict, List, Optional, get_args, get_origin
+
+from emqx_tpu.broker.session import SessionConfig
+from emqx_tpu.broker.channel import MqttCaps
+
+ENV_PREFIX = "EMQX_TPU__"
+
+
+@dataclass
+class NodeConfig:
+    name: str = ""
+    cookie: str = "emqxtpusecret"
+
+
+@dataclass
+class ListenerSpec:
+    name: str = "default"
+    type: str = "tcp"  # tcp | ssl
+    bind: str = "0.0.0.0"
+    port: int = 1883
+    max_connections: int = 1_024_000
+    ssl_certfile: Optional[str] = None
+    ssl_keyfile: Optional[str] = None
+    ssl_cacertfile: Optional[str] = None
+    ssl_verify: bool = False
+
+
+@dataclass
+class RouterConfig:
+    enable_tpu: bool = True
+    min_tpu_batch: int = 64
+    max_levels: int = 16
+    frontier: int = 32
+    max_matches: int = 64
+    max_bytes: int = 256
+
+
+@dataclass
+class RetainerConfig:
+    enable: bool = True
+    max_retained_messages: int = 1_000_000
+    max_payload_size: int = 1024 * 1024
+    msg_clear_interval: float = 60.0
+
+
+@dataclass
+class DelayedConfig:
+    enable: bool = True
+    max_delayed_messages: int = 0  # 0 = unlimited
+
+
+@dataclass
+class RewriteRuleSpec:
+    action: str = "all"
+    source_topic: str = ""
+    re: str = ""
+    dest_topic: str = ""
+
+
+@dataclass
+class AuthUser:
+    user_id: str = ""
+    password: str = ""
+    is_superuser: bool = False
+
+
+@dataclass
+class AuthnConfig:
+    enable: bool = False
+    allow_anonymous: bool = True
+    user_id_type: str = "username"
+    password_hash: str = "pbkdf2"
+    users: List[AuthUser] = field(default_factory=list)
+    jwt_secret: str = ""
+    jwt_verify_claims: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AclRuleSpec:
+    permit: str = "allow"
+    who: str = "all"  # all | clientid:<x> | username:<x> | ipaddr:<prefix>
+    action: str = "all"
+    topics: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AuthzConfig:
+    no_match: str = "allow"
+    rules: List[AclRuleSpec] = field(default_factory=list)
+
+
+@dataclass
+class FlappingConfig:
+    enable: bool = True
+    max_count: int = 15
+    window_time: float = 60.0
+    ban_time: float = 300.0
+
+
+@dataclass
+class SharedSubConfig:
+    strategy: str = "round_robin"
+
+
+@dataclass
+class SysConfig:
+    sys_msg_interval: float = 60.0  # $SYS heartbeat
+    sys_heartbeat_interval: float = 30.0
+
+
+@dataclass
+class DashboardConfig:
+    enable: bool = True
+    bind: str = "127.0.0.1"
+    port: int = 18083
+    api_key: str = ""  # empty => no auth (dev mode)
+
+
+@dataclass
+class AutoSubscribeSpec:
+    topic: str = ""
+    qos: int = 0
+
+
+@dataclass
+class AppConfig:
+    node: NodeConfig = field(default_factory=NodeConfig)
+    listeners: List[ListenerSpec] = field(default_factory=lambda: [ListenerSpec()])
+    mqtt: MqttCaps = field(default_factory=MqttCaps)
+    session: SessionConfig = field(default_factory=SessionConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
+    retainer: RetainerConfig = field(default_factory=RetainerConfig)
+    delayed: DelayedConfig = field(default_factory=DelayedConfig)
+    rewrite: List[RewriteRuleSpec] = field(default_factory=list)
+    authn: AuthnConfig = field(default_factory=AuthnConfig)
+    authz: AuthzConfig = field(default_factory=AuthzConfig)
+    flapping: FlappingConfig = field(default_factory=FlappingConfig)
+    shared_subscription: SharedSubConfig = field(default_factory=SharedSubConfig)
+    sys: SysConfig = field(default_factory=SysConfig)
+    dashboard: DashboardConfig = field(default_factory=DashboardConfig)
+    auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _coerce(tp, value, path):
+    origin = get_origin(tp)
+    if is_dataclass(tp):
+        if not isinstance(value, dict):
+            raise ConfigError(f"{path}: expected object, got {value!r}")
+        return _from_dict(tp, value, path)
+    if origin is list:
+        (item_t,) = get_args(tp)
+        if not isinstance(value, list):
+            raise ConfigError(f"{path}: expected list")
+        return [_coerce(item_t, v, f"{path}[{i}]") for i, v in enumerate(value)]
+    if origin is dict:
+        return dict(value)
+    if tp is Optional[str] or tp == Optional[str]:
+        return None if value is None else str(value)
+    if origin is not None:  # other Optionals / unions: pass through
+        return value
+    if tp is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if tp is int:
+        return int(value)
+    if tp is float:
+        return float(value)
+    if tp is str:
+        return str(value)
+    return value
+
+
+def _from_dict(cls, data: Dict, path: str = ""):
+    import typing
+
+    known = {f.name for f in fields(cls)}
+    for k in data:
+        if k not in known:
+            raise ConfigError(f"{path or cls.__name__}: unknown key {k!r}")
+    # field types are strings under `from __future__ import annotations`
+    hints = typing.get_type_hints(cls)
+    kwargs = {
+        name: _coerce(hints[name], data[name], f"{path}.{name}")
+        for name in known
+        if name in data
+    }
+    return cls(**kwargs)
+
+
+def to_dict(cfg) -> Dict:
+    return dataclasses.asdict(cfg)
+
+
+_COMMENT_RE = re.compile(r"^\s*#.*$", re.M)
+
+
+def load_config(data: Dict) -> AppConfig:
+    cfg = _from_dict(AppConfig, data)
+    _apply_env_overrides(cfg)
+    _validate(cfg)
+    return cfg
+
+
+def load_file(path: Optional[str]) -> AppConfig:
+    if path is None:
+        return load_config({})
+    with open(path) as f:
+        text = _COMMENT_RE.sub("", f.read())
+    return load_config(json.loads(text) if text.strip() else {})
+
+
+def _apply_env_overrides(cfg: AppConfig) -> None:
+    """EMQX_TPU__MQTT__MAX_QOS_ALLOWED=1 style deep overrides."""
+    import typing
+
+    for key, raw in os.environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        parts = [p.lower() for p in key[len(ENV_PREFIX) :].split("__")]
+        obj = cfg
+        ok = True
+        for p in parts[:-1]:
+            if not hasattr(obj, p):
+                ok = False
+                break
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        if not ok or not hasattr(obj, leaf):
+            raise ConfigError(f"unknown config env override: {key}")
+        hints = typing.get_type_hints(type(obj))
+        setattr(obj, leaf, _coerce(hints[leaf], raw, key))
+
+
+def _validate(cfg: AppConfig) -> None:
+    if not cfg.listeners:
+        raise ConfigError("at least one listener is required")
+    seen = set()
+    for l in cfg.listeners:
+        key = (l.type, l.name)
+        if key in seen:
+            raise ConfigError(f"duplicate listener {key}")
+        seen.add(key)
+        if l.type not in ("tcp", "ssl"):
+            raise ConfigError(f"unsupported listener type {l.type!r}")
+        if l.type == "ssl" and not (l.ssl_certfile and l.ssl_keyfile):
+            raise ConfigError("ssl listener requires certfile and keyfile")
+    if cfg.shared_subscription.strategy not in (
+        "random", "round_robin", "sticky", "hash_clientid", "hash_topic",
+    ):
+        raise ConfigError(
+            f"unknown shared sub strategy {cfg.shared_subscription.strategy!r}"
+        )
+    if cfg.authz.no_match not in ("allow", "deny"):
+        raise ConfigError("authz.no_match must be allow|deny")
+    if not 0 <= cfg.mqtt.max_qos_allowed <= 2:
+        raise ConfigError("mqtt.max_qos_allowed must be 0..2")

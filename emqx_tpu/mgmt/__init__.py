@@ -1,0 +1,2 @@
+"""Management plane: REST API + CLI (reference: apps/emqx_management,
+apps/emqx_dashboard backend, emqx_ctl)."""

@@ -1,0 +1,265 @@
+"""REST management API (reference: apps/emqx_management/src/emqx_mgmt_api_*,
+served at /api/v5 like the reference's minirest dashboard listener).
+
+Endpoints:
+  GET    /api/v5/status                       node + broker liveness
+  GET    /api/v5/metrics                      counters
+  GET    /api/v5/stats                        gauges
+  GET    /api/v5/clients[?like=]              connected clients
+  GET    /api/v5/clients/{clientid}
+  DELETE /api/v5/clients/{clientid}           kick
+  GET    /api/v5/subscriptions[?clientid=]
+  GET    /api/v5/routes                       route table topics
+  POST   /api/v5/publish                      {topic, payload, qos, retain}
+  GET    /api/v5/banned  POST /api/v5/banned  DELETE /api/v5/banned/{kind}/{v}
+  GET    /api/v5/retainer/messages
+  DELETE /api/v5/retainer/message/{topic}
+  GET    /api/v5/configs                      full running config
+
+Auth: `Authorization: Bearer <api_key>` when dashboard.api_key is set
+(emqx_mgmt_auth analog); open in dev mode otherwise.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from emqx_tpu.broker.banned import BanEntry
+from emqx_tpu.broker.message import Message
+from emqx_tpu.config.schema import to_dict
+from emqx_tpu.utils.node import node_name
+
+
+class MgmtApi:
+    def __init__(self, app):
+        self.app = app
+        self.broker = app.broker
+        self.cm = app.cm
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+        w = web.Application(middlewares=[self._auth_middleware])
+        w.add_routes(
+            [
+                web.get("/api/v5/status", self.status),
+                web.get("/api/v5/metrics", self.metrics),
+                web.get("/api/v5/stats", self.stats),
+                web.get("/api/v5/clients", self.clients),
+                web.get("/api/v5/clients/{clientid}", self.client_one),
+                web.delete("/api/v5/clients/{clientid}", self.client_kick),
+                web.get("/api/v5/subscriptions", self.subscriptions),
+                web.get("/api/v5/routes", self.routes),
+                web.post("/api/v5/publish", self.publish),
+                web.get("/api/v5/banned", self.banned_list),
+                web.post("/api/v5/banned", self.banned_add),
+                web.delete("/api/v5/banned/{kind}/{value}", self.banned_del),
+                web.get("/api/v5/retainer/messages", self.retained_list),
+                web.delete(
+                    "/api/v5/retainer/message/{topic:.+}", self.retained_del
+                ),
+                web.get("/api/v5/configs", self.configs),
+            ]
+        )
+        self._webapp = w
+
+    @web.middleware
+    async def _auth_middleware(self, request, handler):
+        key = self.app.config.dashboard.api_key
+        if key:
+            auth = request.headers.get("Authorization", "")
+            ok = auth == f"Bearer {key}"
+            if not ok and auth.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode()
+                    ok = decoded.split(":", 1)[-1] == key
+                except Exception:
+                    ok = False
+            if not ok:
+                return web.json_response(
+                    {"code": "UNAUTHORIZED"}, status=401
+                )
+        return await handler(request)
+
+    async def start(self, bind: str, port: int) -> None:
+        self._runner = web.AppRunner(self._webapp)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, bind, port)
+        await site.start()
+        self.port = self._runner.addresses[0][1] if self._runner.addresses else port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+    async def status(self, request):
+        return web.json_response(
+            {
+                "node": node_name(),
+                "status": "running",
+                "version": __import__("emqx_tpu").__version__,
+                "uptime_seconds": self.broker.metrics.snapshot()[
+                    "uptime_seconds"
+                ],
+                "connections": self.cm.channel_count(),
+                "subscriptions": self.broker.subscription_count(),
+                "routes": len(self.broker.router),
+                "retained": len(self.app.retainer),
+            }
+        )
+
+    async def metrics(self, request):
+        return web.json_response(self.broker.metrics.snapshot())
+
+    async def stats(self, request):
+        return web.json_response(
+            {
+                "connections.count": self.cm.channel_count(),
+                "subscriptions.count": self.broker.subscription_count(),
+                "topics.count": len(self.broker.router),
+                "retained.count": len(self.app.retainer),
+                "delayed.count": len(self.app.delayed),
+            }
+        )
+
+    def _client_json(self, ch):
+        return {
+            "clientid": ch.client_id,
+            "username": ch.username,
+            "proto_ver": ch.version,
+            "clean_start": ch.clean_start,
+            "keepalive": ch.keepalive,
+            "connected_at": ch.connected_at,
+            "peerhost": ch.conninfo.get("peerhost"),
+            "subscriptions_cnt": len(ch.session.subscriptions)
+            if ch.session
+            else 0,
+        }
+
+    async def clients(self, request):
+        like = request.query.get("like", "")
+        out = [
+            self._client_json(self.cm.get_channel(cid))
+            for cid in self.cm.client_ids()
+            if like in cid
+        ]
+        return web.json_response({"data": out, "meta": {"count": len(out)}})
+
+    async def client_one(self, request):
+        ch = self.cm.get_channel(request.match_info["clientid"])
+        if ch is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response(self._client_json(ch))
+
+    async def client_kick(self, request):
+        ok = self.cm.kick_client(request.match_info["clientid"])
+        if not ok:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response({}, status=204)
+
+    async def subscriptions(self, request):
+        cid = request.query.get("clientid")
+        out = [
+            {
+                "clientid": c,
+                "topic": f,
+                "qos": o.qos,
+                "no_local": o.no_local,
+            }
+            for (c, f, o) in self.broker.subscriptions()
+            if cid is None or c == cid
+        ]
+        return web.json_response({"data": out, "meta": {"count": len(out)}})
+
+    async def routes(self, request):
+        topics = self.broker.router.topics()
+        return web.json_response(
+            {"data": topics, "meta": {"count": len(topics)}}
+        )
+
+    async def publish(self, request):
+        from emqx_tpu.ops import topics as T
+
+        try:
+            body = await request.json()
+            topic = body["topic"]
+            payload = body.get("payload", "")
+            if not isinstance(topic, str) or not isinstance(payload, str):
+                raise KeyError("topic/payload must be strings")
+            T.validate(topic, kind="name")
+            if body.get("payload_encoding") == "base64":
+                payload = base64.b64decode(payload, validate=True)
+            else:
+                payload = payload.encode()
+        except (json.JSONDecodeError, KeyError, ValueError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        n = self.broker.publish(
+            Message(
+                topic=topic,
+                payload=payload,
+                qos=int(body.get("qos", 0)),
+                retain=bool(body.get("retain", False)),
+                from_client="mgmt_api",
+            )
+        )
+        return web.json_response({"delivered": n})
+
+    async def banned_list(self, request):
+        return web.json_response(
+            {
+                "data": [
+                    dataclasses.asdict(e) for e in self.app.banned.entries()
+                ]
+            }
+        )
+
+    async def banned_add(self, request):
+        try:
+            body = await request.json()
+            kind = body["as"]
+            if kind not in ("clientid", "username", "peerhost"):
+                raise ValueError(f"invalid kind {kind!r}")
+            self.app.banned.add(
+                BanEntry(
+                    kind=kind,
+                    value=str(body["who"]),
+                    by=str(body.get("by", "mgmt_api")),
+                    reason=str(body.get("reason", "")),
+                    until=float(body.get("until", float("inf"))),
+                )
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response({}, status=201)
+
+    async def banned_del(self, request):
+        ok = self.app.banned.delete(
+            request.match_info["kind"], request.match_info["value"]
+        )
+        return web.json_response(
+            {} if ok else {"code": "NOT_FOUND"}, status=204 if ok else 404
+        )
+
+    async def retained_list(self, request):
+        topics = self.app.retainer.topics()
+        return web.json_response(
+            {"data": topics, "meta": {"count": len(topics)}}
+        )
+
+    async def retained_del(self, request):
+        ok = self.app.retainer.delete(request.match_info["topic"])
+        return web.json_response(
+            {} if ok else {"code": "NOT_FOUND"}, status=204 if ok else 404
+        )
+
+    async def configs(self, request):
+        return web.json_response(to_dict(self.app.config))

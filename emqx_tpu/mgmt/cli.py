@@ -1,0 +1,80 @@
+"""Admin CLI (reference: bin/emqx_ctl -> emqx_ctl command registry ->
+emqx_mgmt_cli.erl). Talks to the running broker's REST API.
+
+Usage: python -m emqx_tpu.mgmt.cli [--url http://127.0.0.1:18083] [--key K] CMD
+Commands: status | metrics | stats | clients | client <id> | kick <id> |
+subscriptions | routes | publish <topic> <payload> [--qos N] [--retain] |
+banned | ban <kind> <value> | unban <kind> <value> | retained | configs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _call(url: str, key: str, method: str = "GET", body=None):
+    req = urllib.request.Request(url, method=method)
+    if key:
+        req.add_header("Authorization", f"Bearer {key}")
+    data = None
+    if body is not None:
+        req.add_header("Content-Type", "application/json")
+        data = json.dumps(body).encode()
+    try:
+        with urllib.request.urlopen(req, data=data, timeout=10) as resp:
+            text = resp.read().decode() or "{}"
+            return resp.status, json.loads(text)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="emqx_tpu_ctl", description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:18083")
+    ap.add_argument("--key", default="")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    a = ap.parse_args(argv)
+    if not a.cmd:
+        ap.print_usage(sys.stderr)
+        return 2
+    base = a.url.rstrip("/") + "/api/v5"
+    cmd, *rest = a.cmd
+
+    if cmd in ("status", "metrics", "stats", "subscriptions", "routes", "configs"):
+        code, out = _call(f"{base}/{cmd}", a.key)
+    elif cmd == "clients":
+        code, out = _call(f"{base}/clients", a.key)
+    elif cmd == "client":
+        code, out = _call(f"{base}/clients/{rest[0]}", a.key)
+    elif cmd == "kick":
+        code, out = _call(f"{base}/clients/{rest[0]}", a.key, "DELETE")
+    elif cmd == "publish":
+        body = {"topic": rest[0], "payload": rest[1] if len(rest) > 1 else ""}
+        if "--qos" in rest:
+            body["qos"] = int(rest[rest.index("--qos") + 1])
+        if "--retain" in rest:
+            body["retain"] = True
+        code, out = _call(f"{base}/publish", a.key, "POST", body)
+    elif cmd == "banned":
+        code, out = _call(f"{base}/banned", a.key)
+    elif cmd == "ban":
+        code, out = _call(
+            f"{base}/banned", a.key, "POST", {"as": rest[0], "who": rest[1]}
+        )
+    elif cmd == "unban":
+        code, out = _call(f"{base}/banned/{rest[0]}/{rest[1]}", a.key, "DELETE")
+    elif cmd == "retained":
+        code, out = _call(f"{base}/retainer/messages", a.key)
+    else:
+        print(f"unknown command: {cmd}", file=sys.stderr)
+        return 2
+    print(json.dumps(out, indent=2, default=str))
+    return 0 if code < 400 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

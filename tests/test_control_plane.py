@@ -1,0 +1,244 @@
+"""Control-plane tests: config schema, BrokerApp assembly, REST API, CLI,
+$SYS heartbeat (parity targets: emqx_conf schema checks + emqx_management
+API suites)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.config.schema import (
+    AppConfig,
+    ConfigError,
+    load_config,
+    to_dict,
+)
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.client import Client
+from tests.test_broker_e2e import async_test
+
+
+def test_config_defaults_and_roundtrip():
+    cfg = load_config({})
+    assert cfg.listeners[0].port == 1883
+    assert cfg.mqtt.max_qos_allowed == 2
+    d = to_dict(cfg)
+    assert d["router"]["enable_tpu"] is True
+
+
+def test_config_nested_and_validation():
+    cfg = load_config(
+        {
+            "mqtt": {"max_qos_allowed": 1},
+            "listeners": [{"name": "a", "port": 2883}],
+            "authz": {"rules": [{"permit": "deny", "topics": ["x/#"]}]},
+        }
+    )
+    assert cfg.mqtt.max_qos_allowed == 1
+    assert cfg.listeners[0].port == 2883
+    assert cfg.authz.rules[0].permit == "deny"
+    with pytest.raises(ConfigError):
+        load_config({"unknown_section": {}})
+    with pytest.raises(ConfigError):
+        load_config({"mqtt": {"max_qos_allowed": 7}})
+    with pytest.raises(ConfigError):
+        load_config({"listeners": [{"type": "quic"}]})
+    with pytest.raises(ConfigError):
+        load_config({"shared_subscription": {"strategy": "bogus"}})
+
+
+def test_config_env_overrides():
+    os.environ["EMQX_TPU__MQTT__MAX_QOS_ALLOWED"] = "1"
+    os.environ["EMQX_TPU__ROUTER__ENABLE_TPU"] = "false"
+    try:
+        cfg = load_config({})
+        assert cfg.mqtt.max_qos_allowed == 1
+        assert cfg.router.enable_tpu is False
+        os.environ["EMQX_TPU__NOPE__X"] = "1"
+        with pytest.raises(ConfigError):
+            load_config({})
+    finally:
+        for k in list(os.environ):
+            if k.startswith("EMQX_TPU__"):
+                del os.environ[k]
+
+
+def _app_config(**over):
+    data = {
+        "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+        "dashboard": {"port": 0, "bind": "127.0.0.1"},
+        "router": {"enable_tpu": False},
+        "sys": {"sys_msg_interval": 0.3},
+        **over,
+    }
+    return load_config(data)
+
+
+@async_test
+async def test_app_end_to_end_with_rest():
+    import aiohttp
+
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        mqtt_port = list(app.listeners.list().values())[0].port
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        c = Client("api-test", version=pkt.MQTT_V5)
+        await c.connect("127.0.0.1", mqtt_port)
+        await c.subscribe("api/t", qos=1)
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/status") as r:
+                st = await r.json()
+                assert st["status"] == "running"
+                assert st["connections"] == 1
+            async with s.get(f"{api}/clients") as r:
+                data = (await r.json())["data"]
+                assert data[0]["clientid"] == "api-test"
+            async with s.get(f"{api}/subscriptions") as r:
+                subs = (await r.json())["data"]
+                assert subs == [
+                    {
+                        "clientid": "api-test",
+                        "topic": "api/t",
+                        "qos": 1,
+                        "no_local": False,
+                    }
+                ]
+            async with s.post(
+                f"{api}/publish", json={"topic": "api/t", "payload": "from-rest"}
+            ) as r:
+                assert (await r.json())["delivered"] == 1
+            m = await c.recv()
+            assert m.payload == b"from-rest"
+            # ban + kick
+            async with s.post(
+                f"{api}/banned", json={"as": "clientid", "who": "api-test"}
+            ) as r:
+                assert r.status == 201
+            async with s.delete(f"{api}/clients/api-test") as r:
+                assert r.status == 204
+            await c.closed.wait()
+            async with s.get(f"{api}/clients") as r:
+                assert (await r.json())["data"] == []
+            # $SYS heartbeat publishes metrics topics
+            watcher = Client("sysw", version=pkt.MQTT_V5)
+            await watcher.connect("127.0.0.1", mqtt_port)
+            await watcher.subscribe("$SYS/brokers/#")
+            m = await watcher.recv(timeout=2)
+            assert m.topic.startswith("$SYS/brokers/")
+            await watcher.disconnect()
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_api_key_auth():
+    import aiohttp
+
+    app = BrokerApp(_app_config(dashboard={"port": 0, "bind": "127.0.0.1", "api_key": "sekrit"}))
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/status") as r:
+                assert r.status == 401
+            async with s.get(
+                f"{api}/status", headers={"Authorization": "Bearer sekrit"}
+            ) as r:
+                assert r.status == 200
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_cli_against_running_app():
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        from emqx_tpu.mgmt import cli
+
+        url = f"http://127.0.0.1:{app.mgmt_server.port}"
+        loop = asyncio.get_event_loop()
+        import contextlib
+        import io
+
+        def run_cli(*args):
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = cli.main(["--url", url, *args])
+            return rc, json.loads(buf.getvalue())
+
+        rc, out = await loop.run_in_executor(None, run_cli, "status")
+        assert rc == 0 and out["status"] == "running"
+        rc, out = await loop.run_in_executor(
+            None, run_cli, "publish", "cli/t", "hello", "--retain"
+        )
+        assert rc == 0
+        rc, out = await loop.run_in_executor(None, run_cli, "retained")
+        assert out["data"] == ["cli/t"]
+        rc, out = await loop.run_in_executor(None, run_cli, "ban", "clientid", "bad")
+        assert rc == 0
+        rc, out = await loop.run_in_executor(None, run_cli, "banned")
+        assert out["data"][0]["value"] == "bad"
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_app_with_full_extension_config():
+    """Config-driven wiring: authn users, acl rules, rewrite, auto-subscribe."""
+    cfg = _app_config(
+        authn={
+            "enable": True,
+            "allow_anonymous": False,
+            "users": [{"user_id": "u1", "password": "p1"}],
+        },
+        authz={
+            "no_match": "allow",
+            "rules": [
+                {"permit": "deny", "action": "publish", "topics": ["deny/#"]}
+            ],
+        },
+        rewrite=[
+            {
+                "action": "all",
+                "source_topic": "old/#",
+                "re": "^old/(.+)$",
+                "dest_topic": "new/$1",
+            }
+        ],
+        auto_subscribe=[{"topic": "inbox/${clientid}", "qos": 1}],
+    )
+    app = BrokerApp(cfg)
+    await app.start()
+    try:
+        port = list(app.listeners.list().values())[0].port
+        c = Client("full-1", version=pkt.MQTT_V5, username="u1", password=b"p1")
+        await c.connect("127.0.0.1", port)
+        # auto-subscribed inbox
+        c2 = Client("full-2", username="u1", password=b"p1")
+        await c2.connect("127.0.0.1", port)
+        await c2.publish("inbox/full-1", b"hi", qos=1)
+        m = await c.recv()
+        assert m.payload == b"hi"
+        # rewrite old/x -> new/x
+        await c.subscribe("new/+")
+        await c2.publish("old/x", b"rw")
+        m = await c.recv()
+        assert m.topic == "new/x"
+        # authz deny
+        ack = await c.publish("deny/x", b"no", qos=1)
+        assert ack.reason_code == pkt.RC_NOT_AUTHORIZED
+        # anonymous rejected
+        from emqx_tpu.mqtt.client import MqttError
+
+        with pytest.raises(MqttError):
+            anon = Client("anon")
+            await anon.connect("127.0.0.1", port)
+        await c.disconnect()
+        await c2.disconnect()
+    finally:
+        await app.stop()

@@ -1,0 +1,256 @@
+"""Extension-layer tests: retainer, delayed, rewrite, auth, authz, banned,
+flapping, auto-subscribe (parity targets: emqx_retainer / emqx_modules /
+emqx_authn / emqx_authz / emqx_banned suites)."""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.broker.auth import AuthChain, BuiltinDatabase, JwtAuth
+from emqx_tpu.broker.authz import AclRule, Authorizer
+from emqx_tpu.broker.auto_subscribe import AutoSubscribe, AutoSubscribeTopic
+from emqx_tpu.broker.banned import BanEntry, Banned, Flapping
+from emqx_tpu.broker.delayed import DelayedPublish
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.retainer import Retainer
+from emqx_tpu.broker.rewrite import RewriteRule, TopicRewrite
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.client import Client, MqttError
+from tests.test_broker_e2e import TestBed, async_test
+
+
+# -- retainer ----------------------------------------------------------------
+
+def test_retainer_store_match_delete_unit():
+    r = Retainer()
+    r.on_publish(Message(topic="a/b", payload=b"1", retain=True))
+    r.on_publish(Message(topic="a/c", payload=b"2", retain=True))
+    r.on_publish(Message(topic="x", payload=b"3", retain=True))
+    r.on_publish(Message(topic="$SYS/x", payload=b"s", retain=True))
+    assert len(r) == 3  # $SYS excluded
+    assert {m.payload for m in r.match("a/+")} == {b"1", b"2"}
+    assert {m.payload for m in r.match("#")} == {b"1", b"2", b"3"}
+    assert [m.payload for m in r.match("a/b")] == [b"1"]
+    # overwrite + tombstone delete
+    r.on_publish(Message(topic="a/b", payload=b"new", retain=True))
+    assert [m.payload for m in r.match("a/b")] == [b"new"]
+    r.on_publish(Message(topic="a/b", payload=b"", retain=True))
+    assert r.match("a/b") == []
+    assert len(r) == 2
+
+
+def test_retainer_expiry():
+    r = Retainer()
+    m = Message(
+        topic="exp/t",
+        payload=b"x",
+        retain=True,
+        properties={"Message-Expiry-Interval": 1},
+    )
+    m.timestamp = time.time() - 10
+    r.on_publish(m)
+    assert r.match("exp/t") == []  # expired at read
+    assert r.clear_expired() == 1
+    assert len(r) == 0
+
+
+@async_test
+async def test_retainer_e2e_delivery_on_subscribe():
+    async with TestBed() as tb:
+        retainer = Retainer()
+        retainer.attach(tb.broker.hooks)
+        p = await tb.client("rp")
+        await p.publish("ret/t", b"keep", qos=1, retain=True)
+        s = await tb.client("rs", version=pkt.MQTT_V5)
+        await s.subscribe("ret/+", qos=1)
+        m = await s.recv()
+        assert (m.topic, m.payload, m.retain) == ("ret/t", b"keep", True)
+        # live delivery to an existing subscriber must NOT carry retain=1
+        await p.publish("ret/t", b"live", qos=1, retain=True)
+        m2 = await s.recv()
+        assert (m2.payload, m2.retain) == (b"live", False)
+        await p.disconnect()
+        await s.disconnect()
+
+
+# -- delayed -----------------------------------------------------------------
+
+def test_delayed_intercept_and_fire():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+
+    broker = Broker(hooks=Hooks())
+    d = DelayedPublish(broker)
+    d.attach(broker.hooks)
+    got = []
+    broker.subscribe(
+        "s1", "s1", "real/t", pkt.SubOpts(), lambda m, o: got.append(m)
+    )
+    n = broker.publish(Message(topic="$delayed/1/real/t", payload=b"later"))
+    assert n == 0 and len(d) == 1 and got == []
+    assert d.tick(now=time.time() + 2) == 1
+    assert [m.payload for m in got] == [b"later"]
+    # malformed $delayed topics pass through as ordinary topics
+    broker.publish(Message(topic="$delayed/oops", payload=b"x"))
+    assert len(d) == 0
+
+
+# -- rewrite -----------------------------------------------------------------
+
+@async_test
+async def test_rewrite_pub_and_sub():
+    async with TestBed() as tb:
+        rw = TopicRewrite(
+            [RewriteRule("all", "y/+", r"^y/(.+)$", "z/$1")]
+        )
+        rw.attach(tb.broker.hooks)
+        s = await tb.client("rws")
+        await s.subscribe("y/1")  # rewritten to z/1
+        p = await tb.client("rwp")
+        await p.publish("y/1", b"via-rewrite")  # rewritten to z/1
+        m = await s.recv()
+        assert (m.topic, m.payload) == ("z/1", b"via-rewrite")
+        await s.disconnect()
+        await p.disconnect()
+
+
+# -- auth chain --------------------------------------------------------------
+
+@async_test
+async def test_builtin_auth_allow_deny():
+    async with TestBed() as tb:
+        db = BuiltinDatabase()
+        db.add_user("alice", "secret")
+        AuthChain([db], allow_anonymous=False).attach(tb.broker.hooks)
+        ok = await tb.client("c-good", username="alice", password=b"secret")
+        await ok.disconnect()
+        with pytest.raises(MqttError) as e:
+            await tb.client(
+                "c-bad", username="alice", password=b"wrong",
+                version=pkt.MQTT_V5,
+            )
+        assert "0x86" in str(e.value)
+        # v4 client gets the compat-mapped CONNACK code (0x86 -> 4)
+        with pytest.raises(MqttError) as e4:
+            await tb.client("c-bad4", username="alice", password=b"wrong")
+        assert "0x4" in str(e4.value)
+        # unknown user, anonymous disallowed -> not authorized
+        with pytest.raises(MqttError):
+            await tb.client("c-anon", username="nobody", password=b"x")
+
+
+@async_test
+async def test_jwt_auth():
+    async with TestBed() as tb:
+        secret = b"topsecret"
+        jwt = JwtAuth(secret, verify_claims={"sub": "${clientid}"})
+        AuthChain([jwt], allow_anonymous=False).attach(tb.broker.hooks)
+        tok = JwtAuth.sign(secret, {"sub": "dev-1", "exp": time.time() + 60})
+        ok = await tb.client("dev-1", username="jwt", password=tok.encode())
+        await ok.disconnect()
+        with pytest.raises(MqttError):  # claim mismatch
+            await tb.client("dev-2", username="jwt", password=tok.encode())
+        expired = JwtAuth.sign(secret, {"sub": "dev-1", "exp": time.time() - 1})
+        with pytest.raises(MqttError):
+            await tb.client("dev-1", username="jwt", password=expired.encode())
+
+
+# -- authz -------------------------------------------------------------------
+
+@async_test
+async def test_authz_rules():
+    async with TestBed() as tb:
+        az = Authorizer(
+            rules=[
+                AclRule("deny", "all", "publish", ["forbidden/#"]),
+                AclRule("allow", {"clientid": "vip"}, "all", ["#"]),
+                AclRule("deny", "all", "subscribe", ["secret/+"]),
+            ]
+        )
+        az.attach(tb.broker.hooks)
+        c = await tb.client("pleb", version=pkt.MQTT_V5)
+        ack = await c.publish("forbidden/x", b"no", qos=1)
+        assert ack.reason_code == pkt.RC_NOT_AUTHORIZED
+        sa = await c.subscribe("secret/x")
+        assert sa.reason_codes == [pkt.RC_NOT_AUTHORIZED]
+        ack = await c.publish("open/x", b"yes", qos=1)
+        assert ack.reason_code in (0, pkt.RC_NO_MATCHING_SUBSCRIBERS)
+        await c.disconnect()
+
+
+def test_authz_placeholders_and_eq():
+    az = Authorizer(
+        rules=[
+            AclRule("allow", "all", "publish", ["own/${clientid}/#"]),
+            AclRule("allow", "all", "subscribe", ["eq own/+/raw"]),
+            AclRule("deny", "all", "all", ["#"]),
+        ],
+        no_match="deny",
+    )
+    ci = {"client_id": "c7"}
+    assert az.check(ci, "publish", "own/c7/data") == "allow"
+    assert az.check(ci, "publish", "own/c8/data") == "deny"
+    assert az.check(ci, "subscribe", "own/+/raw") == "allow"  # eq literal
+    assert az.check(ci, "subscribe", "own/zz/raw") == "deny"
+
+
+# -- banned / flapping -------------------------------------------------------
+
+@async_test
+async def test_banned_client_rejected():
+    async with TestBed() as tb:
+        banned = Banned()
+        banned.attach(tb.broker.hooks)
+        banned.add(BanEntry(kind="clientid", value="evil"))
+        with pytest.raises(MqttError) as e:
+            await tb.client("evil", version=pkt.MQTT_V5)
+        assert "0x8a" in str(e.value).lower()
+        ok = await tb.client("good", version=pkt.MQTT_V5)
+        await ok.disconnect()
+        # expired bans lift automatically
+        banned.add(
+            BanEntry(kind="clientid", value="paroled", until=time.time() - 1)
+        )
+        ok2 = await tb.client("paroled")
+        await ok2.disconnect()
+
+
+def test_flapping_autoban():
+    banned = Banned()
+    f = Flapping(banned, max_count=3, window=10.0, ban_time=60.0)
+    ci = {"client_id": "flappy"}
+    for _ in range(3):
+        f.on_disconnected(ci)
+    assert banned.is_banned(ci)
+
+
+# -- auto-subscribe ----------------------------------------------------------
+
+@async_test
+async def test_auto_subscribe():
+    async with TestBed() as tb:
+        AutoSubscribe(
+            [AutoSubscribeTopic(filter="inbox/${clientid}", qos=1)]
+        ).attach(tb.broker.hooks)
+        c = await tb.client("auto-1")
+        p = await tb.client("auto-pub")
+        await p.publish("inbox/auto-1", b"forced", qos=1)
+        m = await c.recv()
+        assert (m.topic, m.payload) == ("inbox/auto-1", b"forced")
+        await c.disconnect()
+        await p.disconnect()
+
+
+@async_test
+async def test_anonymous_allowed_alongside_user_db():
+    # verify-session finding: a client with NO username must fall through the
+    # database provider (IGNORE) and be admitted when allow_anonymous=True
+    async with TestBed() as tb:
+        db = BuiltinDatabase()
+        db.add_user("alice", "secret")
+        AuthChain([db], allow_anonymous=True).attach(tb.broker.hooks)
+        anon = await tb.client("anon-ok")  # no username
+        await anon.disconnect()
+        with pytest.raises(MqttError):  # named user still must match
+            await tb.client("x", username="alice", password=b"bad")
