@@ -134,6 +134,22 @@ class Broker:
             total += n
         return total
 
+    def dispatch(self, filters: List[str], msg: Message) -> int:
+        """Deliver to local subscribers of pre-matched filters.
+
+        This is the receiving half of a cross-node forward: the publisher
+        node already ran the route match, the owner node fans out to its
+        local subscriber tables (emqx_broker:dispatch, emqx_broker.erl:
+        505-530 via the forward path :278-293).
+        """
+        return self._route_dispatch(msg, filters)
+
+    def has_local_subs(self, route_key: str) -> bool:
+        """Any local subscriber (plain or shared-group) on this filter?"""
+        return bool(self._subs.get(route_key)) or self.shared.has_groups(
+            route_key
+        )
+
     def _route_dispatch(self, msg: Message, filters: List[str]) -> int:
         self.metrics.inc("messages.received")
         n = 0

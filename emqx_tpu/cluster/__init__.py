@@ -1,0 +1,32 @@
+"""Cluster layer: membership, RPC, replicated routes, cross-node forwarding.
+
+The reference's three communication planes (SURVEY.md §5.8):
+  (i)  control/membership — ekka on distributed Erlang
+  (ii) state replication  — mria (mnesia + async rlog shards)
+  (iii) data plane        — gen_rpc multi-channel TCP, keyed ordered channels
+
+This package reproduces each plane TPU-host-side:
+  (i)  `membership.Membership`  — cluster view + nodedown callbacks
+  (ii) `route_sync.ClusterRouteTable` — replicated topic→nodes table with
+       dirty (async) plain-route writes and transactional wildcard writes
+  (iii) `rpc.Rpc` over `transport.LocalBus` — keyed channels preserving
+       per-topic ordering, sync call / async cast, BPAPI-versioned protos
+
+Multi-chip TPU state (the NFA tables) is *replicated* per node like the
+reference replicates its trie to every core node; subscriber bitmaps stay
+node-local, exactly as ETS subscriber tables do.
+"""
+
+from emqx_tpu.cluster.membership import Membership
+from emqx_tpu.cluster.node import ClusterNode, make_cluster
+from emqx_tpu.cluster.rpc import Rpc, RpcError
+from emqx_tpu.cluster.transport import LocalBus
+
+__all__ = [
+    "Membership",
+    "ClusterNode",
+    "make_cluster",
+    "Rpc",
+    "RpcError",
+    "LocalBus",
+]

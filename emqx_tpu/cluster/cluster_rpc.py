@@ -1,0 +1,106 @@
+"""Transactional cluster-wide config multicall log.
+
+Reference analog: `emqx_cluster_rpc` (apps/emqx_conf/src/emqx_cluster_rpc.erl:
+20-30) — cluster config mutations append to a replicated transaction log in
+mnesia; each node keeps a per-node commit cursor, applies entries in order,
+and can catch up / skip / fast-forward after being down.
+
+Here the initiating node assigns the next txn id under the cluster's
+log-writer role (the node with the lexicographically smallest name — a
+deterministic stand-in for mnesia's transaction serialization), replicates
+the entry, and every node applies through its registered handler table.
+A node that was partitioned replays missed entries on `catch_up`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+Handler = Callable[..., Any]
+
+
+class ClusterRpcLog:
+    """Replicated ordered log of named operations with a commit cursor."""
+
+    def __init__(self, node: str) -> None:
+        self.node = node
+        self._lock = threading.Lock()
+        self._log: List[Tuple[int, str, tuple]] = []  # (txn_id, op, args)
+        self._cursor = 0  # last applied txn id
+        self._handlers: Dict[str, Handler] = {}
+        self._skipped: List[int] = []
+
+    def register_handler(self, op: str, handler: Handler) -> None:
+        self._handlers[op] = handler
+
+    # -- log writer side ---------------------------------------------------
+    def append(self, op: str, args: tuple) -> Tuple[int, str, tuple]:
+        with self._lock:
+            txn_id = (self._log[-1][0] + 1) if self._log else 1
+            entry = (txn_id, op, args)
+            self._log.append(entry)
+        return entry
+
+    def receive(self, entry: Tuple[int, str, tuple]) -> None:
+        """Accept a replicated entry (idempotent, order-tolerant)."""
+        with self._lock:
+            known = {e[0] for e in self._log}
+            if entry[0] not in known:
+                self._log.append(entry)
+                self._log.sort(key=lambda e: e[0])
+
+    # -- apply side --------------------------------------------------------
+    def apply_pending(self) -> int:
+        """Apply every entry past the cursor, in txn order.
+
+        A handler raising marks the txn skipped (the reference's `skip`
+        resolution for a failed MFA) and the cursor still advances —
+        matching emqx_cluster_rpc's operator-driven skip/fast_forward.
+        """
+        applied = 0
+        while True:
+            with self._lock:
+                nxt = None
+                for e in self._log:
+                    if e[0] == self._cursor + 1:
+                        nxt = e
+                        break
+                if nxt is None:
+                    return applied
+            txn_id, op, args = nxt
+            handler = self._handlers.get(op)
+            try:
+                if handler is None:
+                    raise KeyError(f"no handler for {op}")
+                handler(*args)
+            except Exception:
+                with self._lock:
+                    self._skipped.append(txn_id)
+            with self._lock:
+                self._cursor = txn_id
+            applied += 1
+
+    def fast_forward(self, to_txn: int) -> None:
+        with self._lock:
+            self._cursor = max(self._cursor, to_txn)
+
+    # -- views / catch-up --------------------------------------------------
+    @property
+    def cursor(self) -> int:
+        with self._lock:
+            return self._cursor
+
+    @property
+    def skipped(self) -> List[int]:
+        with self._lock:
+            return list(self._skipped)
+
+    def entries_after(self, txn_id: int) -> List[Tuple[int, str, tuple]]:
+        with self._lock:
+            return [e for e in self._log if e[0] > txn_id]
+
+    def catch_up_from(self, entries: List[Tuple[int, str, tuple]]) -> int:
+        for e in entries:
+            self.receive(tuple(e))
+        return self.apply_pending()

@@ -1,0 +1,149 @@
+"""Cluster membership: join/leave/heartbeat + nodedown notifications.
+
+Reference analog: ekka — autocluster discovery, membership gossip, and
+`ekka:monitor(membership)` subscriptions that the router helper uses to
+purge a dead node's routes (emqx_router_helper.erl:96,135-148) and the
+machine boot uses for autocluster (emqx_machine_boot.erl:46-51).
+
+Failure detection here is heartbeat-deadline based (the BEAM uses
+distribution-link breaks); the test nemesis advances a logical clock to
+force timeouts deterministically, mirroring snabbkaffe-style scheduling
+control rather than wall-clock sleeps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from emqx_tpu.cluster.transport import LocalBus
+
+MembershipCallback = Callable[[str, str], None]  # (event, node)
+
+HEARTBEAT_INTERVAL = 1.0
+FAILURE_TIMEOUT = 3.0
+
+
+class Membership:
+    """One node's view of the cluster, with pluggable clock for tests."""
+
+    def __init__(
+        self,
+        node: str,
+        bus: LocalBus,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.node = node
+        self._bus = bus
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+        self._alive: Dict[str, bool] = {node: True}
+        self._callbacks: List[MembershipCallback] = []
+
+    # -- ekka:monitor(membership) parity ----------------------------------
+    def monitor(self, callback: MembershipCallback) -> None:
+        self._callbacks.append(callback)
+
+    def _emit(self, event: str, node: str) -> None:
+        for cb in list(self._callbacks):
+            cb(event, node)
+
+    # -- cluster ops -------------------------------------------------------
+    def join(self, seed: str) -> bool:
+        """Join the cluster known to `seed` (ekka:join parity)."""
+        try:
+            peers = self._bus.send(
+                self.node, seed, ("membership", "join", self.node)
+            )
+        except Exception:
+            return False
+        now = self._clock()
+        with self._lock:
+            for p in peers:
+                if p != self.node and not self._alive.get(p):
+                    self._alive[p] = True
+                    self._last_seen[p] = now
+        for p in peers:
+            if p != self.node:
+                self._emit("node_up", p)
+        return True
+
+    def handle(self, from_node: str, msg) -> object:
+        kind = msg[1]
+        now = self._clock()
+        if kind == "join":
+            joiner = msg[2]
+            newly = False
+            with self._lock:
+                if not self._alive.get(joiner):
+                    self._alive[joiner] = True
+                    newly = True
+                self._last_seen[joiner] = now
+                view = [n for n, up in self._alive.items() if up]
+            if newly:
+                self._emit("node_up", joiner)
+                # gossip the join to the rest of the cluster
+                for p in view:
+                    if p not in (self.node, joiner):
+                        self._bus.cast(
+                            self.node, p, ("membership", "join", joiner)
+                        )
+            return view
+        if kind == "heartbeat":
+            with self._lock:
+                came_back = not self._alive.get(from_node)
+                self._alive[from_node] = True
+                self._last_seen[from_node] = now
+            if came_back:
+                self._emit("node_up", from_node)
+            return True
+        if kind == "leave":
+            with self._lock:
+                was_up = self._alive.pop(from_node, False)
+                self._last_seen.pop(from_node, None)
+            if was_up:
+                self._emit("node_down", from_node)
+            return True
+        return None
+
+    def leave(self) -> None:
+        """Graceful leave: notify peers (ekka:leave parity)."""
+        for p in self.peers():
+            self._bus.cast(self.node, p, ("membership", "leave"))
+
+    def heartbeat(self) -> None:
+        """Send one heartbeat round + expire dead peers. Called on a timer."""
+        for p in self.peers():
+            ok = self._bus.cast(self.node, p, ("membership", "heartbeat"))
+            if ok:
+                with self._lock:
+                    self._last_seen[p] = self._clock()
+        self.expire()
+
+    def expire(self) -> None:
+        now = self._clock()
+        downs = []
+        with self._lock:
+            for p, seen in list(self._last_seen.items()):
+                if self._alive.get(p) and now - seen > FAILURE_TIMEOUT:
+                    self._alive[p] = False
+                    downs.append(p)
+        for p in downs:
+            self._emit("node_down", p)
+
+    # -- views -------------------------------------------------------------
+    def peers(self) -> List[str]:
+        with self._lock:
+            return sorted(
+                n for n, up in self._alive.items() if up and n != self.node
+            )
+
+    def running_nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, up in self._alive.items() if up)
+
+    def is_alive(self, node: str) -> bool:
+        with self._lock:
+            return bool(self._alive.get(node))

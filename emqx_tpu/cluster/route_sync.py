@@ -1,0 +1,126 @@
+"""Replicated cluster route table: topic filter → set of nodes.
+
+Reference analog: the mria-replicated `emqx_route` bag table plus the
+replicated trie (emqx_router.erl:75-84,111-125). Every node holds the FULL
+cluster filter set (that is what lets publish route locally without a
+network hop); the subscriber tables stay node-local.
+
+Consistency split (mria parity, emqx_router.erl:111-125):
+- plain-topic routes: dirty async replication (`emqx_router_utils`
+  insert_direct_route) — eventual, per-filter ordered;
+- wildcard routes: "transactional" — the writer waits for every reachable
+  peer to ack before returning, because a half-replicated trie edge breaks
+  matching (maybe_trans, emqx_router.erl:118-121).
+
+TPU note: the internal `Router` compiles this cluster-wide filter set into
+the NFA tables, so one device kernel yields dests for a whole batch of
+publishes; bitmaps of *local* subscribers are applied on each owner node.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from emqx_tpu.broker.router import Router
+
+
+class ClusterRouteTable:
+    """One node's replica of the global route table."""
+
+    def __init__(self, node: str, router: Optional[Router] = None) -> None:
+        self.node = node
+        self._router = router or Router(enable_tpu=False)
+        # filter -> nodes having >=1 local subscriber on it
+        self._dests: Dict[str, Set[str]] = {}
+        self._lock = threading.Lock()
+
+    # -- replica writes (applied locally AND via RPC from peers) ----------
+    def add_route(self, filter_: str, node: str) -> None:
+        with self._lock:
+            dests = self._dests.get(filter_)
+            if dests is None:
+                dests = self._dests[filter_] = set()
+                self._router.add_route(filter_)
+            dests.add(node)
+
+    def delete_route(self, filter_: str, node: str) -> None:
+        with self._lock:
+            dests = self._dests.get(filter_)
+            if dests is None:
+                return
+            dests.discard(node)
+            if not dests:
+                del self._dests[filter_]
+                self._router.delete_route(filter_)
+
+    def cleanup_node(self, node: str) -> int:
+        """Purge all routes owned by a dead node (emqx_router_helper:135-148).
+
+        The reference serializes this under a global lock so only one
+        surviving node runs the mnesia transaction; here every node purges
+        its own replica, which is the equivalent end state.
+        """
+        removed = 0
+        with self._lock:
+            for filter_ in list(self._dests):
+                dests = self._dests[filter_]
+                if node in dests:
+                    dests.discard(node)
+                    removed += 1
+                    if not dests:
+                        del self._dests[filter_]
+                        self._router.delete_route(filter_)
+        return removed
+
+    # -- bootstrap (mria replica catch-up on join) -------------------------
+    def dump(self) -> List[tuple]:
+        with self._lock:
+            return [(f, sorted(ns)) for f, ns in self._dests.items()]
+
+    def load(self, dump: List[tuple]) -> None:
+        for filter_, nodes in dump:
+            for n in nodes:
+                self.add_route(filter_, n)
+
+    # -- reads -------------------------------------------------------------
+    def match_dests(self, topic: str) -> Dict[str, List[str]]:
+        """topic -> {node: [matched filters]} (emqx_router:match_routes)."""
+        out: Dict[str, List[str]] = {}
+        with self._lock:
+            for f in self._router.match(topic):
+                for n in self._dests.get(f, ()):
+                    out.setdefault(n, []).append(f)
+        return out
+
+    def match_dests_batch(
+        self, topics: List[str]
+    ) -> List[Dict[str, List[str]]]:
+        """Batch form: one TPU/NFA match for all topics, then dest joins."""
+        with self._lock:
+            matches = self._router.match_batch(topics)
+            out = []
+            for filters in matches:
+                d: Dict[str, List[str]] = {}
+                for f in filters:
+                    for n in self._dests.get(f, ()):
+                        d.setdefault(n, []).append(f)
+                out.append(d)
+        return out
+
+    def has_route(self, filter_: str) -> bool:
+        with self._lock:
+            return filter_ in self._dests
+
+    def routes(self) -> List[tuple]:
+        with self._lock:
+            return [
+                (f, n) for f, ns in self._dests.items() for n in sorted(ns)
+            ]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "routes.count": sum(len(ns) for ns in self._dests.values()),
+                "topics.count": len(self._dests),
+            }

@@ -1,0 +1,265 @@
+"""Multi-node cluster tests on the in-process harness.
+
+Mirrors the reference's slave-node CT suites:
+- emqx_router_helper_SUITE (route cleanup on nodedown)
+- emqx_cluster_rpc_SUITE (3-node config txn log)
+- emqx_broker forward path (cross-node publish)
+plus BPAPI immutability (emqx_bpapi_static_checks parity).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.cluster import make_cluster
+from emqx_tpu.cluster.membership import FAILURE_TIMEOUT
+from emqx_tpu.cluster.rpc import RpcError
+from emqx_tpu.mqtt.packet import SubOpts
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def collector():
+    got = []
+
+    def deliver(msg, opts):
+        got.append(msg)
+
+    return got, deliver
+
+
+@pytest.fixture
+def cluster3():
+    clock = FakeClock()
+    bus, nodes = make_cluster(3, clock=clock)
+    yield bus, nodes, clock
+    for n in nodes:
+        n.rpc.stop()
+
+
+def test_membership_full_mesh(cluster3):
+    _, nodes, _ = cluster3
+    names = sorted(n.name for n in nodes)
+    for n in nodes:
+        assert n.membership.running_nodes() == names
+
+
+def test_cross_node_publish_exact(cluster3):
+    _, (a, b, c), _ = cluster3
+    got, deliver = collector()
+    b.subscribe("s1", "c1", "t/1", SubOpts(qos=0), deliver)
+    # route replicated to all nodes
+    for n in (a, b, c):
+        n.flush()
+        assert n.routes.has_route("t/1")
+    n_del = a.publish(Message(topic="t/1", payload=b"x"))
+    a.flush()
+    assert n_del == 1
+    assert len(got) == 1 and got[0].payload == b"x"
+
+
+def test_cross_node_publish_wildcard_sync_replication(cluster3):
+    _, (a, b, c), _ = cluster3
+    got, deliver = collector()
+    c.subscribe("s1", "c1", "dev/+/temp/#", SubOpts(qos=1), deliver)
+    # wildcard replication is synchronous: visible immediately, no flush
+    assert a.routes.has_route("dev/+/temp/#")
+    assert b.routes.has_route("dev/+/temp/#")
+    n = a.publish(Message(topic="dev/3/temp/x", qos=1))
+    assert n == 1  # qos1 forwards synchronously
+    assert len(got) == 1
+
+
+def test_local_and_remote_fanout_dedup(cluster3):
+    """aggre parity: one forward per node even with many matching filters."""
+    _, (a, b, c), _ = cluster3
+    got_b, del_b = collector()
+    b.subscribe("s1", "cb1", "t/#", SubOpts(), del_b)
+    b.subscribe("s2", "cb2", "t/+", SubOpts(), del_b)
+    got_a, del_a = collector()
+    a.subscribe("s3", "ca1", "t/x", SubOpts(), del_a)
+    n = a.publish(Message(topic="t/x", qos=1))
+    assert n == 3
+    assert len(got_a) == 1 and len(got_b) == 2
+
+
+def test_unsubscribe_removes_replicated_route(cluster3):
+    _, (a, b, c), _ = cluster3
+    got, deliver = collector()
+    b.subscribe("s1", "c1", "u/+", SubOpts(), deliver)
+    assert a.routes.has_route("u/+")
+    assert b.unsubscribe("s1", "u/+")
+    assert not a.routes.has_route("u/+")
+    assert a.publish(Message(topic="u/1")) == 0
+
+
+def test_route_gc_on_nodedown(cluster3):
+    """emqx_router_helper parity: dead node's routes purged everywhere."""
+    bus, (a, b, c), clock = cluster3
+    got, deliver = collector()
+    c.subscribe("s1", "c1", "gone/#", SubOpts(), deliver)
+    c.subscribe("s2", "c2", "gone/exact", SubOpts(), deliver)
+    assert a.routes.has_route("gone/#")
+    # c dies silently (no graceful leave)
+    bus.detach(c.name)
+    clock.advance(FAILURE_TIMEOUT + 1)
+    a.membership.heartbeat()
+    b.membership.heartbeat()
+    assert not a.membership.is_alive(c.name)
+    assert not a.routes.has_route("gone/#")
+    assert not a.routes.has_route("gone/exact")
+    assert not b.routes.has_route("gone/#")
+    assert a.publish(Message(topic="gone/exact")) == 0
+
+
+def test_graceful_leave(cluster3):
+    _, (a, b, c), _ = cluster3
+    c.membership.leave()
+    assert not a.membership.is_alive(c.name)
+    assert not b.membership.is_alive(c.name)
+
+
+def test_node_rejoin_after_partition(cluster3):
+    bus, (a, b, c), clock = cluster3
+    bus.partition(a.name, c.name)
+    bus.partition(b.name, c.name)
+    clock.advance(FAILURE_TIMEOUT + 1)
+    a.membership.heartbeat()
+    c.membership.heartbeat()
+    assert not a.membership.is_alive(c.name)
+    assert not c.membership.is_alive(a.name)
+    bus.heal(a.name, c.name)
+    bus.heal(b.name, c.name)
+    assert c.join(a.name)
+    assert a.membership.is_alive(c.name)
+    got, deliver = collector()
+    c.subscribe("s1", "c1", "re/1", SubOpts(), deliver)
+    c.flush()
+    a.flush()
+    assert a.publish(Message(topic="re/1", qos=1)) == 1
+
+
+def test_late_join_pulls_route_dump():
+    from emqx_tpu.cluster import ClusterNode, LocalBus
+
+    bus = LocalBus()
+    a = ClusterNode("a@x", bus)
+    b = ClusterNode("b@x", bus)
+    b.join("a@x")
+    got, deliver = collector()
+    a.subscribe("s1", "c1", "early/+", SubOpts(), deliver)
+    # c joins after routes exist: must bootstrap the replica
+    c = ClusterNode("c@x", bus)
+    c.join("a@x")
+    assert c.routes.has_route("early/+")
+    assert c.publish(Message(topic="early/1", qos=1)) == 1
+    assert len(got) == 1
+
+
+def test_channel_registry_and_discard(cluster3):
+    _, (a, b, c), _ = cluster3
+    got, deliver = collector()
+    b.register_channel("client-1", "s1")
+    b.subscribe("s1", "client-1", "cr/1", SubOpts(), deliver)
+    for n in (a, b, c):
+        n.flush()
+    assert a.lookup_channel("client-1") == (b.name, "s1")
+    # same clientid reconnects at node c with clean_start: discard on b
+    assert c.discard_session("client-1")
+    c.flush()
+    b.flush()
+    assert b.lookup_channel("client-1") is None
+    assert not a.routes.has_route("cr/1")
+
+
+def test_publish_batch_cross_node(cluster3):
+    _, (a, b, c), _ = cluster3
+    got_b, del_b = collector()
+    got_c, del_c = collector()
+    b.subscribe("s1", "c1", "bat/+/x", SubOpts(), del_b)
+    c.subscribe("s2", "c2", "bat/#", SubOpts(), del_c)
+    msgs = [Message(topic=f"bat/{i}/x") for i in range(50)]
+    n = a.publish_batch(msgs)
+    a.flush()
+    assert n == 100
+    assert len(got_b) == 50 and len(got_c) == 50
+
+
+def test_shared_sub_across_cluster(cluster3):
+    """$share group: each message goes to ONE member on the owner node."""
+    _, (a, b, c), _ = cluster3
+    got1, del1 = collector()
+    got2, del2 = collector()
+    b.subscribe("s1", "c1", "$share/g/sh/t", SubOpts(), del1)
+    b.subscribe("s2", "c2", "$share/g/sh/t", SubOpts(), del2)
+    for i in range(10):
+        assert a.publish(Message(topic="sh/t", qos=1)) == 1
+    assert len(got1) + len(got2) == 10
+    assert len(got1) > 0 and len(got2) > 0  # round-robin spread
+
+
+def test_cluster_config_multicall(cluster3):
+    _, (a, b, c), _ = cluster3
+    applied = {n.name: [] for n in (a, b, c)}
+    for n in (a, b, c):
+        n.conf_log.register_handler(
+            "set", lambda k, v, _n=n: applied[_n.name].append((k, v))
+        )
+    res = a.config_multicall("set", ("mqtt.max_qos", 2))
+    assert all(not isinstance(v, tuple) or v[0] != "badrpc" for v in res.values())
+    for name in applied:
+        assert applied[name] == [("mqtt.max_qos", 2)]
+    # second txn from a different initiator keeps global order
+    b.config_multicall("set", ("mqtt.retain", False))
+    for name in applied:
+        assert applied[name][-1] == ("mqtt.retain", False)
+    assert a.conf_log.cursor == b.conf_log.cursor == c.conf_log.cursor == 2
+
+
+def test_config_catch_up_after_rejoin(cluster3):
+    bus, (a, b, c), clock = cluster3
+    for n in (a, b, c):
+        n.conf_log.register_handler("noop", lambda *args: None)
+    bus.partition(a.name, c.name)
+    bus.partition(b.name, c.name)
+    a.config_multicall("noop", (1,))
+    a.config_multicall("noop", (2,))
+    assert c.conf_log.cursor == 0
+    bus.heal(a.name, c.name)
+    bus.heal(b.name, c.name)
+    c.join(a.name)
+    assert c.conf_log.cursor == 2
+
+
+def test_bpapi_version_negotiation_and_freeze(cluster3):
+    _, (a, b, c), _ = cluster3
+    # frozen proto: re-registering the same version must fail
+    with pytest.raises(RpcError):
+        a.rpc.registry.register("broker", 1, {})
+    # negotiation picks the highest common version
+    a.rpc.registry.register("demo", 1, {"f": lambda: "v1"})
+    a.rpc.registry.register("demo", 2, {"f": lambda: "v2"})
+    b.rpc.registry.register("demo", 1, {"f": lambda: "v1"})
+    a.rpc.forget_peer(b.name)
+    assert a.rpc.supported_version(b.name, "demo") == 1
+    assert a.rpc.call(b.name, "demo", "f") == "v1"
+
+
+def test_multicall_collects_badrpc(cluster3):
+    bus, (a, b, c), _ = cluster3
+    bus.partition(a.name, c.name)
+    res = a.rpc.multicall(
+        [b.name, c.name], "route", "dump"
+    )
+    assert isinstance(res[b.name], list)
+    assert res[c.name][0] == "badrpc"
