@@ -79,7 +79,9 @@ class BrokerApp:
         self.retainer.enabled = c.retainer.enable
         self.retainer.attach(self.hooks)
 
-        self.delayed = DelayedPublish(self.broker)
+        self.delayed = DelayedPublish(
+            self.broker, max_messages=c.delayed.max_delayed_messages
+        )
         self.delayed.enabled = c.delayed.enable
         self.delayed.attach(self.hooks)
 
@@ -125,6 +127,7 @@ class BrokerApp:
         self.authz = Authorizer(
             rules=[self._acl_rule(r) for r in c.authz.rules],
             no_match=c.authz.no_match,
+            deny_action=c.authz.deny_action,
         )
         self.authz.attach(self.hooks)
 
@@ -167,6 +170,7 @@ class BrokerApp:
         self._tasks = [
             asyncio.ensure_future(self._housekeeping()),
             asyncio.ensure_future(self._sys_heartbeat()),
+            asyncio.ensure_future(self._sys_stats()),
         ]
 
     async def stop(self) -> None:
@@ -201,24 +205,51 @@ class BrokerApp:
                 # one bad tick must not kill periodic work for the process
                 logging.getLogger("emqx_tpu").exception("housekeeping tick failed")
 
-    async def _sys_heartbeat(self) -> None:
-        """$SYS broker heartbeat topics (reference: emqx_sys.erl:70-95)."""
-        from emqx_tpu import __version__
+    def _publish_sys(self, stats: dict) -> None:
+        import logging
 
-        interval = self.config.sys.sys_msg_interval
-        prefix = f"$SYS/brokers/{node_name()}"
-        while True:
-            stats = {
-                f"{prefix}/version": __version__,
-                f"{prefix}/uptime": str(int(time.time() - (self.started_at or time.time()))),
-                f"{prefix}/clients/count": str(self.cm.channel_count()),
-                f"{prefix}/subscriptions/count": str(
-                    self.broker.subscription_count()
-                ),
-                f"{prefix}/retained/count": str(len(self.retainer)),
-            }
-            for topic, payload in stats.items():
+        for topic, payload in stats.items():
+            try:
                 self.broker.publish(
                     Message(topic=topic, payload=payload.encode(), qos=0)
                 )
-            await asyncio.sleep(interval)
+            except Exception:
+                # a raising publish hook must not kill the $SYS loops
+                logging.getLogger("emqx_tpu").exception("$SYS publish failed")
+
+    async def _sys_heartbeat(self) -> None:
+        """$SYS liveness beat: uptime/datetime at sys_heartbeat_interval
+        (reference: emqx_sys.erl heartbeat vs. the slower info messages)."""
+        import datetime
+
+        prefix = f"$SYS/brokers/{node_name()}"
+        while True:
+            self._publish_sys(
+                {
+                    f"{prefix}/uptime": str(
+                        int(time.time() - (self.started_at or time.time()))
+                    ),
+                    f"{prefix}/datetime": datetime.datetime.now(
+                        datetime.timezone.utc
+                    ).isoformat(),
+                }
+            )
+            await asyncio.sleep(self.config.sys.sys_heartbeat_interval)
+
+    async def _sys_stats(self) -> None:
+        """$SYS broker info/stats topics (reference: emqx_sys.erl:70-95)."""
+        from emqx_tpu import __version__
+
+        prefix = f"$SYS/brokers/{node_name()}"
+        while True:
+            self._publish_sys(
+                {
+                    f"{prefix}/version": __version__,
+                    f"{prefix}/clients/count": str(self.cm.channel_count()),
+                    f"{prefix}/subscriptions/count": str(
+                        self.broker.subscription_count()
+                    ),
+                    f"{prefix}/retained/count": str(len(self.retainer)),
+                }
+            )
+            await asyncio.sleep(self.config.sys.sys_msg_interval)

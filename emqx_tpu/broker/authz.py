@@ -104,9 +104,19 @@ class Authorizer:
         return result
 
     def authorize(self, ci, action, topic, acc="allow"):
-        """'client.authorize' fold callback."""
+        """'client.authorize' fold callback.
+
+        On deny, the fold result carries the configured deny_action: the
+        channel drops the packet for 'ignore' and closes the connection for
+        'disconnect' (reference authz.deny_action knob).
+        """
         result = self.check(ci, action, topic)
-        return ("stop", result) if result == "deny" else None
+        if result != "deny":
+            return None
+        return (
+            "stop",
+            "disconnect" if self.deny_action == "disconnect" else "deny",
+        )
 
     def attach(self, hooks: Hooks) -> None:
         hooks.add("client.authorize", self.authorize, priority=100)

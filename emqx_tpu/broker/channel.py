@@ -74,6 +74,9 @@ class Channel:
         self.connected_at: Optional[float] = None
         self.disconnect_reason: Optional[str] = None
         self.topic_aliases: Dict[int, str] = {}  # inbound alias -> topic
+        # attrs set by auth providers during CONNECT (is_superuser, claims);
+        # must persist so later authorize checks see them
+        self.auth_attrs: Dict = {}
 
     # -- helpers ----------------------------------------------------------
     def _send(self, p) -> None:
@@ -94,6 +97,7 @@ class Channel:
             "clean_start": self.clean_start,
             "keepalive": self.keepalive,
             **self.conninfo,
+            **self.auth_attrs,
         }
 
     # -- inbound dispatch -------------------------------------------------
@@ -177,10 +181,17 @@ class Channel:
 
         self.hooks.run("client.connect", self.client_info(), p)
         # authenticate: fold over providers; None acc => allow
+        ci = self.client_info()
+        base_keys = set(ci)
         auth = self.hooks.run_fold(
             "client.authenticate",
-            (self.client_info(), {"password": p.password}),
+            (ci, {"password": p.password}),
             None,
+        )
+        # keep provider-set attrs (is_superuser, jwt claims) for the
+        # channel's lifetime — authorize checks read them on every packet
+        self.auth_attrs.update(
+            {k: v for k, v in ci.items() if k not in base_keys}
         )
         if isinstance(auth, dict) and auth.get("result") == "deny":
             self.hooks.run(
@@ -260,6 +271,10 @@ class Channel:
         )
         if allowed != "allow":
             self.broker.metrics.inc("messages.dropped.not_authorized")
+            if allowed == "disconnect":
+                # authz deny_action=disconnect (reference knob): drop the
+                # packet and close the connection
+                return self._close("not_authorized", pkt.RC_NOT_AUTHORIZED)
             if p.qos == 0:
                 return  # silently drop (emqx default for qos0 deny)
             ack = pkt.PubAck(

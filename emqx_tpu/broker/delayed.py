@@ -21,12 +21,16 @@ MAX_DELAY = 4294967  # seconds (reference cap)
 
 
 class DelayedPublish:
-    def __init__(self, broker, max_delay: int = MAX_DELAY):
+    def __init__(
+        self, broker, max_delay: int = MAX_DELAY, max_messages: int = 0
+    ):
         self.broker = broker
         self.max_delay = max_delay
+        self.max_messages = max_messages  # 0 = unlimited (reference default)
         self._heap: List[Tuple[float, int, Message]] = []
         self._seq = 0
         self.enabled = True
+        self.dropped = 0
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -44,6 +48,11 @@ class DelayedPublish:
         if not sep or delay < 0 or real_topic == "":
             return None  # malformed: treat as a normal topic
         delay = min(delay, self.max_delay)
+        if self.max_messages and len(self._heap) >= self.max_messages:
+            # store full: drop the delayed message (reference behavior when
+            # max_delayed_messages is reached), still swallow the original
+            self.dropped += 1
+            return ("stop", None)
         import copy
 
         m = copy.copy(msg)

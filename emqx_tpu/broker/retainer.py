@@ -54,12 +54,24 @@ class Retainer:
         self._insert(msg)
 
     def _insert(self, msg: Message) -> None:
+        words = T.words(msg.topic)
+        if self._count >= self.max_retained:
+            # at capacity only an overwrite of an existing topic is allowed;
+            # probe without allocating so rejected inserts leave no orphan
+            # node chains behind
+            node = self._root
+            for w in words:
+                node = node.children.get(w)
+                if node is None:
+                    return
+            if node.msg is None:
+                return
+            node.msg = msg
+            return
         node = self._root
-        for w in T.words(msg.topic):
+        for w in words:
             node = node.children.setdefault(w, _Node())
         if node.msg is None:
-            if self._count >= self.max_retained:
-                return
             self._count += 1
         node.msg = msg
 

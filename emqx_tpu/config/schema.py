@@ -108,6 +108,7 @@ class AclRuleSpec:
 @dataclass
 class AuthzConfig:
     no_match: str = "allow"
+    deny_action: str = "ignore"  # 'ignore' | 'disconnect' (reference knob)
     rules: List[AclRuleSpec] = field(default_factory=list)
 
 

@@ -196,7 +196,13 @@ class MgmtApi:
                 payload = base64.b64decode(payload, validate=True)
             else:
                 payload = payload.encode()
-        except (json.JSONDecodeError, KeyError, ValueError) as e:
+            qos = body.get("qos", 0)
+            if not isinstance(qos, int) or qos not in (0, 1, 2):
+                raise ValueError(f"invalid qos {qos!r}")
+            retain = body.get("retain", False)
+            if not isinstance(retain, bool):
+                raise ValueError(f"invalid retain {retain!r}")
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             return web.json_response(
                 {"code": "BAD_REQUEST", "message": str(e)}, status=400
             )
@@ -204,8 +210,8 @@ class MgmtApi:
             Message(
                 topic=topic,
                 payload=payload,
-                qos=int(body.get("qos", 0)),
-                retain=bool(body.get("retain", False)),
+                qos=qos,
+                retain=retain,
                 from_client="mgmt_api",
             )
         )

@@ -30,6 +30,18 @@ def _call(url: str, key: str, method: str = "GET", body=None):
             return resp.status, json.loads(text)
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read().decode() or "{}")
+    except urllib.error.URLError as e:
+        return 599, {"code": "UNREACHABLE", "message": str(e.reason)}
+
+
+# command -> minimum positional args after the command word
+_MIN_ARGS = {
+    "client": 1,
+    "kick": 1,
+    "publish": 1,
+    "ban": 2,
+    "unban": 2,
+}
 
 
 def main(argv=None) -> int:
@@ -43,6 +55,13 @@ def main(argv=None) -> int:
         return 2
     base = a.url.rstrip("/") + "/api/v5"
     cmd, *rest = a.cmd
+    positional = [r for r in rest if not r.startswith("--")]
+    if len(positional) < _MIN_ARGS.get(cmd, 0):
+        print(
+            f"{cmd}: expected at least {_MIN_ARGS[cmd]} argument(s)",
+            file=sys.stderr,
+        )
+        return 2
 
     if cmd in ("status", "metrics", "stats", "subscriptions", "routes", "configs"):
         code, out = _call(f"{base}/{cmd}", a.key)

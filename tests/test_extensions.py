@@ -254,3 +254,75 @@ async def test_anonymous_allowed_alongside_user_db():
         await anon.disconnect()
         with pytest.raises(MqttError):  # named user still must match
             await tb.client("x", username="alice", password=b"bad")
+
+
+# -- regression: review findings --------------------------------------------
+
+def test_retainer_cap_leaves_no_orphan_nodes():
+    r = Retainer(max_retained=2)
+    r.on_publish(Message(topic="cap/a", payload=b"1", retain=True))
+    r.on_publish(Message(topic="cap/b", payload=b"2", retain=True))
+    before = len(r._root.children["cap"].children)
+    # rejected inserts (at cap, new topics) must not allocate trie nodes
+    for i in range(10):
+        r.on_publish(Message(topic=f"cap/deep/{i}/x", payload=b"n", retain=True))
+    assert len(r) == 2
+    assert len(r._root.children["cap"].children) == before
+    # overwriting an existing topic at cap is still allowed
+    r.on_publish(Message(topic="cap/a", payload=b"new", retain=True))
+    assert [m.payload for m in r.match("cap/a")] == [b"new"]
+    # tombstone at cap frees a slot for a new topic
+    r.on_publish(Message(topic="cap/a", payload=b"", retain=True))
+    r.on_publish(Message(topic="cap/c", payload=b"3", retain=True))
+    assert len(r) == 2
+
+
+def test_delayed_max_messages_cap():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+
+    broker = Broker(hooks=Hooks())
+    d = DelayedPublish(broker, max_messages=3)
+    d.attach(broker.hooks)
+    for i in range(5):
+        broker.publish(Message(topic=f"$delayed/60/t/{i}", payload=b"x"))
+    assert len(d) == 3 and d.dropped == 2
+
+
+@async_test
+async def test_superuser_bypasses_authz_on_publish():
+    """is_superuser set at CONNECT must persist to later authorize checks."""
+    async with TestBed() as tb:
+        db = BuiltinDatabase()
+        db.add_user("root", "pw", is_superuser=True)
+        db.add_user("pleb", "pw")
+        AuthChain([db], allow_anonymous=False).attach(tb.broker.hooks)
+        Authorizer(
+            rules=[AclRule("deny", "all", "publish", ["#"])]
+        ).attach(tb.broker.hooks)
+        got = []
+        tb.broker.subscribe(
+            "watch", "watch", "su/t", pkt.SubOpts(), lambda m, o: got.append(m)
+        )
+        su = await tb.client("c-root", username="root", password=b"pw")
+        await su.publish("su/t", b"as-root", qos=1)
+        await asyncio.sleep(0.1)
+        assert [m.payload for m in got] == [b"as-root"]
+        pl = await tb.client("c-pleb", username="pleb", password=b"pw")
+        await pl.publish("su/t", b"as-pleb", qos=1)
+        await asyncio.sleep(0.1)
+        assert [m.payload for m in got] == [b"as-root"]  # pleb denied
+        await su.disconnect()
+        await pl.disconnect()
+
+
+@async_test
+async def test_authz_deny_action_disconnect():
+    async with TestBed() as tb:
+        Authorizer(
+            rules=[AclRule("deny", "all", "publish", ["secret/#"])],
+            deny_action="disconnect",
+        ).attach(tb.broker.hooks)
+        c = await tb.client("dd-1")
+        await c.publish("secret/x", b"nope", qos=0)
+        await asyncio.wait_for(c.closed.wait(), timeout=2)
