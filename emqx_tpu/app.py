@@ -124,6 +124,31 @@ class BrokerApp:
         else:
             self.authn = None
 
+        # rule engine (reference L4: emqx_rule_engine)
+        from emqx_tpu.rules.engine import Console, Republish, RuleEngine
+
+        self.rule_engine = RuleEngine(self.broker)
+        self.rule_engine.attach(self.hooks)
+        for spec in c.rules:
+            outputs = []
+            for o in spec.outputs or [None]:
+                if o is None or o.function == "console":
+                    outputs.append(Console())
+                else:
+                    a = o.args
+                    outputs.append(
+                        Republish(
+                            topic=str(a.get("topic", "")),
+                            payload=str(a.get("payload", "${payload}")),
+                            qos=int(a.get("qos", 0)),
+                            retain=bool(a.get("retain", False)),
+                        )
+                    )
+            rule = self.rule_engine.create_rule(
+                spec.id, spec.sql, outputs, spec.description
+            )
+            rule.enabled = spec.enable
+
         self.authz = Authorizer(
             rules=[self._acl_rule(r) for r in c.authz.rules],
             no_match=c.authz.no_match,

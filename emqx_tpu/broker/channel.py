@@ -341,6 +341,11 @@ class Channel:
                 "client.authorize", (self.client_info(), "subscribe", f), "allow"
             )
             if allowed != "allow":
+                if allowed == "disconnect":
+                    # authz deny_action=disconnect applies to subscribe too
+                    return self._close(
+                        "not_authorized", pkt.RC_NOT_AUTHORIZED
+                    )
                 rcs.append(pkt.RC_NOT_AUTHORIZED)
                 continue
             qos = min(opts.qos, self.config.caps.max_qos_allowed)

@@ -146,6 +146,21 @@ class AutoSubscribeSpec:
 
 
 @dataclass
+class RuleOutputSpec:
+    function: str = "console"  # console | republish
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RuleSpec:
+    id: str = ""
+    sql: str = ""
+    enable: bool = True
+    description: str = ""
+    outputs: List[RuleOutputSpec] = field(default_factory=list)
+
+
+@dataclass
 class AppConfig:
     node: NodeConfig = field(default_factory=NodeConfig)
     listeners: List[ListenerSpec] = field(default_factory=lambda: [ListenerSpec()])
@@ -162,6 +177,7 @@ class AppConfig:
     sys: SysConfig = field(default_factory=SysConfig)
     dashboard: DashboardConfig = field(default_factory=DashboardConfig)
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
+    rules: List[RuleSpec] = field(default_factory=list)
 
 
 class ConfigError(ValueError):
@@ -282,5 +298,21 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.authz.no_match not in ("allow", "deny"):
         raise ConfigError("authz.no_match must be allow|deny")
+    if cfg.authz.deny_action not in ("ignore", "disconnect"):
+        raise ConfigError("authz.deny_action must be ignore|disconnect")
     if not 0 <= cfg.mqtt.max_qos_allowed <= 2:
         raise ConfigError("mqtt.max_qos_allowed must be 0..2")
+    for r in cfg.rules:
+        if not r.id or not r.sql:
+            raise ConfigError("each rule needs an id and sql")
+        from emqx_tpu.rules.sql import SqlParseError, parse_sql
+
+        try:
+            parse_sql(r.sql)
+        except SqlParseError as e:
+            raise ConfigError(f"rule {r.id}: bad sql: {e}") from e
+        for o in r.outputs:
+            if o.function not in ("console", "republish"):
+                raise ConfigError(
+                    f"rule {r.id}: unknown output {o.function!r}"
+                )

@@ -63,6 +63,11 @@ class MgmtApi:
                     "/api/v5/retainer/message/{topic:.+}", self.retained_del
                 ),
                 web.get("/api/v5/configs", self.configs),
+                web.get("/api/v5/rules", self.rules_list),
+                web.post("/api/v5/rules", self.rules_create),
+                web.get("/api/v5/rules/{id}", self.rules_one),
+                web.delete("/api/v5/rules/{id}", self.rules_delete),
+                web.post("/api/v5/rule_test", self.rule_test),
             ]
         )
         self._webapp = w
@@ -197,7 +202,7 @@ class MgmtApi:
             else:
                 payload = payload.encode()
             qos = body.get("qos", 0)
-            if not isinstance(qos, int) or qos not in (0, 1, 2):
+            if isinstance(qos, bool) or not isinstance(qos, int) or qos not in (0, 1, 2):
                 raise ValueError(f"invalid qos {qos!r}")
             retain = body.get("retain", False)
             if not isinstance(retain, bool):
@@ -216,6 +221,91 @@ class MgmtApi:
             )
         )
         return web.json_response({"delivered": n})
+
+    # -- rules (emqx_mgmt_api rules + emqx_rule_engine_api parity) ---------
+    def _rule_json(self, rule):
+        return {
+            "id": rule.id,
+            "sql": rule.sql,
+            "enable": rule.enabled,
+            "description": rule.description,
+            "outputs": [o.name for o in rule.outputs],
+            "metrics": rule.metrics.as_dict(),
+        }
+
+    async def rules_list(self, request):
+        eng = self.app.rule_engine
+        return web.json_response(
+            {"data": [self._rule_json(r) for r in eng.rules()]}
+        )
+
+    async def rules_create(self, request):
+        from emqx_tpu.rules import SqlParseError
+        from emqx_tpu.rules.engine import Console, Republish
+
+        eng = self.app.rule_engine
+        try:
+            body = await request.json()
+            rule_id = str(body["id"])
+            sql = str(body["sql"])
+            outputs = []
+            for spec in body.get("outputs", [{"function": "console"}]):
+                fn = spec.get("function", "console")
+                if fn == "republish":
+                    args = spec.get("args", {})
+                    outputs.append(
+                        Republish(
+                            topic=str(args["topic"]),
+                            payload=str(args.get("payload", "${payload}")),
+                            qos=int(args.get("qos", 0)),
+                            retain=bool(args.get("retain", False)),
+                        )
+                    )
+                elif fn == "console":
+                    outputs.append(Console())
+                else:
+                    raise ValueError(f"unknown output function {fn!r}")
+            rule = eng.create_rule(
+                rule_id, sql, outputs, str(body.get("description", ""))
+            )
+            rule.enabled = bool(body.get("enable", True))
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError, SqlParseError) as e:
+            # ValueError also covers duplicate rule ids (create_rule)
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(self._rule_json(rule), status=201)
+
+    async def rules_one(self, request):
+        rule = self.app.rule_engine.get_rule(request.match_info["id"])
+        if rule is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response(self._rule_json(rule))
+
+    async def rules_delete(self, request):
+        if not self.app.rule_engine.delete_rule(request.match_info["id"]):
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response({}, status=204)
+
+    async def rule_test(self, request):
+        from emqx_tpu.rules import SqlParseError, test_sql
+        from emqx_tpu.rules.runtime import RuleEvalError
+
+        try:
+            body = await request.json()
+            rows = test_sql(str(body["sql"]), dict(body.get("context", {})))
+        except (
+            json.JSONDecodeError,
+            KeyError,
+            ValueError,
+            TypeError,
+            SqlParseError,
+            RuleEvalError,
+        ) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response({"match": rows is not None, "rows": rows})
 
     async def banned_list(self, request):
         return web.json_response(

@@ -55,7 +55,32 @@ def main(argv=None) -> int:
         return 2
     base = a.url.rstrip("/") + "/api/v5"
     cmd, *rest = a.cmd
-    positional = [r for r in rest if not r.startswith("--")]
+    # split flags (--retain, --qos N) from positional arguments
+    flags: dict = {}
+    positional: list = []
+    i = 0
+    while i < len(rest):
+        tok = rest[i]
+        if tok == "--qos":
+            if i + 1 >= len(rest):
+                print("--qos needs a value", file=sys.stderr)
+                return 2
+            try:
+                flags["qos"] = int(rest[i + 1])
+            except ValueError:
+                print(f"--qos: bad value {rest[i + 1]!r}", file=sys.stderr)
+                return 2
+            i += 2
+        elif tok == "--retain":
+            flags["retain"] = True
+            i += 1
+        elif tok.startswith("--"):
+            print(f"unknown flag {tok}", file=sys.stderr)
+            return 2
+        else:
+            positional.append(tok)
+            i += 1
+    rest = positional
     if len(positional) < _MIN_ARGS.get(cmd, 0):
         print(
             f"{cmd}: expected at least {_MIN_ARGS[cmd]} argument(s)",
@@ -73,10 +98,7 @@ def main(argv=None) -> int:
         code, out = _call(f"{base}/clients/{rest[0]}", a.key, "DELETE")
     elif cmd == "publish":
         body = {"topic": rest[0], "payload": rest[1] if len(rest) > 1 else ""}
-        if "--qos" in rest:
-            body["qos"] = int(rest[rest.index("--qos") + 1])
-        if "--retain" in rest:
-            body["retain"] = True
+        body.update(flags)
         code, out = _call(f"{base}/publish", a.key, "POST", body)
     elif cmd == "banned":
         code, out = _call(f"{base}/banned", a.key)

@@ -1,0 +1,20 @@
+"""Rule engine: SQL-over-events stream processing.
+
+Reference analog: apps/emqx_rule_engine — rules are SQL statements over
+broker events (`SELECT ... FROM "topic" WHERE ...`), parsed by the rulesql
+grammar (emqx_rule_sqlparser.erl:52-55), fed by hookpoint→event bridging
+(emqx_rule_events.erl:76-116), evaluated per event
+(emqx_rule_runtime.erl), with a built-in SQL function library
+(emqx_rule_funcs.erl) and outputs republish/console/bridge
+(emqx_rule_outputs.erl). `test_sql` mirrors emqx_rule_sqltester.
+
+This implementation is a fresh recursive-descent parser + evaluator over
+plain dicts — events are host-side control flow, deliberately OFF the TPU
+path (the TPU plane owns batch route matching; rules run per matched event
+on the host exactly as the reference runs them per hook callback).
+"""
+
+from emqx_tpu.rules.engine import Rule, RuleEngine, test_sql
+from emqx_tpu.rules.sql import SqlParseError, parse_sql
+
+__all__ = ["Rule", "RuleEngine", "test_sql", "parse_sql", "SqlParseError"]

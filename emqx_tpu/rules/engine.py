@@ -1,0 +1,299 @@
+"""Rule registry + runtime wiring + outputs.
+
+Reference analog: emqx_rule_engine.erl (registry/metrics),
+emqx_rule_outputs.erl (republish/console/custom function),
+emqx_plugin_libs' emqx_placeholder (${var} templating),
+emqx_rule_sqltester (test_sql).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.ops import topics as T
+from emqx_tpu.rules import events as EV
+from emqx_tpu.rules.runtime import apply_query, eval_expr
+from emqx_tpu.rules.sql import Query, parse_sql
+
+log = logging.getLogger("emqx_tpu.rules")
+
+_PLACEHOLDER = re.compile(r"\$\{([A-Za-z0-9_.$]+)\}")
+
+
+def render_template(template: str, env: Dict) -> str:
+    """${a.b} placeholder substitution (emqx_placeholder parity)."""
+
+    def repl(m):
+        cur = env
+        for seg in m.group(1).split("."):
+            if isinstance(cur, (bytes, str)):
+                try:
+                    cur = json.loads(cur)
+                except (ValueError, TypeError):
+                    cur = None
+            if not isinstance(cur, dict) or seg not in cur:
+                return ""
+            cur = cur[seg]
+        if isinstance(cur, bytes):
+            return cur.decode("utf-8", "replace")
+        if isinstance(cur, (dict, list)):
+            return json.dumps(cur)
+        if isinstance(cur, bool):
+            return "true" if cur else "false"
+        if isinstance(cur, float) and cur.is_integer():
+            return str(int(cur))
+        return "" if cur is None else str(cur)
+
+    return _PLACEHOLDER.sub(repl, template)
+
+
+# -- outputs -----------------------------------------------------------------
+
+class Output:
+    name = "output"
+
+    def run(self, engine: "RuleEngine", rule: "Rule", row: Dict, ctx: Dict):
+        raise NotImplementedError
+
+
+class Republish(Output):
+    """Publish the rule result back into the broker
+    (emqx_rule_outputs republish)."""
+
+    name = "republish"
+
+    def __init__(
+        self,
+        topic: str,
+        payload: str = "${payload}",
+        qos: int = 0,
+        retain: bool = False,
+    ):
+        self.topic = topic
+        self.payload = payload
+        self.qos = qos
+        self.retain = retain
+
+    def run(self, engine, rule, row, ctx):
+        env = dict(ctx)
+        env.update(row)
+        topic = render_template(self.topic, env)
+        if self.payload == "${payload}" and "payload" not in env:
+            payload = json.dumps(row).encode()
+        else:
+            payload = render_template(self.payload, env).encode()
+        msg = Message(
+            topic=topic,
+            payload=payload,
+            qos=self.qos,
+            retain=self.retain,
+            from_client=ctx.get("clientid") or "rule_engine",
+        )
+        # guard against a rule republishing into its own FROM clause forever
+        msg.headers["from_rule"] = rule.id
+        engine.broker.publish(msg)
+
+
+class Console(Output):
+    """Log the result (emqx_rule_outputs console)."""
+
+    name = "console"
+
+    def run(self, engine, rule, row, ctx):
+        log.info("rule %s output: %s", rule.id, row)
+        engine.console_log.append((rule.id, row))
+
+
+class FunctionOutput(Output):
+    """Custom callable — the seam data bridges plug into
+    (reference: bridge outputs resolve to connector sends)."""
+
+    name = "function"
+
+    def __init__(self, fn: Callable[[Dict, Dict], None], name: str = "function"):
+        self.fn = fn
+        self.name = name
+
+    def run(self, engine, rule, row, ctx):
+        self.fn(row, ctx)
+
+
+@dataclass
+class RuleMetrics:
+    matched: int = 0
+    passed: int = 0
+    failed: int = 0
+    no_result: int = 0
+    outputs_success: int = 0
+    outputs_failed: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class Rule:
+    id: str
+    sql: str
+    outputs: List[Output]
+    description: str = ""
+    enabled: bool = True
+    query: Query = None  # type: ignore[assignment]
+    metrics: RuleMetrics = field(default_factory=RuleMetrics)
+
+    def __post_init__(self):
+        if self.query is None:
+            self.query = parse_sql(self.sql)
+
+
+class RuleEngine:
+    MAX_CHAIN_DEPTH = 5  # republish -> event -> republish chains
+
+    def __init__(self, broker) -> None:
+        self.broker = broker
+        self._rules: Dict[str, Rule] = {}
+        self._lock = threading.Lock()
+        self.console_log: List = []
+        self._depth = threading.local()
+
+    # -- registry ----------------------------------------------------------
+    def create_rule(
+        self,
+        rule_id: str,
+        sql: str,
+        outputs: List[Output],
+        description: str = "",
+        replace: bool = False,
+    ) -> Rule:
+        rule = Rule(id=rule_id, sql=sql, outputs=outputs, description=description)
+        with self._lock:
+            if not replace and rule_id in self._rules:
+                raise ValueError(f"rule {rule_id!r} already exists")
+            self._rules[rule_id] = rule
+        return rule
+
+    def delete_rule(self, rule_id: str) -> bool:
+        with self._lock:
+            return self._rules.pop(rule_id, None) is not None
+
+    def get_rule(self, rule_id: str) -> Optional[Rule]:
+        return self._rules.get(rule_id)
+
+    def rules(self) -> List[Rule]:
+        return list(self._rules.values())
+
+    # -- hook wiring (emqx_rule_events parity) ----------------------------
+    def attach(self, hooks: Hooks) -> None:
+        hooks.add("message.publish", self._on_publish, priority=120)
+        hooks.add(
+            "message.delivered",
+            lambda ci, msg: self._fire(EV.message_delivered(ci, msg)),
+        )
+        hooks.add(
+            "message.acked",
+            lambda ci, m: self._fire(EV.message_acked(ci, m)),
+        )
+        hooks.add(
+            "message.dropped",
+            lambda msg, reason: self._fire(EV.message_dropped(msg, reason)),
+        )
+        hooks.add(
+            "client.connected",
+            lambda ci, _ch: self._fire(EV.client_connected(ci)),
+        )
+        hooks.add(
+            "client.disconnected",
+            lambda ci, reason: self._fire(EV.client_disconnected(ci, reason)),
+        )
+        hooks.add(
+            "session.subscribed",
+            lambda ci, f, opts, _ch=None: self._fire(
+                EV.session_subscribed(ci, f, opts)
+            ),
+        )
+        hooks.add(
+            "session.unsubscribed",
+            lambda ci, f: self._fire(EV.session_unsubscribed(ci, f)),
+        )
+
+    def _on_publish(self, msg: Optional[Message]):
+        """'message.publish' fold callback: fire rules, pass msg through."""
+        if msg is None:
+            return None
+        self._fire(EV.message_publish(msg), from_rule=msg.headers.get("from_rule"))
+        return None
+
+    def _chain_depth(self) -> int:
+        return getattr(self._depth, "value", 0)
+
+    # -- evaluation --------------------------------------------------------
+    def _selects_event(self, q: Query, ctx: Dict) -> bool:
+        event = ctx["event"]
+        for t in q.topics:
+            if t.startswith("$events/"):
+                if EV.event_topic_to_name(t) == event:
+                    return True
+            elif event == "message.publish" and T.match(ctx["topic"], t):
+                return True
+        return False
+
+    def _fire(self, ctx: Dict, from_rule: Optional[str] = None) -> None:
+        # re-entrancy bound: outputs that publish re-enter _fire
+        # synchronously (via broker hooks); cap the chain so a rule feeding
+        # its own event class (e.g. $events/message_dropped -> republish to
+        # a subscriber-less topic) cannot recurse unboundedly
+        if self._chain_depth() >= self.MAX_CHAIN_DEPTH:
+            log.warning("rule chain depth limit hit; dropping event %s", ctx.get("event"))
+            return
+        from_rule = from_rule or ctx.get("__from_rule")
+        self._depth.value = self._chain_depth() + 1
+        try:
+            for rule in list(self._rules.values()):
+                if not rule.enabled:
+                    continue
+                if from_rule is not None and rule.id == from_rule:
+                    continue  # self-republish loop guard
+                if not self._selects_event(rule.query, ctx):
+                    continue
+                rule.metrics.matched += 1
+                try:
+                    rows = apply_query(rule.query, ctx)
+                except Exception:
+                    rule.metrics.failed += 1
+                    log.exception("rule %s SQL failed", rule.id)
+                    continue
+                if rows is None or not rows:
+                    rule.metrics.no_result += 1
+                    continue
+                rule.metrics.passed += 1
+                for row in rows:
+                    for out in rule.outputs:
+                        try:
+                            out.run(self, rule, row, ctx)
+                            rule.metrics.outputs_success += 1
+                        except Exception:
+                            rule.metrics.outputs_failed += 1
+                            log.exception(
+                                "rule %s output %s failed", rule.id, out.name
+                            )
+        finally:
+            self._depth.value = self._chain_depth() - 1
+
+
+def test_sql(sql: str, ctx: Dict) -> Optional[List[Dict]]:
+    """SQL test bench (emqx_rule_sqltester parity): run a statement against
+    a hand-built event context, no broker required."""
+    q = parse_sql(sql)
+    full = dict(ctx)
+    full.setdefault("event", "message.publish")
+    return apply_query(q, full)
+
+
+test_sql.__test__ = False  # not a pytest case despite the reference's name

@@ -326,3 +326,18 @@ async def test_authz_deny_action_disconnect():
         c = await tb.client("dd-1")
         await c.publish("secret/x", b"nope", qos=0)
         await asyncio.wait_for(c.closed.wait(), timeout=2)
+
+
+@async_test
+async def test_authz_deny_action_disconnect_on_subscribe():
+    async with TestBed() as tb:
+        Authorizer(
+            rules=[AclRule("deny", "all", "subscribe", ["secret/#"])],
+            deny_action="disconnect",
+        ).attach(tb.broker.hooks)
+        c = await tb.client("dds-1")
+        try:
+            await c.subscribe("secret/x")
+        except MqttError:
+            pass  # connection may drop before SUBACK arrives
+        await asyncio.wait_for(c.closed.wait(), timeout=2)

@@ -1,0 +1,397 @@
+"""Rule engine tests (parity targets: emqx_rule_engine_SUITE,
+emqx_rule_funcs_SUITE, emqx_rule_sqltester)."""
+
+import json
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.mqtt.packet import SubOpts
+from emqx_tpu.rules import RuleEngine, SqlParseError, parse_sql, test_sql
+from emqx_tpu.rules.engine import Console, FunctionOutput, Republish, render_template
+
+
+# -- parser ------------------------------------------------------------------
+
+def test_parse_basic_select():
+    q = parse_sql('SELECT * FROM "t/#"')
+    assert q.selects is None and q.topics == ["t/#"] and q.where is None
+
+
+def test_parse_multi_topic_and_where():
+    q = parse_sql(
+        "SELECT payload.x AS x, clientid FROM \"a/+\", \"$events/client_connected\" WHERE qos > 0 and x != 'no'"
+    )
+    assert len(q.selects) == 2
+    assert q.topics == ["a/+", "$events/client_connected"]
+    assert q.where is not None
+
+
+def test_parse_errors():
+    for bad in (
+        "FROM \"t\"",
+        "SELECT * FROM",
+        "SELECT * FROM \"t\" WHERE",
+        "SELECT * FROM \"t\" extra",
+        "SELECT (1 FROM \"t\"",
+    ):
+        with pytest.raises(SqlParseError):
+            parse_sql(bad)
+
+
+# -- sqltester-style evaluation ---------------------------------------------
+
+def _ctx(**kw):
+    base = {
+        "event": "message.publish",
+        "topic": "t/1",
+        "qos": 1,
+        "clientid": "c1",
+        "username": "u1",
+        "payload": json.dumps({"x": 1, "y": {"z": "deep"}, "arr": [10, 20, 30]}),
+        "timestamp": 1700000000000,
+    }
+    base.update(kw)
+    return base
+
+
+def test_select_star():
+    rows = test_sql('SELECT * FROM "t/#"', _ctx())
+    assert rows is not None and rows[0]["clientid"] == "c1"
+
+
+def test_select_payload_nested_and_alias():
+    rows = test_sql(
+        'SELECT payload.x, payload.y.z AS deep, clientid AS who FROM "t/#"',
+        _ctx(),
+    )
+    r = rows[0]
+    assert r["payload"]["x"] == 1
+    assert r["deep"] == "deep"
+    assert r["who"] == "c1"
+
+
+def test_where_filtering():
+    assert test_sql('SELECT * FROM "t/#" WHERE qos = 2', _ctx()) is None
+    assert test_sql('SELECT * FROM "t/#" WHERE qos >= 1', _ctx()) is not None
+    assert test_sql("SELECT * FROM \"t/#\" WHERE clientid = 'c1'", _ctx())
+    assert (
+        test_sql("SELECT * FROM \"t/#\" WHERE clientid IN ('a', 'c1')", _ctx())
+        is not None
+    )
+    assert (
+        test_sql("SELECT * FROM \"t/#\" WHERE clientid NOT IN ('a')", _ctx())
+        is not None
+    )
+    assert test_sql("SELECT * FROM \"t/#\" WHERE topic LIKE 't/%'", _ctx())
+
+
+def test_arithmetic_and_case():
+    r = test_sql(
+        'SELECT payload.x + 1 AS x1, payload.x * 10 AS x10, '
+        "CASE WHEN qos = 1 THEN 'one' ELSE 'other' END AS q FROM \"t/#\"",
+        _ctx(),
+    )[0]
+    assert (r["x1"], r["x10"], r["q"]) == (2, 10, "one")
+    assert test_sql('SELECT 7 div 2 AS d, 7 mod 2 AS m FROM "t"', _ctx(topic="t"))[
+        0
+    ] == {"d": 3, "m": 1}
+
+
+def test_array_index_access():
+    r = test_sql('SELECT payload.arr[2] AS second FROM "t/#"', _ctx())[0]
+    assert r["second"] == 20
+
+
+def test_foreach_incase():
+    rows = test_sql(
+        'FOREACH payload.arr AS e INCASE e > 10 FROM "t/#"', _ctx()
+    )
+    assert [r["e"] for r in rows] == [20, 30]
+    rows = test_sql(
+        'FOREACH payload.arr AS e DO e * 2 AS dbl INCASE e >= 20 FROM "t/#"',
+        _ctx(),
+    )
+    assert [r["dbl"] for r in rows] == [40, 60]
+
+
+def test_undefined_fields_are_null():
+    rows = test_sql(
+        'SELECT payload.missing AS m FROM "t/#" WHERE is_null(payload.missing)',
+        _ctx(),
+    )
+    assert rows[0]["m"] is None
+
+
+def test_funcs_sampler():
+    c = _ctx()
+    cases = [
+        ("lower(upper(clientid))", "c1"),
+        ("strlen(clientid)", 2),
+        ("substr(topic, 2)", "1"),
+        ("concat('a', 'b', 1)", "ab1"),
+        ("nth(1, split('x,y', ','))", "x"),
+        ("json_encode(payload.y)", '{"z": "deep"}'),
+        ("map_get('z', payload.y)", "deep"),
+        ("coalesce(payload.missing, 'dflt')", "dflt"),
+        ("abs(0 - 5)", 5),
+        ("floor(3.7)", 3),
+        ("md5('abc')", "900150983cd24fb0d6963f7d28e17f72"),
+        ("base64_decode(base64_encode('hi'))", "hi"),
+        ("regex_match(topic, '^t/')", True),
+        ("regex_replace(topic, '/', '_')", "t_1"),
+        ("bitand(6, 3)", 2),
+        ("is_num(qos)", True),
+        ("int('42')", 42),
+        ("contains(20, payload.arr)", True),
+        ("first(payload.arr)", 10),
+        ("last(payload.arr)", 30),
+        ("length(payload.arr)", 3),
+        ("unix_ts_to_rfc3339(0)", "1970-01-01T00:00:00Z"),
+    ]
+    for expr, expected in cases:
+        rows = test_sql(f'SELECT {expr} AS v FROM "t/#"', c)
+        assert rows[0]["v"] == expected, expr
+
+
+def test_render_template():
+    env = {"clientid": "c1", "payload": {"x": 5}, "flag": True}
+    assert render_template("id/${clientid}/x/${payload.x}", env) == "id/c1/x/5"
+    assert render_template("${flag}|${missing}", env) == "true|"
+
+
+# -- engine wiring -----------------------------------------------------------
+
+def _engine():
+    broker = Broker(hooks=Hooks())
+    eng = RuleEngine(broker)
+    eng.attach(broker.hooks)
+    return broker, eng
+
+
+def test_rule_on_publish_with_republish():
+    broker, eng = _engine()
+    got = []
+    broker.subscribe(
+        "s", "s", "alerts/#", SubOpts(), lambda m, o: got.append(m)
+    )
+    eng.create_rule(
+        "r1",
+        "SELECT payload.temp AS temp, clientid FROM \"sensors/+\" WHERE payload.temp > 30",
+        [Republish(topic="alerts/${clientid}", payload="${temp}")],
+    )
+    broker.publish(
+        Message(
+            topic="sensors/room1",
+            payload=json.dumps({"temp": 42}).encode(),
+            from_client="dev-1",
+        )
+    )
+    broker.publish(
+        Message(
+            topic="sensors/room1",
+            payload=json.dumps({"temp": 10}).encode(),
+            from_client="dev-1",
+        )
+    )
+    assert len(got) == 1
+    assert got[0].topic == "alerts/dev-1" and got[0].payload == b"42"
+    m = eng.get_rule("r1").metrics
+    assert (m.matched, m.passed, m.no_result) == (2, 1, 1)
+
+
+def test_rule_no_self_loop():
+    broker, eng = _engine()
+    eng.create_rule(
+        "loop",
+        'SELECT * FROM "loop/#"',
+        [Republish(topic="loop/again", payload="x")],
+    )
+    broker.publish(Message(topic="loop/start"))
+    # republished message must not re-trigger the same rule
+    assert eng.get_rule("loop").metrics.matched == 1
+
+
+def test_event_rules():
+    broker, eng = _engine()
+    seen = []
+    eng.create_rule(
+        "ev",
+        'SELECT clientid, event FROM "$events/client_connected", "$events/session_subscribed"',
+        [FunctionOutput(lambda row, ctx: seen.append(row))],
+    )
+    broker.hooks.run("client.connected", {"client_id": "cX"}, None)
+    broker.hooks.run(
+        "session.subscribed", {"client_id": "cX"}, "f/1", SubOpts(), None
+    )
+    broker.hooks.run("client.disconnected", {"client_id": "cX"}, "normal")
+    assert [s["event"] for s in seen] == ["client.connected", "session.subscribed"]
+    assert all(s["clientid"] == "cX" for s in seen)
+
+
+def test_console_output_and_metrics_on_bad_sql_runtime():
+    broker, eng = _engine()
+    eng.create_rule(
+        "c1",
+        'SELECT unknown_func(1) AS v FROM "t/#"',
+        [Console()],
+    )
+    broker.publish(Message(topic="t/x"))
+    assert eng.get_rule("c1").metrics.failed == 1
+
+
+def test_foreach_rule_fanout():
+    broker, eng = _engine()
+    got = []
+    broker.subscribe("s", "s", "each/#", SubOpts(), lambda m, o: got.append(m))
+    eng.create_rule(
+        "fe",
+        'FOREACH payload.readings AS r DO r.v AS v INCASE r.v > 0 FROM "batch/in"',
+        [Republish(topic="each/out", payload="${v}")],
+    )
+    broker.publish(
+        Message(
+            topic="batch/in",
+            payload=json.dumps(
+                {"readings": [{"v": 1}, {"v": -2}, {"v": 3}]}
+            ).encode(),
+        )
+    )
+    assert [m.payload for m in got] == [b"1", b"3"]
+
+
+def test_rule_disable_enable():
+    broker, eng = _engine()
+    rule = eng.create_rule("d1", 'SELECT * FROM "t/#"', [Console()])
+    rule.enabled = False
+    broker.publish(Message(topic="t/1"))
+    assert rule.metrics.matched == 0
+    rule.enabled = True
+    broker.publish(Message(topic="t/1"))
+    assert rule.metrics.matched == 1
+
+
+# -- integration: config + REST ----------------------------------------------
+
+from tests.test_broker_e2e import async_test  # noqa: E402
+
+
+@async_test
+async def test_rules_via_config_and_rest_api():
+    import aiohttp
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import ConfigError, load_config
+    from emqx_tpu.mqtt.client import Client
+
+    cfg = load_config(
+        {
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"port": 0, "bind": "127.0.0.1"},
+            "router": {"enable_tpu": False},
+            "rules": [
+                {
+                    "id": "cfg-rule",
+                    "sql": 'SELECT payload.v AS v FROM "in/#" WHERE payload.v > 1',
+                    "outputs": [
+                        {
+                            "function": "republish",
+                            "args": {"topic": "out/t", "payload": "${v}"},
+                        }
+                    ],
+                }
+            ],
+        }
+    )
+    app = BrokerApp(cfg)
+    await app.start()
+    try:
+        mqtt_port = list(app.listeners.list().values())[0].port
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        c = Client("rule-int")
+        await c.connect("127.0.0.1", mqtt_port)
+        await c.subscribe("out/t", qos=1)
+        await c.publish("in/x", json.dumps({"v": 5}).encode(), qos=1)
+        m = await asyncio.wait_for(c.messages.get(), timeout=3)
+        assert m.payload == b"5"
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/rules") as r:
+                data = (await r.json())["data"]
+                assert data[0]["id"] == "cfg-rule"
+                assert data[0]["metrics"]["passed"] == 1
+            # create a second rule over REST, exercise it, delete it
+            async with s.post(
+                f"{api}/rules",
+                json={
+                    "id": "rest-rule",
+                    "sql": 'SELECT clientid FROM "$events/client_connected"',
+                    "outputs": [{"function": "console"}],
+                },
+            ) as r:
+                assert r.status == 201
+            async with s.post(
+                f"{api}/rule_test",
+                json={
+                    "sql": 'SELECT qos + 1 AS q FROM "t"',
+                    "context": {"topic": "t", "qos": 1},
+                },
+            ) as r:
+                body = await r.json()
+                assert body["match"] and body["rows"][0]["q"] == 2
+            async with s.post(
+                f"{api}/rules", json={"id": "bad", "sql": "SELECT FROM"}
+            ) as r:
+                assert r.status == 400
+            async with s.delete(f"{api}/rules/rest-rule") as r:
+                assert r.status == 204
+            async with s.get(f"{api}/rules/rest-rule") as r:
+                assert r.status == 404
+        await c.disconnect()
+    finally:
+        await app.stop()
+
+    with pytest.raises(ConfigError):
+        load_config(
+            {"rules": [{"id": "x", "sql": "not sql", "outputs": []}]}
+        )
+
+
+import asyncio  # noqa: E402
+
+
+# -- regression: review findings ---------------------------------------------
+
+def test_event_rule_chain_depth_bounded():
+    """$events/message_dropped -> republish to subscriber-less topic must
+    terminate, not recurse."""
+    broker, eng = _engine()
+    eng.create_rule(
+        "dropwatch",
+        'SELECT * FROM "$events/message_dropped"',
+        [Republish(topic="alerts/drops", payload="drop")],
+    )
+    # no subscriber on alerts/drops -> the republish is itself dropped
+    broker.publish(Message(topic="nobody/home"))
+    m = eng.get_rule("dropwatch").metrics
+    assert m.matched <= eng.MAX_CHAIN_DEPTH + 1
+
+
+def test_duplicate_rule_id_rejected():
+    broker, eng = _engine()
+    eng.create_rule("dup", 'SELECT * FROM "t"', [Console()])
+    with pytest.raises(ValueError):
+        eng.create_rule("dup", 'SELECT * FROM "t2"', [Console()])
+    # explicit replace works
+    eng.create_rule("dup", 'SELECT * FROM "t3"', [Console()], replace=True)
+    assert eng.get_rule("dup").sql == 'SELECT * FROM "t3"'
+
+
+def test_sublist_arg_orders():
+    c = _ctx()
+    r = test_sql('SELECT sublist(2, payload.arr) AS v FROM "t/#"', c)[0]
+    assert r["v"] == [10, 20]
+    r = test_sql('SELECT sublist(2, 2, payload.arr) AS v FROM "t/#"', c)[0]
+    assert r["v"] == [20, 30]
