@@ -37,7 +37,7 @@ class NodeConfig:
 @dataclass
 class ListenerSpec:
     name: str = "default"
-    type: str = "tcp"  # tcp | ssl
+    type: str = "tcp"  # tcp | ssl | ws | wss
     bind: str = "0.0.0.0"
     port: int = 1883
     max_connections: int = 1_024_000
@@ -286,10 +286,10 @@ def _validate(cfg: AppConfig) -> None:
         if key in seen:
             raise ConfigError(f"duplicate listener {key}")
         seen.add(key)
-        if l.type not in ("tcp", "ssl"):
+        if l.type not in ("tcp", "ssl", "ws", "wss"):
             raise ConfigError(f"unsupported listener type {l.type!r}")
-        if l.type == "ssl" and not (l.ssl_certfile and l.ssl_keyfile):
-            raise ConfigError("ssl listener requires certfile and keyfile")
+        if l.type in ("ssl", "wss") and not (l.ssl_certfile and l.ssl_keyfile):
+            raise ConfigError(f"{l.type} listener requires certfile and keyfile")
     if cfg.shared_subscription.strategy not in (
         "random", "round_robin", "sticky", "hash_clientid", "hash_topic",
     ):

@@ -55,8 +55,28 @@ class Client:
         self._pid = self._pid % 65535 + 1
         return self._pid
 
-    async def connect(self, host: str = "127.0.0.1", port: int = 1883, timeout: float = 5.0):
-        self._reader, self._writer = await asyncio.open_connection(host, port)
+    async def connect(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 1883,
+        timeout: float = 5.0,
+        transport: str = "tcp",
+        path: str = "/mqtt",
+    ):
+        if transport == "ws":
+            # MQTT-over-WebSocket (binary frames, "mqtt" subprotocol)
+            from websockets.asyncio.client import connect as ws_connect
+
+            from emqx_tpu.transport.ws import _WsStream
+
+            ws = await ws_connect(
+                f"ws://{host}:{port}{path}", subprotocols=["mqtt"], max_size=None
+            )
+            self._reader = self._writer = _WsStream(ws)
+        elif transport == "tcp":
+            self._reader, self._writer = await asyncio.open_connection(host, port)
+        else:
+            raise ValueError(f"unsupported transport {transport!r} (tcp|ws)")
         self._send(
             pkt.Connect(
                 proto_ver=self.version,

@@ -19,7 +19,7 @@ from emqx_tpu.transport.connection import Connection
 @dataclass
 class ListenerConfig:
     name: str = "default"
-    type: str = "tcp"  # tcp | ssl
+    type: str = "tcp"  # tcp | ssl | ws | wss
     bind: str = "127.0.0.1"
     port: int = 1883
     max_connections: int = 1_024_000
@@ -27,6 +27,17 @@ class ListenerConfig:
     ssl_keyfile: Optional[str] = None
     ssl_cacertfile: Optional[str] = None
     ssl_verify: bool = False
+
+
+def build_ssl_context(config: "ListenerConfig") -> ssl_mod.SSLContext:
+    """Server-side TLS context shared by the ssl and wss listener types."""
+    ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(config.ssl_certfile, config.ssl_keyfile)
+    if config.ssl_cacertfile:
+        ctx.load_verify_locations(config.ssl_cacertfile)
+    if config.ssl_verify:
+        ctx.verify_mode = ssl_mod.CERT_REQUIRED
+    return ctx
 
 
 class Listener:
@@ -48,12 +59,7 @@ class Listener:
     async def start(self) -> None:
         ctx = None
         if self.config.type == "ssl":
-            ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(self.config.ssl_certfile, self.config.ssl_keyfile)
-            if self.config.ssl_cacertfile:
-                ctx.load_verify_locations(self.config.ssl_cacertfile)
-            if self.config.ssl_verify:
-                ctx.verify_mode = ssl_mod.CERT_REQUIRED
+            ctx = build_ssl_context(self.config)
         self._server = await asyncio.start_server(
             self._on_client, self.config.bind, self.config.port, ssl=ctx
         )
@@ -89,11 +95,18 @@ class Listeners:
 
     async def start_listener(
         self, config: ListenerConfig, channel_config=None
-    ) -> Listener:
+    ) -> "Listener":
         key = f"{config.type}:{config.name}"
         if key in self._listeners:
             raise ValueError(f"listener {key} already running")
-        l = Listener(self.broker, self.cm, config, channel_config)
+        if config.type in ("ws", "wss"):
+            from emqx_tpu.transport.ws import WsListener
+
+            l = WsListener(
+                self.broker, self.cm, config, channel_config or ChannelConfig()
+            )
+        else:
+            l = Listener(self.broker, self.cm, config, channel_config)
         await l.start()
         self._listeners[key] = l
         return l

@@ -1,0 +1,148 @@
+"""MQTT-over-WebSocket transport tests.
+
+Parity target: the reference serves the same protocol over cowboy WS
+(apps/emqx/src/emqx_ws_connection.erl); the shared-channel design means all
+of emqx_mqtt_SUITE's behaviors apply — here we verify the transport itself:
+binary-framed MQTT over WS, pub/sub across WS and TCP clients, QoS1.
+"""
+
+import asyncio
+import functools
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.mqtt.client import Client
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+class WsBed:
+    __test__ = False
+
+    def __init__(self):
+        self.broker = Broker(hooks=Hooks())
+        self.cm = ChannelManager(self.broker)
+        self.listeners = Listeners(self.broker, self.cm)
+        self.ws_port = None
+        self.tcp_port = None
+
+    async def __aenter__(self):
+        cfg = ChannelConfig()
+        ws = await self.listeners.start_listener(
+            ListenerConfig(name="w", type="ws", bind="127.0.0.1", port=0), cfg
+        )
+        tcp = await self.listeners.start_listener(
+            ListenerConfig(name="t", type="tcp", bind="127.0.0.1", port=0), cfg
+        )
+        self.ws_port = ws.port
+        self.tcp_port = tcp.port
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.listeners.stop_all()
+
+
+@async_test
+async def test_ws_connect_pub_sub():
+    async with WsBed() as bed:
+        sub = Client(client_id="ws-sub")
+        await sub.connect("127.0.0.1", bed.ws_port, transport="ws")
+        await sub.subscribe("t/#", qos=1)
+        pub = Client(client_id="ws-pub")
+        await pub.connect("127.0.0.1", bed.ws_port, transport="ws")
+        await pub.publish("t/1", b"hello-ws", qos=1)
+        m = await sub.recv()
+        assert m.topic == "t/1" and m.payload == b"hello-ws"
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+@async_test
+async def test_ws_and_tcp_interop():
+    """A WS subscriber receives from a TCP publisher and vice versa."""
+    async with WsBed() as bed:
+        ws_c = Client(client_id="wsc")
+        await ws_c.connect("127.0.0.1", bed.ws_port, transport="ws")
+        tcp_c = Client(client_id="tcpc")
+        await tcp_c.connect("127.0.0.1", bed.tcp_port)
+        await ws_c.subscribe("a/b")
+        await tcp_c.subscribe("c/d")
+        await tcp_c.publish("a/b", b"tcp->ws")
+        await ws_c.publish("c/d", b"ws->tcp")
+        assert (await ws_c.recv()).payload == b"tcp->ws"
+        assert (await tcp_c.recv()).payload == b"ws->tcp"
+        await ws_c.disconnect()
+        await tcp_c.disconnect()
+
+
+@async_test
+async def test_ws_qos2_roundtrip():
+    async with WsBed() as bed:
+        sub = Client(client_id="q2s")
+        await sub.connect("127.0.0.1", bed.ws_port, transport="ws")
+        await sub.subscribe("q2/t", qos=2)
+        pub = Client(client_id="q2p")
+        await pub.connect("127.0.0.1", bed.ws_port, transport="ws")
+        await pub.publish("q2/t", b"exactly-once", qos=2)
+        m = await sub.recv()
+        assert m.payload == b"exactly-once" and m.qos == 2
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+@async_test
+async def test_ws_text_frame_rejected():
+    """Text WS frames are a protocol error: connection closes."""
+    from websockets.asyncio.client import connect as ws_connect
+
+    async with WsBed() as bed:
+        ws = await ws_connect(
+            f"ws://127.0.0.1:{bed.ws_port}/mqtt", subprotocols=["mqtt"]
+        )
+        await ws.send("not-binary")
+        await asyncio.wait_for(ws.wait_closed(), 5)
+
+
+@async_test
+async def test_ws_no_subprotocol_accepted():
+    """Header-less WS clients connect fine (fail_if_no_subprotocol=false)."""
+    from websockets.asyncio.client import connect as ws_connect
+
+    from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.mqtt.frame import Parser, serialize
+
+    async with WsBed() as bed:
+        ws = await ws_connect(f"ws://127.0.0.1:{bed.ws_port}/mqtt")
+        await ws.send(serialize(pkt.Connect(client_id="nosp"), pkt.MQTT_V4))
+        parser = Parser()
+        msg = await asyncio.wait_for(ws.recv(), 5)
+        (connack,) = list(parser.feed(msg))
+        assert connack.type == pkt.CONNACK and connack.reason_code == 0
+        await ws.close()
+
+
+@async_test
+async def test_ws_large_payload():
+    async with WsBed() as bed:
+        sub = Client(client_id="big-s")
+        await sub.connect("127.0.0.1", bed.ws_port, transport="ws")
+        await sub.subscribe("big")
+        pub = Client(client_id="big-p")
+        await pub.connect("127.0.0.1", bed.ws_port, transport="ws")
+        payload = bytes(range(256)) * 512  # 128 KiB, spans WS messages
+        await pub.publish("big", payload, qos=1)
+        m = await sub.recv()
+        assert m.payload == payload
+        await pub.disconnect()
+        await sub.disconnect()
