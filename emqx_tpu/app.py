@@ -156,6 +156,65 @@ class BrokerApp:
         )
         self.authz.attach(self.hooks)
 
+        # observability (reference L5 aux: SURVEY.md §5.1/§5.5)
+        from emqx_tpu.observe.alarm import AlarmManager
+        from emqx_tpu.observe.event_message import EventMessage
+        from emqx_tpu.observe.exporters import StatsdExporter
+        from emqx_tpu.observe.monitors import OsMon, SysMon, VmMon
+        from emqx_tpu.observe.slow_subs import SlowSubs
+        from emqx_tpu.observe.topic_metrics import TopicMetrics
+        from emqx_tpu.observe.trace import TraceManager
+
+        ob = c.observe
+        self.alarms = AlarmManager(
+            publish=lambda topic, payload: self.broker.publish(
+                Message(topic=topic, payload=payload)
+            ),
+            size_limit=ob.alarm_size_limit,
+            validity_period=ob.alarm_validity_period,
+        )
+        self.sys_mon = SysMon(self.alarms) if ob.sys_mon_enable else None
+        self.os_mon = OsMon(self.alarms) if ob.os_mon_enable else None
+        self.vm_mon = VmMon(self.alarms) if ob.vm_mon_enable else None
+        self.slow_subs = SlowSubs(
+            threshold_ms=ob.slow_subs.threshold_ms,
+            top_k=ob.slow_subs.top_k_num,
+            expire_interval=ob.slow_subs.expire_interval,
+        )
+        self.slow_subs.enabled = ob.slow_subs.enable
+        self.slow_subs.attach(self.hooks)
+        self.topic_metrics = TopicMetrics()
+        self.topic_metrics.attach(self.hooks)
+        self.event_message = EventMessage(
+            self.broker,
+            enabled={
+                name
+                for name in (
+                    "client_connected",
+                    "client_disconnected",
+                    "session_subscribed",
+                    "session_unsubscribed",
+                    "message_delivered",
+                    "message_acked",
+                    "message_dropped",
+                )
+                if getattr(ob.event_message, name)
+            },
+        )
+        self.event_message.attach(self.hooks)
+        self.trace = TraceManager(base_dir=ob.trace_dir)
+        self.trace.attach(self.hooks)
+        self.statsd = (
+            StatsdExporter(
+                self.broker.metrics,
+                host=ob.statsd.server_host,
+                port=ob.statsd.server_port,
+                interval=ob.statsd.flush_interval,
+            )
+            if ob.statsd.enable
+            else None
+        )
+
         self.mgmt_server = None  # set by start() when dashboard.enable
         self._tasks: List[asyncio.Task] = []
         self.started_at: Optional[float] = None
@@ -192,6 +251,8 @@ class BrokerApp:
             self.mgmt_server = MgmtApi(self)
             await self.mgmt_server.start(c.dashboard.bind, c.dashboard.port)
         self.started_at = time.time()
+        if self.statsd is not None:
+            self.statsd.start()
         self._tasks = [
             asyncio.ensure_future(self._housekeeping()),
             asyncio.ensure_future(self._sys_heartbeat()),
@@ -203,9 +264,14 @@ class BrokerApp:
             t.cancel()
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self.statsd is not None:
+            await self.statsd.stop()
         if self.mgmt_server is not None:
             await self.mgmt_server.stop()
         await self.listeners.stop_all()
+        if self.sys_mon is not None:
+            self.sys_mon.close()
+        self.trace.close()
 
     async def _housekeeping(self) -> None:
         import logging
@@ -224,6 +290,15 @@ class BrokerApp:
                 if now - last_retainer_sweep >= c.retainer.msg_clear_interval:
                     self.retainer.clear_expired(now)
                     last_retainer_sweep = now
+                if self.sys_mon is not None:
+                    self.sys_mon.check(now, 1.0)
+                if self.os_mon is not None:
+                    self.os_mon.check(now)
+                if self.vm_mon is not None:
+                    self.vm_mon.check(now)
+                self.slow_subs.sweep(now)
+                self.alarms.sweep(now)
+                self.topic_metrics.tick_rates(now)
             except asyncio.CancelledError:
                 raise
             except Exception:

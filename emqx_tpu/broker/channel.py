@@ -113,9 +113,10 @@ class Channel:
         if t == pkt.PUBLISH:
             return self._in_publish(p)
         if t == pkt.PUBACK:
-            found, more = self.session.puback(p.packet_id)
-            if found:
-                self.hooks.run("message.acked", self.client_info(), p.packet_id)
+            acked, more = self.session.puback(p.packet_id)
+            if acked is not None:
+                self.hooks.run("message.acked", self.client_info(), acked)
+                self._delivery_completed(acked)
             for q in more:
                 self._send(q)
             return
@@ -144,7 +145,10 @@ class Channel:
             self._send(comp)
             return
         if t == pkt.PUBCOMP:
-            _, more = self.session.pubcomp(p.packet_id)
+            completed, more = self.session.pubcomp(p.packet_id)
+            if completed is not None:
+                self.hooks.run("message.acked", self.client_info(), completed)
+                self._delivery_completed(completed)
             for q in more:
                 self._send(q)
             return
@@ -436,6 +440,18 @@ class Channel:
         for q in out:
             self.hooks.run("message.delivered", self.client_info(), msg)
             self._send(q)
+            if q.type == pkt.PUBLISH and q.qos == 0:
+                # QoS0 completes at send; QoS1/2 complete at PUBACK/PUBCOMP
+                # ('delivery.completed' hook, emqx_slow_subs.erl:25 parity)
+                self._delivery_completed(msg)
+
+    def _delivery_completed(self, msg: Message) -> None:
+        self.hooks.run(
+            "delivery.completed",
+            self.client_info(),
+            msg,
+            time.time() - msg.timestamp,
+        )
 
     # -- timers ------------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:

@@ -14,7 +14,9 @@ from emqx_tpu.broker.message import Message
 
 @dataclass
 class InflightEntry:
-    msg: Optional[Message]  # None once PUBREC received (QoS2 rel phase)
+    # In the QoS2 rel phase the payload is dropped but topic/qos/timestamp
+    # metadata survive so completion hooks can report on the message
+    msg: Optional[Message]
     phase: str  # 'publish' | 'pubrel'
     ts: float
 
@@ -42,8 +44,13 @@ class Inflight:
             return False
         e.phase = phase
         e.ts = time.time()
-        if phase == "pubrel":
-            e.msg = None  # payload no longer needed after PUBREC
+        if phase == "pubrel" and e.msg is not None and e.msg.payload:
+            # payload no longer needed after PUBREC; keep the metadata
+            import copy
+
+            m = copy.copy(e.msg)
+            m.payload = b""
+            e.msg = m
         return True
 
     def delete(self, packet_id: int) -> Optional[InflightEntry]:

@@ -101,10 +101,12 @@ class Session:
             properties=dict(msg.properties),
         )
 
-    def puback(self, packet_id: int) -> Tuple[bool, List[pkt.Publish]]:
-        """QoS1 ack; returns (found, replacement publishes from mqueue)."""
+    def puback(
+        self, packet_id: int
+    ) -> Tuple[Optional[Message], List[pkt.Publish]]:
+        """QoS1 ack; returns (acked msg | None, replacement publishes)."""
         e = self.inflight.delete(packet_id)
-        return e is not None, self._drain()
+        return (e.msg if e is not None else None), self._drain()
 
     def pubrec(self, packet_id: int) -> bool:
         """QoS2 phase 1 ack'd by receiver -> move to rel phase."""
@@ -114,9 +116,12 @@ class Session:
         self.inflight.update(packet_id, "pubrel")
         return True
 
-    def pubcomp(self, packet_id: int) -> Tuple[bool, List[pkt.Publish]]:
+    def pubcomp(
+        self, packet_id: int
+    ) -> Tuple[Optional[Message], List[pkt.Publish]]:
         e = self.inflight.delete(packet_id)
-        return e is not None and e.phase == "pubrel", self._drain()
+        ok = e is not None and e.phase == "pubrel"
+        return (e.msg if ok else None), self._drain()
 
     def _drain(self) -> List[pkt.Publish]:
         out: List[pkt.Publish] = []

@@ -140,6 +140,48 @@ class DashboardConfig:
 
 
 @dataclass
+class SlowSubsConfig:
+    enable: bool = True
+    threshold_ms: float = 500.0
+    top_k_num: int = 10
+    expire_interval: float = 300.0
+
+
+@dataclass
+class StatsdConfig:
+    enable: bool = False
+    server_host: str = "127.0.0.1"
+    server_port: int = 8125
+    flush_interval: float = 30.0
+
+
+@dataclass
+class EventMessageConfig:
+    client_connected: bool = True
+    client_disconnected: bool = True
+    session_subscribed: bool = True
+    session_unsubscribed: bool = True
+    message_delivered: bool = False
+    message_acked: bool = False
+    message_dropped: bool = False
+
+
+@dataclass
+class ObserveConfig:
+    slow_subs: SlowSubsConfig = field(default_factory=SlowSubsConfig)
+    statsd: StatsdConfig = field(default_factory=StatsdConfig)
+    event_message: EventMessageConfig = field(
+        default_factory=EventMessageConfig
+    )
+    trace_dir: str = "trace"
+    alarm_size_limit: int = 1000
+    alarm_validity_period: float = 24 * 3600.0
+    os_mon_enable: bool = True
+    vm_mon_enable: bool = True
+    sys_mon_enable: bool = True
+
+
+@dataclass
 class AutoSubscribeSpec:
     topic: str = ""
     qos: int = 0
@@ -175,6 +217,7 @@ class AppConfig:
     flapping: FlappingConfig = field(default_factory=FlappingConfig)
     shared_subscription: SharedSubConfig = field(default_factory=SharedSubConfig)
     sys: SysConfig = field(default_factory=SysConfig)
+    observe: ObserveConfig = field(default_factory=ObserveConfig)
     dashboard: DashboardConfig = field(default_factory=DashboardConfig)
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
     rules: List[RuleSpec] = field(default_factory=list)

@@ -68,6 +68,22 @@ class MgmtApi:
                 web.get("/api/v5/rules/{id}", self.rules_one),
                 web.delete("/api/v5/rules/{id}", self.rules_delete),
                 web.post("/api/v5/rule_test", self.rule_test),
+                web.get("/api/v5/alarms", self.alarms_list),
+                web.delete("/api/v5/alarms", self.alarms_clear),
+                web.get("/api/v5/slow_subscriptions", self.slow_subs_list),
+                web.delete("/api/v5/slow_subscriptions", self.slow_subs_clear),
+                web.get("/api/v5/mqtt/topic_metrics", self.topic_metrics_list),
+                web.post("/api/v5/mqtt/topic_metrics", self.topic_metrics_add),
+                web.delete(
+                    "/api/v5/mqtt/topic_metrics/{topic:.+}",
+                    self.topic_metrics_del,
+                ),
+                web.get("/api/v5/prometheus/stats", self.prometheus_stats),
+                web.get("/api/v5/trace", self.trace_list),
+                web.post("/api/v5/trace", self.trace_create),
+                web.delete("/api/v5/trace/{name}", self.trace_delete),
+                web.put("/api/v5/trace/{name}/stop", self.trace_stop),
+                web.get("/api/v5/trace/{name}/download", self.trace_download),
             ]
         )
         self._webapp = w
@@ -359,3 +375,122 @@ class MgmtApi:
 
     async def configs(self, request):
         return web.json_response(to_dict(self.app.config))
+
+    # -- observability (emqx_mgmt_api_alarms/trace, emqx_slow_subs REST,
+    #    emqx_topic_metrics REST, emqx_prometheus scrape) ------------------
+    async def alarms_list(self, request):
+        q = request.query.get("activated")
+        activated = None if q is None else q in ("true", "1")
+        return web.json_response({"data": self.app.alarms.list(activated)})
+
+    async def alarms_clear(self, request):
+        n = self.app.alarms.delete_all_deactivated()
+        return web.json_response({"cleared": n}, status=200)
+
+    async def slow_subs_list(self, request):
+        return web.json_response({"data": self.app.slow_subs.topk()})
+
+    async def slow_subs_clear(self, request):
+        self.app.slow_subs.clear()
+        return web.Response(status=204)
+
+    async def topic_metrics_list(self, request):
+        return web.json_response(self.app.topic_metrics.metrics())
+
+    async def topic_metrics_add(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        topic = body.get("topic", "")
+        try:
+            created = self.app.topic_metrics.register(topic)
+        except OverflowError:
+            return web.json_response({"code": "QUOTA_EXCEEDED"}, status=409)
+        except Exception:
+            return web.json_response({"code": "BAD_TOPIC"}, status=400)
+        if not created:
+            return web.json_response({"code": "ALREADY_EXISTED"}, status=409)
+        return web.json_response({"topic": topic}, status=201)
+
+    async def topic_metrics_del(self, request):
+        ok = self.app.topic_metrics.deregister(request.match_info["topic"])
+        return web.json_response(
+            {} if ok else {"code": "NOT_FOUND"}, status=204 if ok else 404
+        )
+
+    async def prometheus_stats(self, request):
+        from emqx_tpu.observe.exporters import prometheus_exposition
+
+        extra = {
+            "connections.count": self.cm.channel_count(),
+            "subscriptions.count": self.broker.subscription_count(),
+            "topics.count": len(self.broker.router),
+            "retained.count": len(self.app.retainer),
+        }
+        if self.app.os_mon is not None:
+            extra["cpu.usage"] = self.app.os_mon.cpu_usage
+            extra["mem.usage"] = self.app.os_mon.mem_usage
+        if self.app.vm_mon is not None:
+            extra["tasks.count"] = self.app.vm_mon.task_count
+        body = prometheus_exposition(self.broker.metrics.snapshot(), extra)
+        return web.Response(text=body, content_type="text/plain")
+
+    async def trace_list(self, request):
+        return web.json_response({"data": self.app.trace.list()})
+
+    async def trace_create(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        try:
+            spec = self.app.trace.create(
+                name=body["name"],
+                type=body["type"],
+                value=body.get(body.get("type"), body.get("value", "")),
+                start_at=body.get("start_at"),
+                end_at=body.get("end_at"),
+            )
+        except KeyError:
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        except ValueError as e:
+            code = (
+                "ALREADY_EXISTED" if "existed" in str(e) else "BAD_REQUEST"
+            )
+            return web.json_response(
+                {"code": code}, status=409 if code == "ALREADY_EXISTED" else 400
+            )
+        except OverflowError:
+            return web.json_response({"code": "QUOTA_EXCEEDED"}, status=409)
+        return web.json_response(
+            {"name": spec.name, "type": spec.type, "status": spec.status()},
+            status=201,
+        )
+
+    async def trace_delete(self, request):
+        ok = self.app.trace.delete(request.match_info["name"])
+        return web.json_response(
+            {} if ok else {"code": "NOT_FOUND"}, status=204 if ok else 404
+        )
+
+    async def trace_stop(self, request):
+        ok = self.app.trace.stop(request.match_info["name"])
+        return web.json_response(
+            {"status": "stopped"} if ok else {"code": "NOT_FOUND"},
+            status=200 if ok else 404,
+        )
+
+    async def trace_download(self, request):
+        content = self.app.trace.read(request.match_info["name"])
+        if content is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.Response(
+            text=content,
+            content_type="text/plain",
+            headers={
+                "Content-Disposition": (
+                    f'attachment; filename="{request.match_info["name"]}.log"'
+                )
+            },
+        )

@@ -1,0 +1,8 @@
+"""Observability: alarms, runtime monitors, slow-subscriber tracking,
+per-topic metrics, $event messages, Prometheus/StatsD export, packet trace.
+
+Reference surface: apps/emqx/src/emqx_alarm.erl, emqx_sys_mon/os_mon/vm_mon,
+apps/emqx_slow_subs, emqx_topic_metrics.erl, emqx_event_message.erl,
+apps/emqx_prometheus, apps/emqx_statsd, apps/emqx/src/emqx_trace/
+(SURVEY.md §5.1, §5.5).
+"""

@@ -1,0 +1,118 @@
+"""Alarm lifecycle: activate/deactivate named alarms with history.
+
+Parity with the reference (apps/emqx/src/emqx_alarm.erl): alarms are named,
+carry details + message, live in an activated table until deactivated, then
+move to a capped history; every transition republishes to
+$SYS/brokers/<node>/alarms/activate|deactivate so MQTT clients can watch
+them (the reference's emqx_alarm_handler behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from emqx_tpu.utils.node import node_name
+
+
+@dataclass
+class Alarm:
+    name: str
+    details: Dict = field(default_factory=dict)
+    message: str = ""
+    activated_at: float = field(default_factory=time.time)
+    deactivated_at: Optional[float] = None
+
+    def to_json(self) -> Dict:
+        return {
+            "name": self.name,
+            "node": node_name(),
+            "details": self.details,
+            "message": self.message,
+            "activated_at": self.activated_at,
+            "deactivated_at": self.deactivated_at,
+            "duration": (
+                (self.deactivated_at or time.time()) - self.activated_at
+            ),
+        }
+
+
+class AlarmManager:
+    def __init__(
+        self,
+        publish: Optional[Callable] = None,
+        size_limit: int = 1000,
+        validity_period: float = 24 * 3600.0,
+    ):
+        """`publish(topic, payload_bytes)` republishes transitions ($SYS)."""
+        self._active: Dict[str, Alarm] = {}
+        self._history: List[Alarm] = []
+        self._publish = publish
+        self.size_limit = size_limit
+        self.validity_period = validity_period
+
+    def activate(
+        self, name: str, details: Optional[Dict] = None, message: str = ""
+    ) -> bool:
+        """Returns False when already active (reference: {error, duplicated})."""
+        if name in self._active:
+            return False
+        alarm = Alarm(name=name, details=details or {}, message=message)
+        self._active[name] = alarm
+        self._republish("activate", alarm)
+        return True
+
+    def deactivate(self, name: str) -> bool:
+        alarm = self._active.pop(name, None)
+        if alarm is None:
+            return False
+        alarm.deactivated_at = time.time()
+        self._history.append(alarm)
+        if len(self._history) > self.size_limit:
+            del self._history[: len(self._history) - self.size_limit]
+        self._republish("deactivate", alarm)
+        return True
+
+    def ensure(self, name: str, active: bool, details=None, message="") -> None:
+        """Level-triggered helper: (de)activate to match a boolean condition."""
+        if active:
+            self.activate(name, details, message)
+        else:
+            self.deactivate(name)
+
+    def is_active(self, name: str) -> bool:
+        return name in self._active
+
+    def list(self, activated: Optional[bool] = None) -> List[Dict]:
+        if activated is True:
+            items = list(self._active.values())
+        elif activated is False:
+            items = list(self._history)
+        else:
+            items = list(self._active.values()) + list(self._history)
+        return [a.to_json() for a in items]
+
+    def delete_all_deactivated(self) -> int:
+        n = len(self._history)
+        self._history.clear()
+        return n
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        """Expire history entries past validity_period (emqx_alarm GC)."""
+        now = now or time.time()
+        self._history = [
+            a
+            for a in self._history
+            if (a.deactivated_at or now) + self.validity_period > now
+        ]
+
+    def _republish(self, kind: str, alarm: Alarm) -> None:
+        if self._publish is None:
+            return
+        topic = f"$SYS/brokers/{node_name()}/alarms/{kind}"
+        try:
+            self._publish(topic, json.dumps(alarm.to_json()).encode())
+        except Exception:
+            pass

@@ -1,0 +1,115 @@
+"""Metric exporters: Prometheus text exposition + StatsD UDP push.
+
+Reference surface: apps/emqx_prometheus (scrape endpoint
+/api/v5/prometheus/stats + push-gateway client), apps/emqx_statsd (same
+metric families over statsd UDP). Metric names follow the reference's
+prometheus naming (emqx_ prefix, dots -> underscores).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+from typing import Dict, Optional
+
+
+def _prom_name(name: str) -> str:
+    return "emqx_" + name.replace(".", "_").replace("-", "_")
+
+
+def prometheus_exposition(
+    metrics_snapshot: Dict[str, float], extra_gauges: Optional[Dict] = None
+) -> str:
+    """Render one scrape body (text exposition format 0.0.4)."""
+    lines = []
+    merged = dict(metrics_snapshot)
+    if extra_gauges:
+        merged.update(extra_gauges)
+    for name in sorted(merged):
+        v = merged[name]
+        pname = _prom_name(name)
+        kind = "counter" if ("." in name and not name.endswith("count")
+                             and "usage" not in name
+                             and "uptime" not in name) else "gauge"
+        lines.append(f"# TYPE {pname} {kind}")
+        lines.append(f"{pname} {float(v):g}")
+    return "\n".join(lines) + "\n"
+
+
+class StatsdExporter:
+    """Periodic UDP push of the same families (emqx_statsd analog)."""
+
+    def __init__(
+        self,
+        metrics,
+        host: str = "127.0.0.1",
+        port: int = 8125,
+        interval: float = 30.0,
+        prefix: str = "emqx",
+    ):
+        self.metrics = metrics
+        self.addr = (host, port)
+        self.interval = interval
+        self.prefix = prefix
+        self._task: Optional[asyncio.Task] = None
+        self._sock: Optional[socket.socket] = None
+        self._last: Dict[str, float] = {}
+
+    def render(self) -> bytes:
+        """counters -> statsd 'c' deltas; gauges -> 'g'."""
+        snap = self.metrics.snapshot()
+        out = []
+        for name, v in sorted(snap.items()):
+            sname = f"{self.prefix}.{name}"
+            if name.endswith("count") or "usage" in name or "uptime" in name:
+                out.append(f"{sname}:{float(v):g}|g")
+            else:
+                delta = v - self._last.get(name, 0)
+                self._last[name] = v
+                if delta:
+                    out.append(f"{sname}:{float(delta):g}|c")
+        return "\n".join(out).encode()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            self.push()
+
+    def push(self) -> int:
+        payload = self.render()
+        if not payload:
+            return 0
+        if self._sock is None:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # chunk to stay under typical UDP MTU
+            sent = 0
+            buf = b""
+            for line in payload.split(b"\n"):
+                if len(buf) + len(line) + 1 > 1400 and buf:
+                    self._sock.sendto(buf, self.addr)
+                    sent += 1
+                    buf = b""
+                buf += (b"\n" if buf else b"") + line
+            if buf:
+                self._sock.sendto(buf, self.addr)
+                sent += 1
+            return sent
+        except OSError:
+            return 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

@@ -1,0 +1,89 @@
+"""Slow-subscriber detection: top-K delivery latency.
+
+Parity with apps/emqx_slow_subs (SURVEY.md §2.2): measures per-delivery
+latency on the 'delivery.completed' hook, keeps a bounded top-K table of
+(clientid, topic) -> max latency over a sliding window, entries expire after
+`expire_interval`. Stats modes of the reference (whole/internal/response)
+collapse to whole-delivery latency here: publish timestamp -> ack (QoS1/2)
+or send (QoS0).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class SlowEntry:
+    client_id: str
+    topic: str
+    latency_ms: float
+    last_update: float
+
+
+class SlowSubs:
+    def __init__(
+        self,
+        threshold_ms: float = 500.0,
+        top_k: int = 10,
+        expire_interval: float = 300.0,
+    ):
+        self.threshold_ms = threshold_ms
+        self.top_k = top_k
+        self.expire_interval = expire_interval
+        self._table: Dict[Tuple[str, str], SlowEntry] = {}
+        self.enabled = True
+
+    # hook: delivery.completed(client_info, msg, latency_s)
+    def on_delivery_completed(self, client_info, msg, latency_s) -> None:
+        if not self.enabled:
+            return
+        ms = latency_s * 1000.0
+        if ms < self.threshold_ms:
+            return
+        key = (client_info.get("client_id", ""), msg.topic)
+        now = time.time()
+        e = self._table.get(key)
+        if e is None:
+            self._table[key] = SlowEntry(key[0], key[1], ms, now)
+            self._shrink()
+        else:
+            e.latency_ms = max(e.latency_ms, ms)
+            e.last_update = now
+
+    def _shrink(self) -> None:
+        if len(self._table) <= self.top_k:
+            return
+        # evict the fastest entries so only the top-K slowest remain
+        ranked = sorted(
+            self._table.items(), key=lambda kv: -kv[1].latency_ms
+        )
+        self._table = dict(ranked[: self.top_k])
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        now = now or time.time()
+        self._table = {
+            k: e
+            for k, e in self._table.items()
+            if now - e.last_update < self.expire_interval
+        }
+
+    def clear(self) -> None:
+        self._table.clear()
+
+    def topk(self) -> List[Dict]:
+        ranked = sorted(self._table.values(), key=lambda e: -e.latency_ms)
+        return [
+            {
+                "clientid": e.client_id,
+                "topic": e.topic,
+                "timespan": round(e.latency_ms, 3),
+                "last_update_time": e.last_update,
+            }
+            for e in ranked
+        ]
+
+    def attach(self, hooks) -> None:
+        hooks.add("delivery.completed", self.on_delivery_completed, tag="slow_subs")

@@ -1,0 +1,112 @@
+"""Per-topic-filter metrics (reference: apps/emqx_modules/src/
+emqx_topic_metrics.erl): operators register topic filters; the module counts
+messages in/out/dropped and per-QoS breakdown for messages whose topic
+matches, with rate estimates. Registration is capped (the reference caps at
+512 filters).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from emqx_tpu.ops import topics as T
+
+MAX_TOPICS = 512
+_COUNTERS = (
+    "messages.in",
+    "messages.out",
+    "messages.dropped",
+    "messages.qos0.in",
+    "messages.qos1.in",
+    "messages.qos2.in",
+)
+
+
+class TopicMetrics:
+    def __init__(self) -> None:
+        self._table: Dict[str, Dict[str, float]] = {}
+        self._rate_base: Dict[str, Dict[str, float]] = {}
+        self._rate_ts: float = time.time()
+        self._rates: Dict[str, Dict[str, float]] = {}
+
+    # -- registration ------------------------------------------------------
+    def register(self, topic_filter: str) -> bool:
+        T.validate(topic_filter, kind="filter")
+        if topic_filter in self._table:
+            return False
+        if len(self._table) >= MAX_TOPICS:
+            raise OverflowError("quota_exceeded")
+        self._table[topic_filter] = {c: 0 for c in _COUNTERS}
+        return True
+
+    def deregister(self, topic_filter: str) -> bool:
+        self._rates.pop(topic_filter, None)
+        self._rate_base.pop(topic_filter, None)
+        return self._table.pop(topic_filter, None) is not None
+
+    def deregister_all(self) -> None:
+        self._table.clear()
+        self._rates.clear()
+        self._rate_base.clear()
+
+    def topics(self) -> List[str]:
+        return list(self._table)
+
+    # -- counting ----------------------------------------------------------
+    def _bump(self, topic: str, counter: str, extra: Optional[str] = None):
+        for f, counters in self._table.items():
+            if T.match(topic, f):
+                counters[counter] += 1
+                if extra:
+                    counters[extra] += 1
+
+    # hooks
+    def on_message_publish(self, msg, acc=None):
+        self._bump(msg.topic, "messages.in", f"messages.qos{msg.qos}.in")
+        return acc if acc is not None else msg
+
+    def on_message_delivered(self, client_info, msg):
+        self._bump(msg.topic, "messages.out")
+
+    def on_message_dropped(self, msg, reason):
+        self._bump(msg.topic, "messages.dropped")
+
+    def attach(self, hooks) -> None:
+        # priority above default so counts include messages later dropped
+        hooks.add("message.publish", self.on_message_publish, priority=100,
+                  tag="topic_metrics")
+        hooks.add("message.delivered", self.on_message_delivered,
+                  tag="topic_metrics")
+        hooks.add("message.dropped", self.on_message_dropped,
+                  tag="topic_metrics")
+
+    # -- rates (called from housekeeping) ----------------------------------
+    def tick_rates(self, now: Optional[float] = None) -> None:
+        now = now or time.time()
+        dt = now - self._rate_ts
+        if dt <= 0:
+            return
+        for f, counters in self._table.items():
+            base = self._rate_base.get(f, {})
+            self._rates[f] = {
+                c: (counters[c] - base.get(c, 0)) / dt for c in _COUNTERS
+            }
+            self._rate_base[f] = dict(counters)
+        self._rate_ts = now
+
+    def metrics(self, topic_filter: Optional[str] = None):
+        if topic_filter is not None:
+            if topic_filter not in self._table:
+                return None
+            return self._one(topic_filter)
+        return [self._one(f) for f in self._table]
+
+    def _one(self, f: str) -> Dict:
+        out = {"topic": f, "metrics": dict(self._table[f])}
+        rates = self._rates.get(f)
+        if rates:
+            out["metrics"].update(
+                {c + ".rate": round(v, 3) for c, v in rates.items()}
+            )
+        return out

@@ -1,0 +1,252 @@
+"""Live packet/event tracing to files (reference: apps/emqx/src/emqx_trace/).
+
+The reference manages named trace specs (filter by clientid, topic, or IP)
+in mnesia, installs logger handlers per trace writing formatted lines to
+per-trace files, with start/end windows and REST download
+(emqx_trace.erl:30-50, emqx_trace_handler.erl:26-45). Trace points are
+invoked inline from broker ops (emqx_broker.erl:129,177,205).
+
+Here: `TraceManager` owns the spec table and open files; it attaches to the
+same hookpoints the reference traces (publish/subscribe/unsubscribe,
+connect/disconnect, deliver) and writes one formatted line per matching
+event. Files live under `base_dir`; finished traces stay on disk for
+download until deleted.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from emqx_tpu.ops import topics as T
+
+
+@dataclass
+class TraceSpec:
+    name: str
+    type: str  # clientid | topic | ip_address
+    value: str
+    start_at: float = field(default_factory=time.time)
+    end_at: Optional[float] = None  # None = until stopped
+    enabled: bool = True
+
+    def status(self, now: Optional[float] = None) -> str:
+        now = now or time.time()
+        if not self.enabled:
+            return "stopped"
+        if now < self.start_at:
+            return "waiting"
+        if self.end_at is not None and now >= self.end_at:
+            return "stopped"
+        return "running"
+
+    def matches(self, meta: Dict) -> bool:
+        if self.type == "clientid":
+            return meta.get("clientid") == self.value
+        if self.type == "topic":
+            topic = meta.get("topic")
+            return topic is not None and T.match(topic, self.value)
+        if self.type == "ip_address":
+            return meta.get("peerhost") == self.value
+        return False
+
+
+class TraceManager:
+    MAX_TRACES = 30  # reference caps concurrent traces
+
+    def __init__(self, base_dir: str = "trace"):
+        self.base_dir = base_dir
+        self._specs: Dict[str, TraceSpec] = {}
+        self._files: Dict[str, object] = {}
+
+    # -- spec management ---------------------------------------------------
+    def create(
+        self,
+        name: str,
+        type: str,
+        value: str,
+        start_at: Optional[float] = None,
+        end_at: Optional[float] = None,
+    ) -> TraceSpec:
+        if name in self._specs:
+            raise ValueError("already_existed")
+        if type not in ("clientid", "topic", "ip_address"):
+            raise ValueError(f"bad trace type {type!r}")
+        if type == "topic":
+            T.validate(value, kind="filter")
+        if sum(1 for s in self._specs.values() if s.status() != "stopped") \
+                >= self.MAX_TRACES:
+            raise OverflowError("max_traces")
+        spec = TraceSpec(
+            name=name,
+            type=type,
+            value=value,
+            start_at=start_at or time.time(),
+            end_at=end_at,
+        )
+        self._specs[name] = spec
+        os.makedirs(self.base_dir, exist_ok=True)
+        self._files[name] = open(self.filepath(name), "a", encoding="utf-8")
+        return spec
+
+    def stop(self, name: str) -> bool:
+        spec = self._specs.get(name)
+        if spec is None:
+            return False
+        spec.enabled = False
+        f = self._files.pop(name, None)
+        if f:
+            f.close()
+        return True
+
+    def delete(self, name: str) -> bool:
+        self.stop(name)
+        if self._specs.pop(name, None) is None:
+            return False
+        try:
+            os.unlink(self.filepath(name))
+        except OSError:
+            pass
+        return True
+
+    def list(self) -> List[Dict]:
+        now = time.time()
+        return [
+            {
+                "name": s.name,
+                "type": s.type,
+                s.type: s.value,
+                "status": s.status(now),
+                "start_at": s.start_at,
+                "end_at": s.end_at,
+            }
+            for s in self._specs.values()
+        ]
+
+    def filepath(self, name: str) -> str:
+        import zlib
+
+        # hash suffix keeps distinct names distinct after sanitization
+        # (e.g. 'a/b' vs 'a_b')
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        tag = zlib.crc32(name.encode()) & 0xFFFFFFFF
+        return os.path.join(self.base_dir, f"trace_{safe}_{tag:08x}.log")
+
+    def read(self, name: str) -> Optional[str]:
+        if name not in self._specs:
+            return None
+        f = self._files.get(name)
+        if f:
+            f.flush()
+        try:
+            with open(self.filepath(name), encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return ""
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+    # -- logging -----------------------------------------------------------
+    def log(self, event: str, meta: Dict) -> None:
+        now = time.time()
+        line = None
+        for name, spec in self._specs.items():
+            if spec.status(now) != "running" or not spec.matches(meta):
+                continue
+            f = self._files.get(name)
+            if f is None:
+                continue
+            if line is None:
+                ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(now))
+                kv = " ".join(
+                    f"{k}: {v}" for k, v in meta.items() if v is not None
+                )
+                line = f"{ts}.{int(now * 1000) % 1000:03d} [{event}] {kv}\n"
+            f.write(line)
+            f.flush()
+
+    # -- hook wiring (the reference traces these ops inline) ----------------
+    def attach(self, hooks) -> None:
+        def payload_preview(msg):
+            p = msg.payload[:64]
+            try:
+                return p.decode("utf-8")
+            except UnicodeDecodeError:
+                return p.hex()
+
+        def on_publish(msg, acc=None):
+            self.log(
+                "PUBLISH",
+                {
+                    "clientid": msg.from_client or None,
+                    "topic": msg.topic,
+                    "qos": msg.qos,
+                    "retain": msg.retain,
+                    "payload": payload_preview(msg),
+                },
+            )
+            return acc if acc is not None else msg
+
+        def on_subscribed(ci, topic, opts, _ch=None):
+            self.log(
+                "SUBSCRIBE",
+                {
+                    "clientid": ci.get("client_id"),
+                    "peerhost": ci.get("peerhost"),
+                    "topic": topic,
+                    "qos": getattr(opts, "qos", 0),
+                },
+            )
+
+        def on_unsubscribed(ci, topic):
+            self.log(
+                "UNSUBSCRIBE",
+                {
+                    "clientid": ci.get("client_id"),
+                    "peerhost": ci.get("peerhost"),
+                    "topic": topic,
+                },
+            )
+
+        def on_connected(ci, _ch):
+            self.log(
+                "CONNECT",
+                {
+                    "clientid": ci.get("client_id"),
+                    "username": ci.get("username"),
+                    "peerhost": ci.get("peerhost"),
+                },
+            )
+
+        def on_disconnected(ci, reason):
+            self.log(
+                "DISCONNECT",
+                {
+                    "clientid": ci.get("client_id"),
+                    "peerhost": ci.get("peerhost"),
+                    "reason": reason,
+                },
+            )
+
+        def on_delivered(ci, msg):
+            self.log(
+                "DELIVER",
+                {
+                    "clientid": ci.get("client_id"),
+                    "topic": msg.topic,
+                    "qos": msg.qos,
+                    "payload": payload_preview(msg),
+                },
+            )
+
+        hooks.add("message.publish", on_publish, priority=90, tag="trace")
+        hooks.add("session.subscribed", on_subscribed, tag="trace")
+        hooks.add("session.unsubscribed", on_unsubscribed, tag="trace")
+        hooks.add("client.connected", on_connected, tag="trace")
+        hooks.add("client.disconnected", on_disconnected, tag="trace")
+        hooks.add("message.delivered", on_delivered, tag="trace")

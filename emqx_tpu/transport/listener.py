@@ -67,10 +67,13 @@ class Listener:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # cancel live connection handlers BEFORE wait_closed: since 3.12
+        # Server.wait_closed blocks until every handler returns
         for t in list(self._conns):
             t.cancel()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
 
     async def _on_client(self, reader, writer) -> None:
         if len(self._conns) >= self.config.max_connections:

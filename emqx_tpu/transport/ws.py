@@ -170,10 +170,11 @@ class WsListener:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
         for t in list(self._conns):
             t.cancel()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
 
     async def _on_ws(self, ws) -> None:
         if len(self._conns) >= self.config.max_connections:
