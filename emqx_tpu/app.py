@@ -54,7 +54,48 @@ class BrokerApp:
         self.broker.shared = SharedSub(strategy=c.shared_subscription.strategy)
         self.cm = ChannelManager(self.broker)
         self.channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
-        self.listeners = Listeners(self.broker, self.cm)
+        # rate limiting + overload protection (reference: emqx_limiter,
+        # emqx_olp; wired into listeners like the esockd limiter adapter)
+        from emqx_tpu.broker.limiter import LimiterServer
+        from emqx_tpu.broker.olp import Olp
+        from emqx_tpu.transport.listener import TransportContext
+
+        self.limiters = LimiterServer(c.limiter)
+        self.olp = Olp(
+            enable=c.olp.enable,
+            lag_watermark_ms=c.olp.lag_watermark_ms,
+            cooldown=c.olp.cooldown,
+        )
+        if c.force_gc.enable:
+            from emqx_tpu.transport.congestion import ForcedGC
+
+            _gc_count, _gc_bytes = c.force_gc.count, c.force_gc.bytes
+            make_forced_gc = lambda: ForcedGC(_gc_count, _gc_bytes)  # noqa: E731
+        else:
+            make_forced_gc = None
+        self.transport_ctx = TransportContext(
+            limiters=self.limiters,
+            olp=self.olp,
+            alarms=None,  # filled in below once AlarmManager exists
+            make_forced_gc=make_forced_gc,
+        )
+        self.listeners = Listeners(self.broker, self.cm, ctx=self.transport_ctx)
+        if self.limiters.limited("message_routing"):
+            # message_routing limiter: overload-drop at the publish gate
+            # (the reference's routing limiter sheds load rather than queue)
+            routing_limiter = self.limiters.connect("message_routing")
+
+            def _routing_gate(msg, acc=None):
+                m = acc if acc is not None else msg
+                if not routing_limiter.try_acquire(1):
+                    self.broker.metrics.inc("limiter.dropped.message_routing")
+                    m.headers["allow_publish"] = False
+                return ("ok", m)
+
+            self.hooks.add(
+                "message.publish", _routing_gate, priority=1000,
+                tag="limiter.message_routing",
+            )
 
         # extensions (reference L4, SURVEY.md §1)
         self.banned = Banned()
@@ -173,6 +214,7 @@ class BrokerApp:
             size_limit=ob.alarm_size_limit,
             validity_period=ob.alarm_validity_period,
         )
+        self.transport_ctx.alarms = self.alarms
         self.sys_mon = SysMon(self.alarms) if ob.sys_mon_enable else None
         self.os_mon = OsMon(self.alarms) if ob.os_mon_enable else None
         self.vm_mon = VmMon(self.alarms) if ob.vm_mon_enable else None
@@ -251,6 +293,7 @@ class BrokerApp:
             self.mgmt_server = MgmtApi(self)
             await self.mgmt_server.start(c.dashboard.bind, c.dashboard.port)
         self.started_at = time.time()
+        self.olp.start()
         if self.statsd is not None:
             self.statsd.start()
         self._tasks = [
@@ -264,6 +307,7 @@ class BrokerApp:
             t.cancel()
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.olp.stop()
         if self.statsd is not None:
             await self.statsd.stop()
         if self.mgmt_server is not None:

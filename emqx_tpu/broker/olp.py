@@ -1,0 +1,65 @@
+"""Overload protection (reference: apps/emqx/src/emqx_olp.erl + the `lc`
+dependency's load_ctl, SURVEY.md §2.1).
+
+The reference gates expensive work on `load_ctl:is_overloaded()` (BEAM
+runqueue pressure) and backs off GC/hibernation/new connections. The
+asyncio analog of runqueue pressure is event-loop lag: a sampler task
+measures how late its own timer fires; sustained lag above the watermark
+flips `is_overloaded()`, and the listener refuses new connections while it
+holds (priority_connection semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+
+class Olp:
+    def __init__(
+        self,
+        enable: bool = True,
+        lag_watermark_ms: float = 500.0,
+        sample_interval: float = 0.1,
+        cooldown: float = 5.0,
+    ):
+        self.enable = enable
+        self.lag_watermark_ms = lag_watermark_ms
+        self.sample_interval = sample_interval
+        self.cooldown = cooldown
+        self.last_lag_ms = 0.0
+        self._overloaded_until = 0.0
+        self._task: Optional[asyncio.Task] = None
+        # stats for $SYS / REST
+        self.trip_count = 0
+
+    def is_overloaded(self) -> bool:
+        return self.enable and time.monotonic() < self._overloaded_until
+
+    def note_lag(self, lag_ms: float) -> None:
+        self.last_lag_ms = lag_ms
+        if lag_ms > self.lag_watermark_ms:
+            if not self.is_overloaded():
+                self.trip_count += 1
+            self._overloaded_until = time.monotonic() + self.cooldown
+
+    async def _sampler(self) -> None:
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(self.sample_interval)
+            lag_ms = (time.monotonic() - t0 - self.sample_interval) * 1000.0
+            self.note_lag(max(0.0, lag_ms))
+
+    def start(self) -> None:
+        if self.enable and self._task is None:
+            self._task = asyncio.ensure_future(self._sampler())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -140,6 +140,20 @@ class DashboardConfig:
 
 
 @dataclass
+class OlpConfig:
+    enable: bool = False
+    lag_watermark_ms: float = 500.0
+    cooldown: float = 5.0
+
+
+@dataclass
+class ForceGcConfig:
+    enable: bool = True
+    count: int = 16000
+    bytes: int = 16 * 1024 * 1024
+
+
+@dataclass
 class SlowSubsConfig:
     enable: bool = True
     threshold_ms: float = 500.0
@@ -218,6 +232,11 @@ class AppConfig:
     shared_subscription: SharedSubConfig = field(default_factory=SharedSubConfig)
     sys: SysConfig = field(default_factory=SysConfig)
     observe: ObserveConfig = field(default_factory=ObserveConfig)
+    # {type: {rate, burst, client: {rate, burst}}}; types: bytes_in,
+    # message_in, connection, message_routing (emqx_limiter schema analog)
+    limiter: Dict[str, Any] = field(default_factory=dict)
+    olp: OlpConfig = field(default_factory=OlpConfig)
+    force_gc: ForceGcConfig = field(default_factory=ForceGcConfig)
     dashboard: DashboardConfig = field(default_factory=DashboardConfig)
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
     rules: List[RuleSpec] = field(default_factory=list)
@@ -341,6 +360,13 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.authz.no_match not in ("allow", "deny"):
         raise ConfigError("authz.no_match must be allow|deny")
+    from emqx_tpu.broker.limiter import TYPES as _LIMITER_TYPES
+
+    for lt in cfg.limiter:
+        if lt not in _LIMITER_TYPES:
+            raise ConfigError(
+                f"unknown limiter type {lt!r} (one of {_LIMITER_TYPES})"
+            )
     if cfg.authz.deny_action not in ("ignore", "disconnect"):
         raise ConfigError("authz.deny_action must be ignore|disconnect")
     if not 0 <= cfg.mqtt.max_qos_allowed <= 2:

@@ -21,7 +21,7 @@ from emqx_tpu.mqtt.frame import FrameError, Parser, serialize
 class Connection:
     """One connected socket; owns the parser, the channel, and timers."""
 
-    def __init__(self, broker, cm, reader, writer, config: ChannelConfig):
+    def __init__(self, broker, cm, reader, writer, config: ChannelConfig, ctx=None):
         self.reader = reader
         self.writer = writer
         peer = writer.get_extra_info("peername") or ("?", 0)
@@ -36,6 +36,22 @@ class Connection:
         self.last_rx = time.time()
         self._closing = False
         self._tasks: list = []
+        # rate limiting / congestion / forced GC (TransportContext wiring)
+        self.limiters = None
+        self.congestion = None
+        self.forced_gc = None
+        if ctx is not None:
+            if ctx.limiters is not None:
+                # None when all types are unlimited -> zero hot-path cost
+                self.limiters = ctx.limiters.container(
+                    "bytes_in", "message_in"
+                )
+            if ctx.alarms is not None:
+                from emqx_tpu.transport.congestion import Congestion
+
+                self.congestion = Congestion(alarms=ctx.alarms)
+            if ctx.make_forced_gc is not None:
+                self.forced_gc = ctx.make_forced_gc()
 
     # -- sink interface used by the channel -------------------------------
     def send_packet(self, p) -> None:
@@ -65,8 +81,21 @@ class Connection:
                 if not data:
                     break
                 self.last_rx = time.time()
+                if self.forced_gc is not None:
+                    self.forced_gc.inc(0, len(data))
+                if self.limiters is not None:
+                    # bytes_in: pause the read loop until tokens accrue
+                    # (emqx_connection rate-limit pause, :103-120)
+                    await self._limited("bytes_in", len(data))
                 try:
                     for p in self.parser.feed(data):
+                        if (
+                            self.limiters is not None
+                            and p.type == pkt.PUBLISH
+                        ):
+                            await self._limited("message_in", 1)
+                        if self.forced_gc is not None:
+                            self.forced_gc.inc(1, 0)
                         self.channel.handle_in(p)
                 except FrameError as e:
                     self.channel.disconnect_reason = f"frame_error:{e.reason}"
@@ -81,12 +110,26 @@ class Connection:
         finally:
             keeper.cancel()
             ticker.cancel()
+            if self.congestion is not None:
+                self.congestion.on_close(self.channel.client_id)
             self.close("sock_closed")
             try:
                 await self.writer.wait_closed()
             except Exception:
                 pass
             self.channel.on_sock_closed()
+
+    async def _limited(self, type_: str, n: float) -> None:
+        """Charge the limiter and pause for the returned interval.
+
+        The charge always lands (token debt), so sustained throughput
+        converges on the configured rate for any chunk size. The pause is
+        counted as liveness — the client IS sending, we are throttling it —
+        so keepalive must not fire mid-throttle."""
+        wait = self.limiters.consume(type_, n)
+        if wait > 0:
+            await asyncio.sleep(wait)
+            self.last_rx = time.time()
 
     async def _drain(self) -> None:
         try:
@@ -120,3 +163,8 @@ class Connection:
             if self.channel.state == "connected":
                 self.channel.tick()
                 await self._drain()
+            if self.congestion is not None:
+                self.congestion.check(
+                    getattr(self.writer, "transport", None),
+                    self.channel.client_id,
+                )

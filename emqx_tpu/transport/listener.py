@@ -17,6 +17,47 @@ from emqx_tpu.transport.connection import Connection
 
 
 @dataclass
+class TransportContext:
+    """Cross-cutting services every connection shares: rate limiting,
+    overload gate, alarms, forced-GC factory (reference: esockd limiter
+    adapter + emqx_olp + emqx_congestion wiring in emqx_connection.erl)."""
+
+    limiters: object = None  # LimiterServer
+    olp: object = None  # Olp
+    alarms: object = None  # AlarmManager
+    make_forced_gc: object = None  # Optional[Callable[[], ForcedGC]]
+
+
+class AdmissionControl:
+    """Shared accept-time gate: max-connections + OLP + connection-rate
+    limiter; refuse-don't-queue (used by both TCP and WS listeners)."""
+
+    def __init__(self, ctx: Optional[TransportContext], metrics):
+        self.ctx = ctx
+        self.metrics = metrics
+        self._conn_limiter = (
+            ctx.limiters.connect("connection")
+            if ctx is not None and ctx.limiters is not None
+            else None
+        )
+
+    def admit(self, current: int, maximum: int) -> bool:
+        if current >= maximum:
+            return False
+        if self.ctx is not None and self.ctx.olp is not None \
+                and self.ctx.olp.is_overloaded():
+            self.metrics.inc("olp.refused")
+            return False
+        if (
+            self._conn_limiter is not None
+            and not self._conn_limiter.try_acquire(1)
+        ):
+            self.metrics.inc("limiter.refused.connection")
+            return False
+        return True
+
+
+@dataclass
 class ListenerConfig:
     name: str = "default"
     type: str = "tcp"  # tcp | ssl | ws | wss
@@ -41,11 +82,20 @@ def build_ssl_context(config: "ListenerConfig") -> ssl_mod.SSLContext:
 
 
 class Listener:
-    def __init__(self, broker, cm, config: ListenerConfig, channel_config=None):
+    def __init__(
+        self,
+        broker,
+        cm,
+        config: ListenerConfig,
+        channel_config=None,
+        ctx: Optional[TransportContext] = None,
+    ):
         self.broker = broker
         self.cm = cm
         self.config = config
         self.channel_config = channel_config or ChannelConfig()
+        self.ctx = ctx
+        self._admission = AdmissionControl(ctx, broker.metrics)
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
 
@@ -76,10 +126,15 @@ class Listener:
             self._server = None
 
     async def _on_client(self, reader, writer) -> None:
-        if len(self._conns) >= self.config.max_connections:
+        if not self._admission.admit(
+            len(self._conns), self.config.max_connections
+        ):
             writer.close()
             return
-        conn = Connection(self.broker, self.cm, reader, writer, self.channel_config)
+        conn = Connection(
+            self.broker, self.cm, reader, writer, self.channel_config,
+            ctx=self.ctx,
+        )
         task = asyncio.current_task()
         self._conns.add(task)
         try:
@@ -91,9 +146,10 @@ class Listener:
 class Listeners:
     """Registry of named listeners (emqx_listeners API parity)."""
 
-    def __init__(self, broker, cm):
+    def __init__(self, broker, cm, ctx: Optional[TransportContext] = None):
         self.broker = broker
         self.cm = cm
+        self.ctx = ctx
         self._listeners: Dict[str, Listener] = {}
 
     async def start_listener(
@@ -106,10 +162,13 @@ class Listeners:
             from emqx_tpu.transport.ws import WsListener
 
             l = WsListener(
-                self.broker, self.cm, config, channel_config or ChannelConfig()
+                self.broker, self.cm, config,
+                channel_config or ChannelConfig(), ctx=self.ctx,
             )
         else:
-            l = Listener(self.broker, self.cm, config, channel_config)
+            l = Listener(
+                self.broker, self.cm, config, channel_config, ctx=self.ctx
+            )
         await l.start()
         self._listeners[key] = l
         return l

@@ -125,11 +125,15 @@ class _WsStream:
 class WsListener:
     """A ws/wss listener feeding the shared Connection pump."""
 
-    def __init__(self, broker, cm, config, channel_config):
+    def __init__(self, broker, cm, config, channel_config, ctx=None):
         self.broker = broker
         self.cm = cm
         self.config = config
         self.channel_config = channel_config
+        self.ctx = ctx
+        from emqx_tpu.transport.listener import AdmissionControl
+
+        self._admission = AdmissionControl(ctx, broker.metrics)
         self._server = None
         self._conns: set = set()
 
@@ -177,11 +181,16 @@ class WsListener:
             self._server = None
 
     async def _on_ws(self, ws) -> None:
-        if len(self._conns) >= self.config.max_connections:
+        if not self._admission.admit(
+            len(self._conns), self.config.max_connections
+        ):
             await ws.close(code=1013)  # try again later
             return
         stream = _WsStream(ws)
-        conn = Connection(self.broker, self.cm, stream, stream, self.channel_config)
+        conn = Connection(
+            self.broker, self.cm, stream, stream, self.channel_config,
+            ctx=self.ctx,
+        )
         task = asyncio.current_task()
         self._conns.add(task)
         try:

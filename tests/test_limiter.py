@@ -1,0 +1,300 @@
+"""Limiter / OLP / forced-GC / congestion tests.
+
+Parity targets: emqx_limiter CT suites (hierarchical token bucket with root
++ per-client buckets), emqx_olp overload gate, emqx_gc counters,
+emqx_congestion alarms (SURVEY.md §2.1).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.broker.limiter import (
+    BucketConfig,
+    LimiterServer,
+    TokenBucket,
+)
+from emqx_tpu.broker.olp import Olp
+from emqx_tpu.observe.alarm import AlarmManager
+from emqx_tpu.transport.congestion import Congestion, ForcedGC
+from tests.test_broker_e2e import async_test
+
+
+# -- token bucket ----------------------------------------------------------
+
+def test_token_bucket_consume_and_refill():
+    b = TokenBucket(rate=10.0, capacity=10.0)
+    assert b.consume(10, now=0.0) == 0.0  # full burst
+    wait = b.consume(5, now=0.0)
+    assert wait == pytest.approx(0.5)  # 5 tokens of debt at 10/s
+    # refill repays the debt: at t=0.5 tokens are back to 0, so another
+    # consume(5) re-enters debt by exactly 0.5s
+    assert b.consume(5, now=0.5) == pytest.approx(0.5)
+    # oversize request: charged fully as debt -> pause covers the excess,
+    # so sustained throughput equals the configured rate (no 64x leak)
+    big = TokenBucket(rate=10.0, capacity=10.0)
+    assert big.consume(110, now=0.0) == pytest.approx(10.0)
+    assert big.consume(10, now=10.0) == pytest.approx(1.0)
+
+
+def test_token_bucket_try_acquire_no_debt():
+    b = TokenBucket(rate=10.0, capacity=10.0)
+    assert b.try_acquire(10, now=0.0)
+    assert not b.try_acquire(1, now=0.0)  # refused, no debt
+    assert b.tokens == pytest.approx(0.0)
+    assert b.try_acquire(5, now=0.5)  # refilled 5
+
+
+def test_limiter_server_root_and_client_buckets():
+    srv = LimiterServer(
+        {
+            "message_in": {
+                "rate": 100,
+                "burst": 100,
+                "client": {"rate": 10, "burst": 10},
+            }
+        }
+    )
+    a = srv.connect("message_in")
+    b = srv.connect("message_in")
+    # client bucket caps each connection at 10 burst
+    for _ in range(10):
+        assert a.consume(1) == 0.0
+    assert a.consume(1) > 0.0  # a's private bucket empty
+    assert b.consume(1) == 0.0  # b unaffected
+    # unlimited type
+    u = srv.connect("bytes_in")
+    assert u.unlimited and u.consume(10**9) == 0.0
+
+
+def test_limiter_shared_root_exhaustion():
+    srv = LimiterServer({"connection": {"rate": 5, "burst": 5}})
+    clients = [srv.connect("connection") for _ in range(3)]
+    ok = sum(1 for i in range(10) if clients[i % 3].consume(1) == 0.0)
+    assert ok == 5  # root allows exactly burst across all clients
+
+
+def test_limiter_client_pause_is_max_of_both_buckets():
+    srv = LimiterServer(
+        {
+            "message_in": {
+                "rate": 1,
+                "burst": 1,
+                "client": {"rate": 100, "burst": 100},
+            }
+        }
+    )
+    c = srv.connect("message_in")
+    assert c.consume(1) == 0.0
+    # root (1/s) is the slower parent: its debt dominates the pause
+    assert c.consume(1) == pytest.approx(1.0, abs=0.1)
+
+
+def test_limiter_try_acquire_root_refusal_restores_local():
+    srv = LimiterServer(
+        {
+            "connection": {
+                "rate": 1,
+                "burst": 1,
+                "client": {"rate": 100, "burst": 100},
+            }
+        }
+    )
+    c = srv.connect("connection")
+    assert c.try_acquire(1)
+    local_before = c._local.tokens
+    assert not c.try_acquire(1)  # root empty -> refuse, local restored
+    assert c._local.tokens == pytest.approx(local_before, abs=0.1)
+
+
+def test_limiter_container_none_when_unlimited():
+    srv = LimiterServer({})
+    assert srv.container("bytes_in", "message_in") is None
+    srv2 = LimiterServer({"message_in": {"rate": 5}})
+    assert srv2.container("bytes_in", "message_in") is not None
+
+
+def test_limiter_server_rejects_unknown_type():
+    with pytest.raises(ValueError):
+        LimiterServer({"bogus": {"rate": 1}})
+
+
+def test_bucket_config_unlimited():
+    assert BucketConfig().unlimited
+    assert not BucketConfig(rate=1).unlimited
+    assert BucketConfig(rate=5, burst=0).capacity == 5
+
+
+# -- OLP -------------------------------------------------------------------
+
+def test_olp_trip_and_cooldown():
+    olp = Olp(enable=True, lag_watermark_ms=100.0, cooldown=0.2)
+    assert not olp.is_overloaded()
+    olp.note_lag(50.0)
+    assert not olp.is_overloaded()
+    olp.note_lag(150.0)
+    assert olp.is_overloaded()
+    assert olp.trip_count == 1
+    time.sleep(0.25)
+    assert not olp.is_overloaded()
+    disabled = Olp(enable=False)
+    disabled.note_lag(10_000)
+    assert not disabled.is_overloaded()
+
+
+# -- forced GC -------------------------------------------------------------
+
+def test_forced_gc_triggers_on_count_and_bytes():
+    g = ForcedGC(count=3, bytes_=1000)
+    assert not g.inc(1, 0)
+    assert not g.inc(1, 0)
+    assert g.inc(1, 0)  # count limit
+    assert g.inc(0, 1500)  # bytes limit
+    assert g.collections == 2
+    off = ForcedGC(count=0, bytes_=0)
+    assert not off.inc(10**9, 10**9)
+
+
+# -- congestion ------------------------------------------------------------
+
+class _FakeTransport:
+    def __init__(self):
+        self.size = 0
+
+    def get_write_buffer_size(self):
+        return self.size
+
+
+def test_congestion_alarm_raise_and_clear():
+    am = AlarmManager()
+    cg = Congestion(
+        alarms=am, high_watermark=100, low_watermark=10, min_alarm_interval=0
+    )
+    tr = _FakeTransport()
+    cg.check(tr, "c1")
+    assert not am.is_active("conn_congestion/c1")
+    tr.size = 500
+    cg.check(tr, "c1")
+    assert am.is_active("conn_congestion/c1")
+    tr.size = 5
+    cg.check(tr, "c1")
+    assert not am.is_active("conn_congestion/c1")
+    # on_close clears a still-raised alarm
+    tr.size = 500
+    cg.check(tr, "c1")
+    assert am.is_active("conn_congestion/c1")
+    cg.on_close("c1")
+    assert not am.is_active("conn_congestion/c1")
+
+
+# -- end-to-end: limiter throttles a live connection -----------------------
+
+@async_test
+async def test_message_in_limiter_throttles_publish_rate():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.mqtt.client import Client
+    from emqx_tpu.transport.listener import (
+        ListenerConfig,
+        Listeners,
+        TransportContext,
+    )
+
+    broker = Broker(hooks=Hooks())
+    cm = ChannelManager(broker)
+    ctx = TransportContext(
+        limiters=LimiterServer(
+            {"message_in": {"client": {"rate": 20, "burst": 5}}}
+        )
+    )
+    listeners = Listeners(broker, cm, ctx=ctx)
+    l = await listeners.start_listener(
+        ListenerConfig(bind="127.0.0.1", port=0)
+    )
+    try:
+        pub = Client("throttled")
+        await pub.connect("127.0.0.1", l.port)
+        sub = Client("watcher")
+        await sub.connect("127.0.0.1", l.port)
+        await sub.subscribe("lim/#")
+        t0 = time.monotonic()
+        for i in range(15):
+            await pub.publish(f"lim/{i}", b"x", qos=1, timeout=20)
+        elapsed = time.monotonic() - t0
+        # burst 5 free, then 10 more at 20/s => >= ~0.4s
+        assert elapsed >= 0.35, elapsed
+        for _ in range(15):
+            await sub.recv(10)
+        await pub.disconnect()
+        await sub.disconnect()
+    finally:
+        await listeners.stop_all()
+
+
+@async_test
+async def test_connection_limiter_refuses_excess_connects():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.mqtt.client import Client, MqttError
+    from emqx_tpu.transport.listener import (
+        ListenerConfig,
+        Listeners,
+        TransportContext,
+    )
+
+    broker = Broker(hooks=Hooks())
+    cm = ChannelManager(broker)
+    ctx = TransportContext(
+        limiters=LimiterServer({"connection": {"rate": 0.001, "burst": 2}})
+    )
+    listeners = Listeners(broker, cm, ctx=ctx)
+    l = await listeners.start_listener(
+        ListenerConfig(bind="127.0.0.1", port=0)
+    )
+    try:
+        c1, c2 = Client("l1"), Client("l2")
+        await c1.connect("127.0.0.1", l.port)
+        await c2.connect("127.0.0.1", l.port)
+        c3 = Client("l3")
+        with pytest.raises((MqttError, ConnectionError, asyncio.TimeoutError)):
+            await c3.connect("127.0.0.1", l.port, timeout=2)
+        assert broker.metrics.get("limiter.refused.connection") >= 1
+        await c1.disconnect()
+        await c2.disconnect()
+        await c3.close()
+    finally:
+        await listeners.stop_all()
+
+
+@async_test
+async def test_olp_refuses_connections_when_overloaded():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.mqtt.client import Client, MqttError
+    from emqx_tpu.transport.listener import (
+        ListenerConfig,
+        Listeners,
+        TransportContext,
+    )
+
+    broker = Broker(hooks=Hooks())
+    cm = ChannelManager(broker)
+    olp = Olp(enable=True, lag_watermark_ms=100.0, cooldown=30.0)
+    olp.note_lag(1000.0)  # force overload
+    ctx = TransportContext(olp=olp)
+    listeners = Listeners(broker, cm, ctx=ctx)
+    l = await listeners.start_listener(
+        ListenerConfig(bind="127.0.0.1", port=0)
+    )
+    try:
+        c = Client("refused")
+        with pytest.raises((MqttError, ConnectionError, asyncio.TimeoutError)):
+            await c.connect("127.0.0.1", l.port, timeout=2)
+        assert broker.metrics.get("olp.refused") >= 1
+        await c.close()
+    finally:
+        await listeners.stop_all()
