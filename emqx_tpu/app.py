@@ -257,6 +257,29 @@ class BrokerApp:
             else None
         )
 
+        # durability (persistent sessions + disc-copies analog, SURVEY §5.4)
+        if c.durability.enable:
+            from emqx_tpu.broker.persistent_session import (
+                DurableState,
+                SessionPersistence,
+            )
+            from emqx_tpu.storage.kv import FileKv
+
+            kv = FileKv(c.durability.data_dir, fsync=c.durability.fsync)
+            self.session_persistence = SessionPersistence(
+                self.broker, self.cm, kv, self.channel_config.session
+            )
+            self.session_persistence.attach(self.hooks)
+            self.durable_state = DurableState(
+                kv,
+                retainer=self.retainer if c.retainer.enable else None,
+                delayed=self.delayed if c.delayed.enable else None,
+                banned=self.banned,
+            )
+        else:
+            self.session_persistence = None
+            self.durable_state = None
+
         self.mgmt_server = None  # set by start() when dashboard.enable
         self._tasks: List[asyncio.Task] = []
         self.started_at: Optional[float] = None
@@ -272,6 +295,13 @@ class BrokerApp:
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         c = self.config
+        # restore durable state BEFORE listeners accept clients
+        if self.session_persistence is not None:
+            restored = self.session_persistence.restore()
+            if restored:
+                self.broker.metrics.gauge_set("sessions.restored", restored)
+        if self.durable_state is not None:
+            self.durable_state.restore()
         for spec in c.listeners:
             await self.listeners.start_listener(
                 ListenerConfig(
@@ -313,6 +343,13 @@ class BrokerApp:
         if self.mgmt_server is not None:
             await self.mgmt_server.stop()
         await self.listeners.stop_all()
+        # final checkpoint AFTER listeners close: connection teardown parks
+        # live persistent sessions into cm._detached, so the snapshot
+        # includes clients that were still connected at shutdown
+        if self.session_persistence is not None:
+            self.session_persistence.flush(force=True)
+        if self.durable_state is not None:
+            self.durable_state.flush()
         if self.sys_mon is not None:
             self.sys_mon.close()
         self.trace.close()
@@ -322,6 +359,7 @@ class BrokerApp:
 
         c = self.config
         last_retainer_sweep = 0.0
+        last_durability_flush = time.time()
         while True:
             await asyncio.sleep(1.0)
             try:
@@ -343,6 +381,17 @@ class BrokerApp:
                 self.slow_subs.sweep(now)
                 self.alarms.sweep(now)
                 self.topic_metrics.tick_rates(now)
+                if (
+                    self.session_persistence is not None
+                    and now - last_durability_flush
+                    >= c.durability.flush_interval
+                ):
+                    # non-forced: flush() itself knows when a write is
+                    # needed (lifecycle hooks fired or detached queues live)
+                    self.session_persistence.flush()
+                    if self.durable_state is not None:
+                        self.durable_state.flush()
+                    last_durability_flush = now
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -75,5 +75,14 @@ class DelayedPublish:
     def pending(self) -> List[Tuple[float, Message]]:
         return [(due, m) for due, _, m in sorted(self._heap)]
 
+    def load(self, due: float, msg: Message) -> bool:
+        """Direct insert for durable-state restore; honors the cap."""
+        if self.max_messages and len(self._heap) >= self.max_messages:
+            self.dropped += 1
+            return False
+        self._seq += 1
+        heapq.heappush(self._heap, (due, self._seq, msg))
+        return True
+
     def attach(self, hooks: Hooks) -> None:
         hooks.add("message.publish", self.intercept, priority=200)

@@ -1,0 +1,211 @@
+"""Persistent sessions + session router.
+
+Reference parity (SURVEY.md §2.1 emqx_persistent_session*/emqx_session_router,
+§5.4(ii)):
+- opt-in persistence for sessions with expiry_interval > 0: session
+  metadata, subscriptions, and pending (undelivered) messages survive a
+  broker restart (the reference persists messages at publish,
+  emqx_broker.erl:213, against per-session undelivered/delivered/marker
+  records; here the unit of durability is a session snapshot — pending
+  queue + inflight — checkpointed on detach and on a flush interval)
+- the **session router** is the separate route table the reference keeps
+  for persistent sessions (emqx_session_router.erl): after a restart no
+  channel exists, so restored sessions are re-attached to the broker with a
+  detached deliverer that banks matched messages into the session mqueue
+  until the client resumes (`resume_begin/resume_end` collapse to the
+  in-process takeover handshake on a single node)
+- durable broker state: retained messages, delayed messages, and the ban
+  table snapshot/restore through the same FileKv (mnesia disc_copies
+  analog, §5.4(iii)).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.storage.codec import (
+    msg_from_json,
+    msg_to_json,
+    session_from_json,
+    session_to_json,
+)
+from emqx_tpu.storage.kv import FileKv
+
+NS_SESSIONS = "persistent_sessions"
+NS_RETAINED = "retained"
+NS_DELAYED = "delayed"
+NS_BANNED = "banned"
+
+
+def make_detached_deliverer(session):
+    """Deliverer for a session with no live channel: bank QoS1/2 messages
+    in the session queue for replay at resume (the reference's
+    'undelivered' records)."""
+
+    def deliver(msg: Message, opts: pkt.SubOpts) -> None:
+        qos = min(msg.qos, opts.qos)
+        if qos == 0:
+            return  # QoS0 to an offline session is dropped (spec behavior)
+        import copy
+
+        m = copy.copy(msg)
+        m.qos = qos
+        session.mqueue.in_(m)
+
+    return deliver
+
+
+class SessionPersistence:
+    """Checkpoints detached sessions; restores them (with routes) at boot."""
+
+    def __init__(self, broker, cm, kv: FileKv, session_config):
+        self.broker = broker
+        self.cm = cm
+        self.kv = kv
+        self.session_config = session_config
+        self._dirty = False
+
+    # -- hook + cm integration --------------------------------------------
+    def attach(self, hooks) -> None:
+        hooks.add(
+            "client.disconnected", self._on_disconnected, tag="persistence"
+        )
+        for hp in (
+            "session.discarded",
+            "session.terminated",
+            "session.resumed",
+            "session.takenover",
+        ):
+            hooks.add(hp, self._mark_dirty_any, tag="persistence")
+
+    def _on_disconnected(self, ci, reason) -> None:
+        self._dirty = True
+
+    def _mark_dirty_any(self, *args) -> None:
+        self._dirty = True
+
+    # -- checkpoint --------------------------------------------------------
+    def flush(self, force: bool = False) -> bool:
+        """Snapshot all detached sessions (called from housekeeping and at
+        shutdown).
+
+        Skips the write only when nothing could have changed: no lifecycle
+        transition raised a hook (_dirty) AND there are no detached
+        sessions whose queues mutate hook-free as offline messages bank."""
+        if not (self._dirty or force or self.cm._detached):
+            return False
+        now = time.time()
+        sessions = {}
+        for cid, (sess, deadline) in self.cm._detached.items():
+            snap = session_to_json(sess)
+            snap["deadline"] = deadline
+            sessions[cid] = snap
+        self.kv.write(NS_SESSIONS, {"at": now, "sessions": sessions})
+        self._dirty = False
+        return True
+
+    # -- restore -----------------------------------------------------------
+    def restore(self) -> int:
+        """Rebuild detached sessions + their routes after a restart."""
+        data = self.kv.read(NS_SESSIONS)
+        if not data:
+            return 0
+        now = time.time()
+        n = 0
+        for cid, snap in data.get("sessions", {}).items():
+            deadline = snap.get("deadline", 0)
+            if deadline <= now:
+                continue  # expired while the broker was down
+            sess = session_from_json(snap, self.session_config)
+            deliver = make_detached_deliverer(sess)
+            for f, opts in sess.subscriptions.items():
+                self.broker.subscribe(cid, cid, f, opts, deliver)
+            self.cm._detached[cid] = (sess, deadline)
+            n += 1
+        return n
+
+
+class DurableState:
+    """Retained / delayed / banned snapshot+restore (disc_copies analog)."""
+
+    def __init__(self, kv: FileKv, retainer=None, delayed=None, banned=None):
+        self.kv = kv
+        self.retainer = retainer
+        self.delayed = delayed
+        self.banned = banned
+
+    def flush(self) -> None:
+        if self.retainer is not None:
+            msgs = []
+            for t in self.retainer.topics():
+                m = self.retainer.get(t)
+                if m is not None:
+                    msgs.append(msg_to_json(m))
+            self.kv.write(NS_RETAINED, {"messages": msgs})
+        if self.delayed is not None:
+            self.kv.write(
+                NS_DELAYED,
+                {
+                    "messages": [
+                        {"due": due, "msg": msg_to_json(m)}
+                        for due, m in self.delayed.pending()
+                    ]
+                },
+            )
+        if self.banned is not None:
+            self.kv.write(
+                NS_BANNED,
+                {
+                    "entries": [
+                        {
+                            "kind": e.kind,
+                            "value": e.value,
+                            "reason": e.reason,
+                            "until": e.until,
+                            "by": e.by,
+                        }
+                        for e in self.banned.entries()
+                    ]
+                },
+            )
+
+    def restore(self) -> Dict[str, int]:
+        out = {"retained": 0, "delayed": 0, "banned": 0}
+        if self.retainer is not None:
+            data = self.kv.read(NS_RETAINED)
+            for d in (data or {}).get("messages", []):
+                m = msg_from_json(d)
+                if not m.is_expired():
+                    self.retainer.on_publish(m)
+                    out["retained"] += 1
+        if self.delayed is not None:
+            data = self.kv.read(NS_DELAYED)
+            for d in (data or {}).get("messages", []):
+                m = msg_from_json(d["msg"])
+                if m.is_expired():
+                    continue
+                if self.delayed.load(d["due"], m):
+                    out["delayed"] += 1
+        if self.banned is not None:
+            from emqx_tpu.broker.banned import BanEntry
+
+            data = self.kv.read(NS_BANNED)
+            now = time.time()
+            for d in (data or {}).get("entries", []):
+                if d.get("until") and d["until"] <= now:
+                    continue
+                until = d.get("until")
+                self.banned.add(
+                    BanEntry(
+                        kind=d["kind"],
+                        value=d["value"],
+                        reason=d.get("reason", ""),
+                        until=until if until is not None else float("inf"),
+                        by=d.get("by", "admin"),
+                    )
+                )
+                out["banned"] += 1
+        return out

@@ -140,6 +140,17 @@ class DashboardConfig:
 
 
 @dataclass
+class DurabilityConfig:
+    """Persistent sessions + durable broker state (retained/delayed/banned).
+    Reference: emqx_persistent_session backends + mnesia disc tables."""
+
+    enable: bool = False
+    data_dir: str = "data"
+    flush_interval: float = 5.0
+    fsync: bool = False
+
+
+@dataclass
 class OlpConfig:
     enable: bool = False
     lag_watermark_ms: float = 500.0
@@ -237,6 +248,7 @@ class AppConfig:
     limiter: Dict[str, Any] = field(default_factory=dict)
     olp: OlpConfig = field(default_factory=OlpConfig)
     force_gc: ForceGcConfig = field(default_factory=ForceGcConfig)
+    durability: DurabilityConfig = field(default_factory=DurabilityConfig)
     dashboard: DashboardConfig = field(default_factory=DashboardConfig)
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
     rules: List[RuleSpec] = field(default_factory=list)

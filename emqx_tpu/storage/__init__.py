@@ -1,0 +1,2 @@
+"""Durable broker state (reference: mnesia disc tables — retained msgs,
+delayed msgs, banned, persistent sessions; SURVEY.md §5.4(iii))."""

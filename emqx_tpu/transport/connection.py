@@ -127,9 +127,14 @@ class Connection:
         counted as liveness — the client IS sending, we are throttling it —
         so keepalive must not fire mid-throttle."""
         wait = self.limiters.consume(type_, n)
-        if wait > 0:
-            await asyncio.sleep(wait)
+        # sleep in short slices, refreshing last_rx each one, so keepalive
+        # never fires during a long throttle pause (waits reach 60s)
+        while wait > 0 and not self._closing:
+            step = min(wait, 5.0)
             self.last_rx = time.time()
+            await asyncio.sleep(step)
+            wait -= step
+        self.last_rx = time.time()
 
     async def _drain(self) -> None:
         try:
