@@ -280,6 +280,25 @@ class BrokerApp:
             self.session_persistence = None
             self.durable_state = None
 
+        # exhook gRPC sidecars (reference: emqx_exhook, SURVEY.md §2.2)
+        if c.exhook:
+            from emqx_tpu import __version__
+            from emqx_tpu.exhook.manager import ExhookManager, ExhookServer
+
+            self.exhook = ExhookManager(version=__version__)
+            for spec in c.exhook:
+                self.exhook.add_server(
+                    ExhookServer(
+                        name=spec.name or spec.url,
+                        url=spec.url,
+                        timeout=spec.timeout,
+                        failed_action=spec.failed_action,
+                    )
+                )
+            self.exhook.attach(self.hooks)
+        else:
+            self.exhook = None
+
         self.mgmt_server = None  # set by start() when dashboard.enable
         self._tasks: List[asyncio.Task] = []
         self.started_at: Optional[float] = None
@@ -352,6 +371,8 @@ class BrokerApp:
             self.durable_state.flush()
         if self.sys_mon is not None:
             self.sys_mon.close()
+        if self.exhook is not None:
+            self.exhook.shutdown()
         self.trace.close()
 
     async def _housekeeping(self) -> None:

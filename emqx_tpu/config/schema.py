@@ -140,6 +140,14 @@ class DashboardConfig:
 
 
 @dataclass
+class ExhookServerSpec:
+    name: str = ""
+    url: str = ""  # e.g. 127.0.0.1:9000
+    timeout: float = 0.5
+    failed_action: str = "deny"  # deny | ignore
+
+
+@dataclass
 class DurabilityConfig:
     """Persistent sessions + durable broker state (retained/delayed/banned).
     Reference: emqx_persistent_session backends + mnesia disc tables."""
@@ -249,6 +257,7 @@ class AppConfig:
     olp: OlpConfig = field(default_factory=OlpConfig)
     force_gc: ForceGcConfig = field(default_factory=ForceGcConfig)
     durability: DurabilityConfig = field(default_factory=DurabilityConfig)
+    exhook: List[ExhookServerSpec] = field(default_factory=list)
     dashboard: DashboardConfig = field(default_factory=DashboardConfig)
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
     rules: List[RuleSpec] = field(default_factory=list)

@@ -1,0 +1,8 @@
+"""exhook: out-of-process hook extension over gRPC.
+
+Reference: apps/emqx_exhook (SURVEY.md §2.2) — the broker bridges every
+hookpoint to a gRPC `HookProvider` sidecar, with per-server timeouts,
+fallback actions and per-hook metrics. This is also the designated seam for
+attaching external matchers/processors (the TPU sidecar pattern named in
+SURVEY.md's north star).
+"""

@@ -1,0 +1,446 @@
+"""exhook manager: bridges broker hookpoints to gRPC HookProvider sidecars.
+
+Parity with apps/emqx_exhook/src/emqx_exhook_mgr.erl + emqx_exhook_handler.erl
+(SURVEY.md §2.2): per-server config (url, timeout, failed_action), hook
+registration driven by the provider's OnProviderLoaded response, per-hook
+call/error metrics, deny-or-ignore fallback when the sidecar is down.
+
+Calls are synchronous with a bounded timeout, like the reference's inline
+gRPC calls on the publish path — a deliberately slow sidecar throttles the
+broker, so keep timeouts tight (default 500ms).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import grpc
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.exhook import hookprovider_pb2 as pb
+from emqx_tpu.exhook.rpc import HookProviderStub
+from emqx_tpu.ops import topics as T
+from emqx_tpu.utils.node import node_name
+
+log = logging.getLogger("emqx_tpu.exhook")
+
+ALL_HOOKS = (
+    "client.connect",
+    "client.connack",
+    "client.connected",
+    "client.disconnected",
+    "client.authenticate",
+    "client.authorize",
+    "client.subscribe",
+    "client.unsubscribe",
+    "session.created",
+    "session.subscribed",
+    "session.unsubscribed",
+    "session.resumed",
+    "session.discarded",
+    "session.takenover",
+    "session.terminated",
+    "message.publish",
+    "message.delivered",
+    "message.dropped",
+    "message.acked",
+)
+
+
+def _ci(client_info: Dict) -> pb.ClientInfo:
+    return pb.ClientInfo(
+        node=node_name(),
+        clientid=str(client_info.get("client_id") or ""),
+        username=str(client_info.get("username") or ""),
+        peerhost=str(client_info.get("peerhost") or ""),
+        proto_ver=int(client_info.get("proto_ver") or 0),
+        clean_start=bool(client_info.get("clean_start", True)),
+        keepalive=int(client_info.get("keepalive") or 0),
+    )
+
+
+def _msg_build(m: Message) -> pb.Message:
+    out = pb.Message(
+        id=str(m.mid),
+        topic=m.topic,
+        payload=m.payload,
+        qos=m.qos,
+        retain=m.retain,
+        timestamp_ms=int(m.timestamp * 1000),
+    )
+    # 'from' is a Python keyword; protobuf exposes the field by name via
+    # setattr
+    setattr(out, "from", m.from_client)
+    for k, v in m.headers.items():
+        if isinstance(v, (str, int, float, bool)):
+            out.headers[str(k)] = str(v)
+    return out
+
+
+def _apply_msg(original: Message, p: pb.Message) -> Message:
+    import copy
+
+    m = copy.copy(original)
+    m.topic = p.topic
+    m.payload = p.payload
+    m.qos = p.qos
+    m.retain = p.retain
+    m.headers = dict(original.headers)
+    for k, v in p.headers.items():
+        m.headers[k] = v
+    return m
+
+
+class ExhookServer:
+    """One configured sidecar: channel + stub + hook registration state."""
+
+    def __init__(
+        self,
+        name: str,
+        url: str,
+        timeout: float = 0.5,
+        failed_action: str = "deny",  # deny | ignore
+        pool_size: int = 8,
+    ):
+        if failed_action not in ("deny", "ignore"):
+            raise ValueError("failed_action must be deny|ignore")
+        self.name = name
+        self.url = url
+        self.timeout = timeout
+        self.failed_action = failed_action
+        self.channel = grpc.insecure_channel(url)
+        self.stub = HookProviderStub(self.channel)
+        self.hooks: Dict[str, List[str]] = {}  # hook -> topic filters
+        self.metrics = defaultdict(lambda: {"succeed": 0, "failed": 0})
+        self.loaded = False
+
+    def load(self, version: str) -> bool:
+        """OnProviderLoaded handshake: learn which hooks to bridge."""
+        try:
+            resp = self.stub.OnProviderLoaded(
+                pb.ProviderLoadedRequest(
+                    broker=pb.BrokerInfo(version=version, node=node_name())
+                ),
+                timeout=self.timeout,
+            )
+        except grpc.RpcError as e:
+            log.warning("exhook %s load failed: %s", self.name, e)
+            return False
+        self.hooks = {
+            h.name: list(h.topics)
+            for h in resp.hooks
+            if h.name in ALL_HOOKS
+        }
+        if not self.hooks:
+            # empty response = all hooks (reference default registration)
+            self.hooks = {h: [] for h in ALL_HOOKS}
+        self.loaded = True
+        return True
+
+    def unload(self) -> None:
+        try:
+            self.stub.OnProviderUnloaded(
+                pb.ProviderUnloadedRequest(), timeout=self.timeout
+            )
+        except grpc.RpcError:
+            pass
+        self.loaded = False
+        self.channel.close()
+
+    def topic_interested(self, hook: str, topic: Optional[str]) -> bool:
+        filters = self.hooks.get(hook)
+        if filters is None:
+            return False
+        if not filters or topic is None:
+            return True
+        return any(T.match(topic, f) for f in filters)
+
+    def call(self, method: str, request, hook: str):
+        """-> (ok, response|None); metrics + fallback bookkeeping."""
+        try:
+            resp = getattr(self.stub, method)(request, timeout=self.timeout)
+            self.metrics[hook]["succeed"] += 1
+            return True, resp
+        except grpc.RpcError as e:
+            self.metrics[hook]["failed"] += 1
+            log.debug("exhook %s %s failed: %s", self.name, method, e)
+            return False, None
+
+    def info(self) -> Dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "loaded": self.loaded,
+            "failed_action": self.failed_action,
+            "hooks": dict(self.hooks),
+            "metrics": {k: dict(v) for k, v in self.metrics.items()},
+        }
+
+
+class ExhookManager:
+    def __init__(self, version: str = "0"):
+        self.version = version
+        self.servers: List[ExhookServer] = []
+
+    def add_server(self, server: ExhookServer) -> bool:
+        ok = server.load(self.version)
+        self.servers.append(server)
+        return ok
+
+    def remove_server(self, name: str) -> bool:
+        for s in list(self.servers):
+            if s.name == name:
+                s.unload()
+                self.servers.remove(s)
+                return True
+        return False
+
+    def shutdown(self) -> None:
+        for s in self.servers:
+            s.unload()
+        self.servers.clear()
+
+    def _servers_for(self, hook: str, topic: Optional[str] = None):
+        return [
+            s
+            for s in self.servers
+            if s.loaded and s.topic_interested(hook, topic)
+        ]
+
+    # -- hook bridges ------------------------------------------------------
+    def attach(self, hooks: Hooks) -> None:
+        # lifecycle notifications (fire-and-forget semantics, still sync)
+        def notify(hook, method, build):
+            def cb(*args):
+                for s in self._servers_for(hook):
+                    s.call(method, build(*args), hook)
+
+            hooks.add(hook, cb, tag=f"exhook.{hook}")
+
+        notify(
+            "client.connect",
+            "OnClientConnect",
+            lambda ci, p: pb.ClientConnectRequest(clientinfo=_ci(ci)),
+        )
+        notify(
+            "client.connack",
+            "OnClientConnack",
+            lambda ci, rc: pb.ClientConnackRequest(
+                clientinfo=_ci(ci), result_code=str(rc)
+            ),
+        )
+        notify(
+            "client.connected",
+            "OnClientConnected",
+            lambda ci, ch: pb.ClientConnectedRequest(clientinfo=_ci(ci)),
+        )
+        notify(
+            "client.disconnected",
+            "OnClientDisconnected",
+            lambda ci, reason: pb.ClientDisconnectedRequest(
+                clientinfo=_ci(ci), reason=str(reason)
+            ),
+        )
+        notify(
+            "session.subscribed",
+            "OnSessionSubscribed",
+            lambda ci, f, opts, ch=None: pb.SessionSubscribedRequest(
+                clientinfo=_ci(ci), topic=f, qos=getattr(opts, "qos", 0)
+            ),
+        )
+        notify(
+            "session.unsubscribed",
+            "OnSessionUnsubscribed",
+            lambda ci, f: pb.SessionUnsubscribedRequest(
+                clientinfo=_ci(ci), topic=f
+            ),
+        )
+        for hook, method in (
+            ("session.created", "OnSessionCreated"),
+            ("session.resumed", "OnSessionResumed"),
+            ("session.discarded", "OnSessionDiscarded"),
+            ("session.takenover", "OnSessionTakenover"),
+        ):
+            notify(
+                hook,
+                method,
+                lambda cid, _h=hook: pb.SessionRequest(
+                    clientinfo=pb.ClientInfo(
+                        node=node_name(), clientid=str(cid)
+                    )
+                ),
+            )
+        notify(
+            "session.terminated",
+            "OnSessionTerminated",
+            lambda cid, reason: pb.SessionTerminatedRequest(
+                clientinfo=pb.ClientInfo(
+                    node=node_name(), clientid=str(cid)
+                ),
+                reason=str(reason),
+            ),
+        )
+        notify(
+            "message.delivered",
+            "OnMessageDelivered",
+            lambda ci, m: pb.MessageDeliveredRequest(
+                clientinfo=_ci(ci), message=_msg_build(m)
+            ),
+        )
+        notify(
+            "message.dropped",
+            "OnMessageDropped",
+            lambda m, reason: pb.MessageDroppedRequest(
+                message=_msg_build(m), reason=str(reason)
+            ),
+        )
+
+        def acked_cb(ci, msg_or_pid):
+            if not isinstance(msg_or_pid, Message):
+                return
+            for s in self._servers_for("message.acked", msg_or_pid.topic):
+                s.call(
+                    "OnMessageAcked",
+                    pb.MessageAckedRequest(
+                        clientinfo=_ci(ci), message=_msg_build(msg_or_pid)
+                    ),
+                    "message.acked",
+                )
+
+        hooks.add("message.acked", acked_cb, tag="exhook.message.acked")
+
+        # valued hooks: authenticate / authorize / message.publish
+        hooks.add(
+            "client.authenticate",
+            self._on_authenticate,
+            priority=-100,  # after in-process auth chain
+            tag="exhook.client.authenticate",
+        )
+        hooks.add(
+            "client.authorize",
+            self._on_authorize,
+            priority=-100,
+            tag="exhook.client.authorize",
+        )
+        hooks.add(
+            "message.publish",
+            self._on_message_publish,
+            priority=-100,  # after rewrite/rules so sidecar sees final form
+            tag="exhook.message.publish",
+        )
+
+        def subscribe_cb(ci, filters):
+            # fold contract: acc is the filter list; exhook only observes
+            for s in self._servers_for("client.subscribe"):
+                s.call(
+                    "OnClientSubscribe",
+                    pb.ClientSubscribeRequest(
+                        clientinfo=_ci(ci),
+                        filters=[
+                            pb.TopicFilter(
+                                name=f, qos=getattr(o, "qos", 0)
+                            )
+                            for f, o in filters
+                        ],
+                    ),
+                    "client.subscribe",
+                )
+            return None
+
+        hooks.add("client.subscribe", subscribe_cb, tag="exhook.client.subscribe")
+
+        def unsubscribe_cb(ci, filters):
+            for s in self._servers_for("client.unsubscribe"):
+                s.call(
+                    "OnClientUnsubscribe",
+                    pb.ClientUnsubscribeRequest(
+                        clientinfo=_ci(ci), topics=list(filters)
+                    ),
+                    "client.unsubscribe",
+                )
+            return None
+
+        hooks.add(
+            "client.unsubscribe", unsubscribe_cb,
+            tag="exhook.client.unsubscribe",
+        )
+
+    # fold: (ci, credentials), acc None|{"result":...}
+    def _on_authenticate(self, ci, credentials, acc):
+        for s in self._servers_for("client.authenticate"):
+            pw = credentials.get("password") or b""
+            if isinstance(pw, bytes):
+                pw = pw.decode("utf-8", "replace")
+            ok, resp = s.call(
+                "OnClientAuthenticate",
+                pb.ClientAuthenticateRequest(
+                    clientinfo=_ci(ci), password=pw
+                ),
+                "client.authenticate",
+            )
+            if not ok:
+                if s.failed_action == "deny":
+                    return ("stop", {"result": "deny"})
+                continue
+            if resp.type == pb.ValuedResponse.ResponsedType.STOP_AND_RETURN:
+                if resp.WhichOneof("value") == "bool_result":
+                    verdict = (
+                        {"result": "allow"}
+                        if resp.bool_result
+                        else {"result": "deny"}
+                    )
+                    return ("stop", verdict)
+        return None  # keep acc
+
+    # fold: (ci, action, topic), acc "allow"/"deny"/"disconnect"
+    def _on_authorize(self, ci, action, topic, acc):
+        for s in self._servers_for("client.authorize", topic):
+            ok, resp = s.call(
+                "OnClientAuthorize",
+                pb.ClientAuthorizeRequest(
+                    clientinfo=_ci(ci), type=str(action), topic=topic
+                ),
+                "client.authorize",
+            )
+            if not ok:
+                if s.failed_action == "deny":
+                    return ("stop", "deny")
+                continue
+            if resp.type == pb.ValuedResponse.ResponsedType.STOP_AND_RETURN:
+                if resp.WhichOneof("value") == "bool_result":
+                    return ("stop", "allow" if resp.bool_result else "deny")
+        return None
+
+    # fold: (), acc Message
+    def _on_message_publish(self, acc):
+        m = acc
+        if m is None or m.is_sys():
+            return None
+        for s in self._servers_for("message.publish", m.topic):
+            ok, resp = s.call(
+                "OnMessagePublish",
+                pb.MessagePublishRequest(message=_msg_build(m)),
+                "message.publish",
+            )
+            if not ok:
+                if s.failed_action == "deny":
+                    import copy
+
+                    m2 = copy.copy(m)
+                    m2.headers = dict(m.headers)
+                    m2.headers["allow_publish"] = False
+                    return ("stop", m2)
+                continue
+            if (
+                resp.type == pb.ValuedResponse.ResponsedType.STOP_AND_RETURN
+                and resp.WhichOneof("value") == "message"
+            ):
+                m = _apply_msg(m, resp.message)
+        return ("ok", m)
+
+    def info(self) -> List[Dict]:
+        return [s.info() for s in self.servers]

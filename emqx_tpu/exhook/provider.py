@@ -1,0 +1,68 @@
+"""Helper for implementing a HookProvider sidecar in Python.
+
+The reference ships exhook as protocol-only (providers are user programs);
+this helper is the equivalent of its example SDKs: subclass
+`HookProviderServicer`, override the OnXxx methods you care about, and
+`serve()` it. Also the template for a TPU-side matcher sidecar.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import List, Optional, Tuple
+
+import grpc
+
+from emqx_tpu.exhook import hookprovider_pb2 as pb
+from emqx_tpu.exhook.rpc import add_hook_provider_to_server
+
+
+class HookProviderServicer:
+    """Base class: override the RPCs you need. `hooks` limits which
+    hookpoints the broker bridges (None = all)."""
+
+    hooks: Optional[List[Tuple[str, List[str]]]] = None
+
+    def OnProviderLoaded(self, request, context):
+        specs = []
+        for item in self.hooks or []:
+            if isinstance(item, str):
+                specs.append(pb.HookSpec(name=item))
+            else:
+                name, topics = item
+                specs.append(pb.HookSpec(name=name, topics=topics))
+        return pb.LoadedResponse(hooks=specs)
+
+    # convenience builders for subclasses
+    @staticmethod
+    def continue_():
+        return pb.ValuedResponse(
+            type=pb.ValuedResponse.ResponsedType.CONTINUE
+        )
+
+    @staticmethod
+    def stop_bool(value: bool):
+        return pb.ValuedResponse(
+            type=pb.ValuedResponse.ResponsedType.STOP_AND_RETURN,
+            bool_result=value,
+        )
+
+    @staticmethod
+    def stop_message(message: pb.Message):
+        return pb.ValuedResponse(
+            type=pb.ValuedResponse.ResponsedType.STOP_AND_RETURN,
+            message=message,
+        )
+
+
+def serve(
+    servicer: HookProviderServicer,
+    bind: str = "127.0.0.1:0",
+    max_workers: int = 8,
+) -> Tuple[grpc.Server, int]:
+    """Start a HookProvider server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_hook_provider_to_server(servicer, server)
+    port = server.add_insecure_port(bind)
+    server.start()
+    return server, port

@@ -1,0 +1,86 @@
+"""gRPC plumbing for the HookProvider service without grpc_tools codegen.
+
+protoc (no grpc plugin in this toolchain) generates only the message
+classes; the service stub and server registration are built here from
+grpc-core primitives (`unary_unary` channel callables and
+`method_handlers_generic_handler`), which is the same wire contract the
+generated code would produce.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from emqx_tpu.exhook import hookprovider_pb2 as pb
+
+SERVICE = "emqx_tpu.exhook.v1.HookProvider"
+
+# rpc name -> (request message class, response message class)
+METHODS = {
+    "OnProviderLoaded": (pb.ProviderLoadedRequest, pb.LoadedResponse),
+    "OnProviderUnloaded": (pb.ProviderUnloadedRequest, pb.EmptySuccess),
+    "OnClientConnect": (pb.ClientConnectRequest, pb.EmptySuccess),
+    "OnClientConnack": (pb.ClientConnackRequest, pb.EmptySuccess),
+    "OnClientConnected": (pb.ClientConnectedRequest, pb.EmptySuccess),
+    "OnClientDisconnected": (pb.ClientDisconnectedRequest, pb.EmptySuccess),
+    "OnClientAuthenticate": (pb.ClientAuthenticateRequest, pb.ValuedResponse),
+    "OnClientAuthorize": (pb.ClientAuthorizeRequest, pb.ValuedResponse),
+    "OnClientSubscribe": (pb.ClientSubscribeRequest, pb.EmptySuccess),
+    "OnClientUnsubscribe": (pb.ClientUnsubscribeRequest, pb.EmptySuccess),
+    "OnSessionCreated": (pb.SessionRequest, pb.EmptySuccess),
+    "OnSessionSubscribed": (pb.SessionSubscribedRequest, pb.EmptySuccess),
+    "OnSessionUnsubscribed": (pb.SessionUnsubscribedRequest, pb.EmptySuccess),
+    "OnSessionResumed": (pb.SessionRequest, pb.EmptySuccess),
+    "OnSessionDiscarded": (pb.SessionRequest, pb.EmptySuccess),
+    "OnSessionTakenover": (pb.SessionRequest, pb.EmptySuccess),
+    "OnSessionTerminated": (pb.SessionTerminatedRequest, pb.EmptySuccess),
+    "OnMessagePublish": (pb.MessagePublishRequest, pb.ValuedResponse),
+    "OnMessageDelivered": (pb.MessageDeliveredRequest, pb.EmptySuccess),
+    "OnMessageDropped": (pb.MessageDroppedRequest, pb.EmptySuccess),
+    "OnMessageAcked": (pb.MessageAckedRequest, pb.EmptySuccess),
+}
+
+
+class HookProviderStub:
+    """Client-side stub (the broker is the gRPC client)."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (req_cls, resp_cls) in METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{SERVICE}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+def add_hook_provider_to_server(servicer, server: grpc.Server) -> None:
+    """Register a servicer (any object with OnXxx methods) on a grpc
+    server. Missing methods default to returning EmptySuccess/CONTINUE."""
+
+    def _default(resp_cls):
+        def handler(request, context):
+            if resp_cls is pb.ValuedResponse:
+                return pb.ValuedResponse(
+                    type=pb.ValuedResponse.ResponsedType.CONTINUE
+                )
+            if resp_cls is pb.LoadedResponse:
+                return pb.LoadedResponse()
+            return resp_cls()
+
+        return handler
+
+    handlers = {}
+    for name, (req_cls, resp_cls) in METHODS.items():
+        fn = getattr(servicer, name, None) or _default(resp_cls)
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )

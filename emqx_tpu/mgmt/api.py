@@ -84,6 +84,7 @@ class MgmtApi:
                 web.delete("/api/v5/trace/{name}", self.trace_delete),
                 web.put("/api/v5/trace/{name}/stop", self.trace_stop),
                 web.get("/api/v5/trace/{name}/download", self.trace_download),
+                web.get("/api/v5/exhooks", self.exhooks_list),
             ]
         )
         self._webapp = w
@@ -480,6 +481,10 @@ class MgmtApi:
             {"status": "stopped"} if ok else {"code": "NOT_FOUND"},
             status=200 if ok else 404,
         )
+
+    async def exhooks_list(self, request):
+        ex = getattr(self.app, "exhook", None)
+        return web.json_response({"data": ex.info() if ex else []})
 
     async def trace_download(self, request):
         content = self.app.trace.read(request.match_info["name"])
