@@ -109,6 +109,17 @@ class Broker:
     def publish(self, msg: Message) -> int:
         """Route + dispatch one message; returns delivery count."""
         msg = self.hooks.run_fold("message.publish", (), msg)
+        return self._publish_folded(msg)
+
+    async def apublish(self, msg: Message) -> int:
+        """Async `publish` for the connection path: awaits async hooks
+        (exhook sidecars) so a slow extension suspends only the publishing
+        client's task, not the event loop."""
+        msg = await self.hooks.arun_fold("message.publish", (), msg)
+        return self._publish_folded(msg)
+
+    def _publish_folded(self, msg: Optional[Message]) -> int:
+        """Shared tail of publish/apublish after the message.publish fold."""
         if msg is None or msg.headers.get("allow_publish") is False:
             self.metrics.inc("messages.dropped")
             return 0

@@ -101,17 +101,17 @@ class Channel:
         }
 
     # -- inbound dispatch -------------------------------------------------
-    def handle_in(self, p) -> None:
+    async def handle_in(self, p) -> None:
         self.broker.metrics.inc("packets.received")
         t = p.type
         if self.state == "idle":
             if t != pkt.CONNECT:
                 return self._close("protocol_error")
-            return self._in_connect(p)
+            return await self._in_connect(p)
         if t == pkt.CONNECT:  # duplicate CONNECT is a protocol error
             return self._close("protocol_error", pkt.RC_PROTOCOL_ERROR)
         if t == pkt.PUBLISH:
-            return self._in_publish(p)
+            return await self._in_publish(p)
         if t == pkt.PUBACK:
             acked, more = self.session.puback(p.packet_id)
             if acked is not None:
@@ -153,9 +153,9 @@ class Channel:
                 self._send(q)
             return
         if t == pkt.SUBSCRIBE:
-            return self._in_subscribe(p)
+            return await self._in_subscribe(p)
         if t == pkt.UNSUBSCRIBE:
-            return self._in_unsubscribe(p)
+            return await self._in_unsubscribe(p)
         if t == pkt.PINGREQ:
             return self._send(pkt.PingResp())
         if t == pkt.DISCONNECT:
@@ -167,7 +167,7 @@ class Channel:
         self._close("unexpected_packet")
 
     # -- CONNECT ----------------------------------------------------------
-    def _in_connect(self, p: pkt.Connect) -> None:
+    async def _in_connect(self, p: pkt.Connect) -> None:
         self.version = p.proto_ver
         self.clean_start = p.clean_start
         self.keepalive = p.keepalive
@@ -183,11 +183,11 @@ class Channel:
             return self._connack_error(pkt.RC_CLIENT_IDENTIFIER_NOT_VALID)
         self.client_id = client_id
 
-        self.hooks.run("client.connect", self.client_info(), p)
+        await self.hooks.arun("client.connect", self.client_info(), p)
         # authenticate: fold over providers; None acc => allow
         ci = self.client_info()
         base_keys = set(ci)
-        auth = self.hooks.run_fold(
+        auth = await self.hooks.arun_fold(
             "client.authenticate",
             (ci, {"password": p.password}),
             None,
@@ -198,7 +198,7 @@ class Channel:
             {k: v for k, v in ci.items() if k not in base_keys}
         )
         if isinstance(auth, dict) and auth.get("result") == "deny":
-            self.hooks.run(
+            await self.hooks.arun(
                 "client.connack", self.client_info(), "not_authorized"
             )
             return self._connack_error(
@@ -223,7 +223,7 @@ class Channel:
             props["Shared-Subscription-Available"] = 1
             props["Wildcard-Subscription-Available"] = 1
             props["Retain-Available"] = int(self.config.caps.retain_available)
-        self.hooks.run("client.connack", self.client_info(), "success")
+        await self.hooks.arun("client.connack", self.client_info(), "success")
         self._send(
             pkt.Connack(
                 session_present=present,
@@ -233,7 +233,7 @@ class Channel:
                 properties=props,
             )
         )
-        self.hooks.run("client.connected", self.client_info(), self)
+        await self.hooks.arun("client.connected", self.client_info(), self)
         if present:
             for q in self.session.replay():
                 self._send(q)
@@ -244,7 +244,7 @@ class Channel:
         self._close("connack_error_%#x" % rc)
 
     # -- PUBLISH ----------------------------------------------------------
-    def _in_publish(self, p: pkt.Publish) -> None:
+    async def _in_publish(self, p: pkt.Publish) -> None:
         topic = p.topic
         # MQTT5 topic alias resolution (emqx_channel packet pipeline :567-576)
         alias = p.properties.get("Topic-Alias") if self.version == pkt.MQTT_V5 else None
@@ -270,7 +270,7 @@ class Channel:
         if p.retain and not self.config.caps.retain_available:
             return self._close("retain_disabled", pkt.RC_RETAIN_NOT_SUPPORTED)
 
-        allowed = self.hooks.run_fold(
+        allowed = await self.hooks.arun_fold(
             "client.authorize", (self.client_info(), "publish", topic), "allow"
         )
         if allowed != "allow":
@@ -299,10 +299,10 @@ class Channel:
             },
         )
         if p.qos == 0:
-            self.broker.publish(msg)
+            await self.broker.apublish(msg)
             return
         if p.qos == 1:
-            n = self.broker.publish(msg)
+            n = await self.broker.apublish(msg)
             rc = pkt.RC_SUCCESS
             if n == 0 and self.version == pkt.MQTT_V5:
                 rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
@@ -314,7 +314,7 @@ class Channel:
             return self._close("receive_max", pkt.RC_RECEIVE_MAXIMUM_EXCEEDED)
         rc = pkt.RC_SUCCESS
         if fresh:
-            n = self.broker.publish(msg)
+            n = await self.broker.apublish(msg)
             if n == 0 and self.version == pkt.MQTT_V5:
                 rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
         rec = pkt.PubAck(packet_id=p.packet_id, reason_code=rc)
@@ -322,9 +322,9 @@ class Channel:
         self._send(rec)
 
     # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
-    def _in_subscribe(self, p: pkt.Subscribe) -> None:
+    async def _in_subscribe(self, p: pkt.Subscribe) -> None:
         # fold so extensions (topic rewrite) can transform the filter list
-        filters = self.hooks.run_fold(
+        filters = await self.hooks.arun_fold(
             "client.subscribe", (self.client_info(),), p.filters
         )
         rcs: List[int] = []
@@ -341,7 +341,7 @@ class Channel:
             except T.TopicValidationError:
                 rcs.append(pkt.RC_TOPIC_FILTER_INVALID)
                 continue
-            allowed = self.hooks.run_fold(
+            allowed = await self.hooks.arun_fold(
                 "client.authorize", (self.client_info(), "subscribe", f), "allow"
             )
             if allowed != "allow":
@@ -360,7 +360,7 @@ class Channel:
                 self.client_id, self.client_id, f, opts, self._make_deliverer(opts)
             )
             self.session.subscriptions[f] = opts
-            self.hooks.run(
+            await self.hooks.arun(
                 "session.subscribed", self.client_info(), f, opts, self
             )
             rcs.append(qos)  # granted qos == success codes 0..2
@@ -372,8 +372,8 @@ class Channel:
 
         return deliver
 
-    def _in_unsubscribe(self, p: pkt.Unsubscribe) -> None:
-        filters = self.hooks.run_fold(
+    async def _in_unsubscribe(self, p: pkt.Unsubscribe) -> None:
+        filters = await self.hooks.arun_fold(
             "client.unsubscribe", (self.client_info(),), p.filters
         )
         rcs: List[int] = []
@@ -381,7 +381,7 @@ class Channel:
             existed = self.broker.unsubscribe(self.client_id, f)
             self.session.subscriptions.pop(f, None)
             if existed:
-                self.hooks.run("session.unsubscribed", self.client_info(), f)
+                await self.hooks.arun("session.unsubscribed", self.client_info(), f)
                 rcs.append(pkt.RC_SUCCESS)
             else:
                 rcs.append(pkt.RC_NO_SUBSCRIPTION_EXISTED)
@@ -397,27 +397,36 @@ class Channel:
         self.state = "disconnected"
         self._close("normal")
 
-    def on_sock_closed(self, reason: str = "sock_closed") -> None:
+    async def on_sock_closed(self, reason: str = "sock_closed") -> None:
         """Transport-level close (also the abnormal path: publish will)."""
         if self.state == "idle":
             return
         was_connected = self.state == "connected"
         self.state = "disconnected"
-        if was_connected and self.will is not None:
-            self._publish_will()
-        self.hooks.run(
-            "client.disconnected", self.client_info(), self.disconnect_reason or reason
-        )
-        self.cm.on_channel_closed(self, reason)
+        try:
+            if was_connected and self.will is not None:
+                # apublish: the will is client-originated traffic, so it
+                # must pass the same async extension chain (exhook
+                # deny/rewrite) as an ordinary PUBLISH
+                await self._publish_will()
+            await self.hooks.arun(
+                "client.disconnected",
+                self.client_info(),
+                self.disconnect_reason or reason,
+            )
+        finally:
+            # registry cleanup must survive task cancellation mid-await
+            # (listener.stop cancels connection tasks in their finally)
+            self.cm.on_channel_closed(self, reason)
 
-    def _publish_will(self) -> None:
+    async def _publish_will(self) -> None:
         w = self.will
         self.will = None
         try:
             T.validate(w.topic, kind="name")
         except T.TopicValidationError:
             return
-        self.broker.publish(
+        await self.broker.apublish(
             Message(
                 topic=w.topic,
                 payload=w.payload,

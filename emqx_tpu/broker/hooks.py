@@ -56,8 +56,15 @@ class Hooks:
         ]
 
     def run(self, name: str, *args) -> None:
-        """Run all callbacks; a STOP return short-circuits."""
+        """Run all callbacks; a STOP return short-circuits.
+
+        Coroutine-function callbacks are skipped on this sync path (they
+        only fire on `arun`); the async channel path uses arun/arun_fold so
+        client-originated traffic always reaches async extensions (exhook).
+        """
         for _, _, cb in self._table.get(name, ()):  # snapshot-free; small N
+            if inspect.iscoroutinefunction(cb):
+                continue
             if cb(*args) is STOP:
                 return
 
@@ -66,24 +73,63 @@ class Hooks:
 
         Callback returns: None (keep acc) | ('ok', new_acc) | STOP |
         ('stop', final_acc); or raises StopAndReturn(final).
+        Coroutine-function callbacks are skipped (see `run`).
         """
         for _, _, cb in self._table.get(name, ()):
+            if inspect.iscoroutinefunction(cb):
+                continue
             try:
                 r = cb(*args, acc)
             except StopAndReturn as s:
                 return s.value
-            if r is None or r is True:
-                continue
+            acc2, stop = self._fold_step(r, acc)
+            if stop:
+                return acc2
+            acc = acc2
+        return acc
+
+    @staticmethod
+    def _fold_step(r, acc) -> Tuple[Any, bool]:
+        """-> (new_acc, stop?)"""
+        if r is None or r is True:
+            return acc, False
+        if r is STOP:
+            return acc, True
+        if isinstance(r, tuple) and len(r) == 2:
+            kind, val = r
+            if kind == "ok":
+                return val, False
+            if kind == "stop":
+                return val, True
+        return r, False  # plain new acc
+
+    async def arun(self, name: str, *args) -> None:
+        """Async `run`: awaits coroutine callbacks, runs sync ones inline.
+
+        This is the channel-path variant — a slow async extension (e.g. an
+        exhook gRPC sidecar) suspends only the calling connection's task,
+        never the event loop (ADVICE r1: emqx_exhook blocking finding).
+        """
+        for _, _, cb in self._table.get(name, ()):
+            r = cb(*args)
+            if inspect.isawaitable(r):
+                r = await r
             if r is STOP:
-                return acc
-            if isinstance(r, tuple) and len(r) == 2:
-                kind, val = r
-                if kind == "ok":
-                    acc = val
-                    continue
-                if kind == "stop":
-                    return val
-            acc = r  # plain new acc
+                return
+
+    async def arun_fold(self, name: str, args: tuple, acc: Any) -> Any:
+        """Async `run_fold`: awaits coroutine callbacks along the chain."""
+        for _, _, cb in self._table.get(name, ()):
+            try:
+                r = cb(*args, acc)
+                if inspect.isawaitable(r):
+                    r = await r
+            except StopAndReturn as s:
+                return s.value
+            acc2, stop = self._fold_step(r, acc)
+            if stop:
+                return acc2
+            acc = acc2
         return acc
 
     def callbacks(self, name: str):

@@ -5,16 +5,23 @@ Parity with apps/emqx_exhook/src/emqx_exhook_mgr.erl + emqx_exhook_handler.erl
 registration driven by the provider's OnProviderLoaded response, per-hook
 call/error metrics, deny-or-ignore fallback when the sidecar is down.
 
-Calls are synchronous with a bounded timeout, like the reference's inline
-gRPC calls on the publish path — a deliberately slow sidecar throttles the
-broker, so keep timeouts tight (default 500ms).
+In the reference each connection is its own Erlang process, so an inline
+gRPC call only blocks that one client. Here the broker shares one event
+loop, so gRPC never runs on it: every server gets a single worker thread
+(ordering-preserving). Lifecycle notifications are enqueued fire-and-forget;
+valued hooks (authenticate/authorize/message.publish) are coroutines that
+await the worker's result, suspending only the calling connection's task.
+A breaker trips after consecutive failures so a dead sidecar costs ~one
+timeout, not one timeout per message.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import grpc
@@ -51,32 +58,53 @@ ALL_HOOKS = (
 )
 
 
-def _ci(client_info: Dict) -> pb.ClientInfo:
+def _ci(client_info: Dict, password: str = "") -> pb.ClientInfo:
     return pb.ClientInfo(
         node=node_name(),
         clientid=str(client_info.get("client_id") or ""),
         username=str(client_info.get("username") or ""),
+        password=password,
         peerhost=str(client_info.get("peerhost") or ""),
-        proto_ver=int(client_info.get("proto_ver") or 0),
-        clean_start=bool(client_info.get("clean_start", True)),
+        sockport=int(client_info.get("sockport") or 0),
+        protocol=str(client_info.get("protocol") or "mqtt"),
+        mountpoint=str(client_info.get("mountpoint") or ""),
+        is_superuser=bool(client_info.get("is_superuser", False)),
+        anonymous=not client_info.get("username"),
+    )
+
+
+def _conninfo(client_info: Dict) -> pb.ConnInfo:
+    return pb.ConnInfo(
+        node=node_name(),
+        clientid=str(client_info.get("client_id") or ""),
+        username=str(client_info.get("username") or ""),
+        peerhost=str(client_info.get("peerhost") or ""),
+        sockport=int(client_info.get("sockport") or 0),
+        proto_name="MQTT",
+        proto_ver=str(client_info.get("proto_ver") or ""),
         keepalive=int(client_info.get("keepalive") or 0),
     )
 
 
 def _msg_build(m: Message) -> pb.Message:
     out = pb.Message(
+        node=node_name(),
         id=str(m.mid),
+        qos=m.qos,
         topic=m.topic,
         payload=m.payload,
-        qos=m.qos,
-        retain=m.retain,
-        timestamp_ms=int(m.timestamp * 1000),
+        timestamp=int(m.timestamp * 1000),
     )
     # 'from' is a Python keyword; protobuf exposes the field by name via
     # setattr
     setattr(out, "from", m.from_client)
+    if m.from_username:
+        out.headers["username"] = str(m.from_username)
+    out.headers["protocol"] = "mqtt"
     for k, v in m.headers.items():
-        if isinstance(v, (str, int, float, bool)):
+        if isinstance(v, bool):
+            out.headers[str(k)] = "true" if v else "false"
+        elif isinstance(v, (str, int, float)):
             out.headers[str(k)] = str(v)
     return out
 
@@ -88,10 +116,15 @@ def _apply_msg(original: Message, p: pb.Message) -> Message:
     m.topic = p.topic
     m.payload = p.payload
     m.qos = p.qos
-    m.retain = p.retain
     m.headers = dict(original.headers)
     for k, v in p.headers.items():
-        m.headers[k] = v
+        if k in ("username", "protocol", "peerhost"):
+            continue  # readonly mirror headers, not broker state
+        if k == "allow_publish":
+            # the reference's writable header is string "true"/"false"
+            m.headers[k] = v != "false"
+        else:
+            m.headers[k] = v
     return m
 
 
@@ -105,6 +138,8 @@ class ExhookServer:
         timeout: float = 0.5,
         failed_action: str = "deny",  # deny | ignore
         pool_size: int = 8,
+        breaker_threshold: int = 3,
+        breaker_cooldown: float = 5.0,
     ):
         if failed_action not in ("deny", "ignore"):
             raise ValueError("failed_action must be deny|ignore")
@@ -117,13 +152,32 @@ class ExhookServer:
         self.hooks: Dict[str, List[str]] = {}  # hook -> topic filters
         self.metrics = defaultdict(lambda: {"succeed": 0, "failed": 0})
         self.loaded = False
+        # one worker per lane, off the event loop: notifications must not
+        # delay latency-sensitive valued calls (auth/authorize/publish),
+        # so each lane gets its own single thread (per-lane ordering)
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"exhook-{name}-notify"
+        )
+        self._pool_valued = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"exhook-{name}-valued"
+        )
+        self._notify_backlog = 0
+        self._notify_backlog_max = 1000
+        self._consec_failures = 0
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown = breaker_cooldown
+        self._broken_until = 0.0
 
     def load(self, version: str) -> bool:
         """OnProviderLoaded handshake: learn which hooks to bridge."""
         try:
             resp = self.stub.OnProviderLoaded(
                 pb.ProviderLoadedRequest(
-                    broker=pb.BrokerInfo(version=version, node=node_name())
+                    broker=pb.BrokerInfo(
+                        version=version,
+                        sysdescr=f"emqx_tpu on {node_name()}",
+                        datetime=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    )
                 ),
                 timeout=self.timeout,
             )
@@ -149,6 +203,8 @@ class ExhookServer:
         except grpc.RpcError:
             pass
         self.loaded = False
+        self._pool.shutdown(wait=False)
+        self._pool_valued.shutdown(wait=False)
         self.channel.close()
 
     def topic_interested(self, hook: str, topic: Optional[str]) -> bool:
@@ -159,16 +215,65 @@ class ExhookServer:
             return True
         return any(T.match(topic, f) for f in filters)
 
+    def _breaker_open(self) -> bool:
+        return time.monotonic() < self._broken_until
+
     def call(self, method: str, request, hook: str):
-        """-> (ok, response|None); metrics + fallback bookkeeping."""
+        """Blocking gRPC call -> (ok, response|None); metrics + breaker.
+
+        Runs on the server's worker thread (or any non-loop thread); never
+        call from the event loop — use `acall`/`notify` there.
+        """
+        if self._breaker_open():
+            self.metrics[hook]["failed"] += 1
+            return False, None
         try:
             resp = getattr(self.stub, method)(request, timeout=self.timeout)
             self.metrics[hook]["succeed"] += 1
+            self._consec_failures = 0
             return True, resp
         except grpc.RpcError as e:
             self.metrics[hook]["failed"] += 1
+            self._consec_failures += 1
+            if self._consec_failures >= self._breaker_threshold:
+                self._broken_until = (
+                    time.monotonic() + self._breaker_cooldown
+                )
             log.debug("exhook %s %s failed: %s", self.name, method, e)
             return False, None
+
+    async def acall(self, method: str, request, hook: str):
+        """Awaitable `call` on the valued-lane worker; only the caller
+        waits. A shut-down pool (unload raced with an in-flight packet)
+        counts as a failure so failed_action applies."""
+        if self._breaker_open():
+            self.metrics[hook]["failed"] += 1
+            return False, None
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                self._pool_valued, self.call, method, request, hook
+            )
+        except RuntimeError:
+            self.metrics[hook]["failed"] += 1
+            return False, None
+
+    def _notify_done(self, _fut) -> None:
+        self._notify_backlog -= 1
+
+    def notify(self, method: str, request, hook: str) -> None:
+        """Fire-and-forget: enqueue on the notify worker; drop when shut
+        down or when the backlog is deep (a stalled sidecar must not grow
+        an unbounded queue of stale notifications)."""
+        if self._notify_backlog >= self._notify_backlog_max:
+            self.metrics[hook]["failed"] += 1
+            return
+        try:
+            fut = self._pool.submit(self.call, method, request, hook)
+        except RuntimeError:
+            return
+        self._notify_backlog += 1
+        fut.add_done_callback(self._notify_done)
 
     def info(self) -> Dict:
         return {
@@ -217,20 +322,20 @@ class ExhookManager:
         def notify(hook, method, build):
             def cb(*args):
                 for s in self._servers_for(hook):
-                    s.call(method, build(*args), hook)
+                    s.notify(method, build(*args), hook)
 
             hooks.add(hook, cb, tag=f"exhook.{hook}")
 
         notify(
             "client.connect",
             "OnClientConnect",
-            lambda ci, p: pb.ClientConnectRequest(clientinfo=_ci(ci)),
+            lambda ci, p: pb.ClientConnectRequest(conninfo=_conninfo(ci)),
         )
         notify(
             "client.connack",
             "OnClientConnack",
             lambda ci, rc: pb.ClientConnackRequest(
-                clientinfo=_ci(ci), result_code=str(rc)
+                conninfo=_conninfo(ci), result_code=str(rc)
             ),
         )
         notify(
@@ -249,7 +354,14 @@ class ExhookManager:
             "session.subscribed",
             "OnSessionSubscribed",
             lambda ci, f, opts, ch=None: pb.SessionSubscribedRequest(
-                clientinfo=_ci(ci), topic=f, qos=getattr(opts, "qos", 0)
+                clientinfo=_ci(ci),
+                topic=f,
+                subopts=pb.SubOpts(
+                    qos=getattr(opts, "qos", 0),
+                    rh=getattr(opts, "retain_handling", 0),
+                    rap=int(getattr(opts, "retain_as_published", False)),
+                    nl=int(getattr(opts, "no_local", False)),
+                ),
             ),
         )
         notify(
@@ -259,16 +371,18 @@ class ExhookManager:
                 clientinfo=_ci(ci), topic=f
             ),
         )
-        for hook, method in (
-            ("session.created", "OnSessionCreated"),
-            ("session.resumed", "OnSessionResumed"),
-            ("session.discarded", "OnSessionDiscarded"),
-            ("session.takenover", "OnSessionTakenover"),
+        for hook, method, req_cls in (
+            ("session.created", "OnSessionCreated", pb.SessionCreatedRequest),
+            ("session.resumed", "OnSessionResumed", pb.SessionResumedRequest),
+            ("session.discarded", "OnSessionDiscarded",
+             pb.SessionDiscardedRequest),
+            ("session.takenover", "OnSessionTakenover",
+             pb.SessionTakenoverRequest),
         ):
             notify(
                 hook,
                 method,
-                lambda cid, _h=hook: pb.SessionRequest(
+                lambda cid, _cls=req_cls: _cls(
                     clientinfo=pb.ClientInfo(
                         node=node_name(), clientid=str(cid)
                     )
@@ -303,7 +417,7 @@ class ExhookManager:
             if not isinstance(msg_or_pid, Message):
                 return
             for s in self._servers_for("message.acked", msg_or_pid.topic):
-                s.call(
+                s.notify(
                     "OnMessageAcked",
                     pb.MessageAckedRequest(
                         clientinfo=_ci(ci), message=_msg_build(msg_or_pid)
@@ -336,11 +450,11 @@ class ExhookManager:
         def subscribe_cb(ci, filters):
             # fold contract: acc is the filter list; exhook only observes
             for s in self._servers_for("client.subscribe"):
-                s.call(
+                s.notify(
                     "OnClientSubscribe",
                     pb.ClientSubscribeRequest(
                         clientinfo=_ci(ci),
-                        filters=[
+                        topic_filters=[
                             pb.TopicFilter(
                                 name=f, qos=getattr(o, "qos", 0)
                             )
@@ -355,10 +469,13 @@ class ExhookManager:
 
         def unsubscribe_cb(ci, filters):
             for s in self._servers_for("client.unsubscribe"):
-                s.call(
+                s.notify(
                     "OnClientUnsubscribe",
                     pb.ClientUnsubscribeRequest(
-                        clientinfo=_ci(ci), topics=list(filters)
+                        clientinfo=_ci(ci),
+                        topic_filters=[
+                            pb.TopicFilter(name=f) for f in filters
+                        ],
                     ),
                     "client.unsubscribe",
                 )
@@ -369,16 +486,20 @@ class ExhookManager:
             tag="exhook.client.unsubscribe",
         )
 
-    # fold: (ci, credentials), acc None|{"result":...}
-    def _on_authenticate(self, ci, credentials, acc):
+    # fold: (ci, credentials), acc None|{"result":...}; coroutine -> only
+    # runs on the async channel path (arun_fold), never blocks the loop
+    async def _on_authenticate(self, ci, credentials, acc):
         for s in self._servers_for("client.authenticate"):
             pw = credentials.get("password") or b""
             if isinstance(pw, bytes):
                 pw = pw.decode("utf-8", "replace")
-            ok, resp = s.call(
+            chain_ok = not (
+                isinstance(acc, dict) and acc.get("result") == "deny"
+            )
+            ok, resp = await s.acall(
                 "OnClientAuthenticate",
                 pb.ClientAuthenticateRequest(
-                    clientinfo=_ci(ci), password=pw
+                    clientinfo=_ci(ci, password=pw), result=chain_ok
                 ),
                 "client.authenticate",
             )
@@ -397,12 +518,20 @@ class ExhookManager:
         return None  # keep acc
 
     # fold: (ci, action, topic), acc "allow"/"deny"/"disconnect"
-    def _on_authorize(self, ci, action, topic, acc):
+    async def _on_authorize(self, ci, action, topic, acc):
         for s in self._servers_for("client.authorize", topic):
-            ok, resp = s.call(
+            req_type = (
+                pb.ClientAuthorizeRequest.AuthorizeReqType.SUBSCRIBE
+                if str(action) == "subscribe"
+                else pb.ClientAuthorizeRequest.AuthorizeReqType.PUBLISH
+            )
+            ok, resp = await s.acall(
                 "OnClientAuthorize",
                 pb.ClientAuthorizeRequest(
-                    clientinfo=_ci(ci), type=str(action), topic=topic
+                    clientinfo=_ci(ci),
+                    type=req_type,
+                    topic=topic,
+                    result=(acc == "allow"),
                 ),
                 "client.authorize",
             )
@@ -415,13 +544,16 @@ class ExhookManager:
                     return ("stop", "allow" if resp.bool_result else "deny")
         return None
 
-    # fold: (), acc Message
-    def _on_message_publish(self, acc):
+    # fold: (), acc Message. Coroutine: fires for client-originated
+    # publishes (Broker.apublish via the channel); internally generated
+    # sync publishes (rules republish, $delayed flush, $SYS) skip exhook,
+    # which also rules out sidecar-induced republish loops.
+    async def _on_message_publish(self, acc):
         m = acc
         if m is None or m.is_sys():
             return None
         for s in self._servers_for("message.publish", m.topic):
-            ok, resp = s.call(
+            ok, resp = await s.acall(
                 "OnMessagePublish",
                 pb.MessagePublishRequest(message=_msg_build(m)),
                 "message.publish",

@@ -13,7 +13,9 @@ import grpc
 
 from emqx_tpu.exhook import hookprovider_pb2 as pb
 
-SERVICE = "emqx_tpu.exhook.v1.HookProvider"
+# The reference service path — a provider binary built against the
+# reference proto (exhook.proto:25) attaches unchanged.
+SERVICE = "emqx.exhook.v1.HookProvider"
 
 # rpc name -> (request message class, response message class)
 METHODS = {
@@ -27,12 +29,12 @@ METHODS = {
     "OnClientAuthorize": (pb.ClientAuthorizeRequest, pb.ValuedResponse),
     "OnClientSubscribe": (pb.ClientSubscribeRequest, pb.EmptySuccess),
     "OnClientUnsubscribe": (pb.ClientUnsubscribeRequest, pb.EmptySuccess),
-    "OnSessionCreated": (pb.SessionRequest, pb.EmptySuccess),
+    "OnSessionCreated": (pb.SessionCreatedRequest, pb.EmptySuccess),
     "OnSessionSubscribed": (pb.SessionSubscribedRequest, pb.EmptySuccess),
     "OnSessionUnsubscribed": (pb.SessionUnsubscribedRequest, pb.EmptySuccess),
-    "OnSessionResumed": (pb.SessionRequest, pb.EmptySuccess),
-    "OnSessionDiscarded": (pb.SessionRequest, pb.EmptySuccess),
-    "OnSessionTakenover": (pb.SessionRequest, pb.EmptySuccess),
+    "OnSessionResumed": (pb.SessionResumedRequest, pb.EmptySuccess),
+    "OnSessionDiscarded": (pb.SessionDiscardedRequest, pb.EmptySuccess),
+    "OnSessionTakenover": (pb.SessionTakenoverRequest, pb.EmptySuccess),
     "OnSessionTerminated": (pb.SessionTerminatedRequest, pb.EmptySuccess),
     "OnMessagePublish": (pb.MessagePublishRequest, pb.ValuedResponse),
     "OnMessageDelivered": (pb.MessageDeliveredRequest, pb.EmptySuccess),

@@ -228,7 +228,9 @@ class MgmtApi:
             return web.json_response(
                 {"code": "BAD_REQUEST", "message": str(e)}, status=400
             )
-        n = self.broker.publish(
+        # apublish: API publishes traverse the full async extension chain
+        # (exhook message.publish) exactly like client traffic
+        n = await self.broker.apublish(
             Message(
                 topic=topic,
                 payload=payload,

@@ -19,6 +19,17 @@ class MqttError(Exception):
     pass
 
 
+def _insecure_client_ctx():
+    """No-verify TLS context (test/tooling default, like `emqtt`'s
+    verify_none); pass an explicit `ssl=` context for real deployments."""
+    import ssl as ssl_mod
+
+    ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl_mod.CERT_NONE
+    return ctx
+
+
 class Client:
     def __init__(
         self,
@@ -62,21 +73,34 @@ class Client:
         timeout: float = 5.0,
         transport: str = "tcp",
         path: str = "/mqtt",
+        ssl: object = None,
     ):
-        if transport == "ws":
+        if transport in ("ws", "wss"):
             # MQTT-over-WebSocket (binary frames, "mqtt" subprotocol)
             from websockets.asyncio.client import connect as ws_connect
 
             from emqx_tpu.transport.ws import _WsStream
 
+            scheme = "wss" if transport == "wss" else "ws"
+            if transport == "wss" and ssl is None:
+                ssl = _insecure_client_ctx()
             ws = await ws_connect(
-                f"ws://{host}:{port}{path}", subprotocols=["mqtt"], max_size=None
+                f"{scheme}://{host}:{port}{path}",
+                subprotocols=["mqtt"],
+                max_size=None,
+                ssl=ssl,
             )
             self._reader = self._writer = _WsStream(ws)
-        elif transport == "tcp":
-            self._reader, self._writer = await asyncio.open_connection(host, port)
+        elif transport in ("tcp", "ssl"):
+            if transport == "ssl" and ssl is None:
+                ssl = _insecure_client_ctx()
+            self._reader, self._writer = await asyncio.open_connection(
+                host, port, ssl=ssl
+            )
         else:
-            raise ValueError(f"unsupported transport {transport!r} (tcp|ws)")
+            raise ValueError(
+                f"unsupported transport {transport!r} (tcp|ssl|ws|wss)"
+            )
         self._send(
             pkt.Connect(
                 proto_ver=self.version,

@@ -119,6 +119,12 @@ class EventMessage:
         )
 
     def on_message_acked(self, client_info, msg_or_pid) -> None:
+        if isinstance(msg_or_pid, Message) and (
+            msg_or_pid.is_sys() or msg_or_pid.topic.startswith("$event/")
+        ):
+            # same guard as delivered/dropped: acking a $event QoS1 delivery
+            # must not spawn another $event publish (self-sustaining loop)
+            return
         data = {
             "clientid": client_info.get("client_id"),
             "username": client_info.get("username"),

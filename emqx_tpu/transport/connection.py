@@ -96,7 +96,7 @@ class Connection:
                             await self._limited("message_in", 1)
                         if self.forced_gc is not None:
                             self.forced_gc.inc(1, 0)
-                        self.channel.handle_in(p)
+                        await self.channel.handle_in(p)
                 except FrameError as e:
                     self.channel.disconnect_reason = f"frame_error:{e.reason}"
                     if self.channel.version == pkt.MQTT_V5:
@@ -117,7 +117,7 @@ class Connection:
                 await self.writer.wait_closed()
             except Exception:
                 pass
-            self.channel.on_sock_closed()
+            await self.channel.on_sock_closed()
 
     async def _limited(self, type_: str, n: float) -> None:
         """Charge the limiter and pause for the returned interval.

@@ -25,6 +25,7 @@ from websockets.asyncio.server import serve
 from websockets.exceptions import ConnectionClosed
 
 from emqx_tpu.transport.connection import Connection
+from emqx_tpu.transport.listener import build_ssl_context
 
 
 class _WsStream:
@@ -79,9 +80,15 @@ class _WsStream:
                 return
 
     async def drain(self) -> None:
-        if self._flush_task is not None and not self._flush_task.done():
-            await self._flush_task
-        await self._flush()
+        # Exactly one _flush coroutine may run at a time (write() and this
+        # loop both create a task only when the previous one is done, with no
+        # await between check and create), so MQTT byte order is preserved.
+        while not self._closed and self._buf:
+            task = self._flush_task
+            if task is None or task.done():
+                task = asyncio.get_running_loop().create_task(self._flush())
+                self._flush_task = task
+            await task
         if self._closed:
             raise ConnectionResetError("ws closed")
 

@@ -202,6 +202,7 @@ def test_shared_sub_across_cluster(cluster3):
     got2, del2 = collector()
     b.subscribe("s1", "c1", "$share/g/sh/t", SubOpts(), del1)
     b.subscribe("s2", "c2", "$share/g/sh/t", SubOpts(), del2)
+    b.flush()  # route replication b->a is async; drain before publishing
     for i in range(10):
         assert a.publish(Message(topic="sh/t", qos=1)) == 1
     assert len(got1) + len(got2) == 10

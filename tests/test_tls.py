@@ -1,0 +1,105 @@
+"""TLS transport smoke tests: ssl and wss listeners end-to-end.
+
+Parity target: emqx_listeners ssl/wss types (apps/emqx/src/
+emqx_listeners.erl:230-248). Regression guard for ADVICE r1 (high): a wss
+listener used to crash with NameError on start because build_ssl_context
+was never imported in ws.py.
+"""
+
+import subprocess
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.mqtt.client import Client
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+from tests.test_ws import async_test
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    key, crt = d / "key.pem", d / "cert.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return str(crt), str(key)
+
+
+class TlsBed:
+    __test__ = False
+
+    def __init__(self, certfile, keyfile):
+        self.broker = Broker(hooks=Hooks())
+        self.cm = ChannelManager(self.broker)
+        self.listeners = Listeners(self.broker, self.cm)
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.ssl_port = None
+        self.wss_port = None
+
+    async def __aenter__(self):
+        cfg = ChannelConfig()
+        s = await self.listeners.start_listener(
+            ListenerConfig(
+                name="s", type="ssl", bind="127.0.0.1", port=0,
+                ssl_certfile=self.certfile, ssl_keyfile=self.keyfile,
+            ),
+            cfg,
+        )
+        w = await self.listeners.start_listener(
+            ListenerConfig(
+                name="w", type="wss", bind="127.0.0.1", port=0,
+                ssl_certfile=self.certfile, ssl_keyfile=self.keyfile,
+            ),
+            cfg,
+        )
+        self.ssl_port = s.port
+        self.wss_port = w.port
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.listeners.stop_all()
+
+
+@async_test
+async def test_ssl_listener_pub_sub(certs):
+    crt, key = certs
+    async with TlsBed(crt, key) as bed:
+        sub = Client(client_id="tls-sub")
+        await sub.connect("127.0.0.1", bed.ssl_port, transport="ssl")
+        await sub.subscribe("tls/t", qos=1)
+        pub = Client(client_id="tls-pub")
+        await pub.connect("127.0.0.1", bed.ssl_port, transport="ssl")
+        await pub.publish("tls/t", b"over-tls", qos=1)
+        msg = await sub.recv(3)
+        assert msg.topic == "tls/t" and msg.payload == b"over-tls"
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+@async_test
+async def test_wss_listener_pub_sub(certs):
+    crt, key = certs
+    async with TlsBed(crt, key) as bed:
+        sub = Client(client_id="wss-sub")
+        await sub.connect("127.0.0.1", bed.wss_port, transport="wss")
+        await sub.subscribe("wss/t", qos=1)
+        pub = Client(client_id="wss-pub")
+        await pub.connect("127.0.0.1", bed.wss_port, transport="wss")
+        await pub.publish("wss/t", b"over-wss", qos=1)
+        msg = await sub.recv(3)
+        assert msg.topic == "wss/t" and msg.payload == b"over-wss"
+        # cross-transport: tls client <- wss publisher already covered by
+        # shared channel; assert wss -> wss here is enough for the smoke
+        await pub.disconnect()
+        await sub.disconnect()
