@@ -322,6 +322,15 @@ class BrokerApp:
         if self.durable_state is not None:
             self.durable_state.restore()
         for spec in c.listeners:
+            chan_cfg = self.channel_config
+            if spec.mountpoint:
+                # per-listener channel config: same caps/session, listener-
+                # specific topic namespace (emqx_listeners.erl:232 analog)
+                import dataclasses
+
+                chan_cfg = dataclasses.replace(
+                    chan_cfg, mountpoint=spec.mountpoint
+                )
             await self.listeners.start_listener(
                 ListenerConfig(
                     name=spec.name,
@@ -334,7 +343,7 @@ class BrokerApp:
                     ssl_cacertfile=spec.ssl_cacertfile,
                     ssl_verify=spec.ssl_verify,
                 ),
-                self.channel_config,
+                chan_cfg,
             )
         if c.dashboard.enable:
             from emqx_tpu.mgmt.api import MgmtApi

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker import mountpoint as MP
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.session import Session, SessionConfig
 from emqx_tpu.mqtt import packet as pkt
@@ -46,6 +47,9 @@ class ChannelConfig:
     session: SessionConfig = field(default_factory=SessionConfig)
     idle_timeout: float = 15.0
     enable_stats: bool = True
+    # per-listener topic namespace prefix, ${clientid}/${username}
+    # placeholders resolved at CONNECT (emqx_mountpoint.erl parity)
+    mountpoint: Optional[str] = None
 
 
 class Channel:
@@ -77,6 +81,8 @@ class Channel:
         # attrs set by auth providers during CONNECT (is_superuser, claims);
         # must persist so later authorize checks see them
         self.auth_attrs: Dict = {}
+        # resolved at CONNECT via MP.replvar (placeholders need clientid)
+        self.mountpoint: Optional[str] = None
 
     # -- helpers ----------------------------------------------------------
     def _send(self, p) -> None:
@@ -96,6 +102,7 @@ class Channel:
             "proto_ver": self.version,
             "clean_start": self.clean_start,
             "keepalive": self.keepalive,
+            "mountpoint": self.mountpoint,
             **self.conninfo,
             **self.auth_attrs,
         }
@@ -205,6 +212,9 @@ class Channel:
                 auth.get("reason_code", pkt.RC_NOT_AUTHORIZED)
             )
 
+        self.mountpoint = MP.replvar(
+            self.config.mountpoint, self.client_info()
+        )
         session, present = self.cm.open_session(self)
         self.session = session
         if self.version == pkt.MQTT_V5:
@@ -224,6 +234,8 @@ class Channel:
             props["Wildcard-Subscription-Available"] = 1
             props["Retain-Available"] = int(self.config.caps.retain_available)
         await self.hooks.arun("client.connack", self.client_info(), "success")
+        if self._gone(session):
+            return  # kicked during the awaited hook (takeover race)
         self._send(
             pkt.Connack(
                 session_present=present,
@@ -234,9 +246,18 @@ class Channel:
             )
         )
         await self.hooks.arun("client.connected", self.client_info(), self)
+        if self._gone(session):
+            return
         if present:
             for q in self.session.replay():
                 self._send(q)
+
+    def _gone(self, session) -> bool:
+        """True when this channel lost its session while awaiting a hook
+        (a concurrent same-clientid CONNECT kicked/takeover'd us — the
+        awaits in the async pipeline reopened the window the reference
+        closes with per-clientid global locks, emqx_cm.erl:245-273)."""
+        return self.session is not session or self.state == "disconnected"
 
     def _connack_error(self, rc: int) -> None:
         code = rc if self.version == pkt.MQTT_V5 else pkt.connack_compat(rc)
@@ -287,8 +308,10 @@ class Channel:
             ack.type = pkt.PUBACK if p.qos == 1 else pkt.PUBREC
             return self._send(ack)
 
+        if self.session is None or self.state != "connected":
+            return  # kicked while awaiting the authorize hook
         msg = Message(
-            topic=topic,
+            topic=MP.mount(self.mountpoint, topic),
             payload=p.payload,
             qos=p.qos,
             retain=p.retain,
@@ -352,16 +375,20 @@ class Channel:
                     )
                 rcs.append(pkt.RC_NOT_AUTHORIZED)
                 continue
+            if self.session is None or self.state != "connected":
+                return  # kicked while awaiting the authorize hook
             qos = min(opts.qos, self.config.caps.max_qos_allowed)
             opts.qos = qos
-            existing = f in self.session.subscriptions
+            mf = MP.mount(self.mountpoint, f)
+            existing = mf in self.session.subscriptions
             opts._existing = existing  # for retain_handling=1 semantics
             self.broker.subscribe(
-                self.client_id, self.client_id, f, opts, self._make_deliverer(opts)
+                self.client_id, self.client_id, mf, opts,
+                self._make_deliverer(opts),
             )
-            self.session.subscriptions[f] = opts
+            self.session.subscriptions[mf] = opts
             await self.hooks.arun(
-                "session.subscribed", self.client_info(), f, opts, self
+                "session.subscribed", self.client_info(), mf, opts, self
             )
             rcs.append(qos)  # granted qos == success codes 0..2
         self._send(pkt.Suback(packet_id=p.packet_id, reason_codes=rcs))
@@ -376,12 +403,15 @@ class Channel:
         filters = await self.hooks.arun_fold(
             "client.unsubscribe", (self.client_info(),), p.filters
         )
+        if self.session is None or self.state != "connected":
+            return  # kicked while awaiting the unsubscribe hook
         rcs: List[int] = []
         for f in filters:
-            existed = self.broker.unsubscribe(self.client_id, f)
-            self.session.subscriptions.pop(f, None)
+            mf = MP.mount(self.mountpoint, f)
+            existed = self.broker.unsubscribe(self.client_id, mf)
+            self.session.subscriptions.pop(mf, None)
             if existed:
-                await self.hooks.arun("session.unsubscribed", self.client_info(), f)
+                await self.hooks.arun("session.unsubscribed", self.client_info(), mf)
                 rcs.append(pkt.RC_SUCCESS)
             else:
                 rcs.append(pkt.RC_NO_SUBSCRIPTION_EXISTED)
@@ -428,7 +458,7 @@ class Channel:
             return
         await self.broker.apublish(
             Message(
-                topic=w.topic,
+                topic=MP.mount(self.mountpoint, w.topic),
                 payload=w.payload,
                 qos=w.qos,
                 retain=w.retain,
@@ -439,6 +469,12 @@ class Channel:
 
     # -- outbound deliveries ----------------------------------------------
     def handle_deliver(self, msg: Message, opts: pkt.SubOpts) -> None:
+        if self.mountpoint and msg.topic.startswith(self.mountpoint):
+            # unmount on the way out (emqx_channel.erl:970-976)
+            import copy
+
+            msg = copy.copy(msg)
+            msg.topic = MP.unmount(self.mountpoint, msg.topic)
         if self.state != "connected" or self.session is None:
             # connection-less window (e.g. between takeover begin/end):
             # park in the session queue for replay

@@ -45,6 +45,9 @@ class ListenerSpec:
     ssl_keyfile: Optional[str] = None
     ssl_cacertfile: Optional[str] = None
     ssl_verify: bool = False
+    # topic namespace prefix for clients of this listener; supports
+    # ${clientid}/${username} placeholders (emqx_mountpoint.erl parity)
+    mountpoint: Optional[str] = None
 
 
 @dataclass

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import threading
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
@@ -152,16 +153,19 @@ class ExhookServer:
         self.hooks: Dict[str, List[str]] = {}  # hook -> topic filters
         self.metrics = defaultdict(lambda: {"succeed": 0, "failed": 0})
         self.loaded = False
-        # one worker per lane, off the event loop: notifications must not
-        # delay latency-sensitive valued calls (auth/authorize/publish),
-        # so each lane gets its own single thread (per-lane ordering)
+        # two lanes off the event loop: notifications (1 thread, ordered,
+        # fire-and-forget) must not delay latency-sensitive valued calls
+        # (auth/authorize/publish), which get pool_size workers — per-
+        # connection ordering is already guaranteed by the awaiting task
         self._pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"exhook-{name}-notify"
         )
         self._pool_valued = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"exhook-{name}-valued"
+            max_workers=max(1, pool_size),
+            thread_name_prefix=f"exhook-{name}-valued",
         )
-        self._notify_backlog = 0
+        self._notify_backlog = 0  # guarded by _notify_lock (worker thread
+        self._notify_lock = threading.Lock()  # decrements, loop increments)
         self._notify_backlog_max = 1000
         self._consec_failures = 0
         self._breaker_threshold = breaker_threshold
@@ -259,20 +263,27 @@ class ExhookServer:
             return False, None
 
     def _notify_done(self, _fut) -> None:
-        self._notify_backlog -= 1
+        with self._notify_lock:
+            self._notify_backlog -= 1
 
     def notify(self, method: str, request, hook: str) -> None:
         """Fire-and-forget: enqueue on the notify worker; drop when shut
         down or when the backlog is deep (a stalled sidecar must not grow
         an unbounded queue of stale notifications)."""
-        if self._notify_backlog >= self._notify_backlog_max:
+        with self._notify_lock:
+            if self._notify_backlog >= self._notify_backlog_max:
+                drop = True
+            else:
+                drop = False
+                self._notify_backlog += 1
+        if drop:
             self.metrics[hook]["failed"] += 1
             return
         try:
             fut = self._pool.submit(self.call, method, request, hook)
         except RuntimeError:
+            self._notify_done(None)
             return
-        self._notify_backlog += 1
         fut.add_done_callback(self._notify_done)
 
     def info(self) -> Dict:
@@ -507,15 +518,19 @@ class ExhookManager:
                 if s.failed_action == "deny":
                     return ("stop", {"result": "deny"})
                 continue
-            if resp.type == pb.ValuedResponse.ResponsedType.STOP_AND_RETURN:
-                if resp.WhichOneof("value") == "bool_result":
-                    verdict = (
-                        {"result": "allow"}
-                        if resp.bool_result
-                        else {"result": "deny"}
-                    )
+            rt = pb.ValuedResponse.ResponsedType
+            if resp.type == rt.IGNORE:
+                continue
+            if resp.WhichOneof("value") == "bool_result":
+                verdict = (
+                    {"result": "allow"}
+                    if resp.bool_result
+                    else {"result": "deny"}
+                )
+                if resp.type == rt.STOP_AND_RETURN:
                     return ("stop", verdict)
-        return None  # keep acc
+                acc = verdict  # CONTINUE: use the value, keep folding
+        return ("ok", acc)
 
     # fold: (ci, action, topic), acc "allow"/"deny"/"disconnect"
     async def _on_authorize(self, ci, action, topic, acc):
@@ -539,10 +554,15 @@ class ExhookManager:
                 if s.failed_action == "deny":
                     return ("stop", "deny")
                 continue
-            if resp.type == pb.ValuedResponse.ResponsedType.STOP_AND_RETURN:
-                if resp.WhichOneof("value") == "bool_result":
-                    return ("stop", "allow" if resp.bool_result else "deny")
-        return None
+            rt = pb.ValuedResponse.ResponsedType
+            if resp.type == rt.IGNORE:
+                continue
+            if resp.WhichOneof("value") == "bool_result":
+                verdict = "allow" if resp.bool_result else "deny"
+                if resp.type == rt.STOP_AND_RETURN:
+                    return ("stop", verdict)
+                acc = verdict  # CONTINUE: use the value, keep folding
+        return ("ok", acc)
 
     # fold: (), acc Message. Coroutine: fires for client-originated
     # publishes (Broker.apublish via the channel); internally generated
@@ -567,11 +587,14 @@ class ExhookManager:
                     m2.headers["allow_publish"] = False
                     return ("stop", m2)
                 continue
-            if (
-                resp.type == pb.ValuedResponse.ResponsedType.STOP_AND_RETURN
-                and resp.WhichOneof("value") == "message"
-            ):
+            rt = pb.ValuedResponse.ResponsedType
+            if resp.type == rt.IGNORE:
+                continue
+            if resp.WhichOneof("value") == "message":
                 m = _apply_msg(m, resp.message)
+                if resp.type == rt.STOP_AND_RETURN:
+                    # stop the whole message.publish chain, not just exhook
+                    return ("stop", m)
         return ("ok", m)
 
     def info(self) -> List[Dict]:

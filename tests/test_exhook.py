@@ -250,3 +250,85 @@ def test_wire_compat_service_path_and_layout():
     assert msg.fields_by_name["topic"].number == 5
     assert msg.fields_by_name["headers"].number == 8
     assert pb.DESCRIPTOR.package == "emqx.exhook.v1"
+
+
+def test_valued_response_continue_and_stop_semantics():
+    """Reference merge_responsed_* semantics (emqx_exhook_handler.erl:
+    341-359): CONTINUE applies the value and keeps folding; IGNORE skips;
+    STOP_AND_RETURN applies the value and stops the chain."""
+
+    class ContinueRewriter(HookProviderServicer):
+        def OnMessagePublish(self, request, context):
+            out = pb.Message()
+            out.CopyFrom(request.message)
+            out.payload = b"[A]" + bytes(out.payload)
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.ResponsedType.CONTINUE, message=out
+            )
+
+        def OnClientAuthenticate(self, request, context):
+            # CONTINUE verdict: used, but later providers may override
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.ResponsedType.CONTINUE,
+                bool_result=False,
+            )
+
+    class StopRewriter(HookProviderServicer):
+        def OnMessagePublish(self, request, context):
+            out = pb.Message()
+            out.CopyFrom(request.message)
+            out.payload = bytes(out.payload) + b"[B-stop]"
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.ResponsedType.STOP_AND_RETURN,
+                message=out,
+            )
+
+        def OnClientAuthenticate(self, request, context):
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.ResponsedType.STOP_AND_RETURN,
+                bool_result=True,
+            )
+
+    class NeverReached(HookProviderServicer):
+        def __init__(self):
+            self.publish_calls = 0
+
+        def OnMessagePublish(self, request, context):
+            self.publish_calls += 1
+            return self.continue_()
+
+    sA, pA = serve(ContinueRewriter())
+    sB, pB = serve(StopRewriter())
+    never = NeverReached()
+    sC, pC = serve(never)
+    try:
+        hooks = Hooks()
+        broker = Broker(hooks=hooks)
+        mgr = ExhookManager(version="test")
+        for name, port in (("a", pA), ("b", pB), ("c", pC)):
+            assert mgr.add_server(
+                ExhookServer(name=name, url=f"127.0.0.1:{port}")
+            )
+        mgr.attach(hooks)
+        from emqx_tpu.mqtt import packet as pkt
+
+        got = []
+        broker.subscribe("s", "c", "t", pkt.SubOpts(), lambda m, o: got.append(m))
+        _apub(broker, Message(topic="t", payload=b"x"))
+        # A's CONTINUE rewrite applied, B's STOP rewrite applied, C never saw it
+        assert got and got[0].payload == b"[A]x[B-stop]"
+        assert never.publish_calls == 0
+
+        # authenticate: A says deny-but-continue, B says allow-and-stop
+        verdict = asyncio.run(
+            hooks.arun_fold(
+                "client.authenticate",
+                ({"client_id": "c"}, {"password": b""}),
+                None,
+            )
+        )
+        assert isinstance(verdict, dict) and verdict["result"] == "allow"
+        mgr.shutdown()
+    finally:
+        for srv in (sA, sB, sC):
+            srv.stop(None)

@@ -1,0 +1,122 @@
+"""Mountpoint tests (reference: apps/emqx/src/emqx_mountpoint.erl and the
+channel pipeline mount/unmount points in emqx_channel.erl:624/722/976).
+
+A mountpointed listener confines its clients to a topic namespace: topics
+are prefixed on publish/subscribe and the prefix is stripped on delivery,
+invisibly to the client. Placeholders resolve per client at CONNECT.
+"""
+
+import asyncio
+
+from emqx_tpu.broker import mountpoint as MP
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.mqtt.client import Client
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+from tests.test_ws import async_test
+
+
+def test_mount_unmount_replvar_unit():
+    assert MP.mount(None, "a/b") == "a/b"
+    assert MP.mount("dev/1/", "a/b") == "dev/1/a/b"
+    assert MP.unmount("dev/1/", "dev/1/a/b") == "a/b"
+    assert MP.unmount("dev/1/", "other/a") == "other/a"  # nomatch passthru
+    # $share filters mount the real topic inside the wrapper
+    assert MP.mount("mp/", "$share/g/t/+") == "$share/g/mp/t/+"
+    assert MP.replvar("u/${username}/c/${clientid}/",
+                      {"client_id": "c1", "username": "alice"}) \
+        == "u/alice/c/c1/"
+    # absent vars keep the placeholder (reference feed_var semantics)
+    assert MP.replvar("u/${username}/", {"client_id": "c1"}) \
+        == "u/${username}/"
+
+
+class MountBed:
+    __test__ = False
+
+    def __init__(self, mountpoint):
+        self.broker = Broker(hooks=Hooks())
+        self.cm = ChannelManager(self.broker)
+        self.listeners = Listeners(self.broker, self.cm)
+        self.mountpoint = mountpoint
+        self.mounted_port = None
+        self.plain_port = None
+
+    async def __aenter__(self):
+        mounted = await self.listeners.start_listener(
+            ListenerConfig(name="m", type="tcp", bind="127.0.0.1", port=0),
+            ChannelConfig(mountpoint=self.mountpoint),
+        )
+        plain = await self.listeners.start_listener(
+            ListenerConfig(name="p", type="tcp", bind="127.0.0.1", port=0),
+            ChannelConfig(),
+        )
+        self.mounted_port = mounted.port
+        self.plain_port = plain.port
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.listeners.stop_all()
+
+
+@async_test
+async def test_mounted_clients_namespaced_and_transparent():
+    async with MountBed("tenant/a/") as bed:
+        # two clients on the mounted listener talk transparently
+        sub = Client(client_id="m-sub")
+        await sub.connect("127.0.0.1", bed.mounted_port)
+        await sub.subscribe("room/+", qos=1)
+        pub = Client(client_id="m-pub")
+        await pub.connect("127.0.0.1", bed.mounted_port)
+        await pub.publish("room/1", b"hi", qos=1)
+        m = await sub.recv(3)
+        assert m.topic == "room/1" and m.payload == b"hi"
+
+        # a plain-listener client must use the full mounted name
+        spy = Client(client_id="spy")
+        await spy.connect("127.0.0.1", bed.plain_port)
+        await spy.subscribe("tenant/a/room/+", qos=1)
+        await pub.publish("room/2", b"seen", qos=1)
+        m = await spy.recv(3)
+        assert m.topic == "tenant/a/room/2" and m.payload == b"seen"
+        m = await sub.recv(3)  # sub's room/+ matches too (unmounted view)
+        assert m.topic == "room/2"
+
+        # and the mounted client cannot see outside its namespace
+        await spy.publish("outside/t", b"invisible", qos=1)
+        await sub.subscribe("outside/t", qos=1)  # becomes tenant/a/outside/t
+        await spy.publish("outside/t", b"still-invisible", qos=1)
+        try:
+            await sub.recv(0.3)
+            raise AssertionError("mounted client escaped its namespace")
+        except asyncio.TimeoutError:
+            pass
+        for c in (sub, pub, spy):
+            await c.disconnect()
+
+
+@async_test
+async def test_mountpoint_placeholders_per_client():
+    async with MountBed("u/${clientid}/") as bed:
+        a = Client(client_id="ca")
+        await a.connect("127.0.0.1", bed.mounted_port)
+        await a.subscribe("inbox", qos=1)
+        spy = Client(client_id="spy")
+        await spy.connect("127.0.0.1", bed.plain_port)
+        await spy.publish("u/ca/inbox", b"for-ca", qos=1)
+        m = await a.recv(3)
+        assert m.topic == "inbox" and m.payload == b"for-ca"
+        # another client's namespace is isolated
+        b = Client(client_id="cb")
+        await b.connect("127.0.0.1", bed.mounted_port)
+        await b.subscribe("inbox", qos=1)
+        await spy.publish("u/ca/inbox", b"not-for-cb", qos=1)
+        try:
+            await b.recv(0.3)
+            raise AssertionError("placeholder mountpoint leaked across clients")
+        except asyncio.TimeoutError:
+            pass
+        for c in (a, b, spy):
+            await c.disconnect()
