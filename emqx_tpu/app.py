@@ -314,6 +314,33 @@ class BrokerApp:
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         c = self.config
+        # publish batch aggregator: live connection traffic rides the device
+        # route path (broker/ingest.py) once the loop is running
+        if c.router.ingest_enable and c.router.enable_tpu:
+            from emqx_tpu.broker.ingest import BatchIngest
+
+            self.broker.ingest = BatchIngest(
+                self.broker,
+                max_batch=c.router.ingest_max_batch,
+                window_us=c.router.ingest_window_us,
+            )
+            self.broker.ingest.start()
+            # pre-warm the route_step kernel for the smallest batch bucket
+            # BEFORE listeners accept: first-contact compile on a real chip
+            # is tens of seconds and must not land on live publishers
+            try:
+                dev = self.broker._device_router()
+                args = dev.prepare()
+                await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    dev.route_prepared,
+                    args,
+                    ["warmup/a"] * max(1, c.router.min_tpu_batch),
+                )
+            except Exception:
+                logging.getLogger("emqx_tpu").exception(
+                    "device route warmup failed; serving with cold kernel"
+                )
         # restore durable state BEFORE listeners accept clients
         if self.session_persistence is not None:
             restored = self.session_persistence.restore()
@@ -361,6 +388,9 @@ class BrokerApp:
         ]
 
     async def stop(self) -> None:
+        if self.broker.ingest is not None:
+            await self.broker.ingest.stop()
+            self.broker.ingest = None
         for t in self._tasks:
             t.cancel()
         if self._tasks:

@@ -16,7 +16,10 @@ the session's registered deliver callback. Shared-subscription groups
 
 from __future__ import annotations
 
+import asyncio
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from emqx_tpu.broker.hooks import Hooks, default_hooks
 from emqx_tpu.broker.message import Message
@@ -31,13 +34,14 @@ Deliverer = Callable[[Message, pkt.SubOpts], None]
 
 
 class Subscriber:
-    __slots__ = ("sid", "deliver", "opts", "client_id")
+    __slots__ = ("sid", "deliver", "opts", "client_id", "slot")
 
     def __init__(self, sid: str, client_id: str, deliver: Deliverer, opts: pkt.SubOpts):
         self.sid = sid
         self.client_id = client_id
         self.deliver = deliver
         self.opts = opts
+        self.slot = -1  # device bitmap slot (non-shared subs only)
 
 
 class Broker:
@@ -47,12 +51,25 @@ class Broker:
         hooks: Optional[Hooks] = None,
         metrics: Optional[Metrics] = None,
     ):
-        self.router = router or Router()
+        # NOT `router or Router()`: Router defines __len__, so an EMPTY
+        # router is falsy and would be silently swapped for a default one
+        self.router = router if router is not None else Router()
         self.hooks = hooks or default_hooks
         self.metrics = metrics or Metrics()
         # filter -> {sid -> Subscriber}
         self._subs: Dict[str, Dict[str, Subscriber]] = {}
         self.shared = SharedSub()
+        # device fan-out state: every non-shared Subscriber entry gets a
+        # dense bitmap slot; (filter id, slot) rides to the device so the
+        # route_step kernel resolves topic -> subscriber bits directly
+        # (emqx_broker.erl:505-530 do_dispatch, as one gather+OR)
+        from emqx_tpu.models.router_model import SubscriberTable
+
+        self.subtab = SubscriberTable()
+        self._slot_subs: List[Optional[Subscriber]] = []
+        self._free_slots: List[int] = []
+        self._device = None  # lazy DeviceRouter
+        self.ingest = None  # BatchIngest, attached by the app
 
     # -- subscribe side ---------------------------------------------------
     def subscribe(
@@ -66,15 +83,25 @@ class Broker:
         group, real = T.parse_share(filter_)
         sub = Subscriber(sid, client_id, deliver, opts)
         if group is not None:
-            self.shared.subscribe(group, real, sub)
-            route_key = self.shared.route_filter(group, real)
+            # one route ref per group (matched by delete on group-empty)
+            if self.shared.subscribe(group, real, sub):
+                self.router.add_route(self.shared.route_filter(group, real))
         else:
             entry = self._subs.setdefault(real, {})
+            prev = entry.get(sid)
             first = not entry
             entry[sid] = sub
-            route_key = real if first else None
-        if route_key is not None:
-            self.router.add_route(route_key)
+            if first:
+                self.router.add_route(real)
+            if prev is not None:
+                # re-subscribe with fresh opts: keep the slot, swap the sub
+                sub.slot = prev.slot
+                self._slot_subs[sub.slot] = sub
+            else:
+                sub.slot = self._alloc_slot(sub)
+                fid = self.router.filter_id(real)
+                if fid is not None:
+                    self.subtab.add(fid, sub.slot)
         self.metrics.gauge_set("subscriptions.count", self.subscription_count())
 
     def unsubscribe(self, sid: str, filter_: str) -> bool:
@@ -87,12 +114,29 @@ class Broker:
         entry = self._subs.get(real)
         if not entry or sid not in entry:
             return False
-        del entry[sid]
+        sub = entry.pop(sid)
+        if sub.slot >= 0:
+            fid = self.router.filter_id(real)
+            if fid is not None:
+                self.subtab.remove(fid, sub.slot)
+            self._free_slot(sub.slot)
         if not entry:
             del self._subs[real]
             self.router.delete_route(real)
         self.metrics.gauge_set("subscriptions.count", self.subscription_count())
         return True
+
+    def _alloc_slot(self, sub: Subscriber) -> int:
+        if self._free_slots:
+            slot = self._free_slots.pop()
+            self._slot_subs[slot] = sub
+            return slot
+        self._slot_subs.append(sub)
+        return len(self._slot_subs) - 1
+
+    def _free_slot(self, slot: int) -> None:
+        self._slot_subs[slot] = None
+        self._free_slots.append(slot)
 
     def subscription_count(self) -> int:
         return sum(len(v) for v in self._subs.values()) + self.shared.count()
@@ -114,15 +158,40 @@ class Broker:
     async def apublish(self, msg: Message) -> int:
         """Async `publish` for the connection path: awaits async hooks
         (exhook sidecars) so a slow extension suspends only the publishing
-        client's task, not the event loop."""
+        client's task, not the event loop. When a BatchIngest is attached,
+        the folded message rides the adaptive batch window onto the device
+        route path instead of a per-message CPU match."""
+        r = await self.apublish_enqueue(msg)
+        return r if isinstance(r, int) else await r
+
+    async def apublish_enqueue(self, msg: Message):
+        """Pipelined publish: fold + enqueue WITHOUT awaiting dispatch.
+
+        Returns either an int (dispatched inline / dropped) or an
+        asyncio.Future resolving to the delivery count when the batch
+        flushes. This is what lets a connection keep parsing subsequent
+        frames while earlier publishes ride the batch window — the analog
+        of the reference's active-N=100 socket pipeline
+        (emqx_connection.erl:125), without which one connection could never
+        have more than one message in a batch.
+        """
         msg = await self.hooks.arun_fold("message.publish", (), msg)
-        return self._publish_folded(msg)
+        if msg is None or msg.headers.get("allow_publish") is False:
+            self.metrics.inc("messages.dropped")
+            return 0
+        ing = self.ingest
+        if ing is not None and ing.running:
+            return ing.enqueue(msg)
+        return self._dispatch_routed(msg)
 
     def _publish_folded(self, msg: Optional[Message]) -> int:
         """Shared tail of publish/apublish after the message.publish fold."""
         if msg is None or msg.headers.get("allow_publish") is False:
             self.metrics.inc("messages.dropped")
             return 0
+        return self._dispatch_routed(msg)
+
+    def _dispatch_routed(self, msg: Message) -> int:
         n = self._route_dispatch(msg, self.router.match(msg.topic))
         if n == 0:
             self.hooks.run("message.dropped", msg, "no_subscribers")
@@ -136,14 +205,92 @@ class Broker:
             m = self.hooks.run_fold("message.publish", (), m)
             if m is not None and m.headers.get("allow_publish") is not False:
                 msgs2.append(m)
-        matches = self.router.match_batch([m.topic for m in msgs2])
-        total = 0
-        for m, filters in zip(msgs2, matches):
-            n = self._route_dispatch(m, filters)
+        return sum(self.dispatch_batch_folded(msgs2))
+
+    def dispatch_batch_folded(self, msgs: Sequence[Message]) -> List[int]:
+        """Route + dispatch already-folded messages as one device step.
+
+        The full flagship pipeline: tokenize + NFA match + bitmap fan-out in
+        one jitted route_step, then host delivery straight from subscriber
+        bits. Rows the kernel flags (too deep / overflow) fall back to the
+        authoritative CPU path per row; batches too small to amortize a
+        dispatch skip the device entirely.
+        """
+        r = self.router
+        if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
+            return [self._dispatch_routed(m) for m in msgs]
+        dev = self._device_router()
+        results = dev.route([m.topic for m in msgs])
+        return self._dispatch_device_results(msgs, results)
+
+    async def adispatch_batch_folded(self, msgs: Sequence[Message]) -> List[int]:
+        """`dispatch_batch_folded` with the kernel launch + readback (and
+        any jit recompile, which can take tens of seconds on a real chip)
+        offloaded to an executor thread so the event loop keeps serving
+        every other connection. Table packing/upload and delivery stay on
+        the loop thread — they touch mutable broker state."""
+        r = self.router
+        if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
+            return [self._dispatch_routed(m) for m in msgs]
+        dev = self._device_router()
+        args = dev.prepare()
+        results = await asyncio.get_running_loop().run_in_executor(
+            None, dev.route_prepared, args, [m.topic for m in msgs]
+        )
+        return self._dispatch_device_results(msgs, results)
+
+    def _device_router(self):
+        if self._device is None:
+            from emqx_tpu.models.router_model import DeviceRouter
+
+            self._device = DeviceRouter(
+                self.router.builder, self.subtab, self.router.matcher.config
+            )
+        return self._device
+
+    def _dispatch_device_results(self, msgs, results) -> List[int]:
+        matched, mcount, flags, bitmaps = results
+        r = self.router
+        out: List[int] = []
+        fell_back = 0
+        for i, m in enumerate(msgs):
+            if flags[i]:
+                fell_back += 1
+                n = self._route_dispatch(m, r.match(m.topic))
+            else:
+                n = self._dispatch_row(m, bitmaps[i], matched[i, : mcount[i]])
             if n == 0:
                 self.hooks.run("message.dropped", m, "no_subscribers")
-            total += n
-        return total
+                self.metrics.inc("messages.dropped.no_subscribers")
+            out.append(n)
+        if fell_back:
+            self.metrics.inc("messages.routed.device_fallback", fell_back)
+        self.metrics.inc("messages.routed.device", len(msgs) - fell_back)
+        return out
+
+    def _dispatch_row(self, msg: Message, bits: np.ndarray, fids) -> int:
+        """Deliver one routed message from its device outputs: subscriber
+        bitmap -> slots -> plain subs; matched filter ids -> shared groups."""
+        self.metrics.inc("messages.received")
+        n = 0
+        slots = np.nonzero(
+            np.unpackbits(bits.view(np.uint8), bitorder="little")
+        )[0]
+        nslots = len(self._slot_subs)
+        for slot in slots:
+            sub = self._slot_subs[slot] if slot < nslots else None
+            if sub is None:
+                continue
+            if sub.opts.no_local and sub.client_id == msg.from_client:
+                continue
+            n += self._deliver_one(sub, msg)
+        for fid in fids:
+            name = self.router.builder.filter_name(int(fid))
+            if name is not None and self.shared.has_groups(name):
+                n += self.shared.dispatch_groups(name, msg)
+        if n:
+            self.metrics.inc("messages.delivered", n)
+        return n
 
     def dispatch(self, filters: List[str], msg: Message) -> int:
         """Deliver to local subscribers of pre-matched filters.
@@ -171,12 +318,21 @@ class Broker:
                 for sub in list(entry.values()):
                     if sub.opts.no_local and sub.client_id == msg.from_client:
                         continue
-                    sub.deliver(msg, sub.opts)
-                    n += 1
+                    n += self._deliver_one(sub, msg)
             n += self.shared.dispatch_groups(f, msg)
         if n:
             self.metrics.inc("messages.delivered", n)
         return n
+
+    def _deliver_one(self, sub: Subscriber, msg: Message) -> int:
+        """One raising deliverer must not poison the rest of the fan-out
+        (or, on the batch path, every other message in the batch)."""
+        try:
+            sub.deliver(msg, sub.opts)
+            return 1
+        except Exception:
+            self.metrics.inc("delivery.errors")
+            return 0
 
     def drop_session_subs(self, sid: str, filters: Sequence[str]) -> None:
         """Bulk cleanup when a session dies (emqx_broker_helper pmon parity)."""

@@ -13,8 +13,10 @@ machine serves TCP, TLS, WebSocket and in-process tests.
 
 from __future__ import annotations
 
+import asyncio
 import secrets
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -83,6 +85,12 @@ class Channel:
         self.auth_attrs: Dict = {}
         # resolved at CONNECT via MP.replvar (placeholders need clientid)
         self.mountpoint: Optional[str] = None
+        # pipelined-publish ack queue (active-N analog,
+        # emqx_connection.erl:125): entries settle strictly FIFO so acks
+        # keep MQTT-4.6.0 ordering even when dispatches resolve out of band
+        self._ack_queue: deque = deque()
+        self._ack_task: Optional[asyncio.Task] = None
+        self._ack_drained: Optional[asyncio.Event] = None
 
     # -- helpers ----------------------------------------------------------
     def _send(self, p) -> None:
@@ -306,7 +314,8 @@ class Channel:
                 packet_id=p.packet_id, reason_code=pkt.RC_NOT_AUTHORIZED
             )
             ack.type = pkt.PUBACK if p.qos == 1 else pkt.PUBREC
-            return self._send(ack)
+            # through the ack queue: earlier pipelined publishes must ack first
+            return self._enqueue_ack(0, lambda n: self._send(ack))
 
         if self.session is None or self.state != "connected":
             return  # kicked while awaiting the authorize hook
@@ -322,27 +331,104 @@ class Channel:
             },
         )
         if p.qos == 0:
-            await self.broker.apublish(msg)
+            r = await self._publish_pipelined(msg)
+            if not isinstance(r, int):
+                self._enqueue_ack(r)
             return
         if p.qos == 1:
-            n = await self.broker.apublish(msg)
-            rc = pkt.RC_SUCCESS
-            if n == 0 and self.version == pkt.MQTT_V5:
-                rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
-            return self._send(pkt.PubAck(packet_id=p.packet_id, reason_code=rc))
+            r = await self._publish_pipelined(msg)
+            pid = p.packet_id
+            return self._enqueue_ack(
+                r, lambda n: self._send_pub_ack(pid, n, pkt.PUBACK)
+            )
         # QoS2: publish on first sight of the packet id, dedupe on DUP resend
         try:
             fresh = self.session.await_rel(p.packet_id)
         except OverflowError:
             return self._close("receive_max", pkt.RC_RECEIVE_MAXIMUM_EXCEEDED)
-        rc = pkt.RC_SUCCESS
+        pid = p.packet_id
+        send_rec = lambda n: self._send_pub_ack(pid, n, pkt.PUBREC)  # noqa: E731
         if fresh:
-            n = await self.broker.apublish(msg)
-            if n == 0 and self.version == pkt.MQTT_V5:
-                rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
-        rec = pkt.PubAck(packet_id=p.packet_id, reason_code=rc)
-        rec.type = pkt.PUBREC
-        self._send(rec)
+            r = await self._publish_pipelined(msg)
+            # on dispatch failure the dedup record must be rolled back, or
+            # the client's retransmit would be "DUP"-acked without the
+            # message ever publishing (silent QoS2 loss)
+            sess = self.session
+            self._enqueue_ack(
+                r, send_rec, on_fail=lambda: sess.release_rel(pid)
+            )
+        else:
+            self._enqueue_ack(-1, send_rec)  # dup: never no-subscribers rc
+
+    # active-N analog (emqx_connection.erl:125 ?ACTIVE_N): how many
+    # publishes one channel may have riding the batch window before the
+    # read path stalls awaiting the oldest dispatch (backpressure)
+    PUB_PIPELINE_MAX = 100
+
+    async def _publish_pipelined(self, msg: Message):
+        """Enqueue to the batch ingest without awaiting dispatch (returns a
+        future). At the pipeline cap, stall the read path until the ack
+        drainer catches up — ordering is preserved either way."""
+        while len(self._ack_queue) >= self.PUB_PIPELINE_MAX:
+            self._ack_drained = asyncio.Event()
+            await self._ack_drained.wait()
+        return await self.broker.apublish_enqueue(msg)
+
+    def _send_pub_ack(self, packet_id: int, n: int, ack_type: int) -> None:
+        rc = pkt.RC_SUCCESS
+        if n == 0 and self.version == pkt.MQTT_V5:
+            rc = pkt.RC_NO_MATCHING_SUBSCRIBERS
+        ack = pkt.PubAck(packet_id=packet_id, reason_code=rc)
+        ack.type = ack_type
+        self._send(ack)
+
+    def _enqueue_ack(self, r, send=None, on_fail=None) -> None:
+        """Settle a publish through the FIFO ack queue.
+
+        `r` is an int (already dispatched) or a future. `send(n)` emits the
+        ack; `on_fail()` rolls back state if the dispatch errored. The fast
+        path (resolved result, empty queue) acks inline; otherwise a single
+        drainer task per channel settles entries strictly in order.
+        """
+        if isinstance(r, int) and not self._ack_queue:
+            if send is not None:
+                send(r)
+            return
+        self._ack_queue.append((r, send, on_fail))
+        if self._ack_task is None or self._ack_task.done():
+            self._ack_task = asyncio.ensure_future(self._drain_acks())
+
+    async def _drain_acks(self) -> None:
+        while self._ack_queue:
+            r, send, on_fail = self._ack_queue.popleft()
+            if isinstance(r, int):
+                n = r
+            else:
+                try:
+                    n = await r
+                except Exception:
+                    # dispatch failed inside the flusher; roll back and let
+                    # the client retransmit
+                    self.broker.metrics.inc("messages.dispatch_error")
+                    if on_fail is not None:
+                        try:
+                            on_fail()
+                        except Exception:
+                            pass
+                    self._signal_drained()
+                    continue
+            self._signal_drained()
+            if send is None or self.state != "connected":
+                continue
+            try:
+                send(n)
+            except Exception:
+                pass  # transport already torn down
+
+    def _signal_drained(self) -> None:
+        if self._ack_drained is not None:
+            self._ack_drained.set()
+            self._ack_drained = None
 
     # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
     async def _in_subscribe(self, p: pkt.Subscribe) -> None:

@@ -1,0 +1,117 @@
+"""Ingest-side publish batch aggregation (adaptive batch window).
+
+SURVEY.md §7 hard part (c): the device route path wants big batches, but a
+publishing client wants low latency. This aggregator sits between the
+channel's publish and the router: concurrent publishes from all connections
+collect into one list, flushed when either `max_batch` messages are pending
+or `window_us` has elapsed since the flusher woke — so a lone publisher
+pays at most one window of added latency while a firehose fills batches
+immediately and never sleeps.
+
+The reference has no analog — its hot loop is per-message per-process
+(emqx_broker.erl:204-215); this is the TPU-era replacement for that regime,
+turning N concurrent publishes into one route_step kernel launch
+(emqx_tpu.models.router_model.DeviceRouter).
+
+Backpressure: `submit` awaits the flush result, so a publisher's PUBACK
+reflects actual dispatch; the pending list is bounded only by connection
+count x inflight windows, which the per-connection limiters already cap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional, Tuple
+
+from emqx_tpu.broker.message import Message
+
+log = logging.getLogger("emqx_tpu.ingest")
+
+
+class BatchIngest:
+    def __init__(
+        self,
+        broker,
+        max_batch: int = 4096,
+        window_us: int = 1000,
+    ):
+        self.broker = broker
+        self.max_batch = max_batch
+        self.window_s = window_us / 1e6
+        self._pending: List[Tuple[Message, asyncio.Future]] = []
+        self._event = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self.running = False
+
+    def start(self) -> None:
+        if self._task is None:
+            self.running = True
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self.running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        # drain anything still pending so no publisher hangs on shutdown
+        while self._pending:
+            batch = self._pending[: self.max_batch]
+            del self._pending[: self.max_batch]
+            await self._settle(batch)
+
+    def enqueue(self, msg: Message) -> asyncio.Future:
+        """Enqueue one folded message; the future resolves with its
+        delivery count when the batch flushes."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((msg, fut))
+        self._event.set()
+        return fut
+
+    async def submit(self, msg: Message) -> int:
+        return await self.enqueue(msg)
+
+    async def _settle(self, batch: List[Tuple[Message, asyncio.Future]]) -> None:
+        try:
+            results = await self.broker.adispatch_batch_folded(
+                [m for m, _ in batch]
+            )
+        except Exception as e:  # noqa: BLE001 — flusher must survive
+            log.exception("batch dispatch failed; failing %d publishes", len(batch))
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), n in zip(batch, results):
+            if not fut.done():
+                fut.set_result(n)
+
+    def _engage_threshold(self) -> int:
+        # below this pending count the device path won't engage anyway
+        # (broker.dispatch_batch_folded falls back per-message), so waiting
+        # a window would tax latency for zero batching gain
+        return max(2, self.broker.router.min_tpu_batch)
+
+    async def _run(self) -> None:
+        while True:
+            await self._event.wait()
+            # one loop tick: every connection task that is ready to publish
+            # gets to enqueue before we decide whether a window is worth it
+            await asyncio.sleep(0)
+            if (
+                self.window_s > 0
+                and len(self._pending) >= self._engage_threshold()
+                and len(self._pending) < self.max_batch
+            ):
+                # real concurrency: hold the window open to fill the batch
+                await asyncio.sleep(self.window_s)
+            batch = self._pending[: self.max_batch]
+            del self._pending[: self.max_batch]
+            if not self._pending:
+                self._event.clear()
+            if batch:
+                await self._settle(batch)

@@ -84,6 +84,9 @@ class Router:
             return [self.match(t) for t in topics]
         return self._matcher.match_batch(topics, fallback=self.match)
 
+    def filter_id(self, filter_: str) -> Optional[int]:
+        return self._builder.filter_id(filter_)
+
     @property
     def builder(self) -> NfaBuilder:
         return self._builder

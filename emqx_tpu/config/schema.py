@@ -58,6 +58,11 @@ class RouterConfig:
     frontier: int = 32
     max_matches: int = 64
     max_bytes: int = 256
+    # ingest-side adaptive batch window (broker/ingest.py): collect
+    # concurrent publishes into one device route_step
+    ingest_enable: bool = True
+    ingest_window_us: int = 1000
+    ingest_max_batch: int = 4096
 
 
 @dataclass

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from emqx_tpu.ops.matcher import batch_match_bytes_impl
+from emqx_tpu.ops.nfa import _next_pow2
 
 
 def popcount32(x):
@@ -106,45 +107,161 @@ class SubscriberTable:
 
     The reference keeps subscribers in per-node ETS bag tables
     (emqx_broker.erl:98-110). Here each local subscriber gets a dense slot;
-    the bitmap matrix rides to the device alongside the NFA tables.
+    the bitmap matrix rides to the device alongside the NFA tables. The slot
+    axis auto-grows (power-of-two words) so the live broker never caps its
+    subscriber count; growth recompiles the route_step kernel once per
+    doubling.
     """
 
     def __init__(self, max_subscribers: int = 1024):
-        self.width_words = (max_subscribers + 31) // 32
+        self.width_words = max(2, (max_subscribers + 31) // 32)
         self._rows: Dict[int, np.ndarray] = {}
         self._fcap = 64
         self._dirty = True
         self._packed: np.ndarray | None = None
+        self.version = 0
+
+    def _ensure_slot(self, slot: int) -> None:
+        need = slot // 32 + 1
+        if need > self.width_words:
+            w = _next_pow2(need)
+            for fid, row in self._rows.items():
+                nr = np.zeros(w, dtype=np.uint32)
+                nr[: len(row)] = row
+                self._rows[fid] = nr
+            self.width_words = w
 
     def add(self, filter_id: int, slot: int) -> None:
+        self._ensure_slot(slot)
         row = self._rows.get(filter_id)
         if row is None:
             row = np.zeros(self.width_words, dtype=np.uint32)
             self._rows[filter_id] = row
         row[slot // 32] |= np.uint32(1 << (slot % 32))
         self._dirty = True
+        self.version += 1
 
     def remove(self, filter_id: int, slot: int) -> None:
         row = self._rows.get(filter_id)
-        if row is None:
+        if row is None or slot // 32 >= len(row):
             return
         row[slot // 32] &= np.uint32(~(1 << (slot % 32)) & 0xFFFFFFFF)
         if not row.any():
             del self._rows[filter_id]
         self._dirty = True
+        self.version += 1
 
     def pack(self, filter_capacity: int) -> np.ndarray:
         # capacity must cover every registered row — dropping one would mean
         # silent message loss for that filter's subscribers
         cap = max(64, filter_capacity, max(self._rows, default=0) + 1)
-        if not self._dirty and self._packed is not None and len(self._packed) >= cap:
+        if (
+            not self._dirty
+            and self._packed is not None
+            and len(self._packed) >= cap
+            and self._packed.shape[1] == self.width_words
+        ):
             return self._packed
         while self._fcap < cap:
             self._fcap *= 2
         out = np.zeros((self._fcap, self.width_words), dtype=np.uint32)
         for fid, row in self._rows.items():
-            out[fid] = row
+            out[fid, : len(row)] = row
         out.setflags(write=False)  # callers share the cache; freeze it
         self._packed = out
         self._dirty = False
         return out
+
+
+class DeviceRouter:
+    """Serving-path engine: owns the device copies of the NFA tables and the
+    subscriber bitmaps and runs `route_step` over host batches.
+
+    This is what puts the flagship kernel on the broker's hot path (the
+    reference analog is the emqx_router:match_routes + emqx_broker:subscribers
+    pair every publish crosses, emqx_broker.erl:204-215). Table/bitmap uploads
+    are cached by version so steady-state batches pay only the kernel launch
+    plus the bitmap readback.
+    """
+
+    def __init__(self, builder, subtab: SubscriberTable, config=None):
+        import dataclasses
+
+        from emqx_tpu.ops.matcher import MatcherConfig
+        from emqx_tpu.ops.nfa import MAX_PROBES
+
+        self.builder = builder
+        self.subtab = subtab
+        config = config or MatcherConfig()
+        if config.probes < MAX_PROBES:
+            config = dataclasses.replace(config, probes=MAX_PROBES)
+        self.config = config
+        self._dev_tables = None
+        self._tables_version = -1
+        self._salt = 0
+        self._dev_bits = None
+        self._bits_version = -1
+
+    def _device_args(self):
+        import jax.numpy as jnp
+
+        t = self.builder.pack()
+        if self._dev_tables is None or self._tables_version != t.version:
+            self._dev_tables = t.device_arrays()
+            self._tables_version = t.version
+            self._salt = t.salt
+        packed = self.subtab.pack(self.builder.num_filters_capacity)
+        if (
+            self._dev_bits is None
+            or self._bits_version != self.subtab.version
+            or self._dev_bits.shape != packed.shape
+        ):
+            self._dev_bits = jnp.asarray(packed)
+            self._bits_version = self.subtab.version
+        return self._dev_tables, self._dev_bits, self._salt
+
+    def prepare(self):
+        """Snapshot + upload current tables/bitmaps. MUST run on the thread
+        that mutates the builder/subtab (the event loop): packing walks live
+        Python structures. The returned pair is immutable device state safe
+        to hand to `route_prepared` on a worker thread."""
+        return self._device_args()
+
+    def route(self, topics):
+        """Batch route: returns host np arrays
+        (matched [B,K], mcount [B], flags [B], bitmaps [B,W])."""
+        return self.route_prepared(self._device_args(), topics)
+
+    def route_prepared(self, args, topics):
+        """Kernel launch + readback against a `prepare()` snapshot; touches
+        no mutable host state, so it may run in an executor thread while
+        the event loop keeps serving connections (the jit compile on a new
+        batch/table shape can take tens of seconds on a real chip)."""
+        from emqx_tpu.ops import tokenizer as tok
+
+        cfg = self.config
+        tables, bits, salt = args
+        B = len(topics)
+        Bp = max(64, _next_pow2(B))
+        mat, lens, too_long = tok.encode_topics(list(topics), cfg.max_bytes)
+        if Bp != B:
+            mat = np.pad(mat, ((0, Bp - B), (0, 0)))
+            lens = np.pad(lens, (0, Bp - B))
+        out = route_step(
+            tables,
+            bits,
+            mat,
+            lens,
+            salt=salt,
+            max_levels=cfg.max_levels,
+            frontier=cfg.frontier,
+            max_matches=cfg.max_matches,
+            probes=cfg.probes,
+        )
+        matched = np.asarray(out["matched"][:B])
+        mcount = np.asarray(out["mcount"][:B])
+        flags = np.asarray(out["flags"][:B]) | too_long
+        # ascontiguousarray: some backends (axon TPU) hand back strided
+        # buffers, and the dispatch path reinterprets rows as uint8
+        bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
+        return matched, mcount, flags, bitmaps

@@ -243,6 +243,10 @@ class NfaBuilder:
     def filter_name(self, fid: int) -> Optional[str]:
         return self._id_filters[fid] if 0 <= fid < len(self._id_filters) else None
 
+    def filter_id(self, filter_: str) -> Optional[int]:
+        """Stable id of a live filter (None if not present)."""
+        return self._filter_ids.get(filter_)
+
     def __len__(self) -> int:
         return len(self._filter_ids)
 

@@ -1,0 +1,240 @@
+"""The device serving path: batch aggregator + bitmap fan-out to real subs.
+
+Proves the flagship pipeline (tokenize + NFA match + subscriber bitmaps,
+models/router_model.route_step) routes LIVE broker traffic — not just bench
+batches. Reference analog: every publish crossing emqx_router:match_routes +
+emqx_broker:do_dispatch (emqx_broker.erl:204-215, 505-530).
+"""
+
+import asyncio
+import functools
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.ingest import BatchIngest
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.session import SessionConfig
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.client import Client
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+def _mk_broker(min_batch=1):
+    return Broker(router=Router(min_tpu_batch=min_batch), hooks=Hooks())
+
+
+def _sub(broker, sid, filt, sink, **opts):
+    broker.subscribe(
+        sid, sid, filt, pkt.SubOpts(**opts),
+        lambda m, o, _s=sink: _s.append(m.topic),
+    )
+
+
+class TestDeviceDispatch:
+    """dispatch_batch_folded: bitmaps -> real subscriber slots."""
+
+    def test_plain_and_wildcard_fanout(self):
+        b = _mk_broker()
+        got_a, got_w, got_h = [], [], []
+        _sub(b, "s1", "dev/1/temp", got_a)
+        _sub(b, "s2", "dev/+/temp", got_w)
+        _sub(b, "s3", "dev/#", got_h)
+        msgs = [Message(topic=t, payload=b"") for t in
+                ["dev/1/temp", "dev/2/temp", "other/x"]]
+        counts = b.dispatch_batch_folded(msgs)
+        assert counts == [3, 2, 0]
+        assert got_a == ["dev/1/temp"]
+        assert got_w == ["dev/1/temp", "dev/2/temp"]
+        assert got_h == ["dev/1/temp", "dev/2/temp"]
+        assert b.metrics.get("messages.routed.device") == 3
+
+    def test_unsubscribe_clears_slot(self):
+        b = _mk_broker()
+        got = []
+        _sub(b, "s1", "a/b", got)
+        b.dispatch_batch_folded([Message(topic="a/b", payload=b"")])
+        assert got == ["a/b"]
+        b.unsubscribe("s1", "a/b")
+        counts = b.dispatch_batch_folded([Message(topic="a/b", payload=b"")])
+        assert counts == [0] and got == ["a/b"]
+
+    def test_slot_reuse_after_unsubscribe(self):
+        b = _mk_broker()
+        g1, g2 = [], []
+        _sub(b, "s1", "x/1", g1)
+        b.unsubscribe("s1", "x/1")
+        _sub(b, "s2", "x/2", g2)  # reuses the freed slot
+        counts = b.dispatch_batch_folded(
+            [Message(topic="x/1", payload=b""), Message(topic="x/2", payload=b"")]
+        )
+        assert counts == [0, 1]
+        assert g1 == [] and g2 == ["x/2"]
+
+    def test_shared_group_via_device(self):
+        b = _mk_broker()
+        got1, got2 = [], []
+        _sub(b, "m1", "$share/g/t/1", got1)
+        _sub(b, "m2", "$share/g/t/1", got2)
+        counts = b.dispatch_batch_folded(
+            [Message(topic="t/1", payload=b"") for _ in range(4)]
+        )
+        assert counts == [1, 1, 1, 1]
+        # one member per message, load spread over the group
+        assert len(got1) + len(got2) == 4
+
+    def test_no_local_honored_on_device_path(self):
+        b = _mk_broker()
+        got = []
+        b.subscribe("s1", "c1", "t", pkt.SubOpts(no_local=True),
+                    lambda m, o: got.append(m.topic))
+        counts = b.dispatch_batch_folded(
+            [Message(topic="t", payload=b"", from_client="c1"),
+             Message(topic="t", payload=b"", from_client="c2")]
+        )
+        assert counts == [0, 1] and got == ["t"]
+
+    def test_matches_cpu_path_on_mixed_workload(self):
+        bd = _mk_broker(min_batch=1)
+        bc = _mk_broker(min_batch=10**9)  # always CPU
+        filters = ["a/b", "a/+", "a/#", "+/b", "#", "$sys/x", "deep/" + "/".join("abcdefgh")]
+        topics = ["a/b", "a/c", "b/b", "x", "$sys/x", "deep/a/b/c/d/e/f/g/h", "a"]
+        sinks_d, sinks_c = {}, {}
+        for i, f in enumerate(filters):
+            sinks_d[f] = []
+            sinks_c[f] = []
+            _sub(bd, f"s{i}", f, sinks_d[f])
+            _sub(bc, f"s{i}", f, sinks_c[f])
+        msgs = [Message(topic=t, payload=b"") for t in topics]
+        nd = bd.dispatch_batch_folded(list(msgs))
+        nc = bc.dispatch_batch_folded(list(msgs))
+        assert nd == nc
+        for f in filters:
+            assert sinks_d[f] == sinks_c[f], f
+
+    def test_subscriber_growth_past_initial_width(self):
+        b = _mk_broker()
+        sinks = []
+        for i in range(130):  # > 4 words of 32 slots
+            s = []
+            sinks.append(s)
+            _sub(b, f"s{i}", f"t/{i}", s)
+        all_sink = []
+        _sub(b, "sw", "t/+", all_sink)
+        counts = b.dispatch_batch_folded(
+            [Message(topic=f"t/{i}", payload=b"") for i in range(130)]
+        )
+        assert counts == [2] * 130
+        assert all(s for s in sinks)
+        assert len(all_sink) == 130
+
+
+class IngestBed:
+    """Broker + TCP listener + running BatchIngest, like the app wires it."""
+
+    __test__ = False
+
+    def __init__(self, window_us=2000, min_batch=2):
+        self.broker = _mk_broker(min_batch)
+        self.cm = ChannelManager(self.broker)
+        self.listeners = Listeners(self.broker, self.cm)
+        self.port = None
+        self._window_us = window_us
+
+    async def __aenter__(self):
+        self.broker.ingest = BatchIngest(self.broker, window_us=self._window_us)
+        self.broker.ingest.start()
+        l = await self.listeners.start_listener(
+            ListenerConfig(port=0),
+            ChannelConfig(session=SessionConfig(retry_interval=0.5)),
+        )
+        self.port = l.port
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.listeners.stop_all()
+        await self.broker.ingest.stop()
+
+    async def client(self, client_id="", **kw) -> Client:
+        c = Client(client_id=client_id, **kw)
+        await c.connect("127.0.0.1", self.port)
+        return c
+
+
+@async_test
+async def test_live_sockets_route_through_device():
+    """Concurrent real-socket publishers; deliveries flow the device path."""
+    async with IngestBed() as tb:
+        subs = []
+        for i in range(4):
+            s = await tb.client(f"sub{i}")
+            await s.subscribe(f"room/{i}/+")
+            subs.append(s)
+        wild = await tb.client("wild")
+        await wild.subscribe("room/#")
+
+        pubs = [await tb.client(f"pub{i}") for i in range(4)]
+        # all 20 publishes in flight at once: the aggregator's batch window
+        # engages and the kernel sees real batches
+        await asyncio.gather(
+            *(
+                pubs[i].publish(f"room/{i}/m{k}", b"x", qos=1)
+                for i in range(4)
+                for k in range(5)
+            )
+        )
+
+        for i, s in enumerate(subs):
+            got = [await asyncio.wait_for(s.recv(), 5) for _ in range(5)]
+            assert sorted(m.topic for m in got) == [
+                f"room/{i}/m{k}" for k in range(5)
+            ]
+        wgot = [await asyncio.wait_for(wild.recv(), 5) for _ in range(20)]
+        assert len(wgot) == 20
+        # the headline assertion: live traffic crossed the device kernel
+        # (a couple of leading publishes may flush solo before the window
+        # engages; the bulk must ride the device)
+        assert tb.broker.metrics.get("messages.routed.device") >= 10
+        for c in subs + pubs + [wild]:
+            await c.disconnect()
+
+
+@async_test
+async def test_ingest_qos1_puback_reflects_dispatch():
+    async with IngestBed() as tb:
+        pub = await tb.client("p1")
+        # no subscribers: still acked, delivery count 0 handled
+        await pub.publish("nobody/home", b"x", qos=1)
+        sub = await tb.client("s1")
+        await sub.subscribe("nobody/home", qos=1)
+        await pub.publish("nobody/home", b"y", qos=1)
+        m = await asyncio.wait_for(sub.recv(), 5)
+        assert m.payload == b"y" and m.qos == 1
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+@async_test
+async def test_ingest_stop_drains_pending():
+    b = _mk_broker()
+    got = []
+    _sub(b, "s1", "t", got)
+    ing = BatchIngest(b, window_us=50_000)
+    ing.start()
+    task = asyncio.ensure_future(ing.submit(Message(topic="t", payload=b"")))
+    await asyncio.sleep(0)  # enqueue before stop
+    await ing.stop()
+    assert await task == 1
+    assert got == ["t"]
