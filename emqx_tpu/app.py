@@ -325,9 +325,18 @@ class BrokerApp:
                 window_us=c.router.ingest_window_us,
             )
             self.broker.ingest.start()
-            # pre-warm the route_step kernel for the smallest batch bucket
-            # BEFORE listeners accept: first-contact compile on a real chip
-            # is tens of seconds and must not land on live publishers
+        # restore durable state BEFORE listeners accept clients
+        if self.session_persistence is not None:
+            restored = self.session_persistence.restore()
+            if restored:
+                self.broker.metrics.gauge_set("sessions.restored", restored)
+        if self.durable_state is not None:
+            self.durable_state.restore()
+        if self.broker.ingest is not None:
+            # pre-warm the route_step kernel BEFORE listeners accept (but
+            # AFTER restore, so restored subscriptions set the table shapes
+            # the compile keys on): first-contact compile on a real chip is
+            # tens of seconds and must not land on live publishers
             try:
                 dev = self.broker._device_router()
                 args = dev.prepare()
@@ -341,13 +350,6 @@ class BrokerApp:
                 logging.getLogger("emqx_tpu").exception(
                     "device route warmup failed; serving with cold kernel"
                 )
-        # restore durable state BEFORE listeners accept clients
-        if self.session_persistence is not None:
-            restored = self.session_persistence.restore()
-            if restored:
-                self.broker.metrics.gauge_set("sessions.restored", restored)
-        if self.durable_state is not None:
-            self.durable_state.restore()
         for spec in c.listeners:
             chan_cfg = self.channel_config
             if spec.mountpoint:

@@ -34,7 +34,7 @@ Deliverer = Callable[[Message, pkt.SubOpts], None]
 
 
 class Subscriber:
-    __slots__ = ("sid", "deliver", "opts", "client_id", "slot")
+    __slots__ = ("sid", "deliver", "opts", "client_id", "slot", "filter")
 
     def __init__(self, sid: str, client_id: str, deliver: Deliverer, opts: pkt.SubOpts):
         self.sid = sid
@@ -42,6 +42,7 @@ class Subscriber:
         self.deliver = deliver
         self.opts = opts
         self.slot = -1  # device bitmap slot (non-shared subs only)
+        self.filter = ""  # the real (share-stripped) subscription filter
 
 
 class Broker:
@@ -82,6 +83,7 @@ class Broker:
     ) -> None:
         group, real = T.parse_share(filter_)
         sub = Subscriber(sid, client_id, deliver, opts)
+        sub.filter = real
         if group is not None:
             # one route ref per group (matched by delete on group-empty)
             if self.shared.subscribe(group, real, sub):
@@ -283,10 +285,21 @@ class Broker:
                 continue
             if sub.opts.no_local and sub.client_id == msg.from_client:
                 continue
+            # staleness net: the kernel ran against a snapshot, and slots /
+            # filter ids freed during an in-flight batch can be reused by
+            # unrelated subscriptions — verify the sub's filter really
+            # matches before delivering (misdelivery is worse than a
+            # topic-match check per delivery)
+            if not T.match(msg.topic, sub.filter):
+                continue
             n += self._deliver_one(sub, msg)
         for fid in fids:
             name = self.router.builder.filter_name(int(fid))
-            if name is not None and self.shared.has_groups(name):
+            if (
+                name is not None
+                and self.shared.has_groups(name)
+                and T.match(msg.topic, name)
+            ):
                 n += self.shared.dispatch_groups(name, msg)
         if n:
             self.metrics.inc("messages.delivered", n)

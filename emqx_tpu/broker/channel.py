@@ -390,7 +390,14 @@ class Channel:
         path (resolved result, empty queue) acks inline; otherwise a single
         drainer task per channel settles entries strictly in order.
         """
-        if isinstance(r, int) and not self._ack_queue:
+        # inline fast path ONLY when nothing is pending anywhere: the
+        # drainer holds its current entry OUTSIDE the queue while awaiting,
+        # so an empty queue alone doesn't mean order-safe
+        if (
+            isinstance(r, int)
+            and not self._ack_queue
+            and (self._ack_task is None or self._ack_task.done())
+        ):
             if send is not None:
                 send(r)
             return

@@ -107,70 +107,66 @@ class SubscriberTable:
 
     The reference keeps subscribers in per-node ETS bag tables
     (emqx_broker.erl:98-110). Here each local subscriber gets a dense slot;
-    the bitmap matrix rides to the device alongside the NFA tables. The slot
-    axis auto-grows (power-of-two words) so the live broker never caps its
-    subscriber count; growth recompiles the route_step kernel once per
-    doubling.
+    the [Fcap, W] uint32 matrix is the PRIMARY storage, mutated in place
+    with every write op-logged (flat index) so `DeviceDeltaSync` can replay
+    churn as O(delta) scatters. Either axis auto-grows by doubling; growth
+    bumps `epoch` (full re-upload + one route_step recompile).
     """
 
     def __init__(self, max_subscribers: int = 1024):
-        self.width_words = max(2, (max_subscribers + 31) // 32)
-        self._rows: Dict[int, np.ndarray] = {}
+        self.width_words = max(2, _next_pow2((max_subscribers + 31) // 32))
         self._fcap = 64
-        self._dirty = True
-        self._packed: np.ndarray | None = None
+        self.arr = np.zeros((self._fcap, self.width_words), dtype=np.uint32)
+        self.epoch = 0
+        self.oplog: list = []  # (name, flat_idx, value)
         self.version = 0
+        self.OPLOG_MAX = 65536
 
-    def _ensure_slot(self, slot: int) -> None:
-        need = slot // 32 + 1
-        if need > self.width_words:
-            w = _next_pow2(need)
-            for fid, row in self._rows.items():
-                nr = np.zeros(w, dtype=np.uint32)
-                nr[: len(row)] = row
-                self._rows[fid] = nr
-            self.width_words = w
+    def _log(self, fid: int, w: int, val: int) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self.epoch += 1
+            self.oplog.clear()
+            return
+        self.oplog.append(("sub_bitmaps", fid * self.width_words + w, val))
+
+    def _ensure(self, fid: int, slot: int) -> None:
+        need_w = _next_pow2(slot // 32 + 1)
+        need_f = _next_pow2(fid + 1)
+        if need_w > self.width_words or need_f > self._fcap:
+            nw = max(self.width_words, need_w)
+            nf = max(self._fcap, need_f)
+            new = np.zeros((nf, nw), dtype=np.uint32)
+            new[: self._fcap, : self.width_words] = self.arr
+            self.arr = new
+            self.width_words = nw
+            self._fcap = nf
+            self.epoch += 1
+            self.oplog.clear()
+            self.version += 1
 
     def add(self, filter_id: int, slot: int) -> None:
-        self._ensure_slot(slot)
-        row = self._rows.get(filter_id)
-        if row is None:
-            row = np.zeros(self.width_words, dtype=np.uint32)
-            self._rows[filter_id] = row
-        row[slot // 32] |= np.uint32(1 << (slot % 32))
-        self._dirty = True
-        self.version += 1
+        self._ensure(filter_id, slot)
+        w = slot // 32
+        self.arr[filter_id, w] |= np.uint32(1 << (slot % 32))
+        self._log(filter_id, w, int(self.arr[filter_id, w]))
 
     def remove(self, filter_id: int, slot: int) -> None:
-        row = self._rows.get(filter_id)
-        if row is None or slot // 32 >= len(row):
+        if filter_id >= self._fcap or slot // 32 >= self.width_words:
             return
-        row[slot // 32] &= np.uint32(~(1 << (slot % 32)) & 0xFFFFFFFF)
-        if not row.any():
-            del self._rows[filter_id]
-        self._dirty = True
-        self.version += 1
+        w = slot // 32
+        self.arr[filter_id, w] &= np.uint32(~(1 << (slot % 32)) & 0xFFFFFFFF)
+        self._log(filter_id, w, int(self.arr[filter_id, w]))
 
     def pack(self, filter_capacity: int) -> np.ndarray:
-        # capacity must cover every registered row — dropping one would mean
-        # silent message loss for that filter's subscribers
-        cap = max(64, filter_capacity, max(self._rows, default=0) + 1)
-        if (
-            not self._dirty
-            and self._packed is not None
-            and len(self._packed) >= cap
-            and self._packed.shape[1] == self.width_words
-        ):
-            return self._packed
-        while self._fcap < cap:
-            self._fcap *= 2
-        out = np.zeros((self._fcap, self.width_words), dtype=np.uint32)
-        for fid, row in self._rows.items():
-            out[fid, : len(row)] = row
-        out.setflags(write=False)  # callers share the cache; freeze it
-        self._packed = out
-        self._dirty = False
-        return out
+        """Grow to cover `filter_capacity` rows and return the live matrix
+        (a view — valid until the next mutation)."""
+        if filter_capacity > self._fcap:
+            self._ensure(filter_capacity - 1, 0)
+        return self.arr
+
+    def device_snapshot(self):
+        return {"sub_bitmaps": self.arr}
 
 
 class DeviceRouter:
@@ -188,7 +184,7 @@ class DeviceRouter:
         import dataclasses
 
         from emqx_tpu.ops.matcher import MatcherConfig
-        from emqx_tpu.ops.nfa import MAX_PROBES
+        from emqx_tpu.ops.nfa import MAX_PROBES, DeviceDeltaSync
 
         self.builder = builder
         self.subtab = subtab
@@ -196,29 +192,16 @@ class DeviceRouter:
         if config.probes < MAX_PROBES:
             config = dataclasses.replace(config, probes=MAX_PROBES)
         self.config = config
-        self._dev_tables = None
-        self._tables_version = -1
-        self._salt = 0
-        self._dev_bits = None
-        self._bits_version = -1
+        self._nfa_sync = DeviceDeltaSync()
+        self._bits_sync = DeviceDeltaSync()
 
     def _device_args(self):
-        import jax.numpy as jnp
-
-        t = self.builder.pack()
-        if self._dev_tables is None or self._tables_version != t.version:
-            self._dev_tables = t.device_arrays()
-            self._tables_version = t.version
-            self._salt = t.salt
-        packed = self.subtab.pack(self.builder.num_filters_capacity)
-        if (
-            self._dev_bits is None
-            or self._bits_version != self.subtab.version
-            or self._dev_bits.shape != packed.shape
-        ):
-            self._dev_bits = jnp.asarray(packed)
-            self._bits_version = self.subtab.version
-        return self._dev_tables, self._dev_bits, self._salt
+        # grow the bitmap matrix to cover every live filter id BEFORE the
+        # snapshot — a matched fid must always gather a real row
+        self.subtab.pack(self.builder.num_filters_capacity)
+        tables = self._nfa_sync.sync(self.builder)
+        bits = self._bits_sync.sync(self.subtab)["sub_bitmaps"]
+        return tables, bits, self.builder.salt
 
     def prepare(self):
         """Snapshot + upload current tables/bitmaps. MUST run on the thread

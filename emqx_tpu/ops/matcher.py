@@ -223,23 +223,22 @@ class TpuMatcher:
     exact matcher for flagged rows."""
 
     def __init__(self, builder: NfaBuilder, config: MatcherConfig = MatcherConfig()):
+        from emqx_tpu.ops.nfa import DeviceDeltaSync
+
         self.builder = builder
         if config.probes < MAX_PROBES:
             import dataclasses
 
             config = dataclasses.replace(config, probes=MAX_PROBES)
         self.config = config
-        self._dev_tables = None
-        self._dev_version = -1
+        self._sync = DeviceDeltaSync()
         self._salt = 0
 
     def _tables(self):
-        t = self.builder.pack()
-        if self._dev_tables is None or self._dev_version != t.version:
-            self._dev_tables = t.device_arrays()
-            self._dev_version = t.version
-            self._salt = t.salt
-        return self._dev_tables
+        # delta-overlay sync: subscription churn reaches the device as
+        # scatters, not full re-uploads (see nfa.DeviceDeltaSync)
+        self._salt = self.builder.salt
+        return self._sync.sync(self.builder)
 
     def match_batch(
         self, topics: Sequence[str], fallback=None

@@ -1,4 +1,4 @@
-"""Subscription-trie -> dense NFA table compiler.
+"""Subscription-trie -> dense NFA table compiler (incrementally maintained).
 
 The reference walks a prefix trie in ETS per published message
 (apps/emqx/src/emqx_trie.erl:271-333). That design is pointer-chasing and
@@ -14,27 +14,34 @@ set of flat arrays ("NFA tables") that a jitted JAX kernel
   collecting this field both when consuming a word *and* at end-of-topic)
 - ``term_filter[node]``  -> filter id ending exactly at this node, or -1
 - literal edges: open-addressing hash table ``(node, sym) -> child`` with a
-  build-time-verified probe bound, so the device probe loop is a fixed-length
-  unrolled gather (no data-dependent control flow under jit)
+  fixed probe bound, so the device probe loop is a fixed-length unrolled
+  gather (no data-dependent control flow under jit)
 - vocab: open-addressing table ``(h1, h2) -> sym`` mapping *word hash pairs*
   to dense symbol ids, so topic tokenization is hash-based and runs entirely
   on device (`emqx_tpu.ops.tokenizer`)
 
 Word hashing uses a 2x32-bit polynomial hash (see `word_hash_pair`) chosen so
 the device tokenizer can compute it with prefix sums instead of a per-byte
-scan. Hash-pair collisions between distinct words are detected at build time
-and resolved by bumping a salt and rebuilding (they are a ~2^-64 event).
+scan. Hash-pair collisions between distinct words are detected at insert
+time and resolved by bumping a salt and rebuilding the vocab (a ~2^-64
+event).
 
-Updates: the builder mutates small Python-side structures per
-subscribe/unsubscribe (mirroring emqx_trie insert/delete:66-119 semantics,
-including refcounted nodes) and re-packs flat arrays lazily on the next
-`pack()` call. Packing is O(edges) in NumPy and amortized across batches;
-a delta-overlay scheme is the planned next step (SURVEY.md §7 stage 2).
+Updates are the delta-overlay scheme (SURVEY.md §7 hard part (a)): the flat
+arrays are the PRIMARY storage, mutated in place per subscribe/unsubscribe
+(mirroring emqx_trie insert/delete:66-119 refcount semantics), and every
+write is appended to an op-log. A device consumer (`DeviceDeltaSync`)
+replays the log as one scatter per touched array — so subscription churn
+costs O(delta) on both host and device, not O(table). Structural events
+(array growth, hash-table rehash, salt change) bump `epoch`, forcing the
+rare full re-upload. Deletions leave tombstones in the open-addressing
+tables (edge_node = -2, vocab_sym = -3); the device probe loops are
+tombstone-oblivious because they always scan the full probe window and
+match on live keys only.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -60,56 +67,62 @@ VOCAB_H_SHIFT = 13
 PLUS_SYM = -2  # sentinel syms (never produced by vocab lookup)
 HASH_SYM = -3
 
+EDGE_TOMB = -2  # tombstoned edge slot (edge_node value)
+VOCAB_TOMB = -3  # tombstoned vocab slot (vocab_sym value)
 
-def _mix32(x: np.uint32) -> np.uint32:
-    """Murmur3-style finalizer (32-bit)."""
-    x = np.uint32(x)
-    x ^= x >> np.uint32(16)
-    x = np.uint32(x * np.uint32(0x7FEB352D))
-    x ^= x >> np.uint32(15)
-    x = np.uint32(x * np.uint32(0x846CA68B))
-    x ^= x >> np.uint32(16)
+
+_M32 = 0xFFFFFFFF
+
+
+def _mix32(x: int) -> int:
+    """Murmur3-style finalizer (32-bit). Pure-int: this runs per-word on the
+    subscribe path and numpy scalar math is ~10x slower."""
+    x &= _M32
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & _M32
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & _M32
+    x ^= x >> 16
     return x
 
 
-def _poly_raw(word: bytes, P: np.uint32) -> np.uint32:
-    h = np.uint32(1)  # == P^0; encodes length so "" hashes distinctly
-    with np.errstate(over="ignore"):
-        for c in word:
-            h = np.uint32(h * P + np.uint32(c))
+def _poly_raw(word: bytes, P: int) -> int:
+    h = 1  # == P^0; encodes length so "" hashes distinctly
+    for c in word:
+        h = (h * P + c) & _M32
     return h
 
 
 def word_hash_pair(word: str, salt: int) -> Tuple[int, int]:
     """(h1, h2) for one word; the device tokenizer computes the same pair."""
     b = word.encode("utf-8", "surrogatepass")
-    with np.errstate(over="ignore"):
-        s1 = np.uint32(np.uint32(salt) * _SALT1 + np.uint32(1))
-        s2 = np.uint32(np.uint32(salt) * _SALT2 + np.uint32(7))
-        h1 = _mix32(_poly_raw(b, P1) ^ s1)
-        h2 = _mix32(_poly_raw(b, P2) ^ s2)
-    return int(h1), int(h2)
+    s1 = (salt * int(_SALT1) + 1) & _M32
+    s2 = (salt * int(_SALT2) + 7) & _M32
+    h1 = _mix32(_poly_raw(b, int(P1)) ^ s1)
+    h2 = _mix32(_poly_raw(b, int(P2)) ^ s2)
+    return h1, h2
 
 
-def edge_slot_hash(node: np.ndarray, sym: np.ndarray) -> np.ndarray:
+def edge_slot_hash(node: int, sym: int) -> int:
     """Initial probe slot hash for the literal-edge table (pre-mask)."""
-    with np.errstate(over="ignore"):
-        h = np.uint32(node).astype(np.uint32) * np.uint32(EDGE_H_MUL_NODE)
-        h = h + np.uint32(sym).astype(np.uint32) * np.uint32(EDGE_H_MUL_SYM)
-        h ^= h >> np.uint32(EDGE_H_SHIFT)
+    h = (node * EDGE_H_MUL_NODE + sym * EDGE_H_MUL_SYM) & _M32
+    h ^= h >> EDGE_H_SHIFT
     return h
 
 
-def vocab_slot_hash(h1: np.ndarray) -> np.ndarray:
-    with np.errstate(over="ignore"):
-        h = np.uint32(h1).astype(np.uint32) * np.uint32(VOCAB_H_MUL)
-        h ^= h >> np.uint32(VOCAB_H_SHIFT)
+def vocab_slot_hash(h1: int) -> int:
+    h = (h1 * VOCAB_H_MUL) & _M32
+    h ^= h >> VOCAB_H_SHIFT
     return h
 
 
 @dataclass
 class NfaTables:
-    """Flat match tables; everything the device kernel needs."""
+    """Flat match tables; everything the device kernel needs.
+
+    Arrays are VIEWS of the builder's live storage — valid until the next
+    builder mutation. Consumers that need isolation across mutations copy
+    (DeviceDeltaSync keeps its own device-side mirror)."""
 
     plus_child: np.ndarray  # int32 [N]
     hash_filter: np.ndarray  # int32 [N]
@@ -129,20 +142,16 @@ class NfaTables:
         import jax.numpy as jnp
 
         return {
-            "plus_child": jnp.asarray(self.plus_child),
-            "hash_filter": jnp.asarray(self.hash_filter),
-            "term_filter": jnp.asarray(self.term_filter),
-            "edge_node": jnp.asarray(self.edge_node),
-            "edge_sym": jnp.asarray(self.edge_sym),
-            "edge_child": jnp.asarray(self.edge_child),
-            "vocab_h1": jnp.asarray(self.vocab_h1),
-            "vocab_h2": jnp.asarray(self.vocab_h2),
-            "vocab_sym": jnp.asarray(self.vocab_sym),
+            "plus_child": jnp.asarray(self.plus_child.copy()),
+            "hash_filter": jnp.asarray(self.hash_filter.copy()),
+            "term_filter": jnp.asarray(self.term_filter.copy()),
+            "edge_node": jnp.asarray(self.edge_node.copy()),
+            "edge_sym": jnp.asarray(self.edge_sym.copy()),
+            "edge_child": jnp.asarray(self.edge_child.copy()),
+            "vocab_h1": jnp.asarray(self.vocab_h1.copy()),
+            "vocab_h2": jnp.asarray(self.vocab_h2.copy()),
+            "vocab_sym": jnp.asarray(self.vocab_sym.copy()),
         }
-
-
-class _HashCollision(Exception):
-    pass
 
 
 def _next_pow2(n: int) -> int:
@@ -152,26 +161,111 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+class DeviceDeltaSync:
+    """Device-resident mirror of incrementally-mutated host tables.
+
+    `sync(src)` returns a dict of device arrays matching
+    `src.device_snapshot()`. On the source's `epoch` changing (array growth,
+    rehash, salt bump) the mirror is rebuilt with a full upload; otherwise
+    the op-log suffix since the last sync is replayed as ONE donated scatter
+    per touched array — churn costs O(delta), not O(table). This is the
+    device half of the delta-overlay design (module docstring).
+    """
+
+    def __init__(self) -> None:
+        self._arrays: Optional[Dict] = None
+        self._epoch = -1
+        self._pos = 0
+
+    def sync(self, src) -> Dict:
+        import jax.numpy as jnp
+
+        if self._arrays is None or self._epoch != src.epoch:
+            self._arrays = {
+                k: jnp.asarray(v.copy())
+                for k, v in src.device_snapshot().items()
+            }
+            self._epoch = src.epoch
+            self._pos = len(src.oplog)
+            return dict(self._arrays)
+        ops = src.oplog[self._pos :]
+        if not ops:
+            return dict(self._arrays)
+        per: Dict[str, Dict[int, int]] = {}
+        for name, idx, val in ops:
+            per.setdefault(name, {})[idx] = val  # last write per slot wins
+        for name, writes in per.items():
+            arr = self._arrays[name]
+            flat = arr.reshape(-1)
+            idxs = np.fromiter(writes.keys(), dtype=np.int32, count=len(writes))
+            vals = np.array(list(writes.values()), dtype=arr.dtype)
+            # pad to a pow2 bucket (repeating one write is a no-op) so jit
+            # recompiles per size bucket, not per delta length
+            n = len(idxs)
+            npad = max(16, _next_pow2(n))
+            if npad != n:
+                idxs = np.pad(idxs, (0, npad - n), mode="edge")
+                vals = np.pad(vals, (0, npad - n), mode="edge")
+            out = _scatter_set(flat, jnp.asarray(idxs), jnp.asarray(vals))
+            self._arrays[name] = out.reshape(arr.shape)
+        self._pos = len(src.oplog)
+        # shallow copy: callers may hold the snapshot across a later sync
+        # (executor batches); mutating the returned dict under them would
+        # hand a worker a torn table set
+        return dict(self._arrays)
+
+
+_scatter_fn = None
+
+
+def _scatter_set(flat, idxs, vals):
+    """jitted flat[idxs] = vals (jax imported lazily, cached)."""
+    global _scatter_fn
+    if _scatter_fn is None:
+        import jax
+
+        _scatter_fn = jax.jit(lambda f, i, v: f.at[i].set(v))
+    return _scatter_fn(flat, idxs, vals)
+
+
 class NfaBuilder:
     """Incrementally maintained subscription automaton.
 
     add/remove mirror emqx_trie:insert/delete refcount semantics
-    (emqx_trie.erl:170-199); `pack()` emits `NfaTables`.
+    (emqx_trie.erl:170-199), mutating the flat device tables in place and
+    op-logging every write (see module docstring). `pack()` is O(1): it
+    hands out views of the live arrays.
     """
 
     ROOT = 0
+    OPLOG_MAX = 65536
+    _MIN_CAP = 1024
 
     def __init__(self) -> None:
-        # node arrays (python lists; index = node id)
-        self._plus: List[int] = [-1]
-        self._hashf: List[int] = [-1]
-        self._term: List[int] = [-1]
+        cap = self._MIN_CAP
+        # node tables
+        self._cap_nodes = cap
+        self.arr_plus = np.full(cap, -1, np.int32)
+        self.arr_hashf = np.full(cap, -1, np.int32)
+        self.arr_term = np.full(cap, -1, np.int32)
+        self._n_nodes = 1  # high-water node count (root pre-allocated)
         self._refs: List[int] = [0]  # filters at-or-below node
         self._free_nodes: List[int] = []
-        # literal edges: (node, sym) -> child
+        # literal edges: authoritative dict + open-addressing device table
         self._edges: Dict[Tuple[int, int], int] = {}
-        # vocab: word -> (sym, refcount)
+        self._E = cap
+        self.arr_edge_node = np.full(cap, -1, np.int32)
+        self.arr_edge_sym = np.full(cap, -1, np.int32)
+        self.arr_edge_child = np.full(cap, -1, np.int32)
+        self._edge_fill = 0  # non-empty slots (live + tombstones)
+        # vocab: word -> [sym, refcount]; device table keyed by hash pair
         self._vocab: Dict[str, List[int]] = {}
+        self._hash_pairs: Dict[Tuple[int, int], str] = {}
+        self._V = cap
+        self.arr_vocab_h1 = np.zeros(cap, np.uint32)
+        self.arr_vocab_h2 = np.zeros(cap, np.uint32)
+        self.arr_vocab_sym = np.full(cap, -1, np.int32)
+        self._vocab_fill = 0
         self._sym_words: List[Optional[str]] = []
         self._free_syms: List[int] = []
         # filters
@@ -180,10 +274,102 @@ class NfaBuilder:
         self._free_filters: List[int] = []
         self._filter_refs: List[int] = []
         self.salt = 0
+        self.epoch = 0  # full-device-resync marker
+        self.oplog: List[Tuple[str, int, int]] = []
         self.version = 0
-        self._packed: Optional[NfaTables] = None
+
+    # -- op-logged writes --------------------------------------------------
+    def _log(self, name: str, idx: int, val: int) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            # cap the log: consumers that fell this far behind resync fully
+            self._bump_epoch()
+            return
+        self.oplog.append((name, int(idx), int(val)))
+
+    def _bump_epoch(self) -> None:
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+
+    def _set_plus(self, node: int, val: int) -> None:
+        self.arr_plus[node] = val
+        self._log("plus_child", node, val)
+
+    def _set_hashf(self, node: int, val: int) -> None:
+        self.arr_hashf[node] = val
+        self._log("hash_filter", node, val)
+
+    def _set_term(self, node: int, val: int) -> None:
+        self.arr_term[node] = val
+        self._log("term_filter", node, val)
 
     # -- vocab -------------------------------------------------------------
+    def _vocab_place(self, h1: int, h2: int, sym: int) -> bool:
+        """Probe-insert into the device vocab table; False if window full."""
+        slot = vocab_slot_hash(h1) & (self._V - 1)
+        for p in range(MAX_PROBES):
+            idx = (slot + p) & (self._V - 1)
+            s = self.arr_vocab_sym[idx]
+            if s == -1 or s == VOCAB_TOMB:
+                if s == -1:
+                    self._vocab_fill += 1
+                self.arr_vocab_h1[idx] = h1
+                self._log("vocab_h1", idx, h1)
+                self.arr_vocab_h2[idx] = h2
+                self._log("vocab_h2", idx, h2)
+                self.arr_vocab_sym[idx] = sym
+                self._log("vocab_sym", idx, sym)
+                return True
+        return False
+
+    def _vocab_rehash(self, newV: int) -> None:
+        while True:
+            h1a = np.zeros(newV, np.uint32)
+            h2a = np.zeros(newV, np.uint32)
+            syma = np.full(newV, -1, np.int32)
+            ok = True
+            for w, ent in self._vocab.items():
+                sym, h1, h2 = ent[0], ent[2], ent[3]
+                slot = vocab_slot_hash(h1) & (newV - 1)
+                placed = False
+                for p in range(MAX_PROBES):
+                    idx = (slot + p) & (newV - 1)
+                    if syma[idx] < 0:
+                        h1a[idx], h2a[idx], syma[idx] = h1, h2, sym
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+            if ok:
+                break
+            newV *= 2
+        self._V = newV
+        self.arr_vocab_h1, self.arr_vocab_h2, self.arr_vocab_sym = h1a, h2a, syma
+        self._vocab_fill = len(self._vocab)
+        self._bump_epoch()
+
+    def _salt_rebuild(self) -> None:
+        """Hash-pair collision between distinct words: bump salt, rebuild."""
+        for _ in range(16):
+            self.salt += 1
+            pairs: Dict[Tuple[int, int], str] = {}
+            ok = True
+            for w in self._vocab:
+                p = word_hash_pair(w, self.salt)
+                if p in pairs:
+                    ok = False
+                    break
+                pairs[p] = w
+            if ok:
+                self._hash_pairs = pairs
+                for w, ent in self._vocab.items():
+                    ent[2], ent[3] = word_hash_pair(w, self.salt)
+                self._vocab_rehash(self._V)
+                return
+        raise RuntimeError("vocab hash collisions persisted across 16 salts")
+
     def _sym_for(self, word: str, create: bool) -> int:
         ent = self._vocab.get(word)
         if ent is not None:
@@ -198,7 +384,17 @@ class NfaBuilder:
         else:
             sym = len(self._sym_words)
             self._sym_words.append(word)
-        self._vocab[word] = [sym, 1]
+        h1, h2 = word_hash_pair(word, self.salt)
+        self._vocab[word] = [sym, 1, h1, h2]
+        other = self._hash_pairs.get((h1, h2))
+        if other is not None and other != word:
+            self._salt_rebuild()  # rehashes every word incl. this one
+            return sym
+        self._hash_pairs[(h1, h2)] = word
+        if (self._vocab_fill + 1) * 2 > self._V:
+            self._vocab_rehash(self._V * 2)
+        elif not self._vocab_place(h1, h2, sym):
+            self._vocab_rehash(self._V * 2)
         return sym
 
     def _sym_release(self, word: str) -> None:
@@ -208,21 +404,120 @@ class NfaBuilder:
             del self._vocab[word]
             self._sym_words[ent[0]] = None
             self._free_syms.append(ent[0])
+            h1, h2 = ent[2], ent[3]
+            self._hash_pairs.pop((h1, h2), None)
+            slot = vocab_slot_hash(h1) & (self._V - 1)
+            for p in range(MAX_PROBES):
+                idx = (slot + p) & (self._V - 1)
+                if (
+                    self.arr_vocab_sym[idx] >= 0
+                    and self.arr_vocab_h1[idx] == np.uint32(h1)
+                    and self.arr_vocab_h2[idx] == np.uint32(h2)
+                ):
+                    self.arr_vocab_sym[idx] = VOCAB_TOMB
+                    self._log("vocab_sym", idx, VOCAB_TOMB)
+                    break
+            # tombstone-heavy table: compact at the SAME size (without this,
+            # churn of unique words ratchets fill up and doubles V forever)
+            if (self._vocab_fill - len(self._vocab)) * 4 > self._V:
+                self._vocab_rehash(self._V)
+
+    # -- edges -------------------------------------------------------------
+    def _edge_rehash(self, newE: int) -> None:
+        while True:
+            ena = np.full(newE, -1, np.int32)
+            esa = np.full(newE, -1, np.int32)
+            eca = np.full(newE, -1, np.int32)
+            ok = True
+            for (node, sym), child in self._edges.items():
+                slot = edge_slot_hash(node, sym) & (newE - 1)
+                placed = False
+                for p in range(MAX_PROBES):
+                    idx = (slot + p) & (newE - 1)
+                    if ena[idx] == -1:
+                        ena[idx], esa[idx], eca[idx] = node, sym, child
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+            if ok:
+                break
+            newE *= 2
+        self._E = newE
+        self.arr_edge_node, self.arr_edge_sym, self.arr_edge_child = (
+            ena,
+            esa,
+            eca,
+        )
+        self._edge_fill = len(self._edges)
+        self._bump_epoch()
+
+    def _edge_insert(self, node: int, sym: int, child: int) -> None:
+        self._edges[(node, sym)] = child
+        if (self._edge_fill + 1) * 2 > self._E:
+            self._edge_rehash(self._E * 2)  # places the new edge too
+            return
+        slot = edge_slot_hash(node, sym) & (self._E - 1)
+        for p in range(MAX_PROBES):
+            idx = (slot + p) & (self._E - 1)
+            n = self.arr_edge_node[idx]
+            if n == -1 or n == EDGE_TOMB:
+                if n == -1:
+                    self._edge_fill += 1
+                self.arr_edge_node[idx] = node
+                self._log("edge_node", idx, node)
+                self.arr_edge_sym[idx] = sym
+                self._log("edge_sym", idx, sym)
+                self.arr_edge_child[idx] = child
+                self._log("edge_child", idx, child)
+                return
+        self._edge_rehash(self._E * 2)
+
+    def _edge_delete(self, node: int, sym: int) -> None:
+        del self._edges[(node, sym)]
+        slot = edge_slot_hash(node, sym) & (self._E - 1)
+        for p in range(MAX_PROBES):
+            idx = (slot + p) & (self._E - 1)
+            if (
+                self.arr_edge_node[idx] == node
+                and self.arr_edge_sym[idx] == sym
+            ):
+                self.arr_edge_node[idx] = EDGE_TOMB
+                self._log("edge_node", idx, EDGE_TOMB)
+                break
+        # tombstone-heavy table: compact in place (drops tombstones)
+        if (self._edge_fill - len(self._edges)) * 4 > self._E:
+            self._edge_rehash(self._E)
 
     # -- nodes -------------------------------------------------------------
+    def _grow_nodes(self) -> None:
+        cap = self._cap_nodes * 2
+        for name in ("arr_plus", "arr_hashf", "arr_term"):
+            old = getattr(self, name)
+            new = np.full(cap, -1, np.int32)
+            new[: len(old)] = old
+            setattr(self, name, new)
+        self._cap_nodes = cap
+        self._bump_epoch()
+
     def _new_node(self) -> int:
         if self._free_nodes:
             n = self._free_nodes.pop()
-            self._plus[n] = -1
-            self._hashf[n] = -1
-            self._term[n] = -1
+            if self.arr_plus[n] != -1:
+                self._set_plus(n, -1)
+            if self.arr_hashf[n] != -1:
+                self._set_hashf(n, -1)
+            if self.arr_term[n] != -1:
+                self._set_term(n, -1)
             self._refs[n] = 0
             return n
-        self._plus.append(-1)
-        self._hashf.append(-1)
-        self._term.append(-1)
+        n = self._n_nodes
+        self._n_nodes += 1
+        if n >= self._cap_nodes:
+            self._grow_nodes()
         self._refs.append(0)
-        return len(self._plus) - 1
+        return n
 
     # -- filters -----------------------------------------------------------
     def _filter_id(self, filter_: str) -> int:
@@ -256,7 +551,11 @@ class NfaBuilder:
 
     # -- public mutation ---------------------------------------------------
     def add(self, filter_: str) -> int:
-        """Insert a topic filter; returns its stable filter id (refcounted)."""
+        """Insert a topic filter; returns its stable filter id (refcounted).
+
+        O(words) — array writes + op-log appends; never a table rebuild
+        except amortized growth/rehash.
+        """
         T.validate(filter_)  # before any mutation: invalid input must not corrupt state
         fid = self._filter_id(filter_)
         if self._filter_refs[fid] > 0:
@@ -269,27 +568,26 @@ class NfaBuilder:
         for i, w in enumerate(ws):
             last = i == len(ws) - 1
             if w == "#":
-                self._hashf[node] = fid
+                self._set_hashf(node, fid)
                 break
             if w == "+":
-                child = self._plus[node]
+                child = int(self.arr_plus[node])
                 if child < 0:
                     child = self._new_node()
-                    self._plus[node] = child
+                    self._set_plus(node, child)
             else:
                 sym = self._sym_for(w, create=True)
                 key = (node, sym)
                 child = self._edges.get(key, -1)
                 if child < 0:
                     child = self._new_node()
-                    self._edges[key] = child
+                    self._edge_insert(node, sym, child)
             node = child
             path.append(node)
             if last:
-                self._term[node] = fid
+                self._set_term(node, fid)
         for n in path:
             self._refs[n] += 1
-        self._dirty()
         return fid
 
     def remove(self, filter_: str) -> bool:
@@ -308,125 +606,62 @@ class NfaBuilder:
         steps: List[Tuple[int, str, int]] = []  # (parent, word, child)
         for i, w in enumerate(ws):
             if w == "#":
-                self._hashf[node] = -1
+                self._set_hashf(node, -1)
                 break
             child = (
-                self._plus[node]
+                int(self.arr_plus[node])
                 if w == "+"
                 else self._edges.get((node, self._sym_for(w, create=False)), -1)
             )
             steps.append((node, w, child))
             node = child
             if i == len(ws) - 1:
-                self._term[node] = -1
+                self._set_term(node, -1)
         self._refs[self.ROOT] -= 1
         for parent, w, child in steps:
             self._refs[child] -= 1
             if self._refs[child] == 0:
                 if w == "+":
-                    self._plus[parent] = -1
+                    self._set_plus(parent, -1)
                 else:
                     sym = self._vocab[w][0]
-                    del self._edges[(parent, sym)]
+                    self._edge_delete(parent, sym)
                 self._free_nodes.append(child)
             if w not in ("+", "#"):
                 self._sym_release(w)
-        self._dirty()
         return True
 
-    def _dirty(self) -> None:
-        self.version += 1
-        self._packed = None
-
-    # -- packing -----------------------------------------------------------
+    # -- packing (O(1): views over live storage) ---------------------------
     def pack(self) -> NfaTables:
-        if self._packed is not None:
-            return self._packed
-        for _ in range(16):
-            try:
-                self._packed = self._pack_with_salt(self.salt)
-                return self._packed
-            except _HashCollision:
-                self.salt += 1
-        raise RuntimeError("vocab hash collisions persisted across 16 salts")
-
-    def _pack_with_salt(self, salt: int) -> NfaTables:
-        n_nodes = len(self._plus)
-        plus = np.asarray(self._plus, dtype=np.int32)
-        hashf = np.asarray(self._hashf, dtype=np.int32)
-        term = np.asarray(self._term, dtype=np.int32)
-
-        # vocab table keyed by hash pair
-        vocab_words = [(w, ent[0]) for w, ent in self._vocab.items()]
-        V = _next_pow2(max(16, 2 * len(vocab_words)))
-        for _ in range(4):
-            vh1 = np.zeros(V, dtype=np.uint32)
-            vh2 = np.zeros(V, dtype=np.uint32)
-            vsym = np.full(V, -1, dtype=np.int32)
-            seen: Dict[Tuple[int, int], str] = {}
-            ok = True
-            for w, sym in vocab_words:
-                h1, h2 = word_hash_pair(w, salt)
-                if (h1, h2) in seen:  # true 64-bit collision
-                    raise _HashCollision()
-                seen[(h1, h2)] = w
-                slot = int(vocab_slot_hash(np.uint32(h1))) & (V - 1)
-                placed = False
-                for p in range(MAX_PROBES):
-                    idx = (slot + p) & (V - 1)
-                    if vsym[idx] < 0:
-                        vh1[idx], vh2[idx], vsym[idx] = h1, h2, sym
-                        placed = True
-                        break
-                if not placed:
-                    ok = False
-                    break
-            if ok:
-                break
-            V *= 2
-        else:
-            raise RuntimeError("vocab table probe bound not satisfiable")
-
-        # literal edge table
-        E = _next_pow2(max(16, 2 * len(self._edges)))
-        for _ in range(6):
-            en = np.full(E, -1, dtype=np.int32)
-            es = np.full(E, -1, dtype=np.int32)
-            ec = np.full(E, -1, dtype=np.int32)
-            ok = True
-            for (node, sym), child in self._edges.items():
-                slot = int(edge_slot_hash(np.int64(node), np.int64(sym))) & (E - 1)
-                placed = False
-                for p in range(MAX_PROBES):
-                    idx = (slot + p) & (E - 1)
-                    if en[idx] < 0:
-                        en[idx], es[idx], ec[idx] = node, sym, child
-                        placed = True
-                        break
-                if not placed:
-                    ok = False
-                    break
-            if ok:
-                break
-            E *= 2
-        else:
-            raise RuntimeError("edge table probe bound not satisfiable")
-
         return NfaTables(
-            plus_child=plus,
-            hash_filter=hashf,
-            term_filter=term,
-            edge_node=en,
-            edge_sym=es,
-            edge_child=ec,
-            vocab_h1=vh1,
-            vocab_h2=vh2,
-            vocab_sym=vsym,
-            salt=salt,
-            num_nodes=n_nodes,
+            plus_child=self.arr_plus,
+            hash_filter=self.arr_hashf,
+            term_filter=self.arr_term,
+            edge_node=self.arr_edge_node,
+            edge_sym=self.arr_edge_sym,
+            edge_child=self.arr_edge_child,
+            vocab_h1=self.arr_vocab_h1,
+            vocab_h2=self.arr_vocab_h2,
+            vocab_sym=self.arr_vocab_sym,
+            salt=self.salt,
+            num_nodes=self._n_nodes,
             num_filters=len(self._id_filters),
             version=self.version,
         )
+
+    def device_snapshot(self) -> Dict[str, np.ndarray]:
+        """Host arrays for a full device upload (DeviceDeltaSync protocol)."""
+        return {
+            "plus_child": self.arr_plus,
+            "hash_filter": self.arr_hashf,
+            "term_filter": self.arr_term,
+            "edge_node": self.arr_edge_node,
+            "edge_sym": self.arr_edge_sym,
+            "edge_child": self.arr_edge_child,
+            "vocab_h1": self.arr_vocab_h1,
+            "vocab_h2": self.arr_vocab_h2,
+            "vocab_sym": self.arr_vocab_sym,
+        }
 
     # -- host-side tokenization (exact; used by tests and CPU fallback) ----
     def tokenize_host(self, topic: str, max_levels: int):

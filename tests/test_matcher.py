@@ -199,3 +199,100 @@ def test_low_probe_config_is_clamped():
     m = TpuMatcher(builder, MatcherConfig(probes=1))
     got = m.match_batch(["w34/x"], fallback=trie.match)
     assert got == [["w34/x"]]
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_churn_differential_delta_sync(seed):
+    """Sustained subscribe/unsubscribe churn against ONE TpuMatcher.
+
+    The device mirror must track the host through delta scatters,
+    tombstoned slots, node/edge/vocab reuse, growth, and epoch bumps
+    (nfa.DeviceDeltaSync) — matching the CPU trie after every step.
+    """
+    rng = random.Random(seed)
+    words = [f"w{i}" for i in range(40)] + ["+", "#"]
+    trie = TopicTrie()
+    builder = NfaBuilder()
+    m = TpuMatcher(builder, MatcherConfig(frontier=64, max_matches=64))
+    live = []
+    topics_pool = [
+        "/".join(rng.choice(words[:40]) for _ in range(rng.randint(1, 5)))
+        for _ in range(64)
+    ]
+    for step in range(30):
+        # mutate: a few adds and removes per step
+        for _ in range(rng.randint(1, 8)):
+            f = "/".join(
+                rng.choice(words) for _ in range(rng.randint(1, 5))
+            )
+            try:
+                T.validate(f)
+            except T.TopicValidationError:
+                continue
+            trie.insert(f)
+            builder.add(f)
+            live.append(f)
+        for _ in range(rng.randint(0, 6)):
+            if not live:
+                break
+            f = live.pop(rng.randrange(len(live)))
+            trie.delete(f)
+            builder.remove(f)
+        got = m.match_batch(topics_pool, fallback=trie.match)
+        for topic, names in zip(topics_pool, got):
+            assert sorted(names) == sorted(trie.match(topic)), (step, topic)
+
+
+def test_churn_epoch_growth():
+    """Push one matcher through table growth (epoch bump) mid-stream."""
+    trie = TopicTrie()
+    builder = NfaBuilder()
+    m = TpuMatcher(builder)
+    # small tables first
+    for i in range(4):
+        trie.insert(f"a/{i}/+")
+        builder.add(f"a/{i}/+")
+    got = m.match_batch(["a/1/x"], fallback=trie.match)
+    assert got[0] == ["a/1/+"]
+    # now >1024 filters: forces node-array growth + edge/vocab rehash
+    for i in range(1500):
+        trie.insert(f"grow/{i}/leaf")
+        builder.add(f"grow/{i}/leaf")
+    topics_list = [f"grow/{i}/leaf" for i in range(0, 1500, 97)] + ["a/2/q"]
+    got = m.match_batch(topics_list, fallback=trie.match)
+    for topic, names in zip(topics_list, got):
+        assert sorted(names) == sorted(trie.match(topic)), topic
+
+
+def test_oplog_cap_forces_epoch_resync():
+    """More ops than OPLOG_MAX between syncs => consumer resyncs fully."""
+    trie = TopicTrie()
+    builder = NfaBuilder()
+    builder.OPLOG_MAX = 64  # tiny, to hit the cap fast
+    m = TpuMatcher(builder)
+    m.match_batch(["x"], fallback=trie.match)  # prime the mirror
+    for i in range(300):
+        trie.insert(f"c/{i}/#")
+        builder.add(f"c/{i}/#")
+    topics_list = [f"c/{i}/deep/leaf" for i in range(0, 300, 13)]
+    got = m.match_batch(topics_list, fallback=trie.match)
+    for topic, names in zip(topics_list, got):
+        assert sorted(names) == sorted(trie.match(topic)), topic
+
+
+def test_insert_cost_is_delta_not_table():
+    """The delta overlay promise: adding one filter after a sync costs a
+    bounded number of op-log entries, not an O(table) repack."""
+    builder = NfaBuilder()
+    for i in range(2000):
+        builder.add(f"base/{i}/+/leaf")
+    from emqx_tpu.ops.nfa import DeviceDeltaSync
+
+    sync = DeviceDeltaSync()
+    sync.sync(builder)
+    pos = len(builder.oplog)
+    epoch = builder.epoch
+    builder.add("base/new/+/leaf")
+    assert builder.epoch == epoch, "single insert must not force a resync"
+    # 4 words -> a handful of node/edge/vocab writes, not thousands
+    assert len(builder.oplog) - pos < 32
