@@ -246,12 +246,12 @@ class Broker:
             from emqx_tpu.models.router_model import DeviceRouter
 
             self._device = DeviceRouter(
-                self.router.builder, self.subtab, self.router.matcher.config
+                self.router.index, self.subtab, self.router.matcher_config
             )
         return self._device
 
     def _dispatch_device_results(self, msgs, results) -> List[int]:
-        matched, mcount, flags, bitmaps = results
+        matched, _mcount, flags, bitmaps = results
         r = self.router
         out: List[int] = []
         fell_back = 0
@@ -260,7 +260,9 @@ class Broker:
                 fell_back += 1
                 n = self._route_dispatch(m, r.match(m.topic))
             else:
-                n = self._dispatch_row(m, bitmaps[i], matched[i, : mcount[i]])
+                # matched rows are SPARSE (-1 holes between engines)
+                row = matched[i]
+                n = self._dispatch_row(m, bitmaps[i], row[row >= 0])
             if n == 0:
                 self.hooks.run("message.dropped", m, "no_subscribers")
                 self.metrics.inc("messages.dropped.no_subscribers")
@@ -294,7 +296,7 @@ class Broker:
                 continue
             n += self._deliver_one(sub, msg)
         for fid in fids:
-            name = self.router.builder.filter_name(int(fid))
+            name = self.router.filter_name(int(fid))
             if (
                 name is not None
                 and self.shared.has_groups(name)

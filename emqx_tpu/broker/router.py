@@ -7,9 +7,10 @@ trie match + direct lookup, :128-141):
 
 - exact (non-wildcard) filters: refcounted dict, O(1) lookup per topic;
 - wildcard filters: the authoritative CPU trie (`TopicTrie`);
-- BOTH feed the `NfaBuilder`, so the TPU batch path resolves every filter
-  kind in one kernel and the CPU path is only a correctness
-  fallback/small-batch shortcut.
+- BOTH feed the `RouteIndex` (shape-hash fast path + residual NFA,
+  ops/route_index.py), so the TPU batch path resolves every filter kind in
+  one kernel and the CPU path is only a correctness fallback/small-batch
+  shortcut.
 
 `match_batch` picks the TPU path when the batch is big enough to amortize a
 dispatch (min_tpu_batch), mirroring how the reference splits work between
@@ -22,8 +23,8 @@ from typing import Dict, List, Optional, Sequence
 
 from emqx_tpu.broker.trie import TopicTrie
 from emqx_tpu.ops import topics as T
-from emqx_tpu.ops.matcher import MatcherConfig, TpuMatcher
-from emqx_tpu.ops.nfa import NfaBuilder
+from emqx_tpu.ops.matcher import MatcherConfig
+from emqx_tpu.ops.route_index import RouteIndex
 
 
 class Router:
@@ -35,10 +36,9 @@ class Router:
     ):
         self._exact: Dict[str, int] = {}
         self._trie = TopicTrie()
-        self._builder = NfaBuilder()
-        self._matcher = TpuMatcher(
-            self._builder, matcher_config or MatcherConfig()
-        )
+        self._index = RouteIndex()
+        self._matcher = None  # lazy match-only DeviceRouter
+        self._matcher_config = matcher_config or MatcherConfig()
         self.min_tpu_batch = min_tpu_batch
         self.enable_tpu = enable_tpu
 
@@ -53,14 +53,14 @@ class Router:
 
     def add_route(self, filter_: str) -> None:
         """Refcounted insert (one ref per subscriber entry)."""
-        self._builder.add(filter_)
+        self._index.add(filter_)
         if T.wildcard(filter_):
             self._trie.insert(filter_)
         else:
             self._exact[filter_] = self._exact.get(filter_, 0) + 1
 
     def delete_route(self, filter_: str) -> None:
-        self._builder.remove(filter_)
+        self._index.remove(filter_)
         if T.wildcard(filter_):
             self._trie.delete(filter_)
         else:
@@ -82,15 +82,30 @@ class Router:
     def match_batch(self, topics: Sequence[str]) -> List[List[str]]:
         if not self.enable_tpu or len(topics) < self.min_tpu_batch:
             return [self.match(t) for t in topics]
-        return self._matcher.match_batch(topics, fallback=self.match)
+        return self.matcher.match_batch(topics, fallback=self.match)
 
     def filter_id(self, filter_: str) -> Optional[int]:
-        return self._builder.filter_id(filter_)
+        return self._index.filter_id(filter_)
+
+    def filter_name(self, fid: int) -> Optional[str]:
+        return self._index.filter_name(fid)
 
     @property
-    def builder(self) -> NfaBuilder:
-        return self._builder
+    def index(self) -> RouteIndex:
+        return self._index
 
     @property
-    def matcher(self) -> TpuMatcher:
+    def matcher(self):
+        """Match-only device engine (its own table mirror; the broker's
+        fan-out DeviceRouter keeps a separate one)."""
+        if self._matcher is None:
+            from emqx_tpu.models.router_model import DeviceRouter
+
+            self._matcher = DeviceRouter(
+                self._index, None, self._matcher_config
+            )
         return self._matcher
+
+    @property
+    def matcher_config(self) -> MatcherConfig:
+        return self._matcher_config

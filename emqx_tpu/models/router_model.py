@@ -22,7 +22,7 @@ arrays, emqx_metrics.erl:439).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +102,96 @@ route_step = partial(jax.jit, static_argnames=(
 ))(route_step_impl)
 
 
+def shape_route_step_impl(
+    shape_tables,
+    nfa_tables,
+    sub_bitmaps,
+    bytes_mat,
+    lengths,
+    *,
+    m_active: int,
+    with_nfa: bool,
+    salt: int,
+    max_levels: int = 16,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+    shape_probes: Optional[int] = None,
+):
+    """The serving-path kernel: shape index + (residual NFA) + fanout.
+
+    Tokenizes once, matches via the O(#shapes) hash path
+    (ops/shape_index.shape_match_device), runs the general NFA walk only
+    when residual filters exist (`with_nfa`), ORs subscriber bitmaps over
+    every matched fid. `matched` is SPARSE ([B, M(+K)] with -1 holes), not
+    prefix-compacted.
+    """
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import tokenizer as tok
+    from emqx_tpu.ops.matcher import batch_match_syms
+    from emqx_tpu.ops.shape_index import SHAPE_PROBES, shape_match_device
+
+    if shape_probes is None:
+        # must cover the host placement bound (ShapeIndex._place probes
+        # SHAPE_PROBES slots) or cluster-tail entries become invisible
+        shape_probes = SHAPE_PROBES
+    h1, h2, nwords, dollar = tok.tokenize_device(
+        bytes_mat, lengths, salt, max_levels
+    )
+    matched = shape_match_device(
+        shape_tables, m_active, h1, h2, nwords, dollar, probes=shape_probes
+    )
+    flags = nwords > max_levels
+    if with_nfa:
+        syms = tok.vocab_lookup_device(nfa_tables, h1, h2, probes)
+        m2, _c2, f2 = batch_match_syms(
+            nfa_tables,
+            syms,
+            nwords,
+            dollar,
+            frontier=frontier,
+            max_matches=max_matches,
+            probes=probes,
+        )
+        matched = jnp.concatenate([matched, m2], axis=1)
+        flags = flags | f2
+    mcount = jnp.sum((matched >= 0).astype(jnp.int32), axis=1)
+    if sub_bitmaps is not None:
+        bitmaps = fanout_bitmaps(sub_bitmaps, matched)
+        fanout_bits = jnp.sum(popcount32(bitmaps).astype(jnp.int32))
+    else:  # match-only callers (Router.match_batch) skip the fan-out half
+        bitmaps = None
+        fanout_bits = jnp.int32(0)
+    stats = {
+        "routed": jnp.sum((mcount > 0).astype(jnp.int32)),
+        "matches": jnp.sum(mcount),
+        "fanout_bits": fanout_bits,
+    }
+    return {
+        "matched": matched,
+        "mcount": mcount,
+        "flags": flags,
+        "bitmaps": bitmaps,
+        "stats": stats,
+    }
+
+
+shape_route_step = partial(
+    jax.jit,
+    static_argnames=(
+        "m_active",
+        "with_nfa",
+        "salt",
+        "max_levels",
+        "frontier",
+        "max_matches",
+        "probes",
+        "shape_probes",
+    ),
+)(shape_route_step_impl)
+
+
 class SubscriberTable:
     """Host-side registry: (filter id, subscriber slot) -> bitmap matrix.
 
@@ -170,49 +260,63 @@ class SubscriberTable:
 
 
 class DeviceRouter:
-    """Serving-path engine: owns the device copies of the NFA tables and the
-    subscriber bitmaps and runs `route_step` over host batches.
+    """Serving-path engine: owns the device mirrors of the shape index, the
+    residual NFA tables, and the subscriber bitmaps; runs
+    `shape_route_step` over host batches.
 
     This is what puts the flagship kernel on the broker's hot path (the
     reference analog is the emqx_router:match_routes + emqx_broker:subscribers
-    pair every publish crosses, emqx_broker.erl:204-215). Table/bitmap uploads
-    are cached by version so steady-state batches pay only the kernel launch
-    plus the bitmap readback.
+    pair every publish crosses, emqx_broker.erl:204-215). All three table
+    sets sync via the delta-overlay protocol, so steady-state batches pay
+    only the kernel launch plus the readback.
     """
 
-    def __init__(self, builder, subtab: SubscriberTable, config=None):
+    def __init__(self, index, subtab: Optional[SubscriberTable], config=None):
         import dataclasses
 
         from emqx_tpu.ops.matcher import MatcherConfig
         from emqx_tpu.ops.nfa import MAX_PROBES, DeviceDeltaSync
 
-        self.builder = builder
-        self.subtab = subtab
+        self.index = index
+        self.subtab = subtab  # None => match-only (no fan-out bitmaps)
         config = config or MatcherConfig()
         if config.probes < MAX_PROBES:
             config = dataclasses.replace(config, probes=MAX_PROBES)
         self.config = config
+        self._shape_sync = DeviceDeltaSync()
         self._nfa_sync = DeviceDeltaSync()
         self._bits_sync = DeviceDeltaSync()
 
     def _device_args(self):
-        # grow the bitmap matrix to cover every live filter id BEFORE the
-        # snapshot — a matched fid must always gather a real row
-        self.subtab.pack(self.builder.num_filters_capacity)
-        tables = self._nfa_sync.sync(self.builder)
-        bits = self._bits_sync.sync(self.subtab)["sub_bitmaps"]
-        return tables, bits, self.builder.salt
+        idx = self.index
+        if self.subtab is not None:
+            # grow the bitmap matrix to cover every live filter id BEFORE
+            # the snapshot — a matched fid must always gather a real row
+            self.subtab.pack(idx.num_filters_capacity)
+            bits = self._bits_sync.sync(self.subtab)["sub_bitmaps"]
+        else:
+            bits = None
+        shape_tables = self._shape_sync.sync(idx.shapes)
+        with_nfa = idx.residual_count > 0
+        nfa_tables = self._nfa_sync.sync(idx.nfa) if with_nfa else None
+        # pow2 bucket: recompile only on shape-count doublings; never past
+        # the shape arrays' capacity (max_shapes need not be a power of 2)
+        m_active = min(
+            _next_pow2(max(4, idx.shapes.num_active_shapes())),
+            idx.shapes.max_shapes,
+        )
+        return shape_tables, nfa_tables, bits, idx.salt, m_active, with_nfa
 
     def prepare(self):
         """Snapshot + upload current tables/bitmaps. MUST run on the thread
-        that mutates the builder/subtab (the event loop): packing walks live
-        Python structures. The returned pair is immutable device state safe
-        to hand to `route_prepared` on a worker thread."""
+        that mutates the index/subtab (the event loop): packing walks live
+        Python structures. The returned tuple is immutable device state
+        safe to hand to `route_prepared` on a worker thread."""
         return self._device_args()
 
     def route(self, topics):
         """Batch route: returns host np arrays
-        (matched [B,K], mcount [B], flags [B], bitmaps [B,W])."""
+        (matched [B,K] sparse, mcount [B], flags [B], bitmaps [B,W])."""
         return self.route_prepared(self._device_args(), topics)
 
     def route_prepared(self, args, topics):
@@ -223,18 +327,21 @@ class DeviceRouter:
         from emqx_tpu.ops import tokenizer as tok
 
         cfg = self.config
-        tables, bits, salt = args
+        shape_tables, nfa_tables, bits, salt, m_active, with_nfa = args
         B = len(topics)
         Bp = max(64, _next_pow2(B))
         mat, lens, too_long = tok.encode_topics(list(topics), cfg.max_bytes)
         if Bp != B:
             mat = np.pad(mat, ((0, Bp - B), (0, 0)))
             lens = np.pad(lens, (0, Bp - B))
-        out = route_step(
-            tables,
+        out = shape_route_step(
+            shape_tables,
+            nfa_tables,
             bits,
             mat,
             lens,
+            m_active=m_active,
+            with_nfa=with_nfa,
             salt=salt,
             max_levels=cfg.max_levels,
             frontier=cfg.frontier,
@@ -244,7 +351,43 @@ class DeviceRouter:
         matched = np.asarray(out["matched"][:B])
         mcount = np.asarray(out["mcount"][:B])
         flags = np.asarray(out["flags"][:B]) | too_long
+        if out["bitmaps"] is None:
+            return matched, mcount, flags, None
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
         bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
         return matched, mcount, flags, bitmaps
+
+    def match_batch(
+        self, topics: Sequence[str], fallback=None
+    ) -> List[List[str]]:
+        """Match topic strings -> matched filter names (no fan-out half).
+
+        Flagged rows (too deep / NFA overflow) go to `fallback(topic)`.
+        Each device hit is re-verified on host with a single-pair topic
+        match before being returned: the shape path's 64-bit combined hash
+        admits a ~2^-64 false positive, and a route decision (unlike local
+        dispatch, which re-checks per delivery) would propagate it
+        cluster-wide.
+        """
+        from emqx_tpu.ops import topics as T
+
+        matched, _mcount, flags, _ = self.route(topics)
+        out: List[List[str]] = []
+        for i, t in enumerate(topics):
+            if flags[i]:
+                if fallback is None:
+                    raise RuntimeError(
+                        f"device match overflow for topic {t!r}; "
+                        "no fallback provided"
+                    )
+                out.append(fallback(t))
+                continue
+            row = matched[i]
+            names = []
+            for fid in row[row >= 0]:
+                name = self.index.filter_name(int(fid))
+                if name is not None and T.match(t, name):
+                    names.append(name)
+            out.append(names)
+        return out

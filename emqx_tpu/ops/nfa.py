@@ -550,14 +550,26 @@ class NfaBuilder:
         return len(self._id_filters)
 
     # -- public mutation ---------------------------------------------------
-    def add(self, filter_: str) -> int:
+    def _adopt_fid(self, filter_: str, fid: int) -> None:
+        """Register an externally-allocated filter id (RouteIndex shares one
+        fid space between the shape index and this residual engine)."""
+        while len(self._id_filters) <= fid:
+            self._id_filters.append(None)
+            self._filter_refs.append(0)
+        self._filter_ids[filter_] = fid
+        self._id_filters[fid] = filter_
+
+    def add(self, filter_: str, fid: Optional[int] = None) -> int:
         """Insert a topic filter; returns its stable filter id (refcounted).
 
         O(words) — array writes + op-log appends; never a table rebuild
         except amortized growth/rehash.
         """
         T.validate(filter_)  # before any mutation: invalid input must not corrupt state
-        fid = self._filter_id(filter_)
+        if fid is None:
+            fid = self._filter_id(filter_)
+        else:
+            self._adopt_fid(filter_, fid)
         if self._filter_refs[fid] > 0:
             self._filter_refs[fid] += 1
             return fid

@@ -1,0 +1,112 @@
+"""Top-level incremental route compiler: shape fast path + residual NFA.
+
+One filter-id space shared by two device engines:
+
+- `ShapeIndex` (ops/shape_index.py) — O(#shapes) hash probes per topic;
+  takes every filter whose wildcard shape fits. This is where ~all real
+  subscription tables land.
+- `NfaBuilder` (ops/nfa.py) — the general trie-walk kernel; holds only the
+  RESIDUAL filters the shape index rejected (shape overflow past
+  MAX_SHAPES, or a 2^-64 combined-hash collision).
+
+The device route step runs the shape kernel always and the NFA kernel only
+when residuals exist (models/router_model.shape_route_step). Both engines
+speak the delta-overlay protocol, so churn reaches the device as scatters.
+
+Reference analog: this pair replaces emqx_router's match path
+(emqx_router.erl:128-141) the way the trie's compaction replaces
+level-by-level walking (emqx_trie.erl:201-232) — except compiled all the
+way down to fixed-shape batch kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from emqx_tpu.ops import topics as T
+from emqx_tpu.ops.nfa import NfaBuilder
+from emqx_tpu.ops.shape_index import MAX_SHAPES, ShapeIndex
+
+
+class RouteIndex:
+    def __init__(self, max_shapes: int = MAX_SHAPES):
+        self._names: Dict[str, int] = {}
+        self._ids: List[Optional[str]] = []
+        self._refs: List[int] = []
+        self._free: List[int] = []
+        self.nfa = NfaBuilder()
+        self.shapes = ShapeIndex(max_shapes=max_shapes)
+        self._residual: Set[str] = set()
+
+    # -- mutation ----------------------------------------------------------
+    def add(self, filter_: str) -> int:
+        T.validate(filter_)
+        fid = self._names.get(filter_)
+        if fid is not None:
+            self._refs[fid] += 1
+            return fid
+        if self._free:
+            fid = self._free.pop()
+            self._ids[fid] = filter_
+            self._refs[fid] = 1
+        else:
+            fid = len(self._ids)
+            self._ids.append(filter_)
+            self._refs.append(1)
+        self._names[filter_] = fid
+        if not self.shapes.add(filter_, fid):
+            self._residual.add(filter_)
+            self.nfa.add(filter_, fid=fid)
+            # vocab collision bumped the tokenizer salt: every combined
+            # hash in the shape index is now stale. Filters whose NEW
+            # hashes collide are evicted and re-homed in the NFA — which
+            # can itself bump the salt again, hence the loop (converges:
+            # each iteration needs a fresh 64-bit hash collision).
+            while self.nfa.salt != self.shapes.salt:
+                for ef, efid in self.shapes.rebuild(self.nfa.salt):
+                    self._residual.add(ef)
+                    self.nfa.add(ef, fid=efid)
+        return fid
+
+    def remove(self, filter_: str) -> bool:
+        fid = self._names.get(filter_)
+        if fid is None:
+            return False
+        self._refs[fid] -= 1
+        if self._refs[fid] > 0:
+            return False
+        del self._names[filter_]
+        self._ids[fid] = None
+        self._free.append(fid)
+        if filter_ in self._residual:
+            self._residual.discard(filter_)
+            self.nfa.remove(filter_)
+        else:
+            self.shapes.remove(filter_)
+        return True
+
+    # -- lookups -----------------------------------------------------------
+    def filter_name(self, fid: int) -> Optional[str]:
+        return self._ids[fid] if 0 <= fid < len(self._ids) else None
+
+    def filter_id(self, filter_: str) -> Optional[int]:
+        return self._names.get(filter_)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    @property
+    def num_filters_capacity(self) -> int:
+        return len(self._ids)
+
+    @property
+    def residual_count(self) -> int:
+        return len(self._residual)
+
+    @property
+    def salt(self) -> int:
+        return self.shapes.salt
+
+    @property
+    def version(self) -> int:
+        return self.shapes.version + self.nfa.version

@@ -1,0 +1,416 @@
+"""Filter-shape hash index: the large-table fast path of the route matcher.
+
+The NFA kernel (ops/matcher.py) walks the subscription trie level-by-level
+with `frontier x probes` random gathers per topic level. On small tables
+that's fast (everything sits in cache), but at 100k+ filters the tables
+spill to HBM and TPU random gather throughput becomes the wall (measured:
+12k topics/s at 1M filters vs 108M at 1k).
+
+This module exploits the structure of real subscription tables: filters
+cluster into a handful of *shapes* — patterns of (literal | +) positions
+with an optional trailing '#'. The reference's trie compaction leans on the
+same observation (literal runs between wildcards, emqx_trie.erl:201-232);
+taken to its TPU-native conclusion, matching becomes:
+
+    for each shape m:  one combined hash over the topic's words at m's
+                       literal positions  ->  one table probe
+
+i.e. O(#shapes) hashes + probes per topic, independent of filter count and
+topic depth. The per-level word hashes already come out of the device
+tokenizer as prefix sums (ops/tokenizer.py); the combined hash is a masked
+sum-product over levels — pure VPU work. Only the final table probe touches
+HBM, gathering ONE fused 16-byte row per (topic, shape, probe):
+~B x M x P rows, vs the NFA's B x L x F x P x 3 scattered words.
+
+Filters whose shape doesn't fit (more than MAX_SHAPES distinct shapes, or
+a 2^-64 combined-hash collision) fall back to the residual NFA engine —
+correctness never depends on the shape heuristic.
+
+Host-side updates follow the same delta-overlay protocol as NfaBuilder
+(epoch / oplog / device_snapshot; see ops/nfa.py) so subscribe/unsubscribe
+churn reaches the device as scatters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from emqx_tpu.ops import topics as T
+from emqx_tpu.ops.nfa import MAX_PROBES, _next_pow2, word_hash_pair
+
+_M32 = 0xFFFFFFFF
+
+MAX_SHAPES = 64
+MAX_MASK_LEVELS = 32  # literal mask is one int32
+# open-addressing probe bound. The DEVICE kernel must probe at least this
+# far or host-placed entries at the cluster tail become invisible to it —
+# shape_match_device and ShapeIndex._place share this constant.
+SHAPE_PROBES = MAX_PROBES
+
+# per-level combining multipliers (odd => bijective mod 2^32) and the
+# shape-id fold constants; the device kernel computes the same values
+K1_MUL = 0x9E3779B1
+K2_MUL = 0x85EBCA77
+FOLD1 = 0xC2B2AE35
+FOLD2 = 0x27D4EB2F
+SLOT_MUL = 0x165667B1
+SLOT_SHIFT = 14
+
+TOMB_FID = -2  # tombstoned table slot (fid lane)
+
+
+def _mix32(x: int) -> int:
+    x &= _M32
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & _M32
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & _M32
+    x ^= x >> 16
+    return x
+
+
+def level_mul(l: int, which: int) -> int:
+    base = K1_MUL if which == 1 else K2_MUL
+    return (base * (l + 1) * 2 + 1) & _M32
+
+
+def combined_pair(words: List[str], mask: int, shape_id: int, salt: int) -> Tuple[int, int]:
+    """(c1, c2) for a filter's literal words / a topic probed under a shape."""
+    s1 = 0
+    s2 = 0
+    for l, w in enumerate(words):
+        if mask >> l & 1:
+            h1, h2 = word_hash_pair(w, salt)
+            s1 = (s1 + h1 * level_mul(l, 1)) & _M32
+            s2 = (s2 + h2 * level_mul(l, 2)) & _M32
+    c1 = _mix32(s1 ^ ((shape_id * FOLD1) & _M32))
+    c2 = _mix32(s2 ^ ((shape_id * FOLD2) & _M32))
+    return c1, c2
+
+
+def slot_hash(c1: int) -> int:
+    h = (c1 * SLOT_MUL) & _M32
+    h ^= h >> SLOT_SHIFT
+    return h
+
+
+class ShapeIndex:
+    """Incrementally-maintained shape hash index (host side).
+
+    Accepts filters whose (wildcard-shape, combined-hash) fit; `add`
+    returns False when the filter must go to the residual NFA engine.
+    """
+
+    OPLOG_MAX = 65536
+
+    def __init__(self, salt: int = 0, max_shapes: int = MAX_SHAPES):
+        self.salt = salt
+        self.max_shapes = max_shapes
+        # shape registry: key -> shape id
+        self._shape_ids: Dict[Tuple[int, int, bool], int] = {}
+        self._shape_refs: List[int] = []
+        self._free_shapes: List[int] = []
+        # shape meta (fixed capacity; device slices [0:M_active])
+        self.arr_shape_mask = np.zeros(max_shapes, np.int32)
+        self.arr_shape_len = np.full(max_shapes, -1, np.int32)  # -1 = dead
+        self.arr_shape_flags = np.zeros(max_shapes, np.int32)  # 1=#, 2=rootwild
+        # filter table: fused [T, 4] int32 (c1, c2, fid, shape_id)
+        self._Tcap = 1024
+        self.arr_table = np.zeros((self._Tcap, 4), np.int32)
+        self.arr_table[:, 2] = -1  # fid lane: -1 empty
+        self._fill = 0  # non-empty slots (live + tombstones)
+        # filter -> (shape_id, c1, c2, fid); key -> filter for collisions
+        self._entries: Dict[str, Tuple[int, int, int, int]] = {}
+        self._by_key: Dict[Tuple[int, int], str] = {}
+        self.epoch = 0
+        self.oplog: list = []
+        self.version = 0
+
+    # -- delta protocol ----------------------------------------------------
+    def _log(self, name: str, idx: int, val: int) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump_epoch()
+            return
+        self.oplog.append((name, int(idx), int(val)))
+
+    def _bump_epoch(self) -> None:
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+
+    def device_snapshot(self) -> Dict[str, np.ndarray]:
+        return {
+            "shape_tab": self.arr_table,
+            "shape_mask": self.arr_shape_mask,
+            "shape_len": self.arr_shape_len,
+            "shape_flags": self.arr_shape_flags,
+        }
+
+    # -- shape parsing -----------------------------------------------------
+    @staticmethod
+    def parse_shape(filter_: str) -> Optional[Tuple[int, int, bool, List[str]]]:
+        """-> (literal_mask, prefix_len, has_hash, words) or None if unfit."""
+        ws = T.words(filter_)
+        has_hash = bool(ws) and ws[-1] == "#"
+        prefix = ws[:-1] if has_hash else ws
+        if len(prefix) > MAX_MASK_LEVELS:
+            return None
+        mask = 0
+        for l, w in enumerate(prefix):
+            if w == "#":
+                return None  # invalid anyway ('# only last'), but be safe
+            if w != "+":
+                mask |= 1 << l
+        return mask, len(prefix), has_hash, prefix
+
+    # -- mutation ----------------------------------------------------------
+    def _shape_for(self, mask: int, plen: int, has_hash: bool) -> Optional[int]:
+        key = (mask, plen, has_hash)
+        sid = self._shape_ids.get(key)
+        if sid is not None:
+            self._shape_refs[sid] += 1
+            return sid
+        if self._free_shapes:
+            sid = self._free_shapes.pop()
+        elif len(self._shape_refs) < self.max_shapes:
+            sid = len(self._shape_refs)
+            self._shape_refs.append(0)
+        else:
+            return None  # shape overflow -> residual
+        self._shape_ids[key] = sid
+        self._shape_refs[sid] = 1
+        rootwild = (plen == 0 and has_hash) or (plen > 0 and not (mask & 1))
+        flags = (1 if has_hash else 0) | (2 if rootwild else 0)
+        self.arr_shape_mask[sid] = mask
+        self._log("shape_mask", sid, mask)
+        self.arr_shape_flags[sid] = flags
+        self._log("shape_flags", sid, flags)
+        self.arr_shape_len[sid] = plen
+        self._log("shape_len", sid, plen)
+        return sid
+
+    def _shape_release(self, sid: int, key: Tuple[int, int, bool]) -> None:
+        self._shape_refs[sid] -= 1
+        if self._shape_refs[sid] == 0:
+            del self._shape_ids[key]
+            self._free_shapes.append(sid)
+            self.arr_shape_len[sid] = -1  # dead: never matches
+            self._log("shape_len", sid, -1)
+
+    def num_active_shapes(self) -> int:
+        """High-water shape id + 1 (device meta slice length)."""
+        return len(self._shape_refs)
+
+    def _place(self, c1: int, c2: int, fid: int, sid: int) -> None:
+        # NOTE: the caller has already put the entry in self._entries, so a
+        # rehash (which rebuilds from _entries) places it — just return.
+        if (self._fill + 1) * 2 > self._Tcap:
+            self._rehash(self._Tcap * 2)
+            return
+        slot = slot_hash(c1) & (self._Tcap - 1)
+        for p in range(MAX_PROBES):
+            idx = (slot + p) & (self._Tcap - 1)
+            f = self.arr_table[idx, 2]
+            if f == -1 or f == TOMB_FID:
+                if f == -1:
+                    self._fill += 1
+                base = idx * 4
+                for lane, val in enumerate(
+                    (np.int32(np.uint32(c1)), np.int32(np.uint32(c2)), fid, sid)
+                ):
+                    self.arr_table[idx, lane] = val
+                    self._log("shape_tab", base + lane, int(val))
+                return
+        self._rehash(self._Tcap * 2)
+
+    def _rehash(self, newT: int) -> None:
+        while True:
+            tab = np.zeros((newT, 4), np.int32)
+            tab[:, 2] = -1
+            ok = True
+            for _f, (sid, c1, c2, fid) in self._entries.items():
+                slot = slot_hash(c1) & (newT - 1)
+                placed = False
+                for p in range(MAX_PROBES):
+                    idx = (slot + p) & (newT - 1)
+                    if tab[idx, 2] == -1:
+                        tab[idx] = (
+                            np.int32(np.uint32(c1)),
+                            np.int32(np.uint32(c2)),
+                            fid,
+                            sid,
+                        )
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+            if ok:
+                break
+            newT *= 2
+        self._Tcap = newT
+        self.arr_table = tab
+        self._fill = len(self._entries)
+        self._bump_epoch()
+
+    def add(self, filter_: str, fid: int) -> bool:
+        """Index this filter under `fid`. False => caller routes it to the
+        residual NFA engine (shape overflow or hash collision)."""
+        parsed = self.parse_shape(filter_)
+        if parsed is None:
+            return False
+        mask, plen, has_hash, prefix = parsed
+        sid = self._shape_for(mask, plen, has_hash)
+        if sid is None:
+            return False
+        c1, c2 = combined_pair(prefix, mask, sid, self.salt)
+        other = self._by_key.get((c1, c2))
+        if other is not None and other != filter_:
+            # true 64-bit collision between distinct filters: residual
+            self._shape_release(sid, (mask, plen, has_hash))
+            return False
+        self._by_key[(c1, c2)] = filter_
+        self._entries[filter_] = (sid, c1, c2, fid)
+        self._place(c1, c2, fid, sid)
+        return True
+
+    def remove(self, filter_: str) -> bool:
+        ent = self._entries.pop(filter_, None)
+        if ent is None:
+            return False
+        sid, c1, c2, _fid = ent
+        self._by_key.pop((c1, c2), None)
+        slot = slot_hash(c1) & (self._Tcap - 1)
+        cc1, cc2 = np.int32(np.uint32(c1)), np.int32(np.uint32(c2))
+        for p in range(MAX_PROBES):
+            idx = (slot + p) & (self._Tcap - 1)
+            if (
+                self.arr_table[idx, 2] >= 0
+                and self.arr_table[idx, 0] == cc1
+                and self.arr_table[idx, 1] == cc2
+            ):
+                self.arr_table[idx, 2] = TOMB_FID
+                self._log("shape_tab", idx * 4 + 2, TOMB_FID)
+                break
+        parsed = self.parse_shape(filter_)
+        if parsed is not None:
+            mask, plen, has_hash, _ = parsed
+            self._shape_release(sid, (mask, plen, has_hash))
+        if (self._fill - len(self._entries)) * 4 > self._Tcap:
+            self._rehash(self._Tcap)  # compact tombstones in place
+        return True
+
+    def rebuild(self, salt: int) -> List[Tuple[str, int]]:
+        """Salt changed (vocab collision in the residual engine): recompute
+        every combined hash and rebuild the table. Rare by construction.
+
+        Returns [(filter, fid)] EVICTED because their new combined hash
+        collides with another filter's — `add` enforces key uniqueness, so
+        rebuild must too or the first-probe-wins device lookup would
+        silently drop one of the pair. The caller (RouteIndex) re-homes
+        evictees in the residual NFA engine.
+        """
+        self.salt = salt
+        entries = list(self._entries.items())
+        self._by_key.clear()
+        evicted: List[Tuple[str, int]] = []
+        for f, (sid, _c1, _c2, fid) in entries:
+            parsed = self.parse_shape(f)
+            mask, plen, has_hash, prefix = parsed
+            c1, c2 = combined_pair(prefix, mask, sid, salt)
+            if (c1, c2) in self._by_key:
+                del self._entries[f]
+                self._shape_release(sid, (mask, plen, has_hash))
+                evicted.append((f, fid))
+                continue
+            self._entries[f] = (sid, c1, c2, fid)
+            self._by_key[(c1, c2)] = f
+        self._rehash(self._Tcap)
+        return evicted
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+# -- device kernel ---------------------------------------------------------
+
+
+def shape_match_device(
+    tables, m_active: int, h1, h2, nwords, dollar, probes: int = SHAPE_PROBES
+):
+    """Match tokenized topics against the shape index. Jit-traceable.
+
+    tables: device dict (shape_tab [T,4] i32, shape_mask/len/flags [Mcap])
+    h1, h2: uint32 [B, L] per-level word hashes; nwords [B]; dollar [B]
+    -> matched fid int32 [B, M] (-1 = no match; SPARSE, not compacted)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, L = h1.shape
+    M = m_active
+    mask = tables["shape_mask"][:M]  # [M]
+    plen = tables["shape_len"][:M]
+    flags = tables["shape_flags"][:M]
+    tab = tables["shape_tab"]  # [T, 4]
+    Tcap = tab.shape[0]
+
+    lvl = jnp.arange(L, dtype=jnp.int32)
+    lvl_bit = (mask[None, :] >> lvl[:, None]) & 1  # [L, M]
+    k1 = jnp.asarray(
+        [level_mul(int(l), 1) for l in range(L)], dtype=jnp.uint32
+    )
+    k2 = jnp.asarray(
+        [level_mul(int(l), 2) for l in range(L)], dtype=jnp.uint32
+    )
+    w1 = k1[:, None] * lvl_bit.astype(jnp.uint32)  # [L, M]
+    w2 = k2[:, None] * lvl_bit.astype(jnp.uint32)
+    # masked sum-product over levels (uint32 wrap = mod 2^32)
+    s1 = jnp.sum(h1[:, :, None] * w1[None, :, :], axis=1, dtype=jnp.uint32)
+    s2 = jnp.sum(h2[:, :, None] * w2[None, :, :], axis=1, dtype=jnp.uint32)
+    sid = jnp.arange(M, dtype=jnp.uint32)
+    c1 = _mix32_dev(s1 ^ (sid[None, :] * jnp.uint32(FOLD1)))
+    c2 = _mix32_dev(s2 ^ (sid[None, :] * jnp.uint32(FOLD2)))
+
+    has_hash = (flags & 1) != 0
+    rootwild = (flags & 2) != 0
+    live = plen >= 0
+    nw = nwords[:, None]
+    ok_len = jnp.where(has_hash[None, :], nw >= plen[None, :], nw == plen[None, :])
+    valid = ok_len & live[None, :] & ~(dollar[:, None] & rootwild[None, :])
+
+    c1i = jax.lax.bitcast_convert_type(c1, jnp.int32)
+    c2i = jax.lax.bitcast_convert_type(c2, jnp.int32)
+    slot = c1 * jnp.uint32(SLOT_MUL)
+    slot = slot ^ (slot >> SLOT_SHIFT)
+    fid = jnp.full((B, M), -1, dtype=jnp.int32)
+    found = jnp.zeros((B, M), dtype=bool)
+    tmask = jnp.uint32(Tcap - 1)
+    for p in range(probes):
+        idx = ((slot + jnp.uint32(p)) & tmask).astype(jnp.int32)
+        rows = tab[idx]  # [B, M, 4] — ONE fused gather per probe
+        hit = (
+            (rows[..., 0] == c1i)
+            & (rows[..., 1] == c2i)
+            & (rows[..., 3] == jnp.arange(M, dtype=jnp.int32)[None, :])
+            & (rows[..., 2] >= 0)
+            & valid
+            & ~found
+        )
+        fid = jnp.where(hit, rows[..., 2], fid)
+        found |= hit
+    return fid
+
+
+def _mix32_dev(x):
+    import jax.numpy as jnp
+
+    x ^= x >> 16
+    x = x * jnp.uint32(0x7FEB352D)
+    x ^= x >> 15
+    x = x * jnp.uint32(0x846CA68B)
+    x ^= x >> 16
+    return x
