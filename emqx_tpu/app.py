@@ -32,6 +32,17 @@ from emqx_tpu.transport.listener import ListenerConfig, Listeners
 from emqx_tpu.utils.node import node_name, set_node_name
 
 
+def _register_builtin_gateways(registry) -> None:
+    """Built-in protocol gateway types (apps/emqx_gateway/src/* impls)."""
+    from emqx_tpu.gateway.exproto import ExprotoGateway
+    from emqx_tpu.gateway.mqttsn import SnGateway
+    from emqx_tpu.gateway.stomp import StompGateway
+
+    registry.register_type("stomp", StompGateway)
+    registry.register_type("mqttsn", SnGateway)
+    registry.register_type("exproto", ExprotoGateway)
+
+
 class BrokerApp:
     def __init__(self, config: Optional[AppConfig] = None):
         self.config = config or AppConfig()
@@ -300,6 +311,7 @@ class BrokerApp:
             self.exhook = None
 
         self.mgmt_server = None  # set by start() when dashboard.enable
+        self.gateways = None  # GatewayRegistry, set by start() when configured
         self._tasks: List[asyncio.Task] = []
         self.started_at: Optional[float] = None
 
@@ -374,6 +386,16 @@ class BrokerApp:
                 ),
                 chan_cfg,
             )
+        if c.gateways:
+            from emqx_tpu.gateway.registry import GatewayRegistry
+
+            self.gateways = GatewayRegistry(self.broker, self.hooks)
+            _register_builtin_gateways(self.gateways)
+            for gspec in c.gateways:
+                if gspec.enable:
+                    await self.gateways.load(
+                        gspec.type, dict(gspec.opts), name=gspec.name
+                    )
         if c.dashboard.enable:
             from emqx_tpu.mgmt.api import MgmtApi
 
@@ -402,6 +424,8 @@ class BrokerApp:
             await self.statsd.stop()
         if self.mgmt_server is not None:
             await self.mgmt_server.stop()
+        if self.gateways is not None:
+            await self.gateways.unload_all()
         await self.listeners.stop_all()
         # final checkpoint AFTER listeners close: connection teardown parks
         # live persistent sessions into cm._detached, so the snapshot

@@ -244,6 +244,18 @@ class RuleSpec:
 
 
 @dataclass
+class GatewaySpec:
+    """One protocol gateway instance (emqx_gateway config analog).
+    type: stomp | mqttsn | exproto; options go in `opts` (bind/port/
+    mountpoint/predefined/handler...)."""
+
+    type: str = "stomp"
+    name: Optional[str] = None  # defaults to type
+    enable: bool = True
+    opts: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class AppConfig:
     node: NodeConfig = field(default_factory=NodeConfig)
     listeners: List[ListenerSpec] = field(default_factory=lambda: [ListenerSpec()])
@@ -269,6 +281,7 @@ class AppConfig:
     dashboard: DashboardConfig = field(default_factory=DashboardConfig)
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
     rules: List[RuleSpec] = field(default_factory=list)
+    gateways: List[GatewaySpec] = field(default_factory=list)
 
 
 class ConfigError(ValueError):

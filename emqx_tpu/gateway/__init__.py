@@ -1,0 +1,22 @@
+"""Multi-protocol gateway framework.
+
+TPU-stack analog of the reference's largest app, `apps/emqx_gateway`
+(19.7k LoC): a registry of pluggable protocol gateways, generic behaviours
+(frame codec / channel / connection), a gateway-local client manager, and
+protocol implementations (STOMP, MQTT-SN, exproto gRPC bring-your-own-
+protocol). Gateway clients bridge into the core broker through
+`GwSession` — subscribe/publish with per-gateway mountpoints, exactly how
+the reference's gateways attach via hooks + emqx_broker
+(apps/emqx_gateway/src/emqx_gateway.erl:22-61, src/bhvrs/).
+"""
+
+from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.gateway.registry import GatewayCM, GatewayRegistry
+
+__all__ = [
+    "Gateway",
+    "GwClientInfo",
+    "GwSession",
+    "GatewayCM",
+    "GatewayRegistry",
+]

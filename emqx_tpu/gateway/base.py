@@ -1,0 +1,204 @@
+"""Gateway behaviours: the generic half every protocol gateway shares.
+
+Parity with the reference's behaviour modules
+(apps/emqx_gateway/src/bhvrs/emqx_gateway_{impl,channel,conn,frame}.erl):
+
+- `Gateway`      — the impl behaviour: load/unload lifecycle, listeners
+                   (emqx_gateway_impl.erl on_gateway_load/unload)
+- `GwFrame`      — incremental codec behaviour (emqx_gateway_frame.erl)
+- `GwSession`    — bridges one gateway client into the core broker:
+                   subscribe/publish with mountpoint, hook runs, delivery
+                   callback (the role emqx_gateway_channel fills via
+                   emqx_broker + hooks)
+- `GwClientInfo` — client identity passed to hooks/authn
+
+Gateways do NOT reimplement broker semantics: retained delivery, shared
+subs, rule-engine events etc. all come for free because GwSession calls the
+same Broker/Hooks the MQTT channel does.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from emqx_tpu.broker import mountpoint as MP
+from emqx_tpu.broker.message import Message
+from emqx_tpu.mqtt import packet as pkt
+
+
+@dataclass
+class GwClientInfo:
+    clientid: str
+    username: Optional[str] = None
+    peername: Tuple[str, int] = ("", 0)
+    protocol: str = ""
+    mountpoint: Optional[str] = None
+    keepalive: int = 0
+    clean_start: bool = True
+    connected_at: float = field(default_factory=time.time)
+
+    def as_dict(self) -> Dict:
+        return {
+            "client_id": self.clientid,
+            "clientid": self.clientid,
+            "username": self.username,
+            "peername": self.peername,
+            "protocol": self.protocol,
+            "mountpoint": self.mountpoint,
+            "keepalive": self.keepalive,
+            "clean_start": self.clean_start,
+            "connected_at": self.connected_at,
+        }
+
+
+class GwSession:
+    """One gateway client's bridge into the core broker.
+
+    Delivery: the broker calls the session's deliver callback with
+    (Message, SubOpts); the protocol channel serializes it out. Topics are
+    mounted on the way in and unmounted on delivery
+    (emqx_mountpoint.erl semantics, same helper the MQTT channel uses).
+    """
+
+    def __init__(
+        self,
+        gw_name: str,
+        broker,
+        hooks,
+        info: GwClientInfo,
+        deliver: Callable[[Message, pkt.SubOpts], None],
+    ):
+        self.gw = gw_name
+        self.broker = broker
+        self.hooks = hooks
+        self.info = info
+        self.mountpoint = MP.replvar(info.mountpoint, info.as_dict())
+        self._deliver = deliver
+        self.subs: Dict[str, pkt.SubOpts] = {}  # client-visible filters
+        self.sid = f"gw:{gw_name}:{info.clientid}"
+        self.connected = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self) -> None:
+        self.connected = True
+        self.hooks.run("client.connected", self.info.as_dict(), self)
+
+    def close(self, reason: str = "normal") -> None:
+        if not self.connected:
+            return
+        self.connected = False
+        for f in list(self.subs):
+            self.unsubscribe(f)
+        self.hooks.run("client.disconnected", self.info.as_dict(), reason)
+
+    # -- pub/sub -----------------------------------------------------------
+    def subscribe(self, filter_: str, opts: Optional[pkt.SubOpts] = None) -> None:
+        opts = opts or pkt.SubOpts()
+        mf = MP.mount(self.mountpoint, filter_)
+        self.broker.subscribe(
+            self.sid, self.info.clientid, mf, opts, self._on_deliver
+        )
+        self.subs[filter_] = opts
+        self.hooks.run(
+            "session.subscribed", self.info.as_dict(), mf, opts
+        )
+
+    def unsubscribe(self, filter_: str) -> bool:
+        mf = MP.mount(self.mountpoint, filter_)
+        ok = self.broker.unsubscribe(self.sid, mf)
+        self.subs.pop(filter_, None)
+        if ok:
+            self.hooks.run(
+                "session.unsubscribed", self.info.as_dict(), mf
+            )
+        return ok
+
+    def publish(
+        self,
+        topic: str,
+        payload: bytes,
+        qos: int = 0,
+        retain: bool = False,
+        properties: Optional[Dict] = None,
+    ) -> "asyncio.Future":
+        """Fold + route one message (async enqueue onto the device batch
+        window when the broker has one); returns an awaitable/int."""
+        msg = Message(
+            topic=MP.mount(self.mountpoint, topic),
+            payload=payload,
+            qos=qos,
+            retain=retain,
+            from_client=self.info.clientid,
+            from_username=self.info.username,
+            properties=properties or {},
+        )
+        return self.broker.apublish_enqueue(msg)
+
+    def publish_sync(
+        self, topic: str, payload: bytes, qos: int = 0, retain: bool = False
+    ) -> int:
+        msg = Message(
+            topic=MP.mount(self.mountpoint, topic),
+            payload=payload,
+            qos=qos,
+            retain=retain,
+            from_client=self.info.clientid,
+            from_username=self.info.username,
+        )
+        return self.broker.publish(msg)
+
+    # -- delivery ----------------------------------------------------------
+    def _on_deliver(self, msg: Message, opts: pkt.SubOpts) -> None:
+        if self.mountpoint and msg.topic.startswith(self.mountpoint):
+            import copy
+
+            msg = copy.copy(msg)
+            msg.topic = MP.unmount(self.mountpoint, msg.topic)
+        self.hooks.run("message.delivered", self.info.as_dict(), msg)
+        self._deliver(msg, opts)
+
+
+class GwFrame:
+    """Incremental frame codec behaviour (emqx_gateway_frame.erl).
+
+    Subclasses keep partial-input state; `parse` returns complete frames
+    and buffers the remainder — the same contract as the MQTT codec
+    (emqx_tpu.mqtt.frame)."""
+
+    def parse(self, data: bytes) -> List[object]:
+        raise NotImplementedError
+
+    def serialize(self, frame: object) -> bytes:
+        raise NotImplementedError
+
+
+class Gateway:
+    """Impl behaviour: one registered protocol gateway
+    (emqx_gateway_impl.erl on_gateway_load/on_gateway_unload).
+
+    Subclasses own their listeners/transports and create GwSessions
+    through the GatewayCM handed to them at load."""
+
+    name: str = "?"
+
+    def __init__(self, name: str, config: Dict):
+        self.name = name
+        self.config = config
+        self.cm = None  # set by registry at load
+        self.broker = None
+        self.hooks = None
+
+    async def start(self) -> None:
+        raise NotImplementedError
+
+    async def stop(self) -> None:
+        raise NotImplementedError
+
+    def status(self) -> Dict:
+        return {
+            "name": self.name,
+            "running": True,
+            "clients": self.cm.count() if self.cm else 0,
+        }

@@ -1,0 +1,386 @@
+"""exproto gateway: bring-your-own-protocol over gRPC.
+
+The user implements a `ConnectionHandler` gRPC service (their protocol
+logic); this gateway owns raw TCP/UDP listeners and, per connection:
+
+- streams socket lifecycle + received bytes to the handler
+  (OnSocketCreated/OnReceivedBytes/OnSocketClosed, client-streaming RPCs)
+- exposes a `ConnectionAdapter` gRPC service the handler calls back into:
+  Send / Close / Authenticate / StartTimer / Publish / Subscribe /
+  Unsubscribe, keyed by the conn id we handed it
+- delivers broker messages to the handler via OnReceivedMessages
+
+Wire-compatible with the reference's exproto
+(apps/emqx_gateway/src/exproto/protos/exproto.proto:23,46): same package
+`emqx.exproto.v1`, services, and message layout — a handler binary built
+against the reference attaches unchanged. Like exhook, the stubs are
+assembled from grpc-core primitives (no grpc_tools in this toolchain).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Dict, Optional
+
+import grpc
+import grpc.aio
+
+from emqx_tpu.gateway import exproto_pb2 as pb
+from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.mqtt import packet as pkt
+
+log = logging.getLogger("emqx_tpu.gateway.exproto")
+
+ADAPTER_SERVICE = "emqx.exproto.v1.ConnectionAdapter"
+HANDLER_SERVICE = "emqx.exproto.v1.ConnectionHandler"
+
+ADAPTER_METHODS = {
+    "Send": (pb.SendBytesRequest, pb.CodeResponse),
+    "Close": (pb.CloseSocketRequest, pb.CodeResponse),
+    "Authenticate": (pb.AuthenticateRequest, pb.CodeResponse),
+    "StartTimer": (pb.TimerRequest, pb.CodeResponse),
+    "Publish": (pb.PublishRequest, pb.CodeResponse),
+    "Subscribe": (pb.SubscribeRequest, pb.CodeResponse),
+    "Unsubscribe": (pb.UnsubscribeRequest, pb.CodeResponse),
+}
+
+HANDLER_METHODS = {
+    "OnSocketCreated": pb.SocketCreatedRequest,
+    "OnSocketClosed": pb.SocketClosedRequest,
+    "OnReceivedBytes": pb.ReceivedBytesRequest,
+    "OnTimerTimeout": pb.TimerTimeoutRequest,
+    "OnReceivedMessages": pb.ReceivedMessagesRequest,
+}
+
+
+class _HandlerClient:
+    """Client-streaming pushes to the user's ConnectionHandler service.
+
+    One long-lived stream per RPC (the reference holds streams open the
+    same way); events are queued and forwarded by a pump task per stream.
+    A stream that errors (handler restart) is torn down so the NEXT push
+    re-opens it — events queued while the handler is down are bounded by
+    QUEUE_MAX and the oldest are dropped, not hoarded.
+    """
+
+    QUEUE_MAX = 10_000
+
+    def __init__(self, target: str):
+        self._channel = grpc.aio.insecure_channel(target)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._tasks: Dict[str, asyncio.Task] = {}
+
+    def _stream(self, rpc: str):
+        q = self._queues.get(rpc)
+        if q is None:
+            q = asyncio.Queue()
+            self._queues[rpc] = q
+            req_cls = HANDLER_METHODS[rpc]
+            method = self._channel.stream_unary(
+                f"/{HANDLER_SERVICE}/{rpc}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=pb.EmptySuccess.FromString,
+            )
+
+            async def gen():
+                while True:
+                    item = await q.get()
+                    if item is None:
+                        return
+                    yield item
+
+            async def pump():
+                try:
+                    await method(gen())
+                except grpc.aio.AioRpcError as e:
+                    log.warning("exproto handler stream %s: %s", rpc, e.code())
+                finally:
+                    # drop the dead stream so the next push re-opens it
+                    if self._queues.get(rpc) is q:
+                        del self._queues[rpc]
+                        self._tasks.pop(rpc, None)
+
+            self._tasks[rpc] = asyncio.get_running_loop().create_task(pump())
+        return q
+
+    def push(self, rpc: str, msg) -> None:
+        q = self._stream(rpc)
+        while q.qsize() >= self.QUEUE_MAX:
+            q.get_nowait()  # shed oldest under backpressure
+        q.put_nowait(msg)
+
+    async def close(self) -> None:
+        # snapshot: pump teardown mutates these dicts as streams finish
+        for q in list(self._queues.values()):
+            q.put_nowait(None)
+        for t in list(self._tasks.values()):
+            try:
+                await asyncio.wait_for(t, timeout=1.0)
+            except (asyncio.TimeoutError, Exception):
+                t.cancel()
+        await self._channel.close()
+
+
+class _ExprotoConn:
+    """One raw socket under exproto management."""
+
+    def __init__(self, gw: "ExprotoGateway", writer, peer, sock):
+        self.gw = gw
+        self.conn_id = uuid.uuid4().hex
+        self.writer = writer
+        self.peer = peer
+        self.sock = sock
+        self.session: Optional[GwSession] = None
+        self.clientid: Optional[str] = None
+        self.keepalive_task: Optional[asyncio.Task] = None
+        self.keepalive_interval: int = 0
+        self.keepalive_deadline: Optional[float] = None
+        self.closed = False
+
+    def touch(self) -> None:
+        """Inbound traffic extends the keepalive deadline."""
+        if self.keepalive_interval:
+            self.keepalive_deadline = (
+                time.monotonic() + 2 * self.keepalive_interval
+            )
+
+    def conninfo(self) -> pb.ConnInfo:
+        return pb.ConnInfo(
+            socktype=pb.TCP,
+            peername=pb.Address(host=self.peer[0], port=self.peer[1]),
+            sockname=pb.Address(host=self.sock[0], port=self.sock[1]),
+        )
+
+    async def close(self, reason: str = "normal") -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self.keepalive_task is not None:
+            self.keepalive_task.cancel()
+        if self.session is not None:
+            self.gw.cm.close(self.clientid, self)
+            self.session.close(reason)
+        self.gw.handler.push(
+            "OnSocketClosed",
+            pb.SocketClosedRequest(conn=self.conn_id, reason=reason),
+        )
+        self.gw.conns.pop(self.conn_id, None)
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    def deliver(self, msg, opts: pkt.SubOpts) -> None:
+        self.gw.handler.push(
+            "OnReceivedMessages",
+            pb.ReceivedMessagesRequest(
+                conn=self.conn_id,
+                messages=[
+                    pb.Message(
+                        node=self.gw.config.get("node", "emqx_tpu@local"),
+                        id=str(msg.mid),
+                        qos=min(msg.qos, opts.qos),
+                        topic=msg.topic,
+                        payload=msg.payload,
+                        timestamp=int(msg.timestamp * 1000),
+                        **{"from": msg.from_client},
+                    )
+                ],
+            ),
+        )
+
+
+class ExprotoGateway(Gateway):
+    """TCP listener + ConnectionAdapter service + handler streams."""
+
+    def __init__(self, name: str, config: Dict):
+        super().__init__(name, config)
+        self.conns: Dict[str, _ExprotoConn] = {}
+        self.handler: Optional[_HandlerClient] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._grpc_server: Optional[grpc.aio.Server] = None
+
+    # -- ConnectionAdapter service ----------------------------------------
+    def _adapter_handlers(self):
+        def ok():
+            return pb.CodeResponse(code=pb.SUCCESS)
+
+        def fail(code, msg=""):
+            return pb.CodeResponse(code=code, message=msg)
+
+        def need_conn(fn):
+            async def wrapped(req, ctx):
+                conn = self.conns.get(req.conn)
+                if conn is None or conn.closed:
+                    return fail(pb.CONN_PROCESS_NOT_ALIVE, "no such conn")
+                return await fn(req, conn)
+
+            return wrapped
+
+        @need_conn
+        async def send(req, conn):
+            conn.writer.write(req.bytes)
+            return ok()
+
+        @need_conn
+        async def close(req, conn):
+            await conn.close("adapter_close")
+            return ok()
+
+        @need_conn
+        async def authenticate(req, conn):
+            ci = req.clientinfo
+            if not ci.clientid:
+                return fail(pb.REQUIRED_PARAMS_MISSED, "clientid required")
+            info = GwClientInfo(
+                clientid=ci.clientid,
+                username=ci.username or None,
+                peername=conn.peer,
+                protocol=ci.proto_name or "exproto",
+                mountpoint=ci.mountpoint or self.config.get("mountpoint"),
+            )
+            res = await self.hooks.arun_fold(
+                "client.authenticate",
+                (info.as_dict(),),
+                {"ok": True, "password": req.password},
+            )
+            if res is not None and res.get("ok") is False:
+                return fail(pb.PERMISSION_DENY, "authentication failed")
+            old = self.cm.open(ci.clientid, conn)
+            if old is not None and old is not conn:
+                await old.close("discarded")
+            conn.clientid = ci.clientid
+            conn.session = GwSession(
+                self.name, self.broker, self.hooks, info, conn.deliver
+            )
+            conn.session.open()
+            return ok()
+
+        @need_conn
+        async def start_timer(req, conn):
+            if req.type != pb.KEEPALIVE or req.interval == 0:
+                return fail(pb.PARAMS_TYPE_ERROR, "bad timer")
+            conn.keepalive_interval = req.interval
+            conn.touch()
+            if conn.keepalive_task is None:
+                conn.keepalive_task = asyncio.get_running_loop().create_task(
+                    self._keepalive_loop(conn, req.interval)
+                )
+            return ok()
+
+        @need_conn
+        async def publish(req, conn):
+            if conn.session is None:
+                return fail(pb.PERMISSION_DENY, "not authenticated")
+            r = conn.session.publish(req.topic, req.payload, qos=req.qos)
+            res = await r
+            if asyncio.isfuture(res):
+                await res
+            return ok()
+
+        @need_conn
+        async def subscribe(req, conn):
+            if conn.session is None:
+                return fail(pb.PERMISSION_DENY, "not authenticated")
+            conn.session.subscribe(req.topic, pkt.SubOpts(qos=min(req.qos, 2)))
+            return ok()
+
+        @need_conn
+        async def unsubscribe(req, conn):
+            if conn.session is None:
+                return fail(pb.PERMISSION_DENY, "not authenticated")
+            conn.session.unsubscribe(req.topic)
+            return ok()
+
+        impls = {
+            "Send": send,
+            "Close": close,
+            "Authenticate": authenticate,
+            "StartTimer": start_timer,
+            "Publish": publish,
+            "Subscribe": subscribe,
+            "Unsubscribe": unsubscribe,
+        }
+        handlers = {}
+        for rpc, (req_cls, resp_cls) in ADAPTER_METHODS.items():
+            handlers[rpc] = grpc.unary_unary_rpc_method_handler(
+                impls[rpc],
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString,
+            )
+        return grpc.method_handlers_generic_handler(ADAPTER_SERVICE, handlers)
+
+    async def _keepalive_loop(self, conn: _ExprotoConn, interval: int) -> None:
+        try:
+            while not conn.closed:
+                await asyncio.sleep(interval)
+                if (
+                    conn.keepalive_deadline is not None
+                    and time.monotonic() > conn.keepalive_deadline
+                ):
+                    self.handler.push(
+                        "OnTimerTimeout",
+                        pb.TimerTimeoutRequest(
+                            conn=conn.conn_id, type=pb.KEEPALIVE
+                        ),
+                    )
+                    await conn.close("keepalive_timeout")
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        target = self.config["handler"]  # e.g. "127.0.0.1:9100"
+        self.handler = _HandlerClient(target)
+
+        self._grpc_server = grpc.aio.server()
+        self._grpc_server.add_generic_rpc_handlers((self._adapter_handlers(),))
+        adapter_bind = self.config.get("adapter_bind", "127.0.0.1:0")
+        self.adapter_port = self._grpc_server.add_insecure_port(adapter_bind)
+        await self._grpc_server.start()
+
+        async def on_conn(reader, writer):
+            peer = writer.get_extra_info("peername") or ("", 0)
+            sock = writer.get_extra_info("sockname") or ("", 0)
+            conn = _ExprotoConn(self, writer, peer, sock)
+            self.conns[conn.conn_id] = conn
+            self.handler.push(
+                "OnSocketCreated",
+                pb.SocketCreatedRequest(
+                    conn=conn.conn_id, conninfo=conn.conninfo()
+                ),
+            )
+            try:
+                while True:
+                    data = await reader.read(4096)
+                    if not data:
+                        break
+                    conn.touch()
+                    self.handler.push(
+                        "OnReceivedBytes",
+                        pb.ReceivedBytesRequest(conn=conn.conn_id, bytes=data),
+                    )
+            except ConnectionError:
+                pass
+            finally:
+                await conn.close("sock_closed")
+
+        host = self.config.get("bind", "127.0.0.1")
+        port = self.config.get("port", 7993)
+        self._server = await asyncio.start_server(on_conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for conn in list(self.conns.values()):
+            await conn.close("gateway_stopped")
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
+        if self.handler is not None:
+            await self.handler.close()

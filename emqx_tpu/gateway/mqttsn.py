@@ -1,0 +1,613 @@
+"""MQTT-SN v1.2 gateway (UDP).
+
+Parity with the reference's MQTT-SN gateway (apps/emqx_gateway/src/mqttsn/:
+emqx_sn_frame.erl codec, channel + registry semantics):
+
+- CONNECT/CONNACK over datagrams; one channel per peer address
+- topic registry: REGISTER/REGACK map topic names <-> 16-bit topic ids,
+  per-client (emqx_sn_registry.erl); predefined topic ids from config;
+  2-char short topic names inline
+- PUBLISH QoS 0/1/2 (+ QoS -1 "publish without connect" to predefined
+  topics), PUBACK/PUBREC/PUBREL/PUBCOMP
+- SUBSCRIBE/UNSUBSCRIBE by name, id, or short name; SUBACK assigns ids;
+  wildcard subscriptions get topic ids lazily via server REGISTER on
+  first delivery
+- PINGREQ/PINGRESP keepalive; DISCONNECT with duration = sleeping client
+  (messages buffered, flushed on the wake-up PINGREQ)
+- ADVERTISE/SEARCHGW/GWINFO discovery responses
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.ops import topics as T
+
+log = logging.getLogger("emqx_tpu.gateway.mqttsn")
+
+# message types (MQTT-SN v1.2 §5.2.2)
+ADVERTISE = 0x00
+SEARCHGW = 0x01
+GWINFO = 0x02
+CONNECT = 0x04
+CONNACK = 0x05
+WILLTOPICREQ = 0x06
+WILLTOPIC = 0x07
+WILLMSGREQ = 0x08
+WILLMSG = 0x09
+REGISTER = 0x0A
+REGACK = 0x0B
+PUBLISH = 0x0C
+PUBACK = 0x0D
+PUBCOMP = 0x0E
+PUBREC = 0x0F
+PUBREL = 0x10
+SUBSCRIBE = 0x12
+SUBACK = 0x13
+UNSUBSCRIBE = 0x14
+UNSUBACK = 0x15
+PINGREQ = 0x16
+PINGRESP = 0x17
+DISCONNECT = 0x18
+
+# flags
+FLAG_DUP = 0x80
+FLAG_QOS_MASK = 0x60
+FLAG_RETAIN = 0x10
+FLAG_WILL = 0x08
+FLAG_CLEAN = 0x04
+FLAG_TOPIC_MASK = 0x03
+TOPIC_NORMAL = 0x00
+TOPIC_PREDEF = 0x01
+TOPIC_SHORT = 0x02
+
+RC_ACCEPTED = 0x00
+RC_CONGESTION = 0x01
+RC_INVALID_TOPIC_ID = 0x02
+RC_NOT_SUPPORTED = 0x03
+
+QOS_NEG1 = 3  # flag value 0b11: QoS -1
+
+
+def qos_from_flags(flags: int) -> int:
+    return (flags & FLAG_QOS_MASK) >> 5
+
+
+def flags_from(qos: int = 0, retain: bool = False, dup: bool = False,
+               topic_type: int = TOPIC_NORMAL, clean: bool = False,
+               will: bool = False) -> int:
+    return (
+        (FLAG_DUP if dup else 0)
+        | ((qos & 3) << 5)
+        | (FLAG_RETAIN if retain else 0)
+        | (FLAG_WILL if will else 0)
+        | (FLAG_CLEAN if clean else 0)
+        | (topic_type & FLAG_TOPIC_MASK)
+    )
+
+
+@dataclass
+class SnFrame:
+    type: int
+    # decoded fields, per type
+    fields: Dict = field(default_factory=dict)
+
+
+def encode(type_: int, body: bytes) -> bytes:
+    n = len(body) + 2
+    if n + 2 > 255:
+        return struct.pack("!BHB", 0x01, n + 2, type_) + body
+    return struct.pack("!BB", n, type_) + body
+
+
+def decode(data: bytes) -> Optional[SnFrame]:
+    if len(data) < 2:
+        return None
+    if data[0] == 0x01:
+        if len(data) < 4:
+            return None
+        length = struct.unpack("!H", data[1:3])[0]
+        type_ = data[3]
+        body = data[4:length]
+    else:
+        length = data[0]
+        type_ = data[1]
+        body = data[2:length]
+    f = SnFrame(type_)
+    d = f.fields
+    try:
+        if type_ == CONNECT:
+            d["flags"], d["protocol_id"] = body[0], body[1]
+            d["duration"] = struct.unpack("!H", body[2:4])[0]
+            d["client_id"] = body[4:].decode("utf-8")
+        elif type_ == CONNACK:
+            d["rc"] = body[0]
+        elif type_ == SEARCHGW:
+            d["radius"] = body[0]
+        elif type_ in (REGISTER,):
+            d["topic_id"] = struct.unpack("!H", body[0:2])[0]
+            d["msg_id"] = struct.unpack("!H", body[2:4])[0]
+            d["topic"] = body[4:].decode("utf-8")
+        elif type_ in (REGACK, PUBACK):
+            d["topic_id"] = struct.unpack("!H", body[0:2])[0]
+            d["msg_id"] = struct.unpack("!H", body[2:4])[0]
+            d["rc"] = body[4]
+        elif type_ == PUBLISH:
+            d["flags"] = body[0]
+            d["topic_id"] = struct.unpack("!H", body[1:3])[0]
+            d["topic_raw"] = body[1:3]
+            d["msg_id"] = struct.unpack("!H", body[3:5])[0]
+            d["payload"] = body[5:]
+        elif type_ in (PUBREC, PUBREL, PUBCOMP):
+            d["msg_id"] = struct.unpack("!H", body[0:2])[0]
+        elif type_ in (SUBSCRIBE, UNSUBSCRIBE):
+            d["flags"] = body[0]
+            d["msg_id"] = struct.unpack("!H", body[1:3])[0]
+            tt = body[0] & FLAG_TOPIC_MASK
+            if tt in (TOPIC_PREDEF,):
+                d["topic_id"] = struct.unpack("!H", body[3:5])[0]
+            elif tt == TOPIC_SHORT:
+                d["topic"] = body[3:5].decode("utf-8")
+            else:
+                d["topic"] = body[3:].decode("utf-8")
+        elif type_ == SUBACK:
+            d["flags"] = body[0]
+            d["topic_id"] = struct.unpack("!H", body[1:3])[0]
+            d["msg_id"] = struct.unpack("!H", body[3:5])[0]
+            d["rc"] = body[5]
+        elif type_ == UNSUBACK:
+            d["msg_id"] = struct.unpack("!H", body[0:2])[0]
+        elif type_ == PINGREQ:
+            d["client_id"] = body.decode("utf-8") if body else ""
+        elif type_ == DISCONNECT:
+            d["duration"] = (
+                struct.unpack("!H", body[0:2])[0] if len(body) >= 2 else None
+            )
+        elif type_ == WILLTOPIC:
+            if body:
+                d["flags"] = body[0]
+                d["topic"] = body[1:].decode("utf-8")
+        elif type_ == WILLMSG:
+            d["payload"] = body
+    except (IndexError, struct.error, UnicodeDecodeError):
+        return None
+    return f
+
+
+class SnTopicRegistry:
+    """Per-client topic-name <-> topic-id map (emqx_sn_registry.erl)."""
+
+    def __init__(self, predefined: Dict[int, str]):
+        self.predefined = dict(predefined)
+        self._pre_rev = {v: k for k, v in predefined.items()}
+        self._by_id: Dict[int, str] = {}
+        self._by_name: Dict[str, int] = {}
+        self._next = 0x0100  # ids below are reserved for predefined
+
+    def register(self, topic: str) -> int:
+        tid = self._by_name.get(topic) or self._pre_rev.get(topic)
+        if tid is not None:
+            return tid
+        tid = self._next
+        self._next += 1
+        self._by_id[tid] = topic
+        self._by_name[topic] = tid
+        return tid
+
+    def lookup_id(self, tid: int) -> Optional[str]:
+        return self._by_id.get(tid) or self.predefined.get(tid)
+
+    def lookup_name(self, topic: str) -> Optional[int]:
+        return self._by_name.get(topic) or self._pre_rev.get(topic)
+
+
+class SnChannel:
+    """One MQTT-SN client (keyed by UDP peer address)."""
+
+    AWAKE_FLUSH_MAX = 100
+
+    def __init__(self, gw: "SnGateway", peer: Tuple[str, int]):
+        self.gw = gw
+        self.peer = peer
+        self.session: Optional[GwSession] = None
+        self.reg = SnTopicRegistry(gw.predefined)
+        self.connected = False
+        self.client_id = ""
+        self.keepalive = 0
+        self.last_seen = time.monotonic()
+        self._msg_seq = 0
+        # sleeping-client buffer (DISCONNECT with duration)
+        self.asleep = False
+        self.sleep_until = 0.0
+        self.sleep_duration = 0
+        self._sleep_buf: List = []
+        # QoS1 pending: msg_id -> (topic_id, payload) for retransmit-free ack
+        self._in_qos2: Dict[int, object] = {}
+        self.will_topic: Optional[str] = None
+        self.will_msg: bytes = b""
+        self._pending_connack = False
+        # frames of one channel are handled strictly in order by a single
+        # worker (a client pipelines CONNECT then SUBSCRIBE in back-to-back
+        # datagrams; concurrent handling would race the handshake). The
+        # worker task reference lives here — the loop only keeps weak refs.
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._worker: Optional[asyncio.Task] = None
+
+    def enqueue(self, f: SnFrame) -> None:
+        self._inbox.put_nowait(f)
+        if self._worker is None:
+            self._worker = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                try:
+                    f = await asyncio.wait_for(self._inbox.get(), timeout=30.0)
+                except asyncio.TimeoutError:
+                    if self.gw._chans.get(self.peer) is not self:
+                        return  # orphaned (dropped/reaped): stop idling
+                    continue
+                try:
+                    await self.handle(f)
+                except Exception:
+                    log.exception("mqttsn frame handling failed")
+                # anonymous peers (QoS -1 publishers, stray frames) must
+                # not accumulate channel state on an open UDP port
+                if (
+                    not self.connected
+                    and not self._pending_connack
+                    and self.session is None
+                    and self._inbox.empty()
+                ):
+                    self.gw.forget(self.peer)
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    def _send(self, type_: int, body: bytes) -> None:
+        self.gw.sendto(encode(type_, body), self.peer)
+
+    def _next_msg_id(self) -> int:
+        self._msg_seq = self._msg_seq % 0xFFFF + 1
+        return self._msg_seq
+
+    # -- incoming ----------------------------------------------------------
+    async def handle(self, f: SnFrame) -> None:
+        self.last_seen = time.monotonic()
+        d = f.fields
+        if f.type == CONNECT:
+            await self._on_connect(d)
+        elif f.type == WILLTOPIC:
+            self.will_topic = d.get("topic")
+            self._send(WILLMSGREQ, b"")
+        elif f.type == WILLMSG:
+            self.will_msg = d.get("payload", b"")
+            if self._pending_connack:
+                self._finish_connect()
+        elif f.type == REGISTER:
+            tid = self.reg.register(d["topic"])
+            self._send(
+                REGACK,
+                struct.pack("!HHB", tid, d["msg_id"], RC_ACCEPTED),
+            )
+        elif f.type == REGACK:
+            pass  # client confirmed our server-side REGISTER
+        elif f.type == PUBLISH:
+            await self._on_publish(d)
+        elif f.type == PUBACK:
+            pass  # QoS1 delivery confirmed (no retransmit queue yet)
+        elif f.type == PUBREC:
+            self._send(PUBREL, struct.pack("!H", d["msg_id"]))
+        elif f.type == PUBREL:
+            msg = self._in_qos2.pop(d["msg_id"], None)
+            if msg is not None:
+                r = self.session.publish(*msg)
+                res = await r
+                if asyncio.isfuture(res):
+                    await res
+            self._send(PUBCOMP, struct.pack("!H", d["msg_id"]))
+        elif f.type == PUBCOMP:
+            pass
+        elif f.type == SUBSCRIBE:
+            await self._on_subscribe(d)
+        elif f.type == UNSUBSCRIBE:
+            await self._on_unsubscribe(d)
+        elif f.type == PINGREQ:
+            if self.asleep:
+                self._flush_sleep_buffer()
+                # back to sleep for another cycle (spec: awake ends with
+                # PINGRESP; client re-sleeps for its negotiated duration)
+                self.sleep_until = time.monotonic() + 2 * self.sleep_duration
+            self._send(PINGRESP, b"")
+        elif f.type == DISCONNECT:
+            await self._on_disconnect(d)
+
+    async def _on_connect(self, d: Dict) -> None:
+        self.client_id = d["client_id"] or f"sn-{self.peer[0]}-{self.peer[1]}"
+        self.keepalive = d["duration"]
+        clean = bool(d["flags"] & FLAG_CLEAN)
+        info = GwClientInfo(
+            clientid=self.client_id,
+            peername=self.peer,
+            protocol="mqtt-sn",
+            mountpoint=self.gw.config.get("mountpoint"),
+            keepalive=self.keepalive,
+            clean_start=clean,
+        )
+        ok = await self.gw.authenticate(info)
+        if not ok:
+            self._send(CONNACK, bytes([RC_NOT_SUPPORTED]))
+            return
+        old = self.gw.cm.open(self.client_id, self)
+        if old is not None and old is not self:
+            old.drop("discarded")
+        if self.session is not None:
+            self.session.close("reconnect")
+        self.session = GwSession(
+            self.gw.name, self.gw.broker, self.gw.hooks, info, self._deliver
+        )
+        self.asleep = False
+        if d["flags"] & FLAG_WILL:
+            self._pending_connack = True
+            self._send(WILLTOPICREQ, b"")
+            return
+        self._finish_connect()
+
+    def _finish_connect(self) -> None:
+        self._pending_connack = False
+        self.session.open()
+        self.connected = True
+        self._send(CONNACK, bytes([RC_ACCEPTED]))
+
+    def _resolve_topic(self, d: Dict) -> Optional[str]:
+        tt = d["flags"] & FLAG_TOPIC_MASK
+        if tt == TOPIC_SHORT:
+            return d["topic_raw"].decode("utf-8", "replace")
+        if tt == TOPIC_PREDEF:
+            return self.gw.predefined.get(d["topic_id"])
+        return self.reg.lookup_id(d["topic_id"])
+
+    async def _on_publish(self, d: Dict) -> None:
+        qos = qos_from_flags(d["flags"])
+        retain = bool(d["flags"] & FLAG_RETAIN)
+        if qos == QOS_NEG1:
+            # QoS -1: publish without a session, predefined/short topics only
+            topic = None
+            tt = d["flags"] & FLAG_TOPIC_MASK
+            if tt == TOPIC_PREDEF:
+                topic = self.gw.predefined.get(d["topic_id"])
+            elif tt == TOPIC_SHORT:
+                topic = d["topic_raw"].decode("utf-8", "replace")
+            if topic:
+                from emqx_tpu.broker.message import Message
+
+                await_r = self.gw.broker.apublish_enqueue(
+                    Message(topic=topic, payload=d["payload"], qos=0,
+                            retain=retain, from_client=self.client_id or "sn-anon")
+                )
+                res = await await_r
+                if asyncio.isfuture(res):
+                    await res
+            return
+        if not self.connected:
+            return
+        topic = self._resolve_topic(d)
+        if topic is None:
+            self._send(
+                PUBACK,
+                struct.pack("!HHB", d["topic_id"], d["msg_id"], RC_INVALID_TOPIC_ID),
+            )
+            return
+        if qos == 2:
+            self._in_qos2[d["msg_id"]] = (topic, d["payload"], 2, retain)
+            self._send(PUBREC, struct.pack("!H", d["msg_id"]))
+            return
+        r = self.session.publish(topic, d["payload"], qos=qos, retain=retain)
+        res = await r
+        if asyncio.isfuture(res):
+            await res
+        if qos == 1:
+            self._send(
+                PUBACK,
+                struct.pack("!HHB", d["topic_id"], d["msg_id"], RC_ACCEPTED),
+            )
+
+    async def _on_subscribe(self, d: Dict) -> None:
+        if not self.connected:
+            return
+        qos = min(qos_from_flags(d["flags"]), 1)
+        tt = d["flags"] & FLAG_TOPIC_MASK
+        if tt == TOPIC_PREDEF:
+            topic = self.gw.predefined.get(d.get("topic_id", 0))
+            tid = d.get("topic_id", 0)
+        else:
+            topic = d.get("topic")
+            tid = 0
+            if topic and not T.wildcard(topic) and tt == TOPIC_NORMAL:
+                tid = self.reg.register(topic)
+        if not topic:
+            self._send(
+                SUBACK,
+                struct.pack(
+                    "!BHHB", flags_from(qos=qos), 0, d["msg_id"],
+                    RC_INVALID_TOPIC_ID,
+                ),
+            )
+            return
+        self.session.subscribe(topic, pkt.SubOpts(qos=qos))
+        self._send(
+            SUBACK,
+            struct.pack("!BHHB", flags_from(qos=qos), tid, d["msg_id"], RC_ACCEPTED),
+        )
+
+    async def _on_unsubscribe(self, d: Dict) -> None:
+        tt = d["flags"] & FLAG_TOPIC_MASK
+        if tt == TOPIC_PREDEF:
+            topic = self.gw.predefined.get(d.get("topic_id", 0))
+        else:
+            topic = d.get("topic")
+        if topic and self.session:
+            self.session.unsubscribe(topic)
+        self._send(UNSUBACK, struct.pack("!H", d["msg_id"]))
+
+    async def _on_disconnect(self, d: Dict) -> None:
+        duration = d.get("duration")
+        if duration:
+            # sleeping client: keep session + subscriptions, buffer deliveries
+            self.asleep = True
+            self.sleep_duration = duration
+            self.sleep_until = time.monotonic() + 2 * duration
+            self._send(DISCONNECT, b"")
+            return
+        self._send(DISCONNECT, b"")
+        self.drop("normal")
+
+    def drop(self, reason: str) -> None:
+        w = self._worker
+        if w is not None and w is not asyncio.current_task():
+            w.cancel()  # reaper/shutdown path; self-drop exits via forget
+        if self.session is not None:
+            if reason not in ("normal", "discarded") and self.will_topic:
+                self.session.publish_sync(self.will_topic, self.will_msg)
+            self.gw.cm.close(self.client_id, self)
+            self.session.close(reason)
+            self.session = None
+        self.connected = False
+        self.gw.forget(self.peer)
+
+    # -- delivery ----------------------------------------------------------
+    def _deliver(self, msg, opts: pkt.SubOpts) -> None:
+        if self.asleep:
+            if len(self._sleep_buf) < self.AWAKE_FLUSH_MAX:
+                self._sleep_buf.append((msg, opts))
+            return
+        self._deliver_now(msg, opts)
+
+    def _flush_sleep_buffer(self) -> None:
+        buf, self._sleep_buf = self._sleep_buf, []
+        for msg, opts in buf:
+            self._deliver_now(msg, opts)
+
+    def _deliver_now(self, msg, opts: pkt.SubOpts) -> None:
+        qos = min(msg.qos, opts.qos, 1)
+        if len(msg.topic) == 2:
+            tt, tid_bytes = TOPIC_SHORT, msg.topic.encode()
+        else:
+            tid = self.reg.lookup_name(msg.topic)
+            if tid is None:
+                # server-side REGISTER before first delivery on this topic
+                tid = self.reg.register(msg.topic)
+                self._send(
+                    REGISTER,
+                    struct.pack("!HH", tid, self._next_msg_id())
+                    + msg.topic.encode(),
+                )
+            tt = (
+                TOPIC_PREDEF
+                if tid in self.gw.predefined
+                else TOPIC_NORMAL
+            )
+            tid_bytes = struct.pack("!H", tid)
+        body = (
+            bytes([flags_from(qos=qos, retain=msg.retain, topic_type=tt)])
+            + tid_bytes
+            + struct.pack("!H", self._next_msg_id() if qos else 0)
+            + msg.payload
+        )
+        self._send(PUBLISH, body)
+
+
+class SnGateway(Gateway):
+    """UDP endpoint + per-peer channels + discovery."""
+
+    def __init__(self, name: str, config: Dict):
+        super().__init__(name, config)
+        self.predefined: Dict[int, str] = {
+            int(k): v for k, v in config.get("predefined", {}).items()
+        }
+        self.gw_id = config.get("gateway_id", 1)
+        self._transport = None
+        self._chans: Dict[Tuple[str, int], SnChannel] = {}
+        self._reaper: Optional[asyncio.Task] = None
+
+    async def authenticate(self, info: GwClientInfo, password=None) -> bool:
+        res = await self.hooks.arun_fold(
+            "client.authenticate",
+            (info.as_dict(),),
+            {"ok": True, "password": password},
+        )
+        return bool(res is None or res.get("ok", True))
+
+    def sendto(self, data: bytes, peer) -> None:
+        if self._transport is not None:
+            self._transport.sendto(data, peer)
+
+    def forget(self, peer) -> None:
+        self._chans.pop(peer, None)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        gw = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                gw._transport = transport
+
+            def datagram_received(self, data, addr):
+                f = decode(data)
+                if f is None:
+                    return
+                if f.type == SEARCHGW:
+                    gw.sendto(encode(GWINFO, bytes([gw.gw_id])), addr)
+                    return
+                chan = gw._chans.get(addr)
+                if chan is None:
+                    chan = SnChannel(gw, addr)
+                    gw._chans[addr] = chan
+                chan.enqueue(f)
+
+        host = self.config.get("bind", "127.0.0.1")
+        port = self.config.get("port", 1884)
+        self._endpoint = await loop.create_datagram_endpoint(
+            Proto, local_addr=(host, port)
+        )
+        self.port = self._endpoint[0].get_extra_info("sockname")[1]
+        self._reaper = loop.create_task(self._reap_loop())
+
+    async def _reap_loop(self, period: float = 5.0) -> None:
+        """Expire channels whose peer vanished (UDP has no FIN): connected
+        clients past 2x their negotiated keepalive get their will published
+        and session torn down (emqx_sn keepalive semantics). Sleeping
+        clients are exempt until their sleep duration elapses twice."""
+        try:
+            while True:
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                for chan in list(self._chans.values()):
+                    if chan.asleep:
+                        if now > chan.sleep_until:
+                            chan.drop("sleep_expired")
+                        continue
+                    ka = chan.keepalive
+                    if ka <= 0:
+                        continue
+                    if now - chan.last_seen > 2 * ka:
+                        chan.drop("keepalive_timeout")
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+        for chan in list(self._chans.values()):
+            chan.drop("gateway_stopped")
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None

@@ -1,0 +1,95 @@
+"""Gateway registry + gateway-local client manager.
+
+Parity: emqx_gateway.erl:22-61 (registry: registered gateway types,
+load/unload/start/stop instances, status) and emqx_gateway_cm.erl (each
+gateway keeps its OWN clientid->channel table, separate from the MQTT
+CM — a STOMP client and an MQTT client may share a clientid without
+kicking each other).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.gateway")
+
+
+class GatewayCM:
+    """Per-gateway client manager: clientid -> channel, with the same
+    discard-on-duplicate semantics the core CM applies
+    (emqx_gateway_cm.erl open_session clean_start path)."""
+
+    def __init__(self, gw_name: str):
+        self.gw = gw_name
+        self._chans: Dict[str, object] = {}
+
+    def open(self, clientid: str, chan: object) -> Optional[object]:
+        """Register; returns the displaced old channel (caller kicks it)."""
+        old = self._chans.pop(clientid, None)
+        self._chans[clientid] = chan
+        return old
+
+    def close(self, clientid: str, chan: object) -> None:
+        if self._chans.get(clientid) is chan:
+            del self._chans[clientid]
+
+    def get(self, clientid: str) -> Optional[object]:
+        return self._chans.get(clientid)
+
+    def count(self) -> int:
+        return len(self._chans)
+
+    def clients(self) -> List[str]:
+        return list(self._chans)
+
+
+class GatewayRegistry:
+    """Registered gateway types + running instances
+    (emqx_gateway.erl registry + per-gateway supervision tree)."""
+
+    def __init__(self, broker, hooks):
+        self.broker = broker
+        self.hooks = hooks
+        self._types: Dict[str, Callable] = {}  # type name -> Gateway class
+        self._running: Dict[str, object] = {}  # instance name -> Gateway
+
+    def register_type(self, type_name: str, factory: Callable) -> None:
+        self._types[type_name] = factory
+
+    def types(self) -> List[str]:
+        return list(self._types)
+
+    async def load(self, type_name: str, config: Dict, name: Optional[str] = None):
+        """Create + start a gateway instance (emqx_gateway:load/2)."""
+        if type_name not in self._types:
+            raise ValueError(f"unknown gateway type: {type_name}")
+        name = name or type_name
+        if name in self._running:
+            raise ValueError(f"gateway already loaded: {name}")
+        gw = self._types[type_name](name, config)
+        gw.cm = GatewayCM(name)
+        gw.broker = self.broker
+        gw.hooks = self.hooks
+        await gw.start()
+        self._running[name] = gw
+        log.info("gateway %s (%s) started", name, type_name)
+        return gw
+
+    async def unload(self, name: str) -> bool:
+        gw = self._running.pop(name, None)
+        if gw is None:
+            return False
+        await gw.stop()
+        log.info("gateway %s stopped", name)
+        return True
+
+    async def unload_all(self) -> None:
+        for name in list(self._running):
+            await self.unload(name)
+
+    def get(self, name: str):
+        return self._running.get(name)
+
+    def list(self) -> List[Dict]:
+        return [gw.status() for gw in self._running.values()]

@@ -1,0 +1,500 @@
+"""STOMP 1.0/1.1/1.2 gateway.
+
+Parity with the reference's STOMP gateway (apps/emqx_gateway/src/stomp/:
+emqx_stomp_frame.erl codec, emqx_stomp_channel.erl semantics):
+
+- CONNECT/STOMP -> CONNECTED with version + heart-beat negotiation
+- SEND -> broker publish (``destination`` header is the topic); optional
+  transactions (BEGIN/COMMIT/ABORT buffer SENDs/ACKs atomically)
+- SUBSCRIBE/UNSUBSCRIBE (``id`` + ``destination``) -> broker subscribe;
+  deliveries come back as MESSAGE frames with ``subscription``/
+  ``message-id`` headers
+- RECEIPT for any client frame carrying ``receipt``; ERROR + close on
+  protocol violations
+- heart-beat: newline keepalives both ways, connection dropped after
+  2x the negotiated incoming period
+
+Framing: ``COMMAND\\n headers \\n\\n body NUL``; 1.2 header escaping
+(\\c \\n \\r \\\\); ``content-length`` for binary bodies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwFrame, GwSession
+from emqx_tpu.mqtt import packet as pkt
+
+log = logging.getLogger("emqx_tpu.gateway.stomp")
+
+SERVER_VERSIONS = ("1.0", "1.1", "1.2")
+MAX_HEADERS = 32
+MAX_HEADER_LEN = 1024
+MAX_BODY = 1 << 20
+
+
+@dataclass
+class StompFrame:
+    command: str
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+
+_ESC = {"\\n": "\n", "\\r": "\r", "\\c": ":", "\\\\": "\\"}
+
+
+def _unescape(s: str, version: str) -> str:
+    if version == "1.0":
+        return s
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            pair = s[i : i + 2]
+            if pair not in _ESC:
+                raise ValueError(f"bad escape {pair!r}")
+            out.append(_ESC[pair])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _escape(s: str, version: str) -> str:
+    if version == "1.0":
+        return s
+    return (
+        s.replace("\\", "\\\\")
+        .replace("\r", "\\r")
+        .replace("\n", "\\n")
+        .replace(":", "\\c")
+    )
+
+
+class StompCodec(GwFrame):
+    """Incremental STOMP parser (emqx_gateway_frame behaviour)."""
+
+    def __init__(self, version: str = "1.2"):
+        self.version = version
+        self._buf = b""
+
+    def parse(self, data: bytes) -> List[StompFrame]:
+        self._buf += data
+        frames: List[StompFrame] = []
+        while True:
+            f, rest = self._parse_one(self._buf)
+            if f is None:
+                break
+            self._buf = rest
+            if f != "heartbeat":
+                frames.append(f)
+        return frames
+
+    def _parse_one(self, buf: bytes):
+        # leading EOLs between frames are heart-beats
+        if buf[:2] == b"\r\n":
+            return "heartbeat", buf[2:]
+        if buf[:1] == b"\n":
+            return "heartbeat", buf[1:]
+        hdr_end = buf.find(b"\n\n")
+        hdr_end_crlf = buf.find(b"\r\n\r\n")
+        if hdr_end_crlf != -1 and (hdr_end == -1 or hdr_end_crlf < hdr_end):
+            head, rest = buf[:hdr_end_crlf], buf[hdr_end_crlf + 4 :]
+        elif hdr_end != -1:
+            head, rest = buf[:hdr_end], buf[hdr_end + 2 :]
+        else:
+            if len(buf) > MAX_HEADERS * MAX_HEADER_LEN:
+                raise ValueError("headers too large")
+            return None, buf
+        lines = head.replace(b"\r\n", b"\n").split(b"\n")
+        command = lines[0].decode("utf-8")
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, sep, v = line.decode("utf-8").partition(":")
+            if not sep:
+                raise ValueError("header without ':'")
+            k = _unescape(k, self.version)
+            # repeated header: first occurrence wins (STOMP 1.2 spec)
+            if k not in headers and len(headers) < MAX_HEADERS:
+                headers[k] = _unescape(v, self.version)
+        clen = headers.get("content-length")
+        if clen is not None:
+            n = int(clen)
+            if n > MAX_BODY:
+                raise ValueError("body too large")
+            if len(rest) < n + 1:
+                return None, buf
+            if rest[n : n + 1] != b"\x00":
+                raise ValueError("missing frame NUL")
+            return StompFrame(command, headers, rest[:n]), rest[n + 1 :]
+        z = rest.find(b"\x00")
+        if z == -1:
+            if len(rest) > MAX_BODY:
+                raise ValueError("body too large")
+            return None, buf
+        return StompFrame(command, headers, rest[:z]), rest[z + 1 :]
+
+    def serialize(self, f: StompFrame) -> bytes:
+        out = [f.command.encode()]
+        for k, v in f.headers.items():
+            out.append(
+                f"{_escape(k, self.version)}:{_escape(str(v), self.version)}".encode()
+            )
+        if f.body and "content-length" not in f.headers:
+            out.append(f"content-length:{len(f.body)}".encode())
+        return b"\n".join(out) + b"\n\n" + f.body + b"\x00\n"
+
+
+class StompChannel:
+    """One STOMP connection's protocol state machine
+    (emqx_stomp_channel.erl)."""
+
+    def __init__(self, gw: "StompGateway", writer: asyncio.StreamWriter, peer):
+        self.gw = gw
+        self.writer = writer
+        self.peer = peer
+        self.codec = StompCodec()
+        self.session: Optional[GwSession] = None
+        self.connected = False
+        self.version = "1.2"
+        # subscription id -> (destination, ack_mode). Several ids may share
+        # one destination (legal in STOMP); the broker-side subscription is
+        # refcounted per destination and each matching id gets its own
+        # MESSAGE frame on delivery.
+        self.subs: Dict[str, Tuple[str, str]] = {}
+        self._dest_refs: Dict[str, int] = {}
+        self.txns: Dict[str, List[StompFrame]] = {}
+        self._msg_seq = 0
+        self._hb_out = 0.0  # negotiated outgoing period (s), 0 = none
+        self._hb_in = 0.0
+        self._last_recv = time.monotonic()
+        self._hb_task: Optional[asyncio.Task] = None
+        self.closing = False
+
+    # -- outgoing ----------------------------------------------------------
+    def send(self, f: StompFrame) -> None:
+        if not self.writer.is_closing():
+            self.writer.write(self.codec.serialize(f))
+
+    def send_error(self, msg: str, detail: str = "") -> None:
+        self.send(
+            StompFrame(
+                "ERROR",
+                {"message": msg, "content-type": "text/plain"},
+                detail.encode(),
+            )
+        )
+
+    def _maybe_receipt(self, f: StompFrame) -> None:
+        rid = f.headers.get("receipt")
+        if rid is not None:
+            self.send(StompFrame("RECEIPT", {"receipt-id": rid}))
+
+    # -- incoming ----------------------------------------------------------
+    async def handle_data(self, data: bytes) -> None:
+        self._last_recv = time.monotonic()
+        try:
+            frames = self.codec.parse(data)
+        except ValueError as e:
+            self.send_error("protocol error", str(e))
+            await self.shutdown("frame_error")
+            return
+        for f in frames:
+            await self.handle_frame(f)
+
+    async def handle_frame(self, f: StompFrame) -> None:
+        if not self.connected and f.command not in ("CONNECT", "STOMP"):
+            self.send_error("not connected")
+            await self.shutdown("not_connected")
+            return
+        handler = getattr(self, f"_on_{f.command.lower()}", None)
+        if handler is None:
+            self.send_error(f"unsupported command {f.command}")
+            return
+        try:
+            await handler(f)
+        except (ValueError, KeyError) as e:
+            # malformed headers (bad qos, missing fields): ERROR the frame,
+            # keep the connection — never let it kill the reader task
+            self.send_error("malformed frame", str(e))
+
+    async def _on_connect(self, f: StompFrame) -> None:
+        if self.connected:
+            self.send_error("already connected")
+            await self.shutdown("duplicate_connect")
+            return
+        accept = f.headers.get("accept-version", "1.0").split(",")
+        vers = [v for v in SERVER_VERSIONS if v in accept]
+        if not vers:
+            self.send_error("unsupported version")
+            await self.shutdown("bad_version")
+            return
+        self.version = max(vers)
+        self.codec.version = self.version
+        login = f.headers.get("login")
+        clientid = f.headers.get("client-id") or f"stomp-{id(self):x}"
+        info = GwClientInfo(
+            clientid=clientid,
+            username=login,
+            peername=self.peer,
+            protocol="stomp",
+            mountpoint=self.gw.config.get("mountpoint"),
+        )
+        ok = await self.gw.authenticate(info, f.headers.get("passcode"))
+        if not ok:
+            self.send_error("authentication failed")
+            await self.shutdown("auth_failure")
+            return
+        # heart-beat negotiation: cx,cy vs server 10s,10s
+        cx, _, cy = f.headers.get("heart-beat", "0,0").partition(",")
+        try:
+            cx_ms, cy_ms = int(cx), int(cy or 0)
+        except ValueError:
+            cx_ms = cy_ms = 0
+        sx_ms = sy_ms = self.gw.config.get("heartbeat_ms", 10_000)
+        self._hb_out = max(sx_ms, cy_ms) / 1e3 if sx_ms and cy_ms else 0.0
+        self._hb_in = max(sy_ms, cx_ms) / 1e3 if sy_ms and cx_ms else 0.0
+        old = self.gw.cm.open(clientid, self)
+        if old is not None:
+            await old.shutdown("discarded")
+        self.session = GwSession(
+            self.gw.name, self.gw.broker, self.gw.hooks, info, self._deliver
+        )
+        self.session.open()
+        self.connected = True
+        self.send(
+            StompFrame(
+                "CONNECTED",
+                {
+                    "version": self.version,
+                    "heart-beat": f"{sx_ms},{sy_ms}",
+                    "server": "emqx-tpu-stomp",
+                    "session": self.session.sid,
+                },
+            )
+        )
+        if self._hb_in or self._hb_out:
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeat_loop()
+            )
+
+    _on_stomp = _on_connect
+
+    async def _on_send(self, f: StompFrame) -> None:
+        txn = f.headers.get("transaction")
+        if txn is not None:
+            buf = self.txns.get(txn)
+            if buf is None:
+                self.send_error(f"unknown transaction {txn}")
+                return
+            buf.append(f)
+            self._maybe_receipt(f)
+            return
+        await self._do_send(f)
+        self._maybe_receipt(f)
+
+    async def _do_send(self, f: StompFrame) -> None:
+        dest = f.headers.get("destination")
+        if not dest:
+            self.send_error("SEND requires destination")
+            return
+        props = {}
+        if "content-type" in f.headers:
+            props["Content-Type"] = f.headers["content-type"]
+        try:
+            qos = min(max(int(f.headers.get("qos", 0)), 0), 2)
+        except ValueError:
+            self.send_error("bad qos header")
+            return
+        r = self.session.publish(dest, f.body, qos=qos, properties=props)
+        res = await r
+        if asyncio.isfuture(res):
+            await res
+
+    async def _on_subscribe(self, f: StompFrame) -> None:
+        sub_id = f.headers.get("id")
+        dest = f.headers.get("destination")
+        if self.version != "1.0" and sub_id is None:
+            self.send_error("SUBSCRIBE requires id")
+            return
+        sub_id = sub_id or dest
+        if not dest:
+            self.send_error("SUBSCRIBE requires destination")
+            return
+        if sub_id in self.subs:
+            self.send_error(f"subscription id {sub_id} in use")
+            return
+        ack = f.headers.get("ack", "auto")
+        self.subs[sub_id] = (dest, ack)
+        n = self._dest_refs.get(dest, 0)
+        self._dest_refs[dest] = n + 1
+        if n == 0:  # first id on this destination opens the broker route
+            qos = 1 if ack in ("client", "client-individual") else 0
+            self.session.subscribe(dest, pkt.SubOpts(qos=qos))
+        self._maybe_receipt(f)
+
+    async def _on_unsubscribe(self, f: StompFrame) -> None:
+        sub_id = f.headers.get("id") or f.headers.get("destination")
+        ent = self.subs.pop(sub_id, None)
+        if ent is not None:
+            dest, _ = ent
+            n = self._dest_refs.get(dest, 1) - 1
+            if n <= 0:  # last id on this destination closes the route
+                self._dest_refs.pop(dest, None)
+                self.session.unsubscribe(dest)
+            else:
+                self._dest_refs[dest] = n
+        self._maybe_receipt(f)
+
+    async def _on_ack(self, f: StompFrame) -> None:
+        txn = f.headers.get("transaction")
+        if txn is not None and txn in self.txns:
+            self.txns[txn].append(f)
+        self._maybe_receipt(f)
+
+    async def _on_nack(self, f: StompFrame) -> None:
+        self._maybe_receipt(f)
+
+    async def _on_begin(self, f: StompFrame) -> None:
+        txn = f.headers.get("transaction")
+        if txn is None or txn in self.txns:
+            self.send_error("bad transaction")
+            return
+        self.txns[txn] = []
+        self._maybe_receipt(f)
+
+    async def _on_commit(self, f: StompFrame) -> None:
+        txn = f.headers.get("transaction")
+        buf = self.txns.pop(txn, None)
+        if buf is None:
+            self.send_error(f"unknown transaction {txn}")
+            return
+        for queued in buf:
+            if queued.command == "SEND":
+                await self._do_send(queued)
+        self._maybe_receipt(f)
+
+    async def _on_abort(self, f: StompFrame) -> None:
+        txn = f.headers.get("transaction")
+        if self.txns.pop(txn, None) is None:
+            self.send_error(f"unknown transaction {txn}")
+            return
+        self._maybe_receipt(f)
+
+    async def _on_disconnect(self, f: StompFrame) -> None:
+        self._maybe_receipt(f)
+        await self.shutdown("normal")
+
+    # -- delivery ----------------------------------------------------------
+    def _deliver(self, msg, opts: pkt.SubOpts) -> None:
+        from emqx_tpu.ops import topics as T
+
+        # every subscription id whose destination matches gets its own
+        # MESSAGE frame (ids are independent subscriptions in STOMP)
+        matched = [
+            (sid, ack)
+            for sid, (dest, ack) in self.subs.items()
+            if dest == msg.topic or T.match(msg.topic, dest)
+        ] or [("", "auto")]
+        ct = msg.properties.get("Content-Type")
+        for sub_id, ack_mode in matched:
+            self._msg_seq += 1
+            headers = {
+                "subscription": sub_id,
+                "message-id": f"{self.session.sid}-{self._msg_seq}",
+                "destination": msg.topic,
+            }
+            if ack_mode in ("client", "client-individual"):
+                headers["ack"] = headers["message-id"]
+            if ct:
+                headers["content-type"] = ct
+            self.send(StompFrame("MESSAGE", headers, msg.payload))
+
+    # -- heart-beat / shutdown ---------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        try:
+            while not self.closing:
+                period = min(
+                    p for p in (self._hb_out, self._hb_in) if p > 0
+                )
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                if self._hb_in and now - self._last_recv > 2 * self._hb_in:
+                    await self.shutdown("heartbeat_timeout")
+                    return
+                if self._hb_out and not self.writer.is_closing():
+                    self.writer.write(b"\n")
+        except asyncio.CancelledError:
+            pass
+
+    async def shutdown(self, reason: str) -> None:
+        if self.closing:
+            return
+        self.closing = True
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        if self.session is not None:
+            self.gw.cm.close(self.session.info.clientid, self)
+            self.session.close(reason)
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class StompGateway(Gateway):
+    """STOMP listener + per-connection channels (emqx_gateway_impl)."""
+
+    def __init__(self, name: str, config: Dict):
+        super().__init__(name, config)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._chans: set = set()
+
+    async def authenticate(self, info: GwClientInfo, password) -> bool:
+        """'client.authenticate' fold, same hookpoint as the MQTT channel
+        (emqx_access_control.erl:31-38)."""
+        res = await self.hooks.arun_fold(
+            "client.authenticate",
+            (info.as_dict(),),
+            {"ok": True, "password": password},
+        )
+        return bool(res is None or res.get("ok", True))
+
+    async def start(self) -> None:
+        host = self.config.get("bind", "127.0.0.1")
+        port = self.config.get("port", 61613)
+
+        async def on_conn(reader, writer):
+            peer = writer.get_extra_info("peername") or ("", 0)
+            chan = StompChannel(self, writer, peer)
+            self._chans.add(chan)
+            try:
+                while True:
+                    data = await reader.read(4096)
+                    if not data:
+                        break
+                    await chan.handle_data(data)
+                    if chan.closing:
+                        break
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                await chan.shutdown("sock_closed")
+                self._chans.discard(chan)
+
+        self._server = await asyncio.start_server(on_conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for chan in list(self._chans):
+            await chan.shutdown("gateway_stopped")
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

@@ -1,0 +1,266 @@
+"""exproto gateway e2e: a gRPC ConnectionHandler implements a line protocol.
+
+The handler (user side, here in-test) speaks a trivial protocol over the
+raw socket the gateway manages:
+    AUTH <clientid>\n   -> adapter.Authenticate
+    SUB <topic>\n       -> adapter.Subscribe
+    PUB <topic> <data>\n-> adapter.Publish
+and receives broker deliveries via OnReceivedMessages, forwarding them to
+the socket as "MSG <topic> <payload>\n" through adapter.Send.
+
+Parity: apps/emqx_gateway/src/exproto (ConnectionAdapter/ConnectionHandler
+pair, exproto.proto:23,46) — service names and messages are the
+reference's `emqx.exproto.v1`, so this doubles as a wire-compat check.
+"""
+
+import asyncio
+import functools
+
+import grpc
+import grpc.aio
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.gateway import exproto_pb2 as pb
+from emqx_tpu.gateway.exproto import (
+    ADAPTER_METHODS,
+    ADAPTER_SERVICE,
+    HANDLER_SERVICE,
+    ExprotoGateway,
+)
+from emqx_tpu.gateway.registry import GatewayRegistry
+from emqx_tpu.mqtt import packet as pkt
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+class LineProtoHandler:
+    """In-test ConnectionHandler gRPC service."""
+
+    def __init__(self):
+        self.server = None
+        self.port = None
+        self.adapter = None  # stub dict, set once the gateway is up
+        self.events = asyncio.Queue()
+
+    # -- adapter client stubs ---------------------------------------------
+    def connect_adapter(self, port):
+        chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        self.adapter = {
+            rpc: chan.unary_unary(
+                f"/{ADAPTER_SERVICE}/{rpc}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+            for rpc, (req, resp) in ADAPTER_METHODS.items()
+        }
+        self._chan = chan
+
+    async def handle_line(self, conn: str, line: str):
+        parts = line.strip().split(" ", 2)
+        if not parts or not parts[0]:
+            return
+        cmd = parts[0]
+        if cmd == "AUTH":
+            r = await self.adapter["Authenticate"](
+                pb.AuthenticateRequest(
+                    conn=conn,
+                    clientinfo=pb.ClientInfo(
+                        proto_name="lineproto",
+                        proto_ver="1",
+                        clientid=parts[1],
+                    ),
+                )
+            )
+            await self.adapter["Send"](
+                pb.SendBytesRequest(
+                    conn=conn, bytes=f"OK {r.code}\n".encode()
+                )
+            )
+        elif cmd == "SUB":
+            await self.adapter["Subscribe"](
+                pb.SubscribeRequest(conn=conn, topic=parts[1], qos=0)
+            )
+            await self.adapter["Send"](
+                pb.SendBytesRequest(conn=conn, bytes=b"SUBBED\n")
+            )
+        elif cmd == "PUB":
+            await self.adapter["Publish"](
+                pb.PublishRequest(
+                    conn=conn, topic=parts[1], qos=0,
+                    payload=parts[2].encode(),
+                )
+            )
+        elif cmd == "QUIT":
+            await self.adapter["Close"](pb.CloseSocketRequest(conn=conn))
+
+    # -- ConnectionHandler service ----------------------------------------
+    async def start(self):
+        handler_self = self
+        buffers = {}
+
+        async def on_bytes(request_iterator, ctx):
+            async for req in request_iterator:
+                buf = buffers.get(req.conn, "") + req.bytes.decode()
+                *lines, rest = buf.split("\n")
+                buffers[req.conn] = rest
+                for line in lines:
+                    await handler_self.handle_line(req.conn, line)
+            return pb.EmptySuccess()
+
+        async def on_messages(request_iterator, ctx):
+            async for req in request_iterator:
+                for m in req.messages:
+                    await handler_self.adapter["Send"](
+                        pb.SendBytesRequest(
+                            conn=req.conn,
+                            bytes=(
+                                f"MSG {m.topic} ".encode() + m.payload + b"\n"
+                            ),
+                        )
+                    )
+            return pb.EmptySuccess()
+
+        async def drain(request_iterator, ctx):
+            async for req in request_iterator:
+                handler_self.events.put_nowait(req)
+            return pb.EmptySuccess()
+
+        impls = {
+            "OnSocketCreated": drain,
+            "OnSocketClosed": drain,
+            "OnReceivedBytes": on_bytes,
+            "OnTimerTimeout": drain,
+            "OnReceivedMessages": on_messages,
+        }
+        from emqx_tpu.gateway.exproto import HANDLER_METHODS
+
+        handlers = {
+            rpc: grpc.stream_unary_rpc_method_handler(
+                impls[rpc],
+                request_deserializer=req_cls.FromString,
+                response_serializer=pb.EmptySuccess.SerializeToString,
+            )
+            for rpc, req_cls in HANDLER_METHODS.items()
+        }
+        self.server = grpc.aio.server()
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(HANDLER_SERVICE, handlers),)
+        )
+        self.port = self.server.add_insecure_port("127.0.0.1:0")
+        await self.server.start()
+
+    async def stop(self):
+        if self.adapter is not None:
+            await self._chan.close()
+        await self.server.stop(grace=0.2)
+
+
+@async_test
+async def test_exproto_line_protocol_end_to_end():
+    handler = LineProtoHandler()
+    await handler.start()
+
+    hooks = Hooks()
+    broker = Broker(hooks=hooks)
+    registry = GatewayRegistry(broker, hooks)
+    registry.register_type("exproto", ExprotoGateway)
+    gw = await registry.load(
+        "exproto",
+        {
+            "bind": "127.0.0.1",
+            "port": 0,
+            "handler": f"127.0.0.1:{handler.port}",
+            "adapter_bind": "127.0.0.1:0",
+        },
+    )
+    handler.connect_adapter(gw.adapter_port)
+
+    seen = []
+    broker.subscribe(
+        "obs", "obs", "xp/#", pkt.SubOpts(qos=0),
+        lambda msg, opts: seen.append(msg),
+    )
+
+    reader, writer = await asyncio.open_connection("127.0.0.1", gw.port)
+
+    async def expect(prefix):
+        line = await asyncio.wait_for(reader.readline(), 5.0)
+        assert line.decode().startswith(prefix), line
+        return line.decode().strip()
+
+    writer.write(b"AUTH lp-client-1\n")
+    assert await expect("OK 0")
+    assert gw.cm.count() == 1
+
+    writer.write(b"SUB down/+\n")
+    await expect("SUBBED")
+
+    writer.write(b"PUB xp/data hello-exproto\n")
+    await asyncio.sleep(0.2)
+    assert len(seen) == 1
+    assert seen[0].topic == "xp/data"
+    assert seen[0].payload == b"hello-exproto"
+    assert seen[0].from_client == "lp-client-1"
+
+    # broker -> handler -> socket delivery
+    from emqx_tpu.broker.message import Message
+
+    broker.publish(Message(topic="down/1", payload=b"to-client"))
+    got = await expect("MSG down/1 to-client")
+    assert got == "MSG down/1 to-client"
+
+    # socket-close event reaches the handler and the session is torn down
+    writer.close()
+    await asyncio.sleep(0.2)
+    assert gw.cm.count() == 0
+
+    await registry.unload_all()
+    await handler.stop()
+
+
+@async_test
+async def test_exproto_adapter_errors():
+    handler = LineProtoHandler()
+    await handler.start()
+    hooks = Hooks()
+    broker = Broker(hooks=hooks)
+    registry = GatewayRegistry(broker, hooks)
+    registry.register_type("exproto", ExprotoGateway)
+    gw = await registry.load(
+        "exproto",
+        {"bind": "127.0.0.1", "port": 0, "handler": f"127.0.0.1:{handler.port}"},
+    )
+    handler.connect_adapter(gw.adapter_port)
+
+    # unknown conn id
+    r = await handler.adapter["Send"](
+        pb.SendBytesRequest(conn="nope", bytes=b"x")
+    )
+    assert r.code == pb.CONN_PROCESS_NOT_ALIVE
+
+    # publish before authenticate -> PERMISSION_DENY
+    reader, writer = await asyncio.open_connection("127.0.0.1", gw.port)
+    await asyncio.sleep(0.2)
+    conn_id = next(iter(gw.conns))
+    r = await handler.adapter["Publish"](
+        pb.PublishRequest(conn=conn_id, topic="t", payload=b"x")
+    )
+    assert r.code == pb.PERMISSION_DENY
+
+    # authenticate without clientid -> REQUIRED_PARAMS_MISSED
+    r = await handler.adapter["Authenticate"](
+        pb.AuthenticateRequest(conn=conn_id, clientinfo=pb.ClientInfo())
+    )
+    assert r.code == pb.REQUIRED_PARAMS_MISSED
+
+    writer.close()
+    await registry.unload_all()
+    await handler.stop()

@@ -1,0 +1,378 @@
+"""Gateway framework tests: STOMP, MQTT-SN, exproto clients driving the broker.
+
+Each protocol is exercised by a raw-socket client implemented in the test
+(independent of the gateway's codec where practical), bridging into the
+same core Broker an MQTT client uses — the parity target is the
+reference's per-gateway CT suites (apps/emqx_gateway/test/).
+"""
+
+import asyncio
+import functools
+import struct
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.gateway.mqttsn import (
+    CONNACK,
+    CONNECT,
+    DISCONNECT,
+    PINGREQ,
+    PINGRESP,
+    PUBACK,
+    PUBLISH,
+    REGACK,
+    REGISTER,
+    SUBACK,
+    SUBSCRIBE,
+    UNSUBACK,
+    UNSUBSCRIBE,
+    SnGateway,
+    decode,
+    encode,
+    flags_from,
+    TOPIC_PREDEF,
+)
+from emqx_tpu.gateway.registry import GatewayRegistry
+from emqx_tpu.gateway.stomp import StompCodec, StompFrame, StompGateway
+from emqx_tpu.mqtt import packet as pkt
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+class GwBed:
+    """Broker + gateway registry, no MQTT listener needed."""
+
+    __test__ = False
+
+    def __init__(self):
+        self.hooks = Hooks()
+        self.broker = Broker(hooks=self.hooks)
+        self.registry = GatewayRegistry(self.broker, self.hooks)
+        self.registry.register_type("stomp", StompGateway)
+        self.registry.register_type("mqttsn", SnGateway)
+
+    def collect(self, filter_, bucket):
+        """Subscribe an in-process MQTT-side observer."""
+        self.broker.subscribe(
+            "obs",
+            "obs",
+            filter_,
+            pkt.SubOpts(qos=0),
+            lambda msg, opts: bucket.append(msg),
+        )
+
+
+class StompClient:
+    """Minimal independent STOMP client for tests."""
+
+    def __init__(self):
+        self.codec = StompCodec()
+        self.frames = asyncio.Queue()
+
+    async def connect(self, port, headers=None):
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port
+        )
+        self._task = asyncio.get_running_loop().create_task(self._read_loop())
+        h = {"accept-version": "1.2", "host": "/"}
+        h.update(headers or {})
+        self.send("CONNECT", h)
+        f = await self.recv()
+        assert f.command == "CONNECTED", f
+        return f
+
+    async def _read_loop(self):
+        try:
+            while True:
+                data = await self.reader.read(4096)
+                if not data:
+                    return
+                for f in self.codec.parse(data):
+                    self.frames.put_nowait(f)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+    def send(self, command, headers=None, body=b""):
+        self.writer.write(
+            self.codec.serialize(StompFrame(command, headers or {}, body))
+        )
+
+    async def recv(self, timeout=5.0):
+        return await asyncio.wait_for(self.frames.get(), timeout)
+
+    async def close(self):
+        self._task.cancel()
+        self.writer.close()
+
+
+@async_test
+async def test_stomp_connect_send_subscribe():
+    bed = GwBed()
+    gw = await bed.registry.load("stomp", {"bind": "127.0.0.1", "port": 0})
+    seen = []
+    bed.collect("t/#", seen)
+
+    c = StompClient()
+    await c.connect(gw.port, {"client-id": "sc1", "login": "u1"})
+    # SEND -> broker
+    c.send("SEND", {"destination": "t/x", "receipt": "r1"}, b"hello")
+    r = await c.recv()
+    assert r.command == "RECEIPT" and r.headers["receipt-id"] == "r1"
+    await asyncio.sleep(0.05)
+    assert len(seen) == 1 and seen[0].payload == b"hello"
+
+    # SUBSCRIBE; deliver broker -> stomp MESSAGE
+    c.send("SUBSCRIBE", {"id": "s1", "destination": "evt/+"})
+    await asyncio.sleep(0.05)
+    bed.broker.publish(
+        __import__(
+            "emqx_tpu.broker.message", fromlist=["Message"]
+        ).Message(topic="evt/a", payload=b"m1")
+    )
+    m = await c.recv()
+    assert m.command == "MESSAGE"
+    assert m.headers["destination"] == "evt/a"
+    assert m.headers["subscription"] == "s1"
+    assert m.body == b"m1"
+
+    # UNSUBSCRIBE stops delivery
+    c.send("UNSUBSCRIBE", {"id": "s1", "receipt": "r2"})
+    assert (await c.recv()).command == "RECEIPT"
+    bed.broker.publish(
+        __import__(
+            "emqx_tpu.broker.message", fromlist=["Message"]
+        ).Message(topic="evt/b", payload=b"m2")
+    )
+    await asyncio.sleep(0.05)
+    assert c.frames.empty()
+    await c.close()
+    await bed.registry.unload_all()
+
+
+@async_test
+async def test_stomp_transactions_and_errors():
+    bed = GwBed()
+    gw = await bed.registry.load("stomp", {"bind": "127.0.0.1", "port": 0})
+    seen = []
+    bed.collect("tx/#", seen)
+    c = StompClient()
+    await c.connect(gw.port)
+    c.send("BEGIN", {"transaction": "t1"})
+    c.send("SEND", {"destination": "tx/a", "transaction": "t1"}, b"1")
+    c.send("SEND", {"destination": "tx/b", "transaction": "t1"}, b"2")
+    await asyncio.sleep(0.05)
+    assert seen == []  # buffered until COMMIT
+    c.send("COMMIT", {"transaction": "t1", "receipt": "rc"})
+    assert (await c.recv()).command == "RECEIPT"
+    await asyncio.sleep(0.05)
+    assert sorted(m.topic for m in seen) == ["tx/a", "tx/b"]
+    # ABORT drops
+    c.send("BEGIN", {"transaction": "t2"})
+    c.send("SEND", {"destination": "tx/c", "transaction": "t2"}, b"3")
+    c.send("ABORT", {"transaction": "t2"})
+    await asyncio.sleep(0.05)
+    assert len(seen) == 2
+    # unknown transaction -> ERROR
+    c.send("COMMIT", {"transaction": "nope"})
+    assert (await c.recv()).command == "ERROR"
+    await c.close()
+    await bed.registry.unload_all()
+
+
+@async_test
+async def test_stomp_duplicate_clientid_discards_old():
+    bed = GwBed()
+    gw = await bed.registry.load("stomp", {"bind": "127.0.0.1", "port": 0})
+    c1 = StompClient()
+    await c1.connect(gw.port, {"client-id": "dup"})
+    c2 = StompClient()
+    await c2.connect(gw.port, {"client-id": "dup"})
+    await asyncio.sleep(0.05)
+    assert gw.cm.count() == 1
+    await c2.close()
+    await bed.registry.unload_all()
+
+
+class SnClient:
+    """Minimal MQTT-SN UDP client."""
+
+    def __init__(self):
+        self.frames = asyncio.Queue()
+
+    async def connect(self, port, client_id="snc", duration=60):
+        loop = asyncio.get_running_loop()
+        inbox = self.frames
+
+        class P(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                pass
+
+            def datagram_received(self, data, addr):
+                f = decode(data)
+                if f is not None:
+                    inbox.put_nowait(f)
+
+        self.transport, _ = await loop.create_datagram_endpoint(
+            P, remote_addr=("127.0.0.1", port)
+        )
+        self.send(
+            CONNECT,
+            bytes([flags_from(clean=True), 0x01])
+            + struct.pack("!H", duration)
+            + client_id.encode(),
+        )
+        f = await self.recv()
+        assert f.type == CONNACK and f.fields["rc"] == 0
+
+    def send(self, type_, body):
+        self.transport.sendto(encode(type_, body))
+
+    async def recv(self, timeout=5.0):
+        return await asyncio.wait_for(self.frames.get(), timeout)
+
+    def close(self):
+        self.transport.close()
+
+
+@async_test
+async def test_mqttsn_register_publish_subscribe():
+    bed = GwBed()
+    gw = await bed.registry.load("mqttsn", {"bind": "127.0.0.1", "port": 0})
+    seen = []
+    bed.collect("sn/#", seen)
+
+    c = SnClient()
+    await c.connect(gw.port, "snc1")
+
+    # REGISTER topic -> topic id
+    c.send(REGISTER, struct.pack("!HH", 0, 1) + b"sn/data")
+    f = await c.recv()
+    assert f.type == REGACK and f.fields["rc"] == 0
+    tid = f.fields["topic_id"]
+
+    # QoS1 PUBLISH via registered id
+    c.send(
+        PUBLISH,
+        bytes([flags_from(qos=1)])
+        + struct.pack("!H", tid)
+        + struct.pack("!H", 7)
+        + b"snpayload",
+    )
+    f = await c.recv()
+    assert f.type == PUBACK and f.fields["rc"] == 0 and f.fields["msg_id"] == 7
+    await asyncio.sleep(0.05)
+    assert len(seen) == 1 and seen[0].payload == b"snpayload"
+    assert seen[0].topic == "sn/data"
+
+    # SUBSCRIBE by name: SUBACK assigns the topic id, delivery uses it
+    c.send(SUBSCRIBE, bytes([flags_from(qos=1)]) + struct.pack("!H", 9) + b"mq/evt")
+    f = await c.recv()
+    assert f.type == SUBACK and f.fields["rc"] == 0
+    sub_tid = f.fields["topic_id"]
+    assert sub_tid != 0
+    from emqx_tpu.broker.message import Message
+
+    bed.broker.publish(Message(topic="mq/evt", payload=b"down", qos=1))
+    f = await c.recv()
+    assert f.type == PUBLISH and f.fields["payload"] == b"down"
+    assert f.fields["topic_id"] == sub_tid
+
+    # WILDCARD subscribe: no id at SUBACK; server REGISTERs on first deliver
+    c.send(SUBSCRIBE, bytes([flags_from(qos=0)]) + struct.pack("!H", 10) + b"wild/+")
+    f = await c.recv()
+    assert f.type == SUBACK and f.fields["topic_id"] == 0
+    bed.broker.publish(Message(topic="wild/one", payload=b"w1"))
+    f = await c.recv()
+    assert f.type == REGISTER and f.fields["topic"] == "wild/one"
+    f = await c.recv()
+    assert f.type == PUBLISH and f.fields["payload"] == b"w1"
+
+    # PINGREQ keepalive
+    c.send(PINGREQ, b"")
+    assert (await c.recv()).type == PINGRESP
+    c.close()
+    await bed.registry.unload_all()
+
+
+@async_test
+async def test_mqttsn_predefined_and_sleep():
+    bed = GwBed()
+    gw = await bed.registry.load(
+        "mqttsn",
+        {"bind": "127.0.0.1", "port": 0, "predefined": {5: "pre/t"}},
+    )
+    seen = []
+    bed.collect("pre/#", seen)
+    c = SnClient()
+    await c.connect(gw.port, "snc2")
+    # publish to predefined id 5
+    c.send(
+        PUBLISH,
+        bytes([flags_from(qos=0, topic_type=TOPIC_PREDEF)])
+        + struct.pack("!H", 5)
+        + struct.pack("!H", 0)
+        + b"pd",
+    )
+    await asyncio.sleep(0.05)
+    assert len(seen) == 1 and seen[0].topic == "pre/t"
+
+    # subscribe then sleep; messages buffer; PINGREQ flushes
+    c.send(SUBSCRIBE, bytes([flags_from(qos=0)]) + struct.pack("!H", 2) + b"pre/t")
+    f = await c.recv()
+    assert f.type == SUBACK
+    c.send(DISCONNECT, struct.pack("!H", 30))  # sleep 30s
+    f = await c.recv()
+    assert f.type == DISCONNECT
+    from emqx_tpu.broker.message import Message
+
+    bed.broker.publish(Message(topic="pre/t", payload=b"while-asleep"))
+    await asyncio.sleep(0.05)
+    assert c.frames.empty()  # buffered, not delivered
+    c.send(PINGREQ, b"snc2")
+    got = [await c.recv(), await c.recv()]
+    types = {g.type for g in got}
+    assert PINGRESP in types and PUBLISH in types
+    c.close()
+    await bed.registry.unload_all()
+
+
+@async_test
+async def test_mqttsn_unsubscribe():
+    bed = GwBed()
+    gw = await bed.registry.load("mqttsn", {"bind": "127.0.0.1", "port": 0})
+    c = SnClient()
+    await c.connect(gw.port, "snc3")
+    c.send(SUBSCRIBE, bytes([flags_from(qos=0)]) + struct.pack("!H", 3) + b"u/t")
+    assert (await c.recv()).type == SUBACK
+    c.send(UNSUBSCRIBE, bytes([flags_from()]) + struct.pack("!H", 4) + b"u/t")
+    assert (await c.recv()).type == UNSUBACK
+    from emqx_tpu.broker.message import Message
+
+    bed.broker.publish(Message(topic="u/t", payload=b"x"))
+    await asyncio.sleep(0.05)
+    assert c.frames.empty()
+    c.close()
+    await bed.registry.unload_all()
+
+
+@async_test
+async def test_registry_lifecycle():
+    bed = GwBed()
+    gw = await bed.registry.load("stomp", {"bind": "127.0.0.1", "port": 0})
+    assert bed.registry.get("stomp") is gw
+    assert [s["name"] for s in bed.registry.list()] == ["stomp"]
+    with pytest.raises(ValueError):
+        await bed.registry.load("stomp", {})  # duplicate name
+    with pytest.raises(ValueError):
+        await bed.registry.load("nope", {})  # unknown type
+    assert await bed.registry.unload("stomp") is True
+    assert await bed.registry.unload("stomp") is False
+    assert bed.registry.list() == []
