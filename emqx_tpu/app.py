@@ -65,6 +65,7 @@ class BrokerApp:
         self.broker.shared = SharedSub(strategy=c.shared_subscription.strategy)
         self.cm = ChannelManager(self.broker)
         self.channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
+        # populated below once authn config is read (SCRAM enhanced auth)
         # rate limiting + overload protection (reference: emqx_limiter,
         # emqx_olp; wired into listeners like the esockd limiter adapter)
         from emqx_tpu.broker.limiter import LimiterServer
@@ -153,6 +154,7 @@ class BrokerApp:
                 ]
             ).attach(self.hooks)
 
+        self.scram = None
         if c.authn.enable:
             providers = []
             if c.authn.users:
@@ -169,12 +171,51 @@ class BrokerApp:
                         c.authn.jwt_secret.encode(), c.authn.jwt_verify_claims
                     )
                 )
+            if c.authn.http_url:
+                from emqx_tpu.auth.http import HttpAuthProvider
+
+                providers.append(
+                    HttpAuthProvider(
+                        c.authn.http_url,
+                        method=c.authn.http_method,
+                        timeout=c.authn.http_timeout,
+                    )
+                )
+            if c.authn.jwks_endpoint:
+                from emqx_tpu.auth.jwks import JwksAuthProvider
+
+                providers.append(
+                    JwksAuthProvider(
+                        c.authn.jwks_endpoint,
+                        refresh_interval=c.authn.jwks_refresh_interval,
+                        verify_claims=c.authn.jwks_verify_claims,
+                    )
+                )
             self.authn = AuthChain(
                 providers, allow_anonymous=c.authn.allow_anonymous
             )
             self.authn.attach(self.hooks)
         else:
             self.authn = None
+        if c.authn.scram_enable:
+            from emqx_tpu.auth.scram import ScramAuthenticator
+
+            self.scram = ScramAuthenticator(iterations=c.authn.scram_iterations)
+            for u in c.authn.scram_users:
+                self.scram.add_user(u.user_id, u.password, u.is_superuser)
+            self.channel_config.enhanced_auth[self.scram.METHOD] = self.scram
+
+        # TLS-PSK identity store (emqx_psk analog)
+        self.psk = None
+        if c.psk.enable:
+            from emqx_tpu.auth.psk import PskStore
+
+            self.psk = PskStore()
+            for ident, secret in c.psk.identities.items():
+                self.psk.insert(ident, secret)
+            if c.psk.file:
+                self.psk.import_file(c.psk.file)
+            self.transport_ctx.psk = self.psk
 
         # rule engine (reference L4: emqx_rule_engine)
         from emqx_tpu.rules.engine import Console, Republish, RuleEngine
@@ -186,6 +227,8 @@ class BrokerApp:
             for o in spec.outputs or [None]:
                 if o is None or o.function == "console":
                     outputs.append(Console())
+                elif o.function == "bridge":
+                    outputs.append(self._bridge_output(str(o.args.get("id", ""))))
                 else:
                     a = o.args
                     outputs.append(
@@ -201,10 +244,27 @@ class BrokerApp:
             )
             rule.enabled = spec.enable
 
+        authz_rules = [self._acl_rule(r) for r in c.authz.rules]
+        if c.authz.acl_file:
+            from emqx_tpu.auth.file_acl import load as load_acl_file
+
+            authz_rules.extend(load_acl_file(c.authz.acl_file))
+        authz_sources = []
+        if c.authz.http_url:
+            from emqx_tpu.auth.http import HttpAuthzSource
+
+            authz_sources.append(
+                HttpAuthzSource(
+                    c.authz.http_url,
+                    method=c.authz.http_method,
+                    timeout=c.authz.http_timeout,
+                )
+            )
         self.authz = Authorizer(
-            rules=[self._acl_rule(r) for r in c.authz.rules],
+            rules=authz_rules,
             no_match=c.authz.no_match,
             deny_action=c.authz.deny_action,
+            sources=authz_sources,
         )
         self.authz.attach(self.hooks)
 
@@ -312,6 +372,7 @@ class BrokerApp:
 
         self.mgmt_server = None  # set by start() when dashboard.enable
         self.gateways = None  # GatewayRegistry, set by start() when configured
+        self.bridges = None  # BridgeManager, set by start() when configured
         self._tasks: List[asyncio.Task] = []
         self.started_at: Optional[float] = None
 
@@ -386,6 +447,11 @@ class BrokerApp:
                 ),
                 chan_cfg,
             )
+        if c.bridges:
+            for bspec in c.bridges:
+                await self._bridge_manager().create(
+                    bspec.id, {**bspec.opts, "enable": bspec.enable}
+                )
         if c.gateways:
             from emqx_tpu.gateway.registry import GatewayRegistry
 
@@ -411,6 +477,24 @@ class BrokerApp:
             asyncio.ensure_future(self._sys_stats()),
         ]
 
+    def _bridge_manager(self):
+        if self.bridges is None:
+            from emqx_tpu.integration.bridge import BridgeManager
+
+            self.bridges = BridgeManager(self.broker, self.hooks)
+        return self.bridges
+
+    def _bridge_output(self, bridge_id: str):
+        """Lazy rule output: bridges may be created after the rule
+        (config order, or via REST) — resolve at fire time."""
+        from emqx_tpu.rules.engine import FunctionOutput
+
+        def fn(row, ctx):
+            if self.bridges is not None:
+                self.bridges.send_row(bridge_id, row, ctx)
+
+        return FunctionOutput(fn, name=f"bridge:{bridge_id}")
+
     async def stop(self) -> None:
         if self.broker.ingest is not None:
             await self.broker.ingest.stop()
@@ -426,6 +510,8 @@ class BrokerApp:
             await self.mgmt_server.stop()
         if self.gateways is not None:
             await self.gateways.unload_all()
+        if self.bridges is not None:
+            await self.bridges.close()
         await self.listeners.stop_all()
         # final checkpoint AFTER listeners close: connection teardown parks
         # live persistent sessions into cm._detached, so the snapshot
@@ -438,6 +524,16 @@ class BrokerApp:
             self.sys_mon.close()
         if self.exhook is not None:
             self.exhook.shutdown()
+        # external auth backends hold lazily-created HTTP sessions
+        if self.authn is not None:
+            for prov in self.authn.providers:
+                closer = getattr(prov, "close", None)
+                if closer is not None:
+                    await closer()
+        for src in self.authz.sources:
+            closer = getattr(src, "close", None)
+            if closer is not None:
+                await closer()
         self.trace.close()
 
     async def _housekeeping(self) -> None:

@@ -103,6 +103,28 @@ class AuthnConfig:
     users: List[AuthUser] = field(default_factory=list)
     jwt_secret: str = ""
     jwt_verify_claims: Dict[str, str] = field(default_factory=dict)
+    # HTTP authn provider (emqx_authn_http analog)
+    http_url: str = ""
+    http_method: str = "POST"
+    http_timeout: float = 5.0
+    # JWKS RS256 provider (emqx_authn_jwt jwks mode)
+    jwks_endpoint: str = ""
+    jwks_refresh_interval: float = 300.0
+    jwks_verify_claims: Dict[str, str] = field(default_factory=dict)
+    # SCRAM-SHA-256 enhanced auth (emqx enhanced_authn scram)
+    scram_enable: bool = False
+    scram_iterations: int = 4096
+    scram_users: List[AuthUser] = field(default_factory=list)
+
+
+@dataclass
+class PskConfig:
+    """TLS-PSK identity store (emqx_psk analog); wired into ssl/wss
+    listeners when the interpreter's ssl module supports PSK."""
+
+    enable: bool = False
+    identities: Dict[str, str] = field(default_factory=dict)  # id -> hex
+    file: str = ""  # identity:hexsecret lines
 
 
 @dataclass
@@ -118,6 +140,12 @@ class AuthzConfig:
     no_match: str = "allow"
     deny_action: str = "ignore"  # 'ignore' | 'disconnect' (reference knob)
     rules: List[AclRuleSpec] = field(default_factory=list)
+    # file source: JSON-lines ACL rules (emqx_authz_file analog)
+    acl_file: str = ""
+    # HTTP source (emqx_authz_http analog)
+    http_url: str = ""
+    http_method: str = "POST"
+    http_timeout: float = 5.0
 
 
 @dataclass
@@ -230,8 +258,20 @@ class AutoSubscribeSpec:
 
 @dataclass
 class RuleOutputSpec:
-    function: str = "console"  # console | republish
+    function: str = "console"  # console | republish | bridge
     args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class BridgeSpec:
+    """One data bridge (emqx_bridge config analog). id = `type:name`
+    (http:alarm, mqtt:site_a); connector options in `opts` (url/method/
+    body for http; host/port/remote_topic/ingress_filter for mqtt;
+    local_topic binds an automatic egress)."""
+
+    id: str = ""
+    enable: bool = True
+    opts: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -282,6 +322,8 @@ class AppConfig:
     auto_subscribe: List[AutoSubscribeSpec] = field(default_factory=list)
     rules: List[RuleSpec] = field(default_factory=list)
     gateways: List[GatewaySpec] = field(default_factory=list)
+    bridges: List[BridgeSpec] = field(default_factory=list)
+    psk: PskConfig = field(default_factory=PskConfig)
 
 
 class ConfigError(ValueError):

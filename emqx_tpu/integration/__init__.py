@@ -1,0 +1,18 @@
+"""Data integration: resources, connectors, and bridges.
+
+TPU-stack analog of the reference's integration triad:
+- `emqx_resource` (apps/emqx_resource/src/emqx_resource_instance.erl) —
+  resource instance lifecycle with health checks and auto-restart
+  -> `integration/resource.py`
+- `emqx_connector` (apps/emqx_connector/src/) — typed clients for
+  external systems (HTTP, MQTT ingress/egress)
+  -> `integration/http.py`, `integration/mqtt_bridge.py`
+- `emqx_bridge` (apps/emqx_bridge/src/) — the config layer binding
+  connectors to the broker and the rule engine
+  -> `integration/bridge.py`
+"""
+
+from emqx_tpu.integration.bridge import BridgeManager
+from emqx_tpu.integration.resource import Resource, ResourceManager, ResourceStatus
+
+__all__ = ["BridgeManager", "Resource", "ResourceManager", "ResourceStatus"]

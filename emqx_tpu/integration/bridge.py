@@ -1,0 +1,158 @@
+"""Bridges: named connector instances bound to the broker + rule engine.
+
+Parity with emqx_bridge (apps/emqx_bridge/src/): a bridge is a configured
+connector running under the ResourceManager, reachable three ways —
+
+- as a **rule-engine output** (`outputs: [{type: bridge, id: ...}]`),
+  the reference's `{bridge, BridgeId}` output resolution;
+- via a **local_topic** binding: messages published to that filter are
+  forwarded automatically (egress without a rule), matching the
+  reference's bridge `local_topic` shortcut;
+- **ingress** bridges re-publish remote messages locally (driven inside
+  the MQTT connector itself).
+
+Bridge ids follow the reference's `type:name` convention (http:alarm,
+mqtt:site_a).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from emqx_tpu.integration.resource import ResourceManager
+from emqx_tpu.ops import topics as T
+
+log = logging.getLogger("emqx_tpu.integration.bridge")
+
+
+def _msg_env(msg) -> Dict:
+    return {
+        "topic": msg.topic,
+        "payload": msg.payload,
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "clientid": msg.from_client,
+        "username": msg.from_username,
+        "id": str(msg.mid),
+        "timestamp": int(msg.timestamp * 1000),
+    }
+
+
+class BridgeManager:
+    def __init__(self, broker, hooks, resources: Optional[ResourceManager] = None):
+        self.broker = broker
+        self.hooks = hooks
+        self.resources = resources or ResourceManager()
+        # bridge id -> config dict (incl. local_topic binding)
+        self._bridges: Dict[str, Dict] = {}
+        self._hooked = False
+
+    # -- config ------------------------------------------------------------
+    async def create(self, bridge_id: str, config: Dict):
+        """config: {type: http|mqtt, enable, local_topic?, ...connector opts}"""
+        if bridge_id in self._bridges:
+            raise ValueError(f"bridge already exists: {bridge_id}")
+        btype, _, _name = bridge_id.partition(":")
+        cfg = dict(config)
+        resource = self._make_resource(btype, cfg)
+        await self.resources.create(
+            bridge_id, resource, enabled=cfg.get("enable", True)
+        )
+        self._bridges[bridge_id] = cfg
+        if cfg.get("local_topic") and not self._hooked:
+            self.hooks.add(
+                "message.publish", self._on_publish, tag="bridge"
+            )
+            self._hooked = True
+        return self.resources.get(bridge_id)
+
+    def _make_resource(self, btype: str, cfg: Dict):
+        if btype == "http":
+            from emqx_tpu.integration.http import HttpConnector
+
+            return HttpConnector(
+                base_url=cfg["url"],
+                method=cfg.get("method", "POST"),
+                path=cfg.get("path", ""),
+                headers=cfg.get("headers"),
+                body=cfg.get("body", "${payload}"),
+                request_timeout=cfg.get("request_timeout", 5.0),
+                pool_size=cfg.get("pool_size", 8),
+                health_path=cfg.get("health_path", ""),
+            )
+        if btype == "mqtt":
+            from emqx_tpu.integration.mqtt_bridge import MqttConnector
+
+            return MqttConnector(
+                self.broker,
+                host=cfg["host"],
+                port=cfg.get("port", 1883),
+                clientid=cfg.get("clientid", "emqx-tpu-bridge"),
+                username=cfg.get("username"),
+                password=cfg.get("password"),
+                remote_topic=cfg.get("remote_topic", "${topic}"),
+                remote_qos=cfg.get("remote_qos", 0),
+                payload=cfg.get("payload", "${payload}"),
+                ingress_filter=cfg.get("ingress_filter"),
+                local_topic=cfg.get("ingress_local_topic", "${topic}"),
+                local_qos=cfg.get("ingress_local_qos", 0),
+            )
+        raise ValueError(f"unknown bridge type: {btype}")
+
+    async def remove(self, bridge_id: str) -> bool:
+        self._bridges.pop(bridge_id, None)
+        return await self.resources.remove(bridge_id)
+
+    async def close(self) -> None:
+        await self.resources.close()
+        self._bridges.clear()
+
+    # -- egress paths -------------------------------------------------------
+    def _on_publish(self, msg):
+        """local_topic binding ('message.publish' fold member, acc = the
+        message): forward a matching publish to every bound bridge,
+        without consuming it."""
+        if msg is None or msg.headers.get("bridged"):
+            return msg
+        for bid, cfg in self._bridges.items():
+            lt = cfg.get("local_topic")
+            if lt and T.match(msg.topic, lt):
+                asyncio.get_event_loop().create_task(
+                    self._send_safe(bid, _msg_env(msg))
+                )
+        return msg
+
+    async def _send_safe(self, bridge_id: str, env: Dict) -> None:
+        try:
+            await self.resources.query(bridge_id, env)
+        except Exception as e:
+            log.warning("bridge %s send failed: %s", bridge_id, e)
+
+    def send_row(self, bridge_id: str, row: Dict, ctx: Dict) -> None:
+        """Fire-and-forget one rule row / message env to a bridge."""
+        env = dict(ctx)
+        env.update(row)
+        asyncio.get_event_loop().create_task(self._send_safe(bridge_id, env))
+
+    def rule_output(self, bridge_id: str):
+        """A rule-engine Output forwarding matched rows to this bridge
+        (emqx_rule_outputs' {bridge, Id} resolution)."""
+        from emqx_tpu.rules.engine import FunctionOutput
+
+        return FunctionOutput(
+            lambda row, ctx: self.send_row(bridge_id, row, ctx),
+            name=f"bridge:{bridge_id}",
+        )
+
+    # -- introspection ------------------------------------------------------
+    def list(self) -> List[Dict]:
+        out = []
+        for info in self.resources.list():
+            cfg = self._bridges.get(info["id"], {})
+            info = dict(info)
+            info["local_topic"] = cfg.get("local_topic")
+            info["type"] = info["id"].partition(":")[0]
+            out.append(info)
+        return out

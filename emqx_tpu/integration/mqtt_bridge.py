@@ -1,0 +1,137 @@
+"""MQTT connector: ingress/egress bridge to a remote MQTT broker.
+
+Parity with emqx_connector's MQTT bridge (apps/emqx_connector/src/mqtt/ —
+emqtt-based ingress/egress workers):
+
+- **egress**: local messages handed to `query()` (from a rule output or a
+  local-topic hook) are published to the remote broker under a templated
+  remote topic.
+- **ingress**: the connector subscribes on the remote broker; arriving
+  messages are re-published into the LOCAL broker under a templated local
+  topic (loop-guarded via a bridge header).
+
+The remote session is the in-repo MQTT client; health = liveness of that
+connection (reconnect is the ResourceManager's restart cycle).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.integration.resource import Resource
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.utils.placeholder import render
+
+log = logging.getLogger("emqx_tpu.integration.mqtt")
+
+
+class MqttConnector(Resource):
+    def __init__(
+        self,
+        broker,
+        host: str,
+        port: int,
+        clientid: str = "emqx-tpu-bridge",
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        # egress: query(env) -> publish remote_topic template
+        remote_topic: str = "${topic}",
+        remote_qos: int = 0,
+        payload: str = "${payload}",
+        # ingress: remote filter -> local topic template
+        ingress_filter: Optional[str] = None,
+        local_topic: str = "${topic}",
+        local_qos: int = 0,
+        keepalive: int = 30,
+    ):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self.clientid = clientid
+        self.username = username
+        self.password = password
+        self.remote_topic = remote_topic
+        self.remote_qos = remote_qos
+        self.payload = payload
+        self.ingress_filter = ingress_filter
+        self.local_topic = local_topic
+        self.local_qos = local_qos
+        self.keepalive = keepalive
+        self._client = None
+        self._ingress_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        from emqx_tpu.mqtt.client import Client
+
+        pw = self.password
+        c = Client(
+            client_id=self.clientid,
+            username=self.username,
+            password=pw.encode() if isinstance(pw, str) else pw,
+            keepalive=self.keepalive,
+        )
+        await c.connect(self.host, self.port)
+        self._client = c
+        if self.ingress_filter:
+            await c.subscribe(
+                [(self.ingress_filter, pkt.SubOpts(qos=self.local_qos))]
+            )
+            self._ingress_task = asyncio.get_running_loop().create_task(
+                self._ingress_loop()
+            )
+
+    async def stop(self) -> None:
+        if self._ingress_task is not None:
+            self._ingress_task.cancel()
+            self._ingress_task = None
+        if self._client is not None:
+            try:
+                await self._client.disconnect()
+            except Exception:
+                pass
+            self._client = None
+
+    async def health_check(self) -> bool:
+        c = self._client
+        return bool(c is not None and not c.closed.is_set())
+
+    # -- egress ------------------------------------------------------------
+    async def query(self, env: Dict) -> None:
+        """Publish one local message/row to the remote broker."""
+        if self._client is None or self._client.closed.is_set():
+            raise RuntimeError("mqtt bridge not connected")
+        topic = render(self.remote_topic, env)
+        payload = render(self.payload, env).encode()
+        await self._client.publish(
+            topic, payload, qos=self.remote_qos, timeout=30
+        )
+
+    # -- ingress -----------------------------------------------------------
+    async def _ingress_loop(self) -> None:
+        try:
+            while True:
+                p = await self._client.messages.get()
+                env = {
+                    "topic": p.topic,
+                    "payload": p.payload,
+                    "qos": p.qos,
+                }
+                msg = Message(
+                    topic=render(self.local_topic, env),
+                    payload=p.payload,
+                    qos=self.local_qos,
+                    from_client=self.clientid,
+                )
+                # loop guard: a bridged-in message must not be bridged out
+                # again by an egress rule on the same broker
+                msg.headers["bridged"] = True
+                r = await self.broker.apublish_enqueue(msg)
+                if asyncio.isfuture(r):
+                    await r
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("mqtt bridge ingress failed")

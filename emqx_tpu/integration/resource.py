@@ -1,0 +1,255 @@
+"""Resource instance lifecycle: create / health-check / restart.
+
+Parity with emqx_resource (apps/emqx_resource/src/emqx_resource_instance.erl
+create/start/stop/restart/remove + emqx_resource_health_check.erl): each
+resource is an async client owned by the manager, which drives a periodic
+health check and restarts unhealthy instances with exponential backoff.
+
+Statuses mirror the reference: ``connecting | connected | disconnected |
+stopped``. Query errors mark the instance disconnected immediately, which
+fast-tracks the next health cycle's restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.integration")
+
+
+class ResourceStatus:
+    CONNECTING = "connecting"
+    CONNECTED = "connected"
+    DISCONNECTED = "disconnected"
+    STOPPED = "stopped"
+
+
+class Resource:
+    """Behaviour every connector implements (emqx_resource callback
+    module: on_start/on_stop/on_query/on_health_check)."""
+
+    async def start(self) -> None:
+        raise NotImplementedError
+
+    async def stop(self) -> None:
+        raise NotImplementedError
+
+    async def health_check(self) -> bool:
+        return True
+
+    async def query(self, request) -> object:
+        raise NotImplementedError
+
+
+@dataclass
+class _Instance:
+    id: str
+    resource: Resource
+    status: str = ResourceStatus.CONNECTING
+    enabled: bool = True
+    restarts: int = 0
+    last_error: Optional[str] = None
+    started_at: float = field(default_factory=time.time)
+    metrics: Dict[str, int] = field(
+        default_factory=lambda: {"success": 0, "failed": 0, "matched": 0}
+    )
+    _backoff: float = 1.0
+    _next_try: float = 0.0
+    # serializes start/stop/health transitions: a health tick must never
+    # interleave with an in-flight create/restart (both await)
+    _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+
+class ResourceManager:
+    """Owns every resource instance + the health-check loop."""
+
+    def __init__(self, health_interval: float = 5.0, backoff_max: float = 60.0):
+        self.health_interval = health_interval
+        self.backoff_max = backoff_max
+        self._instances: Dict[str, _Instance] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def create(self, rid: str, resource: Resource, enabled: bool = True):
+        """Create + start (emqx_resource_instance create_local)."""
+        if rid in self._instances:
+            raise ValueError(f"resource already exists: {rid}")
+        inst = _Instance(id=rid, resource=resource, enabled=enabled)
+        if enabled:
+            await self._start_inst(inst)
+        else:
+            inst.status = ResourceStatus.STOPPED
+        # register only once the initial start settled — the health loop
+        # must not see (and "restart") an instance mid-create
+        if rid in self._instances:
+            raise ValueError(f"resource already exists: {rid}")
+        self._instances[rid] = inst
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._health_loop()
+            )
+        return inst
+
+    async def _start_inst(self, inst: _Instance) -> None:
+        inst.status = ResourceStatus.CONNECTING
+        try:
+            await inst.resource.start()
+        except Exception as e:
+            inst.status = ResourceStatus.DISCONNECTED
+            inst.last_error = str(e)
+            log.warning("resource %s start failed: %s", inst.id, e)
+            return
+        healthy = False
+        try:
+            healthy = await inst.resource.health_check()
+        except Exception as e:
+            inst.last_error = str(e)
+        inst.status = (
+            ResourceStatus.CONNECTED if healthy else ResourceStatus.DISCONNECTED
+        )
+        if healthy:
+            inst._backoff = 1.0
+            inst.last_error = None
+
+    async def stop(self, rid: str) -> bool:
+        inst = self._instances.get(rid)
+        if inst is None:
+            return False
+        inst.enabled = False
+        try:
+            await inst.resource.stop()
+        except Exception:
+            pass
+        inst.status = ResourceStatus.STOPPED
+        return True
+
+    async def restart(self, rid: str) -> bool:
+        inst = self._instances.get(rid)
+        if inst is None:
+            return False
+        async with inst._lock:
+            try:
+                await inst.resource.stop()
+            except Exception:
+                pass
+            inst.enabled = True
+            inst.restarts += 1
+            await self._start_inst(inst)
+        return True
+
+    async def remove(self, rid: str) -> bool:
+        inst = self._instances.pop(rid, None)
+        if inst is None:
+            return False
+        try:
+            await inst.resource.stop()
+        except Exception:
+            pass
+        return True
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for rid in list(self._instances):
+            await self.remove(rid)
+
+    # -- query path --------------------------------------------------------
+    async def query(self, rid: str, request) -> object:
+        """Route one request to the resource; failures mark it
+        disconnected so the health loop restarts it."""
+        inst = self._instances.get(rid)
+        if inst is None:
+            raise KeyError(f"no such resource: {rid}")
+        inst.metrics["matched"] += 1
+        if inst.status == ResourceStatus.STOPPED:
+            inst.metrics["failed"] += 1
+            raise RuntimeError(f"resource {rid} is stopped")
+        try:
+            out = await inst.resource.query(request)
+        except Exception as e:
+            inst.metrics["failed"] += 1
+            inst.status = ResourceStatus.DISCONNECTED
+            inst.last_error = str(e)
+            raise
+        inst.metrics["success"] += 1
+        return out
+
+    # -- health ------------------------------------------------------------
+    async def _health_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.health_interval)
+                for inst in list(self._instances.values()):
+                    if not inst.enabled:
+                        continue
+                    await self._check_one(inst)
+        except asyncio.CancelledError:
+            pass
+
+    async def _check_one(self, inst: _Instance) -> None:
+        if inst._lock.locked():
+            return  # create/restart in flight; don't interleave
+        async with inst._lock:
+            if inst.status == ResourceStatus.CONNECTED:
+                try:
+                    ok = await inst.resource.health_check()
+                except Exception as e:
+                    ok = False
+                    inst.last_error = str(e)
+                if not ok:
+                    inst.status = ResourceStatus.DISCONNECTED
+                    log.warning("resource %s unhealthy", inst.id)
+            if inst.status in (
+                ResourceStatus.DISCONNECTED,
+                ResourceStatus.CONNECTING,
+            ):
+                # exponential backoff between restart attempts
+                now = time.monotonic()
+                if now < inst._next_try:
+                    return
+                inst._backoff = min(inst._backoff * 2, self.backoff_max)
+                inst._next_try = now + inst._backoff
+                inst.restarts += 1
+                log.info(
+                    "resource %s: restart attempt %d", inst.id, inst.restarts
+                )
+                try:
+                    await inst.resource.stop()
+                except Exception:
+                    pass
+                await self._start_inst(inst)
+
+    async def check_now(self, rid: str) -> Optional[str]:
+        """Force one health cycle (tests / REST health endpoint)."""
+        inst = self._instances.get(rid)
+        if inst is None:
+            return None
+        inst._next_try = 0.0
+        await self._check_one(inst)
+        return inst.status
+
+    # -- introspection -----------------------------------------------------
+    def get(self, rid: str) -> Optional[_Instance]:
+        return self._instances.get(rid)
+
+    def status(self, rid: str) -> Optional[str]:
+        inst = self._instances.get(rid)
+        return inst.status if inst else None
+
+    def list(self) -> List[Dict]:
+        return [
+            {
+                "id": i.id,
+                "status": i.status,
+                "enabled": i.enabled,
+                "restarts": i.restarts,
+                "last_error": i.last_error,
+                "metrics": dict(i.metrics),
+            }
+            for i in self._instances.values()
+        ]

@@ -85,6 +85,16 @@ class MgmtApi:
                 web.put("/api/v5/trace/{name}/stop", self.trace_stop),
                 web.get("/api/v5/trace/{name}/download", self.trace_download),
                 web.get("/api/v5/exhooks", self.exhooks_list),
+                web.get("/api/v5/gateways", self.gateways_list),
+                web.get("/api/v5/gateways/{name}", self.gateways_one),
+                web.post("/api/v5/gateways", self.gateways_load),
+                web.delete("/api/v5/gateways/{name}", self.gateways_unload),
+                web.get("/api/v5/bridges", self.bridges_list),
+                web.post("/api/v5/bridges", self.bridges_create),
+                web.delete("/api/v5/bridges/{id}", self.bridges_delete),
+                web.post(
+                    "/api/v5/bridges/{id}/restart", self.bridges_restart
+                ),
             ]
         )
         self._webapp = w
@@ -487,6 +497,85 @@ class MgmtApi:
     async def exhooks_list(self, request):
         ex = getattr(self.app, "exhook", None)
         return web.json_response({"data": ex.info() if ex else []})
+
+    # -- gateways (emqx_mgmt_api_gateway analog) ---------------------------
+    def _gw_registry(self):
+        if self.app.gateways is None:
+            from emqx_tpu.app import _register_builtin_gateways
+            from emqx_tpu.gateway.registry import GatewayRegistry
+
+            self.app.gateways = GatewayRegistry(
+                self.app.broker, self.app.hooks
+            )
+            _register_builtin_gateways(self.app.gateways)
+        return self.app.gateways
+
+    async def gateways_list(self, request):
+        return web.json_response({"data": self._gw_registry().list()})
+
+    async def gateways_one(self, request):
+        gw = self._gw_registry().get(request.match_info["name"])
+        if gw is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response(gw.status())
+
+    async def gateways_load(self, request):
+        body = await request.json()
+        try:
+            gw = await self._gw_registry().load(
+                body["type"], dict(body.get("opts", {})), name=body.get("name")
+            )
+        except (KeyError, ValueError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(gw.status(), status=201)
+
+    async def gateways_unload(self, request):
+        ok = await self._gw_registry().unload(request.match_info["name"])
+        return web.json_response(
+            {} if ok else {"code": "NOT_FOUND"},
+            status=204 if ok else 404,
+        )
+
+    # -- bridges (emqx_mgmt_api_bridge analog) -----------------------------
+    async def bridges_list(self, request):
+        b = self.app.bridges
+        return web.json_response({"data": b.list() if b else []})
+
+    async def bridges_create(self, request):
+        body = await request.json()
+        try:
+            inst = await self.app._bridge_manager().create(
+                body["id"], dict(body.get("opts", {}))
+            )
+        except (KeyError, ValueError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(
+            {"id": inst.id, "status": inst.status}, status=201
+        )
+
+    async def bridges_delete(self, request):
+        b = self.app.bridges
+        ok = b is not None and await b.remove(request.match_info["id"])
+        return web.json_response(
+            {} if ok else {"code": "NOT_FOUND"},
+            status=204 if ok else 404,
+        )
+
+    async def bridges_restart(self, request):
+        b = self.app.bridges
+        ok = b is not None and await b.resources.restart(
+            request.match_info["id"]
+        )
+        return web.json_response(
+            {"status": b.resources.status(request.match_info["id"])}
+            if ok
+            else {"code": "NOT_FOUND"},
+            status=200 if ok else 404,
+        )
 
     async def trace_download(self, request):
         content = self.app.trace.read(request.match_info["name"])

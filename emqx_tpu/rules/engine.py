@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import json
 import logging
-import re
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -24,34 +23,9 @@ from emqx_tpu.rules.sql import Query, parse_sql
 
 log = logging.getLogger("emqx_tpu.rules")
 
-_PLACEHOLDER = re.compile(r"\$\{([A-Za-z0-9_.$]+)\}")
-
-
-def render_template(template: str, env: Dict) -> str:
-    """${a.b} placeholder substitution (emqx_placeholder parity)."""
-
-    def repl(m):
-        cur = env
-        for seg in m.group(1).split("."):
-            if isinstance(cur, (bytes, str)):
-                try:
-                    cur = json.loads(cur)
-                except (ValueError, TypeError):
-                    cur = None
-            if not isinstance(cur, dict) or seg not in cur:
-                return ""
-            cur = cur[seg]
-        if isinstance(cur, bytes):
-            return cur.decode("utf-8", "replace")
-        if isinstance(cur, (dict, list)):
-            return json.dumps(cur)
-        if isinstance(cur, bool):
-            return "true" if cur else "false"
-        if isinstance(cur, float) and cur.is_integer():
-            return str(int(cur))
-        return "" if cur is None else str(cur)
-
-    return _PLACEHOLDER.sub(repl, template)
+# shared ${a.b} placeholder substitution (emqx_placeholder parity) — one
+# implementation for rules, bridges, authz (emqx_tpu/utils/placeholder.py)
+from emqx_tpu.utils.placeholder import render as render_template  # noqa: E402
 
 
 # -- outputs -----------------------------------------------------------------
