@@ -1,0 +1,18 @@
+"""Authentication/authorization backends.
+
+The chain framework lives in emqx_tpu/broker/auth.py (provider protocol +
+'client.authenticate' fold) and emqx_tpu/broker/authz.py (rule sources +
+'client.authorize' fold). This package holds the external-backend
+providers, mirroring the reference's apps:
+
+- `http`  — HTTP authn provider + HTTP authz source
+  (apps/emqx_authn/src/simple_authn/emqx_authn_http.erl,
+   apps/emqx_authz/src/emqx_authz_http.erl)
+- `jwks`  — RS256 JWT verification against a JWKS endpoint
+  (emqx_authn_jwt.erl jwks mode), pure-python RSA verify
+- `scram` — SCRAM-SHA-256 enhanced authentication over MQTT5 AUTH
+  (apps/emqx_authn/src/enhanced_authn/emqx_enhanced_authn_scram_mnesia.erl)
+- `psk`   — TLS-PSK identity store (apps/emqx_psk/src/emqx_psk.erl);
+  handshake wiring is gated on Python's ssl PSK support
+- `file_acl` — file-based authorization source (emqx_authz_file.erl)
+"""

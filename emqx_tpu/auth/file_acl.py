@@ -1,0 +1,50 @@
+"""File-based authorization source.
+
+Parity: apps/emqx_authz/src/emqx_authz_file.erl — the reference consults
+an ``acl.conf`` of Erlang terms; this stack's native format is JSON lines
+(one rule object per line, comments with #), same rule semantics
+(permit/who/action/topics with placeholders, first match wins):
+
+    {"permit": "allow", "who": {"username": "alice"}, "action": "publish",
+     "topics": ["a/b", "c/${clientid}/#"]}
+    {"permit": "deny", "who": "all", "action": "all", "topics": ["#"]}
+
+`load` parses into the Authorizer's AclRule list; `watch`-style reload is
+a `load` + `Authorizer.set_rules` (cache invalidation included).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import List
+
+from emqx_tpu.broker.authz import AclRule
+
+log = logging.getLogger("emqx_tpu.auth.file")
+
+
+def parse_rules(text: str) -> List[AclRule]:
+    rules: List[AclRule] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            obj = json.loads(line)
+            rules.append(
+                AclRule(
+                    permit=obj["permit"],
+                    who=obj.get("who", "all"),
+                    action=obj.get("action", "all"),
+                    topics=list(obj.get("topics", [])),
+                )
+            )
+        except (ValueError, KeyError) as e:
+            raise ValueError(f"acl file line {i}: {e}") from e
+    return rules
+
+
+def load(path: str) -> List[AclRule]:
+    with open(path) as f:
+        return parse_rules(f.read())

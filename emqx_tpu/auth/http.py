@@ -1,0 +1,179 @@
+"""HTTP authentication provider + HTTP authorization source.
+
+Parity with the reference's HTTP backends:
+- authn (apps/emqx_authn/src/simple_authn/emqx_authn_http.erl): request
+  templated from client info; 200/204 with JSON body decides
+  allow/deny/ignore (+ is_superuser), 4xx/5xx => ignore (fall through).
+- authz (apps/emqx_authz/src/emqx_authz_http.erl): per (client, action,
+  topic) query; 200 {"result": "allow"|"deny"|"ignore"}; transport errors
+  => ignore (the chain's no_match policy applies).
+
+Both are async (aiohttp) — the channel runs auth hooks via arun_fold, so
+a slow auth service suspends only that client's task.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, Optional
+
+from emqx_tpu.broker.auth import DENY, IGNORE, OK, Provider
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.utils.placeholder import render
+
+log = logging.getLogger("emqx_tpu.auth.http")
+
+
+def _client_env(ci: Dict, credentials: Optional[Dict] = None) -> Dict:
+    pw = (credentials or {}).get("password") or b""
+    return {
+        "clientid": ci.get("client_id", ""),
+        "username": ci.get("username") or "",
+        "password": pw.decode("utf-8", "replace") if isinstance(pw, bytes) else pw,
+        "peerhost": str(ci.get("peerhost", "")),
+        "mountpoint": ci.get("mountpoint") or "",
+    }
+
+
+class _HttpCaller:
+    def __init__(
+        self,
+        url: str,
+        method: str = "POST",
+        headers: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, str]] = None,
+        timeout: float = 5.0,
+    ):
+        self.url = url
+        self.method = method.upper()
+        self.headers = headers or {"content-type": "application/json"}
+        self.body = body or {}
+        self.timeout = timeout
+        self._session = None
+
+    async def _ensure(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout)
+            )
+        return self._session
+
+    async def call(self, env: Dict):
+        """-> (status, json_or_none) or None on transport error."""
+        s = await self._ensure()
+        url = render(self.url, env)
+        rendered = {k: render(v, env) for k, v in self.body.items()}
+        try:
+            if self.method == "GET":
+                async with s.get(url, params=rendered) as resp:
+                    return resp.status, await self._json(resp)
+            async with s.request(
+                self.method, url, json=rendered, headers=self.headers
+            ) as resp:
+                return resp.status, await self._json(resp)
+        except Exception as e:
+            log.warning("http auth call failed: %s", e)
+            return None
+
+    @staticmethod
+    async def _json(resp):
+        try:
+            return json.loads(await resp.text())
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class HttpAuthProvider(Provider):
+    """'client.authenticate' provider backed by an HTTP service."""
+
+    def __init__(self, url: str, method: str = "POST",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: Optional[Dict[str, str]] = None,
+                 timeout: float = 5.0):
+        self.caller = _HttpCaller(
+            url,
+            method,
+            headers,
+            body
+            or {
+                "clientid": "${clientid}",
+                "username": "${username}",
+                "password": "${password}",
+            },
+            timeout,
+        )
+
+    def authenticate(self, client_info, credentials):
+        # sync path (tests/tools): no opinion — the async path decides
+        return IGNORE, None
+
+    async def authenticate_async(self, client_info, credentials):
+        out = await self.caller.call(_client_env(client_info, credentials))
+        if out is None:
+            return IGNORE, None
+        status, data = out
+        if status == 204:
+            return OK, None
+        if status != 200 or not isinstance(data, dict):
+            return IGNORE, None
+        # missing/invalid `result` falls through the chain (emqx_authn_http
+        # parity) — a 200 error payload must not become allow-all
+        result = data.get("result", "ignore")
+        if result == "allow":
+            if data.get("is_superuser"):
+                client_info["is_superuser"] = True
+            return OK, None
+        if result == "deny":
+            return DENY, pkt.RC_NOT_AUTHORIZED
+        return IGNORE, None
+
+    async def close(self):
+        await self.caller.close()
+
+
+class HttpAuthzSource:
+    """'client.authorize' source backed by an HTTP service."""
+
+    def __init__(self, url: str, method: str = "POST",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: Optional[Dict[str, str]] = None,
+                 timeout: float = 5.0):
+        self.caller = _HttpCaller(
+            url,
+            method,
+            headers,
+            body
+            or {
+                "clientid": "${clientid}",
+                "username": "${username}",
+                "topic": "${topic}",
+                "action": "${action}",
+            },
+            timeout,
+        )
+
+    async def check(self, ci: Dict, action: str, topic: str) -> str:
+        env = _client_env(ci)
+        env["action"] = action
+        env["topic"] = topic
+        out = await self.caller.call(env)
+        if out is None:
+            return "ignore"
+        status, data = out
+        if status == 204:
+            return "allow"
+        if status != 200 or not isinstance(data, dict):
+            return "ignore"
+        r = data.get("result", "ignore")
+        return r if r in ("allow", "deny") else "ignore"
+
+    async def close(self):
+        await self.caller.close()

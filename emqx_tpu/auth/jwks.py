@@ -1,0 +1,161 @@
+"""RS256 JWT verification against a JWKS endpoint.
+
+Parity: emqx_authn_jwt's jwks mode (apps/emqx_authn/src/simple_authn/
+emqx_authn_jwt.erl with emqx_authn_jwks_connector) — tokens arrive in the
+MQTT password field, keys come from a JWKS URL (kid-matched), refreshed
+periodically.
+
+RSA PKCS#1 v1.5 verification is implemented directly (modular
+exponentiation + EMSA-PKCS1-v1_5 digest comparison) — no crypto
+dependency in this image; verification-only, no key generation.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+from emqx_tpu.broker.auth import DENY, IGNORE, OK, Provider
+from emqx_tpu.mqtt import packet as pkt
+
+log = logging.getLogger("emqx_tpu.auth.jwks")
+
+# DigestInfo prefix for SHA-256 (RFC 8017 §9.2 notes)
+_SHA256_PREFIX = bytes.fromhex("3031300d060960864801650304020105000420")
+
+
+def _b64d(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def rsa_verify_pkcs1_sha256(n: int, e: int, message: bytes, sig: bytes) -> bool:
+    k = (n.bit_length() + 7) // 8
+    if len(sig) != k:
+        return False
+    m = pow(int.from_bytes(sig, "big"), e, n)
+    em = m.to_bytes(k, "big")
+    expect = _SHA256_PREFIX + hashlib.sha256(message).digest()
+    # EM = 0x00 0x01 PS(0xff..., >=8) 0x00 T
+    if em[0] != 0 or em[1] != 1:
+        return False
+    try:
+        sep = em.index(b"\x00", 2)
+    except ValueError:
+        return False
+    if sep < 10 or any(b != 0xFF for b in em[2:sep]):
+        return False
+    return em[sep + 1 :] == expect
+
+
+class JwksAuthProvider(Provider):
+    """'client.authenticate' provider: RS256 password-field JWTs."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        refresh_interval: float = 300.0,
+        verify_claims: Optional[Dict[str, str]] = None,
+        timeout: float = 5.0,
+    ):
+        self.endpoint = endpoint
+        self.refresh_interval = refresh_interval
+        self.verify_claims = verify_claims or {}
+        self.timeout = timeout
+        self._keys: Dict[str, Dict] = {}  # kid -> {n: int, e: int}
+        self._fetched_at = 0.0
+        self._last_attempt = 0.0
+        self.retry_interval = 5.0  # failure backoff: don't hammer a dead
+        # endpoint once per connecting client
+        self._session = None
+
+    # -- key management ----------------------------------------------------
+    def load_keys(self, jwks: Dict) -> None:
+        """Install a JWKS document (also the test seam)."""
+        keys = {}
+        for k in jwks.get("keys", []):
+            if k.get("kty") != "RSA" or k.get("use", "sig") != "sig":
+                continue
+            try:
+                keys[k.get("kid", "")] = {
+                    "n": int.from_bytes(_b64d(k["n"]), "big"),
+                    "e": int.from_bytes(_b64d(k["e"]), "big"),
+                }
+            except (KeyError, ValueError):
+                continue
+        self._keys = keys
+        self._fetched_at = time.time()
+
+    async def _refresh(self) -> None:
+        now = time.time()
+        if self._keys and now - self._fetched_at < self.refresh_interval:
+            return
+        if now - self._last_attempt < self.retry_interval:
+            return
+        self._last_attempt = now
+        try:
+            import aiohttp
+
+            if self._session is None:
+                self._session = aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=self.timeout)
+                )
+            async with self._session.get(self.endpoint) as resp:
+                if resp.status == 200:
+                    self.load_keys(json.loads(await resp.text()))
+        except Exception as e:
+            log.warning("jwks refresh failed: %s", e)
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # -- provider ----------------------------------------------------------
+    def authenticate(self, client_info, credentials):
+        token = credentials.get("password")
+        if not token:
+            return IGNORE, None
+        return self._verify(client_info, token)
+
+    async def authenticate_async(self, client_info, credentials):
+        token = credentials.get("password")
+        if not token:
+            return IGNORE, None
+        await self._refresh()
+        return self._verify(client_info, token)
+
+    def _verify(self, client_info, token: bytes):
+        try:
+            parts = token.decode().split(".")
+            if len(parts) != 3:
+                return IGNORE, None
+            header = json.loads(_b64d(parts[0]))
+            if header.get("alg") != "RS256":
+                return IGNORE, None
+            key = self._keys.get(header.get("kid", ""))
+            if key is None and len(self._keys) == 1:
+                key = next(iter(self._keys.values()))
+            if key is None:
+                return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+            signing = f"{parts[0]}.{parts[1]}".encode()
+            if not rsa_verify_pkcs1_sha256(
+                key["n"], key["e"], signing, _b64d(parts[2])
+            ):
+                return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+            claims = json.loads(_b64d(parts[1]))
+        except Exception:
+            return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+        if "exp" in claims and time.time() > claims["exp"]:
+            return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+        for claim, expect in self.verify_claims.items():
+            expect = expect.replace(
+                "${clientid}", client_info.get("client_id", "")
+            ).replace("${username}", client_info.get("username") or "")
+            if claims.get(claim) != expect:
+                return DENY, pkt.RC_NOT_AUTHORIZED
+        client_info["jwt_claims"] = claims
+        return OK, None

@@ -1,0 +1,77 @@
+"""TLS-PSK identity store.
+
+Parity: apps/emqx_psk/src/emqx_psk.erl — an identity->secret store fed
+from config/file (``identity:hex-secret`` lines) and consulted by the TLS
+handshake callback.
+
+Python's ssl module grew PSK callbacks in 3.13
+(`SSLContext.set_psk_server_callback`); on this image (3.12) the store,
+file import, and management surface work, and `wire_into` reports whether
+the running interpreter can terminate PSK handshakes — the listener skips
+PSK wiring cleanly when it can't.
+"""
+
+from __future__ import annotations
+
+import binascii
+import logging
+import ssl
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.auth.psk")
+
+SUPPORTED = hasattr(ssl.SSLContext, "set_psk_server_callback")
+
+
+class PskStore:
+    def __init__(self):
+        self._identities: Dict[str, bytes] = {}
+
+    def insert(self, identity: str, secret_hex: str) -> None:
+        self._identities[identity] = binascii.unhexlify(secret_hex)
+
+    def delete(self, identity: str) -> bool:
+        return self._identities.pop(identity, None) is not None
+
+    def lookup(self, identity: str) -> Optional[bytes]:
+        return self._identities.get(identity)
+
+    def identities(self) -> List[str]:
+        return list(self._identities)
+
+    def import_file(self, path: str) -> int:
+        """``identity:hexsecret`` per line (emqx_psk init file parity)."""
+        n = 0
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                ident, _, secret = line.partition(":")
+                if not secret:
+                    log.warning("psk: skipping malformed line %r", line)
+                    continue
+                try:
+                    self.insert(ident, secret)
+                    n += 1
+                except binascii.Error:
+                    log.warning("psk: bad hex secret for %r", ident)
+        return n
+
+    def wire_into(self, ctx: ssl.SSLContext, hint: str = "emqx_tpu") -> bool:
+        """Attach this store to a server-side TLS context. Returns False
+        (and leaves the context untouched) when the interpreter's ssl
+        module has no PSK support."""
+        if not SUPPORTED:
+            log.warning(
+                "TLS-PSK requested but ssl.SSLContext has no PSK callbacks "
+                "on this Python; listener continues without PSK"
+            )
+            return False
+
+        def cb(conn, identity):
+            secret = self._identities.get(identity or "")
+            return secret or b""
+
+        ctx.set_psk_server_callback(cb, identity_hint=hint)
+        return True

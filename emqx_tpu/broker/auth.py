@@ -36,6 +36,13 @@ class Provider:
         """-> (ignore|ok|deny, reason_code|None)"""
         raise NotImplementedError
 
+    async def authenticate_async(
+        self, client_info: Dict, credentials: Dict
+    ) -> Tuple[str, Optional[int]]:
+        """Async variant — external-backend providers (HTTP/JWKS) override
+        this; the default defers to the sync implementation."""
+        return self.authenticate(client_info, credentials)
+
 
 def _hash_password(password: bytes, algo: str, salt: bytes, iterations: int = 10000) -> bytes:
     if algo == "plain":
@@ -165,15 +172,41 @@ class AuthChain:
         self.allow_anonymous = allow_anonymous
 
     def authenticate(self, client_info, credentials, acc=None):
+        if credentials.get("enhanced_auth"):
+            # already vouched by the enhanced-auth exchange (SCRAM); the
+            # ban gate runs at higher priority on the same hookpoint
+            return None
         for p in self.providers:
             result, rc = p.authenticate(client_info, credentials)
-            if result == OK:
-                return ("stop", {"result": "allow"})
-            if result == DENY:
-                return (
-                    "stop",
-                    {"result": "deny", "reason_code": rc or pkt.RC_NOT_AUTHORIZED},
-                )
+            d = self._decide(result, rc)
+            if d is not None:
+                return d
+        return self._fallthrough()
+
+    async def aauthenticate(self, client_info, credentials, acc=None):
+        """The hook-registered path (channel runs auth via arun_fold, so a
+        slow HTTP/JWKS backend suspends only that client's task)."""
+        if credentials.get("enhanced_auth"):
+            return None
+        for p in self.providers:
+            result, rc = await p.authenticate_async(client_info, credentials)
+            d = self._decide(result, rc)
+            if d is not None:
+                return d
+        return self._fallthrough()
+
+    @staticmethod
+    def _decide(result, rc):
+        if result == OK:
+            return ("stop", {"result": "allow"})
+        if result == DENY:
+            return (
+                "stop",
+                {"result": "deny", "reason_code": rc or pkt.RC_NOT_AUTHORIZED},
+            )
+        return None
+
+    def _fallthrough(self):
         if not self.allow_anonymous:
             # no provider vouched for the client: deny (even with an empty
             # provider list — enabling auth without users must not be open)
@@ -184,4 +217,4 @@ class AuthChain:
         return None  # no opinion
 
     def attach(self, hooks: Hooks) -> None:
-        hooks.add("client.authenticate", self.authenticate, priority=100)
+        hooks.add("client.authenticate", self.aauthenticate, priority=100)

@@ -52,6 +52,10 @@ class ChannelConfig:
     # per-listener topic namespace prefix, ${clientid}/${username}
     # placeholders resolved at CONNECT (emqx_mountpoint.erl parity)
     mountpoint: Optional[str] = None
+    # MQTT5 enhanced authentication: Authentication-Method -> authenticator
+    # (start/finish state machine, e.g. auth/scram.ScramAuthenticator);
+    # reference: emqx_channel enhanced auth + emqx_authn SCRAM mechanism
+    enhanced_auth: Dict[str, object] = field(default_factory=dict)
 
 
 class Channel:
@@ -70,6 +74,7 @@ class Channel:
         self.conninfo = conninfo or {}
         self.config = config or ChannelConfig()
         self.state = "idle"
+        self._ea = None  # in-flight enhanced-auth exchange
         self.version = pkt.MQTT_V4
         self.client_id = ""
         self.username: Optional[str] = None
@@ -123,6 +128,11 @@ class Channel:
             if t != pkt.CONNECT:
                 return self._close("protocol_error")
             return await self._in_connect(p)
+        if self.state == "authenticating":
+            # mid enhanced-auth exchange: only AUTH (continue) is legal
+            if t != pkt.AUTH:
+                return self._close("protocol_error", pkt.RC_PROTOCOL_ERROR)
+            return await self._in_auth_continue(p)
         if t == pkt.CONNECT:  # duplicate CONNECT is a protocol error
             return self._close("protocol_error", pkt.RC_PROTOCOL_ERROR)
         if t == pkt.PUBLISH:
@@ -176,10 +186,63 @@ class Channel:
         if t == pkt.DISCONNECT:
             return self._in_disconnect(p)
         if t == pkt.AUTH:
-            # enhanced auth is negotiated via Authentication-Method; none
-            # configured => protocol error (emqx_channel enhanced auth parity)
-            return self._close("auth_not_supported", pkt.RC_BAD_AUTHENTICATION_METHOD)
+            # MQTT5 re-authentication (spec 4.12.1): allowed when the
+            # method is configured; otherwise protocol error
+            return await self._in_reauth(p)
         self._close("unexpected_packet")
+
+    async def _in_reauth(self, p) -> None:
+        method = p.properties.get("Authentication-Method")
+        authenticator = self.config.enhanced_auth.get(method or "")
+        if authenticator is None:
+            return self._close(
+                "auth_not_supported", pkt.RC_BAD_AUTHENTICATION_METHOD
+            )
+        if p.reason_code == pkt.RC_REAUTHENTICATE:
+            r = authenticator.start(
+                p.properties.get("Authentication-Data", b"")
+            )
+            if r[0] != "continue":
+                return self._close("reauth_failed", pkt.RC_NOT_AUTHORIZED)
+            _, server_first, ea_state = r
+            self._ea = (None, None, method, authenticator, ea_state)
+            self._send(
+                pkt.Auth(
+                    reason_code=pkt.RC_CONTINUE_AUTHENTICATION,
+                    properties={
+                        "Authentication-Method": method,
+                        "Authentication-Data": server_first,
+                    },
+                )
+            )
+            return
+        if p.reason_code == pkt.RC_CONTINUE_AUTHENTICATION and self._ea:
+            _, _, ea_method, authenticator, ea_state = self._ea
+            if method != ea_method:
+                return self._close(
+                    "reauth_method_mismatch", pkt.RC_BAD_AUTHENTICATION_METHOD
+                )
+            r = authenticator.finish(
+                ea_state, p.properties.get("Authentication-Data", b"")
+            )
+            self._ea = None
+            if r[0] != "ok":
+                return self._close("reauth_failed", pkt.RC_NOT_AUTHORIZED)
+            _, server_final, attrs = r
+            self.auth_attrs.update(
+                {k: v for k, v in attrs.items() if k != "username"}
+            )
+            self._send(
+                pkt.Auth(
+                    reason_code=pkt.RC_SUCCESS,
+                    properties={
+                        "Authentication-Method": method,
+                        "Authentication-Data": server_final,
+                    },
+                )
+            )
+            return
+        self._close("protocol_error", pkt.RC_PROTOCOL_ERROR)
 
     # -- CONNECT ----------------------------------------------------------
     async def _in_connect(self, p: pkt.Connect) -> None:
@@ -198,17 +261,85 @@ class Channel:
             return self._connack_error(pkt.RC_CLIENT_IDENTIFIER_NOT_VALID)
         self.client_id = client_id
 
+        # MQTT5 enhanced authentication (AUTH exchange before CONNACK,
+        # e.g. SCRAM-SHA-256; emqx_channel enhanced auth parity)
+        method = (
+            p.properties.get("Authentication-Method")
+            if self.version == pkt.MQTT_V5
+            else None
+        )
+        if method is not None:
+            authenticator = self.config.enhanced_auth.get(method)
+            if authenticator is None:
+                return self._connack_error(pkt.RC_BAD_AUTHENTICATION_METHOD)
+            r = authenticator.start(
+                p.properties.get("Authentication-Data", b"")
+            )
+            if r[0] != "continue":
+                return self._connack_error(pkt.RC_NOT_AUTHORIZED)
+            _, server_first, ea_state = r
+            self._ea = (p, assigned, method, authenticator, ea_state)
+            self.state = "authenticating"
+            self._send(
+                pkt.Auth(
+                    reason_code=pkt.RC_CONTINUE_AUTHENTICATION,
+                    properties={
+                        "Authentication-Method": method,
+                        "Authentication-Data": server_first,
+                    },
+                )
+            )
+            return
+        await self._connect_continue(p, assigned)
+
+    async def _in_auth_continue(self, p: pkt.Auth) -> None:
+        stashed, assigned, method, authenticator, ea_state = self._ea
+        if p.properties.get("Authentication-Method") != method:
+            return self._connack_error(pkt.RC_BAD_AUTHENTICATION_METHOD)
+        r = authenticator.finish(
+            ea_state, p.properties.get("Authentication-Data", b"")
+        )
+        if r[0] != "ok":
+            await self.hooks.arun(
+                "client.connack", self.client_info(), "not_authorized"
+            )
+            return self._connack_error(pkt.RC_NOT_AUTHORIZED)
+        _, server_final, attrs = r
+        self._ea = None
+        if attrs.get("username") and not self.username:
+            self.username = attrs["username"]
+        self.auth_attrs.update(
+            {k: v for k, v in attrs.items() if k != "username"}
+        )
+        await self._connect_continue(
+            stashed,
+            assigned,
+            enhanced=True,
+            extra_props={
+                "Authentication-Method": method,
+                "Authentication-Data": server_final,
+            },
+        )
+
+    async def _connect_continue(
+        self, p: pkt.Connect, assigned, enhanced=False, extra_props=None
+    ) -> None:
         await self.hooks.arun("client.connect", self.client_info(), p)
-        # authenticate: fold over providers; None acc => allow
+        # authenticate fold ALWAYS runs — after enhanced auth too, so the
+        # banned/flapping gate (priority 1000) and exhook still apply; the
+        # marker tells credential providers the client is already vouched
+        creds = (
+            {"enhanced_auth": True}
+            if enhanced
+            else {"password": p.password}
+        )
         ci = self.client_info()
         base_keys = set(ci)
         auth = await self.hooks.arun_fold(
-            "client.authenticate",
-            (ci, {"password": p.password}),
-            None,
+            "client.authenticate", (ci, creds), None
         )
         # keep provider-set attrs (is_superuser, jwt claims) for the
-        # channel's lifetime — authorize checks read them on every packet
+        # channel's lifetime — authorize checks read them every packet
         self.auth_attrs.update(
             {k: v for k, v in ci.items() if k not in base_keys}
         )
@@ -241,6 +372,8 @@ class Channel:
             props["Shared-Subscription-Available"] = 1
             props["Wildcard-Subscription-Available"] = 1
             props["Retain-Available"] = int(self.config.caps.retain_available)
+            if extra_props:
+                props.update(extra_props)  # enhanced-auth server-final
         await self.hooks.arun("client.connack", self.client_info(), "success")
         if self._gone(session):
             return  # kicked during the awaited hook (takeover race)

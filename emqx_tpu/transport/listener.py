@@ -26,6 +26,7 @@ class TransportContext:
     olp: object = None  # Olp
     alarms: object = None  # AlarmManager
     make_forced_gc: object = None  # Optional[Callable[[], ForcedGC]]
+    psk: object = None  # PskStore (wired into ssl/wss contexts when set)
 
 
 class AdmissionControl:
@@ -110,6 +111,8 @@ class Listener:
         ctx = None
         if self.config.type == "ssl":
             ctx = build_ssl_context(self.config)
+            if self.ctx is not None and self.ctx.psk is not None:
+                self.ctx.psk.wire_into(ctx)
         self._server = await asyncio.start_server(
             self._on_client, self.config.bind, self.config.port, ssl=ctx
         )

@@ -156,6 +156,8 @@ class WsListener:
         ctx: Optional[ssl_mod.SSLContext] = None
         if self.config.type == "wss":
             ctx = build_ssl_context(self.config)
+            if self.ctx is not None and getattr(self.ctx, "psk", None) is not None:
+                self.ctx.psk.wire_into(ctx)
         # One WS message may legally coalesce several MQTT packets; allow a
         # generous multiple of max_packet_size before the anti-OOM cap bites
         max_size = max(8 * self.channel_config.caps.max_packet_size, 1 << 20)
