@@ -1,0 +1,374 @@
+"""Independent minimal MQTT 3.1.1/5.0 client for conformance testing.
+
+Deliberately does NOT import anything from emqx_tpu: the wire encoder and
+decoder here are written directly from the OASIS MQTT specifications, so a
+codec bug mirrored between the broker and its in-repo client
+(emqx_tpu/mqtt/frame.py) cannot hide from these tests. This fills the role
+of the external emqtt/paho clients in the reference's CI
+(.github/workflows/run_fvt_tests.yaml paho interop suite).
+
+Scope: CONNECT(+will, v5 properties), PUBLISH QoS0-2 both directions,
+SUBSCRIBE/UNSUBSCRIBE with option bits, PING, DISCONNECT, AUTH passthrough.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Dict, List, Optional, Tuple
+
+# property id -> type tag (subset used in tests)
+PROPS = {
+    0x01: "byte",     # Payload-Format-Indicator
+    0x02: "u32",      # Message-Expiry-Interval
+    0x03: "utf8",     # Content-Type
+    0x08: "utf8",     # Response-Topic
+    0x09: "bin",      # Correlation-Data
+    0x0B: "varint",   # Subscription-Identifier
+    0x11: "u32",      # Session-Expiry-Interval
+    0x12: "utf8",     # Assigned-Client-Identifier
+    0x13: "u16",      # Server-Keep-Alive
+    0x15: "utf8",     # Authentication-Method
+    0x16: "bin",      # Authentication-Data
+    0x17: "byte",     # Request-Problem-Information
+    0x19: "byte",     # Request-Response-Information
+    0x1A: "utf8",     # Response-Information
+    0x1C: "utf8",     # Server-Reference
+    0x1F: "utf8",     # Reason-String
+    0x21: "u16",      # Receive-Maximum
+    0x22: "u16",      # Topic-Alias-Maximum
+    0x23: "u16",      # Topic-Alias
+    0x24: "byte",     # Maximum-QoS
+    0x25: "byte",     # Retain-Available
+    0x26: "pair",     # User-Property
+    0x27: "u32",      # Maximum-Packet-Size
+    0x28: "byte",     # Wildcard-Subscription-Available
+    0x29: "byte",     # Subscription-Identifier-Available
+    0x2A: "byte",     # Shared-Subscription-Available
+}
+
+
+def varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def read_varint(b: bytes, i: int) -> Tuple[int, int]:
+    mult, val = 1, 0
+    while True:
+        d = b[i]
+        i += 1
+        val += (d & 0x7F) * mult
+        if not d & 0x80:
+            return val, i
+        mult *= 128
+
+
+def utf8(s: str) -> bytes:
+    e = s.encode()
+    return struct.pack("!H", len(e)) + e
+
+
+def bindata(b: bytes) -> bytes:
+    return struct.pack("!H", len(b)) + b
+
+
+def enc_props(props: Optional[Dict[int, object]]) -> bytes:
+    if not props:
+        return b"\x00"
+    out = bytearray()
+    for pid, val in props.items():
+        t = PROPS[pid]
+        if t == "pair":
+            for k, v in val if isinstance(val, list) else [val]:
+                out.append(pid)
+                out += utf8(k) + utf8(v)
+            continue
+        out.append(pid)
+        if t == "byte":
+            out.append(int(val))
+        elif t == "u16":
+            out += struct.pack("!H", val)
+        elif t == "u32":
+            out += struct.pack("!I", val)
+        elif t == "varint":
+            out += varint(int(val))
+        elif t == "utf8":
+            out += utf8(str(val))
+        elif t == "bin":
+            out += bindata(bytes(val))
+    return varint(len(out)) + bytes(out)
+
+
+def dec_props(b: bytes, i: int) -> Tuple[Dict[int, object], int]:
+    n, i = read_varint(b, i)
+    end = i + n
+    props: Dict[int, object] = {}
+    while i < end:
+        pid = b[i]
+        i += 1
+        t = PROPS.get(pid)
+        if t == "byte":
+            props[pid] = b[i]
+            i += 1
+        elif t == "u16":
+            props[pid] = struct.unpack_from("!H", b, i)[0]
+            i += 2
+        elif t == "u32":
+            props[pid] = struct.unpack_from("!I", b, i)[0]
+            i += 4
+        elif t == "varint":
+            props[pid], i = read_varint(b, i)
+        elif t == "utf8":
+            ln = struct.unpack_from("!H", b, i)[0]
+            props[pid] = b[i + 2 : i + 2 + ln].decode()
+            i += 2 + ln
+        elif t == "bin":
+            ln = struct.unpack_from("!H", b, i)[0]
+            props[pid] = b[i + 2 : i + 2 + ln]
+            i += 2 + ln
+        elif t == "pair":
+            lk = struct.unpack_from("!H", b, i)[0]
+            k = b[i + 2 : i + 2 + lk].decode()
+            i += 2 + lk
+            lv = struct.unpack_from("!H", b, i)[0]
+            v = b[i + 2 : i + 2 + lv].decode()
+            i += 2 + lv
+            props.setdefault(pid, []).append((k, v))
+        else:
+            raise ValueError(f"unknown property id {pid:#x}")
+    return props, i
+
+
+class Packet:
+    def __init__(self, ptype: int, flags: int, body: bytes):
+        self.type = ptype
+        self.flags = flags
+        self.body = body
+
+    def __repr__(self):
+        return f"<mini pkt type={self.type} flags={self.flags:#x} len={len(self.body)}>"
+
+
+class MiniClient:
+    def __init__(self, client_id: str, version: int = 4, clean: bool = True,
+                 keepalive: int = 60, username: Optional[str] = None,
+                 password: Optional[bytes] = None,
+                 will: Optional[Tuple[str, bytes, int, bool]] = None,
+                 props: Optional[Dict[int, object]] = None):
+        self.client_id = client_id
+        self.version = version
+        self.clean = clean
+        self.keepalive = keepalive
+        self.username = username
+        self.password = password
+        self.will = will
+        self.conn_props = props
+        self.messages: asyncio.Queue = asyncio.Queue()  # inbound PUBLISH dicts
+        self.acks: Dict[Tuple[int, int], asyncio.Future] = {}
+        self.connack = None
+        self._pid = 0
+        self._reader_task = None
+        self._inflight_in: Dict[int, dict] = {}  # qos2 inbound
+
+    # -- wire --------------------------------------------------------------
+    def _frame(self, ptype: int, flags: int, body: bytes) -> bytes:
+        return bytes([(ptype << 4) | flags]) + varint(len(body)) + body
+
+    async def _read_packet(self) -> Packet:
+        h = await self.reader.readexactly(1)
+        # remaining length, byte by byte
+        mult, length = 1, 0
+        while True:
+            d = (await self.reader.readexactly(1))[0]
+            length += (d & 0x7F) * mult
+            if not d & 0x80:
+                break
+            mult *= 128
+        body = await self.reader.readexactly(length) if length else b""
+        return Packet(h[0] >> 4, h[0] & 0x0F, body)
+
+    # -- connect -----------------------------------------------------------
+    async def connect(self, host: str, port: int, timeout: float = 10.0):
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        flags = 0x02 if self.clean else 0
+        if self.will:
+            _, _, wqos, wretain = self.will
+            flags |= 0x04 | (wqos << 3) | (0x20 if wretain else 0)
+        if self.username is not None:
+            flags |= 0x80
+        if self.password is not None:
+            flags |= 0x40
+        body = utf8("MQTT") + bytes([self.version, flags]) + struct.pack(
+            "!H", self.keepalive
+        )
+        if self.version == 5:
+            body += enc_props(self.conn_props)
+        body += utf8(self.client_id)
+        if self.will:
+            wt, wp, _, _ = self.will
+            if self.version == 5:
+                body += b"\x00"  # will properties
+            body += utf8(wt) + bindata(wp)
+        if self.username is not None:
+            body += utf8(self.username)
+        if self.password is not None:
+            body += bindata(self.password)
+        self.writer.write(self._frame(1, 0, body))
+        p = await asyncio.wait_for(self._read_packet(), timeout)
+        assert p.type == 2, p
+        session_present = p.body[0] & 1
+        rc = p.body[1]
+        props = {}
+        if self.version == 5 and len(p.body) > 2:
+            props, _ = dec_props(p.body, 2)
+        self.connack = {"session_present": bool(session_present), "rc": rc,
+                        "props": props}
+        if rc == 0:
+            self._reader_task = asyncio.get_running_loop().create_task(
+                self._reader_loop()
+            )
+        return self.connack
+
+    async def _reader_loop(self):
+        try:
+            while True:
+                p = await self._read_packet()
+                await self._dispatch(p)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+
+    async def _dispatch(self, p: Packet):
+        if p.type == 3:  # PUBLISH
+            qos = (p.flags >> 1) & 3
+            i = 0
+            tl = struct.unpack_from("!H", p.body, i)[0]
+            topic = p.body[i + 2 : i + 2 + tl].decode()
+            i += 2 + tl
+            pid = None
+            if qos:
+                pid = struct.unpack_from("!H", p.body, i)[0]
+                i += 2
+            props = {}
+            if self.version == 5:
+                props, i = dec_props(p.body, i)
+            msg = {
+                "topic": topic, "payload": p.body[i:], "qos": qos,
+                "retain": bool(p.flags & 1), "dup": bool(p.flags & 8),
+                "pid": pid, "props": props,
+            }
+            if qos == 0:
+                self.messages.put_nowait(msg)
+            elif qos == 1:
+                self.messages.put_nowait(msg)
+                self.writer.write(self._frame(4, 0, struct.pack("!H", pid)))
+            else:  # qos2: PUBREC, deliver on PUBREL
+                self._inflight_in[pid] = msg
+                self.writer.write(self._frame(5, 0, struct.pack("!H", pid)))
+        elif p.type in (4, 5, 6, 7, 9, 11):  # acks
+            pid = struct.unpack_from("!H", p.body, 0)[0]
+            if p.type == 6:  # PUBREL -> deliver + PUBCOMP
+                msg = self._inflight_in.pop(pid, None)
+                if msg is not None:
+                    self.messages.put_nowait(msg)
+                self.writer.write(self._frame(7, 0, struct.pack("!H", pid)))
+                return
+            fut = self.acks.pop((p.type, pid), None)
+            if fut is not None and not fut.done():
+                fut.set_result(p)
+        elif p.type == 13:  # PINGRESP
+            fut = self.acks.pop((13, 0), None)
+            if fut and not fut.done():
+                fut.set_result(p)
+        elif p.type == 14:  # DISCONNECT (v5 server-initiated)
+            self.messages.put_nowait(
+                {"disconnect": p.body[0] if p.body else 0}
+            )
+
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 65535 + 1
+        return self._pid
+
+    def _wait_ack(self, ptype: int, pid: int) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self.acks[(ptype, pid)] = fut
+        return fut
+
+    # -- ops ---------------------------------------------------------------
+    async def publish(self, topic: str, payload: bytes, qos: int = 0,
+                      retain: bool = False, props: Optional[Dict] = None,
+                      timeout: float = 10.0, topic_bytes: Optional[bytes] = None):
+        flags = (qos << 1) | (1 if retain else 0)
+        body = (
+            struct.pack("!H", len(topic_bytes)) + topic_bytes
+            if topic_bytes is not None
+            else utf8(topic)
+        )
+        pid = None
+        if qos:
+            pid = self._next_pid()
+            body += struct.pack("!H", pid)
+        if self.version == 5:
+            body += enc_props(props)
+        body += payload
+        self.writer.write(self._frame(3, flags, body))
+        if qos == 1:
+            await asyncio.wait_for(self._wait_ack(4, pid), timeout)
+        elif qos == 2:
+            await asyncio.wait_for(self._wait_ack(5, pid), timeout)  # PUBREC
+            self.writer.write(self._frame(6, 0x02, struct.pack("!H", pid)))
+            await asyncio.wait_for(self._wait_ack(7, pid), timeout)  # PUBCOMP
+
+    async def subscribe(self, filters, timeout: float = 10.0) -> List[int]:
+        """filters: [(topic, opts_byte)] -> reason codes"""
+        pid = self._next_pid()
+        body = struct.pack("!H", pid)
+        if self.version == 5:
+            body += b"\x00"
+        for topic, opts in filters:
+            body += utf8(topic) + bytes([opts])
+        self.writer.write(self._frame(8, 0x02, body))
+        p = await asyncio.wait_for(self._wait_ack(9, pid), timeout)
+        i = 2
+        if self.version == 5:
+            _, i = dec_props(p.body, i)
+        return list(p.body[i:])
+
+    async def unsubscribe(self, topics: List[str], timeout: float = 10.0):
+        pid = self._next_pid()
+        body = struct.pack("!H", pid)
+        if self.version == 5:
+            body += b"\x00"
+        for t in topics:
+            body += utf8(t)
+        self.writer.write(self._frame(10, 0x02, body))
+        await asyncio.wait_for(self._wait_ack(11, pid), timeout)
+
+    async def ping(self, timeout: float = 10.0):
+        self.writer.write(self._frame(12, 0, b""))
+        await asyncio.wait_for(self._wait_ack(13, 0), timeout)
+
+    async def recv(self, timeout: float = 10.0) -> dict:
+        return await asyncio.wait_for(self.messages.get(), timeout)
+
+    async def disconnect(self, rc: int = 0):
+        body = b""
+        if self.version == 5:
+            body = bytes([rc]) + b"\x00"
+        self.writer.write(self._frame(14, 0, body))
+        await self.close()
+
+    async def close(self):
+        if self._reader_task:
+            self._reader_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass

@@ -1,0 +1,325 @@
+"""Protocol conformance suite driven by the INDEPENDENT minimal client.
+
+The reference gates releases on external clients (emqtt in
+emqx_mqtt_SUITE, the paho interop suite in CI FVT); `tests/minimqtt.py`
+plays that role here — its codec shares no code with the broker's, so
+these tests catch wire-format bugs the self-client e2e tests cannot.
+
+Coverage mirrors the client-visible emqx_mqtt_SUITE /
+emqx_mqtt_protocol_v5_SUITE surface: connack semantics, QoS 0/1/2 both
+directions, retain, will, session resumption, subscription options,
+wildcard/$-topic rules, topic alias, max packet size, shared subs.
+"""
+
+import asyncio
+import functools
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.retainer import Retainer
+from emqx_tpu.broker.session import SessionConfig
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+
+from tests.minimqtt import MiniClient
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+class Bed:
+    def __init__(self, channel_config=None, retainer=False):
+        self.hooks = Hooks()
+        self.broker = Broker(hooks=self.hooks)
+        self.cm = ChannelManager(self.broker)
+        self.listeners = Listeners(self.broker, self.cm)
+        self.channel_config = channel_config or ChannelConfig(
+            session=SessionConfig(retry_interval=0.5)
+        )
+        if retainer:
+            self.retainer = Retainer()
+            self.retainer.attach(self.hooks)
+
+    async def __aenter__(self):
+        l = await self.listeners.start_listener(
+            ListenerConfig(port=0), self.channel_config
+        )
+        self.port = l.port
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.listeners.stop_all()
+
+    async def client(self, cid, **kw) -> MiniClient:
+        c = MiniClient(cid, **kw)
+        ack = await c.connect("127.0.0.1", self.port)
+        assert ack["rc"] == 0, ack
+        return c
+
+
+@async_test
+async def test_v4_basic_pubsub_all_qos():
+    async with Bed() as bed:
+        sub = await bed.client("c-sub")
+        pub = await bed.client("c-pub")
+        rcs = await sub.subscribe([("t/q0", 0), ("t/q1", 1), ("t/q2", 2)])
+        assert rcs == [0, 1, 2]
+        await pub.publish("t/q0", b"m0", qos=0)
+        await pub.publish("t/q1", b"m1", qos=1)
+        await pub.publish("t/q2", b"m2", qos=2)
+        got = {}
+        for _ in range(3):
+            m = await sub.recv()
+            got[m["topic"]] = m
+        assert got["t/q0"]["payload"] == b"m0" and got["t/q0"]["qos"] == 0
+        assert got["t/q1"]["payload"] == b"m1" and got["t/q1"]["qos"] == 1
+        assert got["t/q2"]["payload"] == b"m2" and got["t/q2"]["qos"] == 2
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_subscription_qos_caps_delivery():
+    async with Bed() as bed:
+        sub = await bed.client("cap-sub")
+        pub = await bed.client("cap-pub")
+        await sub.subscribe([("cap/#", 1)])  # max granted qos 1
+        await pub.publish("cap/x", b"m", qos=2)
+        m = await sub.recv()
+        assert m["qos"] == 1  # min(pub qos, sub qos)
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_wildcards_and_dollar_topics():
+    async with Bed() as bed:
+        sub = await bed.client("w-sub")
+        pub = await bed.client("w-pub")
+        await sub.subscribe([("+/one/#", 0), ("#", 0)])
+        await pub.publish("a/one/b", b"x", qos=0)
+        m = await sub.recv()
+        m2 = await sub.recv()
+        assert {m["topic"], m2["topic"]} == {"a/one/b"}  # both subs matched
+        # $-prefixed topics must not match root wildcards
+        await pub.publish("$internal/x", b"no", qos=0)
+        await pub.publish("plain", b"yes", qos=0)
+        m = await sub.recv()
+        assert m["topic"] == "plain"
+        assert sub.messages.empty()
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_retain_store_and_clear():
+    async with Bed(retainer=True) as bed:
+        pub = await bed.client("r-pub")
+        await pub.publish("r/state", b"v1", qos=0, retain=True)
+        sub = await bed.client("r-sub")
+        await sub.subscribe([("r/#", 0)])
+        m = await sub.recv()
+        assert m["topic"] == "r/state" and m["payload"] == b"v1"
+        assert m["retain"] is True
+        # empty retained payload clears
+        await pub.publish("r/state", b"", qos=0, retain=True)
+        sub2 = await bed.client("r-sub2")
+        await sub2.subscribe([("r/#", 0)])
+        await asyncio.sleep(0.2)
+        assert sub2.messages.empty()
+        for c in (pub, sub, sub2):
+            await c.disconnect()
+
+
+@async_test
+async def test_will_message_on_abnormal_disconnect():
+    async with Bed() as bed:
+        watcher = await bed.client("will-watch")
+        await watcher.subscribe([("will/#", 0)])
+        dying = MiniClient("will-die", will=("will/t", b"gone", 0, False))
+        await dying.connect("127.0.0.1", bed.port)
+        # abnormal close (no DISCONNECT)
+        dying.writer.close()
+        m = await watcher.recv()
+        assert m["topic"] == "will/t" and m["payload"] == b"gone"
+        await watcher.disconnect()
+
+
+@async_test
+async def test_session_resumption_v4():
+    async with Bed() as bed:
+        c1 = MiniClient("persist", clean=False)
+        await c1.connect("127.0.0.1", bed.port)
+        assert c1.connack["session_present"] is False
+        await c1.subscribe([("p/#", 1)])
+        await c1.close()  # drop without DISCONNECT; session survives
+        await asyncio.sleep(0.1)
+        pub = await bed.client("p-pub")
+        await pub.publish("p/x", b"queued", qos=1)
+        c2 = MiniClient("persist", clean=False)
+        await c2.connect("127.0.0.1", bed.port)
+        assert c2.connack["session_present"] is True
+        m = await c2.recv()
+        assert m["topic"] == "p/x" and m["payload"] == b"queued"
+        # clean reconnect wipes it
+        c3 = MiniClient("persist", clean=True)
+        await c3.connect("127.0.0.1", bed.port)
+        assert c3.connack["session_present"] is False
+        for c in (pub, c2, c3):
+            await c.close()
+
+
+@async_test
+async def test_duplicate_clientid_takeover():
+    async with Bed() as bed:
+        c1 = await bed.client("dup-id")
+        c2 = await bed.client("dup-id")
+        await c2.ping()
+        # c1 must be dead (second connect kicked it)
+        c1.writer.write(b"\xc0\x00")  # PINGREQ on a dead socket
+        await asyncio.sleep(0.2)
+        assert c1.reader.at_eof() or c1.writer.is_closing()
+        await c2.disconnect()
+
+
+@async_test
+async def test_v5_properties_roundtrip():
+    async with Bed() as bed:
+        sub = await bed.client("v5-sub", version=5)
+        pub = await bed.client("v5-pub", version=5)
+        ack = sub.connack
+        # CONNACK advertises capabilities (v5)
+        assert ack["props"].get(0x2A) == 1  # shared subs available
+        assert ack["props"].get(0x28) == 1  # wildcard available
+        await sub.subscribe([("v5/#", 1)])
+        await pub.publish(
+            "v5/m",
+            b"body",
+            qos=1,
+            props={
+                0x03: "application/json",        # content type
+                0x08: "reply/here",              # response topic
+                0x09: b"corr-1",                 # correlation data
+                0x26: [("k1", "v1")],            # user property
+            },
+        )
+        m = await sub.recv()
+        assert m["props"][0x03] == "application/json"
+        assert m["props"][0x08] == "reply/here"
+        assert m["props"][0x09] == b"corr-1"
+        assert ("k1", "v1") in m["props"][0x26]
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_v5_topic_alias():
+    async with Bed() as bed:
+        sub = await bed.client("al-sub", version=5)
+        pub = await bed.client("al-pub", version=5)
+        await sub.subscribe([("al/#", 0)])
+        await pub.publish("al/t", b"one", qos=0, props={0x23: 3})
+        # empty topic + alias resolves to the registered topic
+        await pub.publish("", b"two", qos=0, props={0x23: 3}, topic_bytes=b"")
+        m1 = await sub.recv()
+        m2 = await sub.recv()
+        assert m1["topic"] == m2["topic"] == "al/t"
+        assert {m1["payload"], m2["payload"]} == {b"one", b"two"}
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_v5_assigned_clientid_and_expiry():
+    async with Bed() as bed:
+        c = MiniClient("", version=5)
+        await c.connect("127.0.0.1", bed.port)
+        assert c.connack["props"].get(0x12, "").startswith("emqx_tpu_")
+        await c.disconnect()
+
+
+@async_test
+async def test_shared_subscriptions_balance():
+    async with Bed() as bed:
+        a = await bed.client("sh-a")
+        b = await bed.client("sh-b")
+        pub = await bed.client("sh-pub")
+        await a.subscribe([("$share/g1/job/#", 0)])
+        await b.subscribe([("$share/g1/job/#", 0)])
+        for i in range(10):
+            await pub.publish(f"job/{i}", str(i).encode(), qos=0)
+        await asyncio.sleep(0.3)
+        na, nb = a.messages.qsize(), b.messages.qsize()
+        assert na + nb == 10  # each message to exactly ONE group member
+        assert na > 0 and nb > 0  # and the load actually spreads
+        for c in (a, b, pub):
+            await c.disconnect()
+
+
+@async_test
+async def test_unsubscribe_and_overlap():
+    async with Bed() as bed:
+        sub = await bed.client("u-sub")
+        pub = await bed.client("u-pub")
+        await sub.subscribe([("o/a", 0), ("o/+", 0)])
+        await pub.publish("o/a", b"x", qos=0)
+        # both overlapping subscriptions deliver (non-v5 default)
+        m1, m2 = await sub.recv(), await sub.recv()
+        assert m1["topic"] == m2["topic"] == "o/a"
+        await sub.unsubscribe(["o/+"])
+        await pub.publish("o/a", b"y", qos=0)
+        m = await sub.recv()
+        assert m["payload"] == b"y"
+        assert sub.messages.empty()
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_large_payload_and_deep_topic():
+    async with Bed() as bed:
+        sub = await bed.client("big-sub")
+        pub = await bed.client("big-pub")
+        deep = "/".join(f"s{i}" for i in range(40))  # beyond device budget
+        await sub.subscribe([(deep, 0), ("big/t", 0)])
+        payload = bytes(range(256)) * 512  # 128 KiB
+        await pub.publish("big/t", payload, qos=0)
+        m = await sub.recv()
+        assert m["payload"] == payload
+        await pub.publish(deep, b"deep", qos=0)
+        m = await sub.recv()
+        assert m["topic"] == deep
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_qos2_exactly_once_inbound():
+    async with Bed() as bed:
+        sub = await bed.client("e-sub")
+        pub = await bed.client("e-pub")
+        await sub.subscribe([("e/t", 2)])
+        await pub.publish("e/t", b"once", qos=2)
+        m = await sub.recv()
+        assert m["qos"] == 2 and m["payload"] == b"once"
+        await asyncio.sleep(0.2)
+        assert sub.messages.empty()  # exactly once
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+@async_test
+async def test_ping_keepalive():
+    async with Bed() as bed:
+        c = await bed.client("ping-c", keepalive=2)
+        for _ in range(3):
+            await c.ping()
+        await c.disconnect()
