@@ -136,6 +136,15 @@ class LimiterServer:
                            "client": {"rate": r, "burst": b}}}"""
         self._roots: Dict[str, TokenBucket] = {}
         self._client_cfg: Dict[str, BucketConfig] = {}
+        self.reconfigure(config)
+
+    def reconfigure(self, config: Optional[Dict[str, Dict]]) -> None:
+        """Rebuild buckets from a new config (runtime update path,
+        emqx_config_handler -> limiter). Existing LimiterClients keep
+        their old shared roots until reconnect; new connections pick up
+        the new rates immediately."""
+        roots: Dict[str, TokenBucket] = {}
+        client_cfgs: Dict[str, BucketConfig] = {}
         for type_, spec in (config or {}).items():
             if type_ not in TYPES:
                 raise ValueError(f"unknown limiter type {type_!r}")
@@ -144,14 +153,16 @@ class LimiterServer:
                 burst=float(spec.get("burst", 0) or 0),
             )
             if not root.unlimited:
-                self._roots[type_] = TokenBucket(root.rate, root.capacity)
+                roots[type_] = TokenBucket(root.rate, root.capacity)
             client = spec.get("client") or {}
             ccfg = BucketConfig(
                 rate=float(client.get("rate", 0) or 0),
                 burst=float(client.get("burst", 0) or 0),
             )
             if not ccfg.unlimited:
-                self._client_cfg[type_] = ccfg
+                client_cfgs[type_] = ccfg
+        self._roots = roots
+        self._client_cfg = client_cfgs
 
     def limited(self, type_: str) -> bool:
         return type_ in self._roots or type_ in self._client_cfg

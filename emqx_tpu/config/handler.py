@@ -1,0 +1,121 @@
+"""Runtime config-update pipeline.
+
+Parity: apps/emqx/src/emqx_config_handler.erl + emqx_conf's cluster-wide
+update flow — an update targets a dotted subtree path, is validated by
+re-coercing the FULL config through the typed schema (a bad value rejects
+the update before any side effect), then the most-specific registered
+subtree handler applies the side effects (rebuild limiter buckets, swap
+ACL rules, patch live caps). A handler raising rolls the stored config
+back.
+
+Cluster-wide propagation rides the replicated config txn log
+(cluster/cluster_rpc.py, the emqx_cluster_rpc analog): `update` appends a
+``config_update`` op when a log is attached; every node's handler applies
+the same entry through `apply_entry`.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from emqx_tpu.config.schema import AppConfig, ConfigError, load_config, to_dict
+
+log = logging.getLogger("emqx_tpu.config")
+
+# handler: (new_config: AppConfig) -> None; raising rolls back
+Handler = Callable[[AppConfig], None]
+
+OP_CONFIG_UPDATE = "config_update"
+
+
+class ConfigHandler:
+    def __init__(self, get_config, set_config, conf_log=None):
+        """get_config/set_config: accessors for the owning app's AppConfig.
+        conf_log: optional ClusterRpcLog for cluster-wide updates."""
+        self._get = get_config
+        self._set = set_config
+        self._handlers: List[Tuple[str, Handler]] = []
+        self.conf_log = conf_log
+        if conf_log is not None:
+            conf_log.register_handler(
+                OP_CONFIG_UPDATE, lambda path, value: self.apply_local(path, value)
+            )
+
+    def register(self, path: str, handler: Handler) -> None:
+        """Register a side-effect handler for a config subtree
+        (emqx_config_handler:add_handler)."""
+        self._handlers.append((path, handler))
+        # most specific prefix wins
+        self._handlers.sort(key=lambda e: -len(e[0]))
+
+    # -- update pipeline ---------------------------------------------------
+    def update(self, path: str, value) -> Dict:
+        """Validate + apply + (if clustered) replicate one subtree update.
+        Returns the new subtree as a plain dict."""
+        if self.conf_log is not None:
+            # validate BEFORE the entry enters the replicated log — an
+            # invalid update must never be committed cluster-wide
+            self._merged_config(path, value)
+            entry = self.conf_log.append(OP_CONFIG_UPDATE, (path, value))
+            self.conf_log.apply_pending()
+            if entry[0] in self.conf_log._skipped:
+                raise RuntimeError(
+                    f"config update {path} failed to apply on this node"
+                )
+        else:
+            self.apply_local(path, value)
+        return self.get_subtree(path)
+
+    def _merged_config(self, path: str, value) -> AppConfig:
+        """Merge `value` at `path` over the current config and run it
+        through full schema validation; raises ConfigError on any problem."""
+        data = to_dict(self._get())
+        node = data
+        segs = path.split(".") if path else []
+        if not segs:
+            raise ConfigError("empty config path")
+        for s in segs[:-1]:
+            if not isinstance(node.get(s), dict):
+                raise ConfigError(f"no such config subtree: {path}")
+            node = node[s]
+        leaf = segs[-1]
+        if leaf not in node:
+            raise ConfigError(f"no such config key: {path}")
+        if isinstance(node[leaf], dict) and isinstance(value, dict):
+            node[leaf] = _deep_merge(node[leaf], value)
+        else:
+            node[leaf] = value
+        return load_config(data)
+
+    def apply_local(self, path: str, value) -> None:
+        """The per-node half: validate, store, run side-effect handlers,
+        roll back on failure."""
+        old_cfg = self._get()
+        new_cfg = self._merged_config(path, value)  # full-schema validation
+        self._set(new_cfg)
+        try:
+            for prefix, handler in self._handlers:
+                if path == prefix or path.startswith(prefix + "."):
+                    handler(new_cfg)
+                    break
+        except Exception:
+            self._set(old_cfg)
+            raise
+
+    def get_subtree(self, path: str) -> Dict:
+        data = to_dict(self._get())
+        for s in path.split("."):
+            data = data[s]
+        return data
+
+
+def _deep_merge(base: Dict, over: Dict) -> Dict:
+    out = copy.deepcopy(base)
+    for k, v in over.items():
+        if isinstance(out.get(k), dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

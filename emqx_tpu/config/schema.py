@@ -236,6 +236,23 @@ class EventMessageConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Opt-in anonymized usage reporting (emqx_telemetry analog)."""
+
+    enable: bool = False
+    url: str = ""
+    interval: float = 604800.0  # weekly
+
+
+@dataclass
+class PluginsConfig:
+    """Runtime-installable plugins (emqx_plugins analog)."""
+
+    install_dir: str = "plugins"
+    start: List[str] = field(default_factory=list)  # name-version refs
+
+
+@dataclass
 class ObserveConfig:
     slow_subs: SlowSubsConfig = field(default_factory=SlowSubsConfig)
     statsd: StatsdConfig = field(default_factory=StatsdConfig)
@@ -243,6 +260,7 @@ class ObserveConfig:
         default_factory=EventMessageConfig
     )
     trace_dir: str = "trace"
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     alarm_size_limit: int = 1000
     alarm_validity_period: float = 24 * 3600.0
     os_mon_enable: bool = True
@@ -324,6 +342,7 @@ class AppConfig:
     gateways: List[GatewaySpec] = field(default_factory=list)
     bridges: List[BridgeSpec] = field(default_factory=list)
     psk: PskConfig = field(default_factory=PskConfig)
+    plugins: PluginsConfig = field(default_factory=PluginsConfig)
 
 
 class ConfigError(ValueError):
@@ -354,9 +373,15 @@ def _coerce(tp, value, path):
             return value.lower() in ("1", "true", "yes", "on")
         return bool(value)
     if tp is int:
-        return int(value)
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            raise ConfigError(f"{path}: expected integer, got {value!r}")
     if tp is float:
-        return float(value)
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            raise ConfigError(f"{path}: expected number, got {value!r}")
     if tp is str:
         return str(value)
     return value

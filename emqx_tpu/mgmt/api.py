@@ -35,6 +35,62 @@ from emqx_tpu.config.schema import to_dict
 from emqx_tpu.utils.node import node_name
 
 
+# the single route table: registration AND the OpenAPI document derive
+# from it (emqx_dashboard_swagger generates both from one schema source)
+ROUTES = [
+    ("get", "/api/v5/status", "status", "Node and broker liveness", "node"),
+    ("get", "/api/v5/metrics", "metrics", "Counter metrics", "metrics"),
+    ("get", "/api/v5/stats", "stats", "Gauge statistics", "metrics"),
+    ("get", "/api/v5/clients", "clients", "List connected clients", "clients"),
+    ("get", "/api/v5/clients/{clientid}", "client_one", "One client", "clients"),
+    ("delete", "/api/v5/clients/{clientid}", "client_kick", "Kick a client", "clients"),
+    ("get", "/api/v5/subscriptions", "subscriptions", "List subscriptions", "subscriptions"),
+    ("get", "/api/v5/routes", "routes", "Route table topics", "routes"),
+    ("post", "/api/v5/publish", "publish", "Publish a message", "publish"),
+    ("get", "/api/v5/banned", "banned_list", "List bans", "banned"),
+    ("post", "/api/v5/banned", "banned_add", "Add a ban", "banned"),
+    ("delete", "/api/v5/banned/{kind}/{value}", "banned_del", "Remove a ban", "banned"),
+    ("get", "/api/v5/retainer/messages", "retained_list", "List retained messages", "retainer"),
+    ("delete", "/api/v5/retainer/message/{topic:.+}", "retained_del", "Delete retained message", "retainer"),
+    ("get", "/api/v5/configs", "configs", "Full running config", "configs"),
+    ("put", "/api/v5/configs/{path:.+}", "configs_update", "Update a config subtree at runtime", "configs"),
+    ("get", "/api/v5/rules", "rules_list", "List rules", "rules"),
+    ("post", "/api/v5/rules", "rules_create", "Create a rule", "rules"),
+    ("get", "/api/v5/rules/{id}", "rules_one", "One rule", "rules"),
+    ("delete", "/api/v5/rules/{id}", "rules_delete", "Delete a rule", "rules"),
+    ("post", "/api/v5/rule_test", "rule_test", "Test a rule SQL", "rules"),
+    ("get", "/api/v5/alarms", "alarms_list", "List alarms", "alarms"),
+    ("delete", "/api/v5/alarms", "alarms_clear", "Clear deactivated alarms", "alarms"),
+    ("get", "/api/v5/slow_subscriptions", "slow_subs_list", "Slow consumers top-k", "slow_subs"),
+    ("delete", "/api/v5/slow_subscriptions", "slow_subs_clear", "Clear slow-subs records", "slow_subs"),
+    ("get", "/api/v5/mqtt/topic_metrics", "topic_metrics_list", "Per-topic metrics", "topic_metrics"),
+    ("post", "/api/v5/mqtt/topic_metrics", "topic_metrics_add", "Track a topic", "topic_metrics"),
+    ("delete", "/api/v5/mqtt/topic_metrics/{topic:.+}", "topic_metrics_del", "Untrack a topic", "topic_metrics"),
+    ("get", "/api/v5/prometheus/stats", "prometheus_stats", "Prometheus exposition", "metrics"),
+    ("get", "/api/v5/trace", "trace_list", "List packet traces", "trace"),
+    ("post", "/api/v5/trace", "trace_create", "Create a packet trace", "trace"),
+    ("delete", "/api/v5/trace/{name}", "trace_delete", "Delete a trace", "trace"),
+    ("put", "/api/v5/trace/{name}/stop", "trace_stop", "Stop a trace", "trace"),
+    ("get", "/api/v5/trace/{name}/download", "trace_download", "Download trace log", "trace"),
+    ("get", "/api/v5/exhooks", "exhooks_list", "List exhook servers", "exhook"),
+    ("get", "/api/v5/gateways", "gateways_list", "List gateways", "gateways"),
+    ("get", "/api/v5/gateways/{name}", "gateways_one", "One gateway", "gateways"),
+    ("post", "/api/v5/gateways", "gateways_load", "Load a gateway", "gateways"),
+    ("delete", "/api/v5/gateways/{name}", "gateways_unload", "Unload a gateway", "gateways"),
+    ("get", "/api/v5/bridges", "bridges_list", "List bridges", "bridges"),
+    ("post", "/api/v5/bridges", "bridges_create", "Create a bridge", "bridges"),
+    ("delete", "/api/v5/bridges/{id}", "bridges_delete", "Delete a bridge", "bridges"),
+    ("post", "/api/v5/bridges/{id}/restart", "bridges_restart", "Restart a bridge", "bridges"),
+    ("get", "/api/v5/plugins", "plugins_list", "List plugins", "plugins"),
+    ("post", "/api/v5/plugins/install", "plugins_install", "Install a plugin package", "plugins"),
+    ("put", "/api/v5/plugins/{ref}/start", "plugins_start", "Start a plugin", "plugins"),
+    ("put", "/api/v5/plugins/{ref}/stop", "plugins_stop", "Stop a plugin", "plugins"),
+    ("delete", "/api/v5/plugins/{ref}", "plugins_delete", "Uninstall a plugin", "plugins"),
+    ("get", "/api/v5/telemetry/data", "telemetry_data", "Inspect the telemetry report", "telemetry"),
+    ("get", "/api-docs", "api_docs", "This OpenAPI document", "meta"),
+]
+
+
 class MgmtApi:
     def __init__(self, app):
         self.app = app
@@ -46,55 +102,8 @@ class MgmtApi:
         w = web.Application(middlewares=[self._auth_middleware])
         w.add_routes(
             [
-                web.get("/api/v5/status", self.status),
-                web.get("/api/v5/metrics", self.metrics),
-                web.get("/api/v5/stats", self.stats),
-                web.get("/api/v5/clients", self.clients),
-                web.get("/api/v5/clients/{clientid}", self.client_one),
-                web.delete("/api/v5/clients/{clientid}", self.client_kick),
-                web.get("/api/v5/subscriptions", self.subscriptions),
-                web.get("/api/v5/routes", self.routes),
-                web.post("/api/v5/publish", self.publish),
-                web.get("/api/v5/banned", self.banned_list),
-                web.post("/api/v5/banned", self.banned_add),
-                web.delete("/api/v5/banned/{kind}/{value}", self.banned_del),
-                web.get("/api/v5/retainer/messages", self.retained_list),
-                web.delete(
-                    "/api/v5/retainer/message/{topic:.+}", self.retained_del
-                ),
-                web.get("/api/v5/configs", self.configs),
-                web.get("/api/v5/rules", self.rules_list),
-                web.post("/api/v5/rules", self.rules_create),
-                web.get("/api/v5/rules/{id}", self.rules_one),
-                web.delete("/api/v5/rules/{id}", self.rules_delete),
-                web.post("/api/v5/rule_test", self.rule_test),
-                web.get("/api/v5/alarms", self.alarms_list),
-                web.delete("/api/v5/alarms", self.alarms_clear),
-                web.get("/api/v5/slow_subscriptions", self.slow_subs_list),
-                web.delete("/api/v5/slow_subscriptions", self.slow_subs_clear),
-                web.get("/api/v5/mqtt/topic_metrics", self.topic_metrics_list),
-                web.post("/api/v5/mqtt/topic_metrics", self.topic_metrics_add),
-                web.delete(
-                    "/api/v5/mqtt/topic_metrics/{topic:.+}",
-                    self.topic_metrics_del,
-                ),
-                web.get("/api/v5/prometheus/stats", self.prometheus_stats),
-                web.get("/api/v5/trace", self.trace_list),
-                web.post("/api/v5/trace", self.trace_create),
-                web.delete("/api/v5/trace/{name}", self.trace_delete),
-                web.put("/api/v5/trace/{name}/stop", self.trace_stop),
-                web.get("/api/v5/trace/{name}/download", self.trace_download),
-                web.get("/api/v5/exhooks", self.exhooks_list),
-                web.get("/api/v5/gateways", self.gateways_list),
-                web.get("/api/v5/gateways/{name}", self.gateways_one),
-                web.post("/api/v5/gateways", self.gateways_load),
-                web.delete("/api/v5/gateways/{name}", self.gateways_unload),
-                web.get("/api/v5/bridges", self.bridges_list),
-                web.post("/api/v5/bridges", self.bridges_create),
-                web.delete("/api/v5/bridges/{id}", self.bridges_delete),
-                web.post(
-                    "/api/v5/bridges/{id}/restart", self.bridges_restart
-                ),
+                getattr(web, method)(path, getattr(self, handler))
+                for method, path, handler, _summary, _tag in ROUTES
             ]
         )
         self._webapp = w
@@ -497,6 +506,99 @@ class MgmtApi:
     async def exhooks_list(self, request):
         ex = getattr(self.app, "exhook", None)
         return web.json_response({"data": ex.info() if ex else []})
+
+    async def configs_update(self, request):
+        """PUT /configs/{path}: runtime config update through the
+        validated handler pipeline (emqx_config_handler + PUT /configs)."""
+        from emqx_tpu.config.schema import ConfigError
+
+        path = request.match_info["path"].replace("/", ".")
+        try:
+            value = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": "invalid JSON"}, status=400
+            )
+        try:
+            new_subtree = self.app.config_handler.update(path, value)
+        except ConfigError as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        except Exception as e:
+            return web.json_response(
+                {"code": "UPDATE_FAILED", "message": str(e)}, status=500
+            )
+        return web.json_response(new_subtree)
+
+    # -- plugins / telemetry (emqx_plugins + emqx_telemetry analogs) -------
+    async def plugins_list(self, request):
+        pm = self.app._plugin_manager()
+        return web.json_response({"data": pm.list()})
+
+    async def plugins_install(self, request):
+        from emqx_tpu.plugins import PluginError
+
+        body = await request.json()
+        try:
+            p = self.app._plugin_manager().install(body["path"])
+        except (KeyError, PluginError, OSError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(
+            {"name": p.name, "version": p.version}, status=201
+        )
+
+    async def plugins_start(self, request):
+        from emqx_tpu.plugins import PluginError
+
+        try:
+            self.app._plugin_manager().start(request.match_info["ref"])
+        except PluginError as e:
+            return web.json_response(
+                {"code": "NOT_FOUND", "message": str(e)}, status=404
+            )
+        return web.json_response({"status": "running"})
+
+    async def plugins_stop(self, request):
+        from emqx_tpu.plugins import PluginError
+
+        try:
+            self.app._plugin_manager().stop(request.match_info["ref"])
+        except PluginError as e:
+            return web.json_response(
+                {"code": "NOT_FOUND", "message": str(e)}, status=404
+            )
+        return web.json_response({"status": "stopped"})
+
+    async def plugins_delete(self, request):
+        from emqx_tpu.plugins import PluginError
+
+        try:
+            self.app._plugin_manager().uninstall(request.match_info["ref"])
+        except PluginError as e:
+            return web.json_response(
+                {"code": "NOT_FOUND", "message": str(e)}, status=404
+            )
+        return web.json_response({}, status=204)
+
+    async def telemetry_data(self, request):
+        t = self.app.telemetry
+        if t is None:
+            from emqx_tpu.observe.telemetry import Telemetry
+
+            t = self.app.telemetry = Telemetry(self.app)
+        return web.json_response(t.get_telemetry_data())
+
+    async def api_docs(self, request):
+        from emqx_tpu import __version__
+        from emqx_tpu.mgmt.openapi import build_spec
+
+        spec = build_spec(
+            [(m, p, s, t) for m, p, _h, s, t in ROUTES], __version__
+        )
+        return web.json_response(spec)
 
     # -- gateways (emqx_mgmt_api_gateway analog) ---------------------------
     def _gw_registry(self):

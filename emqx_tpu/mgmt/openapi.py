@@ -1,0 +1,116 @@
+"""OpenAPI 3 spec generated from the typed config schema + route table.
+
+Parity: apps/emqx_dashboard/src/emqx_dashboard_swagger.erl — the reference
+derives its OpenAPI document from the same HOCON schemas that validate
+config; here the single source of truth is the AppConfig dataclass tree
+(config/schema.py): every dataclass becomes a component schema via
+reflection, so REST docs can never drift from what `load_config` accepts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Dict, get_args, get_origin
+
+
+def _type_schema(tp, components: Dict) -> Dict:
+    origin = get_origin(tp)
+    if dataclasses.is_dataclass(tp):
+        name = tp.__name__
+        if name not in components:
+            components[name] = None  # cycle guard
+            components[name] = dataclass_schema(tp, components)
+        return {"$ref": f"#/components/schemas/{name}"}
+    if origin is list:
+        (item,) = get_args(tp)
+        return {"type": "array", "items": _type_schema(item, components)}
+    if origin is dict:
+        return {"type": "object", "additionalProperties": True}
+    if origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            inner = _type_schema(args[0], components)
+            return {**inner, "nullable": True}
+        return {"anyOf": [_type_schema(a, components) for a in args]}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    if tp is str:
+        return {"type": "string"}
+    return {}
+
+
+def dataclass_schema(cls, components: Dict) -> Dict:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        sch = _type_schema(hints[f.name], components)
+        if f.default is not dataclasses.MISSING:
+            sch = {**sch, "default": f.default}
+        props[f.name] = sch
+    out = {"type": "object", "properties": props}
+    if cls.__doc__:
+        out["description"] = " ".join(cls.__doc__.split())
+    return out
+
+
+def build_spec(route_specs, version: str) -> Dict:
+    """route_specs: [(method, path, summary, tag)]"""
+    from emqx_tpu.config.schema import AppConfig
+
+    components: Dict[str, Dict] = {}
+    _type_schema(AppConfig, components)
+
+    paths: Dict[str, Dict] = {}
+    for method, path, summary, tag in route_specs:
+        # aiohttp {param} and {param:regex} -> openapi {param}
+        norm = []
+        for seg in path.split("/"):
+            if seg.startswith("{") and ":" in seg:
+                seg = seg.split(":", 1)[0] + "}"
+            norm.append(seg)
+        path = "/".join(norm)
+        op = {
+            "summary": summary,
+            "tags": [tag],
+            "responses": {"200": {"description": "success"}},
+        }
+        params = [
+            seg[1:-1]
+            for seg in path.split("/")
+            if seg.startswith("{") and seg.endswith("}")
+        ]
+        if params:
+            op["parameters"] = [
+                {
+                    "name": p,
+                    "in": "path",
+                    "required": True,
+                    "schema": {"type": "string"},
+                }
+                for p in params
+            ]
+        if method in ("post", "put"):
+            op["requestBody"] = {
+                "content": {"application/json": {"schema": {"type": "object"}}}
+            }
+        paths.setdefault(path, {})[method] = op
+
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "emqx_tpu management API",
+            "version": version,
+            "description": (
+                "REST management surface; config component schemas are "
+                "generated from the same typed schema that validates "
+                "broker configuration."
+            ),
+        },
+        "paths": paths,
+        "components": {"schemas": components},
+    }

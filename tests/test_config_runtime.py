@@ -1,0 +1,149 @@
+"""Runtime config updates + OpenAPI generation.
+
+Parity targets: emqx_config_handler (validated subtree updates with
+side-effect handlers + rollback), emqx_cluster_rpc (cluster-wide config
+txns), emqx_dashboard_swagger (OpenAPI from the config schema).
+"""
+
+import asyncio
+import functools
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.cluster.cluster_rpc import ClusterRpcLog
+from emqx_tpu.config.handler import ConfigHandler
+from emqx_tpu.config.schema import AppConfig, ConfigError, load_config
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+def _app_config(**over):
+    return load_config(
+        {
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"port": 0, "bind": "127.0.0.1"},
+            "router": {"enable_tpu": False},
+            **over,
+        }
+    )
+
+
+def test_handler_validate_apply_rollback():
+    app = BrokerApp(_app_config())
+    h = app.config_handler
+
+    # live caps patch: the SHARED caps object every channel reads
+    assert app.channel_config.caps.max_qos_allowed == 2
+    h.update("mqtt", {"max_qos_allowed": 1})
+    assert app.channel_config.caps.max_qos_allowed == 1
+    assert app.config.mqtt.max_qos_allowed == 1
+
+    # schema validation rejects garbage BEFORE any side effect
+    with pytest.raises(ConfigError):
+        h.update("mqtt", {"max_qos_allowed": "not-a-number"})
+    assert app.channel_config.caps.max_qos_allowed == 1
+    with pytest.raises(ConfigError):
+        h.update("nonexistent.subtree", 1)
+
+    # handler failure rolls the stored config back
+    def boom(cfg):
+        raise RuntimeError("apply failed")
+
+    h.register("sys", boom)
+    with pytest.raises(RuntimeError):
+        h.update("sys", {"sys_msg_interval": 5.0})
+    assert app.config.sys.sys_msg_interval != 5.0
+
+    # limiter rebuild without restart
+    h.update(
+        "limiter", {"message_in": {"rate": 100.0, "burst": 10.0}}
+    )
+    assert app.limiters.limited("message_in")
+    h.update("limiter", {"message_in": {"rate": 0, "burst": 0}})
+    assert not app.limiters.limited("message_in")
+
+    # authz rules swap (cache invalidated)
+    h.update(
+        "authz",
+        {"rules": [{"permit": "deny", "who": "all", "action": "publish",
+                    "topics": ["locked/#"]}]},
+    )
+    assert app.authz.check({"client_id": "c"}, "publish", "locked/x") == "deny"
+
+
+def test_cluster_wide_config_update():
+    """Two nodes' handlers converge through the replicated txn log."""
+    app1 = BrokerApp(_app_config())
+    app2 = BrokerApp(_app_config())
+    log1 = ClusterRpcLog("n1")
+    log2 = ClusterRpcLog("n2")
+    h1 = app1._make_config_handler(conf_log=log1)
+    h2 = app2._make_config_handler(conf_log=log2)
+
+    h1.update("mqtt", {"max_topic_levels": 9})
+    assert app1.config.mqtt.max_topic_levels == 9
+    # replicate the entry (the cluster layer's multicall does this wiring)
+    for e in log1._log:
+        log2.receive(e)
+    assert log2.apply_pending() == 1
+    assert app2.config.mqtt.max_topic_levels == 9
+    assert app2.channel_config.caps.max_topic_levels == 9
+
+
+@async_test
+async def test_rest_config_update_and_api_docs():
+    import aiohttp
+
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}"
+        async with aiohttp.ClientSession() as s:
+            # runtime update over REST
+            async with s.put(
+                f"{api}/api/v5/configs/mqtt", json={"max_qos_allowed": 1}
+            ) as r:
+                assert r.status == 200
+                assert (await r.json())["max_qos_allowed"] == 1
+            assert app.channel_config.caps.max_qos_allowed == 1
+            async with s.get(f"{api}/api/v5/configs") as r:
+                assert (await r.json())["mqtt"]["max_qos_allowed"] == 1
+            # invalid update -> 400, nothing changed
+            async with s.put(
+                f"{api}/api/v5/configs/mqtt", json={"max_qos_allowed": "x"}
+            ) as r:
+                assert r.status == 400
+            # dotted path via URL segments
+            async with s.put(
+                f"{api}/api/v5/configs/flapping/max_count", json=99
+            ) as r:
+                assert r.status == 200
+            assert app.config.flapping.max_count == 99
+            assert app.flapping.max_count == 99
+
+            # OpenAPI document
+            async with s.get(f"{api}/api-docs") as r:
+                assert r.status == 200
+                spec = await r.json()
+            assert spec["openapi"].startswith("3.")
+            assert "/api/v5/configs/{path}" in spec["paths"]
+            assert "/api/v5/bridges/{id}/restart" in spec["paths"]
+            schemas = spec["components"]["schemas"]
+            assert "AppConfig" in schemas
+            # schema components reflect the real dataclass fields
+            assert "max_qos_allowed" in schemas["MqttCaps"]["properties"]
+            assert (
+                schemas["AppConfig"]["properties"]["listeners"]["items"][
+                    "$ref"
+                ]
+                == "#/components/schemas/ListenerSpec"
+            )
+    finally:
+        await app.stop()
