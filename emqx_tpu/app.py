@@ -336,9 +336,20 @@ class BrokerApp:
             )
             from emqx_tpu.storage.kv import FileKv
 
+            import os as _os
+
+            from emqx_tpu.storage.wal import MessageWal
+
             kv = FileKv(c.durability.data_dir, fsync=c.durability.fsync)
             self.session_persistence = SessionPersistence(
-                self.broker, self.cm, kv, self.channel_config.session
+                self.broker,
+                self.cm,
+                kv,
+                self.channel_config.session,
+                wal=MessageWal(
+                    _os.path.join(c.durability.data_dir, "messages.wal"),
+                    fsync=c.durability.fsync,
+                ),
             )
             self.session_persistence.attach(self.hooks)
             self.durable_state = DurableState(
@@ -373,6 +384,9 @@ class BrokerApp:
         self.mgmt_server = None  # set by start() when dashboard.enable
         self.gateways = None  # GatewayRegistry, set by start() when configured
         self.bridges = None  # BridgeManager, set by start() when configured
+        self.plugins = None  # PluginManager (lazy)
+        self.telemetry = None  # Telemetry, set by start()
+        self.config_handler = self._make_config_handler()
         self._tasks: List[asyncio.Task] = []
         self.started_at: Optional[float] = None
 
@@ -471,11 +485,91 @@ class BrokerApp:
         self.olp.start()
         if self.statsd is not None:
             self.statsd.start()
+        # runtime plugins (emqx_plugins analog): start configured refs.
+        # one broken plugin must not abort broker boot — log and continue
+        if c.plugins.start:
+            pm = self._plugin_manager()
+            for ref in c.plugins.start:
+                try:
+                    pm.start(ref)
+                except Exception:
+                    logging.getLogger("emqx_tpu").exception(
+                        "plugin %s failed to start; continuing boot", ref
+                    )
+        # telemetry reporter (opt-in)
+        from emqx_tpu.observe.telemetry import Telemetry
+
+        import os as _os
+
+        self.telemetry = Telemetry(
+            self,
+            enable=c.observe.telemetry.enable,
+            url=c.observe.telemetry.url,
+            interval=c.observe.telemetry.interval,
+            uuid_path=(
+                _os.path.join(c.durability.data_dir, "telemetry_uuid")
+                if c.durability.enable
+                else None
+            ),
+        )
+        self.telemetry.start()
         self._tasks = [
             asyncio.ensure_future(self._housekeeping()),
             asyncio.ensure_future(self._sys_heartbeat()),
             asyncio.ensure_future(self._sys_stats()),
         ]
+
+    def _make_config_handler(self, conf_log=None):
+        """Runtime config-update pipeline (emqx_config_handler parity):
+        per-subtree side-effect handlers with schema validation and
+        rollback; see config/handler.py."""
+        import dataclasses as _dc
+
+        from emqx_tpu.config.handler import ConfigHandler
+
+        def set_config(cfg):
+            self.config = cfg
+
+        h = ConfigHandler(lambda: self.config, set_config, conf_log=conf_log)
+
+        def apply_mqtt(cfg):
+            # patch the SHARED caps object in place: every live channel and
+            # listener references it, so new limits apply immediately
+            for f in _dc.fields(cfg.mqtt):
+                setattr(
+                    self.channel_config.caps, f.name, getattr(cfg.mqtt, f.name)
+                )
+
+        def apply_limiter(cfg):
+            self.limiters.reconfigure(cfg.limiter)
+
+        def apply_authz(cfg):
+            self.authz.no_match = cfg.authz.no_match
+            self.authz.deny_action = cfg.authz.deny_action
+            self.authz.set_rules(
+                [self._acl_rule(r) for r in cfg.authz.rules]
+            )
+
+        def apply_flapping(cfg):
+            if self.flapping is not None:
+                self.flapping.max_count = cfg.flapping.max_count
+                self.flapping.window = cfg.flapping.window_time
+                self.flapping.ban_time = cfg.flapping.ban_time
+
+        h.register("mqtt", apply_mqtt)
+        h.register("limiter", apply_limiter)
+        h.register("authz", apply_authz)
+        h.register("flapping", apply_flapping)
+        return h
+
+    def _plugin_manager(self):
+        if self.plugins is None:
+            from emqx_tpu.plugins import PluginManager
+
+            self.plugins = PluginManager(
+                self, self.config.plugins.install_dir
+            )
+        return self.plugins
 
     def _bridge_manager(self):
         if self.bridges is None:
@@ -508,6 +602,10 @@ class BrokerApp:
             await self.statsd.stop()
         if self.mgmt_server is not None:
             await self.mgmt_server.stop()
+        if self.telemetry is not None:
+            await self.telemetry.stop()
+        if self.plugins is not None:
+            self.plugins.stop_all()
         if self.gateways is not None:
             await self.gateways.unload_all()
         if self.bridges is not None:

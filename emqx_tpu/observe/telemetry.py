@@ -1,0 +1,130 @@
+"""Telemetry reporter (opt-in usage statistics).
+
+Parity: apps/emqx_modules/src/emqx_telemetry.erl — periodically collects
+an anonymized report (node uuid, version, uptime, feature usage and
+broker-scale counters, NO payloads/topics/identities) and POSTs it to a
+configurable endpoint. Disabled by default; the report surface doubles as
+`GET /telemetry/data` for operators to inspect exactly what would leave
+the node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Dict, Optional
+
+log = logging.getLogger("emqx_tpu.telemetry")
+
+
+class Telemetry:
+    def __init__(
+        self,
+        app,
+        enable: bool = False,
+        url: str = "",
+        interval: float = 7 * 24 * 3600.0,
+        uuid_path: Optional[str] = None,
+    ):
+        self.app = app
+        self.enable = enable
+        self.url = url
+        self.interval = interval
+        # stable node identity across restarts (the reference persists its
+        # telemetry UUID in mnesia); ephemeral only when no data dir exists
+        self.node_uuid = self._load_uuid(uuid_path)
+        self._task: Optional[asyncio.Task] = None
+        self.last_report_at: Optional[float] = None
+
+    @staticmethod
+    def _load_uuid(path: Optional[str]) -> str:
+        if path is None:
+            return uuid.uuid4().hex
+        try:
+            with open(path) as f:
+                existing = f.read().strip()
+            if existing:
+                return existing
+        except OSError:
+            pass
+        fresh = uuid.uuid4().hex
+        try:
+            import os
+
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                f.write(fresh)
+        except OSError as e:
+            log.warning("cannot persist telemetry uuid: %s", e)
+        return fresh
+
+    def get_telemetry_data(self) -> Dict:
+        """The full (anonymized) report — what `enable` would transmit."""
+        from emqx_tpu import __version__
+
+        broker = self.app.broker
+        c = self.app.config
+        return {
+            "uuid": self.node_uuid,
+            "version": __version__,
+            "license": {"edition": "opensource"},
+            "uptime_seconds": int(
+                time.time() - (self.app.started_at or time.time())
+            ),
+            "connections": self.app.cm.channel_count(),
+            "subscriptions": broker.subscription_count(),
+            "routes": len(broker.router),
+            "messages_received": broker.metrics.snapshot().get(
+                "messages.received", 0
+            ),
+            "active_plugins": [
+                p["name"] for p in getattr(self.app, "plugins", None).list()
+            ]
+            if getattr(self.app, "plugins", None)
+            else [],
+            "features": {
+                "tpu_routing": c.router.enable_tpu,
+                "gateways": [g.type for g in c.gateways],
+                "bridges": [b.id.partition(":")[0] for b in c.bridges],
+                "authn": c.authn.enable,
+                "rule_engine": bool(c.rules),
+                "cluster": False,
+            },
+        }
+
+    def start(self) -> None:
+        if self.enable and self.url:
+            self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await self.report_now()
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass
+
+    async def report_now(self) -> bool:
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10)
+            ) as s:
+                async with s.post(
+                    self.url, json=self.get_telemetry_data()
+                ) as resp:
+                    ok = resp.status < 300
+            self.last_report_at = time.time()
+            return ok
+        except Exception as e:
+            log.debug("telemetry report failed: %s", e)
+            return False

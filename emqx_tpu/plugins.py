@@ -1,0 +1,161 @@
+"""Runtime-installable plugins.
+
+Parity: apps/emqx_plugins/src/emqx_plugins.erl:72-91 — a plugin ships as
+a ``.tar.gz`` package (name-version.tar.gz) containing:
+
+    release.json      {"name", "version", "description", "entry"}
+    <module>.py       (+ any support files)
+
+Install extracts into the install dir, `start` imports the entry module
+and calls its ``plugin_start(app)`` (symmetric ``plugin_stop(app)``), and
+configured start ordering is applied at boot. Plugins attach to the same
+hookpoints as built-in extensions — the in-process analog of exhook's
+out-of-process extension model.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import shutil
+import sys
+import tarfile
+from pathlib import Path
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.plugins")
+
+
+class PluginError(Exception):
+    pass
+
+
+class _Plugin:
+    def __init__(self, name: str, version: str, dir_: Path, meta: Dict):
+        self.name = name
+        self.version = version
+        self.dir = dir_
+        self.meta = meta
+        self.module = None
+        self.running = False
+
+    @property
+    def ref(self) -> str:
+        return f"{self.name}-{self.version}"
+
+
+class PluginManager:
+    def __init__(self, app, install_dir: str):
+        self.app = app
+        self.install_dir = Path(install_dir)
+        self.install_dir.mkdir(parents=True, exist_ok=True)
+        self._plugins: Dict[str, _Plugin] = {}  # "name-version" -> plugin
+        self.scan()
+
+    # -- discovery ---------------------------------------------------------
+    def scan(self) -> None:
+        """Pick up already-extracted plugin dirs (restart survival)."""
+        for d in self.install_dir.iterdir() if self.install_dir.exists() else []:
+            manifest = d / "release.json"
+            if d.is_dir() and manifest.exists():
+                try:
+                    meta = json.loads(manifest.read_text())
+                    p = _Plugin(meta["name"], meta["version"], d, meta)
+                    self._plugins.setdefault(p.ref, p)
+                except (ValueError, KeyError) as e:
+                    log.warning("skipping bad plugin dir %s: %s", d, e)
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self, package_path: str) -> _Plugin:
+        """Extract a plugin package (emqx_plugins:ensure_installed)."""
+        with tarfile.open(package_path, "r:gz") as tf:
+            names = tf.getnames()
+            if "release.json" not in names:
+                raise PluginError("package missing release.json")
+            for n in names:
+                if n.startswith(("/", "..")) or ".." in Path(n).parts:
+                    raise PluginError(f"unsafe path in package: {n}")
+            meta = json.loads(tf.extractfile("release.json").read())
+            for key in ("name", "version", "entry"):
+                if key not in meta:
+                    raise PluginError(f"release.json missing {key!r}")
+            ref = f"{meta['name']}-{meta['version']}"
+            if ref in self._plugins:
+                raise PluginError(f"plugin already installed: {ref}")
+            dest = self.install_dir / ref
+            dest.mkdir(parents=True, exist_ok=True)
+            # filter="data" also rejects symlink/hardlink members that the
+            # name check above cannot see (arbitrary-write hardening)
+            tf.extractall(dest, filter="data")
+        p = _Plugin(meta["name"], meta["version"], dest, meta)
+        self._plugins[p.ref] = p
+        log.info("plugin %s installed", p.ref)
+        return p
+
+    def start(self, ref: str) -> None:
+        p = self._require(ref)
+        if p.running:
+            return
+        if p.module is None:
+            entry = p.meta["entry"]
+            path = p.dir / f"{entry}.py"
+            if not path.exists():
+                raise PluginError(f"entry module not found: {path}")
+            spec = importlib.util.spec_from_file_location(
+                f"emqx_tpu_plugin_{p.name}", path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[spec.name] = mod
+            spec.loader.exec_module(mod)
+            p.module = mod
+        starter = getattr(p.module, "plugin_start", None)
+        if starter is None:
+            raise PluginError(f"{ref}: no plugin_start(app) in entry module")
+        starter(self.app)
+        p.running = True
+        log.info("plugin %s started", ref)
+
+    def stop(self, ref: str) -> None:
+        p = self._require(ref)
+        if not p.running:
+            return
+        stopper = getattr(p.module, "plugin_stop", None)
+        if stopper is not None:
+            try:
+                stopper(self.app)
+            except Exception:
+                log.exception("plugin %s stop failed", ref)
+        p.running = False
+        log.info("plugin %s stopped", ref)
+
+    def uninstall(self, ref: str) -> None:
+        p = self._require(ref)
+        if p.running:
+            self.stop(ref)
+        shutil.rmtree(p.dir, ignore_errors=True)
+        del self._plugins[ref]
+        log.info("plugin %s uninstalled", ref)
+
+    def stop_all(self) -> None:
+        for ref, p in self._plugins.items():
+            if p.running:
+                self.stop(ref)
+
+    def _require(self, ref: str) -> _Plugin:
+        p = self._plugins.get(ref)
+        if p is None:
+            raise PluginError(f"no such plugin: {ref}")
+        return p
+
+    # -- introspection -----------------------------------------------------
+    def list(self) -> List[Dict]:
+        return [
+            {
+                "name": p.name,
+                "version": p.version,
+                "description": p.meta.get("description", ""),
+                "running": p.running,
+            }
+            for p in self._plugins.values()
+        ]
