@@ -94,6 +94,8 @@ class ChannelManager:
         expiry = sess.config.expiry_interval
         if expiry > 0:
             self._detached[cid] = (sess, time.time() + expiry)
+            # persistence swaps in its durable banker on this hookpoint
+            self.broker.hooks.run("session.detached", cid)
         else:
             self.broker.drop_session_subs(cid, list(sess.subscriptions))
             self.broker.hooks.run("session.terminated", cid, reason)

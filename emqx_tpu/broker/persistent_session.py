@@ -40,10 +40,12 @@ NS_DELAYED = "delayed"
 NS_BANNED = "banned"
 
 
-def make_detached_deliverer(session):
+def make_detached_deliverer(session, wal=None, client_id: str = ""):
     """Deliverer for a session with no live channel: bank QoS1/2 messages
     in the session queue for replay at resume (the reference's
-    'undelivered' records)."""
+    'undelivered' records). With a WAL attached, each banked message is
+    also appended durably — the snapshot-to-snapshot crash window closes
+    (emqx_broker.erl:213 persist-at-publish parity)."""
 
     def deliver(msg: Message, opts: pkt.SubOpts) -> None:
         qos = min(msg.qos, opts.qos)
@@ -54,18 +56,27 @@ def make_detached_deliverer(session):
         m = copy.copy(msg)
         m.qos = qos
         session.mqueue.in_(m)
+        if wal is not None:
+            wal.append(client_id, msg_to_json(m))
 
     return deliver
 
 
 class SessionPersistence:
-    """Checkpoints detached sessions; restores them (with routes) at boot."""
+    """Checkpoints detached sessions; restores them (with routes) at boot.
 
-    def __init__(self, broker, cm, kv: FileKv, session_config):
+    With a `MessageWal` attached, messages banked for detached sessions
+    between checkpoints are appended durably and replayed over the
+    snapshot at restore — closing the snapshot-to-snapshot crash window
+    (the reference's persist-at-publish + undelivered records,
+    emqx_persistent_session.erl:63-77)."""
+
+    def __init__(self, broker, cm, kv: FileKv, session_config, wal=None):
         self.broker = broker
         self.cm = cm
         self.kv = kv
         self.session_config = session_config
+        self.wal = wal
         self._dirty = False
 
     # -- hook + cm integration --------------------------------------------
@@ -73,6 +84,7 @@ class SessionPersistence:
         hooks.add(
             "client.disconnected", self._on_disconnected, tag="persistence"
         )
+        hooks.add("session.detached", self._on_detached, tag="persistence")
         for hp in (
             "session.discarded",
             "session.terminated",
@@ -83,6 +95,19 @@ class SessionPersistence:
 
     def _on_disconnected(self, ci, reason) -> None:
         self._dirty = True
+
+    def _on_detached(self, cid: str) -> None:
+        """The CM just parked this session: swap the (dead channel's)
+        deliverers for the detached banker so every banked message hits
+        the WAL from the moment of detach."""
+        self._dirty = True
+        ent = self.cm._detached.get(cid)
+        if ent is None:
+            return
+        sess, _deadline = ent
+        deliver = make_detached_deliverer(sess, self.wal, cid)
+        for f, opts in sess.subscriptions.items():
+            self.broker.subscribe(cid, cid, f, opts, deliver)
 
     def _mark_dirty_any(self, *args) -> None:
         self._dirty = True
@@ -104,6 +129,9 @@ class SessionPersistence:
             snap["deadline"] = deadline
             sessions[cid] = snap
         self.kv.write(NS_SESSIONS, {"at": now, "sessions": sessions})
+        if self.wal is not None:
+            # the snapshot now owns everything the WAL recorded
+            self.wal.truncate()
         self._dirty = False
         return True
 
@@ -120,11 +148,18 @@ class SessionPersistence:
             if deadline <= now:
                 continue  # expired while the broker was down
             sess = session_from_json(snap, self.session_config)
-            deliver = make_detached_deliverer(sess)
+            deliver = make_detached_deliverer(sess, self.wal, cid)
             for f, opts in sess.subscriptions.items():
                 self.broker.subscribe(cid, cid, f, opts, deliver)
             self.cm._detached[cid] = (sess, deadline)
             n += 1
+        if self.wal is not None:
+            # replay the post-snapshot suffix: messages banked after the
+            # last checkpoint survive the crash (at-least-once)
+            for cid, msg_json in self.wal.replay():
+                ent = self.cm._detached.get(cid)
+                if ent is not None:
+                    ent[0].mqueue.in_(msg_from_json(msg_json))
         return n
 
 

@@ -54,6 +54,10 @@ class ClusterNode:
         self._chan_lock = threading.Lock()
         # clientid -> (node, sid): replicated channel registry
         self._channels: Dict[str, Tuple[str, str]] = {}
+        # persistent-session router state (emqx_session_router parity):
+        # locally parked sessions + the replicated clientid -> owner map
+        self._parked: Dict[str, Dict] = {}
+        self._parked_owner: Dict[str, str] = {}
         self._register_protos()
         self.membership.monitor(self._on_membership)
         bus.attach(name, self._handle)
@@ -104,9 +108,28 @@ class ClusterNode:
                 "entries_after": self.conf_log.entries_after,
             },
         )
+        self.rpc.registry.register(
+            "sess",
+            1,
+            {
+                "insert_parked": self._proto_insert_parked,
+                "delete_parked": self._proto_delete_parked,
+                "resume_begin": self._proto_resume_begin,
+                "resume_end": self._proto_resume_end,
+                "dump_parked": self._proto_dump_parked,
+            },
+        )
 
     def _on_membership(self, event: str, node: str) -> None:
         if event == "node_down":
+            # sessions parked on a dead node are unreachable until it
+            # returns: purge the owner entries so reconnecting clients get
+            # fresh sessions instead of resume limbo (route-GC semantics)
+            gone = [
+                cid for cid, n in self._parked_owner.items() if n == node
+            ]
+            for cid in gone:
+                self._parked_owner.pop(cid, None)
             purged = self.routes.cleanup_node(node)
             with self._chan_lock:
                 for cid, (n, _) in list(self._channels.items()):
@@ -132,6 +155,11 @@ class ClusterNode:
         # config log catch-up
         entries = self.rpc.call(seed, "conf", "entries_after", self.conf_log.cursor)
         self.conf_log.catch_up_from([tuple(e) for e in entries])
+        # parked-session owner map bootstrap (a late joiner must be able
+        # to resume sessions parked before it joined)
+        self._parked_owner.update(
+            self.rpc.call(seed, "sess", "dump_parked")
+        )
         return True
 
     def leave(self) -> None:
@@ -325,6 +353,117 @@ class ClusterNode:
                 self.unsubscribe(sid, f)
         self.unregister_channel(client_id)
         return True
+
+    # -- persistent-session park/resume (emqx_session_router parity) -------
+    def park_session(self, client_id: str, session_json: Dict, deadline: float) -> None:
+        """Park a detached persistent session on this node: its wildcard/
+        plain routes stay HERE (the separate persistent-session route
+        table, emqx_session_router.erl), and matched messages bank in the
+        park's pending list until a resume fetches them."""
+        from emqx_tpu.mqtt import packet as pkt
+        from emqx_tpu.storage.codec import msg_to_json, subopts_from_json
+
+        park = {
+            "session": session_json,
+            "deadline": deadline,
+            "pending": [],
+            "marker": None,  # set by resume_begin: forward-to-node marker
+        }
+        self._parked[client_id] = park
+        sid = f"parked:{client_id}"
+
+        def deliver(msg: Message, opts: pkt.SubOpts) -> None:
+            qos = min(msg.qos, opts.qos)
+            if qos == 0:
+                return
+            park["pending"].append(msg_to_json(msg))
+
+        for f, opts_json in session_json.get("subscriptions", {}).items():
+            self.subscribe(sid, client_id, f, subopts_from_json(opts_json), deliver)
+        self._parked_owner[client_id] = self.name
+        for p in self.membership.peers():
+            self.rpc.cast(p, "sess", "insert_parked", client_id, self.name)
+
+    def resume_session(self, client_id: str, install=None):
+        """Two-phase cross-node resume (emqx_session_router.erl:171-220
+        resume_begin/resume_end with markers):
+
+        1. resume_begin on the owner: returns the session snapshot + the
+           pendings banked so far; the owner sets a marker and KEEPS
+           routing, so messages arriving during the handoff keep banking.
+        2. `install(session_json)` runs HERE, between the phases — the
+           caller sets up its local routes for the session while the
+           owner's park still catches in-flight traffic; only then
+        3. resume_end on the owner returns the straggler pendings that
+           arrived during the window and drops the park + its routes.
+
+        Without an installed local route before resume_end, a message
+        landing in the gap would match no route — the exact loss the
+        marker protocol exists to prevent.
+
+        Returns (session_json, pending_msgs) or None when no parked
+        session exists anywhere.
+        """
+        owner = self._parked_owner.get(client_id)
+        if owner is None:
+            return None
+        if owner == self.name:
+            begin = self._proto_resume_begin(client_id, self.name)
+        else:
+            try:
+                begin = self.rpc.call(
+                    owner, "sess", "resume_begin", client_id, self.name
+                )
+            except RpcError:
+                self._parked_owner.pop(client_id, None)
+                return None
+        if begin is None:
+            return None
+        snap, pending = begin
+        if install is not None:
+            install(snap)  # local routes live BEFORE the park is dropped
+        if owner == self.name:
+            stragglers = self._proto_resume_end(client_id)
+        else:
+            stragglers = self.rpc.call(owner, "sess", "resume_end", client_id)
+        return snap, [
+            self._msg_from(m) for m in list(pending) + list(stragglers)
+        ]
+
+    @staticmethod
+    def _msg_from(m):
+        from emqx_tpu.storage.codec import msg_from_json
+
+        return msg_from_json(m)
+
+    def _proto_insert_parked(self, client_id: str, node: str) -> None:
+        self._parked_owner[client_id] = node
+
+    def _proto_delete_parked(self, client_id: str) -> None:
+        self._parked_owner.pop(client_id, None)
+
+    def _proto_dump_parked(self) -> Dict[str, str]:
+        return dict(self._parked_owner)
+
+    def _proto_resume_begin(self, client_id: str, to_node: str):
+        park = self._parked.get(client_id)
+        if park is None:
+            return None
+        park["marker"] = to_node
+        pending, park["pending"] = park["pending"], []
+        return park["session"], pending
+
+    def _proto_resume_end(self, client_id: str):
+        park = self._parked.pop(client_id, None)
+        if park is None:
+            return []
+        sid = f"parked:{client_id}"
+        for f in park["session"].get("subscriptions", {}):
+            self.unsubscribe(sid, f)
+        self._parked_owner.pop(client_id, None)
+        for p in self.membership.peers():
+            self.rpc.cast(p, "sess", "delete_parked", client_id)
+        return park["pending"]
 
     # -- cluster config txn (emqx_cluster_rpc multicall parity) ------------
     def config_multicall(self, op: str, args: tuple) -> Dict[str, object]:
