@@ -71,6 +71,9 @@ class RetainerConfig:
     max_retained_messages: int = 1_000_000
     max_payload_size: int = 1024 * 1024
     msg_clear_interval: float = 60.0
+    # device replay index for wildcard storms over big stores; engages at
+    # device_threshold topics when the TPU path is enabled
+    device_threshold: int = 10_000
 
 
 @dataclass
@@ -173,6 +176,13 @@ class DashboardConfig:
     bind: str = "127.0.0.1"
     port: int = 18083
     api_key: str = ""  # empty => no auth (dev mode)
+    # admin users for JWT login (emqx_dashboard_admin analog); password
+    # accepted in plain here, hashed at app assembly
+    admins: Dict[str, str] = field(default_factory=dict)  # user -> password
+    jwt_ttl: float = 3600.0
+    # live monitor sampling (emqx_dashboard_monitor analog)
+    monitor_interval: float = 5.0
+    monitor_history: int = 360  # samples kept for monitor_current charts
 
 
 @dataclass

@@ -22,6 +22,7 @@ Auth: `Authorization: Bearer <api_key>` when dashboard.api_key is set
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import dataclasses
 import json
@@ -88,7 +89,16 @@ ROUTES = [
     ("delete", "/api/v5/plugins/{ref}", "plugins_delete", "Uninstall a plugin", "plugins"),
     ("get", "/api/v5/telemetry/data", "telemetry_data", "Inspect the telemetry report", "telemetry"),
     ("get", "/api-docs", "api_docs", "This OpenAPI document", "meta"),
+    ("post", "/api/v5/login", "login", "Obtain an admin JWT", "dashboard"),
+    ("get", "/api/v5/monitor_current", "monitor_current", "Latest monitor sample", "dashboard"),
+    ("get", "/api/v5/monitor_history", "monitor_history", "Monitor sample history", "dashboard"),
+    ("get", "/api/v5/monitor", "monitor_ws", "Live monitor stream (WebSocket)", "dashboard"),
+    ("get", "/", "index_page", "Status page", "dashboard"),
 ]
+
+# reachable without credentials (login mints them; the page fetches the
+# sample endpoint, which stays protected)
+_PUBLIC_PATHS = {"/api/v5/login", "/"}
 
 
 class MgmtApi:
@@ -98,6 +108,14 @@ class MgmtApi:
         self.cm = app.cm
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
+
+        from emqx_tpu.mgmt.dashboard import DashboardAdmin, Monitor
+
+        d = app.config.dashboard
+        self.admin = DashboardAdmin(d.admins, ttl=d.jwt_ttl)
+        self.monitor = Monitor(
+            app, interval=d.monitor_interval, history=d.monitor_history
+        )
 
         w = web.Application(middlewares=[self._auth_middleware])
         w.add_routes(
@@ -111,10 +129,14 @@ class MgmtApi:
     @web.middleware
     async def _auth_middleware(self, request, handler):
         key = self.app.config.dashboard.api_key
-        if key:
+        needs_auth = bool(key or self.admin.has_admins())
+        if needs_auth and request.path not in _PUBLIC_PATHS:
             auth = request.headers.get("Authorization", "")
-            ok = auth == f"Bearer {key}"
-            if not ok and auth.startswith("Basic "):
+            ok = bool(key) and auth == f"Bearer {key}"
+            if not ok and auth.startswith("Bearer "):
+                # admin JWT (emqx_dashboard_admin tokens)
+                ok = self.admin.verify(auth[7:]) is not None
+            if not ok and key and auth.startswith("Basic "):
                 try:
                     decoded = base64.b64decode(auth[6:]).decode()
                     ok = decoded.split(":", 1)[-1] == key
@@ -132,10 +154,60 @@ class MgmtApi:
         site = web.TCPSite(self._runner, bind, port)
         await site.start()
         self.port = self._runner.addresses[0][1] if self._runner.addresses else port
+        self.monitor.start()
 
     async def stop(self) -> None:
+        await self.monitor.stop()
         if self._runner is not None:
             await self._runner.cleanup()
+
+    # -- dashboard (emqx_dashboard admin/monitor analogs) ------------------
+    async def login(self, request):
+        try:
+            body = await request.json()
+            token = self.admin.login(body["username"], body["password"])
+        except (ValueError, KeyError, TypeError):
+            token = None
+        if token is None:
+            return web.json_response({"code": "BAD_USERNAME_OR_PWD"}, status=401)
+        return web.json_response(
+            {"token": token, "version": __import__("emqx_tpu").__version__}
+        )
+
+    async def monitor_current(self, request):
+        return web.json_response(self.monitor.sample())
+
+    async def monitor_history(self, request):
+        return web.json_response({"data": self.monitor.samples})
+
+    async def monitor_ws(self, request):
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        q = self.monitor.subscribe()
+
+        async def pump():
+            try:
+                await ws.send_json(self.monitor.sample())
+                while True:
+                    await ws.send_json(await q.get())
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+        task = asyncio.get_running_loop().create_task(pump())
+        try:
+            # drain client frames so the CLOSE handshake completes (a
+            # handler parked only on q.get() would never see it)
+            async for _ in ws:
+                pass
+        finally:
+            task.cancel()
+            self.monitor.unsubscribe(q)
+        return ws
+
+    async def index_page(self, request):
+        from emqx_tpu.mgmt.dashboard import STATUS_PAGE
+
+        return web.Response(text=STATUS_PAGE, content_type="text/html")
 
     # -- handlers ----------------------------------------------------------
     async def status(self, request):

@@ -1,0 +1,168 @@
+"""Dashboard backend: admin JWT auth + live monitor sampling/stream.
+
+Parity: apps/emqx_dashboard/src — emqx_dashboard_admin (username/password
+admins, JWT bearer tokens for the REST surface), emqx_dashboard_monitor
+(periodic sampling of connection/subscription/message-rate gauges with a
+bounded history, streamed over WebSocket to the UI and queryable at
+/monitor_current). The SPA itself is not bundled (the reference fetches a
+prebuilt web app at build time, scripts/get-dashboard.sh); a minimal
+status page is served at / so the endpoint is human-usable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import os
+import time
+from typing import Dict, List, Optional
+
+from emqx_tpu.broker.auth import JwtAuth
+
+
+class DashboardAdmin:
+    """Admin credential store + JWT mint/verify (emqx_dashboard_admin)."""
+
+    def __init__(self, admins: Dict[str, str], ttl: float = 3600.0,
+                 secret: Optional[bytes] = None):
+        self.ttl = ttl
+        self.secret = secret or os.urandom(32)
+        self._users: Dict[str, tuple] = {}
+        for user, password in admins.items():
+            self.add_admin(user, password)
+
+    def add_admin(self, user: str, password: str) -> None:
+        salt = os.urandom(16)
+        self._users[user] = (
+            salt,
+            hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 10000),
+        )
+
+    def login(self, user: str, password: str) -> Optional[str]:
+        ent = self._users.get(user)
+        if ent is None:
+            return None
+        salt, phash = ent
+        cand = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 10000)
+        if not hmac.compare_digest(cand, phash):
+            return None
+        return JwtAuth.sign(
+            self.secret,
+            {"sub": user, "exp": time.time() + self.ttl, "iss": "emqx_tpu"},
+        )
+
+    def verify(self, token: str) -> Optional[str]:
+        """-> username or None."""
+        auth = JwtAuth(self.secret)
+        ci: Dict = {}
+        result, _rc = auth.authenticate(ci, {"password": token.encode()})
+        if result != "ok":
+            return None
+        claims = ci.get("jwt_claims", {})
+        return claims.get("sub")
+
+    def has_admins(self) -> bool:
+        return bool(self._users)
+
+
+class Monitor:
+    """Bounded ring of periodic samples (emqx_dashboard_monitor)."""
+
+    def __init__(self, app, interval: float = 5.0, history: int = 360):
+        self.app = app
+        self.interval = interval
+        self.history = history
+        self.samples: List[Dict] = []
+        self._task: Optional[asyncio.Task] = None
+        self._subscribers: List[asyncio.Queue] = []
+        self._last_counters: Dict[str, float] = {}
+
+    def sample(self, update_baseline: bool = False) -> Dict:
+        """One sample. Only the periodic loop passes update_baseline=True —
+        ad-hoc REST/WS reads must not reset the rate window (two fast
+        polls would otherwise produce garbage per-interval rates)."""
+        m = self.app.broker.metrics.snapshot()
+        now = time.time()
+        recv = m.get("messages.received", 0)
+        sent = m.get("messages.delivered", 0)
+        last = self._last_counters
+        dt = max(now - last.get("at", now), 1e-9) if last else None
+        s = {
+            "at": int(now * 1000),
+            "connections": self.app.cm.channel_count(),
+            "subscriptions": self.app.broker.subscription_count(),
+            "topics": len(self.app.broker.router),
+            "retained": len(self.app.retainer),
+            "received": recv,
+            "sent": sent,
+            "received_rate": round((recv - last.get("recv", recv)) / dt, 2)
+            if dt
+            else 0.0,
+            "sent_rate": round((sent - last.get("sent", sent)) / dt, 2)
+            if dt
+            else 0.0,
+        }
+        if update_baseline:
+            self._last_counters = {"at": now, "recv": recv, "sent": sent}
+        return s
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                s = self.sample(update_baseline=True)
+                self.samples.append(s)
+                if len(self.samples) > self.history:
+                    del self.samples[: -self.history]
+                for q in list(self._subscribers):
+                    if q.qsize() < 16:
+                        q.put_nowait(s)
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass
+
+    def subscribe(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._subscribers.append(q)
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        if q in self._subscribers:
+            self._subscribers.remove(q)
+
+
+STATUS_PAGE = """<!doctype html>
+<html><head><title>emqx_tpu dashboard</title>
+<style>body{font-family:system-ui;margin:2rem;max-width:46rem}
+table{border-collapse:collapse}td,th{padding:.3rem .8rem;border:1px solid #ccc}
+code{background:#f4f4f4;padding:0 .3rem}</style></head>
+<body><h1>emqx_tpu</h1>
+<p>TPU-native MQTT broker. Management API at <code>/api/v5</code>,
+OpenAPI at <code>/api-docs</code>, live samples at
+<code>/api/v5/monitor_current</code>, stream at
+<code>WS /api/v5/monitor</code>.</p>
+<table id="t"><tr><th>metric</th><th>value</th></tr></table>
+<script>
+async function tick(){
+  const r = await fetch('/api/v5/monitor_current');
+  if(!r.ok) return;
+  const d = await r.json();
+  const t = document.getElementById('t');
+  while(t.rows.length>1) t.deleteRow(1);
+  for(const [k,v] of Object.entries(d)){
+    const row = t.insertRow(); row.insertCell().textContent = k;
+    row.insertCell().textContent = v;
+  }
+}
+tick(); setInterval(tick, 5000);
+</script></body></html>
+"""

@@ -1,0 +1,108 @@
+"""Dashboard backend: JWT admin login, protected API, monitor stream.
+
+Parity: apps/emqx_dashboard (emqx_dashboard_admin JWT tokens,
+emqx_dashboard_monitor sampling + WebSocket stream).
+"""
+
+import asyncio
+import functools
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.config.schema import load_config
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+def _cfg(**dash):
+    return load_config(
+        {
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"port": 0, "bind": "127.0.0.1", **dash},
+            "router": {"enable_tpu": False},
+        }
+    )
+
+
+@async_test
+async def test_admin_jwt_login_protects_api():
+    import aiohttp
+
+    app = BrokerApp(
+        _cfg(admins={"root": "hunter2"}, monitor_interval=0.1)
+    )
+    await app.start()
+    try:
+        base = f"http://127.0.0.1:{app.mgmt_server.port}"
+        async with aiohttp.ClientSession() as s:
+            # protected without a token
+            async with s.get(f"{base}/api/v5/status") as r:
+                assert r.status == 401
+            # the status page and login stay public
+            async with s.get(f"{base}/") as r:
+                assert r.status == 200
+                assert "emqx_tpu" in await r.text()
+            async with s.post(
+                f"{base}/api/v5/login",
+                json={"username": "root", "password": "wrong"},
+            ) as r:
+                assert r.status == 401
+            async with s.post(
+                f"{base}/api/v5/login",
+                json={"username": "root", "password": "hunter2"},
+            ) as r:
+                assert r.status == 200
+                token = (await r.json())["token"]
+            hdrs = {"Authorization": f"Bearer {token}"}
+            async with s.get(f"{base}/api/v5/status", headers=hdrs) as r:
+                assert r.status == 200
+            # garbage token rejected
+            async with s.get(
+                f"{base}/api/v5/status",
+                headers={"Authorization": "Bearer junk.t.x"},
+            ) as r:
+                assert r.status == 401
+
+            # monitor: current sample + history + websocket stream
+            async with s.get(
+                f"{base}/api/v5/monitor_current", headers=hdrs
+            ) as r:
+                cur = await r.json()
+                assert {"connections", "subscriptions", "received"} <= set(cur)
+            await asyncio.sleep(0.35)
+            async with s.get(
+                f"{base}/api/v5/monitor_history", headers=hdrs
+            ) as r:
+                hist = (await r.json())["data"]
+                assert len(hist) >= 2
+            async with s.ws_connect(
+                f"{base}/api/v5/monitor", headers=hdrs
+            ) as ws:
+                first = await asyncio.wait_for(ws.receive_json(), 5)
+                assert "connections" in first
+                second = await asyncio.wait_for(ws.receive_json(), 5)
+                assert second["at"] >= first["at"]
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_dev_mode_stays_open():
+    import aiohttp
+
+    app = BrokerApp(_cfg())
+    await app.start()
+    try:
+        base = f"http://127.0.0.1:{app.mgmt_server.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/api/v5/status") as r:
+                assert r.status == 200
+    finally:
+        await app.stop()
