@@ -27,6 +27,7 @@ from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.session import Session, SessionConfig
 from emqx_tpu.mqtt import packet as pkt
 from emqx_tpu.ops import topics as T
+from emqx_tpu.utils.tracepoints import atp, tp
 
 
 @dataclass
@@ -338,6 +339,9 @@ class Channel:
         auth = await self.hooks.arun_fold(
             "client.authenticate", (ci, creds), None
         )
+        # nemesis site: the await window in which a concurrent same-
+        # clientid CONNECT can kick this channel (_gone() guards below)
+        await atp("channel.authenticated", cid=self.client_id)
         # keep provider-set attrs (is_superuser, jwt claims) for the
         # channel's lifetime — authorize checks read them every packet
         self.auth_attrs.update(
@@ -377,6 +381,7 @@ class Channel:
         await self.hooks.arun("client.connack", self.client_info(), "success")
         if self._gone(session):
             return  # kicked during the awaited hook (takeover race)
+        tp("channel.connack", cid=self.client_id, present=present)
         self._send(
             pkt.Connack(
                 session_present=present,

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.session import Session, SessionConfig
+from emqx_tpu.utils.tracepoints import tp
 
 
 class ChannelManager:
@@ -44,19 +45,23 @@ class ChannelManager:
         if channel.clean_start:
             if old is not None:
                 self._discard_channel(old)
+                tp("cm.discarded", cid=cid)
             self._drop_detached(cid)
         else:
             if old is not None:
                 session = old.kick("takenover")
                 self.broker.hooks.run("session.takenover", cid)
                 present = session is not None
+                tp("cm.takenover", cid=cid)
             elif cid in self._detached:
                 session, _ = self._detached.pop(cid)
                 self.broker.hooks.run("session.resumed", cid)
                 present = True
+                tp("cm.resumed", cid=cid)
         if session is None:
             session = Session(cid, channel.config.session)
             self.broker.hooks.run("session.created", cid)
+            tp("cm.created", cid=cid)
         else:
             # rebind broker deliverers from the old channel to the new one
             for f, opts in session.subscriptions.items():

@@ -17,6 +17,8 @@ from __future__ import annotations
 import random as _random
 from typing import Dict, List, Optional, Tuple
 
+from emqx_tpu.utils.tracepoints import tp
+
 
 class _Group:
     __slots__ = ("members", "rr_index", "sticky_sid")
@@ -126,9 +128,11 @@ class SharedSub:
                     continue
                 try:
                     sub.deliver(msg, sub.opts)
+                    tp("shared.delivered", sid=sid, mid=str(msg.mid))
                     n += 1
                     break
                 except Exception:
+                    tp("shared.nack", sid=sid, mid=str(msg.mid))
                     continue  # NACK -> failover to next member
         return n
 

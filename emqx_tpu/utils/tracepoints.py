@@ -1,0 +1,108 @@
+"""Structured trace points + scheduling nemesis for concurrency testing.
+
+The snabbkaffe analog (the reference compiles ?tp trace points into
+modules and has CT suites assert causal properties over collected traces
+while a nemesis perturbs scheduling; SURVEY.md §4/§5.2). Here:
+
+- `tp(kind, **fields)` emits a structured event into the active collector
+  — a single module-level flag check when tracing is off, so production
+  paths pay one branch;
+- `await atp(kind, **fields)` is the async variant where the NEMESIS can
+  inject an await (sleep / custom coroutine) to widen race windows at
+  exactly the instrumented point, the way snabbkaffe's scheduling
+  injections force interleavings;
+- `TraceCollector` gathers events and provides causal assertions:
+  `causally_ordered(a, b, key)` (every `b` is preceded by a matching
+  `a`), `pairs(a, b, key)` (one-to-one), `projection(kind)`.
+
+Only tests activate collection; there is no global registry of trace
+kinds — kinds are free-form strings named at the emission site.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, Dict, List, Optional
+
+_active: Optional["TraceCollector"] = None
+
+
+def tp(kind: str, **fields) -> None:
+    if _active is not None:
+        _active._emit(kind, fields)
+
+
+async def atp(kind: str, **fields) -> None:
+    """Trace point that is also a nemesis injection site."""
+    c = _active
+    if c is None:
+        return
+    c._emit(kind, fields)
+    inj = c._nemesis.get(kind)
+    if inj is not None:
+        r = inj(fields)
+        if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
+            await r
+
+
+class TraceCollector:
+    def __init__(self):
+        self.events: List[Dict] = []
+        self._nemesis: Dict[str, Callable] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self):
+        global _active
+        if _active is not None:
+            raise RuntimeError("a TraceCollector is already active")
+        _active = self
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        _active = None
+
+    def _emit(self, kind: str, fields: Dict) -> None:
+        self.events.append(
+            {"kind": kind, "at": time.monotonic(), **fields}
+        )
+
+    # -- nemesis -----------------------------------------------------------
+    def inject_delay(self, kind: str, delay: float) -> None:
+        """Sleep `delay` whenever `atp(kind)` fires — widens the race
+        window at that point (snabbkaffe scheduling nemesis)."""
+        self._nemesis[kind] = lambda _f: asyncio.sleep(delay)
+
+    def inject(self, kind: str, fn: Callable[[Dict], Optional[Awaitable]]):
+        """Custom injection: fn(fields) may return an awaitable."""
+        self._nemesis[kind] = fn
+
+    # -- assertions --------------------------------------------------------
+    def projection(self, kind: str) -> List[Dict]:
+        return [e for e in self.events if e["kind"] == kind]
+
+    def causally_ordered(self, a: str, b: str, key: str) -> bool:
+        """Every `b` event must be preceded by an `a` event whose `key`
+        field matches (the ?causality assertion)."""
+        seen = set()
+        for e in self.events:
+            if e["kind"] == a:
+                seen.add(e.get(key))
+            elif e["kind"] == b and e.get(key) not in seen:
+                return False
+        return True
+
+    def pairs(self, a: str, b: str, key: str) -> bool:
+        """One-to-one: every `a` is eventually followed by exactly one
+        matching `b`, and no unmatched `b` exists."""
+        opened: Dict = {}
+        for e in self.events:
+            if e["kind"] == a:
+                opened[e.get(key)] = opened.get(e.get(key), 0) + 1
+            elif e["kind"] == b:
+                k = e.get(key)
+                if opened.get(k, 0) <= 0:
+                    return False
+                opened[k] -= 1
+        return all(v == 0 for v in opened.values())

@@ -1,0 +1,177 @@
+"""Concurrency-fault harness: causal trace assertions + scheduling nemesis.
+
+The snabbkaffe analog (SURVEY.md §4/§5.2): structured trace points emitted
+from the racy paths (takeover, shared-sub redispatch), a nemesis that
+widens race windows by injecting awaits at those points, and assertions
+over the collected causal trace — NOT just happy-path outcomes.
+"""
+
+import asyncio
+import functools
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import ChannelConfig
+from emqx_tpu.broker.cm import ChannelManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.session import SessionConfig
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.transport.listener import ListenerConfig, Listeners
+from emqx_tpu.utils.tracepoints import TraceCollector, atp, tp
+
+from tests.minimqtt import MiniClient
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+def test_collector_assertions():
+    with TraceCollector() as t:
+        tp("a", cid="x")
+        tp("b", cid="x")
+        tp("a", cid="y")
+        assert t.causally_ordered("a", "b", "cid")
+        assert not t.causally_ordered("b", "a", "cid")  # no a-after-b for y? (b precedes nothing)
+        assert not t.pairs("a", "b", "cid")  # y unmatched
+        tp("b", cid="y")
+        assert t.pairs("a", "b", "cid")
+    # inactive: emission is a no-op
+    tp("never", cid="z")
+    assert all(e["kind"] != "never" for e in t.events)
+
+
+def test_nested_collector_rejected():
+    with TraceCollector():
+        with pytest.raises(RuntimeError):
+            TraceCollector().__enter__()
+
+
+@async_test
+async def test_takeover_race_under_nemesis():
+    """N same-clientid connects racing through a widened auth window:
+    exactly one channel survives, exactly one live CONNACK holder, and
+    the session is owned by the last CONNACK'd channel — asserted over
+    the causal trace, not just the end state."""
+    broker = Broker(hooks=Hooks())
+    cm = ChannelManager(broker)
+    listeners = Listeners(broker, cm)
+    l = await listeners.start_listener(
+        ListenerConfig(port=0), ChannelConfig(session=SessionConfig())
+    )
+
+    with TraceCollector() as t:
+        # nemesis: park every connect inside the post-auth await so all
+        # contenders pile into the takeover window together
+        t.inject_delay("channel.authenticated", 0.05)
+
+        clients = [MiniClient("race-id", clean=False) for _ in range(5)]
+        results = await asyncio.gather(
+            *(c.connect("127.0.0.1", l.port) for c in clients),
+            return_exceptions=True,
+        )
+        await asyncio.sleep(0.3)
+
+        acks = [r for r in results if isinstance(r, dict) and r["rc"] == 0]
+        assert acks, "at least one contender must win"
+        # invariant: one live registered channel for the clientid
+        assert cm.channel_count() == 1
+        # causal: every CONNACK was preceded by an authenticated event
+        assert t.causally_ordered(
+            "channel.authenticated", "channel.connack", "cid"
+        )
+        # the surviving channel still works
+        for c in clients:
+            try:
+                await asyncio.wait_for(c.ping(2), 2)
+                survivor = c
+                break
+            except Exception:
+                continue
+        else:
+            pytest.fail("no surviving connection")
+        await survivor.disconnect()
+    await listeners.stop_all()
+
+
+@async_test
+async def test_shared_sub_redispatch_causality():
+    """A NACKed shared delivery must be followed by a successful delivery
+    of the SAME message to another member (redispatch causality)."""
+    hooks = Hooks()
+    broker = Broker(hooks=hooks)
+
+    ok_got = []
+
+    def flaky(msg, opts):
+        raise RuntimeError("consumer down")  # always NACKs
+
+    def healthy(msg, opts):
+        ok_got.append(msg)
+
+    broker.subscribe("s-bad", "c-bad", "$share/g/work/#", pkt.SubOpts(qos=1), flaky)
+    broker.subscribe("s-ok", "c-ok", "$share/g/work/#", pkt.SubOpts(qos=1), healthy)
+    # force the flaky member to be picked first every time
+    broker.shared.strategy = "sticky"
+    for g in broker.shared._table["work/#"].values():
+        g.sticky_sid = "s-bad"
+
+    with TraceCollector() as t:
+        for i in range(5):
+            broker.publish(Message(topic=f"work/{i}", payload=b"j", qos=1))
+        # every message: nack on s-bad then delivery on s-ok, same mid
+        nacks = t.projection("shared.nack")
+        delivered = t.projection("shared.delivered")
+        assert len(nacks) == 5 and len(delivered) == 5
+        assert all(e["sid"] == "s-bad" for e in nacks)
+        assert all(e["sid"] == "s-ok" for e in delivered)
+        assert t.causally_ordered("shared.nack", "shared.delivered", "mid")
+        assert t.pairs("shared.nack", "shared.delivered", "mid")
+    assert len(ok_got) == 5
+
+
+@async_test
+async def test_detach_resume_causality_under_load():
+    """Messages banked during detach are causally between detach and
+    resume; nothing delivers to the dead channel."""
+    broker = Broker(hooks=Hooks())
+    cm = ChannelManager(broker)
+    listeners = Listeners(broker, cm)
+    l = await listeners.start_listener(
+        ListenerConfig(port=0),
+        ChannelConfig(session=SessionConfig(expiry_interval=600)),
+    )
+    with TraceCollector() as t:
+        c1 = MiniClient("dr-c", clean=False)
+        await c1.connect("127.0.0.1", l.port)
+        await c1.subscribe([("dr/#", 1)])
+        await c1.close()
+        await asyncio.sleep(0.1)
+        pub = MiniClient("dr-pub")
+        await pub.connect("127.0.0.1", l.port)
+        await pub.publish("dr/1", b"banked", qos=1)
+        c2 = MiniClient("dr-c", clean=False)
+        await c2.connect("127.0.0.1", l.port)
+        assert c2.connack["session_present"] is True
+        m = await c2.recv(5)
+        assert m["payload"] == b"banked"
+        # causal: exactly one resume for dr-c, and it precedes the second
+        # (session_present) CONNACK
+        resumes = [e for e in t.projection("cm.resumed") if e["cid"] == "dr-c"]
+        assert len(resumes) == 1
+        present_acks = [
+            e
+            for e in t.projection("channel.connack")
+            if e["cid"] == "dr-c" and e["present"]
+        ]
+        assert len(present_acks) == 1
+        assert resumes[0]["at"] < present_acks[0]["at"]
+        await c2.disconnect()
+        await pub.disconnect()
+    await listeners.stop_all()
