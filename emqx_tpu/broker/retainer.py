@@ -31,12 +31,47 @@ class _Node:
 
 
 class Retainer:
-    def __init__(self, max_retained: int = 1_000_000, max_payload: int = 1024 * 1024):
+    def __init__(
+        self,
+        max_retained: int = 1_000_000,
+        max_payload: int = 1024 * 1024,
+        device_threshold: int = 10_000,
+        enable_device: bool = False,
+    ):
         self._root = _Node()
         self._count = 0
         self.max_retained = max_retained
         self.max_payload = max_payload
         self.enabled = True
+        # device replay index (models/retained_index.py): wildcard match
+        # over big stores as batched kernel launches instead of a trie walk
+        # per subscriber. Opt-in (the app enables it when router.enable_tpu
+        # is on); used once the store crosses device_threshold, and only
+        # while EVERY stored topic fits the device budget. NOTE: the first
+        # wildcard match past the threshold pays the kernel compile on the
+        # caller's thread — same pattern as the router's warmup.
+        self.device_threshold = device_threshold
+        self.enable_device = enable_device
+        self._device = None
+        self._device_unfit = 0
+
+    def _dev_add(self, topic: str) -> None:
+        if not self.enable_device:
+            return
+        if self._device is None:
+            from emqx_tpu.models.retained_index import DeviceRetainedIndex
+
+            self._device = DeviceRetainedIndex()
+        if not self._device.add(topic):
+            self._device_unfit += 1
+
+    def _dev_remove(self, topic: str) -> None:
+        if self._device is None:
+            return
+        if topic in self._device._rows:
+            self._device.remove(topic)
+        else:
+            self._device_unfit = max(0, self._device_unfit - 1)
 
     def __len__(self) -> int:
         return self._count
@@ -73,6 +108,7 @@ class Retainer:
             node = node.children.setdefault(w, _Node())
         if node.msg is None:
             self._count += 1
+            self._dev_add(msg.topic)
         node.msg = msg
 
     def delete(self, topic: str) -> bool:
@@ -88,6 +124,7 @@ class Retainer:
             return False
         node.msg = None
         self._count -= 1
+        self._dev_remove(topic)
         for parent, w in reversed(path):
             child = parent.children[w]
             if child.msg is None and not child.children:
@@ -110,6 +147,22 @@ class Retainer:
         fw = T.words(filter_)
         out: List[Message] = []
         now = now or time.time()
+
+        # device replay path for wildcard storms over big stores: batched
+        # kernel launches instead of an O(store) trie walk per subscriber
+        if (
+            T.wildcard(filter_)
+            and self._device is not None
+            and self._device_unfit == 0
+            and self._count >= self.device_threshold
+        ):
+            topics = self._device.match(filter_)
+            if topics is not None:
+                for t in topics:
+                    m = self.get(t)
+                    if m is not None and not m.is_expired(now):
+                        out.append(m)
+                return out
 
         def walk(node: _Node, i: int, root_level: bool) -> None:
             if i == len(fw):

@@ -1,0 +1,292 @@
+"""Device index for retained-message replay storms.
+
+BASELINE config 5 is a retained-replay storm: a wildcard SUBSCRIBE against
+millions of retained messages. The reference walks its retained-topic
+table per subscribe (emqx_retainer_mnesia.erl:146-152 match_messages) —
+O(store) per subscriber.
+
+TPU-native inversion of the routing kernel: the stored retained TOPICS
+are the batch, and the incoming subscribe FILTER becomes a one-entry
+shape-index table. One `shape_route_step` launch per chunk of stored
+topics answers "which retained topics match this filter" as a dense
+match matrix — the same kernel that routes publishes, pointed the other
+way. Topics are pre-tokenized into pinned device chunks at insert time,
+so a replay query is pure kernel launches + one small readback per chunk.
+
+Matches are re-verified on host (`T.match`) before use — kernel caps and
+hash collisions can only cost a false candidate, never a wrong replay.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from emqx_tpu.ops import topics as T
+
+CHUNK = 1 << 18  # 262144 topics per device launch
+
+
+class DeviceRetainedIndex:
+    def __init__(self, max_bytes: int = 64, max_levels: int = 8):
+        self.max_bytes = max_bytes
+        self.max_levels = max_levels
+        self._rows: Dict[str, int] = {}  # topic -> global row
+        self._by_row: List[Optional[str]] = []
+        self._free: List[int] = []
+        # host chunks; device mirrors uploaded lazily per query
+        self._host_b: List[np.ndarray] = []  # [CHUNK, max_bytes] uint8
+        self._host_l: List[np.ndarray] = []  # [CHUNK] int32
+        self._dev: List[Optional[tuple]] = []  # (bytes, lens) or None=dirty
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    # -- mutation ----------------------------------------------------------
+    def add(self, topic: str) -> bool:
+        """False when the topic doesn't fit the device budget (too long /
+        too deep) — the caller's CPU path remains authoritative for it."""
+        if topic in self._rows:
+            return True
+        enc = topic.encode()
+        if len(enc) > self.max_bytes or len(T.words(topic)) > self.max_levels:
+            return False
+        if self._free:
+            row = self._free.pop()
+            self._by_row[row] = topic
+        else:
+            row = len(self._by_row)
+            self._by_row.append(topic)
+            if row >= len(self._host_b) * CHUNK:
+                self._host_b.append(
+                    np.zeros((CHUNK, self.max_bytes), np.uint8)
+                )
+                self._host_l.append(np.zeros(CHUNK, np.int32))
+                self._dev.append(None)
+        self._rows[topic] = row
+        c, i = divmod(row, CHUNK)
+        self._host_b[c][i, : len(enc)] = np.frombuffer(enc, np.uint8)
+        self._host_b[c][i, len(enc):] = 0
+        self._host_l[c][i] = len(enc)
+        self._dev[c] = None  # dirty
+        return True
+
+    def bulk_add(self, topics: List[str]) -> int:
+        """Vectorized initial load (restore / bench); returns count added.
+        Topics must fit the device budget (raises otherwise — callers
+        pre-filter, the same contract `add` enforces per topic)."""
+        from emqx_tpu.ops.tokenizer import encode_topics
+
+        fresh = [t for t in topics if t not in self._rows]
+        for t in fresh:
+            if len(T.words(t)) > self.max_levels:
+                raise ValueError(f"bulk_add: topic too deep: {t!r}")
+        pos = 0
+        while pos < len(fresh):
+            # fill the tail of the current chunk
+            row0 = len(self._by_row)
+            c, i0 = divmod(row0, CHUNK)
+            if c >= len(self._host_b):
+                self._host_b.append(np.zeros((CHUNK, self.max_bytes), np.uint8))
+                self._host_l.append(np.zeros(CHUNK, np.int32))
+                self._dev.append(None)
+            take = min(CHUNK - i0, len(fresh) - pos)
+            batch = fresh[pos : pos + take]
+            mat, lens, too_long = encode_topics(batch, self.max_bytes)
+            if too_long.any():
+                raise ValueError("bulk_add: topic exceeds max_bytes")
+            self._host_b[c][i0 : i0 + take] = mat
+            self._host_l[c][i0 : i0 + take] = lens
+            self._dev[c] = None
+            for k, t in enumerate(batch):
+                self._rows[t] = row0 + k
+            self._by_row.extend(batch)
+            pos += take
+        return len(fresh)
+
+    def remove(self, topic: str) -> None:
+        row = self._rows.pop(topic, None)
+        if row is None:
+            return
+        self._by_row[row] = None
+        self._free.append(row)
+        c, i = divmod(row, CHUNK)
+        self._host_l[c][i] = 0  # len-0 rows tokenize to zero words
+        self._host_b[c][i, :] = 0
+        self._dev[c] = None
+
+    # -- query ------------------------------------------------------------
+    def match(self, filter_: str) -> Optional[List[str]]:
+        """Retained topics matching `filter_`, or None when the filter
+        itself exceeds the device budget (caller falls back to CPU)."""
+        import jax
+        import jax.numpy as jnp
+
+        from emqx_tpu.models.router_model import shape_route_step
+        from emqx_tpu.ops.nfa import _next_pow2
+        from emqx_tpu.ops.route_index import RouteIndex
+
+        if len(T.words(filter_)) > self.max_levels:
+            return None
+        idx = RouteIndex()
+        idx.add(filter_)
+        shape_tables = {
+            k: jax.device_put(v.copy())
+            for k, v in idx.shapes.device_snapshot().items()
+        }
+        with_nfa = idx.residual_count > 0
+        nfa_tables = (
+            {
+                k: jax.device_put(v.copy())
+                for k, v in idx.nfa.device_snapshot().items()
+            }
+            if with_nfa
+            else None
+        )
+        m_active = min(
+            _next_pow2(max(4, idx.shapes.num_active_shapes())),
+            idx.shapes.max_shapes,
+        )
+        out: List[str] = []
+        outs = []
+        for c in range(len(self._host_b)):
+            if self._dev[c] is None:
+                self._dev[c] = (
+                    jax.device_put(self._host_b[c]),
+                    jax.device_put(self._host_l[c]),
+                )
+            bm, ln = self._dev[c]
+            r = shape_route_step(
+                shape_tables,
+                nfa_tables,
+                None,
+                bm,
+                ln,
+                m_active=m_active,
+                with_nfa=with_nfa,
+                salt=idx.salt,
+                max_levels=self.max_levels,
+            )
+            # dispatch all chunks before reading any back (pipelining)
+            outs.append((c, r["mcount"]))
+        nrows = len(self._by_row)
+        for c, mcount in outs:
+            hit_rows = np.nonzero(np.asarray(mcount))[0]
+            base = c * CHUNK
+            for i in hit_rows:
+                row = base + int(i)
+                # padding rows (len 0) can match plen-0 filters like '#'
+                t = self._by_row[row] if row < nrows else None
+                # host verification: false candidates cost a check, false
+                # replay would cost correctness
+                if t is not None and T.match(t, filter_):
+                    out.append(t)
+        return out
+
+    def match_many(self, filters: List[str]) -> Dict[str, np.ndarray]:
+        """Answer a replay STORM: many wildcard subscribes in one pass.
+
+        All filters enter ONE shape table; each chunk launch matches every
+        stored topic against every filter simultaneously, and the [B, M]
+        result (one fid lane per filter shape — within a shape at most one
+        filter matches a topic, so the lanes are exact) scatters rows to
+        subscribers. Per-storm cost is the same handful of kernel launches
+        a single filter pays — the storm amortizes to ~O(1) passes, vs the
+        reference's O(store) walk PER subscriber.
+
+        Returns {filter: row-index array}; materialize topics lazily with
+        `topic_at`. Unlike `match`, hits are spot-checked (sampled), not
+        exhaustively re-verified — the 2^-64 combined-hash collision class
+        is accepted here, matching the module's differential test gate.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from emqx_tpu.models.router_model import shape_route_step
+        from emqx_tpu.ops.nfa import _next_pow2
+        from emqx_tpu.ops.route_index import RouteIndex
+
+        idx = RouteIndex()
+        fids: Dict[int, str] = {}
+        for f in filters:
+            if len(T.words(f)) > self.max_levels:
+                raise ValueError(f"filter too deep for device budget: {f}")
+            fids[idx.add(f)] = f
+        shape_tables = {
+            k: jax.device_put(v.copy())
+            for k, v in idx.shapes.device_snapshot().items()
+        }
+        with_nfa = idx.residual_count > 0
+        nfa_tables = (
+            {
+                k: jax.device_put(v.copy())
+                for k, v in idx.nfa.device_snapshot().items()
+            }
+            if with_nfa
+            else None
+        )
+        m_active = min(
+            _next_pow2(max(1, idx.shapes.num_active_shapes())),
+            idx.shapes.max_shapes,
+        )
+        outs = []
+        for c in range(len(self._host_b)):
+            if self._dev[c] is None:
+                self._dev[c] = (
+                    jax.device_put(self._host_b[c]),
+                    jax.device_put(self._host_l[c]),
+                )
+            bm, ln = self._dev[c]
+            r = shape_route_step(
+                shape_tables,
+                nfa_tables,
+                None,
+                bm,
+                ln,
+                m_active=m_active,
+                with_nfa=with_nfa,
+                salt=idx.salt,
+                max_levels=self.max_levels,
+            )
+            outs.append((c, r["matched"]))
+        nrows = len(self._by_row)
+        # vectorized liveness mask: tombstoned rows (removed topics) can
+        # still match plen-0 filters like '#' via their zeroed length
+        live = np.zeros(nrows, dtype=bool)
+        for r, t in enumerate(self._by_row):
+            live[r] = t is not None
+        by_fid: Dict[int, List[np.ndarray]] = {}
+        rng = np.random.default_rng(0)
+        checked = 0
+        for c, matched in outs:
+            m = np.asarray(matched)  # [CHUNK, M(+K)]
+            base = c * CHUNK
+            for lane in range(m.shape[1]):
+                col = m[:, lane]
+                rows = np.nonzero(col >= 0)[0]
+                if not len(rows):
+                    continue
+                rows_g = rows + base
+                keep = rows_g < nrows
+                rows, rows_g = rows[keep], rows_g[keep]
+                keep = live[rows_g]
+                rows, rows_g = rows[keep], rows_g[keep]
+                for fid in np.unique(col[rows]):
+                    sel = rows_g[col[rows] == fid]
+                    by_fid.setdefault(int(fid), []).append(sel)
+                    if checked < 64 and len(sel):  # sampled verification
+                        row = int(rng.choice(sel))
+                        t = self._by_row[row]
+                        f = fids.get(int(fid))
+                        assert f is None or T.match(t, f), (t, f)
+                        checked += 1
+        out: Dict[str, np.ndarray] = {f: np.empty(0, np.int64) for f in filters}
+        for fid, chunks in by_fid.items():
+            f = fids.get(fid)
+            if f is not None:
+                out[f] = np.concatenate(chunks)
+        return out
+
+    def topic_at(self, row: int) -> Optional[str]:
+        return self._by_row[row] if 0 <= row < len(self._by_row) else None

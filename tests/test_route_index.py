@@ -179,3 +179,41 @@ def test_parse_shape():
     assert ShapeIndex.parse_shape("+") == (0, 1, False, ["+"])
     deep = "/".join(["a"] * 40)
     assert ShapeIndex.parse_shape(deep) is None  # beyond mask width
+
+
+def test_device_retained_replay_differential():
+    """DeviceRetainedIndex vs the CPU trie walk (BASELINE config 5 path)."""
+    import random as _r
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.retainer import Retainer
+
+    _r.seed(5)
+    # device path forced from 50 topics up
+    ret = Retainer(device_threshold=50, enable_device=True)
+    cpu = Retainer(device_threshold=1 << 30)  # never uses the device
+    topics = set()
+    for i in range(3000):
+        t = f"site/{i % 37}/dev/{i % 211}/ch/{i}"
+        topics.add(t)
+    topics.add("$other/hidden")  # $-root must not match "#"
+    for t in topics:
+        m = Message(topic=t, payload=b"r", retain=True)
+        ret._insert(m)
+        cpu._insert(m)
+    assert ret._device is not None and ret._device_unfit == 0
+
+    for f in ("site/3/dev/+/ch/#", "site/+/dev/7/#", "#", "site/3/#",
+              "nomatch/#", "+/+/+/+/+/+"):
+        want = sorted(m.topic for m in cpu.match(f))
+        got = sorted(m.topic for m in ret.match(f))
+        assert got == want, f
+
+    # deletion keeps the two in sync (tombstoned rows never match)
+    victims = [t for t in list(topics)[:100]]
+    for t in victims:
+        ret.delete(t)
+        cpu.delete(t)
+    want = sorted(m.topic for m in cpu.match("site/+/dev/+/ch/#"))
+    got = sorted(m.topic for m in ret.match("site/+/dev/+/ch/#"))
+    assert got == want
