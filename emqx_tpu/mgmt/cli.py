@@ -4,7 +4,12 @@ emqx_mgmt_cli.erl). Talks to the running broker's REST API.
 Usage: python -m emqx_tpu.mgmt.cli [--url http://127.0.0.1:18083] [--key K] CMD
 Commands: status | metrics | stats | clients | client <id> | kick <id> |
 subscriptions | routes | publish <topic> <payload> [--qos N] [--retain] |
-banned | ban <kind> <value> | unban <kind> <value> | retained | configs
+banned | ban <kind> <value> | unban <kind> <value> | retained | configs |
+set_config <path> <json> | gateways | gateway_load <type> <opts-json> |
+gateway_unload <name> | bridges | bridge_create <id> <opts-json> |
+bridge_restart <id> | bridge_delete <id> | plugins |
+plugin_install <path> | plugin_start <ref> | plugin_stop <ref> |
+plugin_uninstall <ref> | monitor | telemetry | rules | alarms | trace
 """
 
 from __future__ import annotations
@@ -41,7 +46,27 @@ _MIN_ARGS = {
     "publish": 1,
     "ban": 2,
     "unban": 2,
+    "set_config": 2,
+    "gateway_load": 1,
+    "gateway_unload": 1,
+    "bridge_create": 2,
+    "bridge_restart": 1,
+    "bridge_delete": 1,
+    "plugin_install": 1,
+    "plugin_start": 1,
+    "plugin_stop": 1,
+    "plugin_uninstall": 1,
 }
+
+
+def _json_arg(s: str):
+    """Strict parse for arguments documented as <json>: a typo must fail
+    loudly client-side, not travel as a quoted string."""
+    try:
+        return json.loads(s)
+    except ValueError as e:
+        print(f"invalid JSON argument {s!r}: {e}", file=sys.stderr)
+        raise SystemExit(2)
 
 
 def main(argv=None) -> int:
@@ -110,6 +135,55 @@ def main(argv=None) -> int:
         code, out = _call(f"{base}/banned/{rest[0]}/{rest[1]}", a.key, "DELETE")
     elif cmd == "retained":
         code, out = _call(f"{base}/retainer/messages", a.key)
+    elif cmd == "set_config":
+        code, out = _call(
+            f"{base}/configs/{rest[0].replace('.', '/')}",
+            a.key,
+            "PUT",
+            _json_arg(rest[1]),
+        )
+    elif cmd == "gateways":
+        code, out = _call(f"{base}/gateways", a.key)
+    elif cmd == "gateway_load":
+        body = {"type": rest[0]}
+        if len(rest) > 1:
+            body["opts"] = _json_arg(rest[1])
+        code, out = _call(f"{base}/gateways", a.key, "POST", body)
+    elif cmd == "gateway_unload":
+        code, out = _call(f"{base}/gateways/{rest[0]}", a.key, "DELETE")
+    elif cmd == "bridges":
+        code, out = _call(f"{base}/bridges", a.key)
+    elif cmd == "bridge_create":
+        code, out = _call(
+            f"{base}/bridges", a.key, "POST",
+            {"id": rest[0], "opts": _json_arg(rest[1])},
+        )
+    elif cmd == "bridge_restart":
+        code, out = _call(f"{base}/bridges/{rest[0]}/restart", a.key, "POST")
+    elif cmd == "bridge_delete":
+        code, out = _call(f"{base}/bridges/{rest[0]}", a.key, "DELETE")
+    elif cmd == "plugins":
+        code, out = _call(f"{base}/plugins", a.key)
+    elif cmd == "plugin_install":
+        code, out = _call(
+            f"{base}/plugins/install", a.key, "POST", {"path": rest[0]}
+        )
+    elif cmd == "plugin_start":
+        code, out = _call(f"{base}/plugins/{rest[0]}/start", a.key, "PUT")
+    elif cmd == "plugin_stop":
+        code, out = _call(f"{base}/plugins/{rest[0]}/stop", a.key, "PUT")
+    elif cmd == "plugin_uninstall":
+        code, out = _call(f"{base}/plugins/{rest[0]}", a.key, "DELETE")
+    elif cmd == "monitor":
+        code, out = _call(f"{base}/monitor_current", a.key)
+    elif cmd == "telemetry":
+        code, out = _call(f"{base}/telemetry/data", a.key)
+    elif cmd == "rules":
+        code, out = _call(f"{base}/rules", a.key)
+    elif cmd == "alarms":
+        code, out = _call(f"{base}/alarms", a.key)
+    elif cmd == "trace":
+        code, out = _call(f"{base}/trace", a.key)
     else:
         print(f"unknown command: {cmd}", file=sys.stderr)
         return 2

@@ -68,6 +68,46 @@ class RouteIndex:
                     self.nfa.add(ef, fid=efid)
         return fid
 
+    def bulk_add(self, filters) -> List[int]:
+        """Vectorized insert (cold start / session restore): one numpy
+        tokenizer pass + vectorized table build instead of per-filter
+        hashing. Returns fids, parallel to `filters`. Matches repeated
+        `add` bit-for-bit (tests enforce)."""
+        # validate EVERYTHING before any mutation: an invalid filter must
+        # not leave earlier batch entries half-registered (named but not
+        # indexed => silently unroutable)
+        for f in filters:
+            if f not in self._names:
+                T.validate(f)
+        fids: List[int] = []
+        fresh: List[tuple] = []
+        for f in filters:
+            fid = self._names.get(f)
+            if fid is not None:
+                self._refs[fid] += 1
+                fids.append(fid)
+                continue
+            if self._free:
+                fid = self._free.pop()
+                self._ids[fid] = f
+                self._refs[fid] = 1
+            else:
+                fid = len(self._ids)
+                self._ids.append(f)
+                self._refs.append(1)
+            self._names[f] = fid
+            fids.append(fid)
+            fresh.append((f, fid))
+        if fresh:
+            for ef, efid in self.shapes.bulk_add(fresh):
+                self._residual.add(ef)
+                self.nfa.add(ef, fid=efid)
+            while self.nfa.salt != self.shapes.salt:
+                for ef, efid in self.shapes.rebuild(self.nfa.salt):
+                    self._residual.add(ef)
+                    self.nfa.add(ef, fid=efid)
+        return fids
+
     def remove(self, filter_: str) -> bool:
         fid = self._names.get(filter_)
         if fid is None:

@@ -61,6 +61,17 @@ SLOT_SHIFT = 14
 TOMB_FID = -2  # tombstoned table slot (fid lane)
 
 
+def _mix32_np(x):
+    """Vectorized `_mix32` (numpy uint32, wraps mod 2^32)."""
+    x = x.astype(np.uint32)
+    x ^= x >> np.uint32(16)
+    x = x * np.uint32(0x7FEB352D)
+    x ^= x >> np.uint32(15)
+    x = x * np.uint32(0x846CA68B)
+    x ^= x >> np.uint32(16)
+    return x
+
+
 def _mix32(x: int) -> int:
     x &= _M32
     x ^= x >> 16
@@ -184,8 +195,11 @@ class ShapeIndex:
         self._shape_refs[sid] = 1
         rootwild = (plen == 0 and has_hash) or (plen > 0 and not (mask & 1))
         flags = (1 if has_hash else 0) | (2 if rootwild else 0)
-        self.arr_shape_mask[sid] = mask
-        self._log("shape_mask", sid, mask)
+        # int32 wrap: a 32-literal-level mask sets bit 31; the device's
+        # arithmetic shift + &1 reads bits identically either way
+        mask_i32 = int(np.int32(np.uint32(mask)))
+        self.arr_shape_mask[sid] = mask_i32
+        self._log("shape_mask", sid, mask_i32)
         self.arr_shape_flags[sid] = flags
         self._log("shape_flags", sid, flags)
         self.arr_shape_len[sid] = plen
@@ -227,33 +241,59 @@ class ShapeIndex:
         self._rehash(self._Tcap * 2)
 
     def _rehash(self, newT: int) -> None:
+        """Rebuild the table from `_entries` (vectorized placement).
+
+        Any placement within MAX_PROBES of an entry's home slot is valid
+        for lookup (host and device probe the full bound), so placement
+        runs in probe ROUNDS: in round p every still-unplaced entry bids
+        for its home+p slot, first bidder per empty slot wins. Entries
+        left after MAX_PROBES rounds double the table and retry.
+        """
+        ents = list(self._entries.values())
+        n = len(ents)
+        if n == 0:
+            tab = np.zeros((newT, 4), np.int32)
+            tab[:, 2] = -1
+            self._Tcap = newT
+            self.arr_table = tab
+            self._fill = 0
+            self._bump_epoch()
+            return
+        sid = np.array([e[0] for e in ents], np.int64)
+        c1 = np.array([e[1] & 0xFFFFFFFF for e in ents], np.uint32)
+        c2 = np.array([e[2] & 0xFFFFFFFF for e in ents], np.uint32)
+        fid = np.array([e[3] for e in ents], np.int64)
+        with np.errstate(over="ignore"):
+            home = c1 * np.uint32(SLOT_MUL)
+            home = home ^ (home >> np.uint32(SLOT_SHIFT))
         while True:
             tab = np.zeros((newT, 4), np.int32)
             tab[:, 2] = -1
-            ok = True
-            for _f, (sid, c1, c2, fid) in self._entries.items():
-                slot = slot_hash(c1) & (newT - 1)
-                placed = False
-                for p in range(MAX_PROBES):
-                    idx = (slot + p) & (newT - 1)
-                    if tab[idx, 2] == -1:
-                        tab[idx] = (
-                            np.int32(np.uint32(c1)),
-                            np.int32(np.uint32(c2)),
-                            fid,
-                            sid,
-                        )
-                        placed = True
-                        break
-                if not placed:
-                    ok = False
+            unplaced = np.arange(n)
+            for p in range(MAX_PROBES):
+                if not len(unplaced):
                     break
-            if ok:
+                idx = (home[unplaced] + np.uint32(p)) & np.uint32(newT - 1)
+                idx = idx.astype(np.int64)
+                free = tab[idx, 2] == -1
+                cand = unplaced[free]
+                cidx = idx[free]
+                # first bidder per distinct empty slot wins this round
+                _, first = np.unique(cidx, return_index=True)
+                win, widx = cand[first], cidx[first]
+                tab[widx, 0] = c1[win].view(np.int32)
+                tab[widx, 1] = c2[win].view(np.int32)
+                tab[widx, 2] = fid[win]
+                tab[widx, 3] = sid[win]
+                placed_mask = np.zeros(n, bool)
+                placed_mask[win] = True
+                unplaced = unplaced[~placed_mask[unplaced]]
+            if not len(unplaced):
                 break
             newT *= 2
         self._Tcap = newT
         self.arr_table = tab
-        self._fill = len(self._entries)
+        self._fill = n
         self._bump_epoch()
 
     def add(self, filter_: str, fid: int) -> bool:
@@ -276,6 +316,80 @@ class ShapeIndex:
         self._entries[filter_] = (sid, c1, c2, fid)
         self._place(c1, c2, fid, sid)
         return True
+
+    def bulk_add(self, entries: List[Tuple[str, int]]) -> List[Tuple[str, int]]:
+        """Vectorized insert of many (filter, fid) pairs; returns the
+        REJECTED pairs (shape overflow / hash collision / unparseable) the
+        caller must route to the residual engine.
+
+        The cold-start path (restore 10M subscriptions): per-level word
+        hashes come from the numpy mirror of the device tokenizer in one
+        pass, combined hashes and table placement are vectorized; results
+        are bit-identical to repeated `add` calls. Ends with an epoch bump
+        (one full device upload) instead of millions of op-log entries.
+        """
+        from emqx_tpu.ops.tokenizer import encode_topics, tokenize_host_np
+
+        rejected: List[Tuple[str, int]] = []
+        metas = []  # (filter, fid, sid, key=(mask, plen, has_hash))
+        raw: List[str] = []
+        for f, fid in entries:
+            parsed = self.parse_shape(f)
+            if parsed is None:
+                rejected.append((f, fid))
+                continue
+            mask, plen, has_hash, _prefix = parsed
+            sid = self._shape_for(mask, plen, has_hash)
+            if sid is None:
+                rejected.append((f, fid))
+                continue
+            metas.append((f, fid, sid, (mask, plen, has_hash)))
+            raw.append(f)
+        if not metas:
+            return rejected
+        L = MAX_MASK_LEVELS
+        # row width sized to the actual data (so every row fits by
+        # construction) and rows processed in blocks: a fixed 8*L width at
+        # 1M+ filters costs GBs of cumsum intermediates
+        maxlen = max(16, max(len(f.encode()) for f in raw))
+        width = 1 << (maxlen - 1).bit_length()
+        masks = np.array([m[3][0] for m in metas], dtype=np.int64)
+        sids = np.array([m[2] for m in metas], dtype=np.uint32)
+        k1 = np.array([level_mul(l, 1) for l in range(L)], dtype=np.uint32)
+        k2 = np.array([level_mul(l, 2) for l in range(L)], dtype=np.uint32)
+        lvls = np.arange(L)[None, :]
+        n = len(raw)
+        c1s = np.empty(n, np.uint32)
+        c2s = np.empty(n, np.uint32)
+        BLOCK = 1 << 18
+        with np.errstate(over="ignore"):
+            for lo in range(0, n, BLOCK):
+                hi = min(lo + BLOCK, n)
+                mat, lens, _tl = encode_topics(raw[lo:hi], width)
+                h1, h2, _nw, _dl, _ws, _wl = tokenize_host_np(
+                    mat, lens, self.salt, L
+                )
+                lb = ((masks[lo:hi, None] >> lvls) & 1).astype(np.uint32)
+                s1 = np.sum(h1 * k1[None, :] * lb, axis=1, dtype=np.uint32)
+                s2 = np.sum(h2 * k2[None, :] * lb, axis=1, dtype=np.uint32)
+                c1s[lo:hi] = _mix32_np(s1 ^ (sids[lo:hi] * np.uint32(FOLD1)))
+                c2s[lo:hi] = _mix32_np(s2 ^ (sids[lo:hi] * np.uint32(FOLD2)))
+        # grow once to the final load factor
+        need = len(self._entries) + len(metas)
+        newT = self._Tcap
+        while (need + 1) * 2 > newT:
+            newT *= 2
+        for i, (f, fid, sid, key) in enumerate(metas):
+            c1, c2 = int(c1s[i]), int(c2s[i])
+            other = self._by_key.get((c1, c2))
+            if other is not None and other != f:
+                self._shape_release(sid, key)
+                rejected.append((f, fid))
+                continue
+            self._by_key[(c1, c2)] = f
+            self._entries[f] = (sid, c1, c2, fid)
+        self._rehash(newT)  # places everything; bumps epoch once
+        return rejected
 
     def remove(self, filter_: str) -> bool:
         ent = self._entries.pop(filter_, None)

@@ -161,6 +161,82 @@ def tokenize_device(bytes_mat, lengths, salt: int, max_levels: int):
     return h1, h2, nwords, is_dollar
 
 
+def tokenize_host_np(bytes_mat, lengths, salt: int, max_levels: int):
+    """Numpy mirror of `tokenize_device`, bit-for-bit.
+
+    The vectorized host half of bulk subscription loads: computing a
+    million filters' word hashes one Python call at a time
+    (nfa.word_hash_pair) is the cold-start bottleneck; this produces the
+    same (h1, h2, nwords, is_dollar) — plus the word extents the shape
+    compiler needs — with a handful of numpy passes.
+
+    Returns (h1, h2, nwords, is_dollar, wstart, wlen); all uint32/int32
+    arrays shaped like the device variant's.
+    """
+    B, MB = bytes_mat.shape
+    L = max_levels
+    pw1, ipw1, pw2, ipw2 = _pow_tables(MB)
+    cols = np.arange(MB, dtype=np.int32)
+    inb = cols[None, :] < lengths[:, None]
+    c = bytes_mat.astype(np.uint32)
+    issep = inb & (bytes_mat == SLASH)
+    ischar = inb & ~issep
+    segex = np.cumsum(issep, axis=1, dtype=np.int32) - issep.astype(np.int32)
+    rows = np.arange(B, dtype=np.int32)[:, None]
+
+    with np.errstate(over="ignore"):
+        u1 = np.where(ischar, c * ipw1[cols][None, :], np.uint32(0))
+        u2 = np.where(ischar, c * ipw2[cols][None, :], np.uint32(0))
+        U1 = np.cumsum(u1, axis=1, dtype=np.uint32)
+        U2 = np.cumsum(u2, axis=1, dtype=np.uint32)
+
+        # slot L is the discard bucket (device uses scatter mode="drop");
+        # separators past L words clip into it
+        sep_slot = np.minimum(np.where(issep, segex, L), L)
+        sepcol = np.full((B, L + 1), -1, dtype=np.int32)
+        sepcol[rows, sep_slot] = np.broadcast_to(cols[None, :], (B, MB))
+        sepcol = sepcol[:, :L]
+        k = np.arange(L, dtype=np.int32)[None, :]
+        nsep = np.sum(issep, axis=1).astype(np.int32)
+        nwords = nsep + 1
+        has_sep = sepcol >= 0
+        wend = np.where(has_sep, sepcol - 1, lengths[:, None] - 1)
+        prev_sep = np.concatenate(
+            [np.full((B, 1), -1, dtype=np.int32), sepcol[:, : L - 1]], axis=1
+        )
+        wstart = prev_sep + 1
+        wlen = wend - wstart + 1
+
+        def word_hash(U, pw, salt_mul, salt_add):
+            e = np.clip(wend, 0, MB - 1)
+            s0 = np.clip(wstart - 1, 0, MB - 1)
+            Ue = np.take_along_axis(U, e, axis=1)
+            Us = np.where(
+                wstart > 0,
+                np.take_along_axis(U, s0, axis=1),
+                np.uint32(0),
+            )
+            raw = (Ue - Us) * pw[e] + pw[np.clip(wlen, 0, MB)]
+            seed = np.uint32(
+                (int(salt) * int(salt_mul) + salt_add) & 0xFFFFFFFF
+            )
+            x = raw ^ seed
+            x ^= x >> np.uint32(16)
+            x = x * np.uint32(0x7FEB352D)
+            x ^= x >> np.uint32(15)
+            x = x * np.uint32(0x846CA68B)
+            x ^= x >> np.uint32(16)
+            return x
+
+        h1 = word_hash(U1, pw1, int(_SALT1), 1)
+        h2 = word_hash(U2, pw2, int(_SALT2), 7)
+    valid_word = k < np.minimum(nwords, L)[:, None]
+    h1 = np.where(valid_word, h1, np.uint32(0))
+    h2 = np.where(valid_word, h2, np.uint32(0))
+    is_dollar = (lengths > 0) & (bytes_mat[:, 0] == DOLLAR)
+    return h1, h2, nwords, is_dollar, wstart, wlen
+
+
 def vocab_lookup_device(tables, h1, h2, probes: int = 8):
     """jnp: word hash pairs -> dense symbol ids (-1 = out-of-vocabulary)."""
     import jax.numpy as jnp

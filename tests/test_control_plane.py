@@ -183,6 +183,31 @@ async def test_cli_against_running_app():
         assert rc == 0
         rc, out = await loop.run_in_executor(None, run_cli, "banned")
         assert out["data"][0]["value"] == "bad"
+        # round-2 surfaces: gateways, bridges, runtime config, monitor
+        rc, out = await loop.run_in_executor(
+            None, run_cli, "gateway_load", "stomp",
+            '{"bind": "127.0.0.1", "port": 0}',
+        )
+        assert rc == 0 and out["name"] == "stomp"
+        rc, out = await loop.run_in_executor(None, run_cli, "gateways")
+        assert out["data"][0]["running"] is True
+        rc, out = await loop.run_in_executor(
+            None, run_cli, "gateway_unload", "stomp"
+        )
+        assert rc == 0
+        rc, out = await loop.run_in_executor(
+            None, run_cli, "set_config", "mqtt", '{"max_qos_allowed": 1}'
+        )
+        assert rc == 0 and out["max_qos_allowed"] == 1
+        assert app.channel_config.caps.max_qos_allowed == 1
+        rc, out = await loop.run_in_executor(None, run_cli, "monitor")
+        assert "connections" in out
+        rc, out = await loop.run_in_executor(None, run_cli, "bridges")
+        assert rc == 0 and out["data"] == []
+        rc, out = await loop.run_in_executor(None, run_cli, "plugins")
+        assert rc == 0
+        rc, out = await loop.run_in_executor(None, run_cli, "telemetry")
+        assert rc == 0 and "uuid" in out
     finally:
         await app.stop()
 

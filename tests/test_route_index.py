@@ -217,3 +217,57 @@ def test_device_retained_replay_differential():
     want = sorted(m.topic for m in cpu.match("site/+/dev/+/ch/#"))
     got = sorted(m.topic for m in ret.match("site/+/dev/+/ch/#"))
     assert got == want
+
+
+def test_bulk_add_equivalent_to_incremental():
+    """bulk_add must produce the same combined hashes/entries as add()."""
+    random.seed(21)
+    filters = []
+    for i in range(4000):
+        kind = i % 5
+        if kind == 0:
+            filters.append(f"plant/{i % 97}/line/{i % 11}/m")
+        elif kind == 1:
+            filters.append(f"plant/{i % 97}/+/{i % 11}/#")
+        elif kind == 2:
+            filters.append(f"+/{i % 397}/state")
+        elif kind == 3:
+            filters.append(f"deep/{'x/' * (i % 6)}end{i}")
+        else:
+            filters.append(f"plant/{i}/#")
+    filters = sorted(set(filters))
+
+    inc = RouteIndex()
+    fids_inc = [inc.add(f) for f in filters]
+    blk = RouteIndex()
+    fids_blk = blk.bulk_add(filters)
+    assert fids_inc == fids_blk
+    assert blk.residual_count == inc.residual_count
+    # identical hash entries per filter
+    for f in filters:
+        if f in blk._residual:
+            continue
+        assert blk.shapes._entries[f] == inc.shapes._entries[f], f
+    # refcount semantics: bulk over existing refs
+    again = blk.bulk_add(filters[:10])
+    assert again == fids_blk[:10]
+    assert blk.remove(filters[0]) is False  # still referenced
+
+    # and matching agrees with the trie
+    trie = TopicTrie()
+    for f in filters:
+        trie.insert(f)
+    check(trie, blk, ["plant/5/line/7/m", "plant/5/q/7/x", "a/398/state",
+                      "q/12/state", "deep/x/end7", "plant/123/a/b"])
+
+
+def test_bulk_add_rejects_invalid_atomically():
+    idx = RouteIndex()
+    with pytest.raises(Exception):
+        idx.bulk_add(["ok/t", "bad/#/middle"])
+    # nothing half-registered: the batch validated before any mutation
+    assert len(idx) == 0
+    assert idx.filter_id("ok/t") is None
+    fid = idx.add("ok/t")  # still fully indexable afterwards
+    assert idx.shapes._entries.get("ok/t") is not None
+    assert fid == 0
