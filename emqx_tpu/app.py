@@ -128,6 +128,8 @@ class BrokerApp:
         self.retainer = Retainer(
             max_retained=c.retainer.max_retained_messages,
             max_payload=c.retainer.max_payload_size,
+            device_threshold=c.retainer.device_threshold,
+            enable_device=c.router.enable_tpu,
         )
         self.retainer.enabled = c.retainer.enable
         self.retainer.attach(self.hooks)
@@ -296,6 +298,26 @@ class BrokerApp:
         )
         self.slow_subs.enabled = ob.slow_subs.enable
         self.slow_subs.attach(self.hooks)
+
+        # license (lib-ee/emqx_license analog): verify + expiry alarms +
+        # connection gate; community/unlimited when no key is configured
+        from emqx_tpu import license as lic_mod
+
+        if c.license.key:
+            if not c.license.pubkey_n:
+                from emqx_tpu.config.schema import ConfigError
+
+                raise ConfigError(
+                    "license.key is set but license.pubkey_n (hex modulus "
+                    "of the verifier key) is missing"
+                )
+            pub = (int(c.license.pubkey_n, 16), c.license.pubkey_e)
+            self.license = lic_mod.LicenseChecker(
+                lic_mod.parse(c.license.key, pub), alarms=self.alarms
+            )
+        else:
+            self.license = lic_mod.LicenseChecker(alarms=self.alarms)
+        self.license.attach(self.hooks, self.cm)
         self.topic_metrics = TopicMetrics()
         self.topic_metrics.attach(self.hooks)
         self.event_message = EventMessage(
@@ -660,6 +682,7 @@ class BrokerApp:
                     self.vm_mon.check(now)
                 self.slow_subs.sweep(now)
                 self.alarms.sweep(now)
+                self.license.tick(now)
                 self.topic_metrics.tick_rates(now)
                 if (
                     self.session_persistence is not None

@@ -312,6 +312,17 @@ class RuleSpec:
 
 
 @dataclass
+class LicenseConfig:
+    """Enterprise license (lib-ee/emqx_license analog). `key` is the
+    signed license string; `pubkey_n`/`pubkey_e` override the verifier
+    key (hex n). Empty key => community/unlimited."""
+
+    key: str = ""
+    pubkey_n: str = ""
+    pubkey_e: int = 65537
+
+
+@dataclass
 class GatewaySpec:
     """One protocol gateway instance (emqx_gateway config analog).
     type: stomp | mqttsn | exproto; options go in `opts` (bind/port/
@@ -353,6 +364,7 @@ class AppConfig:
     bridges: List[BridgeSpec] = field(default_factory=list)
     psk: PskConfig = field(default_factory=PskConfig)
     plugins: PluginsConfig = field(default_factory=PluginsConfig)
+    license: LicenseConfig = field(default_factory=LicenseConfig)
 
 
 class ConfigError(ValueError):

@@ -241,6 +241,22 @@ class SubscriberTable:
         self.arr[filter_id, w] |= np.uint32(1 << (slot % 32))
         self._log(filter_id, w, int(self.arr[filter_id, w]))
 
+    def bulk_add(self, fids, slots) -> None:
+        """Vectorized (fid, slot) load for cold starts; one epoch bump."""
+        fids = np.asarray(fids, dtype=np.int64)
+        slots = np.asarray(slots, dtype=np.int64)
+        if not len(fids):
+            return
+        self._ensure(int(fids.max()), int(slots.max()))
+        w = slots // 32
+        bits = (np.uint32(1) << (slots % 32).astype(np.uint32)).astype(
+            np.uint32
+        )
+        np.bitwise_or.at(self.arr, (fids, w), bits)
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+
     def remove(self, filter_id: int, slot: int) -> None:
         if filter_id >= self._fcap or slot // 32 >= self.width_words:
             return

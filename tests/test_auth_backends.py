@@ -548,3 +548,57 @@ def test_file_acl_rules():
     assert az.check({"client_id": "c1", "username": "u"}, "publish", "pub/c2/x") == "deny"
     with pytest.raises(ValueError):
         parse_rules('{"who": "all"}')  # missing permit
+
+
+@async_test
+async def test_license_verification_and_gate():
+    """lib-ee/emqx_license parity: signed license, expiry alarm,
+    connection gate."""
+    import time as _time
+
+    from emqx_tpu import license as lic
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+    from tests.minimqtt import MiniClient
+
+    n, e, d = _gen_rsa(1024)
+    key = lic.sign(
+        (n, d),
+        {"customer": "acme", "edition": "enterprise",
+         "max_connections": 2, "expiry_at": _time.time() + 3600},
+    )
+    # standalone parse/verify semantics
+    parsed = lic.parse(key, (n, e))
+    assert parsed.customer == "acme" and parsed.max_connections == 2
+    with pytest.raises(lic.LicenseError):
+        lic.parse(key[:-8] + "AAAAAAAA", (n, e))
+    expired = lic.sign((n, d), {"customer": "x", "expiry_at": 1.0})
+    assert lic.parse(expired, (n, e)).expired()
+
+    app = BrokerApp(
+        load_config(
+            {
+                "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+                "dashboard": {"enable": False},
+                "router": {"enable_tpu": False},
+                "license": {"key": key, "pubkey_n": hex(n)[2:]},
+            }
+        )
+    )
+    await app.start()
+    try:
+        port = list(app.listeners.list().values())[0].port
+        c1 = MiniClient("lic-1")
+        assert (await c1.connect("127.0.0.1", port))["rc"] == 0
+        c2 = MiniClient("lic-2")
+        assert (await c2.connect("127.0.0.1", port))["rc"] == 0
+        c3 = MiniClient("lic-3")  # over max_connections=2
+        ack = await c3.connect("127.0.0.1", port)
+        assert ack["rc"] != 0
+        await c1.disconnect()
+        await asyncio.sleep(0.1)
+        c4 = MiniClient("lic-4")  # slot freed
+        assert (await c4.connect("127.0.0.1", port))["rc"] == 0
+        assert app.license.license.info()["customer"] == "acme"
+    finally:
+        await app.stop()
