@@ -153,10 +153,7 @@ def bench_config(name, rng, measure_updates=False):
         if with_nfa
         else None
     )
-    m_active = min(
-        _next_pow2(max(4, index.shapes.num_active_shapes())),
-        index.shapes.max_shapes,
-    )
+    m_active = index.shapes.m_active()
     sub_bitmaps = jax.device_put(
         subs.pack(index.num_filters_capacity).copy()
     )

@@ -144,10 +144,7 @@ class DeviceRetainedIndex:
             if with_nfa
             else None
         )
-        m_active = min(
-            _next_pow2(max(4, idx.shapes.num_active_shapes())),
-            idx.shapes.max_shapes,
-        )
+        m_active = idx.shapes.m_active()
         out: List[str] = []
         outs = []
         for c in range(len(self._host_b)):
@@ -226,10 +223,7 @@ class DeviceRetainedIndex:
             if with_nfa
             else None
         )
-        m_active = min(
-            _next_pow2(max(1, idx.shapes.num_active_shapes())),
-            idx.shapes.max_shapes,
-        )
+        m_active = idx.shapes.m_active(floor=1)
         outs = []
         for c in range(len(self._host_b)):
             if self._dev[c] is None:

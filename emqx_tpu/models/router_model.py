@@ -315,12 +315,7 @@ class DeviceRouter:
         shape_tables = self._shape_sync.sync(idx.shapes)
         with_nfa = idx.residual_count > 0
         nfa_tables = self._nfa_sync.sync(idx.nfa) if with_nfa else None
-        # pow2 bucket: recompile only on shape-count doublings; never past
-        # the shape arrays' capacity (max_shapes need not be a power of 2)
-        m_active = min(
-            _next_pow2(max(4, idx.shapes.num_active_shapes())),
-            idx.shapes.max_shapes,
-        )
+        m_active = idx.shapes.m_active()
         return shape_tables, nfa_tables, bits, idx.salt, m_active, with_nfa
 
     def prepare(self):

@@ -218,6 +218,16 @@ class ShapeIndex:
         """High-water shape id + 1 (device meta slice length)."""
         return len(self._shape_refs)
 
+    def m_active(self, floor: int = 4) -> int:
+        """Device meta slice length, pow2-bucketed so the jitted step
+        recompiles only on shape-count doublings, clamped to capacity
+        (max_shapes need not be a power of two). The single source for
+        every shape_route_step caller."""
+        return min(
+            _next_pow2(max(floor, self.num_active_shapes())),
+            self.max_shapes,
+        )
+
     def _place(self, c1: int, c2: int, fid: int, sid: int) -> None:
         # NOTE: the caller has already put the entry in self._entries, so a
         # rehash (which rebuilds from _entries) places it — just return.

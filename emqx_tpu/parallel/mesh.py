@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from emqx_tpu.models.router_model import route_step_impl
+from emqx_tpu.models.router_model import route_step_impl, shape_route_step_impl
 
 
 def make_mesh(
@@ -48,6 +48,28 @@ def make_mesh(
     return Mesh(arr, axis_names=("dp", "tp"))
 
 
+# canonical output shardings + stats reduction, shared by both engines
+_OUT_SPECS = {
+    "matched": P("dp", None),
+    "mcount": P("dp"),
+    "flags": P("dp"),
+    "bitmaps": P("dp", "tp"),
+    "stats": {"routed": P(), "matches": P(), "fanout_bits": P()},
+}
+
+
+def _reduce_stats(out):
+    """routed/matches are identical across tp replicas: reduce over dp
+    only. fanout_bits is partial per lane slice: reduce over both axes."""
+    stats = out["stats"]
+    out["stats"] = {
+        "routed": jax.lax.psum(stats["routed"], "dp"),
+        "matches": jax.lax.psum(stats["matches"], "dp"),
+        "fanout_bits": jax.lax.psum(stats["fanout_bits"], ("dp", "tp")),
+    }
+    return out
+
+
 @lru_cache(maxsize=32)
 def _dist_step_fn(
     mesh: Mesh,
@@ -76,28 +98,14 @@ def _dist_step_fn(
             max_matches=max_matches,
             probes=probes,
         )
-        stats = out["stats"]
-        # routed/matches are identical across tp replicas: reduce over dp only.
-        # fanout_bits is partial per lane slice: reduce over both axes.
-        out["stats"] = {
-            "routed": jax.lax.psum(stats["routed"], "dp"),
-            "matches": jax.lax.psum(stats["matches"], "dp"),
-            "fanout_bits": jax.lax.psum(stats["fanout_bits"], ("dp", "tp")),
-        }
-        return out
+        return _reduce_stats(out)
 
     table_specs = {k: P() for k in table_keys}
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(table_specs, P(None, "tp"), P("dp", None), P("dp")),
-        out_specs={
-            "matched": P("dp", None),
-            "mcount": P("dp"),
-            "flags": P("dp"),
-            "bitmaps": P("dp", "tp"),
-            "stats": {"routed": P(), "matches": P(), "fanout_bits": P()},
-        },
+        out_specs=_OUT_SPECS,
     )
     return jax.jit(fn)
 
@@ -137,6 +145,83 @@ def dist_route_step(
     return fn(tables, sub_bitmaps, bytes_mat, lengths)
 
 
+@lru_cache(maxsize=32)
+def _dist_shape_step_fn(
+    mesh: Mesh,
+    shape_keys: tuple,
+    nfa_keys: Optional[tuple],
+    m_active: int,
+    salt: int,
+    max_levels: int,
+    frontier: int,
+    max_matches: int,
+    probes: int,
+):
+    """The SERVING engine (shape index + residual NFA + fan-out) sharded
+    over the mesh — same layout as `_dist_step_fn`, both table sets
+    replicated."""
+    with_nfa = nfa_keys is not None
+
+    def local_step(shape_tables, nfa_tables, sub_bitmaps, bytes_mat, lengths):
+        out = shape_route_step_impl(
+            shape_tables,
+            nfa_tables,
+            sub_bitmaps,
+            bytes_mat,
+            lengths,
+            m_active=m_active,
+            with_nfa=with_nfa,
+            salt=salt,
+            max_levels=max_levels,
+            frontier=frontier,
+            max_matches=max_matches,
+            probes=probes,
+        )
+        return _reduce_stats(out)
+
+    shape_specs = {k: P() for k in shape_keys}
+    nfa_specs = {k: P() for k in nfa_keys} if with_nfa else None
+    fn = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(shape_specs, nfa_specs, P(None, "tp"), P("dp", None), P("dp")),
+        out_specs=_OUT_SPECS,
+    )
+    return jax.jit(fn)
+
+
+def dist_shape_route_step(
+    mesh: Mesh,
+    shape_tables: Dict,
+    nfa_tables: Optional[Dict],
+    sub_bitmaps,
+    bytes_mat,
+    lengths,
+    *,
+    m_active: int,
+    salt: int,
+    max_levels: int = 16,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+):
+    """Distributed serving step (shape engine). Sharding as in
+    `dist_route_step`: tables replicated, subscriber lanes on 'tp',
+    topic batch on 'dp', stats psum'd over ICI."""
+    fn = _dist_shape_step_fn(
+        mesh,
+        tuple(sorted(shape_tables)),
+        tuple(sorted(nfa_tables)) if nfa_tables is not None else None,
+        m_active,
+        salt,
+        max_levels,
+        frontier,
+        max_matches,
+        probes,
+    )
+    return fn(shape_tables, nfa_tables, sub_bitmaps, bytes_mat, lengths)
+
+
 def shard_inputs(mesh: Mesh, tables: Dict, sub_bitmaps, bytes_mat, lengths):
     """device_put inputs with the canonical shardings (for repeated calls)."""
     t = {
@@ -147,3 +232,28 @@ def shard_inputs(mesh: Mesh, tables: Dict, sub_bitmaps, bytes_mat, lengths):
     bm = jax.device_put(bytes_mat, NamedSharding(mesh, P("dp", None)))
     ln = jax.device_put(lengths, NamedSharding(mesh, P("dp")))
     return t, sb, bm, ln
+
+
+def shard_shape_inputs(
+    mesh: Mesh,
+    shape_tables: Dict,
+    nfa_tables: Optional[Dict],
+    sub_bitmaps,
+    bytes_mat,
+    lengths,
+):
+    """`shard_inputs` for the serving (shape) engine — the ONE place the
+    canonical layout is declared for its callers (dryrun, tests)."""
+
+    def repl(d):
+        return {
+            k: jax.device_put(v, NamedSharding(mesh, P()))
+            for k, v in d.items()
+        }
+
+    st = repl(shape_tables)
+    nt = repl(nfa_tables) if nfa_tables is not None else None
+    sb = jax.device_put(sub_bitmaps, NamedSharding(mesh, P(None, "tp")))
+    bm = jax.device_put(bytes_mat, NamedSharding(mesh, P("dp", None)))
+    ln = jax.device_put(lengths, NamedSharding(mesh, P("dp")))
+    return st, nt, sb, bm, ln

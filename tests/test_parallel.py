@@ -12,7 +12,8 @@ def test_graft_entry_single():
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
-    assert int(out["stats"]["routed"]) == args[2].shape[0]
+    batch = args[3].shape[0]  # bytes_mat
+    assert int(out["stats"]["routed"]) == batch
     assert not bool(np.asarray(out["flags"]).any())
 
 
@@ -23,15 +24,78 @@ def test_dryrun_multichip(n):
     ge.dryrun_multichip(n)
 
 
-def test_dist_matches_single_device():
-    """The sharded step must produce identical results to the local step."""
-    import jax
-
+@pytest.mark.parametrize("force_residual", [False, True])
+def test_dist_matches_single_device(force_residual):
+    """The sharded serving step must equal the local step bit-for-bit —
+    both with an empty residual engine and with live NFA lanes (forced
+    via a tiny max_shapes so some filters overflow into the NFA)."""
     import __graft_entry__ as ge
-    from emqx_tpu.models.router_model import route_step
+    from emqx_tpu.models.router_model import SubscriberTable, shape_route_step
+    from emqx_tpu.ops.route_index import RouteIndex
+    from emqx_tpu.ops.tokenizer import encode_topics
+    from emqx_tpu.parallel.mesh import (
+        dist_shape_route_step,
+        make_mesh,
+        shard_shape_inputs,
+    )
+
+    index = RouteIndex(max_shapes=2 if force_residual else 64)
+    subs = SubscriberTable(max_subscribers=512)
+    shapes = ["device/%d/+/t%d/#", "plant/%d/s%d", "+/%d/x/%d", "q/%d/%d/#"]
+    for i in range(96):
+        fid = index.add(shapes[i % 4] % (i % 16, i))
+        subs.add(fid, i % 512)
+    assert (index.residual_count > 0) == force_residual
+    with_nfa = index.residual_count > 0
+    topics = [f"device/{i % 16}/x/t{i}/y" for i in range(64)]
+    bytes_mat, lengths, _ = encode_topics(topics, 64)
+    sub_bitmaps = subs.pack(index.num_filters_capacity)
+    m_active = index.shapes.m_active()
+
+    st = index.shapes.device_snapshot()
+    nt = index.nfa.device_snapshot() if with_nfa else None
+    local = shape_route_step(
+        {k: v.copy() for k, v in st.items()},
+        {k: v.copy() for k, v in nt.items()} if nt is not None else None,
+        sub_bitmaps,
+        bytes_mat,
+        np.asarray(lengths),
+        m_active=m_active, with_nfa=with_nfa, salt=index.salt, **ge._CFG,
+    )
+    mesh = make_mesh(8)
+    dst, dnt, sb, bm, ln = shard_shape_inputs(
+        mesh, st, nt, sub_bitmaps, bytes_mat, np.asarray(lengths)
+    )
+    dist = dist_shape_route_step(
+        mesh, dst, dnt, sb, bm, ln,
+        m_active=m_active, salt=index.salt, **ge._CFG,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(local["matched"]), np.asarray(dist["matched"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(local["bitmaps"]), np.asarray(dist["bitmaps"])
+    )
+    for k in local["stats"]:
+        assert int(local["stats"][k]) == int(dist["stats"][k]), k
+
+
+def test_dist_nfa_step_still_works():
+    """The residual-NFA distributed step stays available (legacy path)."""
+    import __graft_entry__ as ge
+    from emqx_tpu.models.router_model import SubscriberTable, route_step
+    from emqx_tpu.ops.nfa import NfaBuilder
+    from emqx_tpu.ops.tokenizer import encode_topics
     from emqx_tpu.parallel.mesh import dist_route_step, make_mesh, shard_inputs
 
-    builder, tables, subs, bytes_mat, lengths = ge._workload(batch=64)
+    builder = NfaBuilder()
+    subs = SubscriberTable(max_subscribers=512)
+    for i in range(64):
+        fid = builder.add(f"n/{i}/+/q")
+        subs.add(fid, i)
+    tables = builder.pack()
+    topics = [f"n/{i % 64}/z/q" for i in range(64)]
+    bytes_mat, lengths, _ = encode_topics(topics, 64)
     sub_bitmaps = subs.pack(builder.num_filters_capacity)
     dev = tables.device_arrays()
     local = route_step(
@@ -39,9 +103,15 @@ def test_dist_matches_single_device():
         salt=tables.salt, **ge._CFG,
     )
     mesh = make_mesh(8)
-    t, sb, bm, ln = shard_inputs(mesh, dev, sub_bitmaps, bytes_mat, np.asarray(lengths))
+    t, sb, bm, ln = shard_inputs(
+        mesh, dev, sub_bitmaps, bytes_mat, np.asarray(lengths)
+    )
     dist = dist_route_step(mesh, t, sb, bm, ln, salt=tables.salt, **ge._CFG)
-    np.testing.assert_array_equal(np.asarray(local["matched"]), np.asarray(dist["matched"]))
-    np.testing.assert_array_equal(np.asarray(local["bitmaps"]), np.asarray(dist["bitmaps"]))
+    np.testing.assert_array_equal(
+        np.asarray(local["matched"]), np.asarray(dist["matched"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(local["bitmaps"]), np.asarray(dist["bitmaps"])
+    )
     for k in local["stats"]:
         assert int(local["stats"][k]) == int(dist["stats"][k]), k
