@@ -576,3 +576,292 @@ def _tz_to_s(tz):
         return None
     sign = 1 if m.group(1) == "+" else -1
     return sign * (int(m.group(2)) * 3600 + int(m.group(3)) * 60)
+
+
+# -- trig / extra math (emqx_rule_funcs.erl math family) ---------------------
+
+for _name in (
+    "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "log2", "log10",
+):
+    def _mk(fname):
+        mf = getattr(math, fname)
+
+        def _f(x, _mf=mf):
+            v = _num(x)
+            try:
+                return _mf(v) if v is not None else None
+            except ValueError:
+                return None
+
+        return _f
+
+    FUNCS[_name] = _mk(_name)
+del _name, _mk
+
+
+@func("mod")
+def _mod(x, y):
+    a, b = _num(x), _num(y)
+    if a is None or b is None or int(b) == 0:
+        return None
+    return int(a) % int(b)
+
+
+@func("fmod")
+def _fmod(x, y):
+    a, b = _num(x), _num(y)
+    if a is None or b in (None, 0):
+        return None
+    return math.fmod(a, b)
+
+
+@func("eq")
+def _eq_fn(a, b):
+    # same semantics as the SQL '=' operator (runtime._eq): bools only
+    # equal themselves, numbers/strings compare through coercion
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    na, nb = _num(a), _num(b)
+    if na is not None and nb is not None:
+        return na == nb
+    return a == b
+
+
+# -- binaries / encoding -----------------------------------------------------
+
+
+@func("bin2hexstr")
+def _bin2hexstr(b):
+    if isinstance(b, str):
+        b = b.encode()
+    return b.hex() if isinstance(b, bytes) else None
+
+
+@func("hexstr2bin")
+def _hexstr2bin(s):
+    try:
+        return bytes.fromhex(_s(s))
+    except ValueError:
+        return None
+
+
+@func("hash")
+def _hash(alg, data):
+    alg = _s(alg).lower()
+    if isinstance(data, str):
+        data = data.encode()
+    if not isinstance(data, bytes):
+        data = _s(data).encode()
+    try:
+        return hashlib.new(alg, data).hexdigest()
+    except ValueError:
+        return None
+
+
+@func("bitsize")
+def _bitsize(b):
+    if isinstance(b, str):
+        b = b.encode()
+    return len(b) * 8 if isinstance(b, bytes) else None
+
+
+@func("subbits", "get_subbits")
+def _subbits(b, *args):
+    """subbits(bytes, len) / subbits(bytes, start, len): big-endian
+    unsigned integer slice (emqx_rule_funcs subbits default mode)."""
+    if isinstance(b, str):
+        b = b.encode()
+    if not isinstance(b, bytes):
+        return None
+    nums = [_num(a) for a in args]
+    if any(v is None for v in nums) or not nums:
+        return None
+    if len(nums) == 1:
+        start, ln = 1, int(nums[0])
+    else:
+        start, ln = int(nums[0]), int(nums[1])
+    bits = int.from_bytes(b, "big")
+    total = len(b) * 8
+    lo = total - (start - 1) - ln
+    if lo < 0 or ln <= 0:
+        return None
+    return (bits >> lo) & ((1 << ln) - 1)
+
+
+# -- topic helpers -----------------------------------------------------------
+
+
+@func("contains_topic")
+def _contains_topic(topics, topic):
+    if not isinstance(topics, list):
+        return False
+    return any(_s(t) == _s(topic) for t in topics)
+
+
+@func("contains_topic_match")
+def _contains_topic_match(filters, topic):
+    from emqx_tpu.ops import topics as _T
+
+    if not isinstance(filters, list):
+        return False
+    return any(_T.match(_s(topic), _s(f)) for f in filters)
+
+
+@func("find_topic_filter")
+def _find_topic_filter(filters, topic):
+    from emqx_tpu.ops import topics as _T
+
+    if not isinstance(filters, list):
+        return None
+    for f in filters:
+        if _T.match(_s(topic), _s(f)):
+            return f
+    return None
+
+
+# -- strings / maps extras ---------------------------------------------------
+
+
+@func("find_s")
+def _find_s(s, sub):
+    """Suffix of `s` from the first occurrence of `sub` ('' if absent)."""
+    s, sub = _s(s), _s(sub)
+    i = s.find(sub)
+    return "" if i < 0 else s[i:]
+
+
+@func("sprintf_s")
+def _sprintf_s(fmt, *args):
+    """Erlang io_lib-style ~s/~p/~w formatting subset."""
+    out = []
+    it = iter(args)
+    i = 0
+    fmt = _s(fmt)
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "~" and i + 1 < len(fmt):
+            d = fmt[i + 1]
+            if d in ("s", "p", "w"):
+                try:
+                    v = next(it)
+                except StopIteration:
+                    return None
+                out.append(_s(v) if d == "s" else json.dumps(v, default=str))
+                i += 2
+                continue
+            if d == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+@func("map_new")
+def _map_new():
+    return {}
+
+
+@func("map_path", "mget_path")
+def _map_path(path, m):
+    """Dotted-path get (map_path("a.b.c", m))."""
+    cur = m
+    for seg in _s(path).split("."):
+        if isinstance(cur, (str, bytes)):
+            try:
+                cur = json.loads(cur)
+            except (ValueError, TypeError):
+                return None
+        if not isinstance(cur, dict) or seg not in cur:
+            return None
+        cur = cur[seg]
+    return cur
+
+
+@func("null")
+def _null():
+    return None
+
+
+@func("now_rfc3339")
+def _now_rfc3339(unit="second"):
+    t = time.time()
+    base = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t))
+    u = _s(unit)
+    if u == "millisecond":
+        return f"{base}.{int(t * 1e3) % 1000:03d}Z"
+    if u == "microsecond":
+        return f"{base}.{int(t * 1e6) % 1000000:06d}Z"
+    return base + "Z"
+
+
+# -- rule-engine KV store / proc dict (emqx_rule_funcs kv_store_*,
+#    proc_dict_* — cross-rule persistent scratch state) ----------------------
+
+# NOTE scope divergence vs the reference: emqx scopes proc_dict_* to the
+# rule's process while kv_store_* is node-global; this runtime evaluates
+# all rules on one loop, so both are node-global (separate namespaces).
+_KV_STORE: Dict[str, Any] = {}
+_PROC_DICT: Dict[str, Any] = {}
+
+
+def _store_put(store, k, v):
+    store[_s(k)] = v
+    return v
+
+
+@func("kv_store_put")
+def _kv_put(k, v):
+    return _store_put(_KV_STORE, k, v)
+
+
+@func("kv_store_get")
+def _kv_get(k, default=None):
+    return _KV_STORE.get(_s(k), default)
+
+
+@func("kv_store_del")
+def _kv_del(k):
+    _KV_STORE.pop(_s(k), None)
+    return None
+
+
+@func("proc_dict_put")
+def _pd_put(k, v):
+    return _store_put(_PROC_DICT, k, v)
+
+
+@func("proc_dict_get")
+def _pd_get(k):
+    return _PROC_DICT.get(_s(k))
+
+
+@func("proc_dict_del")
+def _pd_del(k):
+    _PROC_DICT.pop(_s(k), None)
+    return None
+
+
+# -- message-context accessors (zero-arg funcs reading the rule ctx;
+#    emqx_rule_funcs clientid/0, topic/0, payload/0 etc.) --------------------
+# The runtime special-cases these: they receive the evaluation context.
+
+CONTEXT_FUNCS: Dict[str, Callable[[Dict], Any]] = {
+    "clientid": lambda ctx: ctx.get("clientid"),
+    "username": lambda ctx: ctx.get("username"),
+    "topic": lambda ctx: ctx.get("topic"),
+    "payload": lambda ctx: ctx.get("payload"),
+    "qos": lambda ctx: ctx.get("qos"),
+    "msgid": lambda ctx: ctx.get("id"),
+    "peerhost": lambda ctx: ctx.get("peerhost"),
+    "clientip": lambda ctx: ctx.get("peerhost"),
+    "flags": lambda ctx: ctx.get("flags") or {},
+    "pub_props": lambda ctx: ctx.get("pub_props") or {},
+}
+
+
+def context_flag(ctx: Dict, name) -> Any:
+    return (ctx.get("flags") or {}).get(_s(name))

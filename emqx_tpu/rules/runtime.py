@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-from emqx_tpu.rules.funcs import FUNCS
+from emqx_tpu.rules.funcs import CONTEXT_FUNCS, FUNCS
 from emqx_tpu.rules.sql import (
     BinOp,
     Call,
@@ -108,6 +108,14 @@ def eval_expr(node, ctx: Dict):
                 return eval_expr(result, ctx)
         return eval_expr(node.default, ctx) if node.default is not None else None
     if isinstance(node, Call):
+        # zero-arg message-context accessors (clientid(), topic(), ...)
+        cf = CONTEXT_FUNCS.get(node.name)
+        if cf is not None and not node.args:
+            return cf(ctx)
+        if node.name == "flag" and len(node.args) == 1:
+            from emqx_tpu.rules.funcs import context_flag
+
+            return context_flag(ctx, eval_expr(node.args[0], ctx))
         fn = FUNCS.get(node.name)
         if fn is None:
             raise RuleEvalError(f"unknown function {node.name!r}")

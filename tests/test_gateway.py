@@ -376,3 +376,49 @@ async def test_registry_lifecycle():
     assert await bed.registry.unload("stomp") is True
     assert await bed.registry.unload("stomp") is False
     assert bed.registry.list() == []
+
+
+@async_test
+async def test_gateway_rest_api():
+    """REST load/list/unload of gateways (emqx_mgmt_api_gateway analog)."""
+    import aiohttp
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+
+    app = BrokerApp(
+        load_config(
+            {
+                "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+                "dashboard": {"port": 0, "bind": "127.0.0.1"},
+                "router": {"enable_tpu": False},
+            }
+        )
+    )
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/gateways") as r:
+                assert (await r.json())["data"] == []
+            async with s.post(
+                f"{api}/gateways",
+                json={"type": "stomp", "opts": {"bind": "127.0.0.1", "port": 0}},
+            ) as r:
+                assert r.status == 201
+                st = await r.json()
+                assert st["name"] == "stomp" and st["running"]
+            async with s.get(f"{api}/gateways/stomp") as r:
+                assert r.status == 200
+            # the loaded gateway accepts a real client
+            c = StompClient()
+            await c.connect(app.gateways.get("stomp").port)
+            await c.close()
+            async with s.post(f"{api}/gateways", json={"type": "bogus"}) as r:
+                assert r.status == 400
+            async with s.delete(f"{api}/gateways/stomp") as r:
+                assert r.status == 204
+            async with s.get(f"{api}/gateways/stomp") as r:
+                assert r.status == 404
+    finally:
+        await app.stop()

@@ -395,3 +395,63 @@ def test_sublist_arg_orders():
     assert r["v"] == [10, 20]
     r = test_sql('SELECT sublist(2, 2, payload.arr) AS v FROM "t/#"', c)[0]
     assert r["v"] == [20, 30]
+
+
+def test_extended_function_families():
+    """Round-2 additions: trig/log, binaries, topic helpers, kv store,
+    context accessors (emqx_rule_funcs parity depth)."""
+    import math
+
+    from emqx_tpu.rules.funcs import FUNCS
+    from emqx_tpu.rules.runtime import eval_expr
+    from emqx_tpu.rules.sql import parse_sql
+
+    f = FUNCS
+    assert abs(f["sin"](0) - 0.0) < 1e-9
+    assert abs(f["cos"](0) - 1.0) < 1e-9
+    assert f["log2"](8) == 3.0
+    assert f["log10"](1000) == 3.0
+    assert f["acos"](5) is None  # domain error -> None, not crash
+    assert f["mod"](10, 3) == 1
+    assert f["fmod"](10.5, 3) == 1.5
+    assert f["eq"]("1", 1) is True
+
+    assert f["bin2hexstr"](b"\x01\xff") == "01ff"
+    assert f["hexstr2bin"]("01ff") == b"\x01\xff"
+    assert f["hexstr2bin"]("zz") is None
+    assert f["hash"]("sha256", "abc") == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+    assert f["bitsize"](b"ab") == 16
+    assert f["subbits"](b"\xff\x00", 8) == 255
+    assert f["subbits"](b"\xff\x00", 9, 8) == 0
+
+    assert f["contains_topic"](["a/b", "c"], "a/b") is True
+    assert f["contains_topic_match"](["a/+"], "a/x") is True
+    assert f["find_topic_filter"](["q/#", "a/+"], "a/x") == "a/+"
+
+    assert f["find_s"]("hello/world", "/w") == "/world"
+    assert f["sprintf_s"]("~s-~s", "a", "b") == "a-b"
+    assert f["map_path"]("a.b", {"a": {"b": 7}}) == 7
+    assert f["map_path"]("a.b", '{"a": {"b": 7}}') == 7
+    assert f["map_new"]() == {}
+    assert f["now_rfc3339"]().endswith("Z")
+
+    f["kv_store_put"]("k1", 42)
+    assert f["kv_store_get"]("k1") == 42
+    f["kv_store_del"]("k1")
+    assert f["kv_store_get"]("k1", "gone") == "gone"
+
+    # context accessors through the full SQL path
+    q = parse_sql(
+        "SELECT clientid() as who, topic() as t, qos() as q, "
+        "flag('retain') as r FROM \"s/#\""
+    )
+    ctx = {
+        "clientid": "c-9", "topic": "s/1", "qos": 1,
+        "flags": {"retain": True}, "payload": b"x",
+    }
+    out = {}
+    for item in q.selects:
+        out[item.alias[0] if item.alias else "?"] = eval_expr(item.expr, ctx)
+    assert out == {"who": "c-9", "t": "s/1", "q": 1, "r": True}
