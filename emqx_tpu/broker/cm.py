@@ -32,6 +32,9 @@ class ChannelManager:
     def channel_count(self) -> int:
         return len(self._channels)
 
+    def detached_count(self) -> int:
+        return len(self._detached)
+
     def client_ids(self) -> List[str]:
         return list(self._channels)
 

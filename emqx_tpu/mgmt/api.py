@@ -88,6 +88,7 @@ ROUTES = [
     ("put", "/api/v5/plugins/{ref}/stop", "plugins_stop", "Stop a plugin", "plugins"),
     ("delete", "/api/v5/plugins/{ref}", "plugins_delete", "Uninstall a plugin", "plugins"),
     ("get", "/api/v5/telemetry/data", "telemetry_data", "Inspect the telemetry report", "telemetry"),
+    ("get", "/api/v5/node_dump", "node_dump", "Full node state dump", "node"),
     ("get", "/api-docs", "api_docs", "This OpenAPI document", "meta"),
     ("post", "/api/v5/login", "login", "Obtain an admin JWT", "dashboard"),
     ("get", "/api/v5/monitor_current", "monitor_current", "Latest monitor sample", "dashboard"),
@@ -662,6 +663,11 @@ class MgmtApi:
 
             t = self.app.telemetry = Telemetry(self.app)
         return web.json_response(t.get_telemetry_data())
+
+    async def node_dump(self, request):
+        from emqx_tpu.utils.node_dump import collect
+
+        return web.json_response(collect(self.app), dumps=lambda o: json.dumps(o, default=str))
 
     async def api_docs(self, request):
         from emqx_tpu import __version__

@@ -9,7 +9,8 @@ set_config <path> <json> | gateways | gateway_load <type> <opts-json> |
 gateway_unload <name> | bridges | bridge_create <id> <opts-json> |
 bridge_restart <id> | bridge_delete <id> | plugins |
 plugin_install <path> | plugin_start <ref> | plugin_stop <ref> |
-plugin_uninstall <ref> | monitor | telemetry | rules | alarms | trace
+plugin_uninstall <ref> | monitor | telemetry | rules | alarms | trace |
+node_dump
 """
 
 from __future__ import annotations
@@ -184,6 +185,8 @@ def main(argv=None) -> int:
         code, out = _call(f"{base}/alarms", a.key)
     elif cmd == "trace":
         code, out = _call(f"{base}/trace", a.key)
+    elif cmd == "node_dump":
+        code, out = _call(f"{base}/node_dump", a.key)
     else:
         print(f"unknown command: {cmd}", file=sys.stderr)
         return 2

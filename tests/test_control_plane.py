@@ -267,3 +267,44 @@ async def test_app_with_full_extension_config():
         await c2.disconnect()
     finally:
         await app.stop()
+
+
+@async_test
+async def test_node_dump():
+    """emqx_node_dump analog: one-call support snapshot, secrets redacted."""
+    import aiohttp
+
+    app = BrokerApp(
+        _app_config(
+            authn={"enable": True, "allow_anonymous": True,
+                   "users": [{"user_id": "u", "password": "hunter2"}]},
+            dashboard={"port": 0, "bind": "127.0.0.1",
+                       "admins": {"root": "adminpw"}},
+            psk={"enable": True, "identities": {"dev1": "deadbeef"}},
+        )
+    )
+    await app.start()
+    try:
+        st, tok = None, None
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{api}/login",
+                              json={"username": "root", "password": "adminpw"}) as r:
+                tok = (await r.json())["token"]
+            async with s.get(f"{api}/node_dump",
+                             headers={"Authorization": f"Bearer {tok}"}) as r:
+                assert r.status == 200
+                d = await r.json()
+        assert d["versions"]["emqx_tpu"]
+        assert {"connections", "routes", "route_index"} <= set(d["broker"])
+        assert "license" in d["components"]
+        # secrets never leave the node
+        import json as _json
+
+        blob = _json.dumps(d["config"])
+        assert "hunter2" not in blob       # authn user password (key match)
+        assert "adminpw" not in blob       # dashboard admin (value map)
+        assert "deadbeef" not in blob      # psk secret (value map)
+        assert "*****" in blob
+    finally:
+        await app.stop()
