@@ -34,13 +34,17 @@ from emqx_tpu.utils.node import node_name, set_node_name
 
 def _register_builtin_gateways(registry) -> None:
     """Built-in protocol gateway types (apps/emqx_gateway/src/* impls)."""
+    from emqx_tpu.gateway.coap import CoapGateway
     from emqx_tpu.gateway.exproto import ExprotoGateway
+    from emqx_tpu.gateway.lwm2m import Lwm2mGateway
     from emqx_tpu.gateway.mqttsn import SnGateway
     from emqx_tpu.gateway.stomp import StompGateway
 
     registry.register_type("stomp", StompGateway)
     registry.register_type("mqttsn", SnGateway)
     registry.register_type("exproto", ExprotoGateway)
+    registry.register_type("coap", CoapGateway)
+    registry.register_type("lwm2m", Lwm2mGateway)
 
 
 class BrokerApp:
@@ -491,7 +495,9 @@ class BrokerApp:
         if c.gateways:
             from emqx_tpu.gateway.registry import GatewayRegistry
 
-            self.gateways = GatewayRegistry(self.broker, self.hooks)
+            self.gateways = GatewayRegistry(
+                self.broker, self.hooks, retainer=self.retainer
+            )
             _register_builtin_gateways(self.gateways)
             for gspec in c.gateways:
                 if gspec.enable:

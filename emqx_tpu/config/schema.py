@@ -325,8 +325,8 @@ class LicenseConfig:
 @dataclass
 class GatewaySpec:
     """One protocol gateway instance (emqx_gateway config analog).
-    type: stomp | mqttsn | exproto; options go in `opts` (bind/port/
-    mountpoint/predefined/handler...)."""
+    type: stomp | mqttsn | exproto | coap | lwm2m; options go in `opts`
+    (bind/port/mountpoint/predefined/handler/notify_type/lifetime...)."""
 
     type: str = "stomp"
     name: Optional[str] = None  # defaults to type

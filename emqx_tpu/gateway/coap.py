@@ -1,0 +1,677 @@
+"""CoAP gateway (RFC 7252) with EMQX's PubSub + MQTT-connection handlers.
+
+Parity with the reference CoAP gateway
+(apps/emqx_gateway/src/coap/: emqx_coap_frame.erl codec,
+emqx_coap_channel.erl + emqx_coap_session.erl lifecycle,
+emqx_coap_tm.erl / emqx_coap_transport.erl message layer,
+handler/emqx_coap_pubsub_handler.erl + emqx_coap_mqtt_handler.erl,
+behavior contract in src/coap/README.md):
+
+- RFC 7252 message layer: CON/NON/ACK/RST, message-id dedup window,
+  CON retransmission with exponential backoff, token-matched exchanges
+- Observe (RFC 7641): GET + Observe:0 subscribes (per-token observe
+  entry, monotonically increasing sequence numbers on notifications),
+  GET + Observe:1 unsubscribes
+- Block-wise transfer (RFC 7959): Block1 request-payload assembly and
+  Block2 response slicing (the reference's emqx_coap_frame block options)
+- PubSub handler: POST/PUT ``ps/{topic}`` publishes (2.04 Changed), GET
+  reads the retained message (2.05 Content / 4.04), subscribe/
+  unsubscribe per the draft-ietf-core-coap-pubsub mapping
+- MQTT handler: POST/PUT/DELETE ``mqtt/connection`` = connect /
+  heartbeat / close; connection mode hands out a token and every
+  subsequent request must carry matching ``clientid`` + ``token`` query
+  parameters or the request is RST/4.01, exactly as the README specifies
+- connectionless mode: requests carry ``clientid`` in the query string
+
+The gateway bridges into the core Broker through GwSession, so retained
+messages, shared subs, the rule engine and hooks all behave as for MQTT.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.ops import topics as T
+
+log = logging.getLogger("emqx_tpu.gateway.coap")
+
+# -- RFC 7252 constants ------------------------------------------------------
+
+VER = 1
+CON, NON, ACK, RST = 0, 1, 2, 3
+
+# method / response codes, c.dd encoded as (c << 5) | dd
+EMPTY = 0x00
+GET, POST, PUT, DELETE = 0x01, 0x02, 0x03, 0x04
+CREATED = 0x41  # 2.01
+DELETED = 0x42  # 2.02
+VALID = 0x43  # 2.03
+CHANGED = 0x44  # 2.04
+CONTENT = 0x45  # 2.05
+NO_CONTENT = 0x47  # 2.07 (pubsub draft; reference uses it for unsubscribe)
+CONTINUE = 0x5F  # 2.31 (block1)
+BAD_REQUEST = 0x80  # 4.00
+UNAUTHORIZED = 0x81  # 4.01
+FORBIDDEN = 0x83  # 4.03
+NOT_FOUND = 0x84  # 4.04
+NOT_ALLOWED = 0x85  # 4.05
+REQ_INCOMPLETE = 0x88  # 4.08
+TOO_LARGE = 0x8D  # 4.13
+INTERNAL_ERROR = 0xA0  # 5.00
+
+# option numbers
+OPT_OBSERVE = 6
+OPT_URI_PATH = 11
+OPT_CONTENT_FORMAT = 12
+OPT_MAX_AGE = 14
+OPT_URI_QUERY = 15
+OPT_BLOCK2 = 23
+OPT_BLOCK1 = 27
+OPT_SIZE2 = 28
+OPT_SIZE1 = 60
+
+# transmission parameters (RFC 7252 §4.8)
+ACK_TIMEOUT = 2.0
+ACK_RANDOM_FACTOR = 1.5
+MAX_RETRANSMIT = 4
+EXCHANGE_LIFETIME = 247.0
+DEDUP_WINDOW = 60.0  # practical dedup retention for the test/server regime
+
+DEFAULT_BLOCK_SIZE = 1024  # szx 6
+
+
+def code_str(code: int) -> str:
+    return f"{code >> 5}.{code & 0x1F:02d}"
+
+
+@dataclass
+class CoapMessage:
+    type: int = CON
+    code: int = EMPTY
+    msg_id: int = 0
+    token: bytes = b""
+    options: List[Tuple[int, bytes]] = field(default_factory=list)
+    payload: bytes = b""
+
+    # -- option helpers ------------------------------------------------------
+    def opt_all(self, num: int) -> List[bytes]:
+        return [v for n, v in self.options if n == num]
+
+    def opt(self, num: int) -> Optional[bytes]:
+        vals = self.opt_all(num)
+        return vals[0] if vals else None
+
+    def opt_uint(self, num: int) -> Optional[int]:
+        v = self.opt(num)
+        if v is None:
+            return None
+        return int.from_bytes(v, "big")
+
+    def set_uint(self, num: int, val: int) -> None:
+        b = b"" if val == 0 else val.to_bytes((val.bit_length() + 7) // 8, "big")
+        self.options.append((num, b))
+
+    @property
+    def uri_path(self) -> List[str]:
+        return [v.decode("utf-8", "replace") for v in self.opt_all(OPT_URI_PATH)]
+
+    @property
+    def queries(self) -> Dict[str, str]:
+        out = {}
+        for v in self.opt_all(OPT_URI_QUERY):
+            s = v.decode("utf-8", "replace")
+            k, _, val = s.partition("=")
+            out[k] = val
+        return out
+
+    @property
+    def observe(self) -> Optional[int]:
+        return self.opt_uint(OPT_OBSERVE)
+
+    def block(self, num: int) -> Optional[Tuple[int, bool, int]]:
+        """-> (block_num, more, size) for OPT_BLOCK1/OPT_BLOCK2."""
+        v = self.opt_uint(num)
+        if v is None:
+            return None
+        return v >> 4, bool(v & 0x08), 1 << ((v & 0x07) + 4)
+
+    def set_block(self, opt_num: int, block_num: int, more: bool, size: int) -> None:
+        szx = max(0, size.bit_length() - 5)  # size == 2 ** (szx + 4)
+        self.set_uint(opt_num, (block_num << 4) | (0x08 if more else 0) | szx)
+
+
+def encode_message(m: CoapMessage) -> bytes:
+    out = bytearray()
+    out.append((VER << 6) | (m.type << 4) | len(m.token))
+    out.append(m.code)
+    out += struct.pack("!H", m.msg_id)
+    out += m.token
+    prev = 0
+    for num, val in sorted(m.options, key=lambda o: o[0]):
+        delta = num - prev
+        prev = num
+        dn, dext = _opt_nibble(delta)
+        ln, lext = _opt_nibble(len(val))
+        out.append((dn << 4) | ln)
+        out += dext + lext + val
+    if m.payload:
+        out.append(0xFF)
+        out += m.payload
+    return bytes(out)
+
+
+def _opt_nibble(v: int) -> Tuple[int, bytes]:
+    if v < 13:
+        return v, b""
+    if v < 269:
+        return 13, bytes([v - 13])
+    return 14, struct.pack("!H", v - 269)
+
+
+def _opt_ext(nibble: int, data: bytes, pos: int) -> Tuple[int, int]:
+    if nibble < 13:
+        return nibble, pos
+    if nibble == 13:
+        return data[pos] + 13, pos + 1
+    if nibble == 14:
+        return struct.unpack_from("!H", data, pos)[0] + 269, pos + 2
+    raise ValueError("reserved option nibble 15")
+
+
+def decode_message(data: bytes) -> Optional[CoapMessage]:
+    if len(data) < 4 or (data[0] >> 6) != VER:
+        return None
+    tkl = data[0] & 0x0F
+    if tkl > 8:
+        return None
+    m = CoapMessage(
+        type=(data[0] >> 4) & 0x03,
+        code=data[1],
+        msg_id=struct.unpack_from("!H", data, 2)[0],
+        token=data[4 : 4 + tkl],
+    )
+    pos = 4 + tkl
+    prev = 0
+    try:
+        while pos < len(data):
+            b = data[pos]
+            pos += 1
+            if b == 0xFF:
+                m.payload = data[pos:]
+                break
+            delta, pos = _opt_ext(b >> 4, data, pos)
+            length, pos = _opt_ext(b & 0x0F, data, pos)
+            prev += delta
+            m.options.append((prev, data[pos : pos + length]))
+            pos += length
+    except (IndexError, ValueError, struct.error):
+        return None
+    return m
+
+
+# -- per-peer channel --------------------------------------------------------
+
+
+@dataclass
+class ObserveEntry:
+    token: bytes
+    topic: str
+    seq: int = 1
+
+
+@dataclass
+class Block1Buf:
+    next_num: int = 0
+    data: bytearray = field(default_factory=bytearray)
+    at: float = field(default_factory=time.monotonic)
+
+
+class CoapChannel:
+    """One CoAP peer: message layer + request handlers
+    (emqx_coap_channel.erl + emqx_coap_tm.erl roles)."""
+
+    def __init__(self, gw: "CoapGateway", peer: Tuple[str, int]):
+        self.gw = gw
+        self.peer = peer
+        self.session: Optional[GwSession] = None
+        self.conn_token: Optional[str] = None  # connection-mode auth token
+        self.clientid: Optional[str] = None
+        self.last_seen = time.monotonic()
+        self.heartbeat = gw.heartbeat
+        self._next_mid = secrets.randbelow(0x10000)
+        self._observes: Dict[str, ObserveEntry] = {}  # topic -> entry
+        self._dedup: Dict[int, Tuple[float, Optional[bytes]]] = {}
+        self._pending_con: Dict[int, asyncio.Task] = {}  # mid -> retransmit
+        self._block1: Dict[bytes, Block1Buf] = {}  # token -> partial upload
+        self._block2: Dict[bytes, bytes] = {}  # token -> full response body
+
+    # -- plumbing ------------------------------------------------------------
+    def next_mid(self) -> int:
+        self._next_mid = (self._next_mid + 1) & 0xFFFF
+        return self._next_mid
+
+    def send(self, m: CoapMessage) -> None:
+        self.gw.sendto(encode_message(m), self.peer)
+
+    def send_con(self, m: CoapMessage) -> None:
+        """Send a CON message with RFC 7252 retransmission."""
+        self.send(m)
+        task = asyncio.get_running_loop().create_task(self._retransmit(m))
+        self._pending_con[m.msg_id] = task
+
+    async def _retransmit(self, m: CoapMessage) -> None:
+        try:
+            timeout = ACK_TIMEOUT * ACK_RANDOM_FACTOR
+            for _ in range(MAX_RETRANSMIT):
+                await asyncio.sleep(timeout)
+                self.send(m)
+                timeout *= 2
+            await asyncio.sleep(timeout)
+            # give up: peer is gone (emqx_coap_transport timeout semantics)
+            self.drop("con_timeout")
+        except asyncio.CancelledError:
+            pass
+
+    def _ack_received(self, mid: int) -> None:
+        task = self._pending_con.pop(mid, None)
+        if task is not None:
+            task.cancel()
+
+    def reply(
+        self,
+        req: CoapMessage,
+        code: int,
+        payload: bytes = b"",
+        options: Optional[List[Tuple[int, bytes]]] = None,
+    ) -> CoapMessage:
+        """Build a response: piggybacked ACK for CON, NON for NON."""
+        m = CoapMessage(
+            type=ACK if req.type == CON else NON,
+            code=code,
+            msg_id=req.msg_id if req.type == CON else self.next_mid(),
+            token=req.token,
+            options=list(options or []),
+            payload=payload,
+        )
+        return m
+
+    def rst(self, req: CoapMessage) -> None:
+        self.send(CoapMessage(type=RST, code=EMPTY, msg_id=req.msg_id))
+
+    # -- inbound -------------------------------------------------------------
+    def handle(self, m: CoapMessage) -> None:
+        self.last_seen = time.monotonic()
+        if m.type in (ACK, RST):
+            self._ack_received(m.msg_id)
+            if m.type == RST:
+                # peer rejected a notification: cancel its observe
+                self._cancel_observes_by_token(m.token)
+            return
+        if m.code == EMPTY:
+            if m.type == CON:  # CoAP ping
+                self.send(CoapMessage(type=RST, code=EMPTY, msg_id=m.msg_id))
+            return
+        # message-id dedup (emqx_coap_tm duplicate detection)
+        now = time.monotonic()
+        hit = self._dedup.get(m.msg_id)
+        if hit is not None and now - hit[0] < DEDUP_WINDOW:
+            if hit[1] is not None:
+                self.gw.sendto(hit[1], self.peer)  # replay cached response
+            return
+        resp = self._handle_request(m)
+        raw = encode_message(resp) if resp is not None else None
+        self._dedup[m.msg_id] = (now, raw)
+        if raw is not None:
+            self.gw.sendto(raw, self.peer)
+
+    # -- request routing -----------------------------------------------------
+    def _handle_request(self, m: CoapMessage) -> Optional[CoapMessage]:
+        path = m.uri_path
+        if not path:
+            return self.reply(m, NOT_FOUND)
+        if path[0] == "ps" and len(path) >= 2:
+            return self._handle_pubsub(m, "/".join(path[1:]))
+        if path[0] == "mqtt" and path[1:] == ["connection"]:
+            return self._handle_connection(m)
+        return self.reply(m, NOT_FOUND)
+
+    # -- auth / identity (emqx_coap_channel check_token + enter_connected) ---
+    def _check_identity(self, m: CoapMessage) -> Optional[CoapMessage]:
+        """Connection-mode guard: clientid+token must match. Returns an
+        error response to send, or None when the request may proceed."""
+        q = m.queries
+        if self.conn_token is not None:
+            if (
+                q.get("clientid") != self.clientid
+                or q.get("token") != self.conn_token
+            ):
+                return self.reply(m, UNAUTHORIZED)
+            return None
+        if q.get("token"):
+            # token given but no connection: unauthorized per README
+            return self.reply(m, UNAUTHORIZED)
+        return None
+
+    def _ensure_session(self, m: CoapMessage) -> Optional[GwSession]:
+        """Connectionless mode: lazily open a session named by the
+        clientid query param (or the peer address)."""
+        if self.session is not None:
+            return self.session
+        q = m.queries
+        clientid = q.get("clientid") or f"coap-{self.peer[0]}-{self.peer[1]}"
+        info = GwClientInfo(
+            clientid=clientid,
+            username=q.get("username"),
+            peername=self.peer,
+            protocol="coap",
+            mountpoint=self.gw.config.get("mountpoint"),
+        )
+        self.clientid = clientid
+        self.session = GwSession(
+            self.gw.name, self.gw.broker, self.gw.hooks, info, self._notify
+        )
+        old = self.gw.cm.open(clientid, self)
+        if old is not None and old is not self:
+            old.drop("kicked")
+        self.session.open()
+        return self.session
+
+    # -- pubsub handler (handler/emqx_coap_pubsub_handler.erl) ---------------
+    def _handle_pubsub(self, m: CoapMessage, topic: str) -> Optional[CoapMessage]:
+        err = self._check_identity(m)
+        if err is not None:
+            return err
+        try:
+            T.validate(topic, kind="filter" if m.code == GET else "name")
+        except T.TopicValidationError:
+            return self.reply(m, BAD_REQUEST)
+        if m.code in (POST, PUT):
+            return self._do_publish(m, topic)
+        if m.code == GET:
+            obs = m.observe
+            if obs == 0:
+                return self._do_subscribe(m, topic)
+            if obs == 1:
+                return self._do_unsubscribe(m, topic)
+            return self._do_read(m, topic)
+        return self.reply(m, NOT_ALLOWED)
+
+    def _do_publish(self, m: CoapMessage, topic: str) -> Optional[CoapMessage]:
+        sess = self._ensure_session(m)
+        if sess is None:
+            return self.reply(m, UNAUTHORIZED)
+        # Block1: assemble multi-block uploads before publishing
+        b1 = m.block(OPT_BLOCK1)
+        payload = m.payload
+        if b1 is not None:
+            num, more, size = b1
+            buf = self._block1.get(m.token)
+            if num == 0:
+                buf = Block1Buf()
+                self._block1[m.token] = buf
+            if buf is None or num != buf.next_num:
+                self._block1.pop(m.token, None)
+                return self.reply(m, REQ_INCOMPLETE)
+            buf.data += m.payload
+            buf.next_num += 1
+            if more:
+                r = self.reply(m, CONTINUE)
+                r.set_block(OPT_BLOCK1, num, True, size)
+                return r
+            payload = bytes(self._block1.pop(m.token).data)
+        q = m.queries
+        qos = _parse_qos(q.get("qos"), default=0 if m.type == NON else 1)
+        retain = q.get("retain", "").lower() in ("true", "1")
+        sess.publish_sync(topic, payload, qos=qos, retain=retain)
+        r = self.reply(m, CHANGED)
+        if b1 is not None:
+            r.set_block(OPT_BLOCK1, b1[0], False, b1[2])
+        return r
+
+    def _do_read(self, m: CoapMessage, topic: str) -> CoapMessage:
+        """Plain GET: return the retained message (pubsub-draft read)."""
+        retainer = self.gw.config.get("retainer") or getattr(
+            self.gw, "retainer", None
+        )
+        msgs = retainer.match(topic) if retainer is not None else []
+        if not msgs:
+            return self.reply(m, NOT_FOUND)
+        return self._content_reply(m, msgs[0].payload)
+
+    def _do_subscribe(self, m: CoapMessage, topic: str) -> CoapMessage:
+        sess = self._ensure_session(m)
+        if sess is None:
+            return self.reply(m, UNAUTHORIZED)
+        qos = _parse_qos(m.queries.get("qos"), default=0)
+        ent = self._observes.get(topic)
+        if ent is None:
+            ent = ObserveEntry(token=m.token, topic=topic)
+            self._observes[topic] = ent
+            sess.subscribe(topic, pkt.SubOpts(qos=qos))
+        else:
+            ent.token = m.token  # re-register refreshes the token
+        r = self.reply(m, CONTENT)
+        r.set_uint(OPT_OBSERVE, ent.seq)
+        return r
+
+    def _do_unsubscribe(self, m: CoapMessage, topic: str) -> CoapMessage:
+        ent = self._observes.pop(topic, None)
+        if ent is not None and self.session is not None:
+            self.session.unsubscribe(topic)
+        return self.reply(m, NO_CONTENT)
+
+    def _cancel_observes_by_token(self, token: bytes) -> None:
+        for topic, ent in list(self._observes.items()):
+            if ent.token == token:
+                self._observes.pop(topic, None)
+                if self.session is not None:
+                    self.session.unsubscribe(topic)
+
+    # -- delivery → observe notification (emqx_coap_observe_res.erl) ---------
+    def _notify(self, msg: Message, opts: pkt.SubOpts) -> None:
+        ent = None
+        for topic, e in self._observes.items():
+            if T.match(msg.topic, topic):
+                ent = e
+                break
+        if ent is None:
+            return
+        ent.seq = (ent.seq + 1) & 0xFFFFFF
+        notify_type = self.gw.notify_type
+        if notify_type == "qos":
+            mtype = CON if msg.qos > 0 else NON
+        else:
+            mtype = CON if notify_type == "con" else NON
+        m = CoapMessage(
+            type=mtype,
+            code=CONTENT,
+            msg_id=self.next_mid(),
+            token=ent.token,
+            payload=msg.payload,
+        )
+        m.set_uint(OPT_OBSERVE, ent.seq)
+        if len(m.payload) > self.gw.max_block_size:
+            # Block2 slicing: cache body, send first block
+            self._block2[ent.token] = m.payload
+            m.payload = m.payload[: self.gw.max_block_size]
+            m.set_block(OPT_BLOCK2, 0, True, self.gw.max_block_size)
+        if mtype == CON:
+            self.send_con(m)
+        else:
+            self.send(m)
+
+    def _content_reply(self, m: CoapMessage, body: bytes) -> CoapMessage:
+        """2.05 response with Block2 slicing for large bodies."""
+        b2 = m.block(OPT_BLOCK2)
+        size = b2[2] if b2 is not None else self.gw.max_block_size
+        num = b2[0] if b2 is not None else 0
+        if len(body) <= size and num == 0:
+            return self.reply(m, CONTENT, payload=body)
+        if num == 0:
+            self._block2[m.token] = body
+        else:
+            body = self._block2.get(m.token, body)
+        lo = num * size
+        if lo >= len(body):
+            return self.reply(m, BAD_REQUEST)
+        chunk = body[lo : lo + size]
+        more = lo + size < len(body)
+        if not more:
+            self._block2.pop(m.token, None)
+        r = self.reply(m, CONTENT, payload=chunk)
+        r.set_block(OPT_BLOCK2, num, more, size)
+        return r
+
+    # -- mqtt/connection handler (handler/emqx_coap_mqtt_handler.erl) --------
+    def _handle_connection(self, m: CoapMessage) -> Optional[CoapMessage]:
+        q = m.queries
+        if m.code == POST:  # connect
+            clientid = q.get("clientid")
+            if not clientid:
+                return self.reply(m, BAD_REQUEST)
+            info = GwClientInfo(
+                clientid=clientid,
+                username=q.get("username"),
+                peername=self.peer,
+                protocol="coap",
+                mountpoint=self.gw.config.get("mountpoint"),
+                clean_start=True,
+            )
+            ok = self.gw.authenticate_sync(info, q.get("password"))
+            if not ok:
+                return self.reply(m, UNAUTHORIZED)
+            if self.session is not None:
+                self.session.close("reconnect")
+            self.clientid = clientid
+            self.conn_token = secrets.token_hex(8)
+            self.session = GwSession(
+                self.gw.name, self.gw.broker, self.gw.hooks, info, self._notify
+            )
+            old = self.gw.cm.open(clientid, self)
+            if old is not None and old is not self:
+                old.drop("kicked")
+            self.session.open()
+            return self.reply(m, CREATED, payload=self.conn_token.encode())
+        if m.code == PUT:  # heartbeat
+            if self.conn_token is not None and (
+                q.get("clientid") != self.clientid
+                or q.get("token") != self.conn_token
+            ):
+                return self.reply(m, UNAUTHORIZED)
+            return self.reply(m, CHANGED)
+        if m.code == DELETE:  # close
+            if self.conn_token is None or (
+                q.get("clientid") != self.clientid
+                or q.get("token") != self.conn_token
+            ):
+                return self.reply(m, UNAUTHORIZED)
+            self.drop("client_disconnect")
+            return self.reply(m, DELETED)
+        return self.reply(m, NOT_ALLOWED)
+
+    # -- teardown ------------------------------------------------------------
+    def drop(self, reason: str) -> None:
+        for task in self._pending_con.values():
+            task.cancel()
+        self._pending_con.clear()
+        self._observes.clear()
+        if self.session is not None:
+            self.session.close(reason)
+            self.session = None
+        if self.clientid is not None:
+            self.gw.cm.close(self.clientid, self)
+        self.conn_token = None
+        self.gw.forget(self.peer)
+
+
+def _parse_qos(s: Optional[str], default: int) -> int:
+    try:
+        q = int(s) if s is not None else default
+    except ValueError:
+        return default
+    return min(max(q, 0), 2)
+
+
+class CoapGateway(Gateway):
+    """UDP endpoint + per-peer CoAP channels (emqx_coap_impl.erl)."""
+
+    def __init__(self, name: str, config: Dict):
+        super().__init__(name, config)
+        self.heartbeat = config.get("heartbeat", 30.0)
+        self.notify_type = config.get("notify_type", "qos")  # qos|con|non
+        self.max_block_size = config.get("max_block_size", DEFAULT_BLOCK_SIZE)
+        self._transport = None
+        self._chans: Dict[Tuple[str, int], CoapChannel] = {}
+        self._reaper: Optional[asyncio.Task] = None
+
+    def authenticate_sync(self, info: GwClientInfo, password=None) -> bool:
+        res = self.hooks.run_fold(
+            "client.authenticate",
+            (info.as_dict(),),
+            {"ok": True, "password": password},
+        )
+        return bool(res is None or res.get("ok", True))
+
+    def sendto(self, data: bytes, peer) -> None:
+        if self._transport is not None:
+            self._transport.sendto(data, peer)
+
+    def forget(self, peer) -> None:
+        self._chans.pop(peer, None)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        gw = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                gw._transport = transport
+
+            def datagram_received(self, data, addr):
+                m = decode_message(data)
+                if m is None:
+                    return
+                chan = gw._chans.get(addr)
+                if chan is None:
+                    chan = CoapChannel(gw, addr)
+                    gw._chans[addr] = chan
+                chan.handle(m)
+
+        host = self.config.get("bind", "127.0.0.1")
+        port = self.config.get("port", 5683)
+        self._endpoint = await loop.create_datagram_endpoint(
+            Proto, local_addr=(host, port)
+        )
+        self.port = self._endpoint[0].get_extra_info("sockname")[1]
+        self._reaper = loop.create_task(self._reap_loop())
+
+    async def _reap_loop(self, period: float = 5.0) -> None:
+        """Expire peers silent past 2x heartbeat (channel keepalive,
+        emqx_coap_channel.erl heartbeat timer)."""
+        try:
+            while True:
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                for chan in list(self._chans.values()):
+                    if now - chan.last_seen > 2 * self.heartbeat:
+                        chan.drop("heartbeat_timeout")
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+        for chan in list(self._chans.values()):
+            chan.drop("gateway_stopped")
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None

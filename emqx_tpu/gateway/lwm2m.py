@@ -1,0 +1,512 @@
+"""LwM2M gateway: registration lifecycle + device management over CoAP.
+
+Parity with the reference LwM2M gateway
+(apps/emqx_gateway/src/lwm2m/: emqx_lwm2m_impl.erl listener/registry,
+emqx_lwm2m_channel.erl + emqx_lwm2m_session.erl register/update/
+deregister lifecycle and downlink queue, emqx_lwm2m_cmd.erl MQTT-JSON
+<-> CoAP command translation; behavior contract in lwm2m README):
+
+- UDP CoAP endpoint (reuses the RFC 7252 codec from gateway/coap.py)
+- ``POST /rd?ep=&lt=&lwm2m=`` registers: opens a broker session for the
+  endpoint under mountpoint ``lwm2m/{ep}/``, subscribes the downlink
+  command topic ``dn/#``, publishes a ``register`` uplink, answers
+  2.01 Created with ``Location-Path: rd/<loc>``
+- ``POST /rd/<loc>`` updates lifetime/objects (``update`` uplink, 2.04);
+  ``DELETE /rd/<loc>`` deregisters (2.02)
+- downlink commands are JSON messages on ``dn/#``:
+  ``{"reqID": n, "msgType": "read|write|execute|discover|observe|
+  cancel-observe|write-attr|create|delete", "data": {...}}`` — each is
+  translated to a CoAP request to the device (emqx_lwm2m_cmd.erl
+  mqtt_to_coap), retransmitted per RFC 7252, and the device's response
+  is published as JSON on ``up/resp`` (coap_to_mqtt)
+- observe notifications (Observe seq > 0) are published on
+  ``up/notify`` with ``seqNum``
+- lifetime expiry reaps the registration (session close + will-style
+  disconnect hooks)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import secrets
+import time
+from typing import Dict, Optional, Tuple
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.gateway import coap as C
+from emqx_tpu.gateway import lwm2m_codec as LC
+from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.mqtt import packet as pkt
+
+log = logging.getLogger("emqx_tpu.gateway.lwm2m")
+
+# translator topics (gateway.lwm2m.translators config defaults in the
+# reference's emqx_gateway_schema: command dn/#, response/register/update
+# up/resp, notify up/notify)
+TOPIC_COMMAND = "dn/#"
+TOPIC_RESPONSE = "up/resp"
+TOPIC_NOTIFY = "up/notify"
+
+CODE_MSG = {
+    C.CREATED: "created",
+    C.DELETED: "deleted",
+    C.VALID: "valid",
+    C.CHANGED: "changed",
+    C.CONTENT: "content",
+    C.CONTINUE: "continue",
+    C.BAD_REQUEST: "bad_request",
+    C.UNAUTHORIZED: "unauthorized",
+    C.FORBIDDEN: "forbidden",
+    C.NOT_FOUND: "not_found",
+    C.NOT_ALLOWED: "method_not_allowed",
+    C.INTERNAL_ERROR: "internal_server_error",
+}
+
+
+class Lwm2mChannel:
+    """One LwM2M endpoint (emqx_lwm2m_channel.erl + session)."""
+
+    def __init__(self, gw: "Lwm2mGateway", peer: Tuple[str, int]):
+        self.gw = gw
+        self.peer = peer
+        self.endpoint: Optional[str] = None
+        self.location: Optional[str] = None
+        self.lifetime = 86400.0
+        self.reg_info: Dict = {}
+        self.session: Optional[GwSession] = None
+        self.last_seen = time.monotonic()
+        self._next_mid = secrets.randbelow(0x10000)
+        self._next_tok = 1
+        # token -> (cmd_json, coap_path) awaiting a device response
+        self._pending: Dict[bytes, Dict] = {}
+        # observe-token -> path (kept after the first response, for notifies)
+        self._observing: Dict[bytes, Dict] = {}
+        self._retransmits: Dict[int, asyncio.Task] = {}
+        self._dedup: Dict[int, Tuple[float, Optional[bytes]]] = {}
+
+    # -- plumbing ------------------------------------------------------------
+    def next_mid(self) -> int:
+        self._next_mid = (self._next_mid + 1) & 0xFFFF
+        return self._next_mid
+
+    def next_token(self) -> bytes:
+        t = self._next_tok
+        self._next_tok = (self._next_tok + 1) & 0xFFFFFFFF
+        return t.to_bytes(4, "big")
+
+    def send(self, m: C.CoapMessage) -> None:
+        self.gw.sendto(C.encode_message(m), self.peer)
+
+    def send_con(self, m: C.CoapMessage) -> None:
+        self.send(m)
+        task = asyncio.get_running_loop().create_task(self._retransmit(m))
+        self._retransmits[m.msg_id] = task
+
+    async def _retransmit(self, m: C.CoapMessage) -> None:
+        try:
+            timeout = C.ACK_TIMEOUT * C.ACK_RANDOM_FACTOR
+            for _ in range(C.MAX_RETRANSMIT):
+                await asyncio.sleep(timeout)
+                self.send(m)
+                timeout *= 2
+            await asyncio.sleep(timeout)
+            # device unreachable: fail the pending command upward
+            ref = self._pending.pop(m.token, None)
+            if ref is not None:
+                self._uplink_response(
+                    ref, code="timeout", content=None, msg_type_override=(
+                        f"{ref.get('msgType', 'cmd')}_timeout"
+                    )
+                )
+        except asyncio.CancelledError:
+            pass
+
+    def _ack(self, mid: int) -> None:
+        task = self._retransmits.pop(mid, None)
+        if task is not None:
+            task.cancel()
+
+    # -- inbound from the device --------------------------------------------
+    def handle(self, m: C.CoapMessage) -> None:
+        self.last_seen = time.monotonic()
+        if m.type in (C.ACK, C.RST):
+            self._ack(m.msg_id)
+            if m.type == C.RST:
+                self._observing.pop(m.token, None)
+                return
+            if m.code != C.EMPTY:
+                self._handle_response(m)
+            return
+        # separate response / notification from the device (CON or NON)
+        if m.code == C.EMPTY:
+            return
+        if (m.code >> 5) >= 2:  # response class
+            if m.type == C.CON:
+                self.send(
+                    C.CoapMessage(type=C.ACK, code=C.EMPTY, msg_id=m.msg_id)
+                )
+            self._handle_response(m)
+            return
+        # request from the device: registration interface
+        now = time.monotonic()
+        hit = self._dedup.get(m.msg_id)
+        if hit is not None and now - hit[0] < C.DEDUP_WINDOW:
+            if hit[1] is not None:
+                self.gw.sendto(hit[1], self.peer)
+            return
+        resp = self._handle_request(m)
+        raw = C.encode_message(resp) if resp is not None else None
+        self._dedup[m.msg_id] = (now, raw)
+        if raw is not None:
+            self.gw.sendto(raw, self.peer)
+
+    def _reply(self, req: C.CoapMessage, code: int, **kw) -> C.CoapMessage:
+        return C.CoapMessage(
+            type=C.ACK if req.type == C.CON else C.NON,
+            code=code,
+            msg_id=req.msg_id if req.type == C.CON else self.next_mid(),
+            token=req.token,
+            **kw,
+        )
+
+    # -- registration interface (emqx_lwm2m_session.erl init/update) ---------
+    def _handle_request(self, m: C.CoapMessage) -> Optional[C.CoapMessage]:
+        path = m.uri_path
+        if not path or path[0] != "rd":
+            return self._reply(m, C.NOT_FOUND)
+        if m.code == C.POST and len(path) == 1:
+            return self._register(m)
+        if m.code == C.POST and len(path) == 2:
+            return self._update(m, path[1])
+        if m.code == C.DELETE and len(path) == 2:
+            return self._deregister(m, path[1])
+        return self._reply(m, C.NOT_ALLOWED)
+
+    def _register(self, m: C.CoapMessage) -> C.CoapMessage:
+        q = m.queries
+        ep = q.get("ep")
+        if not ep:
+            return self._reply(m, C.BAD_REQUEST)
+        self.lifetime = float(q.get("lt", self.gw.default_lifetime))
+        if not (
+            self.gw.lifetime_min <= self.lifetime <= self.gw.lifetime_max
+        ):
+            return self._reply(m, C.BAD_REQUEST)
+        links = m.payload.decode("utf-8", "replace") if m.payload else ""
+        object_list = [
+            s.strip().strip("<>") for s in links.split(",") if s.strip()
+        ]
+        self.reg_info = {
+            "ep": ep,
+            "lt": int(self.lifetime),
+            "lwm2m": q.get("lwm2m", "1.0"),
+            "sms": q.get("sms"),
+            "b": q.get("b", "U"),
+            "alternatePath": "/",
+            "objectList": object_list,
+        }
+        info = GwClientInfo(
+            clientid=ep,
+            username=q.get("imei") or None,
+            peername=self.peer,
+            protocol="lwm2m",
+            mountpoint=self.gw.mountpoint_for(ep),
+            keepalive=int(self.lifetime),
+        )
+        if not self.gw.authenticate_sync(info):
+            return self._reply(m, C.UNAUTHORIZED)
+        if self.session is not None:
+            self.session.close("re_register")
+        self.endpoint = ep
+        self.location = secrets.token_hex(4)
+        self.session = GwSession(
+            self.gw.name, self.gw.broker, self.gw.hooks, info, self._downlink
+        )
+        old = self.gw.cm.open(ep, self)
+        if old is not None and old is not self:
+            old.drop("kicked")
+        self.session.open()
+        self.session.subscribe(TOPIC_COMMAND, pkt.SubOpts(qos=self.gw.qos))
+        self._uplink("register", dict(self.reg_info))
+        r = self._reply(m, C.CREATED)
+        r.options = [(8, b"rd"), (8, self.location.encode())]  # Location-Path
+        return r
+
+    def _update(self, m: C.CoapMessage, loc: str) -> C.CoapMessage:
+        if loc != self.location or self.session is None:
+            return self._reply(m, C.NOT_FOUND)
+        q = m.queries
+        if "lt" in q:
+            self.lifetime = float(q["lt"])
+            self.reg_info["lt"] = int(self.lifetime)
+        if m.payload:
+            links = m.payload.decode("utf-8", "replace")
+            self.reg_info["objectList"] = [
+                s.strip().strip("<>") for s in links.split(",") if s.strip()
+            ]
+        self._uplink("update", dict(self.reg_info))
+        return self._reply(m, C.CHANGED)
+
+    def _deregister(self, m: C.CoapMessage, loc: str) -> C.CoapMessage:
+        if loc != self.location:
+            return self._reply(m, C.NOT_FOUND)
+        self.drop("deregister")
+        return self._reply(m, C.DELETED)
+
+    # -- downlink: MQTT command JSON -> CoAP request (emqx_lwm2m_cmd) --------
+    def _downlink(self, msg: Message, opts: pkt.SubOpts) -> None:
+        try:
+            cmd = json.loads(msg.payload)
+        except (ValueError, UnicodeDecodeError):
+            log.warning("lwm2m %s: bad downlink payload", self.endpoint)
+            return
+        msg_type = cmd.get("msgType")
+        data = cmd.get("data", {})
+        path = data.get("path") or data.get("basePath") or "/"
+        token = self.next_token()
+        req = C.CoapMessage(type=C.CON, msg_id=self.next_mid(), token=token)
+        for seg in LC.parse_path(path):
+            req.options.append((C.OPT_URI_PATH, str(seg).encode()))
+        if msg_type == "read":
+            req.code = C.GET
+        elif msg_type == "write":
+            req.code = C.PUT
+            if "basePath" in data and "content" in data:
+                req.payload = LC.json_to_tlv(data["basePath"], data["content"])
+            else:
+                req.payload = LC.json_to_tlv(
+                    path, [{"path": path, "value": data.get("value")}]
+                )
+            req.set_uint(C.OPT_CONTENT_FORMAT, LC.FMT_TLV)
+        elif msg_type == "create":
+            req.code = C.POST
+            req.payload = LC.json_to_tlv(
+                data.get("basePath", path), data.get("content", [])
+            )
+            req.set_uint(C.OPT_CONTENT_FORMAT, LC.FMT_TLV)
+        elif msg_type == "delete":
+            req.code = C.DELETE
+        elif msg_type == "execute":
+            req.code = C.POST
+            args = data.get("args")
+            if args:
+                req.payload = str(args).encode()
+        elif msg_type == "discover":
+            req.code = C.GET
+            req.set_uint(17, LC.FMT_LINK)  # Accept: link-format
+        elif msg_type == "observe":
+            req.code = C.GET
+            req.set_uint(C.OPT_OBSERVE, 0)
+        elif msg_type == "cancel-observe":
+            req.code = C.GET
+            req.set_uint(C.OPT_OBSERVE, 1)
+        elif msg_type == "write-attr":
+            req.code = C.PUT
+            for k in ("pmin", "pmax", "gt", "lt", "st"):
+                if k in data and data[k] is not None:
+                    req.options.append(
+                        (C.OPT_URI_QUERY, f"{k}={data[k]}".encode())
+                    )
+        else:
+            log.warning("lwm2m %s: unknown msgType %r", self.endpoint, msg_type)
+            return
+        self._pending[token] = {**cmd, "_path": path}
+        self.send_con(req)
+
+    # -- device response -> uplink JSON (emqx_lwm2m_cmd coap_to_mqtt) --------
+    def _handle_response(self, m: C.CoapMessage) -> None:
+        ref = self._pending.pop(m.token, None)
+        obs_seq = m.observe
+        if ref is None:
+            ref = self._observing.get(m.token)
+            if ref is None:
+                return
+            # continuing notification stream
+            self._notify(ref, m, obs_seq or 0)
+            return
+        msg_type = ref.get("msgType")
+        if msg_type == "observe" and (m.code >> 5) == 2:
+            self._observing[m.token] = ref
+        if msg_type == "cancel-observe":
+            # drop any observe entry sharing this path
+            for tok, oref in list(self._observing.items()):
+                if oref.get("_path") == ref.get("_path"):
+                    del self._observing[tok]
+        if msg_type == "observe" and obs_seq not in (None, 0):
+            self._notify(ref, m, obs_seq)
+            return
+        content = self._decode_content(m, ref)
+        self._uplink_response(ref, code=C.code_str(m.code), content=content)
+
+    def _decode_content(self, m: C.CoapMessage, ref: Dict):
+        if (m.code >> 5) != 2 or m.code in (C.CHANGED, C.CREATED, C.DELETED):
+            return None
+        path = ref.get("_path", "/")
+        fmt = m.opt_uint(C.OPT_CONTENT_FORMAT)
+        if fmt == LC.FMT_TLV:
+            return LC.tlv_to_json(path, m.payload)
+        if fmt == LC.FMT_LINK:
+            return m.payload.decode("utf-8", "replace").split(",")
+        if fmt == LC.FMT_OPAQUE:
+            return LC.opaque_to_json(path, m.payload)
+        return LC.text_to_json(path, m.payload)
+
+    def _uplink_response(
+        self, ref: Dict, code, content, msg_type_override: Optional[str] = None
+    ) -> None:
+        data = {
+            "code": code,
+            "codeMsg": CODE_MSG.get(code, code) if isinstance(code, int)
+            else code,
+            "reqPath": ref.get("_path"),
+        }
+        if isinstance(code, str) and "." in code:
+            try:
+                num = (int(code.split(".")[0]) << 5) | int(code.split(".")[1])
+                data["codeMsg"] = CODE_MSG.get(num, code)
+            except ValueError:
+                pass
+        if content is not None:
+            data["content"] = content
+        self._publish_up(
+            TOPIC_RESPONSE,
+            {
+                "reqID": ref.get("reqID"),
+                "msgType": msg_type_override or ref.get("msgType"),
+                "data": data,
+            },
+        )
+
+    def _notify(self, ref: Dict, m: C.CoapMessage, seq: int) -> None:
+        content = self._decode_content(m, ref)
+        self._publish_up(
+            TOPIC_NOTIFY,
+            {
+                "reqID": ref.get("reqID"),
+                "msgType": "notify",
+                "seqNum": seq,
+                "data": {
+                    "code": C.code_str(m.code),
+                    "codeMsg": CODE_MSG.get(m.code, ""),
+                    "reqPath": ref.get("_path"),
+                    "content": content,
+                },
+            },
+        )
+
+    def _uplink(self, msg_type: str, data: Dict) -> None:
+        self._publish_up(
+            TOPIC_RESPONSE, {"msgType": msg_type, "data": data}
+        )
+
+    def _publish_up(self, topic: str, obj: Dict) -> None:
+        if self.session is None:
+            return
+        self.session.publish_sync(
+            topic, json.dumps(obj).encode(), qos=self.gw.qos
+        )
+
+    # -- teardown ------------------------------------------------------------
+    def drop(self, reason: str) -> None:
+        for task in self._retransmits.values():
+            task.cancel()
+        self._retransmits.clear()
+        self._pending.clear()
+        self._observing.clear()
+        if self.session is not None:
+            self.session.close(reason)
+            self.session = None
+        if self.endpoint is not None:
+            self.gw.cm.close(self.endpoint, self)
+        self.location = None
+        self.gw.forget(self.peer)
+
+
+class Lwm2mGateway(Gateway):
+    """UDP endpoint + per-endpoint channels (emqx_lwm2m_impl.erl)."""
+
+    def __init__(self, name: str, config: Dict):
+        super().__init__(name, config)
+        self.qos = config.get("qos", 0)
+        self.default_lifetime = config.get("lifetime", 86400)
+        self.lifetime_min = config.get("lifetime_min", 1)
+        self.lifetime_max = config.get("lifetime_max", 86400 * 7)
+        self.mountpoint = config.get("mountpoint", "lwm2m/{ep}/")
+        self._transport = None
+        self._chans: Dict[Tuple[str, int], Lwm2mChannel] = {}
+        self._reaper: Optional[asyncio.Task] = None
+
+    def mountpoint_for(self, ep: str) -> str:
+        return self.mountpoint.replace("{ep}", ep).replace(
+            "${endpoint_name}", ep
+        )
+
+    def authenticate_sync(self, info: GwClientInfo, password=None) -> bool:
+        res = self.hooks.run_fold(
+            "client.authenticate",
+            (info.as_dict(),),
+            {"ok": True, "password": password},
+        )
+        return bool(res is None or res.get("ok", True))
+
+    def sendto(self, data: bytes, peer) -> None:
+        if self._transport is not None:
+            self._transport.sendto(data, peer)
+
+    def forget(self, peer) -> None:
+        self._chans.pop(peer, None)
+
+    def find_channel(self, endpoint: str) -> Optional[Lwm2mChannel]:
+        return self.cm.get(endpoint)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        gw = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                gw._transport = transport
+
+            def datagram_received(self, data, addr):
+                m = C.decode_message(data)
+                if m is None:
+                    return
+                chan = gw._chans.get(addr)
+                if chan is None:
+                    chan = Lwm2mChannel(gw, addr)
+                    gw._chans[addr] = chan
+                chan.handle(m)
+
+        host = self.config.get("bind", "127.0.0.1")
+        port = self.config.get("port", 5783)
+        self._endpoint = await loop.create_datagram_endpoint(
+            Proto, local_addr=(host, port)
+        )
+        self.port = self._endpoint[0].get_extra_info("sockname")[1]
+        self._reaper = loop.create_task(self._reap_loop())
+
+    async def _reap_loop(self, period: float = 5.0) -> None:
+        """Registration lifetime expiry (emqx_lwm2m_session lifetime)."""
+        try:
+            while True:
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                for chan in list(self._chans.values()):
+                    if (
+                        chan.session is not None
+                        and now - chan.last_seen > chan.lifetime * 1.5
+                    ):
+                        chan.drop("lifetime_expired")
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+        for chan in list(self._chans.values()):
+            chan.drop("gateway_stopped")
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None

@@ -48,9 +48,10 @@ class GatewayRegistry:
     """Registered gateway types + running instances
     (emqx_gateway.erl registry + per-gateway supervision tree)."""
 
-    def __init__(self, broker, hooks):
+    def __init__(self, broker, hooks, retainer=None):
         self.broker = broker
         self.hooks = hooks
+        self.retainer = retainer
         self._types: Dict[str, Callable] = {}  # type name -> Gateway class
         self._running: Dict[str, object] = {}  # instance name -> Gateway
 
@@ -71,6 +72,7 @@ class GatewayRegistry:
         gw.cm = GatewayCM(name)
         gw.broker = self.broker
         gw.hooks = self.hooks
+        gw.retainer = self.retainer
         await gw.start()
         self._running[name] = gw
         log.info("gateway %s (%s) started", name, type_name)

@@ -685,7 +685,9 @@ class MgmtApi:
             from emqx_tpu.gateway.registry import GatewayRegistry
 
             self.app.gateways = GatewayRegistry(
-                self.app.broker, self.app.hooks
+                self.app.broker,
+                self.app.hooks,
+                retainer=getattr(self.app, "retainer", None),
             )
             _register_builtin_gateways(self.app.gateways)
         return self.app.gateways

@@ -58,9 +58,10 @@ def test_cross_node_publish_exact(cluster3):
     _, (a, b, c), _ = cluster3
     got, deliver = collector()
     b.subscribe("s1", "c1", "t/1", SubOpts(qos=0), deliver)
-    # route replicated to all nodes
+    # route replicated to all nodes (replication rides b's sender queues,
+    # so drain b before asserting the other nodes see the route)
+    b.flush()
     for n in (a, b, c):
-        n.flush()
         assert n.routes.has_route("t/1")
     n_del = a.publish(Message(topic="t/1", payload=b"x"))
     a.flush()

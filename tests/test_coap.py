@@ -1,0 +1,403 @@
+"""CoAP gateway tests driven by an independent scripted client.
+
+The client below implements its own RFC 7252 encoder/decoder (no imports
+from the gateway's codec), the way the reference's CT suites drive the
+gateway with er_coap_client (apps/emqx_gateway/test/emqx_coap_SUITE.erl).
+"""
+
+import asyncio
+import functools
+import struct
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.retainer import Retainer
+from emqx_tpu.gateway.coap import CoapGateway
+from emqx_tpu.gateway.registry import GatewayRegistry
+from emqx_tpu.mqtt import packet as pkt
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+# -- independent scripted client --------------------------------------------
+
+CON, NON, ACK, RST = 0, 1, 2, 3
+
+
+def c_encode(
+    mtype,
+    code,
+    mid,
+    token=b"",
+    path=(),
+    queries=(),
+    payload=b"",
+    observe=None,
+    block1=None,
+    block2=None,
+):
+    """Scripted-client encoder, written independently of the gateway."""
+    opts = []
+    if observe is not None:
+        opts.append((6, b"" if observe == 0 else observe.to_bytes(3, "big").lstrip(b"\x00") or b"\x00"))
+    for seg in path:
+        opts.append((11, seg.encode()))
+    for q in queries:
+        opts.append((15, q.encode()))
+    for optnum, blk in ((27, block1), (23, block2)):
+        if blk is not None:
+            num, more, size = blk
+            szx = {16: 0, 32: 1, 64: 2, 128: 3, 256: 4, 512: 5, 1024: 6}[size]
+            v = (num << 4) | (8 if more else 0) | szx
+            opts.append((optnum, v.to_bytes(3, "big").lstrip(b"\x00") or b""))
+    out = bytearray([0x40 | (mtype << 4) | len(token), code])
+    out += struct.pack("!H", mid) + token
+    prev = 0
+    for n, v in sorted(opts, key=lambda o: o[0]):  # stable: keeps path order
+        d = n - prev
+        prev = n
+        assert d < 13, "scripted client keeps option deltas small"
+        if len(v) < 13:
+            out.append((d << 4) | len(v))
+        else:
+            assert len(v) < 269
+            out.append((d << 4) | 13)
+            out.append(len(v) - 13)
+        out += v
+    if payload:
+        out.append(0xFF)
+        out += payload
+    return bytes(out)
+
+
+def c_decode(data):
+    """-> dict(type, code, mid, token, options={num: [bytes]}, payload)."""
+    tkl = data[0] & 0x0F
+    out = {
+        "type": (data[0] >> 4) & 3,
+        "code": data[1],
+        "mid": struct.unpack_from("!H", data, 2)[0],
+        "token": data[4 : 4 + tkl],
+        "options": {},
+        "payload": b"",
+    }
+    pos = 4 + tkl
+    prev = 0
+    while pos < len(data):
+        b = data[pos]
+        pos += 1
+        if b == 0xFF:
+            out["payload"] = data[pos:]
+            break
+        d, ln = b >> 4, b & 0x0F
+        if d == 13:
+            d = data[pos] + 13
+            pos += 1
+        if ln == 13:
+            ln = data[pos] + 13
+            pos += 1
+        prev += d
+        out["options"].setdefault(prev, []).append(data[pos : pos + ln])
+        pos += ln
+    return out
+
+
+def opt_uint(resp, num, default=None):
+    vals = resp["options"].get(num)
+    if not vals:
+        return default
+    return int.from_bytes(vals[0], "big")
+
+
+class CoapClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self.transport = None
+        self._mid = 100
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(c_decode(data))
+
+    async def connect(self, port):
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, remote_addr=("127.0.0.1", port)
+        )
+
+    def send_raw(self, data):
+        self.transport.sendto(data)
+
+    def request(self, mtype, code, **kw):
+        self._mid += 1
+        tok = kw.pop("token", struct.pack("!H", self._mid))
+        self.send_raw(c_encode(mtype, code, self._mid, token=tok, **kw))
+        return self._mid, tok
+
+    async def recv(self, timeout=5.0):
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+    def close(self):
+        if self.transport:
+            self.transport.close()
+
+
+GET, POST, PUT, DELETE = 1, 2, 3, 4
+
+
+class Bed:
+    __test__ = False
+
+    def __init__(self, gw_config=None):
+        self.hooks = Hooks()
+        self.broker = Broker(hooks=self.hooks)
+        self.retainer = Retainer()
+        self.retainer.attach(self.hooks)
+        self.registry = GatewayRegistry(self.broker, self.hooks)
+        self.registry.register_type("coap", CoapGateway)
+        self.config = {"port": 0, "retainer": self.retainer, **(gw_config or {})}
+
+    async def start(self):
+        self.gw = await self.registry.load("coap", self.config)
+        return self.gw
+
+    async def stop(self):
+        await self.registry.unload_all()
+
+    def collect(self, filter_):
+        got = []
+        self.broker.subscribe(
+            "obs", "obs", filter_, pkt.SubOpts(qos=0), lambda m, o: got.append(m)
+        )
+        return got
+
+
+@async_test
+async def test_publish_con_gets_changed_and_reaches_broker():
+    bed = Bed()
+    gw = await bed.start()
+    got = bed.collect("sensors/#")
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        mid, tok = cli.request(
+            CON, POST, path=("ps", "sensors", "t1"), payload=b"22.5",
+            queries=("clientid=c1",),
+        )
+        resp = await cli.recv()
+        assert resp["type"] == ACK and resp["mid"] == mid
+        assert resp["code"] == 0x44  # 2.04 Changed
+        await asyncio.sleep(0.05)
+        assert [m.payload for m in got] == [b"22.5"]
+        assert got[0].topic == "sensors/t1"
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_observe_subscribe_and_notify():
+    bed = Bed()
+    gw = await bed.start()
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        mid, tok = cli.request(
+            CON, GET, path=("ps", "room", "temp"), observe=0,
+            queries=("clientid=c-obs",),
+        )
+        resp = await cli.recv()
+        assert resp["code"] == 0x45  # 2.05 Content
+        seq0 = opt_uint(resp, 6)
+        assert seq0 is not None
+        # publish from the MQTT side -> notification with higher seq
+        bed.broker.publish(Message(topic="room/temp", payload=b"20.1"))
+        await asyncio.sleep(0.05)
+        note = await cli.recv()
+        assert note["code"] == 0x45 and note["payload"] == b"20.1"
+        assert note["token"] == tok
+        assert opt_uint(note, 6) > seq0
+        # second publish: sequence strictly increases
+        bed.broker.publish(Message(topic="room/temp", payload=b"20.2"))
+        note2 = await cli.recv()
+        assert note2["payload"] == b"20.2"
+        assert opt_uint(note2, 6) > opt_uint(note, 6)
+        # unsubscribe via Observe:1 -> 2.07, no further notifications
+        cli.request(
+            CON, GET, path=("ps", "room", "temp"), observe=1,
+            queries=("clientid=c-obs",),
+        )
+        resp = await cli.recv()
+        assert resp["code"] == 0x47  # 2.07 No Content
+        bed.broker.publish(Message(topic="room/temp", payload=b"21"))
+        await asyncio.sleep(0.1)
+        assert cli.inbox.empty()
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_get_reads_retained_message():
+    bed = Bed()
+    gw = await bed.start()
+    bed.broker.publish(
+        Message(topic="conf/limit", payload=b"42", retain=True)
+    )
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        cli.request(CON, GET, path=("ps", "conf", "limit"),
+                    queries=("clientid=c2",))
+        resp = await cli.recv()
+        assert resp["code"] == 0x45 and resp["payload"] == b"42"
+        cli.request(CON, GET, path=("ps", "conf", "missing"),
+                    queries=("clientid=c2",))
+        resp = await cli.recv()
+        assert resp["code"] == 0x84  # 4.04
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_connection_mode_lifecycle_and_token_guard():
+    bed = Bed()
+    gw = await bed.start()
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        # connect -> 2.01 + token payload
+        cli.request(CON, POST, path=("mqtt", "connection"),
+                    queries=("clientid=dev1", "username=u", "password=p"))
+        resp = await cli.recv()
+        assert resp["code"] == 0x41  # 2.01 Created
+        token = resp["payload"].decode()
+        assert token
+        # request with wrong token -> 4.01
+        cli.request(CON, POST, path=("ps", "up"), payload=b"x",
+                    queries=("clientid=dev1", "token=bogus"))
+        resp = await cli.recv()
+        assert resp["code"] == 0x81  # 4.01
+        # right token -> accepted
+        got = bed.collect("up")
+        cli.request(CON, POST, path=("ps", "up"), payload=b"x",
+                    queries=("clientid=dev1", f"token={token}"))
+        resp = await cli.recv()
+        assert resp["code"] == 0x44
+        await asyncio.sleep(0.05)
+        assert len(got) == 1
+        # heartbeat -> 2.04 Changed
+        cli.request(CON, PUT, path=("mqtt", "connection"),
+                    queries=("clientid=dev1", f"token={token}"))
+        resp = await cli.recv()
+        assert resp["code"] == 0x44
+        # close -> 2.02 Deleted
+        cli.request(CON, DELETE, path=("mqtt", "connection"),
+                    queries=("clientid=dev1", f"token={token}"))
+        resp = await cli.recv()
+        assert resp["code"] == 0x42
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_message_id_dedup_replays_cached_response():
+    bed = Bed()
+    gw = await bed.start()
+    got = bed.collect("d/#")
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        raw = c_encode(CON, POST, 777, token=b"tt", path=("ps", "d", "1"),
+                       queries=("clientid=c3",), payload=b"v")
+        cli.send_raw(raw)
+        r1 = await cli.recv()
+        cli.send_raw(raw)  # retransmission of the same message id
+        r2 = await cli.recv()
+        assert r1 == r2
+        await asyncio.sleep(0.05)
+        assert len(got) == 1  # published exactly once
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_block1_upload_assembles_payload():
+    bed = Bed()
+    gw = await bed.start()
+    got = bed.collect("big/#")
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        body = bytes(range(256)) * 5  # 1280 bytes > one 512B block
+        blocks = [body[i : i + 512] for i in range(0, len(body), 512)]
+        tok = b"\x01\x02"
+        for i, chunk in enumerate(blocks):
+            more = i < len(blocks) - 1
+            cli.request(
+                CON, PUT, token=tok, path=("ps", "big", "b"),
+                queries=("clientid=c4",), payload=chunk,
+                block1=(i, more, 512),
+            )
+            resp = await cli.recv()
+            if more:
+                assert resp["code"] == 0x5F  # 2.31 Continue
+            else:
+                assert resp["code"] == 0x44  # 2.04 Changed
+        await asyncio.sleep(0.05)
+        assert len(got) == 1 and got[0].payload == body
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_block2_notification_download():
+    """Notifications larger than max_block_size arrive as Block2 slices."""
+    bed = Bed({"max_block_size": 64, "notify_type": "non"})
+    gw = await bed.start()
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        cli.request(CON, GET, path=("ps", "blob"), observe=0,
+                    queries=("clientid=c5",))
+        await cli.recv()
+        body = b"A" * 200
+        bed.broker.publish(Message(topic="blob", payload=body))
+        first = await cli.recv()
+        assert first["payload"] == body[:64]
+        blk = opt_uint(first, 23)
+        assert blk is not None and (blk & 0x08)  # more flag set
+    finally:
+        cli.close()
+        await bed.stop()
+
+
+@async_test
+async def test_bad_topic_and_unknown_path():
+    bed = Bed()
+    gw = await bed.start()
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        cli.request(CON, POST, path=("ps", "bad", "#"), payload=b"x",
+                    queries=("clientid=c6",))
+        resp = await cli.recv()
+        assert resp["code"] == 0x80  # 4.00: wildcard in a publish topic
+        cli.request(CON, GET, path=("nope",))
+        resp = await cli.recv()
+        assert resp["code"] == 0x84  # 4.04
+    finally:
+        cli.close()
+        await bed.stop()

@@ -1,0 +1,397 @@
+"""LwM2M gateway tests: scripted device client + MQTT-side command driver.
+
+Mirrors the reference's emqx_lwm2m_SUITE flow: register -> downlink
+command JSON on lwm2m/{ep}/dn/# -> device response -> uplink JSON on
+lwm2m/{ep}/up/resp (notify on up/notify). The device client below speaks
+raw CoAP using the independent codec from test_coap.
+"""
+
+import asyncio
+import functools
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.gateway.lwm2m import Lwm2mGateway
+from emqx_tpu.gateway import lwm2m_codec as LC
+from emqx_tpu.gateway.registry import GatewayRegistry
+from emqx_tpu.mqtt import packet as pkt
+
+from tests.test_coap import (
+    ACK,
+    CON,
+    NON,
+    GET,
+    POST,
+    PUT,
+    DELETE,
+    CoapClient,
+    c_encode,
+    opt_uint,
+)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+class Bed:
+    __test__ = False
+
+    def __init__(self):
+        self.hooks = Hooks()
+        self.broker = Broker(hooks=self.hooks)
+        self.registry = GatewayRegistry(self.broker, self.hooks)
+        self.registry.register_type("lwm2m", Lwm2mGateway)
+
+    async def start(self, **cfg):
+        self.gw = await self.registry.load("lwm2m", {"port": 0, **cfg})
+        return self.gw
+
+    async def stop(self):
+        await self.registry.unload_all()
+
+    def collect(self, filter_):
+        got = []
+        self.broker.subscribe(
+            "obs", "obs", filter_, pkt.SubOpts(qos=0), lambda m, o: got.append(m)
+        )
+        return got
+
+    def send_cmd(self, ep, cmd):
+        self.broker.publish(
+            Message(topic=f"lwm2m/{ep}/dn/cmd", payload=json.dumps(cmd).encode())
+        )
+
+
+class Device(CoapClient):
+    """Scripted LwM2M device: registers and answers downlink requests."""
+
+    async def register(self, port, ep, lt=300, objects="</1/0>,</3/0>"):
+        await self.connect(port)
+        self.request(
+            CON,
+            POST,
+            path=("rd",),
+            queries=(f"ep={ep}", f"lt={lt}", "lwm2m=1.0", "b=U"),
+            payload=objects.encode(),
+        )
+        resp = await self.recv()
+        assert resp["code"] == 0x41, resp  # 2.01 Created
+        loc = [v.decode() for v in resp["options"].get(8, [])]
+        assert loc and loc[0] == "rd"
+        self.location = loc[1]
+        return resp
+
+    async def expect_request(self, timeout=5.0):
+        """Wait for a downlink CoAP request from the gateway."""
+        while True:
+            m = await self.recv(timeout)
+            if m["code"] in (GET, POST, PUT, DELETE):
+                return m
+
+    def respond(self, req, code, payload=b"", content_format=None, observe=None):
+        opts = []
+        if content_format is not None:
+            v = content_format.to_bytes(2, "big").lstrip(b"\x00") or b""
+            opts.append((12, v))
+        raw = c_encode(
+            ACK,
+            code,
+            req["mid"],
+            token=req["token"],
+            payload=payload,
+            observe=observe,
+        )
+        # content-format option isn't in c_encode's kwargs; splice manually
+        if content_format is not None:
+            raw = _with_option(raw, 12, content_format)
+        self.send_raw(raw)
+
+    def notify(self, token, seq, payload, content_format=0):
+        self._mid += 1
+        raw = c_encode(NON, 0x45, self._mid, token=token, payload=payload,
+                       observe=seq)
+        if content_format:
+            raw = _with_option(raw, 12, content_format)
+        self.send_raw(raw)
+
+
+def _with_option(raw, num, uint_val):
+    """Re-encode a scripted frame inserting a uint option (test helper)."""
+    # decode with the independent decoder, re-encode including the option
+    from tests.test_coap import c_decode
+
+    m = c_decode(raw)
+    v = uint_val.to_bytes(2, "big").lstrip(b"\x00") or b""
+    # rebuild: header + token
+    out = bytearray([0x40 | (m["type"] << 4) | len(m["token"]), m["code"]])
+    out += struct.pack("!H", m["mid"]) + m["token"]
+    opts = []
+    for n, vals in m["options"].items():
+        for val in vals:
+            opts.append((n, val))
+    opts.append((num, v))
+    prev = 0
+    for n, val in sorted(opts, key=lambda o: o[0]):
+        d = n - prev
+        prev = n
+        assert d < 13
+        if len(val) < 13:
+            out.append((d << 4) | len(val))
+        else:
+            out.append((d << 4) | 13)
+            out.append(len(val) - 13)
+        out += val
+    if m["payload"]:
+        out.append(0xFF)
+        out += m["payload"]
+    return bytes(out)
+
+
+# -- TLV codec unit tests ----------------------------------------------------
+
+
+def test_tlv_roundtrip_resource():
+    items = [LC.Tlv(LC.RESOURCE, 0, b"Acme"), LC.Tlv(LC.RESOURCE, 9, b"\x64")]
+    enc = LC.encode_tlv(items)
+    dec = LC.decode_tlv(enc)
+    assert [(t.kind, t.ident, t.value) for t in dec] == [
+        (LC.RESOURCE, 0, b"Acme"),
+        (LC.RESOURCE, 9, b"\x64"),
+    ]
+
+
+def test_tlv_nested_object_instance():
+    inst = LC.Tlv(
+        LC.OBJ_INSTANCE,
+        0,
+        [LC.Tlv(LC.RESOURCE, 0, b"X"), LC.Tlv(LC.RESOURCE, 300, b"\x01" * 300)],
+    )
+    dec = LC.decode_tlv(LC.encode_tlv([inst]))
+    assert dec[0].kind == LC.OBJ_INSTANCE
+    kids = dec[0].children
+    assert kids[0].value == b"X"
+    assert kids[1].ident == 300 and len(kids[1].value) == 300
+
+
+def test_tlv_to_json_device_object():
+    # Device object: 3/0/0 manufacturer (String), 3/0/9 battery (Integer)
+    payload = LC.encode_tlv(
+        [
+            LC.Tlv(
+                LC.OBJ_INSTANCE,
+                0,
+                [
+                    LC.Tlv(LC.RESOURCE, 0, b"Acme"),
+                    LC.Tlv(LC.RESOURCE, 9, (87).to_bytes(1, "big")),
+                ],
+            )
+        ]
+    )
+    rows = LC.tlv_to_json("/3", payload)
+    by_path = {r["path"]: r["value"] for r in rows}
+    assert by_path["/3/0/0"] == "Acme"
+    assert by_path["/3/0/9"] == 87
+
+
+def test_pack_unpack_values():
+    assert LC.unpack_value("Integer", LC.pack_value("Integer", -5)) == -5
+    assert LC.unpack_value("Integer", LC.pack_value("Integer", 70000)) == 70000
+    assert LC.unpack_value("Boolean", LC.pack_value("Boolean", True)) is True
+    assert abs(LC.unpack_value("Float", LC.pack_value("Float", 2.5)) - 2.5) < 1e-9
+    assert LC.unpack_value("String", LC.pack_value("String", "hi")) == "hi"
+
+
+def test_path_type_lookup():
+    assert LC.path_type("/3/0/0") == "String"
+    assert LC.path_type("/3/0/9") == "Integer"
+    assert LC.path_type("/1/0/1") == "Integer"
+    assert LC.path_type("/6/0/0") == "Float"
+    assert LC.path_type("/99/0/0") == "String"
+
+
+# -- gateway lifecycle tests -------------------------------------------------
+
+
+@async_test
+async def test_register_publishes_uplink_and_location():
+    bed = Bed()
+    gw = await bed.start()
+    up = bed.collect("lwm2m/ep1/up/resp")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep1", lt=120)
+        await asyncio.sleep(0.05)
+        assert len(up) == 1
+        body = json.loads(up[0].payload)
+        assert body["msgType"] == "register"
+        assert body["data"]["ep"] == "ep1"
+        assert body["data"]["lt"] == 120
+        assert body["data"]["objectList"] == ["/1/0", "/3/0"]
+        assert gw.cm.count() == 1
+    finally:
+        dev.close()
+        await bed.stop()
+
+
+@async_test
+async def test_update_and_deregister():
+    bed = Bed()
+    gw = await bed.start()
+    up = bed.collect("lwm2m/ep2/up/resp")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep2", lt=100)
+        dev.request(
+            CON, POST, path=("rd", dev.location), queries=("lt=200",)
+        )
+        resp = await dev.recv()
+        assert resp["code"] == 0x44  # 2.04 Changed
+        await asyncio.sleep(0.05)
+        kinds = [json.loads(m.payload)["msgType"] for m in up]
+        assert kinds == ["register", "update"]
+        assert json.loads(up[1].payload)["data"]["lt"] == 200
+        # deregister
+        dev.request(CON, DELETE, path=("rd", dev.location))
+        resp = await dev.recv()
+        assert resp["code"] == 0x42  # 2.02 Deleted
+        assert gw.cm.count() == 0
+    finally:
+        dev.close()
+        await bed.stop()
+
+
+@async_test
+async def test_read_command_round_trip():
+    bed = Bed()
+    gw = await bed.start()
+    up = bed.collect("lwm2m/ep3/up/resp")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep3")
+        await asyncio.sleep(0.05)
+        bed.send_cmd("ep3", {"reqID": 7, "msgType": "read",
+                             "data": {"path": "/3/0/0"}})
+        req = await dev.expect_request()
+        assert req["code"] == GET
+        # device answers 2.05 text/plain
+        dev.respond(req, 0x45, payload=b"Acme Ltd", content_format=0)
+        await asyncio.sleep(0.1)
+        resps = [json.loads(m.payload) for m in up]
+        resp = [r for r in resps if r.get("reqID") == 7]
+        assert resp, resps
+        r = resp[0]
+        assert r["msgType"] == "read"
+        assert r["data"]["code"] == "2.05"
+        assert r["data"]["content"] == [{"path": "/3/0/0", "value": "Acme Ltd"}]
+    finally:
+        dev.close()
+        await bed.stop()
+
+
+@async_test
+async def test_write_command_sends_tlv_and_reports_changed():
+    bed = Bed()
+    gw = await bed.start()
+    up = bed.collect("lwm2m/ep4/up/resp")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep4")
+        await asyncio.sleep(0.05)
+        bed.send_cmd("ep4", {"reqID": 8, "msgType": "write",
+                             "data": {"path": "/1/0/1", "value": 600}})
+        req = await dev.expect_request()
+        assert req["code"] == PUT
+        # payload is TLV for resource 1 with integer 600
+        tlvs = LC.decode_tlv(req["payload"])
+        assert tlvs[0].ident == 1
+        assert int.from_bytes(tlvs[0].value, "big", signed=True) == 600
+        dev.respond(req, 0x44)  # 2.04 Changed
+        await asyncio.sleep(0.1)
+        resps = [json.loads(m.payload) for m in up if b"reqID" in m.payload]
+        r = [x for x in resps if x.get("reqID") == 8][0]
+        assert r["data"]["code"] == "2.04"
+        assert r["data"]["codeMsg"] == "changed"
+    finally:
+        dev.close()
+        await bed.stop()
+
+
+@async_test
+async def test_execute_command():
+    bed = Bed()
+    gw = await bed.start()
+    up = bed.collect("lwm2m/ep5/up/resp")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep5")
+        await asyncio.sleep(0.05)
+        bed.send_cmd("ep5", {"reqID": 9, "msgType": "execute",
+                             "data": {"path": "/3/0/4", "args": "now"}})
+        req = await dev.expect_request()
+        assert req["code"] == POST and req["payload"] == b"now"
+        dev.respond(req, 0x44)
+        await asyncio.sleep(0.1)
+        resps = [json.loads(m.payload) for m in up if b"reqID" in m.payload]
+        assert [x for x in resps if x.get("reqID") == 9]
+    finally:
+        dev.close()
+        await bed.stop()
+
+
+@async_test
+async def test_observe_and_notify_stream():
+    bed = Bed()
+    gw = await bed.start()
+    up_resp = bed.collect("lwm2m/ep6/up/resp")
+    up_note = bed.collect("lwm2m/ep6/up/notify")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep6")
+        await asyncio.sleep(0.05)
+        bed.send_cmd("ep6", {"reqID": 10, "msgType": "observe",
+                             "data": {"path": "/3/0/9"}})
+        req = await dev.expect_request()
+        assert req["code"] == GET and opt_uint(req, 6) == 0
+        token = req["token"]
+        # initial value -> response channel
+        dev.respond(req, 0x45, payload=b"77", content_format=0, observe=0)
+        await asyncio.sleep(0.1)
+        resps = [json.loads(m.payload) for m in up_resp if b"reqID" in m.payload]
+        first = [x for x in resps if x.get("reqID") == 10][0]
+        assert first["msgType"] == "observe"
+        assert first["data"]["content"] == [{"path": "/3/0/9", "value": 77}]
+        # subsequent notifications -> notify topic with seqNum
+        dev.notify(token, 5, b"76")
+        await asyncio.sleep(0.1)
+        notes = [json.loads(m.payload) for m in up_note]
+        assert notes and notes[0]["msgType"] == "notify"
+        assert notes[0]["seqNum"] == 5
+        assert notes[0]["data"]["content"] == [{"path": "/3/0/9", "value": 76}]
+    finally:
+        dev.close()
+        await bed.stop()
+
+
+@async_test
+async def test_bad_register_missing_ep():
+    bed = Bed()
+    gw = await bed.start()
+    dev = Device()
+    try:
+        await dev.connect(gw.port)
+        dev.request(CON, POST, path=("rd",), queries=("lt=60",))
+        resp = await dev.recv()
+        assert resp["code"] == 0x80  # 4.00
+    finally:
+        dev.close()
+        await bed.stop()
