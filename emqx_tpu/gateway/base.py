@@ -196,6 +196,25 @@ class Gateway:
     async def stop(self) -> None:
         raise NotImplementedError
 
+    # -- auth (shared by all protocol gateways): run the same
+    # 'client.authenticate' fold the MQTT channel uses
+    # (emqx_gateway_channel authenticate -> emqx_access_control) --------
+    async def authenticate(self, info: GwClientInfo, password=None) -> bool:
+        res = await self.hooks.arun_fold(
+            "client.authenticate",
+            (info.as_dict(),),
+            {"ok": True, "password": password},
+        )
+        return bool(res is None or res.get("ok", True))
+
+    def authenticate_sync(self, info: GwClientInfo, password=None) -> bool:
+        res = self.hooks.run_fold(
+            "client.authenticate",
+            (info.as_dict(),),
+            {"ok": True, "password": password},
+        )
+        return bool(res is None or res.get("ok", True))
+
     def status(self) -> Dict:
         return {
             "name": self.name,

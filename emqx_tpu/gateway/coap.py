@@ -37,6 +37,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from emqx_tpu.broker import mountpoint as MP
 from emqx_tpu.broker.message import Message
 from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
 from emqx_tpu.mqtt import packet as pkt
@@ -251,6 +252,7 @@ class CoapChannel:
         self._observes: Dict[str, ObserveEntry] = {}  # topic -> entry
         self._dedup: Dict[int, Tuple[float, Optional[bytes]]] = {}
         self._pending_con: Dict[int, asyncio.Task] = {}  # mid -> retransmit
+        self._con_tokens: Dict[int, bytes] = {}  # mid -> token (in-flight CON)
         self._block1: Dict[bytes, Block1Buf] = {}  # token -> partial upload
         self._block2: Dict[bytes, bytes] = {}  # token -> full response body
 
@@ -267,6 +269,9 @@ class CoapChannel:
         self.send(m)
         task = asyncio.get_running_loop().create_task(self._retransmit(m))
         self._pending_con[m.msg_id] = task
+        # an RST carries only the msg id (no token), so remember which
+        # token each in-flight CON belongs to for observe cancellation
+        self._con_tokens[m.msg_id] = m.token
 
     async def _retransmit(self, m: CoapMessage) -> None:
         try:
@@ -281,10 +286,11 @@ class CoapChannel:
         except asyncio.CancelledError:
             pass
 
-    def _ack_received(self, mid: int) -> None:
+    def _ack_received(self, mid: int) -> Optional[bytes]:
         task = self._pending_con.pop(mid, None)
         if task is not None:
             task.cancel()
+        return self._con_tokens.pop(mid, None)
 
     def reply(
         self,
@@ -311,10 +317,14 @@ class CoapChannel:
     def handle(self, m: CoapMessage) -> None:
         self.last_seen = time.monotonic()
         if m.type in (ACK, RST):
-            self._ack_received(m.msg_id)
+            con_token = self._ack_received(m.msg_id)
             if m.type == RST:
-                # peer rejected a notification: cancel its observe
-                self._cancel_observes_by_token(m.token)
+                # peer rejected a notification: cancel its observe. RFC
+                # 7252 RSTs carry no token, so resolve it from the
+                # in-flight CON's msg id.
+                self._cancel_observes_by_token(
+                    m.token or con_token or b""
+                )
             return
         if m.code == EMPTY:
             if m.type == CON:  # CoAP ping
@@ -423,6 +433,7 @@ class CoapChannel:
                 return self.reply(m, REQ_INCOMPLETE)
             buf.data += m.payload
             buf.next_num += 1
+            buf.at = time.monotonic()  # live upload: not abandoned
             if more:
                 r = self.reply(m, CONTINUE)
                 r.set_block(OPT_BLOCK1, num, True, size)
@@ -442,7 +453,12 @@ class CoapChannel:
         retainer = self.gw.config.get("retainer") or getattr(
             self.gw, "retainer", None
         )
-        msgs = retainer.match(topic) if retainer is not None else []
+        sess = self._ensure_session(m)
+        if sess is None:
+            return self.reply(m, UNAUTHORIZED)
+        # match under the mountpoint publishes were stored with
+        mounted = MP.mount(sess.mountpoint, topic)
+        msgs = retainer.match(mounted) if retainer is not None else []
         if not msgs:
             return self.reply(m, NOT_FOUND)
         return self._content_reply(m, msgs[0].payload)
@@ -470,6 +486,8 @@ class CoapChannel:
         return self.reply(m, NO_CONTENT)
 
     def _cancel_observes_by_token(self, token: bytes) -> None:
+        if not token:
+            return
         for topic, ent in list(self._observes.items()):
             if ent.token == token:
                 self._observes.pop(topic, None)
@@ -583,6 +601,7 @@ class CoapChannel:
         for task in self._pending_con.values():
             task.cancel()
         self._pending_con.clear()
+        self._con_tokens.clear()
         self._observes.clear()
         if self.session is not None:
             self.session.close(reason)
@@ -612,14 +631,6 @@ class CoapGateway(Gateway):
         self._transport = None
         self._chans: Dict[Tuple[str, int], CoapChannel] = {}
         self._reaper: Optional[asyncio.Task] = None
-
-    def authenticate_sync(self, info: GwClientInfo, password=None) -> bool:
-        res = self.hooks.run_fold(
-            "client.authenticate",
-            (info.as_dict(),),
-            {"ok": True, "password": password},
-        )
-        return bool(res is None or res.get("ok", True))
 
     def sendto(self, data: bytes, peer) -> None:
         if self._transport is not None:
@@ -656,7 +667,8 @@ class CoapGateway(Gateway):
 
     async def _reap_loop(self, period: float = 5.0) -> None:
         """Expire peers silent past 2x heartbeat (channel keepalive,
-        emqx_coap_channel.erl heartbeat timer)."""
+        emqx_coap_channel.erl heartbeat timer); sweep stale dedup cache
+        entries and abandoned Block1 uploads."""
         try:
             while True:
                 await asyncio.sleep(period)
@@ -664,6 +676,15 @@ class CoapGateway(Gateway):
                 for chan in list(self._chans.values()):
                     if now - chan.last_seen > 2 * self.heartbeat:
                         chan.drop("heartbeat_timeout")
+                        continue
+                    chan._dedup = {
+                        mid: v
+                        for mid, v in chan._dedup.items()
+                        if now - v[0] < DEDUP_WINDOW
+                    }
+                    for tok, buf in list(chan._block1.items()):
+                        if now - buf.at > EXCHANGE_LIFETIME:
+                            del chan._block1[tok]
         except asyncio.CancelledError:
             pass
 

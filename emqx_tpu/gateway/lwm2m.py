@@ -31,6 +31,7 @@ import asyncio
 import json
 import logging
 import secrets
+import struct
 import time
 from typing import Dict, Optional, Tuple
 
@@ -84,6 +85,7 @@ class Lwm2mChannel:
         # observe-token -> path (kept after the first response, for notifies)
         self._observing: Dict[bytes, Dict] = {}
         self._retransmits: Dict[int, asyncio.Task] = {}
+        self._con_tokens: Dict[int, bytes] = {}  # mid -> token (in-flight)
         self._dedup: Dict[int, Tuple[float, Optional[bytes]]] = {}
 
     # -- plumbing ------------------------------------------------------------
@@ -103,6 +105,8 @@ class Lwm2mChannel:
         self.send(m)
         task = asyncio.get_running_loop().create_task(self._retransmit(m))
         self._retransmits[m.msg_id] = task
+        # RSTs carry only the msg id; remember the token for cleanup
+        self._con_tokens[m.msg_id] = m.token
 
     async def _retransmit(self, m: C.CoapMessage) -> None:
         try:
@@ -123,18 +127,29 @@ class Lwm2mChannel:
         except asyncio.CancelledError:
             pass
 
-    def _ack(self, mid: int) -> None:
+    def _ack(self, mid: int) -> Optional[bytes]:
         task = self._retransmits.pop(mid, None)
         if task is not None:
             task.cancel()
+        return self._con_tokens.pop(mid, None)
 
     # -- inbound from the device --------------------------------------------
     def handle(self, m: C.CoapMessage) -> None:
         self.last_seen = time.monotonic()
         if m.type in (C.ACK, C.RST):
-            self._ack(m.msg_id)
+            con_token = self._ack(m.msg_id)
             if m.type == C.RST:
-                self._observing.pop(m.token, None)
+                # device rejected a downlink: resolve the (empty-token)
+                # RST back to the CON it answers, fail the command
+                # upward and drop any observe bookkeeping
+                token = m.token or con_token
+                if token:
+                    self._observing.pop(token, None)
+                    ref = self._pending.pop(token, None)
+                    if ref is not None:
+                        self._uplink_response(
+                            ref, code="reset", content=None
+                        )
                 return
             if m.code != C.EMPTY:
                 self._handle_response(m)
@@ -189,7 +204,10 @@ class Lwm2mChannel:
         ep = q.get("ep")
         if not ep:
             return self._reply(m, C.BAD_REQUEST)
-        self.lifetime = float(q.get("lt", self.gw.default_lifetime))
+        try:
+            self.lifetime = float(q.get("lt", self.gw.default_lifetime))
+        except ValueError:
+            return self._reply(m, C.BAD_REQUEST)
         if not (
             self.gw.lifetime_min <= self.lifetime <= self.gw.lifetime_max
         ):
@@ -239,7 +257,10 @@ class Lwm2mChannel:
             return self._reply(m, C.NOT_FOUND)
         q = m.queries
         if "lt" in q:
-            self.lifetime = float(q["lt"])
+            try:
+                self.lifetime = float(q["lt"])
+            except ValueError:
+                return self._reply(m, C.BAD_REQUEST)
             self.reg_info["lt"] = int(self.lifetime)
         if m.payload:
             links = m.payload.decode("utf-8", "replace")
@@ -263,12 +284,35 @@ class Lwm2mChannel:
             log.warning("lwm2m %s: bad downlink payload", self.endpoint)
             return
         msg_type = cmd.get("msgType")
-        data = cmd.get("data", {})
+        data = cmd.get("data") or {}
+        if not isinstance(data, dict):
+            log.warning("lwm2m %s: bad downlink data", self.endpoint)
+            return
         path = data.get("path") or data.get("basePath") or "/"
         token = self.next_token()
         req = C.CoapMessage(type=C.CON, msg_id=self.next_mid(), token=token)
-        for seg in LC.parse_path(path):
-            req.options.append((C.OPT_URI_PATH, str(seg).encode()))
+        try:
+            for seg in LC.parse_path(path):
+                req.options.append((C.OPT_URI_PATH, str(seg).encode()))
+            self._build_downlink(req, msg_type, data, path)
+        except (ValueError, TypeError, IndexError, KeyError) as e:
+            # bad command from the MQTT side: answer on up/resp instead
+            # of letting the error escape the broker's delivery fan-out
+            log.warning("lwm2m %s: bad downlink %r: %s",
+                        self.endpoint, msg_type, e)
+            self._uplink_response(
+                {**cmd, "_path": path}, code="bad_request", content=None
+            )
+            return
+        if req.code == C.EMPTY:
+            log.warning("lwm2m %s: unknown msgType %r", self.endpoint, msg_type)
+            return
+        self._pending[token] = {**cmd, "_path": path}
+        self.send_con(req)
+
+    def _build_downlink(
+        self, req: C.CoapMessage, msg_type: str, data: Dict, path: str
+    ) -> None:
         if msg_type == "read":
             req.code = C.GET
         elif msg_type == "write":
@@ -309,11 +353,7 @@ class Lwm2mChannel:
                     req.options.append(
                         (C.OPT_URI_QUERY, f"{k}={data[k]}".encode())
                     )
-        else:
-            log.warning("lwm2m %s: unknown msgType %r", self.endpoint, msg_type)
-            return
-        self._pending[token] = {**cmd, "_path": path}
-        self.send_con(req)
+        # unknown msg_type: req.code stays EMPTY, caller drops it
 
     # -- device response -> uplink JSON (emqx_lwm2m_cmd coap_to_mqtt) --------
     def _handle_response(self, m: C.CoapMessage) -> None:
@@ -345,13 +385,21 @@ class Lwm2mChannel:
             return None
         path = ref.get("_path", "/")
         fmt = m.opt_uint(C.OPT_CONTENT_FORMAT)
-        if fmt == LC.FMT_TLV:
-            return LC.tlv_to_json(path, m.payload)
-        if fmt == LC.FMT_LINK:
-            return m.payload.decode("utf-8", "replace").split(",")
-        if fmt == LC.FMT_OPAQUE:
+        try:
+            if fmt == LC.FMT_TLV:
+                return LC.tlv_to_json(path, m.payload)
+            if fmt == LC.FMT_LINK:
+                return m.payload.decode("utf-8", "replace").split(",")
+            if fmt == LC.FMT_OPAQUE:
+                return LC.opaque_to_json(path, m.payload)
+            return LC.text_to_json(path, m.payload)
+        except (IndexError, ValueError, KeyError, struct.error) as e:
+            # malformed device payload: report it upward rather than
+            # dropping the exchange (emqx_lwm2m_cmd bad_payload_format)
+            log.warning(
+                "lwm2m %s: bad payload for %s: %s", self.endpoint, path, e
+            )
             return LC.opaque_to_json(path, m.payload)
-        return LC.text_to_json(path, m.payload)
 
     def _uplink_response(
         self, ref: Dict, code, content, msg_type_override: Optional[str] = None
@@ -413,6 +461,7 @@ class Lwm2mChannel:
         for task in self._retransmits.values():
             task.cancel()
         self._retransmits.clear()
+        self._con_tokens.clear()
         self._pending.clear()
         self._observing.clear()
         if self.session is not None:
@@ -442,14 +491,6 @@ class Lwm2mGateway(Gateway):
         return self.mountpoint.replace("{ep}", ep).replace(
             "${endpoint_name}", ep
         )
-
-    def authenticate_sync(self, info: GwClientInfo, password=None) -> bool:
-        res = self.hooks.run_fold(
-            "client.authenticate",
-            (info.as_dict(),),
-            {"ok": True, "password": password},
-        )
-        return bool(res is None or res.get("ok", True))
 
     def sendto(self, data: bytes, peer) -> None:
         if self._transport is not None:
@@ -499,6 +540,12 @@ class Lwm2mGateway(Gateway):
                         and now - chan.last_seen > chan.lifetime * 1.5
                     ):
                         chan.drop("lifetime_expired")
+                        continue
+                    chan._dedup = {
+                        mid: v
+                        for mid, v in chan._dedup.items()
+                        if now - v[0] < C.DEDUP_WINDOW
+                    }
         except asyncio.CancelledError:
             pass
 

@@ -537,14 +537,6 @@ class SnGateway(Gateway):
         self._chans: Dict[Tuple[str, int], SnChannel] = {}
         self._reaper: Optional[asyncio.Task] = None
 
-    async def authenticate(self, info: GwClientInfo, password=None) -> bool:
-        res = await self.hooks.arun_fold(
-            "client.authenticate",
-            (info.as_dict(),),
-            {"ok": True, "password": password},
-        )
-        return bool(res is None or res.get("ok", True))
-
     def sendto(self, data: bytes, peer) -> None:
         if self._transport is not None:
             self._transport.sendto(data, peer)

@@ -457,16 +457,6 @@ class StompGateway(Gateway):
         self._server: Optional[asyncio.AbstractServer] = None
         self._chans: set = set()
 
-    async def authenticate(self, info: GwClientInfo, password) -> bool:
-        """'client.authenticate' fold, same hookpoint as the MQTT channel
-        (emqx_access_control.erl:31-38)."""
-        res = await self.hooks.arun_fold(
-            "client.authenticate",
-            (info.as_dict(),),
-            {"ok": True, "password": password},
-        )
-        return bool(res is None or res.get("ok", True))
-
     async def start(self) -> None:
         host = self.config.get("bind", "127.0.0.1")
         port = self.config.get("port", 61613)

@@ -99,6 +99,50 @@ class BridgeManager:
                 local_topic=cfg.get("ingress_local_topic", "${topic}"),
                 local_qos=cfg.get("ingress_local_qos", 0),
             )
+        if btype in ("mysql", "pgsql"):
+            from emqx_tpu.integration.sql_common import SqlSink
+
+            if btype == "mysql":
+                from emqx_tpu.integration.mysql import MysqlConnector as Conn
+            else:
+                from emqx_tpu.integration.pgsql import PgsqlConnector as Conn
+            conn = Conn(
+                host=cfg.get("host", "127.0.0.1"),
+                port=cfg.get("port", 3306 if btype == "mysql" else 5432),
+                user=cfg.get("user") or cfg.get("username", ""),
+                password=cfg.get("password", ""),
+                database=cfg.get("database", ""),
+                timeout=cfg.get("request_timeout", 5.0),
+            )
+            return SqlSink(conn, cfg.get("sql", ""))
+        if btype == "redis":
+            from emqx_tpu.integration.redis import RedisConnector
+            from emqx_tpu.utils.placeholder import render
+
+            conn = RedisConnector(
+                host=cfg.get("host", "127.0.0.1"),
+                port=cfg.get("port", 6379),
+                db=cfg.get("db", 0),
+                password=cfg.get("password"),
+                timeout=cfg.get("request_timeout", 5.0),
+            )
+            cmd_template = cfg.get("command", ["LPUSH", "emqx:${topic}", "${payload}"])
+
+            class RedisSink:
+                async def start(self):
+                    await conn.start()
+
+                async def stop(self):
+                    await conn.stop()
+
+                async def health_check(self):
+                    return await conn.health_check()
+
+                async def query(self, env):
+                    args = [render(str(a), env) for a in cmd_template]
+                    return await conn.command(*args)
+
+            return RedisSink()
         raise ValueError(f"unknown bridge type: {btype}")
 
     async def remove(self, bridge_id: str) -> bool:

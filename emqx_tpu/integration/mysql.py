@@ -1,0 +1,321 @@
+"""MySQL connector: from-scratch client protocol + authn/authz backends.
+
+Parity: apps/emqx_connector/src/emqx_connector_mysql.erl (mysql-otp
+client) plus emqx_authn_mysql.erl / emqx_authz_mysql.erl.
+
+No MySQL client library exists in this image, so the wire protocol is
+implemented directly (the same approach as the RESP2 client in
+integration/redis.py):
+
+- packet framing: 3-byte little-endian length + sequence id
+- handshake v10 parse + HandshakeResponse41 with
+  ``mysql_native_password`` scramble (SHA1(p) XOR SHA1(nonce·SHA1²(p))),
+  AuthSwitchRequest handling
+- text protocol COM_QUERY result sets (column definitions skipped,
+  length-encoded row values), OK/ERR/EOF packets, COM_PING health checks
+
+``query(sql) -> (column_names, rows)`` with row values as bytes|None,
+which is what the shared SQL authn/authz layer (sql_common.py) consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import struct
+from typing import List, Optional, Tuple
+
+from emqx_tpu.integration.resource import Resource
+from emqx_tpu.integration.sql_common import (
+    DEFAULT_AUTHN_QUERY,
+    DEFAULT_AUTHZ_QUERY,
+    SqlAuthProvider,
+    SqlAuthzSource,
+)
+
+log = logging.getLogger("emqx_tpu.integration.mysql")
+
+# capability flags (include/mysql_com.h names)
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+
+COM_QUERY = 0x03
+COM_PING = 0x0E
+COM_QUIT = 0x01
+
+UTF8_CHARSET = 33
+
+
+class MysqlError(Exception):
+    """Transport / protocol failure (connection must be reset)."""
+
+
+class MysqlServerError(MysqlError):
+    """An ERR packet: server refused, stream still aligned."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+def native_password_scramble(password: bytes, nonce: bytes) -> bytes:
+    """mysql_native_password: SHA1(p) XOR SHA1(nonce + SHA1(SHA1(p)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(nonce + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def _lenenc_int(data: bytes, pos: int) -> Tuple[Optional[int], int]:
+    first = data[pos]
+    if first < 0xFB:
+        return first, pos + 1
+    if first == 0xFB:  # NULL in row context
+        return None, pos + 1
+    if first == 0xFC:
+        return struct.unpack_from("<H", data, pos + 1)[0], pos + 3
+    if first == 0xFD:
+        return int.from_bytes(data[pos + 1 : pos + 4], "little"), pos + 4
+    if first == 0xFE:
+        return struct.unpack_from("<Q", data, pos + 1)[0], pos + 9
+    raise MysqlError(f"bad length-encoded integer 0x{first:02x}")
+
+
+def _lenenc_str(data: bytes, pos: int) -> Tuple[Optional[bytes], int]:
+    n, pos = _lenenc_int(data, pos)
+    if n is None:
+        return None, pos
+    return data[pos : pos + n], pos + n
+
+
+class MysqlConnector(Resource):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 3306,
+        user: str = "root",
+        password: str = "",
+        database: str = "",
+        timeout: float = 5.0,
+    ):
+        self.host = host
+        self.port = port
+        self.user = user
+        self.password = password
+        self.database = database
+        self.timeout = timeout
+        self._r: Optional[asyncio.StreamReader] = None
+        self._w: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self._seq = 0
+        self.server_version = ""
+
+    # -- framing -------------------------------------------------------------
+    async def _read_packet(self) -> bytes:
+        hdr = await self._r.readexactly(4)
+        n = int.from_bytes(hdr[:3], "little")
+        self._seq = (hdr[3] + 1) & 0xFF
+        return await self._r.readexactly(n)
+
+    def _send_packet(self, payload: bytes) -> None:
+        self._w.write(
+            len(payload).to_bytes(3, "little")
+            + bytes([self._seq])
+            + payload
+        )
+        self._seq = (self._seq + 1) & 0xFF
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> None:
+        self._r, self._w = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout
+        )
+        self._seq = 0
+        await asyncio.wait_for(self._handshake(), self.timeout)
+
+    async def _handshake(self) -> None:
+        greeting = await self._read_packet()
+        if greeting[0] == 0xFF:
+            raise self._err(greeting)
+        if greeting[0] != 10:
+            raise MysqlError(f"unsupported protocol version {greeting[0]}")
+        pos = 1
+        end = greeting.index(b"\x00", pos)
+        self.server_version = greeting[pos:end].decode()
+        pos = end + 1 + 4  # connection id
+        auth1 = greeting[pos : pos + 8]
+        pos += 8 + 1  # filler
+        cap = struct.unpack_from("<H", greeting, pos)[0]
+        pos += 2
+        auth2 = b""
+        plugin = b"mysql_native_password"
+        if len(greeting) > pos:
+            pos += 1 + 2  # charset + status
+            cap |= struct.unpack_from("<H", greeting, pos)[0] << 16
+            pos += 2
+            auth_len = greeting[pos]
+            pos += 1 + 10  # reserved
+            if cap & CLIENT_SECURE_CONNECTION:
+                n2 = max(13, auth_len - 8)
+                auth2 = greeting[pos : pos + n2].rstrip(b"\x00")
+                pos += n2
+            if cap & CLIENT_PLUGIN_AUTH:
+                end = greeting.index(b"\x00", pos)
+                plugin = greeting[pos:end]
+        nonce = (auth1 + auth2)[:20]
+
+        flags = (
+            CLIENT_LONG_PASSWORD
+            | CLIENT_PROTOCOL_41
+            | CLIENT_TRANSACTIONS
+            | CLIENT_SECURE_CONNECTION
+            | CLIENT_PLUGIN_AUTH
+        )
+        if self.database:
+            flags |= CLIENT_CONNECT_WITH_DB
+        auth_resp = native_password_scramble(self.password.encode(), nonce)
+        body = struct.pack("<IIB23x", flags, 1 << 24, UTF8_CHARSET)
+        body += self.user.encode() + b"\x00"
+        body += bytes([len(auth_resp)]) + auth_resp
+        if self.database:
+            body += self.database.encode() + b"\x00"
+        body += b"mysql_native_password\x00"
+        self._send_packet(body)
+
+        resp = await self._read_packet()
+        if resp[0] == 0xFE:  # AuthSwitchRequest
+            end = resp.index(b"\x00", 1)
+            switch_plugin = resp[1:end]
+            new_nonce = resp[end + 1 :].rstrip(b"\x00")[:20]
+            if switch_plugin != b"mysql_native_password":
+                raise MysqlError(
+                    f"unsupported auth plugin {switch_plugin!r}"
+                )
+            self._send_packet(
+                native_password_scramble(self.password.encode(), new_nonce)
+            )
+            resp = await self._read_packet()
+        if resp[0] == 0xFF:
+            raise self._err(resp)
+        if resp[0] != 0x00:
+            raise MysqlError(f"unexpected handshake reply 0x{resp[0]:02x}")
+
+    async def stop(self) -> None:
+        if self._w is not None:
+            try:
+                self._seq = 0
+                self._send_packet(bytes([COM_QUIT]))
+                self._w.close()
+                await self._w.wait_closed()
+            except Exception:
+                pass
+            self._r = self._w = None
+
+    async def health_check(self) -> bool:
+        try:
+            await self._command(bytes([COM_PING]))
+            return True
+        except Exception:
+            return False
+
+    # -- text protocol -------------------------------------------------------
+    def _err(self, pkt: bytes) -> MysqlServerError:
+        code = struct.unpack_from("<H", pkt, 1)[0]
+        msg = pkt[3:]
+        if msg[:1] == b"#":  # sql state marker
+            msg = msg[6:]
+        return MysqlServerError(code, msg.decode("utf-8", "replace"))
+
+    async def _command(self, payload: bytes) -> bytes:
+        if self._w is None:
+            raise MysqlError("not connected")
+        self._seq = 0
+        self._send_packet(payload)
+        pkt = await self._read_packet()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        return pkt
+
+    async def query(
+        self, sql: str
+    ) -> Tuple[List[str], List[List[Optional[bytes]]]]:
+        """COM_QUERY -> (column_names, rows); DML returns ([], [])."""
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._do_query(sql), self.timeout
+                )
+            except MysqlServerError:
+                raise
+            except (
+                asyncio.TimeoutError,
+                asyncio.IncompleteReadError,
+                OSError,
+                MysqlError,
+            ) as e:
+                # desynced stream: drop the connection, resource layer
+                # reconnects (same policy as the RESP2 client)
+                try:
+                    self._w.close()
+                except Exception:
+                    pass
+                self._r = self._w = None
+                raise MysqlError(f"connection reset: {e}") from e
+
+    async def _do_query(self, sql: str):
+        first = await self._command(bytes([COM_QUERY]) + sql.encode())
+        if first[0] == 0x00:  # OK packet: no result set
+            return [], []
+        ncols, _ = _lenenc_int(first, 0)
+        cols: List[str] = []
+        for _ in range(ncols):
+            coldef = await self._read_packet()
+            # catalog, schema, table, org_table, name, org_name
+            pos = 0
+            vals = []
+            for _f in range(6):
+                v, pos = _lenenc_str(coldef, pos)
+                vals.append(v)
+            cols.append((vals[4] or b"").decode("utf-8", "replace"))
+        pkt = await self._read_packet()
+        if pkt[0] == 0xFE and len(pkt) < 9:  # EOF after col defs
+            pkt = await self._read_packet()
+        rows: List[List[Optional[bytes]]] = []
+        while True:
+            if pkt[0] == 0xFE and len(pkt) < 9:  # EOF: result done
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            pos = 0
+            row: List[Optional[bytes]] = []
+            for _ in range(ncols):
+                v, pos = _lenenc_str(pkt, pos)
+                row.append(v)
+            rows.append(row)
+            pkt = await self._read_packet()
+        return cols, rows
+
+    async def execute(self, sql: str) -> None:
+        await self.query(sql)
+
+
+class MysqlAuthProvider(SqlAuthProvider):
+    """emqx_authn_mysql.erl parity over the from-scratch client."""
+
+    def __init__(self, conn: MysqlConnector, query: str = DEFAULT_AUTHN_QUERY,
+                 algo: str = "sha256"):
+        super().__init__(conn, query, algo)
+
+
+class MysqlAuthzSource(SqlAuthzSource):
+    """emqx_authz_mysql.erl parity over the from-scratch client."""
+
+    def __init__(self, conn: MysqlConnector, query: str = DEFAULT_AUTHZ_QUERY):
+        super().__init__(conn, query)

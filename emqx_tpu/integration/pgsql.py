@@ -1,0 +1,310 @@
+"""PostgreSQL connector: from-scratch client protocol + authn/authz.
+
+Parity: apps/emqx_connector/src/emqx_connector_pgsql.erl (epgsql client)
+plus emqx_authn_pgsql.erl / emqx_authz_pgsql.erl.
+
+No libpq/psycopg in this image, so the v3 frontend/backend protocol is
+implemented directly:
+
+- StartupMessage (protocol 3.0) with user/database parameters
+- authentication: trust (AuthenticationOk), cleartext password, MD5
+  (``md5`` + md5(md5(password+user)+salt)), and SCRAM-SHA-256 SASL
+  (RFC 5802/7677 client: client-first/server-first/client-final with
+  server-signature verification)
+- simple query protocol: Q -> RowDescription/DataRow/CommandComplete/
+  ReadyForQuery, ErrorResponse handling
+
+``query(sql) -> (column_names, rows)`` with values as bytes|None, the
+interface sql_common.py consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import logging
+import secrets
+import struct
+from typing import List, Optional, Tuple
+
+from emqx_tpu.integration.resource import Resource
+from emqx_tpu.integration.sql_common import (
+    DEFAULT_AUTHN_QUERY,
+    DEFAULT_AUTHZ_QUERY,
+    SqlAuthProvider,
+    SqlAuthzSource,
+)
+
+log = logging.getLogger("emqx_tpu.integration.pgsql")
+
+
+class PgError(Exception):
+    """Transport / protocol failure (connection must be reset)."""
+
+
+class PgServerError(PgError):
+    """An ErrorResponse: server refused, stream still aligned (the
+    backend always follows with ReadyForQuery in the simple protocol)."""
+
+    def __init__(self, fields: dict):
+        self.fields = fields
+        super().__init__(fields.get("M", "server error"))
+
+
+def _scram_client_proof(
+    password: bytes, salt: bytes, iterations: int, auth_message: bytes
+) -> Tuple[bytes, bytes]:
+    """-> (client_proof, expected_server_signature) per RFC 5802."""
+    salted = hashlib.pbkdf2_hmac("sha256", password, salt, iterations)
+    client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored_key = hashlib.sha256(client_key).digest()
+    client_sig = hmac.new(stored_key, auth_message, hashlib.sha256).digest()
+    proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+    server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+    server_sig = hmac.new(server_key, auth_message, hashlib.sha256).digest()
+    return proof, server_sig
+
+
+class PgsqlConnector(Resource):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 5432,
+        user: str = "postgres",
+        password: str = "",
+        database: str = "postgres",
+        timeout: float = 5.0,
+    ):
+        self.host = host
+        self.port = port
+        self.user = user
+        self.password = password
+        self.database = database
+        self.timeout = timeout
+        self._r: Optional[asyncio.StreamReader] = None
+        self._w: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self.parameters: dict = {}
+
+    # -- framing -------------------------------------------------------------
+    async def _read_msg(self) -> Tuple[bytes, bytes]:
+        hdr = await self._r.readexactly(5)
+        tag = hdr[:1]
+        n = struct.unpack("!I", hdr[1:])[0]
+        body = await self._r.readexactly(n - 4)
+        return tag, body
+
+    def _send_msg(self, tag: bytes, body: bytes) -> None:
+        self._w.write(tag + struct.pack("!I", len(body) + 4) + body)
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> None:
+        self._r, self._w = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout
+        )
+        await asyncio.wait_for(self._startup(), self.timeout)
+
+    async def _startup(self) -> None:
+        params = (
+            b"user\x00" + self.user.encode() + b"\x00"
+            b"database\x00" + self.database.encode() + b"\x00\x00"
+        )
+        body = struct.pack("!I", 196608) + params  # protocol 3.0
+        self._w.write(struct.pack("!I", len(body) + 4) + body)
+        while True:
+            tag, data = await self._read_msg()
+            if tag == b"E":
+                raise PgServerError(self._parse_error(data))
+            if tag == b"R":
+                await self._authenticate(data)
+                continue
+            if tag == b"S":  # ParameterStatus
+                k, _, v = data.rstrip(b"\x00").partition(b"\x00")
+                self.parameters[k.decode()] = v.decode()
+                continue
+            if tag == b"K":  # BackendKeyData
+                continue
+            if tag == b"Z":  # ReadyForQuery
+                return
+            raise PgError(f"unexpected startup message {tag!r}")
+
+    async def _authenticate(self, data: bytes) -> None:
+        code = struct.unpack_from("!I", data)[0]
+        if code == 0:  # AuthenticationOk
+            return
+        if code == 3:  # cleartext
+            self._send_msg(b"p", self.password.encode() + b"\x00")
+            return
+        if code == 5:  # md5
+            salt = data[4:8]
+            inner = hashlib.md5(
+                self.password.encode() + self.user.encode()
+            ).hexdigest()
+            digest = hashlib.md5(inner.encode() + salt).hexdigest()
+            self._send_msg(b"p", b"md5" + digest.encode() + b"\x00")
+            return
+        if code == 10:  # SASL: mechanism list
+            mechs = [m for m in data[4:].split(b"\x00") if m]
+            if b"SCRAM-SHA-256" not in mechs:
+                raise PgError(f"no supported SASL mechanism in {mechs}")
+            await self._scram()
+            return
+        raise PgError(f"unsupported authentication request {code}")
+
+    async def _scram(self) -> None:
+        cnonce = base64.b64encode(secrets.token_bytes(18)).decode()
+        first_bare = f"n=,r={cnonce}".encode()
+        initial = b"n,," + first_bare
+        body = (
+            b"SCRAM-SHA-256\x00" + struct.pack("!I", len(initial)) + initial
+        )
+        self._send_msg(b"p", body)
+        tag, data = await self._read_msg()
+        if tag == b"E":
+            raise PgServerError(self._parse_error(data))
+        if tag != b"R" or struct.unpack_from("!I", data)[0] != 11:
+            raise PgError("expected SASLContinue")
+        server_first = data[4:]
+        attrs = dict(
+            kv.split(b"=", 1) for kv in server_first.split(b",") if b"=" in kv
+        )
+        rnonce = attrs[b"r"].decode()
+        if not rnonce.startswith(cnonce):
+            raise PgError("server nonce does not extend client nonce")
+        salt = base64.b64decode(attrs[b"s"])
+        iterations = int(attrs[b"i"])
+        final_bare = f"c=biws,r={rnonce}".encode()
+        auth_message = first_bare + b"," + server_first + b"," + final_bare
+        proof, server_sig = _scram_client_proof(
+            self.password.encode(), salt, iterations, auth_message
+        )
+        final = final_bare + b",p=" + base64.b64encode(proof)
+        self._send_msg(b"p", final)
+        tag, data = await self._read_msg()
+        if tag == b"E":
+            raise PgServerError(self._parse_error(data))
+        if tag != b"R" or struct.unpack_from("!I", data)[0] != 12:
+            raise PgError("expected SASLFinal")
+        sf = data[4:]
+        got = dict(kv.split(b"=", 1) for kv in sf.split(b",") if b"=" in kv)
+        if base64.b64decode(got.get(b"v", b"")) != server_sig:
+            raise PgError("bad server signature (server not authenticated)")
+        tag, data = await self._read_msg()
+        if tag == b"E":
+            raise PgServerError(self._parse_error(data))
+        if tag != b"R" or struct.unpack_from("!I", data)[0] != 0:
+            raise PgError("expected AuthenticationOk after SASL")
+
+    async def stop(self) -> None:
+        if self._w is not None:
+            try:
+                self._send_msg(b"X", b"")  # Terminate
+                self._w.close()
+                await self._w.wait_closed()
+            except Exception:
+                pass
+            self._r = self._w = None
+
+    async def health_check(self) -> bool:
+        try:
+            cols, rows = await self.query("SELECT 1")
+            return bool(rows and rows[0][0] in (b"1", "1", 1))
+        except Exception:
+            return False
+
+    # -- simple query protocol ------------------------------------------------
+    def _parse_error(self, data: bytes) -> dict:
+        out = {}
+        pos = 0
+        while pos < len(data) and data[pos] != 0:
+            t = chr(data[pos])
+            end = data.index(b"\x00", pos + 1)
+            out[t] = data[pos + 1 : end].decode("utf-8", "replace")
+            pos = end + 1
+        return out
+
+    async def query(
+        self, sql: str
+    ) -> Tuple[List[str], List[List[Optional[bytes]]]]:
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._do_query(sql), self.timeout
+                )
+            except PgServerError:
+                raise
+            except (
+                asyncio.TimeoutError,
+                asyncio.IncompleteReadError,
+                OSError,
+                PgError,
+            ) as e:
+                try:
+                    self._w.close()
+                except Exception:
+                    pass
+                self._r = self._w = None
+                raise PgError(f"connection reset: {e}") from e
+
+    async def _do_query(self, sql: str):
+        if self._w is None:
+            raise PgError("not connected")
+        self._send_msg(b"Q", sql.encode() + b"\x00")
+        cols: List[str] = []
+        rows: List[List[Optional[bytes]]] = []
+        error: Optional[PgServerError] = None
+        while True:
+            tag, data = await self._read_msg()
+            if tag == b"T":  # RowDescription
+                (n,) = struct.unpack_from("!H", data)
+                pos = 2
+                cols = []
+                for _ in range(n):
+                    end = data.index(b"\x00", pos)
+                    cols.append(data[pos:end].decode("utf-8", "replace"))
+                    pos = end + 1 + 18  # oid/attnum/typoid/typlen/mod/fmt
+            elif tag == b"D":  # DataRow
+                (n,) = struct.unpack_from("!H", data)
+                pos = 2
+                row: List[Optional[bytes]] = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", data, pos)
+                    pos += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(data[pos : pos + ln])
+                        pos += ln
+                rows.append(row)
+            elif tag == b"C" or tag == b"I":  # CommandComplete / EmptyQuery
+                continue
+            elif tag == b"E":
+                error = PgServerError(self._parse_error(data))
+            elif tag == b"N":  # NoticeResponse
+                continue
+            elif tag == b"Z":  # ReadyForQuery: transaction done
+                if error is not None:
+                    raise error
+                return cols, rows
+            else:
+                raise PgError(f"unexpected message {tag!r}")
+
+    async def execute(self, sql: str) -> None:
+        await self.query(sql)
+
+
+class PgsqlAuthProvider(SqlAuthProvider):
+    """emqx_authn_pgsql.erl parity over the from-scratch client."""
+
+    def __init__(self, conn: PgsqlConnector, query: str = DEFAULT_AUTHN_QUERY,
+                 algo: str = "sha256"):
+        super().__init__(conn, query, algo)
+
+
+class PgsqlAuthzSource(SqlAuthzSource):
+    """emqx_authz_pgsql.erl parity over the from-scratch client."""
+
+    def __init__(self, conn: PgsqlConnector, query: str = DEFAULT_AUTHZ_QUERY):
+        super().__init__(conn, query)

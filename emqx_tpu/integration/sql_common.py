@@ -1,0 +1,194 @@
+"""Shared SQL-database authn/authz logic for the MySQL/PostgreSQL clients.
+
+Parity: the query/row handling common to
+apps/emqx_authn/src/simple_authn/emqx_authn_mysql.erl + _pgsql.erl
+(SELECT password_hash/salt/is_superuser, hash check) and
+apps/emqx_authz/src/emqx_authz_mysql.erl + _pgsql.erl
+(SELECT permission/action/topic rows mapped to allow|deny).
+
+Both wire clients expose ``await query(sql) -> (columns, rows)`` with
+text-protocol values as bytes/str; everything here is protocol-agnostic.
+
+The reference binds parameters with prepared statements; here templated
+``${var}`` placeholders are rendered as SQL string literals with quote
+escaping (render_sql), which is equivalent for the quoted-literal cases
+these queries use.
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from emqx_tpu.broker.auth import DENY, IGNORE, OK, Provider, _hash_password
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.ops import topics as T
+from emqx_tpu.utils.placeholder import render
+
+log = logging.getLogger("emqx_tpu.integration.sql")
+
+_VAR = re.compile(r"\$\{([a-zA-Z0-9_.]+)\}")
+
+
+def sql_quote(value) -> str:
+    """Render one value as a SQL literal (single quotes doubled,
+    backslashes escaped — safe for both MySQL and PostgreSQL with
+    standard_conforming_strings handled by doubling only quotes for pg;
+    backslash doubling is harmless in string context)."""
+    if value is None:
+        return "NULL"
+    if isinstance(value, (bytes, bytearray)):
+        s = value.decode("utf-8", "replace")
+    else:
+        s = str(value)
+    return "'" + s.replace("\\", "\\\\").replace("'", "''") + "'"
+
+
+def render_sql(template: str, env: Dict) -> str:
+    """``${var}`` -> quoted SQL literal from env ('' when missing)."""
+    return _VAR.sub(lambda m: sql_quote(env.get(m.group(1), "")), template)
+
+
+def _to_str(v) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(v, (bytes, bytearray)):
+        return v.decode("utf-8", "replace")
+    return str(v)
+
+
+def _client_env(client_info: Dict) -> Dict:
+    return {
+        "username": client_info.get("username") or "",
+        "clientid": client_info.get("client_id")
+        or client_info.get("clientid", ""),
+        "peerhost": (client_info.get("peername") or ("", 0))[0],
+    }
+
+
+DEFAULT_AUTHN_QUERY = (
+    "SELECT password_hash, salt, is_superuser FROM mqtt_user "
+    "where username = ${username} LIMIT 1"
+)
+DEFAULT_AUTHZ_QUERY = (
+    "SELECT permission, action, topic FROM mqtt_acl "
+    "where username = ${username}"
+)
+
+
+class SqlSink:
+    """Bridge/rule-sink adapter: renders an INSERT template per row env
+    and executes it on the wrapped connector (the role of
+    emqx_bridge_mysql/pgsql's ``sql`` template)."""
+
+    def __init__(self, conn, sql_template: str):
+        self.conn = conn
+        self.sql_template = sql_template
+
+    async def start(self) -> None:
+        await self.conn.start()
+
+    async def stop(self) -> None:
+        await self.conn.stop()
+
+    async def health_check(self) -> bool:
+        return await self.conn.health_check()
+
+    async def query(self, env: Dict):
+        return await self.conn.query(render_sql(self.sql_template, env))
+
+
+class SqlAuthProvider(Provider):
+    """Credential lookup via a templated SELECT (emqx_authn_mysql/_pgsql
+    parity). The query must yield password_hash [, salt [, is_superuser]]
+    — matched positionally when columns are unnamed, by name when the
+    connector reports column names."""
+
+    def __init__(
+        self,
+        conn,
+        query: str = DEFAULT_AUTHN_QUERY,
+        algo: str = "sha256",
+    ):
+        self.conn = conn
+        self.query_template = query
+        self.algo = algo
+
+    def authenticate(self, client_info, credentials):
+        return IGNORE, None  # decided on the async path
+
+    async def authenticate_async(self, client_info, credentials):
+        if credentials.get("enhanced_auth"):
+            return IGNORE, None
+        sql = render_sql(self.query_template, _client_env(client_info))
+        try:
+            cols, rows = await self.conn.query(sql)
+        except Exception as e:
+            log.warning("sql authn lookup failed: %s", e)
+            return IGNORE, None
+        if not rows:
+            return IGNORE, None
+        row = rows[0]
+        names = [c.lower() for c in cols] if cols else []
+        def col(name: str, idx: int):
+            if name in names:
+                return row[names.index(name)]
+            return row[idx] if idx < len(row) else None
+
+        phash = _to_str(col("password_hash", 0))
+        salt = _to_str(col("salt", 1)) or ""
+        is_super = _to_str(col("is_superuser", 2))
+        if phash is None:
+            return IGNORE, None
+        password = credentials.get("password") or b""
+        cand = _hash_password(password, self.algo, salt.encode())
+        # stored value may be hex (hashed algos) or raw (algo=plain)
+        if hmac.compare_digest(cand.hex(), phash) or hmac.compare_digest(
+            cand, phash.encode()
+        ):
+            if is_super in ("1", "true", "t", "True"):
+                client_info["is_superuser"] = True
+            return OK, None
+        return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+
+
+class SqlAuthzSource:
+    """permission/action/topic rule rows (emqx_authz_mysql/_pgsql parity):
+    first row whose action+topic match decides allow|deny; no match falls
+    through the chain."""
+
+    def __init__(self, conn, query: str = DEFAULT_AUTHZ_QUERY):
+        self.conn = conn
+        self.query_template = query
+
+    async def check(self, ci: Dict, action: str, topic: str) -> str:
+        env = _client_env(ci)
+        sql = render_sql(self.query_template, env)
+        try:
+            cols, rows = await self.conn.query(sql)
+        except Exception as e:
+            log.warning("sql authz lookup failed: %s", e)
+            return "ignore"
+        names = [c.lower() for c in cols] if cols else []
+
+        def col(row: Sequence, name: str, idx: int):
+            if name in names:
+                return row[names.index(name)]
+            return row[idx] if idx < len(row) else None
+
+        for row in rows:
+            permission = (_to_str(col(row, "permission", 0)) or "").lower()
+            act = (_to_str(col(row, "action", 1)) or "").lower()
+            filt = _to_str(col(row, "topic", 2)) or ""
+            if act not in (action, "all"):
+                continue
+            # ``eq `` prefix pins a literal topic (reference authz rule DSL)
+            if filt.startswith("eq "):
+                matched = topic == filt[3:]
+            else:
+                matched = T.match(topic, render(filt, env))
+            if matched:
+                return "allow" if permission == "allow" else "deny"
+        return "ignore"

@@ -223,7 +223,7 @@ def test_two_os_processes_cluster(tmp_path):
 
         got, deliver = collector()
         parent.subscribe("s1", "cp", "ack/child", SubOpts(), deliver)
-        assert poll(lambda: parent.routes.has_route("t/#"), timeout=10)
+        assert poll(lambda: parent.routes.has_route("t/#"), timeout=30)
 
         # exact routes replicate async (dirty-write parity): the child must
         # have ack/child before its ack publish can route back
@@ -234,17 +234,17 @@ def test_two_os_processes_cluster(tmp_path):
                 return False
             return any(f == "ack/child" for f, _nodes in dump)
 
-        assert poll(child_has_ack_route, timeout=10)
+        assert poll(child_has_ack_route, timeout=30)
         parent.publish(Message(topic="t/hello", payload=b"ping"))
-        assert poll(lambda: len(got) >= 1, timeout=10)
+        assert poll(lambda: len(got) >= 1, timeout=30)
         assert got[0].payload == b"ping"
 
         # hard-kill the child: no goodbye, routes must be GC'd on expiry
         proc.send_signal(signal.SIGKILL)
-        proc.wait(timeout=10)
+        proc.wait(timeout=30)
         clock.advance(FAILURE_TIMEOUT + 1)
         parent.membership.heartbeat()
-        assert poll(lambda: not parent.routes.has_route("t/#"), timeout=5)
+        assert poll(lambda: not parent.routes.has_route("t/#"), timeout=15)
         assert parent.publish(Message(topic="t/hello")) == 0
     finally:
         if proc.poll() is None:

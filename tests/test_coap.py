@@ -401,3 +401,30 @@ async def test_bad_topic_and_unknown_path():
     finally:
         cli.close()
         await bed.stop()
+
+
+@async_test
+async def test_rst_of_con_notification_cancels_observe():
+    """RFC 7252 RSTs carry no token; the gateway must resolve the
+    rejected CON's msg id back to the observe entry and cancel it."""
+    bed = Bed({"notify_type": "con"})
+    gw = await bed.start()
+    cli = CoapClient()
+    await cli.connect(gw.port)
+    try:
+        cli.request(CON, GET, path=("ps", "n", "1"), observe=0,
+                    queries=("clientid=c-rst",))
+        await cli.recv()
+        bed.broker.publish(Message(topic="n/1", payload=b"x"))
+        note = await cli.recv()
+        assert note["type"] == 0  # CON notification
+        # reject it: RST with the note's msg id and NO token
+        cli.send_raw(c_encode(3, 0, note["mid"]))
+        await asyncio.sleep(0.1)
+        # further publishes produce no notifications
+        bed.broker.publish(Message(topic="n/1", payload=b"y"))
+        await asyncio.sleep(0.15)
+        assert cli.inbox.empty()
+    finally:
+        cli.close()
+        await bed.stop()

@@ -395,3 +395,33 @@ async def test_bad_register_missing_ep():
     finally:
         dev.close()
         await bed.stop()
+
+
+@async_test
+async def test_bad_downlink_command_reports_bad_request():
+    """A malformed command must produce an up/resp error, not a crash
+    in the broker's delivery fan-out."""
+    bed = Bed()
+    gw = await bed.start()
+    up = bed.collect("lwm2m/ep7/up/resp")
+    dev = Device()
+    try:
+        await dev.register(gw.port, "ep7")
+        await asyncio.sleep(0.05)
+        bed.send_cmd("ep7", {"reqID": 11, "msgType": "read",
+                             "data": {"path": "/device/zero"}})
+        await asyncio.sleep(0.1)
+        resps = [json.loads(m.payload) for m in up if b"reqID" in m.payload]
+        bad = [x for x in resps if x.get("reqID") == 11]
+        assert bad and bad[0]["data"]["code"] == "bad_request"
+        # channel still alive: a good command round-trips afterwards
+        bed.send_cmd("ep7", {"reqID": 12, "msgType": "read",
+                             "data": {"path": "/3/0/1"}})
+        req = await dev.expect_request()
+        dev.respond(req, 0x45, payload=b"M1", content_format=0)
+        await asyncio.sleep(0.1)
+        resps = [json.loads(m.payload) for m in up if b"reqID" in m.payload]
+        assert [x for x in resps if x.get("reqID") == 12]
+    finally:
+        dev.close()
+        await bed.stop()

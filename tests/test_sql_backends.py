@@ -1,0 +1,628 @@
+"""MySQL + PostgreSQL wire clients against scripted fake servers.
+
+The stubs below speak the real wire protocols (handshake v10 +
+mysql_native_password, pg v3 startup + MD5/SCRAM-SHA-256), so the
+from-scratch clients' framing, auth scrambles, and result-set parsing
+are exercised end-to-end — the SUITE analog of the reference's
+docker-compose matrices (.ci/docker-compose-file/ mysql/pgsql).
+"""
+
+import asyncio
+import base64
+import functools
+import hashlib
+import hmac
+import secrets
+import struct
+
+import pytest
+
+from emqx_tpu.broker.auth import DENY, IGNORE, OK
+from emqx_tpu.integration.mysql import (
+    MysqlAuthProvider,
+    MysqlAuthzSource,
+    MysqlConnector,
+    MysqlError,
+    MysqlServerError,
+    native_password_scramble,
+)
+from emqx_tpu.integration.pgsql import (
+    PgError,
+    PgServerError,
+    PgsqlAuthProvider,
+    PgsqlAuthzSource,
+    PgsqlConnector,
+)
+from emqx_tpu.integration.sql_common import render_sql, sql_quote
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+# -- scripted MySQL server ---------------------------------------------------
+
+
+class StubMysql:
+    """Handshake v10 + COM_QUERY text protocol over real TCP.
+
+    tables: {sql_substring: (cols, rows)} — a query matches the first
+    substring key it contains; unmatched SELECTs return empty sets.
+    """
+
+    def __init__(self, user="app", password="pw", tables=None,
+                 auth_switch=False):
+        self.user = user
+        self.password = password
+        self.tables = tables or {}
+        self.auth_switch = auth_switch
+        self.queries = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        self.server.close()
+
+    # framing helpers
+    async def _read(self, r):
+        hdr = await r.readexactly(4)
+        n = int.from_bytes(hdr[:3], "little")
+        return hdr[3], await r.readexactly(n)
+
+    def _send(self, w, seq, payload):
+        w.write(len(payload).to_bytes(3, "little") + bytes([seq]) + payload)
+
+    def _ok(self, w, seq):
+        self._send(w, seq, b"\x00\x00\x00\x02\x00\x00\x00")
+
+    def _err(self, w, seq, code, msg):
+        self._send(
+            w, seq,
+            b"\xff" + struct.pack("<H", code) + b"#HY000" + msg.encode(),
+        )
+
+    def _lenenc(self, b):
+        if b is None:
+            return b"\xfb"
+        n = len(b)
+        if n < 0xFB:
+            return bytes([n]) + b
+        return b"\xfc" + struct.pack("<H", n) + b
+
+    async def _client(self, r, w):
+        try:
+            nonce = secrets.token_bytes(20)
+            # greeting: v10, version, conn id, auth1, filler, caps, ...
+            caps = 0x0200 | 0x8000 | 0x80000  # 41 | secure | plugin_auth
+            greet = (
+                bytes([10]) + b"8.0-stub\x00" + struct.pack("<I", 99)
+                + nonce[:8] + b"\x00"
+                + struct.pack("<H", caps & 0xFFFF)
+                + bytes([33]) + struct.pack("<H", 2)
+                + struct.pack("<H", caps >> 16)
+                + bytes([21]) + b"\x00" * 10
+                + nonce[8:] + b"\x00"
+                + b"mysql_native_password\x00"
+            )
+            self._send(w, 0, greet)
+            seq, resp = await self._read(r)
+            # parse handshake response: skip 32 fixed bytes, read username
+            pos = 32
+            end = resp.index(b"\x00", pos)
+            user = resp[pos:end].decode()
+            pos = end + 1
+            alen = resp[pos]
+            auth = resp[pos + 1 : pos + 1 + alen]
+            if self.auth_switch:
+                nonce = secrets.token_bytes(20)
+                self._send(
+                    w, seq + 1,
+                    b"\xfe" + b"mysql_native_password\x00" + nonce + b"\x00",
+                )
+                seq, auth = await self._read(r)
+            expect = native_password_scramble(self.password.encode(), nonce)
+            if user != self.user or auth != expect:
+                self._err(w, seq + 1, 1045, "Access denied")
+                w.close()
+                return
+            self._ok(w, seq + 1)
+            # command loop
+            while True:
+                seq, cmd = await self._read(r)
+                if not cmd or cmd[0] == 0x01:  # COM_QUIT
+                    break
+                if cmd[0] == 0x0E:  # COM_PING
+                    self._ok(w, 1)
+                    continue
+                if cmd[0] == 0x03:  # COM_QUERY
+                    sql = cmd[1:].decode()
+                    self.queries.append(sql)
+                    hit = next(
+                        (v for k, v in self.tables.items() if k in sql), None
+                    )
+                    if hit is None:
+                        if sql.upper().startswith(("INSERT", "UPDATE")):
+                            self._ok(w, 1)
+                            continue
+                        hit = ([], [])
+                    cols, rows = hit
+                    s = 1
+                    self._send(w, s, bytes([len(cols) or 0]))
+                    s += 1
+                    if not cols:
+                        continue
+                    for c in cols:
+                        cb = c.encode()
+                        coldef = (
+                            self._lenenc(b"def") + self._lenenc(b"")
+                            + self._lenenc(b"t") + self._lenenc(b"t")
+                            + self._lenenc(cb) + self._lenenc(cb)
+                            + bytes([0x0C]) + struct.pack("<H", 33)
+                            + struct.pack("<I", 255) + bytes([253])
+                            + struct.pack("<H", 0) + bytes([0])
+                            + struct.pack("<H", 0)
+                        )
+                        self._send(w, s, coldef)
+                        s += 1
+                    self._send(w, s, b"\xfe\x00\x00\x02\x00")  # EOF
+                    s += 1
+                    for row in rows:
+                        body = b"".join(
+                            self._lenenc(
+                                None if v is None else str(v).encode()
+                            )
+                            for v in row
+                        )
+                        self._send(w, s, body)
+                        s += 1
+                    self._send(w, s, b"\xfe\x00\x00\x02\x00")  # EOF
+                    continue
+                self._err(w, 1, 1047, "unknown command")
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            w.close()
+
+
+# -- scripted PostgreSQL server ----------------------------------------------
+
+
+class StubPg:
+    """v3 protocol: startup, trust|md5|scram auth, simple query."""
+
+    def __init__(self, user="app", password="pw", auth="md5", tables=None):
+        self.user = user
+        self.password = password
+        self.auth = auth  # trust | clear | md5 | scram
+        self.tables = tables or {}
+        self.queries = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        self.server.close()
+
+    async def _read_startup(self, r):
+        n = struct.unpack("!I", await r.readexactly(4))[0]
+        return await r.readexactly(n - 4)
+
+    async def _read_msg(self, r):
+        hdr = await r.readexactly(5)
+        n = struct.unpack("!I", hdr[1:])[0]
+        return hdr[:1], await r.readexactly(n - 4)
+
+    def _send(self, w, tag, body):
+        w.write(tag + struct.pack("!I", len(body) + 4) + body)
+
+    def _error(self, w, msg):
+        body = b"SERROR\x00CP0001\x00M" + msg.encode() + b"\x00\x00"
+        self._send(w, b"E", body)
+
+    def _ready(self, w):
+        self._send(w, b"Z", b"I")
+
+    async def _client(self, r, w):
+        try:
+            body = await self._read_startup(r)
+            proto = struct.unpack_from("!I", body)[0]
+            assert proto == 196608, proto
+            kv = body[4:].split(b"\x00")
+            params = dict(zip(kv[::2], kv[1::2]))
+            user = params.get(b"user", b"").decode()
+            if not await self._do_auth(r, w, user):
+                w.close()
+                return
+            self._send(w, b"R", struct.pack("!I", 0))  # AuthenticationOk
+            self._send(w, b"S", b"server_version\x0014.0-stub\x00")
+            self._send(w, b"K", struct.pack("!II", 1, 2))
+            self._ready(w)
+            while True:
+                tag, data = await self._read_msg(r)
+                if tag == b"X":
+                    break
+                if tag != b"Q":
+                    self._error(w, "unsupported")
+                    self._ready(w)
+                    continue
+                sql = data.rstrip(b"\x00").decode()
+                self.queries.append(sql)
+                if sql.startswith("SYNTAX"):
+                    self._error(w, "syntax error")
+                    self._ready(w)
+                    continue
+                hit = next(
+                    (v for k, v in self.tables.items() if k in sql), None
+                )
+                if sql == "SELECT 1":
+                    hit = (["?column?"], [["1"]])
+                if hit is None:
+                    self._send(w, b"C", b"INSERT 0 1\x00")
+                    self._ready(w)
+                    continue
+                cols, rows = hit
+                desc = struct.pack("!H", len(cols))
+                for c in cols:
+                    desc += (
+                        c.encode() + b"\x00"
+                        + struct.pack("!IhIhih", 0, 0, 25, -1, -1, 0)
+                    )
+                self._send(w, b"T", desc)
+                for row in rows:
+                    body = struct.pack("!H", len(row))
+                    for v in row:
+                        if v is None:
+                            body += struct.pack("!i", -1)
+                        else:
+                            vb = str(v).encode()
+                            body += struct.pack("!i", len(vb)) + vb
+                    self._send(w, b"D", body)
+                self._send(w, b"C", f"SELECT {len(rows)}\x00".encode())
+                self._ready(w)
+        except (asyncio.IncompleteReadError, ConnectionError, AssertionError):
+            pass
+        finally:
+            w.close()
+
+    async def _do_auth(self, r, w, user) -> bool:
+        if user != self.user:
+            self._error(w, "no such user")
+            return False
+        if self.auth == "trust":
+            return True
+        if self.auth == "clear":
+            self._send(w, b"R", struct.pack("!I", 3))
+            tag, data = await self._read_msg(r)
+            return data.rstrip(b"\x00").decode() == self.password
+        if self.auth == "md5":
+            salt = secrets.token_bytes(4)
+            self._send(w, b"R", struct.pack("!I", 5) + salt)
+            tag, data = await self._read_msg(r)
+            inner = hashlib.md5(
+                self.password.encode() + user.encode()
+            ).hexdigest()
+            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            if data.rstrip(b"\x00").decode() != want:
+                self._error(w, "password authentication failed")
+                return False
+            return True
+        if self.auth == "scram":
+            self._send(
+                w, b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00"
+            )
+            tag, data = await self._read_msg(r)
+            mech, rest = data.split(b"\x00", 1)
+            assert mech == b"SCRAM-SHA-256"
+            (n,) = struct.unpack_from("!I", rest)
+            client_first = rest[4 : 4 + n]
+            bare = client_first.split(b"n,,", 1)[1]
+            cnonce = dict(
+                kv.split(b"=", 1) for kv in bare.split(b",")
+            )[b"r"].decode()
+            snonce = cnonce + base64.b64encode(secrets.token_bytes(9)).decode()
+            salt = secrets.token_bytes(16)
+            iters = 4096
+            server_first = (
+                f"r={snonce},s={base64.b64encode(salt).decode()},i={iters}"
+            ).encode()
+            self._send(w, b"R", struct.pack("!I", 11) + server_first)
+            tag, data = await self._read_msg(r)
+            final = data
+            parts = dict(
+                kv.split(b"=", 1) for kv in final.split(b",") if b"=" in kv
+            )
+            proof = base64.b64decode(parts[b"p"])
+            final_bare = final.rsplit(b",p=", 1)[0]
+            auth_msg = bare + b"," + server_first + b"," + final_bare
+            salted = hashlib.pbkdf2_hmac(
+                "sha256", self.password.encode(), salt, iters
+            )
+            client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+            stored = hashlib.sha256(client_key).digest()
+            sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+            want_proof = bytes(a ^ b for a, b in zip(client_key, sig))
+            if proof != want_proof:
+                self._error(w, "SCRAM authentication failed")
+                return False
+            server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+            server_sig = hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+            self._send(
+                w, b"R",
+                struct.pack("!I", 12)
+                + b"v=" + base64.b64encode(server_sig),
+            )
+            return True
+        return False
+
+
+# -- render/quote unit tests -------------------------------------------------
+
+
+def test_sql_quote_escapes():
+    assert sql_quote("a'b") == "'a''b'"
+    assert sql_quote("a\\b") == "'a\\\\b'"
+    assert sql_quote(None) == "NULL"
+    assert (
+        render_sql("SELECT * FROM t WHERE u = ${username}", {"username": "x'y"})
+        == "SELECT * FROM t WHERE u = 'x''y'"
+    )
+
+
+# -- MySQL client tests ------------------------------------------------------
+
+
+@async_test
+async def test_mysql_handshake_query_ping():
+    stub = await StubMysql(
+        tables={"FROM mqtt_user": (
+            ["password_hash", "salt", "is_superuser"],
+            [[hashlib.sha256(b"s1pw1").hexdigest(), "s1", "1"]],
+        )}
+    ).start()
+    conn = MysqlConnector(port=stub.port, user="app", password="pw")
+    await conn.start()
+    assert conn.server_version == "8.0-stub"
+    assert await conn.health_check()
+    cols, rows = await conn.query(
+        "SELECT password_hash, salt, is_superuser FROM mqtt_user"
+    )
+    assert cols == ["password_hash", "salt", "is_superuser"]
+    assert rows[0][1] == b"s1"
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_mysql_wrong_password_rejected():
+    stub = await StubMysql(password="right").start()
+    conn = MysqlConnector(port=stub.port, user="app", password="wrong")
+    with pytest.raises(MysqlError):
+        await conn.start()
+    await stub.stop()
+
+
+@async_test
+async def test_mysql_auth_switch_flow():
+    stub = await StubMysql(auth_switch=True).start()
+    conn = MysqlConnector(port=stub.port, user="app", password="pw")
+    await conn.start()
+    assert await conn.health_check()
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_mysql_authn_provider_ok_and_deny():
+    phash = hashlib.sha256(b"saltsecret").hexdigest()
+    stub = await StubMysql(
+        tables={"FROM mqtt_user": (
+            ["password_hash", "salt", "is_superuser"],
+            [[phash, "salt", "0"]],
+        )}
+    ).start()
+    conn = MysqlConnector(port=stub.port, user="app", password="pw")
+    await conn.start()
+    prov = MysqlAuthProvider(conn)
+    ci = {"username": "u1", "client_id": "c1"}
+    res, _ = await prov.authenticate_async(ci, {"password": b"secret"})
+    assert res == OK
+    res, rc = await prov.authenticate_async(ci, {"password": b"nope"})
+    assert res == DENY
+    # the rendered query carried the quoted username
+    assert any("'u1'" in q for q in stub.queries)
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_mysql_authz_source():
+    stub = await StubMysql(
+        tables={"FROM mqtt_acl": (
+            ["permission", "action", "topic"],
+            [
+                ["allow", "publish", "up/${clientid}/#"],
+                ["deny", "all", "adm/#"],
+            ],
+        )}
+    ).start()
+    conn = MysqlConnector(port=stub.port, user="app", password="pw")
+    await conn.start()
+    src = MysqlAuthzSource(conn)
+    ci = {"username": "u1", "client_id": "c9"}
+    assert await src.check(ci, "publish", "up/c9/data") == "allow"
+    assert await src.check(ci, "publish", "adm/x") == "deny"
+    assert await src.check(ci, "subscribe", "other") == "ignore"
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_mysql_server_error_keeps_connection():
+    stub = await StubMysql().start()
+    conn = MysqlConnector(port=stub.port, user="app", password="pw")
+    await conn.start()
+    # unknown command byte path not reachable via query; use stub err on
+    # unmatched SELECT -> empty resultset is fine, so drive ERR via a
+    # direct bad command
+    with pytest.raises(MysqlServerError):
+        await conn._command(bytes([0x55]))
+    assert await conn.health_check()  # stream still usable
+    await conn.stop()
+    await stub.stop()
+
+
+# -- PostgreSQL client tests -------------------------------------------------
+
+
+@pytest.mark.parametrize("auth", ["trust", "clear", "md5", "scram"])
+def test_pg_auth_modes(auth):
+    @async_test
+    async def run():
+        stub = await StubPg(auth=auth).start()
+        conn = PgsqlConnector(port=stub.port, user="app", password="pw")
+        await conn.start()
+        assert conn.parameters.get("server_version") == "14.0-stub"
+        assert await conn.health_check()
+        await conn.stop()
+        await stub.stop()
+
+    run()
+
+
+@async_test
+async def test_pg_wrong_password_md5():
+    stub = await StubPg(auth="md5", password="right").start()
+    conn = PgsqlConnector(port=stub.port, user="app", password="wrong")
+    with pytest.raises(PgError):
+        await conn.start()
+    await stub.stop()
+
+
+@async_test
+async def test_pg_query_rows_and_nulls():
+    stub = await StubPg(
+        auth="trust",
+        tables={"FROM mqtt_user": (
+            ["password_hash", "salt", "is_superuser"],
+            [["abc", None, "t"]],
+        )},
+    ).start()
+    conn = PgsqlConnector(port=stub.port, user="app")
+    await conn.start()
+    cols, rows = await conn.query("SELECT * FROM mqtt_user")
+    assert cols == ["password_hash", "salt", "is_superuser"]
+    assert rows == [[b"abc", None, b"t"]]
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_pg_server_error_then_recover():
+    stub = await StubPg(auth="trust").start()
+    conn = PgsqlConnector(port=stub.port, user="app")
+    await conn.start()
+    with pytest.raises(PgServerError):
+        await conn.query("SYNTAX garbage")
+    assert await conn.health_check()  # ReadyForQuery resynced the stream
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_pg_authn_provider_and_superuser():
+    phash = hashlib.sha256(b"ns2pw2").hexdigest()
+    stub = await StubPg(
+        auth="scram",
+        tables={"FROM mqtt_user": (
+            ["password_hash", "salt", "is_superuser"],
+            [[phash, "ns2", "t"]],
+        )},
+    ).start()
+    conn = PgsqlConnector(port=stub.port, user="app", password="pw")
+    await conn.start()
+    prov = PgsqlAuthProvider(conn)
+    ci = {"username": "u2", "client_id": "c2"}
+    res, _ = await prov.authenticate_async(ci, {"password": b"pw2"})
+    assert res == OK
+    assert ci.get("is_superuser") is True
+    res, _ = await prov.authenticate_async(
+        {"username": "u2", "client_id": "c2"}, {"password": b"bad"}
+    )
+    assert res == DENY
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_pg_authz_source_eq_rule():
+    stub = await StubPg(
+        auth="trust",
+        tables={"FROM mqtt_acl": (
+            ["permission", "action", "topic"],
+            [["allow", "subscribe", "eq t/+/x"]],
+        )},
+    ).start()
+    conn = PgsqlConnector(port=stub.port, user="app")
+    await conn.start()
+    src = PgsqlAuthzSource(conn)
+    ci = {"username": "u", "client_id": "c"}
+    # 'eq ' pins the literal: the filter chars match only verbatim
+    assert await src.check(ci, "subscribe", "t/+/x") == "allow"
+    assert await src.check(ci, "subscribe", "t/9/x") == "ignore"
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_unknown_user_rejected_pg():
+    stub = await StubPg(auth="trust", user="other").start()
+    conn = PgsqlConnector(port=stub.port, user="app")
+    with pytest.raises(PgError):
+        await conn.start()
+    await stub.stop()
+
+
+# -- bridge sink integration -------------------------------------------------
+
+
+@async_test
+async def test_mysql_bridge_sink_renders_sql():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.integration.bridge import BridgeManager
+
+    stub = await StubMysql().start()
+    hooks = Hooks()
+    broker = Broker(hooks=hooks)
+    mgr = BridgeManager(broker, hooks)
+    await mgr.create(
+        "mysql:audit",
+        {
+            "host": "127.0.0.1",
+            "port": stub.port,
+            "user": "app",
+            "password": "pw",
+            "local_topic": "audit/#",
+            "sql": "INSERT INTO audit(topic, payload) VALUES "
+                   "(${topic}, ${payload})",
+        },
+    )
+    broker.publish(Message(topic="audit/x", payload=b"p'1"))
+    for _ in range(50):
+        await asyncio.sleep(0.02)
+        if any(q.startswith("INSERT INTO audit") for q in stub.queries):
+            break
+    ins = [q for q in stub.queries if q.startswith("INSERT")]
+    assert ins and "'audit/x'" in ins[0] and "'p''1'" in ins[0]
+    await mgr.close()
+    await stub.stop()
