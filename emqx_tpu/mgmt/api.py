@@ -87,6 +87,27 @@ ROUTES = [
     ("put", "/api/v5/plugins/{ref}/start", "plugins_start", "Start a plugin", "plugins"),
     ("put", "/api/v5/plugins/{ref}/stop", "plugins_stop", "Stop a plugin", "plugins"),
     ("delete", "/api/v5/plugins/{ref}", "plugins_delete", "Uninstall a plugin", "plugins"),
+    ("get", "/api/v5/listeners", "listeners_list", "List listeners", "listeners"),
+    ("post", "/api/v5/listeners", "listeners_create", "Create a listener", "listeners"),
+    ("delete", "/api/v5/listeners/{id}", "listeners_delete", "Delete a listener", "listeners"),
+    ("post", "/api/v5/listeners/{id}/stop", "listeners_stop", "Stop a listener", "listeners"),
+    ("post", "/api/v5/listeners/{id}/start", "listeners_start", "Start a stopped listener", "listeners"),
+    ("post", "/api/v5/listeners/{id}/restart", "listeners_restart", "Restart a listener", "listeners"),
+    ("get", "/api/v5/authentication", "authn_list", "List authentication providers", "authentication"),
+    ("post", "/api/v5/authentication", "authn_create", "Create an authentication provider", "authentication"),
+    ("delete", "/api/v5/authentication/{id}", "authn_delete", "Remove an authentication provider", "authentication"),
+    ("get", "/api/v5/authentication/{id}/users", "authn_users_list", "List builtin users", "authentication"),
+    ("post", "/api/v5/authentication/{id}/users", "authn_users_add", "Add a builtin user", "authentication"),
+    ("delete", "/api/v5/authentication/{id}/users/{user}", "authn_users_del", "Delete a builtin user", "authentication"),
+    ("get", "/api/v5/authorization/sources", "authz_sources_list", "List authorization sources", "authorization"),
+    ("post", "/api/v5/authorization/sources", "authz_sources_create", "Add an authorization source", "authorization"),
+    ("delete", "/api/v5/authorization/sources/{type}", "authz_sources_delete", "Remove an authorization source", "authorization"),
+    ("post", "/api/v5/authorization/sources/{type}/move", "authz_sources_move", "Reorder an authorization source", "authorization"),
+    ("get", "/api/v5/api_key", "api_keys_list", "List API keys", "api_keys"),
+    ("post", "/api/v5/api_key", "api_keys_create", "Create an API key (secret shown once)", "api_keys"),
+    ("get", "/api/v5/api_key/{name}", "api_keys_get", "One API key", "api_keys"),
+    ("put", "/api/v5/api_key/{name}", "api_keys_update", "Update an API key", "api_keys"),
+    ("delete", "/api/v5/api_key/{name}", "api_keys_delete", "Delete an API key", "api_keys"),
     ("get", "/api/v5/telemetry/data", "telemetry_data", "Inspect the telemetry report", "telemetry"),
     ("get", "/api/v5/node_dump", "node_dump", "Full node state dump", "node"),
     ("get", "/api-docs", "api_docs", "This OpenAPI document", "meta"),
@@ -110,10 +131,14 @@ class MgmtApi:
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
+        from emqx_tpu.mgmt.api_keys import ApiKeyStore
         from emqx_tpu.mgmt.dashboard import DashboardAdmin, Monitor
 
         d = app.config.dashboard
         self.admin = DashboardAdmin(d.admins, ttl=d.jwt_ttl)
+        self.api_keys = ApiKeyStore()
+        # authn providers created over REST: id -> (provider, connector)
+        self._authn_by_id = {}
         self.monitor = Monitor(
             app, interval=d.monitor_interval, history=d.monitor_history
         )
@@ -130,17 +155,23 @@ class MgmtApi:
     @web.middleware
     async def _auth_middleware(self, request, handler):
         key = self.app.config.dashboard.api_key
-        needs_auth = bool(key or self.admin.has_admins())
+        needs_auth = bool(
+            key or self.admin.has_admins() or self.api_keys.has_keys()
+        )
         if needs_auth and request.path not in _PUBLIC_PATHS:
             auth = request.headers.get("Authorization", "")
             ok = bool(key) and auth == f"Bearer {key}"
             if not ok and auth.startswith("Bearer "):
                 # admin JWT (emqx_dashboard_admin tokens)
                 ok = self.admin.verify(auth[7:]) is not None
-            if not ok and key and auth.startswith("Basic "):
+            if not ok and auth.startswith("Basic "):
                 try:
                     decoded = base64.b64decode(auth[6:]).decode()
-                    ok = decoded.split(":", 1)[-1] == key
+                    user, _, secret = decoded.partition(":")
+                    # machine API keys (emqx_mgmt_auth) or the static key
+                    ok = self.api_keys.verify(user, secret) or (
+                        bool(key) and secret == key
+                    )
                 except Exception:
                     ok = False
             if not ok:
@@ -677,6 +708,486 @@ class MgmtApi:
             [(m, p, s, t) for m, p, _h, s, t in ROUTES], __version__
         )
         return web.json_response(spec)
+
+    # -- listeners (emqx_mgmt_api_listeners analog) ------------------------
+    async def listeners_list(self, request):
+        return web.json_response({"data": self.app.listeners.describe()})
+
+    @staticmethod
+    def _listener_id(request):
+        lid = request.match_info["id"]
+        if ":" not in lid:
+            raise ValueError("listener id is type:name")
+        return lid.split(":", 1)
+
+    async def listeners_create(self, request):
+        from emqx_tpu.transport.listener import ListenerConfig
+
+        try:
+            body = await request.json()
+            config = ListenerConfig(
+                name=body.get("name", "default"),
+                type=body.get("type", "tcp"),
+                bind=body.get("bind", "127.0.0.1"),
+                port=int(body.get("port", 1883)),
+                max_connections=int(body.get("max_connections", 1_024_000)),
+                ssl_certfile=body.get("ssl_certfile"),
+                ssl_keyfile=body.get("ssl_keyfile"),
+                ssl_cacertfile=body.get("ssl_cacertfile"),
+                ssl_verify=bool(body.get("ssl_verify", False)),
+            )
+            l = await self.app.listeners.start_listener(
+                config, self.app.channel_config
+            )
+        except (ValueError, TypeError, OSError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(
+            {"id": f"{config.type}:{config.name}", "port": l.port},
+            status=201,
+        )
+
+    async def listeners_delete(self, request):
+        try:
+            type_, name = self._listener_id(request)
+        except ValueError as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        await self.app.listeners.stop_listener(type_, name)
+        if self.app.listeners._specs.pop(f"{type_}:{name}", None) is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response({}, status=204)
+
+    async def _listener_action(self, request, action):
+        try:
+            type_, name = self._listener_id(request)
+            if action == "stop":
+                ok = await self.app.listeners.stop_listener(type_, name)
+                if not ok:
+                    return web.json_response(
+                        {"code": "NOT_FOUND"}, status=404
+                    )
+            elif action == "start":
+                await self.app.listeners.start_stopped(type_, name)
+            else:
+                await self.app.listeners.restart_listener(type_, name)
+        except KeyError:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        except (ValueError, OSError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response({})
+
+    async def listeners_stop(self, request):
+        return await self._listener_action(request, "stop")
+
+    async def listeners_start(self, request):
+        return await self._listener_action(request, "start")
+
+    async def listeners_restart(self, request):
+        return await self._listener_action(request, "restart")
+
+    # -- authentication chain (emqx_authn_api analog) ----------------------
+    def _authn_chain(self):
+        """The live AuthChain, created+attached on first REST use."""
+        if self.app.authn is None:
+            from emqx_tpu.broker.auth import AuthChain
+
+            self.app.authn = AuthChain(
+                [],
+                allow_anonymous=self.app.config.authn.allow_anonymous,
+            )
+            self.app.authn.attach(self.app.hooks)
+        return self.app.authn
+
+    async def authn_list(self, request):
+        chain = self.app.authn
+        rows = []
+        for p in chain.providers if chain else []:
+            pid = getattr(p, "_api_id", None) or type(p).__name__
+            rows.append(
+                {"id": pid, "provider": type(p).__name__, "enable": True}
+            )
+        return web.json_response({"data": rows})
+
+    async def _make_authn_provider(self, pid: str, body: dict):
+        """-> (provider, connector|None); raises ValueError."""
+        backend = pid.split(":", 1)[1] if ":" in pid else pid
+        if backend == "built_in_database":
+            from emqx_tpu.broker.auth import BuiltinDatabase
+
+            db = BuiltinDatabase(
+                user_id_type=body.get("user_id_type", "username"),
+                algo=body.get("password_hash_algorithm", "pbkdf2"),
+            )
+            return db, None
+        if backend == "jwt":
+            from emqx_tpu.broker.auth import JwtAuth
+
+            secret = body.get("secret")
+            if not secret:
+                raise ValueError("jwt provider needs 'secret'")
+            return (
+                JwtAuth(secret.encode(), body.get("verify_claims", {})),
+                None,
+            )
+        if backend == "http":
+            from emqx_tpu.auth.http import HttpAuthProvider
+
+            if not body.get("url"):
+                raise ValueError("http provider needs 'url'")
+            return (
+                HttpAuthProvider(
+                    body["url"],
+                    method=body.get("method", "POST"),
+                    timeout=float(body.get("timeout", 5.0)),
+                ),
+                None,
+            )
+        if backend == "redis":
+            from emqx_tpu.integration.redis import (
+                RedisAuthProvider,
+                RedisConnector,
+            )
+
+            server = body.get("server", "127.0.0.1:6379")
+            host, _, port = server.partition(":")
+            conn = RedisConnector(
+                host=host or "127.0.0.1",
+                port=int(port or 6379),
+                db=int(body.get("database", 0)),
+                password=body.get("password"),
+            )
+            await conn.start()
+            return (
+                RedisAuthProvider(
+                    conn,
+                    key_template=body.get("cmd_key", "mqtt_user:${username}"),
+                    algo=body.get("password_hash_algorithm", "sha256"),
+                ),
+                conn,
+            )
+        if backend in ("mysql", "postgresql", "pgsql"):
+            from emqx_tpu.integration.sql_common import DEFAULT_AUTHN_QUERY
+
+            if backend == "mysql":
+                from emqx_tpu.integration.mysql import (
+                    MysqlAuthProvider as Prov,
+                    MysqlConnector as Conn,
+                )
+                default_port = 3306
+            else:
+                from emqx_tpu.integration.pgsql import (
+                    PgsqlAuthProvider as Prov,
+                    PgsqlConnector as Conn,
+                )
+                default_port = 5432
+            server = body.get("server", "127.0.0.1")
+            host, _, port = server.partition(":")
+            conn = Conn(
+                host=host or "127.0.0.1",
+                port=int(port or default_port),
+                user=body.get("username", ""),
+                password=body.get("password", ""),
+                database=body.get("database", ""),
+            )
+            await conn.start()
+            return (
+                Prov(
+                    conn,
+                    query=body.get("query", DEFAULT_AUTHN_QUERY),
+                    algo=body.get("password_hash_algorithm", "sha256"),
+                ),
+                conn,
+            )
+        raise ValueError(f"unknown authn backend: {backend}")
+
+    async def authn_create(self, request):
+        try:
+            body = await request.json()
+            mechanism = body.get("mechanism", "password_based")
+            backend = body.get("backend", "built_in_database")
+            pid = f"{mechanism}:{backend}"
+            if pid in self._authn_by_id:
+                return web.json_response(
+                    {"code": "ALREADY_EXISTS"}, status=409
+                )
+            provider, conn = await self._make_authn_provider(pid, body)
+        except (ValueError, KeyError, TypeError, OSError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        provider._api_id = pid
+        self._authn_by_id[pid] = (provider, conn)
+        self._authn_chain().providers.append(provider)
+        return web.json_response({"id": pid}, status=201)
+
+    async def authn_delete(self, request):
+        pid = request.match_info["id"]
+        entry = self._authn_by_id.pop(pid, None)
+        chain = self.app.authn
+        if entry is None or chain is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        provider, conn = entry
+        if provider in chain.providers:
+            chain.providers.remove(provider)
+        if conn is not None:
+            try:
+                await conn.stop()
+            except Exception:
+                pass
+        return web.json_response({}, status=204)
+
+    def _builtin_db(self, pid):
+        from emqx_tpu.broker.auth import BuiltinDatabase
+
+        entry = self._authn_by_id.get(pid)
+        provider = entry[0] if entry else None
+        if (
+            provider is None
+            and pid == "password_based:built_in_database"
+            and self.app.authn is not None
+        ):
+            # the config-file-created builtin database has no REST id;
+            # only the canonical id may address it
+            for p in self.app.authn.providers:
+                if isinstance(p, BuiltinDatabase):
+                    return p
+        return provider if isinstance(provider, BuiltinDatabase) else None
+
+    async def authn_users_list(self, request):
+        db = self._builtin_db(request.match_info["id"])
+        if db is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response({"data": db.users()})
+
+    async def authn_users_add(self, request):
+        db = self._builtin_db(request.match_info["id"])
+        if db is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        try:
+            body = await request.json()
+            db.add_user(
+                body["user_id"],
+                body["password"],
+                bool(body.get("is_superuser", False)),
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response({"user_id": body["user_id"]}, status=201)
+
+    async def authn_users_del(self, request):
+        db = self._builtin_db(request.match_info["id"])
+        if db is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        if not db.delete_user(request.match_info["user"]):
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response({}, status=204)
+
+    # -- authorization sources (emqx_authz_api_sources analog) -------------
+    async def authz_sources_list(self, request):
+        rows = []
+        for s in self.app.authz.sources:
+            rows.append(
+                {
+                    "type": getattr(s, "_api_type", type(s).__name__),
+                    "enable": True,
+                }
+            )
+        return web.json_response({"data": rows})
+
+    async def authz_sources_create(self, request):
+        try:
+            body = await request.json()
+            stype = body["type"]
+            if any(
+                getattr(s, "_api_type", None) == stype
+                for s in self.app.authz.sources
+            ):
+                return web.json_response(
+                    {"code": "ALREADY_EXISTS"}, status=409
+                )
+            source, conn = await self._make_authz_source(stype, body)
+        except (ValueError, KeyError, TypeError, OSError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        source._api_type = stype
+        source._api_conn = conn
+        self.app.authz.add_source(source)
+        return web.json_response({"type": stype}, status=201)
+
+    async def _make_authz_source(self, stype: str, body: dict):
+        if stype == "http":
+            from emqx_tpu.auth.http import HttpAuthzSource
+
+            if not body.get("url"):
+                raise ValueError("http source needs 'url'")
+            return (
+                HttpAuthzSource(
+                    body["url"],
+                    method=body.get("method", "POST"),
+                    timeout=float(body.get("timeout", 5.0)),
+                ),
+                None,
+            )
+        if stype == "redis":
+            from emqx_tpu.integration.redis import (
+                RedisAuthzSource,
+                RedisConnector,
+            )
+
+            server = body.get("server", "127.0.0.1:6379")
+            host, _, port = server.partition(":")
+            conn = RedisConnector(
+                host=host or "127.0.0.1",
+                port=int(port or 6379),
+                db=int(body.get("database", 0)),
+                password=body.get("password"),
+            )
+            await conn.start()
+            return (
+                RedisAuthzSource(
+                    conn,
+                    key_template=body.get("cmd_key", "mqtt_acl:${username}"),
+                ),
+                conn,
+            )
+        if stype in ("mysql", "postgresql", "pgsql"):
+            from emqx_tpu.integration.sql_common import DEFAULT_AUTHZ_QUERY
+
+            if stype == "mysql":
+                from emqx_tpu.integration.mysql import (
+                    MysqlAuthzSource as Src,
+                    MysqlConnector as Conn,
+                )
+                default_port = 3306
+            else:
+                from emqx_tpu.integration.pgsql import (
+                    PgsqlAuthzSource as Src,
+                    PgsqlConnector as Conn,
+                )
+                default_port = 5432
+            server = body.get("server", "127.0.0.1")
+            host, _, port = server.partition(":")
+            conn = Conn(
+                host=host or "127.0.0.1",
+                port=int(port or default_port),
+                user=body.get("username", ""),
+                password=body.get("password", ""),
+                database=body.get("database", ""),
+            )
+            await conn.start()
+            return Src(conn, query=body.get("query", DEFAULT_AUTHZ_QUERY)), conn
+        raise ValueError(f"unknown authz source type: {stype}")
+
+    def _find_authz_source(self, stype: str):
+        for s in self.app.authz.sources:
+            if getattr(s, "_api_type", None) == stype:
+                return s
+        return None
+
+    async def authz_sources_delete(self, request):
+        s = self._find_authz_source(request.match_info["type"])
+        if s is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        self.app.authz.sources.remove(s)
+        conn = getattr(s, "_api_conn", None)
+        if conn is not None:
+            try:
+                await conn.stop()
+            except Exception:
+                pass
+        return web.json_response({}, status=204)
+
+    async def authz_sources_move(self, request):
+        s = self._find_authz_source(request.match_info["type"])
+        if s is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        try:
+            body = await request.json()
+            position = body["position"]  # front | rear | before:T | after:T | index
+        except (ValueError, KeyError, TypeError):
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        src = self.app.authz.sources
+        # resolve the target index BEFORE mutating, so a bad position
+        # leaves the evaluation order untouched
+        if position == "front":
+            idx = 0
+        elif position == "rear":
+            idx = len(src)  # after removal this is the end
+        elif isinstance(position, str) and position.partition(":")[0] in (
+            "before",
+            "after",
+        ):
+            rel, _, other_type = position.partition(":")
+            other = self._find_authz_source(other_type)
+            if other is None or other is s:
+                return web.json_response({"code": "BAD_REQUEST"}, status=400)
+            idx = src.index(other) + (1 if rel == "after" else 0)
+        else:
+            try:
+                idx = int(position)
+            except (ValueError, TypeError):
+                return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        cur = src.index(s)
+        src.remove(s)
+        if cur < idx:
+            idx -= 1  # removal shifted everything after s left by one
+        src.insert(min(max(idx, 0), len(src)), s)
+        return web.json_response({})
+
+    # -- API keys (emqx_mgmt_auth analog) -----------------------------------
+    async def api_keys_list(self, request):
+        return web.json_response({"data": self.api_keys.list()})
+
+    async def api_keys_create(self, request):
+        try:
+            body = await request.json()
+            rec = self.api_keys.create(
+                body["name"],
+                description=body.get("description", ""),
+                enable=bool(body.get("enable", True)),
+                expired_at=body.get("expired_at"),
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"code": "ALREADY_EXISTS", "message": str(e)}, status=409
+            )
+        except (KeyError, TypeError):
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        return web.json_response(rec, status=201)
+
+    async def api_keys_get(self, request):
+        rec = self.api_keys.get(request.match_info["name"])
+        if rec is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response(rec)
+
+    async def api_keys_update(self, request):
+        try:
+            body = await request.json()
+        except (ValueError, TypeError):
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        rec = self.api_keys.update(
+            request.match_info["name"],
+            description=body.get("description"),
+            enable=body.get("enable"),
+            expired_at=body.get("expired_at", "unset"),
+        )
+        if rec is None:
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response(rec)
+
+    async def api_keys_delete(self, request):
+        if not self.api_keys.delete(request.match_info["name"]):
+            return web.json_response({"code": "NOT_FOUND"}, status=404)
+        return web.json_response({}, status=204)
 
     # -- gateways (emqx_mgmt_api_gateway analog) ---------------------------
     def _gw_registry(self):

@@ -1,0 +1,143 @@
+"""Machine API keys for the management REST surface.
+
+Parity: apps/emqx_management/src/emqx_mgmt_auth.erl — named API keys
+(api_key + api_secret pairs) with enable flag, expiry, and description.
+The secret is generated server-side, returned exactly once at creation,
+and stored only as a salted SHA-256 hash (the reference stores a
+pbkdf2-style hash in mnesia).
+
+Used from the REST auth middleware via HTTP Basic ``api_key:api_secret``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ApiKey:
+    name: str
+    api_key: str
+    secret_hash: bytes
+    salt: bytes
+    description: str = ""
+    enable: bool = True
+    expired_at: Optional[float] = None  # epoch seconds, None = never
+    created_at: float = field(default_factory=time.time)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (
+            self.expired_at is not None
+            and (now or time.time()) >= self.expired_at
+        )
+
+    def as_dict(self) -> Dict:
+        return {
+            "name": self.name,
+            "api_key": self.api_key,
+            "description": self.description,
+            "enable": self.enable,
+            "expired_at": self.expired_at,
+            "created_at": self.created_at,
+            "expired": self.expired(),
+        }
+
+
+def _hash(secret: str, salt: bytes) -> bytes:
+    return hashlib.sha256(salt + secret.encode()).digest()
+
+
+class ApiKeyStore:
+    def __init__(self):
+        self._keys: Dict[str, ApiKey] = {}  # name -> key
+        self._by_key: Dict[str, str] = {}  # api_key -> name
+
+    @staticmethod
+    def _coerce_expiry(expired_at) -> Optional[float]:
+        """Accept epoch seconds or an RFC3339/ISO string (the EMQX wire
+        format); raise ValueError otherwise."""
+        if expired_at is None or isinstance(expired_at, (int, float)):
+            return expired_at
+        if isinstance(expired_at, str):
+            from datetime import datetime
+
+            return datetime.fromisoformat(expired_at).timestamp()
+        raise ValueError("expired_at must be epoch seconds or ISO-8601")
+
+    def has_keys(self) -> bool:
+        return bool(self._keys)
+
+    def create(
+        self,
+        name: str,
+        description: str = "",
+        enable: bool = True,
+        expired_at: Optional[float] = None,
+    ) -> Dict:
+        """-> the api_key/api_secret pair; the secret is never shown again
+        (emqx_mgmt_auth create semantics)."""
+        expired_at = self._coerce_expiry(expired_at)  # before any mutation
+        if name in self._keys:
+            raise ValueError(f"api key exists: {name}")
+        api_key = secrets.token_urlsafe(12)
+        api_secret = secrets.token_urlsafe(24)
+        salt = secrets.token_bytes(16)
+        rec = ApiKey(
+            name=name,
+            api_key=api_key,
+            secret_hash=_hash(api_secret, salt),
+            salt=salt,
+            description=description,
+            enable=enable,
+            expired_at=expired_at,
+        )
+        self._keys[name] = rec
+        self._by_key[api_key] = name
+        out = rec.as_dict()
+        out["api_secret"] = api_secret
+        return out
+
+    def verify(self, api_key: str, api_secret: str) -> bool:
+        name = self._by_key.get(api_key)
+        rec = self._keys.get(name) if name else None
+        if rec is None or not rec.enable or rec.expired():
+            return False
+        return hmac.compare_digest(
+            _hash(api_secret, rec.salt), rec.secret_hash
+        )
+
+    def update(
+        self,
+        name: str,
+        description: Optional[str] = None,
+        enable: Optional[bool] = None,
+        expired_at: object = "unset",
+    ) -> Optional[Dict]:
+        rec = self._keys.get(name)
+        if rec is None:
+            return None
+        if description is not None:
+            rec.description = description
+        if enable is not None:
+            rec.enable = enable
+        if expired_at != "unset":
+            rec.expired_at = self._coerce_expiry(expired_at)
+        return rec.as_dict()
+
+    def delete(self, name: str) -> bool:
+        rec = self._keys.pop(name, None)
+        if rec is not None:
+            self._by_key.pop(rec.api_key, None)
+        return rec is not None
+
+    def get(self, name: str) -> Optional[Dict]:
+        rec = self._keys.get(name)
+        return rec.as_dict() if rec else None
+
+    def list(self) -> List[Dict]:
+        return [k.as_dict() for k in self._keys.values()]

@@ -107,6 +107,9 @@ class Listener:
             return self._server.sockets[0].getsockname()[1]
         return self.config.port
 
+    def connection_count(self) -> int:
+        return len(self._conns)
+
     async def start(self) -> None:
         ctx = None
         if self.config.type == "ssl":
@@ -154,6 +157,9 @@ class Listeners:
         self.cm = cm
         self.ctx = ctx
         self._listeners: Dict[str, Listener] = {}
+        # specs survive a stop so the REST surface can start/restart by id
+        # (emqx_mgmt_api_listeners start/stop/restart semantics)
+        self._specs: Dict[str, tuple] = {}  # key -> (config, channel_config)
 
     async def start_listener(
         self, config: ListenerConfig, channel_config=None
@@ -173,6 +179,9 @@ class Listeners:
                 self.broker, self.cm, config, channel_config, ctx=self.ctx
             )
         await l.start()
+        # spec recorded only on success: a failed create must not leave
+        # a phantom stopped-listener entry on the REST surface
+        self._specs[key] = (config, channel_config)
         self._listeners[key] = l
         return l
 
@@ -184,6 +193,23 @@ class Listeners:
         await l.stop()
         return True
 
+    async def start_stopped(self, type_: str, name: str) -> "Listener":
+        """Start a previously-stopped listener from its saved spec."""
+        key = f"{type_}:{name}"
+        if key in self._listeners:
+            raise ValueError(f"listener {key} already running")
+        spec = self._specs.get(key)
+        if spec is None:
+            raise KeyError(f"unknown listener {key}")
+        return await self.start_listener(spec[0], spec[1])
+
+    async def restart_listener(self, type_: str, name: str) -> "Listener":
+        key = f"{type_}:{name}"
+        if key not in self._specs:
+            raise KeyError(f"unknown listener {key}")
+        await self.stop_listener(type_, name)
+        return await self.start_stopped(type_, name)
+
     async def stop_all(self) -> None:
         for key in list(self._listeners):
             t, n = key.split(":", 1)
@@ -191,3 +217,26 @@ class Listeners:
 
     def list(self):
         return dict(self._listeners)
+
+    def describe(self):
+        """Listener status rows for the REST surface: running and
+        stopped-but-known listeners alike."""
+        rows = []
+        for key, (config, _cc) in self._specs.items():
+            l = self._listeners.get(key)
+            rows.append(
+                {
+                    "id": key,
+                    "type": config.type,
+                    "name": config.name,
+                    "bind": f"{config.bind}:{config.port}",
+                    "running": l is not None,
+                    "current_connections": (
+                        l.connection_count() if l is not None
+                        and hasattr(l, "connection_count") else 0
+                    ),
+                    "max_connections": config.max_connections,
+                    "port": l.port if l is not None else config.port,
+                }
+            )
+        return rows

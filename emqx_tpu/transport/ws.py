@@ -180,6 +180,9 @@ class WsListener:
                 return sp
         return None
 
+    def connection_count(self) -> int:
+        return len(self._conns)
+
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()

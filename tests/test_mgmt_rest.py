@@ -1,0 +1,333 @@
+"""REST completion suites: listeners CRUD, authn/authz CRUD, API keys.
+
+Parity targets: emqx_mgmt_api_listeners SUITE, emqx_authn_api /
+emqx_authz_api_sources SUITEs, emqx_mgmt_auth (API keys) SUITE.
+"""
+
+import asyncio
+import base64
+import hashlib
+
+import aiohttp
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.config.schema import load_config
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.client import Client
+from tests.test_broker_e2e import async_test
+from tests.test_sql_backends import StubMysql, StubPg
+
+
+def _app_config(**over):
+    data = {
+        "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+        "dashboard": {"port": 0, "bind": "127.0.0.1"},
+        "router": {"enable_tpu": False},
+        **over,
+    }
+    return load_config(data)
+
+
+@async_test
+async def test_listeners_crud_lifecycle():
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/listeners") as r:
+                rows = (await r.json())["data"]
+                assert len(rows) == 1 and rows[0]["running"] is True
+                assert rows[0]["id"] == "tcp:default"
+            # create a second listener
+            async with s.post(
+                f"{api}/listeners",
+                json={"type": "tcp", "name": "extra", "port": 0},
+            ) as r:
+                assert r.status == 201
+                extra_port = (await r.json())["port"]
+            # a client can connect to it
+            c = Client("l-test")
+            await c.connect("127.0.0.1", extra_port)
+            await c.disconnect()
+            # stop it -> connections refused
+            async with s.post(f"{api}/listeners/tcp:extra/stop") as r:
+                assert r.status == 200
+            async with s.get(f"{api}/listeners") as r:
+                rows = {x["id"]: x for x in (await r.json())["data"]}
+                assert rows["tcp:extra"]["running"] is False
+            with pytest.raises(OSError):
+                c2 = Client("l-test2")
+                await c2.connect("127.0.0.1", extra_port)
+            # start it again from the saved spec
+            async with s.post(f"{api}/listeners/tcp:extra/start") as r:
+                assert r.status == 200
+            async with s.get(f"{api}/listeners") as r:
+                rows = {x["id"]: x for x in (await r.json())["data"]}
+                assert rows["tcp:extra"]["running"] is True
+                restarted_port = rows["tcp:extra"]["port"]
+            c3 = Client("l-test3")
+            await c3.connect("127.0.0.1", restarted_port)
+            await c3.disconnect()
+            # restart the default listener
+            async with s.post(f"{api}/listeners/tcp:default/restart") as r:
+                assert r.status == 200
+            # delete the extra listener entirely
+            async with s.delete(f"{api}/listeners/tcp:extra") as r:
+                assert r.status == 204
+            async with s.get(f"{api}/listeners") as r:
+                ids = [x["id"] for x in (await r.json())["data"]]
+                assert "tcp:extra" not in ids
+            # unknown id -> 404
+            async with s.post(f"{api}/listeners/tcp:nope/stop") as r:
+                assert r.status == 404
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_authn_chain_crud_and_builtin_users():
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        mqtt_port = list(app.listeners.list().values())[0].port
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/authentication") as r:
+                assert (await r.json())["data"] == []
+            # create a builtin-database provider
+            async with s.post(
+                f"{api}/authentication",
+                json={
+                    "mechanism": "password_based",
+                    "backend": "built_in_database",
+                    "user_id_type": "username",
+                    "password_hash_algorithm": "sha256",
+                },
+            ) as r:
+                assert r.status == 201
+                pid = (await r.json())["id"]
+                assert pid == "password_based:built_in_database"
+            # duplicate -> 409
+            async with s.post(
+                f"{api}/authentication",
+                json={"mechanism": "password_based",
+                      "backend": "built_in_database"},
+            ) as r:
+                assert r.status == 409
+            # add a user, then a good/bad login pair
+            async with s.post(
+                f"{api}/authentication/{pid}/users",
+                json={"user_id": "u1", "password": "pw1"},
+            ) as r:
+                assert r.status == 201
+            async with s.get(f"{api}/authentication/{pid}/users") as r:
+                assert (await r.json())["data"] == ["u1"]
+            ok = Client("good", username="u1", password=b"pw1")
+            await ok.connect("127.0.0.1", mqtt_port)
+            await ok.disconnect()
+            bad = Client("bad", username="u1", password=b"nope")
+            with pytest.raises(Exception):
+                await bad.connect("127.0.0.1", mqtt_port)
+            # delete user then provider
+            async with s.delete(f"{api}/authentication/{pid}/users/u1") as r:
+                assert r.status == 204
+            async with s.delete(f"{api}/authentication/{pid}") as r:
+                assert r.status == 204
+            async with s.get(f"{api}/authentication") as r:
+                assert (await r.json())["data"] == []
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_authn_mysql_provider_via_rest():
+    phash = hashlib.sha256(b"s9mypw").hexdigest()
+    stub = await StubMysql(
+        tables={"FROM mqtt_user": (
+            ["password_hash", "salt", "is_superuser"],
+            [[phash, "s9", "0"]],
+        )}
+    ).start()
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        mqtt_port = list(app.listeners.list().values())[0].port
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{api}/authentication",
+                json={
+                    "mechanism": "password_based",
+                    "backend": "mysql",
+                    "server": f"127.0.0.1:{stub.port}",
+                    "username": "app",
+                    "password": "pw",
+                    "password_hash_algorithm": "sha256",
+                },
+            ) as r:
+                assert r.status == 201, await r.text()
+        ok = Client("mysql-ok", username="u1", password=b"mypw")
+        await ok.connect("127.0.0.1", mqtt_port)
+        await ok.disconnect()
+        bad = Client("mysql-bad", username="u1", password=b"wrong")
+        with pytest.raises(Exception):
+            await bad.connect("127.0.0.1", mqtt_port)
+    finally:
+        await app.stop()
+        await stub.stop()
+
+
+@async_test
+async def test_authz_sources_crud_and_enforcement():
+    stub = await StubPg(
+        auth="trust",
+        tables={"FROM mqtt_acl": (
+            ["permission", "action", "topic"],
+            [["deny", "publish", "forbidden/#"]],
+        )},
+    ).start()
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        mqtt_port = list(app.listeners.list().values())[0].port
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/authorization/sources") as r:
+                assert (await r.json())["data"] == []
+            async with s.post(
+                f"{api}/authorization/sources",
+                json={
+                    "type": "postgresql",
+                    "server": f"127.0.0.1:{stub.port}",
+                    "username": "app",
+                },
+            ) as r:
+                assert r.status == 201, await r.text()
+            async with s.get(f"{api}/authorization/sources") as r:
+                assert [x["type"] for x in (await r.json())["data"]] == [
+                    "postgresql"
+                ]
+            # publish to a denied topic is dropped; allowed passes
+            sub_ok = Client("authz-sub")
+            await sub_ok.connect("127.0.0.1", mqtt_port)
+            await sub_ok.subscribe("#", qos=0)
+            pub = Client("authz-pub", username="u")
+            await pub.connect("127.0.0.1", mqtt_port)
+            await pub.publish("forbidden/x", b"no", qos=0)
+            await pub.publish("fine/x", b"yes", qos=0)
+            m = await sub_ok.recv(timeout=5)
+            assert m.topic == "fine/x"  # denied one never delivered
+            await pub.disconnect()
+            await sub_ok.disconnect()
+            # move + delete round-trip
+            async with s.post(
+                f"{api}/authorization/sources/postgresql/move",
+                json={"position": "front"},
+            ) as r:
+                assert r.status == 200
+            async with s.delete(
+                f"{api}/authorization/sources/postgresql"
+            ) as r:
+                assert r.status == 204
+            async with s.get(f"{api}/authorization/sources") as r:
+                assert (await r.json())["data"] == []
+    finally:
+        await app.stop()
+        await stub.stop()
+
+
+@async_test
+async def test_api_key_machine_auth():
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            # open surface (no admins/keys yet): create the first key
+            async with s.post(
+                f"{api}/api_key",
+                json={"name": "ci", "description": "ci bot"},
+            ) as r:
+                assert r.status == 201
+                rec = await r.json()
+                key, secret = rec["api_key"], rec["api_secret"]
+            # now the surface requires auth
+            async with s.get(f"{api}/metrics") as r:
+                assert r.status == 401
+            basic = base64.b64encode(f"{key}:{secret}".encode()).decode()
+            hdr = {"Authorization": f"Basic {basic}"}
+            async with s.get(f"{api}/metrics", headers=hdr) as r:
+                assert r.status == 200
+            # secret never shown again
+            async with s.get(f"{api}/api_key/ci", headers=hdr) as r:
+                rec2 = await r.json()
+                assert "api_secret" not in rec2
+            # disable the key (this request still carries valid auth)
+            async with s.put(
+                f"{api}/api_key/ci", json={"enable": False}, headers=hdr
+            ) as r:
+                assert r.status == 200
+        # disabled key is rejected afterwards
+        async with aiohttp.ClientSession() as s2:
+            async with s2.get(f"{api}/metrics", headers=hdr) as r:
+                assert r.status == 401
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_api_key_expiry_and_delete():
+    import time as _time
+
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{api}/api_key",
+                json={"name": "old", "expired_at": _time.time() - 1},
+            ) as r:
+                rec = await r.json()
+            basic = base64.b64encode(
+                f"{rec['api_key']}:{rec['api_secret']}".encode()
+            ).decode()
+            hdr = {"Authorization": f"Basic {basic}"}
+            async with s.get(f"{api}/metrics", headers=hdr) as r:
+                assert r.status == 401  # expired
+            # a live key can delete the stale one
+            mapi = app.mgmt_server
+            live = mapi.api_keys.create("live")
+            basic2 = base64.b64encode(
+                f"{live['api_key']}:{live['api_secret']}".encode()
+            ).decode()
+            hdr2 = {"Authorization": f"Basic {basic2}"}
+            async with s.delete(f"{api}/api_key/old", headers=hdr2) as r:
+                assert r.status == 204
+            async with s.get(f"{api}/api_key/old", headers=hdr2) as r:
+                assert r.status == 404
+    finally:
+        await app.stop()
+
+
+@async_test
+async def test_new_endpoints_in_openapi():
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/api-docs") as r:
+                doc = await r.json()
+        paths = doc["paths"]
+        for p in (
+            "/api/v5/listeners",
+            "/api/v5/authentication",
+            "/api/v5/authorization/sources",
+            "/api/v5/api_key",
+        ):
+            assert p in paths, p
+    finally:
+        await app.stop()
