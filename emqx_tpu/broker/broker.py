@@ -64,9 +64,12 @@ class Broker:
         # dense bitmap slot; (filter id, slot) rides to the device so the
         # route_step kernel resolves topic -> subscriber bits directly
         # (emqx_broker.erl:505-530 do_dispatch, as one gather+OR)
-        from emqx_tpu.models.router_model import SubscriberTable
+        from emqx_tpu.models.router_model import GroupTable, SubscriberTable
 
         self.subtab = SubscriberTable()
+        # $share groups mirrored as device lane segments so the kernel
+        # resolves the member pick too (emqx_shared_sub.erl:234-285)
+        self.grouptab = GroupTable()
         self._slot_subs: List[Optional[Subscriber]] = []
         self._free_slots: List[int] = []
         self._device = None  # lazy DeviceRouter
@@ -88,6 +91,11 @@ class Broker:
             # one route ref per group (matched by delete on group-empty)
             if self.shared.subscribe(group, real, sub):
                 self.router.add_route(self.shared.route_filter(group, real))
+            fid = self.router.filter_id(real)
+            if fid is not None:
+                gid = self.grouptab.ensure_group(fid, real, group)
+                g = self.shared.group(real, group)
+                self.grouptab.set_len(gid, len(g.members) if g else 0)
         else:
             entry = self._subs.setdefault(real, {})
             prev = entry.get(sid)
@@ -109,9 +117,21 @@ class Broker:
     def unsubscribe(self, sid: str, filter_: str) -> bool:
         group, real = T.parse_share(filter_)
         if group is not None:
+            fid = self.router.filter_id(real)
             removed, empty = self.shared.unsubscribe(group, real, sid)
             if empty:
+                if fid is not None:
+                    self.grouptab.drop_group(fid, real, group)
                 self.router.delete_route(self.shared.route_filter(group, real))
+            elif removed and fid is not None:
+                gid = self.grouptab.gid_of(real, group)
+                g = self.shared.group(real, group)
+                if gid is not None and g is not None:
+                    self.grouptab.set_len(gid, len(g.members))
+                    # a stored sticky index may now point past the end or
+                    # at a different member; the host re-pins on delivery
+                    if self.grouptab.group_sticky[gid] >= len(g.members):
+                        self.grouptab.set_sticky(gid, -1)
             return removed
         entry = self._subs.get(real)
         if not entry or sid not in entry:
@@ -222,7 +242,9 @@ class Broker:
         if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
             return [self._dispatch_routed(m) for m in msgs]
         dev = self._device_router()
-        results = dev.route([m.topic for m in msgs])
+        results = dev.route(
+            [m.topic for m in msgs], self._client_hashes(msgs)
+        )
         return self._dispatch_device_results(msgs, results)
 
     async def adispatch_batch_folded(self, msgs: Sequence[Message]) -> List[int]:
@@ -237,7 +259,11 @@ class Broker:
         dev = self._device_router()
         args = dev.prepare()
         results = await asyncio.get_running_loop().run_in_executor(
-            None, dev.route_prepared, args, [m.topic for m in msgs]
+            None,
+            dev.route_prepared,
+            args,
+            [m.topic for m in msgs],
+            self._client_hashes(msgs),
         )
         return self._dispatch_device_results(msgs, results)
 
@@ -246,15 +272,29 @@ class Broker:
             from emqx_tpu.models.router_model import DeviceRouter
 
             self._device = DeviceRouter(
-                self.router.index, self.subtab, self.router.matcher_config
+                self.router.index,
+                self.subtab,
+                self.router.matcher_config,
+                grouptab=self.grouptab,
+                share_strategy=self.shared.strategy,
             )
         return self._device
 
+    def _client_hashes(self, msgs):
+        """Publisher-id hashes for the device $share pick — skipped
+        entirely when no groups exist or the strategy doesn't use them."""
+        if not len(self.grouptab) or self.shared.strategy != "hash_clientid":
+            return None
+        from emqx_tpu.broker.shared_sub import stable_hash
+
+        return [stable_hash(m.from_client) for m in msgs]
+
     def _dispatch_device_results(self, msgs, results) -> List[int]:
-        matched, _mcount, flags, bitmaps = results
+        matched, _mcount, flags, bitmaps, picks = results
         r = self.router
         out: List[int] = []
         fell_back = 0
+        touched_gids: set = set()
         for i, m in enumerate(msgs):
             if flags[i]:
                 fell_back += 1
@@ -262,19 +302,32 @@ class Broker:
             else:
                 # matched rows are SPARSE (-1 holes between engines)
                 row = matched[i]
-                n = self._dispatch_row(m, bitmaps[i], row[row >= 0])
+                msg_picks = (
+                    (picks[0][i], picks[1][i]) if picks is not None else None
+                )
+                n = self._dispatch_row(
+                    m, bitmaps[i], row[row >= 0], msg_picks, touched_gids
+                )
             if n == 0:
                 self.hooks.run("message.dropped", m, "no_subscribers")
                 self.metrics.inc("messages.dropped.no_subscribers")
             out.append(n)
+        if touched_gids:
+            self._sync_group_counters(touched_gids)
         if fell_back:
             self.metrics.inc("messages.routed.device_fallback", fell_back)
         self.metrics.inc("messages.routed.device", len(msgs) - fell_back)
         return out
 
-    def _dispatch_row(self, msg: Message, bits: np.ndarray, fids) -> int:
+    def _dispatch_row(
+        self, msg: Message, bits: np.ndarray, fids, picks=None,
+        touched_gids: Optional[set] = None,
+    ) -> int:
         """Deliver one routed message from its device outputs: subscriber
-        bitmap -> slots -> plain subs; matched filter ids -> shared groups."""
+        bitmap -> slots -> plain subs; matched filter ids -> shared groups.
+        When `picks` is given ((gids, idxs) from the device $share pick),
+        group delivery goes straight to the picked member with host-side
+        failover only; otherwise the host runs the full pick."""
         self.metrics.inc("messages.received")
         n = 0
         slots = np.nonzero(
@@ -295,17 +348,53 @@ class Broker:
             if not T.match(msg.topic, sub.filter):
                 continue
             n += self._deliver_one(sub, msg)
-        for fid in fids:
-            name = self.router.filter_name(int(fid))
-            if (
-                name is not None
-                and self.shared.has_groups(name)
-                and T.match(msg.topic, name)
-            ):
-                n += self.shared.dispatch_groups(name, msg)
+        if picks is not None:
+            # device-resolved $share picks: host does delivery + failover
+            gids, idxs = picks
+            for gid, idx in zip(gids, idxs):
+                if gid < 0:
+                    continue
+                info = self.grouptab.info(int(gid))
+                if info is None:
+                    continue  # group dropped while the batch was in flight
+                real, gname = info
+                # staleness net, same as slots: re-verify the filter
+                if not T.match(msg.topic, real):
+                    continue
+                n += self.shared.dispatch_picked(real, gname, int(idx), msg)
+                if touched_gids is not None:
+                    touched_gids.add(int(gid))
+        else:
+            for fid in fids:
+                name = self.router.filter_name(int(fid))
+                if (
+                    name is not None
+                    and self.shared.has_groups(name)
+                    and T.match(msg.topic, name)
+                ):
+                    n += self.shared.dispatch_groups(name, msg)
         if n:
             self.metrics.inc("messages.delivered", n)
         return n
+
+    def _sync_group_counters(self, gids) -> None:
+        """Push advanced round-robin bases / sticky pins back to the
+        device mirror — called once per BATCH with the touched gid set,
+        so churn is one bounded write per group per batch."""
+        for gid in gids:
+            info = self.grouptab.info(gid)
+            if info is None:
+                continue
+            g = self.shared.group(*info)
+            if g is None:
+                continue
+            self.grouptab.set_rr(gid, g.rr_index)
+            if self.shared.strategy == "sticky" and g.sticky_sid is not None:
+                sids = list(g.members.keys())
+                if g.sticky_sid in sids:
+                    self.grouptab.set_sticky(
+                        gid, sids.index(g.sticky_sid)
+                    )
 
     def dispatch(self, filters: List[str], msg: Message) -> int:
         """Deliver to local subscribers of pre-matched filters.

@@ -20,6 +20,17 @@ from typing import Dict, List, Optional, Tuple
 from emqx_tpu.utils.tracepoints import tp
 
 
+def stable_hash(s: Optional[str]) -> int:
+    """FNV-1a 32-bit over the utf-8 bytes. Deterministic across runs and
+    identical to the device-side pick input, unlike Python's randomized
+    ``hash()`` (the reference uses erlang:phash2 the same way,
+    emqx_shared_sub.erl:234-285)."""
+    h = 0x811C9DC5
+    for b in (s or "").encode("utf-8", "surrogatepass"):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
 class _Group:
     __slots__ = ("members", "rr_index", "sticky_sid")
 
@@ -103,13 +114,46 @@ class SharedSub:
             rest = [x for x in sids if x != first]
             return [first] + rest
         if s == "hash_clientid":
-            i = hash(msg.from_client) % len(sids)
+            i = stable_hash(msg.from_client) % len(sids)
         elif s == "hash_topic":
-            i = hash(msg.topic) % len(sids)
+            i = stable_hash(msg.topic) % len(sids)
         else:  # round_robin
             i = g.rr_index % len(sids)
             g.rr_index += 1
         return sids[i:] + sids[:i]
+
+    # -- device-pick delivery (the host half of SURVEY hard part (d)) ------
+    def group(self, real: str, gname: str) -> Optional[_Group]:
+        groups = self._table.get(real)
+        return groups.get(gname) if groups else None
+
+    def dispatch_picked(self, real: str, gname: str, idx: int, msg) -> int:
+        """Deliver to the device-picked member index, host keeping only
+        ack/retry failover (emqx_shared_sub.erl:165-189 redispatch). The
+        pick came from a table snapshot, so an out-of-range idx (members
+        left since) just means failover order starts elsewhere."""
+        g = self.group(real, gname)
+        if g is None or not g.members:
+            return 0
+        sids = list(g.members.keys())
+        i = idx % len(sids) if sids else 0
+        candidates = sids[i:] + sids[:i]
+        for sid in candidates:
+            sub = g.members.get(sid)
+            if sub is None:
+                continue
+            try:
+                sub.deliver(msg, sub.opts)
+                tp("shared.delivered", sid=sid, mid=str(msg.mid))
+                if self.strategy == "sticky":
+                    g.sticky_sid = sid
+                elif self.strategy == "round_robin":
+                    g.rr_index += 1
+                return 1
+            except Exception:
+                tp("shared.nack", sid=sid, mid=str(msg.mid))
+                continue
+        return 0
 
     def dispatch_groups(self, real: str, msg) -> int:
         """Deliver to ONE member of each group subscribed at `real`.

@@ -168,9 +168,11 @@ class MgmtApi:
                 try:
                     decoded = base64.b64decode(auth[6:]).decode()
                     user, _, secret = decoded.partition(":")
-                    # machine API keys (emqx_mgmt_auth) or the static key
+                    # machine API keys (emqx_mgmt_auth), the static key as
+                    # a password, or the legacy bare-key form (no colon)
                     ok = self.api_keys.verify(user, secret) or (
-                        bool(key) and secret == key
+                        bool(key)
+                        and (secret == key or (not secret and user == key))
                     )
                 except Exception:
                     ok = False
@@ -755,8 +757,7 @@ class MgmtApi:
             return web.json_response(
                 {"code": "BAD_REQUEST", "message": str(e)}, status=400
             )
-        await self.app.listeners.stop_listener(type_, name)
-        if self.app.listeners._specs.pop(f"{type_}:{name}", None) is None:
+        if not await self.app.listeners.delete_listener(type_, name):
             return web.json_response({"code": "NOT_FOUND"}, status=404)
         return web.json_response({}, status=204)
 
@@ -1147,6 +1148,8 @@ class MgmtApi:
         return web.json_response({"data": self.api_keys.list()})
 
     async def api_keys_create(self, request):
+        from emqx_tpu.mgmt.api_keys import DuplicateKey
+
         try:
             body = await request.json()
             rec = self.api_keys.create(
@@ -1155,12 +1158,14 @@ class MgmtApi:
                 enable=bool(body.get("enable", True)),
                 expired_at=body.get("expired_at"),
             )
-        except ValueError as e:
+        except DuplicateKey as e:
             return web.json_response(
                 {"code": "ALREADY_EXISTS", "message": str(e)}, status=409
             )
-        except (KeyError, TypeError):
-            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        except (ValueError, KeyError, TypeError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
         return web.json_response(rec, status=201)
 
     async def api_keys_get(self, request):
@@ -1172,14 +1177,16 @@ class MgmtApi:
     async def api_keys_update(self, request):
         try:
             body = await request.json()
-        except (ValueError, TypeError):
-            return web.json_response({"code": "BAD_REQUEST"}, status=400)
-        rec = self.api_keys.update(
-            request.match_info["name"],
-            description=body.get("description"),
-            enable=body.get("enable"),
-            expired_at=body.get("expired_at", "unset"),
-        )
+            rec = self.api_keys.update(
+                request.match_info["name"],
+                description=body.get("description"),
+                enable=body.get("enable"),
+                expired_at=body.get("expired_at", "unset"),
+            )
+        except (ValueError, TypeError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
         if rec is None:
             return web.json_response({"code": "NOT_FOUND"}, status=404)
         return web.json_response(rec)

@@ -19,6 +19,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 
+class DuplicateKey(ValueError):
+    """Key name already taken (distinct from validation errors so the
+    REST layer can map 409 vs 400)."""
+
+
 @dataclass
 class ApiKey:
     name: str
@@ -83,7 +88,7 @@ class ApiKeyStore:
         (emqx_mgmt_auth create semantics)."""
         expired_at = self._coerce_expiry(expired_at)  # before any mutation
         if name in self._keys:
-            raise ValueError(f"api key exists: {name}")
+            raise DuplicateKey(f"api key exists: {name}")
         api_key = secrets.token_urlsafe(12)
         api_secret = secrets.token_urlsafe(24)
         salt = secrets.token_bytes(16)
@@ -121,12 +126,14 @@ class ApiKeyStore:
         rec = self._keys.get(name)
         if rec is None:
             return None
+        if expired_at != "unset":  # validate BEFORE any mutation
+            expired_at = self._coerce_expiry(expired_at)
         if description is not None:
             rec.description = description
         if enable is not None:
             rec.enable = enable
         if expired_at != "unset":
-            rec.expired_at = self._coerce_expiry(expired_at)
+            rec.expired_at = expired_at
         return rec.as_dict()
 
     def delete(self, name: str) -> bool:

@@ -108,6 +108,10 @@ def shape_route_step_impl(
     sub_bitmaps,
     bytes_mat,
     lengths,
+    group_tables=None,
+    client_hash=None,
+    topic_hash=None,
+    rand=None,
     *,
     m_active: int,
     with_nfa: bool,
@@ -117,6 +121,8 @@ def shape_route_step_impl(
     max_matches: int = 64,
     probes: int = 8,
     shape_probes: Optional[int] = None,
+    with_groups: bool = False,
+    share_strategy: int = 0,
 ):
     """The serving-path kernel: shape index + (residual NFA) + fanout.
 
@@ -163,6 +169,17 @@ def shape_route_step_impl(
     else:  # match-only callers (Router.match_batch) skip the fan-out half
         bitmaps = None
         fanout_bits = jnp.int32(0)
+    if with_groups and group_tables is not None:
+        pick_gid, pick_idx = share_pick_device(
+            group_tables,
+            matched,
+            client_hash,
+            topic_hash,
+            rand,
+            strategy=share_strategy,
+        )
+    else:
+        pick_gid = pick_idx = None
     stats = {
         "routed": jnp.sum((mcount > 0).astype(jnp.int32)),
         "matches": jnp.sum(mcount),
@@ -173,6 +190,8 @@ def shape_route_step_impl(
         "mcount": mcount,
         "flags": flags,
         "bitmaps": bitmaps,
+        "pick_gid": pick_gid,
+        "pick_idx": pick_idx,
         "stats": stats,
     }
 
@@ -188,8 +207,254 @@ shape_route_step = partial(
         "max_matches",
         "probes",
         "shape_probes",
+        "with_groups",
+        "share_strategy",
     ),
 )(shape_route_step_impl)
+
+
+STRATEGY_IDS = {
+    "random": 0,
+    "round_robin": 1,
+    "sticky": 2,
+    "hash_clientid": 3,
+    "hash_topic": 4,
+}
+
+
+class GroupTable:
+    """$share groups as device lane segments (SURVEY hard part (d)).
+
+    Host registry mapping (real filter, group name) -> gid, mirrored on
+    device as:
+      ``filter_groups [Fcap, GPF]`` int32 — group ids per filter (-1 pad)
+      ``group_len     [Gcap]``      int32 — member count per group
+      ``group_rr      [Gcap]``      int32 — round-robin base (synced once
+                                            per batch, not per message)
+      ``group_sticky  [Gcap]``      int32 — sticky member index (-1 unset)
+
+    The kernel picks a member INDEX per (topic, group); the host resolves
+    index -> member and keeps only ack/retry failover
+    (emqx_shared_sub.erl:234-285 pick semantics on-device).
+    Implements the epoch/oplog/device_snapshot contract DeviceDeltaSync
+    expects, same as SubscriberTable.
+    """
+
+    def __init__(self, gpf: int = 4):
+        self.gpf = gpf
+        self._fcap = 64
+        self._gcap = 64
+        self.filter_groups = np.full((self._fcap, self.gpf), -1, np.int32)
+        self.group_len = np.zeros(self._gcap, np.int32)
+        self.group_rr = np.zeros(self._gcap, np.int32)
+        self.group_sticky = np.full(self._gcap, -1, np.int32)
+        self._gids: Dict = {}  # (real, gname) -> gid
+        self._info: Dict[int, tuple] = {}  # gid -> (real, gname)
+        self._free: List[int] = []
+        self._next_gid = 0
+        self.epoch = 0
+        self.oplog: list = []
+        self.version = 0
+        self.OPLOG_MAX = 65536
+
+    def _bump(self) -> None:
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+
+    def _log(self, name: str, flat_idx: int, val: int) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump()
+            return
+        self.oplog.append((name, flat_idx, val))
+
+    def _grow_fcap(self, need: int) -> None:
+        nf = max(self._fcap, _next_pow2(need))
+        if nf != self._fcap:
+            new = np.full((nf, self.gpf), -1, np.int32)
+            new[: self._fcap] = self.filter_groups
+            self.filter_groups = new
+            self._fcap = nf
+            self._bump()
+
+    def _grow_gpf(self) -> None:
+        new = np.full((self._fcap, self.gpf * 2), -1, np.int32)
+        new[:, : self.gpf] = self.filter_groups
+        self.filter_groups = new
+        self.gpf *= 2
+        self._bump()
+
+    def _grow_gcap(self) -> None:
+        ng = self._gcap * 2
+        for name in ("group_len", "group_rr", "group_sticky"):
+            arr = getattr(self, name)
+            fill = -1 if name == "group_sticky" else 0
+            new = np.full(ng, fill, arr.dtype)
+            new[: self._gcap] = arr
+            setattr(self, name, new)
+        self._gcap = ng
+        self._bump()
+
+    # -- membership ---------------------------------------------------------
+    def ensure_group(self, fid: int, real: str, gname: str) -> int:
+        key = (real, gname)
+        gid = self._gids.get(key)
+        if gid is not None:
+            return gid
+        if self._free:
+            gid = self._free.pop()
+        else:
+            gid = self._next_gid
+            self._next_gid += 1
+        while gid >= self._gcap:
+            self._grow_gcap()
+        self._gids[key] = gid
+        self._info[gid] = key
+        # reset through the log so a recycled gid's device row resets too
+        for name, val in (
+            ("group_len", 0),
+            ("group_rr", 0),
+            ("group_sticky", -1),
+        ):
+            getattr(self, name)[gid] = val
+            self._log(name, gid, val)
+        self._grow_fcap(fid + 1)
+        row = self.filter_groups[fid]
+        slot = int(np.argmax(row < 0)) if (row < 0).any() else -1
+        if slot < 0 or row[slot] >= 0:
+            self._grow_gpf()
+            row = self.filter_groups[fid]
+            slot = int(np.argmax(row < 0))
+        self.filter_groups[fid, slot] = gid
+        self._log("filter_groups", fid * self.gpf + slot, gid)
+        return gid
+
+    def set_len(self, gid: int, n: int) -> None:
+        if self.group_len[gid] != n:
+            self.group_len[gid] = n
+            self._log("group_len", gid, n)
+
+    def set_rr(self, gid: int, v: int) -> None:
+        v &= 0x7FFFFFFF
+        if self.group_rr[gid] != v:
+            self.group_rr[gid] = v
+            self._log("group_rr", gid, v)
+
+    def set_sticky(self, gid: int, idx: int) -> None:
+        if self.group_sticky[gid] != idx:
+            self.group_sticky[gid] = idx
+            self._log("group_sticky", gid, idx)
+
+    def drop_group(self, fid: int, real: str, gname: str) -> None:
+        gid = self._gids.pop((real, gname), None)
+        if gid is None:
+            return
+        self._info.pop(gid, None)
+        self._free.append(gid)
+        self.group_len[gid] = 0
+        self._log("group_len", gid, 0)
+        if fid < self._fcap:
+            row = self.filter_groups[fid]
+            for slot in np.nonzero(row == gid)[0]:
+                self.filter_groups[fid, slot] = -1
+                self._log("filter_groups", fid * self.gpf + int(slot), -1)
+
+    def gid_of(self, real: str, gname: str):
+        return self._gids.get((real, gname))
+
+    def info(self, gid: int):
+        return self._info.get(gid)
+
+    def pack_fcap(self, filter_capacity: int) -> None:
+        if filter_capacity > self._fcap:
+            self._grow_fcap(filter_capacity)
+
+    def device_snapshot(self):
+        return {
+            "filter_groups": self.filter_groups,
+            "group_len": self.group_len,
+            "group_rr": self.group_rr,
+            "group_sticky": self.group_sticky,
+        }
+
+    def __len__(self) -> int:
+        return len(self._gids)
+
+
+def _occurrence_index(flat_gids):
+    """occ[i] = #{j < i : g[j] == g[i]} in flat (batch-major) order — the
+    per-batch round-robin offset. Stable argsort groups equal gids while
+    preserving arrival order; run positions come from a cummax of run
+    starts; scatter restores original order."""
+    n = flat_gids.shape[0]
+    order = jnp.argsort(flat_gids, stable=True)
+    sg = flat_gids[order]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), bool), sg[1:] != sg[:-1]]
+    )
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(new_seg, idx, 0)
+    )
+    run_pos = idx - seg_start
+    return jnp.zeros(n, jnp.int32).at[order].set(run_pos)
+
+
+def share_pick_device(
+    group_tables,
+    matched,
+    client_hash,
+    topic_hash,
+    rand,
+    *,
+    strategy: int,
+):
+    """Resolve $share picks on-device: matched fids -> group lanes ->
+    member index per strategy (emqx_shared_sub.erl:234-285 on the MXU-
+    adjacent path). Returns (pick_gid [B,P], pick_idx [B,P]), -1 holes.
+
+    strategy: STRATEGY_IDS value (static — each strategy is its own
+    compiled program; brokers run one strategy at a time).
+    """
+    fg = group_tables["filter_groups"]
+    glen = group_tables["group_len"]
+    B, K = matched.shape
+    gpf = fg.shape[1]
+    safe = jnp.maximum(matched, 0)
+    gids = fg[safe]  # [B, K, GPF]
+    valid = (matched >= 0)[:, :, None] & (gids >= 0)
+    gids = jnp.where(valid, gids, -1).reshape(B, K * gpf)
+    gsafe = jnp.maximum(gids, 0)
+    lens = glen[gsafe]
+    denom = jnp.maximum(lens, 1)
+    if strategy == 1:  # round_robin: per-batch occurrence + synced base
+        occ = _occurrence_index(gids.reshape(-1)).reshape(B, -1)
+        idx = (group_tables["group_rr"][gsafe] + occ) % denom
+    elif strategy == 2:  # sticky: stored index, random fallback
+        st = group_tables["group_sticky"][gsafe]
+        fallback = (
+            (rand[:, None].astype(jnp.uint32) ^ gsafe.astype(jnp.uint32))
+            % denom.astype(jnp.uint32)
+        ).astype(jnp.int32)
+        idx = jnp.where((st >= 0) & (st < lens), st, fallback)
+    elif strategy == 3:  # hash_clientid
+        idx = (
+            client_hash[:, None].astype(jnp.uint32)
+            % denom.astype(jnp.uint32)
+        ).astype(jnp.int32)
+    elif strategy == 4:  # hash_topic
+        idx = (
+            topic_hash[:, None].astype(jnp.uint32)
+            % denom.astype(jnp.uint32)
+        ).astype(jnp.int32)
+    else:  # random: per-message entropy decorrelated across groups
+        mixed = rand[:, None].astype(jnp.uint32) * jnp.uint32(
+            2654435761
+        ) ^ gsafe.astype(jnp.uint32)
+        idx = (mixed % denom.astype(jnp.uint32)).astype(jnp.int32)
+    ok = (gids >= 0) & (lens > 0)
+    return jnp.where(ok, gids, -1), jnp.where(ok, idx, -1)
 
 
 class SubscriberTable:
@@ -287,7 +552,14 @@ class DeviceRouter:
     only the kernel launch plus the readback.
     """
 
-    def __init__(self, index, subtab: Optional[SubscriberTable], config=None):
+    def __init__(
+        self,
+        index,
+        subtab: Optional[SubscriberTable],
+        config=None,
+        grouptab: Optional[GroupTable] = None,
+        share_strategy: str = "round_robin",
+    ):
         import dataclasses
 
         from emqx_tpu.ops.matcher import MatcherConfig
@@ -295,6 +567,8 @@ class DeviceRouter:
 
         self.index = index
         self.subtab = subtab  # None => match-only (no fan-out bitmaps)
+        self.grouptab = grouptab  # None => host-side $share pick
+        self.share_strategy = STRATEGY_IDS.get(share_strategy, 1)
         config = config or MatcherConfig()
         if config.probes < MAX_PROBES:
             config = dataclasses.replace(config, probes=MAX_PROBES)
@@ -302,6 +576,8 @@ class DeviceRouter:
         self._shape_sync = DeviceDeltaSync()
         self._nfa_sync = DeviceDeltaSync()
         self._bits_sync = DeviceDeltaSync()
+        self._group_sync = DeviceDeltaSync()
+        self._rng = np.random.default_rng(0xEC0)
 
     def _device_args(self):
         idx = self.index
@@ -316,7 +592,20 @@ class DeviceRouter:
         with_nfa = idx.residual_count > 0
         nfa_tables = self._nfa_sync.sync(idx.nfa) if with_nfa else None
         m_active = idx.shapes.m_active()
-        return shape_tables, nfa_tables, bits, idx.salt, m_active, with_nfa
+        if self.grouptab is not None and len(self.grouptab):
+            self.grouptab.pack_fcap(idx.num_filters_capacity)
+            group_tables = self._group_sync.sync(self.grouptab)
+        else:
+            group_tables = None
+        return (
+            shape_tables,
+            nfa_tables,
+            bits,
+            idx.salt,
+            m_active,
+            with_nfa,
+            group_tables,
+        )
 
     def prepare(self):
         """Snapshot + upload current tables/bitmaps. MUST run on the thread
@@ -325,32 +614,67 @@ class DeviceRouter:
         safe to hand to `route_prepared` on a worker thread."""
         return self._device_args()
 
-    def route(self, topics):
-        """Batch route: returns host np arrays
-        (matched [B,K] sparse, mcount [B], flags [B], bitmaps [B,W])."""
-        return self.route_prepared(self._device_args(), topics)
+    def route(self, topics, client_hashes=None):
+        """Batch route: returns host np arrays (matched [B,K] sparse,
+        mcount [B], flags [B], bitmaps [B,W], picks|None)."""
+        return self.route_prepared(
+            self._device_args(), topics, client_hashes
+        )
 
-    def route_prepared(self, args, topics):
+    def route_prepared(self, args, topics, client_hashes=None):
         """Kernel launch + readback against a `prepare()` snapshot; touches
         no mutable host state, so it may run in an executor thread while
         the event loop keeps serving connections (the jit compile on a new
-        batch/table shape can take tens of seconds on a real chip)."""
+        batch/table shape can take tens of seconds on a real chip).
+
+        `client_hashes` ([B] uint32, stable_hash of each publisher id)
+        feeds the device $share pick; required only when a group table is
+        loaded and the strategy is hash_clientid.
+        Returns (matched, mcount, flags, bitmaps[, pick_gid, pick_idx]).
+        """
+        from emqx_tpu.broker.shared_sub import stable_hash
         from emqx_tpu.ops import tokenizer as tok
 
         cfg = self.config
-        shape_tables, nfa_tables, bits, salt, m_active, with_nfa = args
+        (
+            shape_tables,
+            nfa_tables,
+            bits,
+            salt,
+            m_active,
+            with_nfa,
+            group_tables,
+        ) = args
         B = len(topics)
         Bp = max(64, _next_pow2(B))
         mat, lens, too_long = tok.encode_topics(list(topics), cfg.max_bytes)
         if Bp != B:
             mat = np.pad(mat, ((0, Bp - B), (0, 0)))
             lens = np.pad(lens, (0, Bp - B))
+        with_groups = group_tables is not None
+        if with_groups:
+            ch = np.zeros(Bp, np.uint32)
+            if client_hashes is not None:
+                ch[:B] = np.asarray(client_hashes, np.uint32)
+            th = np.fromiter(
+                (stable_hash(t) for t in topics), np.uint32, count=B
+            )
+            th = np.pad(th, (0, Bp - B))
+            rand = self._rng.integers(
+                0, 1 << 32, size=Bp, dtype=np.uint32
+            )
+        else:
+            ch = th = rand = None
         out = shape_route_step(
             shape_tables,
             nfa_tables,
             bits,
             mat,
             lens,
+            group_tables,
+            ch,
+            th,
+            rand,
             m_active=m_active,
             with_nfa=with_nfa,
             salt=salt,
@@ -358,16 +682,25 @@ class DeviceRouter:
             frontier=cfg.frontier,
             max_matches=cfg.max_matches,
             probes=cfg.probes,
+            with_groups=with_groups,
+            share_strategy=self.share_strategy,
         )
         matched = np.asarray(out["matched"][:B])
         mcount = np.asarray(out["mcount"][:B])
         flags = np.asarray(out["flags"][:B]) | too_long
+        if with_groups:
+            picks = (
+                np.asarray(out["pick_gid"][:B]),
+                np.asarray(out["pick_idx"][:B]),
+            )
+        else:
+            picks = None
         if out["bitmaps"] is None:
-            return matched, mcount, flags, None
+            return matched, mcount, flags, None, picks
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
         bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
-        return matched, mcount, flags, bitmaps
+        return matched, mcount, flags, bitmaps, picks
 
     def match_batch(
         self, topics: Sequence[str], fallback=None
@@ -383,7 +716,7 @@ class DeviceRouter:
         """
         from emqx_tpu.ops import topics as T
 
-        matched, _mcount, flags, _ = self.route(topics)
+        matched, _mcount, flags, _bits, _picks = self.route(topics)
         out: List[List[str]] = []
         for i, t in enumerate(topics):
             if flags[i]:

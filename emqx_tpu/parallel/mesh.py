@@ -67,6 +67,10 @@ def _reduce_stats(out):
         "matches": jax.lax.psum(stats["matches"], "dp"),
         "fanout_bits": jax.lax.psum(stats["fanout_bits"], ("dp", "tp")),
     }
+    # group picks are a single-chip output (the dist step serves the
+    # cross-node forward path, where $share picks happen host-side)
+    out.pop("pick_gid", None)
+    out.pop("pick_idx", None)
     return out
 
 

@@ -210,6 +210,11 @@ class Listeners:
         await self.stop_listener(type_, name)
         return await self.start_stopped(type_, name)
 
+    async def delete_listener(self, type_: str, name: str) -> bool:
+        """Stop (if running) and forget the saved spec entirely."""
+        await self.stop_listener(type_, name)
+        return self._specs.pop(f"{type_}:{name}", None) is not None
+
     async def stop_all(self) -> None:
         for key in list(self._listeners):
             t, n = key.split(":", 1)
