@@ -1,0 +1,59 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+import jax.numpy as jnp
+t0=time.perf_counter()
+def mark(s): print(f"[+{time.perf_counter()-t0:6.1f}s] {s}", flush=True)
+
+from emqx_tpu.models.retained_index import DeviceRetainedIndex, CHUNK
+from emqx_tpu.models.router_model import shape_route_step
+from emqx_tpu.ops.route_index import RouteIndex
+
+N = 5_000_000
+STORM = 512
+topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(N)]
+dev = DeviceRetainedIndex(max_bytes=64, max_levels=8)
+dev.bulk_add(topics)
+mark(f"built ({len(dev._host_b)} chunks of {CHUNK})")
+filters = [f"site/{i % 211}/dev/+/ch/#" for i in range(STORM)]
+
+idx = RouteIndex()
+fids = {}
+for f in filters: fids[idx.add(f)] = f
+shape_tables = {k: jax.device_put(v.copy()) for k, v in idx.shapes.device_snapshot().items()}
+m_active = idx.shapes.m_active(floor=1)
+# upload chunks + compile first
+for c in range(len(dev._host_b)):
+    dev._dev[c] = (jax.device_put(dev._host_b[c]), jax.device_put(dev._host_l[c]))
+r = shape_route_step(shape_tables, None, None, *dev._dev[0],
+    m_active=m_active, with_nfa=False, salt=idx.salt, max_levels=8)
+jax.block_until_ready(r["matched"])
+mark("uploaded + compiled; timed storm begins")
+
+t1=time.perf_counter()
+outs=[]
+for c in range(len(dev._host_b)):
+    r = shape_route_step(shape_tables, None, None, *dev._dev[c],
+        m_active=m_active, with_nfa=False, salt=idx.salt, max_levels=8)
+    outs.append(r["matched"].astype(jnp.int16))
+jax.block_until_ready(outs)
+t2=time.perf_counter(); print(f"launches+compute ({len(outs)}): {t2-t1:.3f}s")
+cat = jnp.concatenate(outs, axis=0).ravel()
+jax.block_until_ready(cat)
+t3=time.perf_counter(); print(f"device concat: {t3-t2:.3f}s")
+flat = np.asarray(cat)
+t4=time.perf_counter(); print(f"readback {flat.nbytes/1e6:.0f}MB: {t4-t3:.3f}s")
+hits = np.nonzero(flat >= 0)[0]
+rows_g = hits  # lanes=1
+hf = flat[hits].astype(np.int64)
+order = np.argsort(hf, kind="stable")
+t5=time.perf_counter(); print(f"host group: {t5-t4:.3f}s  total storm {t5-t1:.3f}s = {(t5-t1)/STORM*1e3:.2f}ms/sub")
+# also: individual readback style for comparison
+t6=time.perf_counter()
+outs2=[]
+for c in range(len(dev._host_b)):
+    r = shape_route_step(shape_tables, None, None, *dev._dev[c],
+        m_active=m_active, with_nfa=False, salt=idx.salt, max_levels=8)
+    outs2.append(r["matched"].astype(jnp.int16))
+mats=[np.asarray(m) for m in outs2]
+t7=time.perf_counter(); print(f"alt per-chunk readback path: {t7-t6:.3f}s")

@@ -1,0 +1,49 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+t0=time.perf_counter()
+def mark(s): print(f"[+{time.perf_counter()-t0:6.1f}s] {s}", flush=True)
+
+from emqx_tpu.models.retained_index import DeviceRetainedIndex, CHUNK
+N, STORM = 5_000_000, 512
+topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(N)]
+dev = DeviceRetainedIndex(max_bytes=64, max_levels=8)
+dev.bulk_add(topics)
+mark("built")
+filters = [f"site/{i % 211}/dev/+/ch/#" for i in range(STORM)]
+dev.match_many(filters)   # FULL warm
+mark("warm done; instrumenting storm phases")
+
+# replicate match_many with marks
+from emqx_tpu.models.router_model import shape_route_step
+from emqx_tpu.ops.route_index import RouteIndex
+from emqx_tpu.ops import topics as T
+
+t1=time.perf_counter()
+idx = RouteIndex(); fids={}
+for f in filters: fids[idx.add(f)] = f
+shape_tables = {k: jax.device_put(v.copy()) for k, v in idx.shapes.device_snapshot().items()}
+m_active = idx.shapes.m_active(floor=1)
+t2=time.perf_counter(); print(f"index+tables: {t2-t1:.2f}s")
+outs=[]
+for c in range(len(dev._host_b)):
+    bm, ln = dev._dev[c]
+    r = shape_route_step(shape_tables, None, None, bm, ln,
+        m_active=m_active, with_nfa=False, salt=idx.salt, max_levels=8)
+    outs.append(r["matched"].astype(jnp.int16))
+jax.block_until_ready(outs)
+t3=time.perf_counter(); print(f"launches ({len(outs)}): {t3-t2:.2f}s")
+flat = np.concatenate([np.asarray(m).ravel() for m in outs])
+t4=time.perf_counter(); print(f"readback {flat.nbytes/1e6:.1f}MB: {t4-t3:.2f}s")
+nrows=len(dev._by_row)
+live = np.zeros(len(dev._host_b)*CHUNK, dtype=bool)
+for r_, t_ in enumerate(dev._by_row): live[r_] = t_ is not None
+t5=time.perf_counter(); print(f"live mask python loop: {t5-t4:.2f}s")
+hits = np.nonzero(flat >= 0)[0]
+rows_g = hits  # lanes=1
+keep = live[rows_g]; rows_g = rows_g[keep]
+hf = flat[hits[keep]].astype(np.int64)
+order = np.argsort(hf, kind="stable")
+rows_g = rows_g[order]; hf = hf[order]
+bounds = np.nonzero(np.diff(hf))[0]+1
+t6=time.perf_counter(); print(f"group: {t6-t5:.2f}s; storm total {t6-t1:.2f}s = {(t6-t1)/STORM*1e3:.1f}ms/sub")

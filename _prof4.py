@@ -1,0 +1,22 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+t0=time.perf_counter()
+def mark(s): print(f"[+{time.perf_counter()-t0:6.1f}s] {s}", flush=True)
+from emqx_tpu.models.retained_index import DeviceRetainedIndex, CHUNK
+N, STORM = 5_000_000, 512
+topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(N)]
+dev = DeviceRetainedIndex(max_bytes=64, max_levels=8)
+dev.bulk_add(topics)
+mark("built")
+filters = [f"site/{i % 211}/dev/+/ch/#" for i in range(STORM)]
+dev.warm(filters)
+mark("warm (no readback) done")
+t1=time.perf_counter()
+res = dev.match_many(filters)
+t2=time.perf_counter()
+print(f"storm1: {t2-t1:.2f}s = {(t2-t1)/STORM*1e3:.1f}ms/sub, pairs={sum(len(v) for v in res.values())}")
+t1=time.perf_counter()
+res = dev.match_many(filters)
+t2=time.perf_counter()
+print(f"storm2 (degraded?): {t2-t1:.2f}s")

@@ -1,0 +1,66 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+t0=time.perf_counter()
+def mark(s): print(f"[+{time.perf_counter()-t0:6.1f}s] {s}", flush=True)
+
+from emqx_tpu.models.retained_index import DeviceRetainedIndex, CHUNK
+N = 5_000_000
+STORM = 512
+topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(N)]
+dev = DeviceRetainedIndex(max_bytes=64, max_levels=8)
+mark("building")
+dev.bulk_add(topics)
+mark("built; warm")
+filters = [f"site/{i % 211}/dev/+/ch/#" for i in range(STORM)]
+dev.match_many(filters[:8])
+mark("warm done; instrumented storm")
+
+# instrumented match_many
+import jax
+from emqx_tpu.models.router_model import shape_route_step
+from emqx_tpu.ops.route_index import RouteIndex
+from emqx_tpu.ops import topics as T
+
+t1=time.perf_counter()
+idx = RouteIndex()
+fids = {}
+for f in filters:
+    fids[idx.add(f)] = f
+shape_tables = {k: jax.device_put(v.copy()) for k, v in idx.shapes.device_snapshot().items()}
+with_nfa = idx.residual_count > 0
+nfa_tables = {k: jax.device_put(v.copy()) for k, v in idx.nfa.device_snapshot().items()} if with_nfa else None
+m_active = idx.shapes.m_active(floor=1)
+print("m_active lanes:", m_active, "with_nfa:", with_nfa, "chunks:", len(dev._host_b))
+t2=time.perf_counter(); print(f"table build+upload: {t2-t1:.3f}s")
+
+outs=[]
+for c in range(len(dev._host_b)):
+    bm, ln = dev._dev[c]
+    r = shape_route_step(shape_tables, nfa_tables, None, bm, ln,
+        m_active=m_active, with_nfa=with_nfa, salt=idx.salt, max_levels=8)
+    outs.append((c, r["matched"]))
+jax.block_until_ready(outs[-1][1])
+t3=time.perf_counter(); print(f"launches (all chunks): {t3-t2:.3f}s")
+
+host_mats = [np.asarray(m) for _, m in outs]
+t4=time.perf_counter(); print(f"readback {sum(m.nbytes for m in host_mats)/1e6:.0f}MB: {t4-t3:.3f}s")
+
+nrows = len(dev._by_row)
+live = np.ones(nrows, dtype=bool)
+by_fid = {}
+for (c, _), m in zip(outs, host_mats):
+    base = c * CHUNK
+    for lane in range(m.shape[1]):
+        col = m[:, lane]
+        rows = np.nonzero(col >= 0)[0]
+        if not len(rows): continue
+        rows_g = rows + base
+        keep = rows_g < nrows
+        rows, rows_g = rows[keep], rows_g[keep]
+        for fid in np.unique(col[rows]):
+            sel = rows_g[col[rows] == fid]
+            by_fid.setdefault(int(fid), []).append(sel)
+t5=time.perf_counter(); print(f"host grouping: {t5-t4:.3f}s")
+total = sum(len(x) for v in by_fid.values() for x in v)
+print(f"matched pairs: {total}; storm total {t5-t1:.3f}s = {(t5-t1)/STORM*1e3:.2f} ms/sub")

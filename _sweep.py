@@ -1,0 +1,28 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from emqx_tpu.models.router_model import shape_route_step
+from emqx_tpu.ops.route_index import RouteIndex
+from emqx_tpu.ops.tokenizer import encode_topics
+
+idx = RouteIndex()
+for i in range(211):
+    idx.add(f"site/{i}/dev/+/ch/#")
+st = {k: jax.device_put(v.copy()) for k, v in idx.shapes.device_snapshot().items()}
+m_active = idx.shapes.m_active(floor=1)
+print("m_active:", m_active)
+
+for B in (8192, 65536, 262144, 1<<20):
+    topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(B)]
+    mat, lens, _ = encode_topics(topics, 64)
+    bm, ln = jax.device_put(mat), jax.device_put(lens)
+    r = shape_route_step(st, None, None, bm, ln, m_active=m_active,
+                         with_nfa=False, salt=idx.salt, max_levels=8)
+    jax.block_until_ready(r["matched"])  # compile
+    t=time.perf_counter()
+    for _ in range(3):
+        r = shape_route_step(st, None, None, bm, ln, m_active=m_active,
+                             with_nfa=False, salt=idx.salt, max_levels=8)
+    jax.block_until_ready(r["matched"])
+    dt=(time.perf_counter()-t)/3
+    print(f"B={B:>8}: {dt*1e3:8.2f} ms/launch = {dt/B*1e9:7.1f} ns/row")

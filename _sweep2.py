@@ -1,0 +1,36 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from emqx_tpu.models.router_model import shape_route_step
+from emqx_tpu.ops.route_index import RouteIndex
+from emqx_tpu.ops.tokenizer import encode_topics
+
+idx = RouteIndex()
+for i in range(211):
+    idx.add(f"site/{i}/dev/+/ch/#")
+st = {k: jax.device_put(v.copy()) for k, v in idx.shapes.device_snapshot().items()}
+m_active = idx.shapes.m_active(floor=1)
+B = 1<<20
+topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(B)]
+mat, lens, _ = encode_topics(topics, 64)
+bm, ln = jax.device_put(mat), jax.device_put(lens)
+
+def launch():
+    return shape_route_step(st, None, None, bm, ln, m_active=m_active,
+                            with_nfa=False, salt=idx.salt, max_levels=8)
+r = launch(); jax.block_until_ready(r["matched"])
+
+def t_launches(tag, n=3):
+    t=time.perf_counter()
+    for _ in range(n): r = launch()
+    jax.block_until_ready(r["matched"])
+    print(f"{tag}: {(time.perf_counter()-t)/n*1e3:.1f} ms/launch", flush=True)
+
+t_launches("before any readback")
+x = np.asarray(launch()["matched"])   # one full readback (4MB)
+print("did readback of", x.nbytes/1e6, "MB")
+t_launches("after 1 readback")
+for _ in range(5):
+    x = np.asarray(launch()["matched"])
+t_launches("after 6 readbacks")
+t_launches("again (stable?)")

@@ -1,0 +1,36 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from emqx_tpu.models.router_model import shape_route_step_impl
+from emqx_tpu.ops.route_index import RouteIndex
+from emqx_tpu.ops.tokenizer import encode_topics
+
+idx = RouteIndex()
+for i in range(211):
+    idx.add(f"site/{i}/dev/+/ch/#")
+st = {k: jax.device_put(v.copy()) for k, v in idx.shapes.device_snapshot().items()}
+m_active = idx.shapes.m_active(floor=1)
+B = 1<<20
+topics = [f"site/{i % 211}/dev/{i % 7919}/ch/{i}" for i in range(B)]
+mat, lens, _ = encode_topics(topics, 64)
+bm, ln = jax.device_put(mat), jax.device_put(lens)
+
+# variant O: chunk data captured as closure constants
+t=time.perf_counter()
+@jax.jit
+def launch_const(tables):
+    return shape_route_step_impl(tables, None, None, bm, ln,
+        m_active=m_active, with_nfa=False, salt=idx.salt, max_levels=8)["matched"].astype(jnp.int16)
+r = launch_const(st); jax.block_until_ready(r)
+print(f"const-capture compile+first: {time.perf_counter()-t:.1f}s", flush=True)
+x = np.asarray(r)  # flip to eager/degraded mode
+print("readback done", flush=True)
+t=time.perf_counter()
+for _ in range(3):
+    r = launch_const(st)
+jax.block_until_ready(r)
+print(f"const-capture launch after readback: {(time.perf_counter()-t)/3*1e3:.1f} ms", flush=True)
+t=time.perf_counter()
+x2 = np.asarray(launch_const(st))
+print(f"launch+readback cycle: {time.perf_counter()-t:.2f}s", flush=True)

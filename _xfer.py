@@ -1,0 +1,14 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+
+a32 = jax.device_put(np.zeros((1<<20, 1), np.int32)); jax.block_until_ready(a32)
+a16 = a32.astype(jnp.int16); jax.block_until_ready(a16)
+r16 = a16.ravel(); jax.block_until_ready(r16)
+
+for name, arr in [("int32 [1M,1]", a32), ("int16 [1M,1]", a16), ("int16 ravel", r16),
+                  ("int32 [1M,1] again", a32)]:
+    t=time.perf_counter()
+    x = np.asarray(arr)
+    dt=time.perf_counter()-t
+    print(f"{name}: {x.nbytes/1e6:.1f}MB in {dt:.3f}s = {x.nbytes/1e6/dt:.1f}MB/s")

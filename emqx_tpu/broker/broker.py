@@ -128,9 +128,16 @@ class Broker:
                 g = self.shared.group(real, group)
                 if gid is not None and g is not None:
                     self.grouptab.set_len(gid, len(g.members))
-                    # a stored sticky index may now point past the end or
-                    # at a different member; the host re-pins on delivery
-                    if self.grouptab.group_sticky[gid] >= len(g.members):
+                    # a member leaving shifts indices: recompute the
+                    # stored sticky index from the pinned sid so the pin
+                    # stays on the same live member (not whoever slid
+                    # into the old index)
+                    sids = list(g.members.keys())
+                    if g.sticky_sid in sids:
+                        self.grouptab.set_sticky(
+                            gid, sids.index(g.sticky_sid)
+                        )
+                    else:
                         self.grouptab.set_sticky(gid, -1)
             return removed
         entry = self._subs.get(real)

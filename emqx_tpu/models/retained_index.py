@@ -25,20 +25,91 @@ import numpy as np
 
 from emqx_tpu.ops import topics as T
 
-CHUNK = 1 << 18  # 262144 topics per device launch
+
+def _retained_step(
+    shape_tables, nfa_tables, bm, *, m_active, with_nfa, salt, max_levels,
+    narrow,
+):
+    """Storm launch: lengths derived on-device (topics cannot contain
+    NUL — emqx_topic validate rejects it — so length = count of nonzero
+    bytes), which removes the lengths operand from every launch; the
+    result is narrowed to int16 when fids fit. Every byte crossing the
+    host<->device link per launch is paid per storm, so operands are
+    kept minimal."""
+    import jax.numpy as jnp
+
+    from emqx_tpu.models.router_model import shape_route_step_impl
+
+    ln = jnp.sum((bm != 0).astype(jnp.int32), axis=1)
+    out = shape_route_step_impl(
+        shape_tables,
+        nfa_tables,
+        None,
+        bm,
+        ln,
+        m_active=m_active,
+        with_nfa=with_nfa,
+        salt=salt,
+        max_levels=max_levels,
+    )
+    m = out["matched"]
+    return m.astype(jnp.int16) if narrow else m
+
+
+_retained_step_jit = None
+
+
+def _get_retained_step():
+    global _retained_step_jit
+    if _retained_step_jit is None:
+        import jax
+        from functools import partial
+
+        _retained_step_jit = partial(
+            jax.jit,
+            static_argnames=(
+                "m_active", "with_nfa", "salt", "max_levels", "narrow"
+            ),
+        )(_retained_step)
+    return _retained_step_jit
+
+
+# Topics per device launch. Sized large: per-launch dispatch overhead
+# (host->device descriptor round-trips; ~hundreds of ms through a dev
+# tunnel) dominates the kernel's per-row cost, so fewer, bigger launches
+# win. One chunk = 64MB of topic bytes + 4MB lengths in HBM.
+CHUNK = 1 << 20
 
 
 class DeviceRetainedIndex:
     def __init__(self, max_bytes: int = 64, max_levels: int = 8):
-        self.max_bytes = max_bytes
+        self.max_bytes = max_bytes  # hard cap (device-budget gate)
         self.max_levels = max_levels
+        # actual storage width: a pow2 bucket grown to the longest stored
+        # topic. Every storm moves chunk bytes across the host<->device
+        # link at least once, so padding to the cap when topics are short
+        # doubles or quadruples the transfer for nothing.
+        self.bucket = min(16, max_bytes)
         self._rows: Dict[str, int] = {}  # topic -> global row
         self._by_row: List[Optional[str]] = []
         self._free: List[int] = []
+        self._tombstones = 0  # live rows removed (match_many fast path)
         # host chunks; device mirrors uploaded lazily per query
-        self._host_b: List[np.ndarray] = []  # [CHUNK, max_bytes] uint8
-        self._host_l: List[np.ndarray] = []  # [CHUNK] int32
-        self._dev: List[Optional[tuple]] = []  # (bytes, lens) or None=dirty
+        self._host_b: List[np.ndarray] = []  # [CHUNK, bucket] uint8
+        self._dev: List[Optional[object]] = []  # device bytes or None=dirty
+
+    def _grow_bucket(self, need: int) -> None:
+        from emqx_tpu.ops.nfa import _next_pow2
+
+        nb = min(max(self.bucket, _next_pow2(need)), self.max_bytes)
+        if nb == self.bucket:
+            return
+        for c in range(len(self._host_b)):
+            new = np.zeros((CHUNK, nb), np.uint8)
+            new[:, : self.bucket] = self._host_b[c]
+            self._host_b[c] = new
+            self._dev[c] = None
+        self.bucket = nb
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -52,23 +123,24 @@ class DeviceRetainedIndex:
         enc = topic.encode()
         if len(enc) > self.max_bytes or len(T.words(topic)) > self.max_levels:
             return False
+        if len(enc) > self.bucket:
+            self._grow_bucket(len(enc))
         if self._free:
             row = self._free.pop()
             self._by_row[row] = topic
+            self._tombstones -= 1
         else:
             row = len(self._by_row)
             self._by_row.append(topic)
             if row >= len(self._host_b) * CHUNK:
                 self._host_b.append(
-                    np.zeros((CHUNK, self.max_bytes), np.uint8)
+                    np.zeros((CHUNK, self.bucket), np.uint8)
                 )
-                self._host_l.append(np.zeros(CHUNK, np.int32))
                 self._dev.append(None)
         self._rows[topic] = row
         c, i = divmod(row, CHUNK)
         self._host_b[c][i, : len(enc)] = np.frombuffer(enc, np.uint8)
         self._host_b[c][i, len(enc):] = 0
-        self._host_l[c][i] = len(enc)
         self._dev[c] = None  # dirty
         return True
 
@@ -79,25 +151,27 @@ class DeviceRetainedIndex:
         from emqx_tpu.ops.tokenizer import encode_topics
 
         fresh = [t for t in topics if t not in self._rows]
+        longest = 0
         for t in fresh:
             if len(T.words(t)) > self.max_levels:
                 raise ValueError(f"bulk_add: topic too deep: {t!r}")
+            longest = max(longest, len(t.encode()))
+        if longest > self.bucket:
+            self._grow_bucket(longest)
         pos = 0
         while pos < len(fresh):
             # fill the tail of the current chunk
             row0 = len(self._by_row)
             c, i0 = divmod(row0, CHUNK)
             if c >= len(self._host_b):
-                self._host_b.append(np.zeros((CHUNK, self.max_bytes), np.uint8))
-                self._host_l.append(np.zeros(CHUNK, np.int32))
+                self._host_b.append(np.zeros((CHUNK, self.bucket), np.uint8))
                 self._dev.append(None)
             take = min(CHUNK - i0, len(fresh) - pos)
             batch = fresh[pos : pos + take]
-            mat, lens, too_long = encode_topics(batch, self.max_bytes)
+            mat, _lens, too_long = encode_topics(batch, self.bucket)
             if too_long.any():
                 raise ValueError("bulk_add: topic exceeds max_bytes")
             self._host_b[c][i0 : i0 + take] = mat
-            self._host_l[c][i0 : i0 + take] = lens
             self._dev[c] = None
             for k, t in enumerate(batch):
                 self._rows[t] = row0 + k
@@ -111,97 +185,16 @@ class DeviceRetainedIndex:
             return
         self._by_row[row] = None
         self._free.append(row)
+        self._tombstones += 1
         c, i = divmod(row, CHUNK)
-        self._host_l[c][i] = 0  # len-0 rows tokenize to zero words
-        self._host_b[c][i, :] = 0
+        self._host_b[c][i, :] = 0  # len derives 0 -> zero words
         self._dev[c] = None
 
     # -- query ------------------------------------------------------------
-    def match(self, filter_: str) -> Optional[List[str]]:
-        """Retained topics matching `filter_`, or None when the filter
-        itself exceeds the device budget (caller falls back to CPU)."""
+    def _build_tables(self, filters: List[str], floor: int = 0):
+        """-> (idx, fid->filter, launch kwargs) for a storm's filter set."""
         import jax
-        import jax.numpy as jnp
 
-        from emqx_tpu.models.router_model import shape_route_step
-        from emqx_tpu.ops.nfa import _next_pow2
-        from emqx_tpu.ops.route_index import RouteIndex
-
-        if len(T.words(filter_)) > self.max_levels:
-            return None
-        idx = RouteIndex()
-        idx.add(filter_)
-        shape_tables = {
-            k: jax.device_put(v.copy())
-            for k, v in idx.shapes.device_snapshot().items()
-        }
-        with_nfa = idx.residual_count > 0
-        nfa_tables = (
-            {
-                k: jax.device_put(v.copy())
-                for k, v in idx.nfa.device_snapshot().items()
-            }
-            if with_nfa
-            else None
-        )
-        m_active = idx.shapes.m_active()
-        out: List[str] = []
-        outs = []
-        for c in range(len(self._host_b)):
-            if self._dev[c] is None:
-                self._dev[c] = (
-                    jax.device_put(self._host_b[c]),
-                    jax.device_put(self._host_l[c]),
-                )
-            bm, ln = self._dev[c]
-            r = shape_route_step(
-                shape_tables,
-                nfa_tables,
-                None,
-                bm,
-                ln,
-                m_active=m_active,
-                with_nfa=with_nfa,
-                salt=idx.salt,
-                max_levels=self.max_levels,
-            )
-            # dispatch all chunks before reading any back (pipelining)
-            outs.append((c, r["mcount"]))
-        nrows = len(self._by_row)
-        for c, mcount in outs:
-            hit_rows = np.nonzero(np.asarray(mcount))[0]
-            base = c * CHUNK
-            for i in hit_rows:
-                row = base + int(i)
-                # padding rows (len 0) can match plen-0 filters like '#'
-                t = self._by_row[row] if row < nrows else None
-                # host verification: false candidates cost a check, false
-                # replay would cost correctness
-                if t is not None and T.match(t, filter_):
-                    out.append(t)
-        return out
-
-    def match_many(self, filters: List[str]) -> Dict[str, np.ndarray]:
-        """Answer a replay STORM: many wildcard subscribes in one pass.
-
-        All filters enter ONE shape table; each chunk launch matches every
-        stored topic against every filter simultaneously, and the [B, M]
-        result (one fid lane per filter shape — within a shape at most one
-        filter matches a topic, so the lanes are exact) scatters rows to
-        subscribers. Per-storm cost is the same handful of kernel launches
-        a single filter pays — the storm amortizes to ~O(1) passes, vs the
-        reference's O(store) walk PER subscriber.
-
-        Returns {filter: row-index array}; materialize topics lazily with
-        `topic_at`. Unlike `match`, hits are spot-checked (sampled), not
-        exhaustively re-verified — the 2^-64 combined-hash collision class
-        is accepted here, matching the module's differential test gate.
-        """
-        import jax
-        import jax.numpy as jnp
-
-        from emqx_tpu.models.router_model import shape_route_step
-        from emqx_tpu.ops.nfa import _next_pow2
         from emqx_tpu.ops.route_index import RouteIndex
 
         idx = RouteIndex()
@@ -223,63 +216,142 @@ class DeviceRetainedIndex:
             if with_nfa
             else None
         )
-        m_active = idx.shapes.m_active(floor=1)
+        kwargs = dict(
+            m_active=idx.shapes.m_active(floor=floor) if floor else
+            idx.shapes.m_active(),
+            with_nfa=with_nfa,
+            salt=idx.salt,
+            max_levels=self.max_levels,
+            narrow=idx.num_filters_capacity < (1 << 15) - 1,
+        )
+        return idx, fids, shape_tables, nfa_tables, kwargs
+
+    def _launch_all(self, shape_tables, nfa_tables, kwargs) -> list:
+        """Dispatch one storm launch per chunk (lengths derived
+        on-device; no lengths operand), all before any readback."""
+        import jax
+
+        step = _get_retained_step()
         outs = []
         for c in range(len(self._host_b)):
             if self._dev[c] is None:
-                self._dev[c] = (
-                    jax.device_put(self._host_b[c]),
-                    jax.device_put(self._host_l[c]),
-                )
-            bm, ln = self._dev[c]
-            r = shape_route_step(
-                shape_tables,
-                nfa_tables,
-                None,
-                bm,
-                ln,
-                m_active=m_active,
-                with_nfa=with_nfa,
-                salt=idx.salt,
-                max_levels=self.max_levels,
+                self._dev[c] = jax.device_put(self._host_b[c])
+            outs.append(
+                step(shape_tables, nfa_tables, self._dev[c], **kwargs)
             )
-            outs.append((c, r["matched"]))
+        return outs
+
+    def match(self, filter_: str) -> Optional[List[str]]:
+        """Retained topics matching `filter_`, or None when the filter
+        itself exceeds the device budget (caller falls back to CPU)."""
+        if len(T.words(filter_)) > self.max_levels:
+            return None
+        _idx, _fids, shape_tables, nfa_tables, kwargs = self._build_tables(
+            [filter_]
+        )
+        outs = self._launch_all(shape_tables, nfa_tables, kwargs)
         nrows = len(self._by_row)
-        # vectorized liveness mask: tombstoned rows (removed topics) can
-        # still match plen-0 filters like '#' via their zeroed length
-        live = np.zeros(nrows, dtype=bool)
-        for r, t in enumerate(self._by_row):
-            live[r] = t is not None
-        by_fid: Dict[int, List[np.ndarray]] = {}
-        rng = np.random.default_rng(0)
-        checked = 0
-        for c, matched in outs:
-            m = np.asarray(matched)  # [CHUNK, M(+K)]
+        out: List[str] = []
+        for c, matched in enumerate(outs):
+            hit_rows = np.nonzero((np.asarray(matched) >= 0).any(axis=1))[0]
             base = c * CHUNK
-            for lane in range(m.shape[1]):
-                col = m[:, lane]
-                rows = np.nonzero(col >= 0)[0]
-                if not len(rows):
-                    continue
-                rows_g = rows + base
-                keep = rows_g < nrows
-                rows, rows_g = rows[keep], rows_g[keep]
-                keep = live[rows_g]
-                rows, rows_g = rows[keep], rows_g[keep]
-                for fid in np.unique(col[rows]):
-                    sel = rows_g[col[rows] == fid]
-                    by_fid.setdefault(int(fid), []).append(sel)
-                    if checked < 64 and len(sel):  # sampled verification
-                        row = int(rng.choice(sel))
-                        t = self._by_row[row]
-                        f = fids.get(int(fid))
-                        assert f is None or T.match(t, f), (t, f)
-                        checked += 1
+            for i in hit_rows:
+                row = base + int(i)
+                # padding rows (len 0) can match plen-0 filters like '#'
+                t = self._by_row[row] if row < nrows else None
+                # host verification: false candidates cost a check, false
+                # replay would cost correctness
+                if t is not None and T.match(t, filter_):
+                    out.append(t)
+        return out
+
+    def warm(self, filters: List[str]) -> None:
+        """Upload chunks + compile the storm program WITHOUT reading
+        results back (`match_many` works unwarmed, it just pays the XLA
+        compile inline; the program is keyed on the filter table's size
+        bucket, so warm with a representative filter set)."""
+        import jax
+
+        _idx, _f, shape_tables, nfa_tables, kwargs = self._build_tables(
+            filters, floor=1
+        )
+        jax.block_until_ready(
+            self._launch_all(shape_tables, nfa_tables, kwargs)
+        )
+
+    def match_many(self, filters: List[str]) -> Dict[str, np.ndarray]:
+        """Answer a replay STORM: many wildcard subscribes in one pass.
+
+        All filters enter ONE shape table; each chunk launch matches every
+        stored topic against every filter simultaneously, and the [B, M]
+        result (one fid lane per filter shape — within a shape at most one
+        filter matches a topic, so the lanes are exact) scatters rows to
+        subscribers. Per-storm cost is the same handful of kernel launches
+        a single filter pays — the storm amortizes to ~O(1) passes, vs the
+        reference's O(store) walk PER subscriber.
+
+        Returns {filter: row-index array}; materialize topics lazily with
+        `topic_at`. Unlike `match`, hits are spot-checked (sampled), not
+        exhaustively re-verified — the 2^-64 combined-hash collision class
+        is accepted here, matching the module's differential test gate.
+        """
+        if not self._host_b:  # empty index: nothing can match
+            return {f: np.empty(0, np.int64) for f in filters}
+        _idx, fids, shape_tables, nfa_tables, kwargs = self._build_tables(
+            filters, floor=1
+        )
+        outs = self._launch_all(shape_tables, nfa_tables, kwargs)
+        # all chunks dispatched before any readback (launches pipeline);
+        # read back per chunk — moderate transfer sizes behave far better
+        # on the dev tunnel than one giant buffer
+        lanes = int(outs[0].shape[1])
+        flat = np.concatenate([np.asarray(m).ravel() for m in outs])
+        del outs
+        nrows = len(self._by_row)
+        # flat index = (row_g * lanes + lane); group hit rows by fid with
+        # one stable argsort instead of per-chunk unique passes. Dtypes
+        # stay narrow: the sort is the host-side hot spot at 5M+ pairs.
+        nhits = int(np.count_nonzero(flat >= 0))
+        if nhits == flat.size and lanes == 1 and nrows == flat.size:
+            # dense storm (every stored row matched): skip the index
+            # materialization entirely
+            hits = rows_g = np.arange(flat.size, dtype=np.int64)
+        else:
+            hits = np.nonzero(flat >= 0)[0]
+            rows_g = hits if lanes == 1 else hits // lanes
+            oob = rows_g >= nrows  # padding rows can match plen-0 filters
+            if oob.any():
+                keep = ~oob
+                hits, rows_g = hits[keep], rows_g[keep]
+        if self._tombstones:
+            # tombstoned rows (removed topics) can still match plen-0
+            # filters like '#' via their zeroed length
+            live = np.zeros(nrows, dtype=bool)
+            for r, t in enumerate(self._by_row):
+                live[r] = t is not None
+            keep = live[rows_g]
+            hits, rows_g = hits[keep], rows_g[keep]
+        hit_fids = flat[hits]
+        order = np.argsort(hit_fids, kind="stable")
+        rows_g = rows_g[order]
+        hit_fids = hit_fids[order]
+        bounds = np.nonzero(np.diff(hit_fids))[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(hit_fids)]])
         out: Dict[str, np.ndarray] = {f: np.empty(0, np.int64) for f in filters}
-        for fid, chunks in by_fid.items():
-            f = fids.get(fid)
-            if f is not None:
-                out[f] = np.concatenate(chunks)
+        rng = np.random.default_rng(0)
+        for s, e in zip(starts, ends):
+            if e <= s:
+                continue
+            f = fids.get(int(hit_fids[s]))
+            if f is None:
+                continue
+            sel = rows_g[s:e]
+            out[f] = sel
+            # sampled verification (see docstring)
+            row = int(rng.choice(sel))
+            t = self._by_row[row]
+            assert t is None or T.match(t, f), (t, f)
         return out
 
     def topic_at(self, row: int) -> Optional[str]:

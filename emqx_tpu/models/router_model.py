@@ -577,7 +577,12 @@ class DeviceRouter:
         self._nfa_sync = DeviceDeltaSync()
         self._bits_sync = DeviceDeltaSync()
         self._group_sync = DeviceDeltaSync()
-        self._rng = np.random.default_rng(0xEC0)
+        # per-batch entropy seed; itertools.count's next() is atomic
+        # under the GIL, keeping route_prepared free of shared mutable
+        # state (it runs on executor threads)
+        import itertools
+
+        self._rand_seq = itertools.count(0xEC0)
 
     def _device_args(self):
         idx = self.index
@@ -653,16 +658,24 @@ class DeviceRouter:
             lens = np.pad(lens, (0, Bp - B))
         with_groups = group_tables is not None
         if with_groups:
+            # only the inputs this strategy reads are materialized — the
+            # others are cheap zero vectors, not per-topic Python hashing
             ch = np.zeros(Bp, np.uint32)
             if client_hashes is not None:
                 ch[:B] = np.asarray(client_hashes, np.uint32)
-            th = np.fromiter(
-                (stable_hash(t) for t in topics), np.uint32, count=B
-            )
-            th = np.pad(th, (0, Bp - B))
-            rand = self._rng.integers(
-                0, 1 << 32, size=Bp, dtype=np.uint32
-            )
+            if self.share_strategy == 4:  # hash_topic
+                th = np.fromiter(
+                    (stable_hash(t) for t in topics), np.uint32, count=B
+                )
+                th = np.pad(th, (0, Bp - B))
+            else:
+                th = np.zeros(Bp, np.uint32)
+            if self.share_strategy in (0, 2):  # random / sticky fallback
+                rand = np.random.default_rng(
+                    next(self._rand_seq)
+                ).integers(0, 1 << 32, size=Bp, dtype=np.uint32)
+            else:
+                rand = np.zeros(Bp, np.uint32)
         else:
             ch = th = rand = None
         out = shape_route_step(

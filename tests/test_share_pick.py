@@ -79,7 +79,7 @@ def test_device_pick_round_robin_equivalence():
         for sid, v in buckets.items():
             if any(m.payload == str(i).encode() for m in v):
                 order.append(sid)
-    assert order[:3] != order[0] * 3  # not all to one member
+    assert order[:3] != [order[0]] * 3  # not all to one member
 
 
 def test_device_pick_round_robin_advances_across_batches():
