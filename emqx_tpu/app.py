@@ -67,6 +67,17 @@ class BrokerApp:
         )
         self.broker = Broker(router=self.router, hooks=self.hooks)
         self.broker.shared = SharedSub(strategy=c.shared_subscription.strategy)
+        if (
+            c.router.enable_tpu
+            and c.router.mesh_shape[0] > 0
+            and c.router.mesh_shape[1] > 0
+        ):
+            # SPMD serving: the dispatch path runs dist_shape_route_step
+            # over a (dp, tp) device mesh (parallel/mesh.py)
+            from emqx_tpu.parallel.mesh import make_mesh
+
+            dp, tp = c.router.mesh_shape
+            self.broker.mesh = make_mesh(dp * tp, tp=tp)
         self.cm = ChannelManager(self.broker)
         self.channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
         # populated below once authn config is read (SCRAM enhanced auth)

@@ -73,6 +73,7 @@ class Broker:
         self._slot_subs: List[Optional[Subscriber]] = []
         self._free_slots: List[int] = []
         self._device = None  # lazy DeviceRouter
+        self.mesh = None  # jax Mesh => SPMD serving (set by app/tests)
         self.ingest = None  # BatchIngest, attached by the app
 
     # -- subscribe side ---------------------------------------------------
@@ -128,17 +129,9 @@ class Broker:
                 g = self.shared.group(real, group)
                 if gid is not None and g is not None:
                     self.grouptab.set_len(gid, len(g.members))
-                    # a member leaving shifts indices: recompute the
-                    # stored sticky index from the pinned sid so the pin
-                    # stays on the same live member (not whoever slid
-                    # into the old index)
-                    sids = list(g.members.keys())
-                    if g.sticky_sid in sids:
-                        self.grouptab.set_sticky(
-                            gid, sids.index(g.sticky_sid)
-                        )
-                    else:
-                        self.grouptab.set_sticky(gid, -1)
+                    # a member leaving shifts indices: re-derive the pin
+                    # from the sid so it stays on the same live member
+                    self.grouptab.repin(gid, g.members.keys(), g.sticky_sid)
             return removed
         entry = self._subs.get(real)
         if not entry or sid not in entry:
@@ -284,6 +277,7 @@ class Broker:
                 self.router.matcher_config,
                 grouptab=self.grouptab,
                 share_strategy=self.shared.strategy,
+                mesh=self.mesh,
             )
         return self._device
 
@@ -397,11 +391,7 @@ class Broker:
                 continue
             self.grouptab.set_rr(gid, g.rr_index)
             if self.shared.strategy == "sticky" and g.sticky_sid is not None:
-                sids = list(g.members.keys())
-                if g.sticky_sid in sids:
-                    self.grouptab.set_sticky(
-                        gid, sids.index(g.sticky_sid)
-                    )
+                self.grouptab.repin(gid, g.members.keys(), g.sticky_sid)
 
     def dispatch(self, filters: List[str], msg: Message) -> int:
         """Deliver to local subscribers of pre-matched filters.

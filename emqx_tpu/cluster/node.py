@@ -294,7 +294,13 @@ class ClusterNode:
         return self.broker.dispatch(filters, msg)
 
     def _proto_forward_batch(self, batch) -> int:
-        return sum(self.broker.dispatch(fs, m) for m, fs in batch)
+        """Inbound batched forward: ride the broker's device batch path
+        (re-match + bitmap fan-out on the receiving node's own mirror,
+        emqx_broker.erl:278-293 forward -> dispatch). Small batches fall
+        through to the per-message host dispatch inside
+        dispatch_batch_folded itself."""
+        msgs = [m for m, _fs in batch]
+        return sum(self.broker.dispatch_batch_folded(msgs))
 
     # -- channel registry (emqx_cm_registry parity) ------------------------
     def register_channel(self, client_id: str, sid: str) -> None:

@@ -63,6 +63,10 @@ class RouterConfig:
     ingest_enable: bool = True
     ingest_window_us: int = 1000
     ingest_max_batch: int = 4096
+    # SPMD serving over a device mesh: [dp, tp] axis sizes. [0, 0] (the
+    # default) = single-device serving; set e.g. [4, 2] on an 8-chip
+    # host to run dist_shape_route_step on the live dispatch path.
+    mesh_shape: List[int] = field(default_factory=lambda: [0, 0])
 
 
 @dataclass
@@ -491,6 +495,19 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.authz.no_match not in ("allow", "deny"):
         raise ConfigError("authz.no_match must be allow|deny")
+    ms = cfg.router.mesh_shape
+    if len(ms) != 2 or any(not isinstance(x, int) or x < 0 for x in ms):
+        raise ConfigError("router.mesh_shape must be [dp, tp] with ints >= 0")
+    dp, tp = ms
+    if (dp == 0) != (tp == 0):
+        raise ConfigError(
+            "router.mesh_shape: dp and tp must both be 0 (off) or both >= 1"
+        )
+    if tp and (tp & (tp - 1)):
+        raise ConfigError(
+            "router.mesh_shape: tp must be a power of two (subscriber "
+            "bitmap lanes are power-of-two words)"
+        )
     from emqx_tpu.broker.limiter import TYPES as _LIMITER_TYPES
 
     for lt in cfg.limiter:

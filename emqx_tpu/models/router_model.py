@@ -346,6 +346,16 @@ class GroupTable:
             self.group_sticky[gid] = idx
             self._log("group_sticky", gid, idx)
 
+    def repin(self, gid: int, member_sids, sticky_sid) -> None:
+        """Recompute the device sticky index from the pinned sid (the
+        ONE place the sid->index mapping convention lives; membership
+        changes shift indices, so a raw index cannot be kept)."""
+        sids = list(member_sids)
+        if sticky_sid in sids:
+            self.set_sticky(gid, sids.index(sticky_sid))
+        else:
+            self.set_sticky(gid, -1)
+
     def drop_group(self, fid: int, real: str, gname: str) -> None:
         gid = self._gids.pop((real, gname), None)
         if gid is None:
@@ -559,7 +569,13 @@ class DeviceRouter:
         config=None,
         grouptab: Optional[GroupTable] = None,
         share_strategy: str = "round_robin",
+        mesh=None,
     ):
+        """`mesh`: a jax.sharding.Mesh with ("dp", "tp") axes — when set,
+        batches execute the SPMD dist_shape_route_step (tables replicated,
+        topic batch sharded over dp, subscriber lanes over tp, stats
+        psum'd over ICI; parallel/mesh.py). $share picks stay host-side in
+        mesh mode (the dist step serves the fan-out half only)."""
         import dataclasses
 
         from emqx_tpu.ops.matcher import MatcherConfig
@@ -568,6 +584,7 @@ class DeviceRouter:
         self.index = index
         self.subtab = subtab  # None => match-only (no fan-out bitmaps)
         self.grouptab = grouptab  # None => host-side $share pick
+        self.mesh = mesh
         self.share_strategy = STRATEGY_IDS.get(share_strategy, 1)
         config = config or MatcherConfig()
         if config.probes < MAX_PROBES:
@@ -577,6 +594,7 @@ class DeviceRouter:
         self._nfa_sync = DeviceDeltaSync()
         self._bits_sync = DeviceDeltaSync()
         self._group_sync = DeviceDeltaSync()
+        self._mesh_placed = None  # (version key, placed tables) cache
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
         # state (it runs on executor threads)
@@ -656,6 +674,11 @@ class DeviceRouter:
         if Bp != B:
             mat = np.pad(mat, ((0, Bp - B), (0, 0)))
             lens = np.pad(lens, (0, Bp - B))
+        if self.mesh is not None and bits is not None:
+            return self._route_mesh(
+                shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
+                mat, lens, B, too_long,
+            )
         with_groups = group_tables is not None
         if with_groups:
             # only the inputs this strategy reads are materialized — the
@@ -714,6 +737,75 @@ class DeviceRouter:
         # buffers, and the dispatch path reinterprets rows as uint8
         bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
         return matched, mcount, flags, bitmaps, picks
+
+    def _route_mesh(
+        self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
+        mat, lens, B, too_long,
+    ):
+        """SPMD serving: the batch rides dist_shape_route_step over the
+        device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
+        dryrun gate compiles). Inputs are laid out with the canonical
+        shardings; XLA inserts the ICI collectives.
+
+        Table placements are CACHED keyed on the index/subtab versions —
+        replicating the full bitmap matrix across the mesh per batch
+        would dwarf the kernel; only changed state is re-placed."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from emqx_tpu.parallel.mesh import dist_shape_route_step
+
+        cfg = self.config
+        dp = self.mesh.shape["dp"]
+        tp = self.mesh.shape["tp"]
+        if bits.shape[1] % tp:
+            raise ValueError(
+                f"subscriber bitmap width {bits.shape[1]} not divisible "
+                f"by mesh tp={tp}; use a power-of-two tp"
+            )
+        # batch rows must split evenly over dp (shard_map constraint);
+        # mat was padded to a pow2 >= 64 — round up to a dp multiple for
+        # non-pow2 dp sizes
+        rows = mat.shape[0]
+        if rows % dp:
+            extra = dp - rows % dp
+            mat = np.pad(mat, ((0, extra), (0, 0)))
+            lens = np.pad(lens, (0, extra))
+        key = (
+            self.index.version,
+            self.subtab.version if self.subtab is not None else -1,
+        )
+        if self._mesh_placed is None or self._mesh_placed[0] != key:
+            repl = NamedSharding(self.mesh, P())
+            st = {k: jax.device_put(v, repl) for k, v in shape_tables.items()}
+            nt = (
+                {k: jax.device_put(v, repl) for k, v in nfa_tables.items()}
+                if nfa_tables is not None
+                else None
+            )
+            sb = jax.device_put(bits, NamedSharding(self.mesh, P(None, "tp")))
+            self._mesh_placed = (key, st, nt, sb)
+        _, st, nt, sb = self._mesh_placed
+        bm = jax.device_put(mat, NamedSharding(self.mesh, P("dp", None)))
+        ln = jax.device_put(lens, NamedSharding(self.mesh, P("dp")))
+        out = dist_shape_route_step(
+            self.mesh,
+            st,
+            nt,
+            sb,
+            bm,
+            ln,
+            m_active=m_active,
+            salt=salt,
+            max_levels=cfg.max_levels,
+            frontier=cfg.frontier,
+            max_matches=cfg.max_matches,
+            probes=cfg.probes,
+        )
+        matched = np.asarray(out["matched"][:B])
+        mcount = np.asarray(out["mcount"][:B])
+        flags = np.asarray(out["flags"][:B]) | too_long
+        bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
+        return matched, mcount, flags, bitmaps, None
 
     def match_batch(
         self, topics: Sequence[str], fallback=None

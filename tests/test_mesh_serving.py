@@ -1,0 +1,214 @@
+"""The mesh-serving seam: a LIVE broker whose DeviceRouter executes the
+SPMD dist step, and a cluster whose forward path rides the device batch
+dispatch (VERDICT r2 weak #7 / SURVEY §2.4 TPU mapping).
+
+Runs on the virtual 8-device CPU mesh from conftest; the same layout the
+driver's dryrun_multichip gate compiles (emqx_broker.erl:278-293 is the
+reference forward regime).
+"""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.cluster.node import make_cluster
+from emqx_tpu.mqtt import packet as pkt
+
+
+def make_mesh():
+    from emqx_tpu.parallel.mesh import make_mesh as mm
+
+    return mm(8)
+
+
+def collector():
+    got = []
+    return got, lambda m, o: got.append(m)
+
+
+def mesh_broker(min_batch=8):
+    b = Broker()
+    b.mesh = make_mesh()
+    b.router.enable_tpu = True
+    b.router.min_tpu_batch = min_batch
+    return b
+
+
+def test_live_broker_serves_through_dist_step():
+    """Subscribe real subscribers, push a batch through
+    dispatch_batch_folded, and verify the mesh path delivered and the
+    device counter moved."""
+    b = mesh_broker()
+    buckets = {}
+    for i in range(16):
+        got, deliver = collector()
+        buckets[i] = got
+        b.subscribe(f"s{i}", f"c{i}", f"dev/{i}/+/t", pkt.SubOpts(), deliver)
+    wide, wdeliver = collector()
+    b.subscribe("sw", "cw", "dev/#", pkt.SubOpts(), wdeliver)
+
+    msgs = [Message(topic=f"dev/{i % 16}/x/t", payload=str(i).encode())
+            for i in range(64)]
+    n = b.dispatch_batch_folded(msgs)
+    assert sum(n) == 64 * 2  # per-device sub + wildcard sub
+    for i in range(16):
+        assert len(buckets[i]) == 4, (i, len(buckets[i]))
+    assert len(wide) == 64
+    assert b.metrics.get("messages.routed.device") == 64
+    # the router genuinely ran in mesh mode
+    assert b._device.mesh is not None
+
+
+def test_mesh_serving_equivalence_vs_host_path():
+    """Same subs + messages on a mesh broker and a host-path broker must
+    deliver identically."""
+    mb = mesh_broker()
+    hb = Broker()
+    hb.router.enable_tpu = False
+    got_m, got_h = {}, {}
+    for i in range(8):
+        for tag, b, got in (("m", mb, got_m), ("h", hb, got_h)):
+            bucket, deliver = collector()
+            got[i] = bucket
+            b.subscribe(f"s{i}", f"c{i}", f"a/{i}/#", pkt.SubOpts(), deliver)
+    msgs = [Message(topic=f"a/{i % 8}/leaf/{i}") for i in range(32)]
+    nm = mb.dispatch_batch_folded(msgs)
+    nh = hb.dispatch_batch_folded(msgs)
+    assert nm == nh
+    for i in range(8):
+        assert [m.topic for m in got_m[i]] == [m.topic for m in got_h[i]]
+
+
+def test_mesh_serving_fallback_rows():
+    """Rows the kernel flags (too deep) must fall back per-row to the CPU
+    path, still on the mesh broker."""
+    b = mesh_broker()
+    got, deliver = collector()
+    b.subscribe("s1", "c1", "deep/#", pkt.SubOpts(), deliver)
+    deep = "deep/" + "/".join(str(i) for i in range(20))  # > max_levels
+    msgs = [Message(topic=deep)] * 4 + [
+        Message(topic="deep/ok") for _ in range(12)
+    ]
+    n = b.dispatch_batch_folded(msgs)
+    assert sum(n) == 16
+    assert len(got) == 16
+    assert b.metrics.get("messages.routed.device_fallback") == 4
+
+
+def test_cluster_forward_rides_device_batch_path():
+    """Two in-process nodes: node A (owner) forwards a batch to node B;
+    B's forward handler dispatches through the device batch path and
+    messages.routed.device increments on BOTH nodes."""
+    bus, (a, b, c), clock = None, (None, None, None), None
+    _, nodes = make_cluster(2)
+    a, b = nodes
+    for n in nodes:
+        n.broker.mesh = make_mesh()
+        n.broker.router.enable_tpu = True
+        n.broker.router.min_tpu_batch = 8
+
+    got_b, deliver_b = collector()
+    b.subscribe("sb", "cb", "f/+/x", pkt.SubOpts(), deliver_b)
+    got_a, deliver_a = collector()
+    a.subscribe("sa", "ca", "f/+/x", pkt.SubOpts(), deliver_a)
+    b.flush()
+    a.flush()
+    assert a.routes.has_route("f/+/x")
+
+    msgs = [Message(topic=f"f/{i}/x", payload=str(i).encode())
+            for i in range(32)]
+    total = a.publish_batch(msgs)
+    a.flush()  # drain the async forward to B
+    assert total == 64  # 32 local on A + 32 forwarded to B
+    assert len(got_a) == 32
+    assert len(got_b) == 32
+    assert [m.payload for m in got_b] == [str(i).encode() for i in range(32)]
+    # the forward batch rode B's device path
+    assert b.broker.metrics.get("messages.routed.device") >= 32
+    for n in nodes:
+        n.rpc.stop()
+
+
+def test_cluster_forward_device_and_shared_groups():
+    """Forwarded batches hitting $share groups on the receiver still
+    deliver one-per-group through the device path's host pick."""
+    _, nodes = make_cluster(2)
+    a, b = nodes
+    b.broker.mesh = make_mesh()
+    b.broker.router.enable_tpu = True
+    b.broker.router.min_tpu_batch = 8
+
+    g1, d1 = collector()
+    g2, d2 = collector()
+    b.subscribe("m1", "m1", "$share/g/q/t", pkt.SubOpts(), d1)
+    b.subscribe("m2", "m2", "$share/g/q/t", pkt.SubOpts(), d2)
+    b.flush()
+    assert a.routes.has_route("q/t")
+
+    msgs = [Message(topic="q/t", payload=str(i).encode()) for i in range(16)]
+    a.publish_batch(msgs)
+    a.flush()
+    assert len(g1) + len(g2) == 16  # exactly one delivery per message
+    assert len(g1) > 0 and len(g2) > 0  # load-balanced
+    for n in nodes:
+        n.rpc.stop()
+
+
+def test_app_config_enables_mesh_serving():
+    """router.mesh_shape wires SPMD serving into a full BrokerApp."""
+    import asyncio
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+
+    async def run():
+        app = BrokerApp(load_config({
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"enable": False},
+            "router": {"mesh_shape": [4, 2], "min_tpu_batch": 8},
+        }))
+        await app.start()
+        try:
+            assert app.broker.mesh is not None
+            assert app.broker.mesh.shape == {"dp": 4, "tp": 2}
+            got, deliver = collector()
+            app.broker.subscribe("s", "c", "m/#", pkt.SubOpts(), deliver)
+            msgs = [Message(topic=f"m/{i}") for i in range(16)]
+            n = app.broker.dispatch_batch_folded(msgs)
+            assert sum(n) == 16 and len(got) == 16
+            assert app.broker.metrics.get("messages.routed.device") >= 16
+        finally:
+            await app.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 120))
+
+
+def test_mesh_shape_config_validation():
+    from emqx_tpu.config.schema import ConfigError, load_config
+
+    with pytest.raises(ConfigError):
+        load_config({"router": {"mesh_shape": [4, 0]}})
+    with pytest.raises(ConfigError):
+        load_config({"router": {"mesh_shape": [4, 3]}})
+    with pytest.raises(ConfigError):
+        load_config({"router": {"mesh_shape": [4]}})
+    load_config({"router": {"mesh_shape": [0, 0]}})  # off is fine
+    load_config({"router": {"mesh_shape": [4, 2]}})
+
+
+def test_mesh_table_placement_cached_across_batches():
+    """Unchanged tables are NOT re-placed across batches; a subscribe
+    invalidates the cache."""
+    b = mesh_broker()
+    got, deliver = collector()
+    b.subscribe("s1", "c1", "k/#", pkt.SubOpts(), deliver)
+    b.dispatch_batch_folded([Message(topic=f"k/{i}") for i in range(8)])
+    placed1 = b._device._mesh_placed
+    assert placed1 is not None
+    b.dispatch_batch_folded([Message(topic=f"k/{i}") for i in range(8)])
+    assert b._device._mesh_placed is placed1  # cache hit
+    b.subscribe("s2", "c2", "k2/#", pkt.SubOpts(), lambda m, o: None)
+    b.dispatch_batch_folded([Message(topic=f"k/{i}") for i in range(8)])
+    assert b._device._mesh_placed is not placed1  # invalidated
+    assert len(got) == 24
