@@ -114,7 +114,81 @@ def build_config(name, rng):
         nums = rng.integers(0, 1000, size=BATCH * TIMED_BATCHES)
         topics = [f"device/{i}/mid/{j}/leaf" for i, j in zip(ids, nums)]
         return filters, topics, 8
+    if name == "mixed_10m":
+        return _build_mixed_10m(rng)
     raise ValueError(name)
+
+
+def _build_mixed_10m(rng):
+    """Shape-DIVERSE 10M-subscription table (r2 verdict item 2):
+
+    - 66 distinct wildcard shapes over an 8-level space: 2 dense overlay
+      families every topic matches (guaranteeing matches/topic >= 2) +
+      64 sparse mask families ('+' and '#' in varying positions/depths)
+    - the last 2 families overflow the 64-shape device table, forcing
+      the residual-NFA engine onto the hot path for every batch
+    - publish topics Zipf over the FULL id space
+    """
+    n_topics = BATCH * TIMED_BATCHES
+    A, C = 10_000, 100
+    filters = [f"v/{a}/#" for a in range(A)]  # dense overlay 1
+    filters += [  # dense overlay 2: matches every topic with c < C
+        f"v/{a}/+/{c}/#" for a in range(A) for c in range(C)
+    ]
+    # 64 sparse mask families over levels [v, a, b, c, d, e, f, g].
+    # Validity: every '+' position must be < depth (a wildcard past the
+    # filter's last level silently collapses the shape into a shallower
+    # family); families dedupe on (positions, depth). (2,) at depth 4
+    # is excluded — it IS dense overlay 2's shape.
+    cands = []
+    for plus_pos in (1, 2, 3, 4, 5, 6):
+        for depth in (4, 5, 6, 7, 8):
+            cands.append(((plus_pos,), depth))
+    for combo in ((1, 3), (2, 4), (1, 4), (2, 5), (3, 5), (1, 5), (3, 6),
+                  (4, 6), (2, 6), (1, 6)):
+        for depth in (6, 7, 8):
+            cands.append((combo, depth))
+    for combo in ((1, 3, 5), (2, 4, 6), (1, 2, 4), (3, 4, 6), (1, 4, 6),
+                  (2, 3, 5), (1, 3, 6), (2, 4, 5), (1, 2, 5), (2, 3, 6)):
+        for depth in (7, 8):
+            cands.append((combo, depth))
+    seen = {(frozenset((2,)), 4)}  # overlay 2's shape
+    masks = []
+    for plus, depth in cands:
+        key = (frozenset(plus), depth)
+        if max(plus) < depth and key not in seen:
+            seen.add(key)
+            masks.append((tuple(plus), depth))
+    masks = masks[:64]
+    assert len(masks) == 64, len(masks)
+    per_family = (10_000_000 - len(filters)) // 64
+    # last two families stay smaller so the residual NFA (where they
+    # land after the 64-shape device table fills) builds quickly
+    sizes = [per_family] * 62 + [50_000, 50_000]
+    id_digits = [A, 50, C, 40, 30, 20, 10]  # per-level id spaces
+    for fam, ((plus, depth), sz) in enumerate(zip(masks, sizes)):
+        ha = rng.integers(0, 1 << 62, size=sz, dtype=np.int64)
+        cols = {}
+        for lvl in range(1, depth):
+            if lvl in plus:
+                continue
+            cols[lvl] = (ha + fam * 1_000_003 + lvl * 7919) % id_digits[
+                min(lvl - 1, 6)
+            ]
+        for k in range(sz):
+            parts = ["v"]
+            for lvl in range(1, depth):
+                parts.append("+" if lvl in plus else str(cols[lvl][k]))
+            if depth < 8:
+                parts.append("#")
+            filters.append("/".join(parts))
+    aa = _zipf_ids(rng, n_topics, A)
+    rest = [rng.integers(0, d, size=n_topics) for d in id_digits[1:]]
+    topics = [
+        f"v/{a}/{b}/{c}/{d}/{e}/{f}/{g}"
+        for a, b, c, d, e, f, g in zip(aa, *rest)
+    ]
+    return filters, topics, 2
 
 
 def bench_config(name, rng, measure_updates=False):
@@ -139,6 +213,10 @@ def bench_config(name, rng, measure_updates=False):
     )
     subs.bulk_add(fid_arr, slot_arr)
     insert_s = time.perf_counter() - t0
+    if name == "mixed_10m":
+        # the workload's whole point: full shape table + live residual NFA
+        assert index.shapes.m_active() == 64, index.shapes.m_active()
+        assert index.residual_count > 0, "residual NFA not engaged"
 
     shape_tables = {
         k: jax.device_put(v.copy())
@@ -193,17 +271,25 @@ def bench_config(name, rng, measure_updates=False):
     jax.block_until_ready(out)
     _mark(f"{name}: compiled; timing")
 
-    # sustained throughput: keep only tiny stat scalars per batch
+    # sustained throughput: keep only tiny stat scalars per batch.
+    # Three independent timing loops, median reported — the r2 verdict
+    # flagged a 2x builder-vs-driver swing on single measurements.
+    rates = []
     scalars = []
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        for bm, ln in stage:
-            o = step(bm, ln)
-            scalars.append((o["stats"]["matches"], o["stats"]["fanout_bits"]))
-    jax.block_until_ready(scalars[-1])
-    tpu_s = time.perf_counter() - t0
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        for _ in range(REPEATS):
+            for bm, ln in stage:
+                o = step(bm, ln)
+                scalars.append(
+                    (o["stats"]["matches"], o["stats"]["fanout_bits"])
+                )
+        jax.block_until_ready(scalars[-1])
+        tpu_s = time.perf_counter() - t0
+        rates.append(BATCH * TIMED_BATCHES * REPEATS / tpu_s)
+        del scalars[: -TIMED_BATCHES * REPEATS]
+    tpu_rps = float(np.median(rates))
     n_lookups = BATCH * TIMED_BATCHES * REPEATS
-    tpu_rps = n_lookups / tpu_s
 
     _mark(f"{name}: throughput done; latency")
     # per-batch latency: serialized dispatch + readback (pays tunnel RTT)
@@ -240,9 +326,13 @@ def bench_config(name, rng, measure_updates=False):
     )
 
     _mark(f"{name}: readbacks done; cpu baseline")
-    # correctness spot-check vs the CPU trie + flags clean
+    # correctness spot-check vs the CPU trie; flagged rows (frontier /
+    # depth overflow) fall back per-row on the serving path, so they are
+    # excluded from the device-vs-trie count comparison and reported
     o = step(*stage[0])
-    assert not bool(np.asarray(o["flags"]).any()), name
+    flags0 = np.asarray(o["flags"])
+    flag_rate = float(flags0.mean())
+    assert flag_rate < 0.01, (name, flag_rate)
     from emqx_tpu.broker.trie import TopicTrie
 
     trie = TopicTrie()
@@ -255,12 +345,16 @@ def bench_config(name, rng, measure_updates=False):
     cpu_rps = len(sample) / cpu_s
     # matched counts must agree with the trie on a sample of the workload
     mcount0 = np.asarray(o["mcount"])
-    trie_counts = [len(trie.match(t)) for t in topics[:256]]
-    assert list(mcount0[:256]) == trie_counts, name
+    for i in range(256):
+        if not flags0[i]:
+            assert mcount0[i] == len(trie.match(topics[i])), (name, i)
 
     del stage, shape_tables, nfa_tables, sub_bitmaps
     out = {
         "subscriptions": len(filters) * spf,
+        "distinct_shapes": index.shapes.m_active(),
+        "residual_nfa_filters": index.residual_count,
+        "flagged_row_rate": round(flag_rate, 5),
         "tpu_rps": round(tpu_rps, 1),
         "cpu_trie_rps": round(cpu_rps, 1),
         "speedup": round(tpu_rps / cpu_rps, 2),
@@ -278,7 +372,18 @@ def bench_config(name, rng, measure_updates=False):
     return out
 
 
-CONFIGS = ["exact_1k", "plus_100k", "mixed_1m", "share_10m", "retained_5m"]
+# share_10m (the headline) runs FIRST in its own fresh process — the
+# dev tunnel degrades as a process accumulates readbacks, and the gate
+# capture must match what a fresh run reports (r2 verdict item 1a)
+CONFIGS = [
+    "share_10m",
+    "mixed_10m",
+    "exact_1k",
+    "plus_100k",
+    "mixed_1m",
+    "retained_5m",
+    "e2e_serving",
+]
 
 
 def bench_retained(rng):
@@ -362,13 +467,175 @@ def bench_retained(rng):
 
 
 
+def bench_e2e() -> dict:
+    """End-to-end SERVING throughput (r2 verdict item 1b): concurrent
+    socket publishers -> MQTT codec -> ingest batch window -> device
+    route_step -> session delivery, measured at the subscriber sockets.
+    Reference regime: emqx_broker.erl:204-215 is end-to-end per message.
+
+    Reports e2e_msgs_per_s plus per-message latency percentiles that
+    INCLUDE the ingest batch window (publish send -> subscriber recv).
+    """
+    import asyncio
+    import struct as _struct
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+    from emqx_tpu.mqtt.client import Client
+
+    N_PUB = 24
+    N_SUB = 8
+    PER_PUB = 2000  # 48k timed messages
+    WARM = 128
+
+    async def run():
+        app = BrokerApp(load_config({
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"enable": False},
+        }))
+        await app.start()
+        port = list(app.listeners.list().values())[0].port
+        subs = []
+        for i in range(N_SUB):
+            # keepalive 0: subscribers only receive, and the in-repo
+            # client has no auto-ping loop — a >90s run would otherwise
+            # get them keepalive-kicked mid-measurement
+            c = Client(client_id=f"bench-sub-{i}", keepalive=0)
+            await c.connect("127.0.0.1", port)
+            await c.subscribe("bench/+/t", qos=0)
+            subs.append(c)
+        pubs = []
+        for i in range(N_PUB):
+            c = Client(client_id=f"bench-pub-{i}", keepalive=0)
+            await c.connect("127.0.0.1", port)
+            pubs.append(c)
+        _mark("e2e: pre-compiling every ingest batch bucket")
+        # the ingest window produces variable batch sizes, padded to pow2
+        # buckets — each NEW bucket is a fresh XLA compile (~40-60s on a
+        # cold chip). Compile them all BEFORE the timed run so no
+        # mid-run stall starves the subscribers.
+        from emqx_tpu.broker.message import Message as _Msg
+
+        size = app.broker.router.min_tpu_batch
+        while size <= app.config.router.ingest_max_batch:
+            app.broker.dispatch_batch_folded(
+                [_Msg(topic="warmup/bucket") for _ in range(size)]
+            )
+            await asyncio.sleep(0)
+            size *= 2
+        _mark("e2e: warm volley through the sockets")
+        await asyncio.wait_for(asyncio.gather(*[
+            p.publish(f"bench/{i}/t", b"warm", qos=0)
+            for i, p in enumerate(pubs) for _ in range(WARM // N_PUB + 1)
+        ]), 300)
+
+        async def drain(c, stop_at):
+            got = 0
+            lats = []
+            while got < stop_at:
+                m = await asyncio.wait_for(c.recv(), 300)
+                if m.payload == b"warm":
+                    continue
+                (ts,) = _struct.unpack("!d", m.payload[:8])
+                lats.append(time.perf_counter() - ts)
+                got += 1
+            return got, lats
+
+        total = N_PUB * PER_PUB
+        _mark(f"e2e: timed run ({total} msgs x {N_SUB} subscribers)")
+
+        async def pump(p, i):
+            for j in range(PER_PUB):
+                await p.publish(
+                    f"bench/{i}/t",
+                    _struct.pack("!d", time.perf_counter()) + b"x",
+                    qos=0,
+                )
+                if j % 200 == 0:  # yield so the loop serves deliveries
+                    await asyncio.sleep(0)
+
+        t0 = time.perf_counter()
+        results = await asyncio.wait_for(
+            asyncio.gather(
+                *[pump(p, i) for i, p in enumerate(pubs)],
+                *[drain(c, total) for c in subs],
+            ),
+            1500,
+        )
+        wall = time.perf_counter() - t0
+        # the flood phase measures sustainable throughput; its latencies
+        # are queue backlog, not serving latency. The PACED phase below
+        # measures real socket-to-socket latency (incl. the ingest
+        # window) at ~50% of the sustained rate.
+        _mark("e2e: paced latency phase")
+        rate = total / wall
+        interval = 1.0 / max(rate * 0.5 / N_PUB, 1.0)
+        PACED = 400
+
+        async def paced_pump(p, i):
+            for _ in range(PACED // N_PUB):
+                await p.publish(
+                    f"bench/{i}/t",
+                    _struct.pack("!d", time.perf_counter()) + b"p",
+                    qos=0,
+                )
+                await asyncio.sleep(interval)
+
+        paced = await asyncio.wait_for(
+            asyncio.gather(
+                *[paced_pump(p, i) for i, p in enumerate(pubs)],
+                *[
+                    drain(c, (PACED // N_PUB) * N_PUB)
+                    for c in subs
+                ],
+            ),
+            600,
+        )
+        lat_all = []
+        for r in paced[N_PUB:]:
+            lat_all.extend(r[1])
+        lats = np.array(lat_all)
+        for c in subs + pubs:
+            await c.disconnect()
+        met = app.broker.metrics
+        out = {
+            "publishers": N_PUB,
+            "subscribers": N_SUB,
+            "messages": total,
+            "deliveries": total * N_SUB,
+            "e2e_msgs_per_s": round(rate, 1),
+            "e2e_deliveries_per_s": round(total * N_SUB / wall, 1),
+            "e2e_paced_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2),
+            "e2e_paced_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+            "routed_device": met.get("messages.routed.device"),
+            "routed_device_fallback": met.get(
+                "messages.routed.device_fallback"
+            ),
+            "note": (
+                "single-core python host: throughput is connection-layer "
+                "bound (serialize+deliver per subscriber), not kernel "
+                "bound; paced latencies include the ingest batch window"
+            ),
+        }
+        await app.stop()
+        return out
+
+    return asyncio.run(run())
+
+
 def run_one(name: str) -> None:
     """Child-process entry: one config, one JSON line on stdout."""
     rng = np.random.default_rng(42 + CONFIGS.index(name))
     if name == "retained_5m":
         res = bench_retained(rng)
+    elif name == "e2e_serving":
+        res = bench_e2e()
     else:
-        res = bench_config(name, rng, measure_updates=(name == "mixed_1m"))
+        res = bench_config(
+            name,
+            rng,
+            measure_updates=name in ("mixed_1m", "mixed_10m"),
+        )
     print(json.dumps(res))
 
 
@@ -412,12 +679,19 @@ def main() -> None:
                     "baseline": "cpu_trie_python_in_process",
                     "device": str(jax.devices()[0]),
                     "batch": BATCH,
+                    "e2e_msgs_per_s": results["e2e_serving"][
+                        "e2e_msgs_per_s"
+                    ],
+                    "mixed_10m_tpu_rps": results["mixed_10m"]["tpu_rps"],
                     "note": (
-                        "per-batch p50/p99 include dev-tunnel dispatch "
-                        "overhead; production p99 = batch window + kernel "
-                        "time. One process per config (tunnel degrades "
-                        "after readback bursts). All 5 BASELINE configs "
-                        "swept (retained_5m = config 5 replay storm)."
+                        "headline = median of 3 timing loops, first config "
+                        "in a fresh process (tunnel degrades after readback "
+                        "bursts; one process per config). per-batch p50/p99 "
+                        "include dev-tunnel dispatch overhead; e2e_serving "
+                        "latencies are socket-to-socket incl. the ingest "
+                        "window. All 5 BASELINE configs swept plus "
+                        "mixed_10m (66-shape diverse 10M table, residual "
+                        "NFA forced) and e2e_serving."
                     ),
                     "configs": results,
                 },

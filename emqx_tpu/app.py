@@ -54,6 +54,10 @@ class BrokerApp:
         if c.node.name:
             set_node_name(c.node.name)
 
+        from emqx_tpu.observe.logfmt import setup_logging
+
+        setup_logging(c.log.level, c.log.formatter, c.log.to_file)
+
         self.hooks = Hooks()
         self.router = Router(
             matcher_config=MatcherConfig(
@@ -595,10 +599,17 @@ class BrokerApp:
                 self.flapping.window = cfg.flapping.window_time
                 self.flapping.ban_time = cfg.flapping.ban_time
 
+        def apply_log(cfg: AppConfig) -> None:
+            from emqx_tpu.observe import logfmt
+
+            logfmt.set_formatter(cfg.log.formatter)
+            logfmt.set_level(cfg.log.level)
+
         h.register("mqtt", apply_mqtt)
         h.register("limiter", apply_limiter)
         h.register("authz", apply_authz)
         h.register("flapping", apply_flapping)
+        h.register("log", apply_log)
         return h
 
     def _plugin_manager(self):

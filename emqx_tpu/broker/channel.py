@@ -406,9 +406,13 @@ class Channel:
         return self.session is not session or self.state == "disconnected"
 
     def _connack_error(self, rc: int) -> None:
+        from emqx_tpu.mqtt import reason_codes as RC
+
         code = rc if self.version == pkt.MQTT_V5 else pkt.connack_compat(rc)
         self._send(pkt.Connack(session_present=False, reason_code=code))
-        self._close("connack_error_%#x" % rc)
+        # close reason carries the spec name (emqx_reason_codes:name/1),
+        # which is what traces / client.disconnected hooks surface
+        self._close(f"connack_{RC.name(rc)}")
 
     # -- PUBLISH ----------------------------------------------------------
     async def _in_publish(self, p: pkt.Publish) -> None:

@@ -327,6 +327,16 @@ class LicenseConfig:
 
 
 @dataclass
+class LogConfig:
+    """Structured logging (``log`` config root; emqx_logger_jsonfmt /
+    textfmt analog). formatter switches at runtime via /configs/log."""
+
+    level: str = "info"  # debug|info|warning|error
+    formatter: str = "text"  # text | json
+    to_file: str = ""  # empty = stderr
+
+
+@dataclass
 class GatewaySpec:
     """One protocol gateway instance (emqx_gateway config analog).
     type: stomp | mqttsn | exproto | coap | lwm2m; options go in `opts`
@@ -369,6 +379,7 @@ class AppConfig:
     psk: PskConfig = field(default_factory=PskConfig)
     plugins: PluginsConfig = field(default_factory=PluginsConfig)
     license: LicenseConfig = field(default_factory=LicenseConfig)
+    log: LogConfig = field(default_factory=LogConfig)
 
 
 class ConfigError(ValueError):
@@ -495,6 +506,10 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.authz.no_match not in ("allow", "deny"):
         raise ConfigError("authz.no_match must be allow|deny")
+    if cfg.log.formatter not in ("text", "json"):
+        raise ConfigError("log.formatter must be text|json")
+    if cfg.log.level.upper() not in ("DEBUG", "INFO", "WARNING", "ERROR"):
+        raise ConfigError("log.level must be debug|info|warning|error")
     ms = cfg.router.mesh_shape
     if len(ms) != 2 or any(not isinstance(x, int) or x < 0 for x in ms):
         raise ConfigError("router.mesh_shape must be [dp, tp] with ints >= 0")

@@ -590,11 +590,27 @@ class DeviceRouter:
         if config.probes < MAX_PROBES:
             config = dataclasses.replace(config, probes=MAX_PROBES)
         self.config = config
-        self._shape_sync = DeviceDeltaSync()
-        self._nfa_sync = DeviceDeltaSync()
-        self._bits_sync = DeviceDeltaSync()
+        if mesh is not None:
+            # sharded-from-upload mirrors: the canonical mesh layout is
+            # applied at the DeviceDeltaSync level, so subscribe/
+            # unsubscribe churn stays O(delta) scatters on the mesh too
+            # (jit propagates the placed sharding through the scatter)
+            from emqx_tpu.parallel.mesh import (
+                bitmap_placement,
+                table_placement,
+            )
+
+            tplace = table_placement(mesh)
+            self._shape_sync = DeviceDeltaSync(placement=tplace)
+            self._nfa_sync = DeviceDeltaSync(placement=tplace)
+            self._bits_sync = DeviceDeltaSync(
+                placement=bitmap_placement(mesh)
+            )
+        else:
+            self._shape_sync = DeviceDeltaSync()
+            self._nfa_sync = DeviceDeltaSync()
+            self._bits_sync = DeviceDeltaSync()
         self._group_sync = DeviceDeltaSync()
-        self._mesh_placed = None  # (version key, placed tables) cache
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
         # state (it runs on executor threads)
@@ -744,15 +760,11 @@ class DeviceRouter:
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
-        dryrun gate compiles). Inputs are laid out with the canonical
-        shardings; XLA inserts the ICI collectives.
-
-        Table placements are CACHED keyed on the index/subtab versions —
-        replicating the full bitmap matrix across the mesh per batch
-        would dwarf the kernel; only changed state is re-placed."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from emqx_tpu.parallel.mesh import dist_shape_route_step
+        dryrun gate compiles). Tables/bitmaps arrive ALREADY sharded —
+        the sync mirrors upload straight into the canonical layout, so
+        nothing is re-placed per batch; only the topic batch itself is
+        placed here."""
+        from emqx_tpu.parallel.mesh import dist_shape_route_step, place_batch
 
         cfg = self.config
         dp = self.mesh.shape["dp"]
@@ -770,23 +782,8 @@ class DeviceRouter:
             extra = dp - rows % dp
             mat = np.pad(mat, ((0, extra), (0, 0)))
             lens = np.pad(lens, (0, extra))
-        key = (
-            self.index.version,
-            self.subtab.version if self.subtab is not None else -1,
-        )
-        if self._mesh_placed is None or self._mesh_placed[0] != key:
-            repl = NamedSharding(self.mesh, P())
-            st = {k: jax.device_put(v, repl) for k, v in shape_tables.items()}
-            nt = (
-                {k: jax.device_put(v, repl) for k, v in nfa_tables.items()}
-                if nfa_tables is not None
-                else None
-            )
-            sb = jax.device_put(bits, NamedSharding(self.mesh, P(None, "tp")))
-            self._mesh_placed = (key, st, nt, sb)
-        _, st, nt, sb = self._mesh_placed
-        bm = jax.device_put(mat, NamedSharding(self.mesh, P("dp", None)))
-        ln = jax.device_put(lens, NamedSharding(self.mesh, P("dp")))
+        st, nt, sb = shape_tables, nfa_tables, bits
+        bm, ln = place_batch(self.mesh, mat, lens)
         out = dist_shape_route_step(
             self.mesh,
             st,

@@ -95,21 +95,14 @@ CONNACK_SERVER = 3
 CONNACK_CREDENTIALS = 4
 CONNACK_AUTH = 5
 
-_V3_CONNACK_COMPAT = {
-    RC_SUCCESS: CONNACK_ACCEPT,
-    RC_UNSUPPORTED_PROTOCOL_VERSION: CONNACK_PROTO_VER,
-    RC_CLIENT_IDENTIFIER_NOT_VALID: CONNACK_INVALID_ID,
-    RC_SERVER_UNAVAILABLE: CONNACK_SERVER,
-    RC_SERVER_BUSY: CONNACK_SERVER,
-    RC_BANNED: CONNACK_AUTH,
-    RC_BAD_USERNAME_OR_PASSWORD: CONNACK_CREDENTIALS,
-    RC_NOT_AUTHORIZED: CONNACK_AUTH,
-}
-
-
 def connack_compat(rc: int) -> int:
-    """Map an MQTT5 reason code onto a v3 CONNACK return code."""
-    return _V3_CONNACK_COMPAT.get(rc, CONNACK_SERVER)
+    """Map an MQTT5 reason code onto a v3 CONNACK return code —
+    delegates to the ONE compat table (mqtt/reason_codes.py,
+    emqx_reason_codes:compat/1 parity)."""
+    from emqx_tpu.mqtt.reason_codes import compat_connack
+
+    code = compat_connack(rc)
+    return CONNACK_SERVER if code is None else code
 
 
 # -- MQTT5 properties --------------------------------------------------------

@@ -1,0 +1,104 @@
+"""Structured log formatters, switchable from config at runtime.
+
+Parity: apps/emqx/src/emqx_logger_jsonfmt.erl + emqx_logger_textfmt.erl —
+the reference configures OTP logger handlers with a json or text
+formatter from the ``log`` config root, changeable at runtime. Here the
+same pair of formatters attaches to the root ``emqx_tpu`` logger, and
+``set_formatter``/``set_level`` re-point the live handler (the runtime
+config pipeline's ``log`` subtree calls them).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+_LOGGER_NAME = "emqx_tpu"
+
+
+def _iso_utc(record: logging.LogRecord) -> str:
+    """``2026-07-30T12:00:00.123+00:00`` — UTC with an explicit offset,
+    shared by both formatters (timestamps stay comparable across hosts
+    and DST changes)."""
+    t = time.gmtime(record.created)
+    ms = int(record.msecs)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", t) + f".{ms:03d}+00:00"
+
+
+class TextFormatter(logging.Formatter):
+    """``2026-07-30T12:00:00.123+00:00 [info] module: message`` — the
+    reference's default single-line text format."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = _iso_utc(record)
+        msg = record.getMessage()
+        out = f"{ts} [{record.levelname.lower()}] {record.name}: {msg}"
+        if record.exc_info:
+            out += "\n" + self.formatException(record.exc_info)
+        return out
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line (emqx_logger_jsonfmt best_effort_json):
+    time/level/msg plus logger metadata; unserializable values fall back
+    to their repr rather than failing the log call."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "time": _iso_utc(record),
+            "level": record.levelname.lower(),
+            "msg": record.getMessage(),
+            "logger": record.name,
+        }
+        if record.exc_info:
+            obj["exception"] = self.formatException(record.exc_info)
+        for k, v in getattr(record, "__dict__", {}).items():
+            if k.startswith("ctx_"):  # structured context fields
+                try:
+                    json.dumps(v)
+                    obj[k[4:]] = v
+                except (TypeError, ValueError):
+                    obj[k[4:]] = repr(v)
+        return json.dumps(obj, ensure_ascii=False)
+
+
+_FORMATTERS = {"text": TextFormatter, "json": JsonFormatter}
+_handler: Optional[logging.Handler] = None
+
+
+def setup_logging(
+    level: str = "info",
+    formatter: str = "text",
+    to_file: str = "",
+) -> logging.Handler:
+    """Install (or replace) the emqx_tpu log handler. Returns it."""
+    global _handler
+    logger = logging.getLogger(_LOGGER_NAME)
+    if _handler is not None:
+        logger.removeHandler(_handler)
+        _handler.close()
+    _handler = (
+        logging.FileHandler(to_file) if to_file else logging.StreamHandler()
+    )
+    _handler.setFormatter(_FORMATTERS.get(formatter, TextFormatter)())
+    logger.addHandler(_handler)
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    logger.propagate = False
+    return _handler
+
+
+def set_formatter(kind: str) -> None:
+    """Runtime switch text <-> json on the live handler."""
+    if kind not in _FORMATTERS:
+        raise ValueError(f"unknown log formatter {kind!r} (text|json)")
+    if _handler is not None:
+        _handler.setFormatter(_FORMATTERS[kind]())
+
+
+def set_level(level: str) -> None:
+    lv = getattr(logging, level.upper(), None)
+    if lv is None:
+        raise ValueError(f"unknown log level {level!r}")
+    logging.getLogger(_LOGGER_NAME).setLevel(lv)

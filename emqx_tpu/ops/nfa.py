@@ -172,17 +172,23 @@ class DeviceDeltaSync:
     device half of the delta-overlay design (module docstring).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, placement=None) -> None:
+        """`placement`: optional fn(name, np_array) -> device array used
+        for the initial/full uploads — e.g. a NamedSharding device_put
+        for SPMD serving. Delta scatters run under jit, so the placed
+        sharding propagates and churn stays O(delta) on a mesh too."""
         self._arrays: Optional[Dict] = None
         self._epoch = -1
         self._pos = 0
+        self._placement = placement
 
     def sync(self, src) -> Dict:
         import jax.numpy as jnp
 
         if self._arrays is None or self._epoch != src.epoch:
+            put = self._placement or (lambda _k, v: jnp.asarray(v))
             self._arrays = {
-                k: jnp.asarray(v.copy())
+                k: put(k, v.copy())
                 for k, v in src.device_snapshot().items()
             }
             self._epoch = src.epoch
@@ -207,7 +213,12 @@ class DeviceDeltaSync:
                 idxs = np.pad(idxs, (0, npad - n), mode="edge")
                 vals = np.pad(vals, (0, npad - n), mode="edge")
             out = _scatter_set(flat, jnp.asarray(idxs), jnp.asarray(vals))
-            self._arrays[name] = out.reshape(arr.shape)
+            out = out.reshape(arr.shape)
+            if self._placement is not None:
+                # the scatter's jit may drop the placed sharding; re-pin
+                # it (device-side reshard — no host re-upload)
+                out = self._placement(name, out)
+            self._arrays[name] = out
         self._pos = len(src.oplog)
         # shallow copy: callers may hold the snapshot across a later sync
         # (executor batches); mutating the returned dict under them would

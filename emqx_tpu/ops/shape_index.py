@@ -154,7 +154,9 @@ class ShapeIndex:
 
     def device_snapshot(self) -> Dict[str, np.ndarray]:
         return {
-            "shape_tab": self.arr_table,
+            # flat view: row-major [T,4] -> [T*4], matching the oplog's
+            # flat indices AND avoiding the TPU [_,4] tile-padding blowup
+            "shape_tab": self.arr_table.reshape(-1),
             "shape_mask": self.arr_shape_mask,
             "shape_len": self.arr_shape_len,
             "shape_flags": self.arr_shape_flags,
@@ -467,7 +469,10 @@ def shape_match_device(
 ):
     """Match tokenized topics against the shape index. Jit-traceable.
 
-    tables: device dict (shape_tab [T,4] i32, shape_mask/len/flags [Mcap])
+    tables: device dict (shape_tab FLAT [T*4] i32 — kept one-dimensional
+    because a [T, 4] s32 operand pads its minor dim 4 -> 128 under TPU
+    tiling, a 32x HBM expansion that OOMs at 10M-filter scale;
+    shape_mask/len/flags [Mcap])
     h1, h2: uint32 [B, L] per-level word hashes; nwords [B]; dollar [B]
     -> matched fid int32 [B, M] (-1 = no match; SPARSE, not compacted)
     """
@@ -479,8 +484,8 @@ def shape_match_device(
     mask = tables["shape_mask"][:M]  # [M]
     plen = tables["shape_len"][:M]
     flags = tables["shape_flags"][:M]
-    tab = tables["shape_tab"]  # [T, 4]
-    Tcap = tab.shape[0]
+    tab = tables["shape_tab"]  # [T*4] flat row-major
+    Tcap = tab.shape[0] // 4
 
     lvl = jnp.arange(L, dtype=jnp.int32)
     lvl_bit = (mask[None, :] >> lvl[:, None]) & 1  # [L, M]
@@ -515,16 +520,21 @@ def shape_match_device(
     tmask = jnp.uint32(Tcap - 1)
     for p in range(probes):
         idx = ((slot + jnp.uint32(p)) & tmask).astype(jnp.int32)
-        rows = tab[idx]  # [B, M, 4] — ONE fused gather per probe
+        base4 = idx * 4  # flat row offset (4 x 1D gathers: the 2D form
+        # would force the 32x-padded [T,4] layout back into HBM)
+        r_c1 = tab[base4]
+        r_c2 = tab[base4 + 1]
+        r_fid = tab[base4 + 2]
+        r_sid = tab[base4 + 3]
         hit = (
-            (rows[..., 0] == c1i)
-            & (rows[..., 1] == c2i)
-            & (rows[..., 3] == jnp.arange(M, dtype=jnp.int32)[None, :])
-            & (rows[..., 2] >= 0)
+            (r_c1 == c1i)
+            & (r_c2 == c2i)
+            & (r_sid == jnp.arange(M, dtype=jnp.int32)[None, :])
+            & (r_fid >= 0)
             & valid
             & ~found
         )
-        fid = jnp.where(hit, rows[..., 2], fid)
+        fid = jnp.where(hit, r_fid, fid)
         found |= hit
     return fid
 

@@ -238,6 +238,27 @@ def shard_inputs(mesh: Mesh, tables: Dict, sub_bitmaps, bytes_mat, lengths):
     return t, sb, bm, ln
 
 
+def table_placement(mesh: Mesh):
+    """Canonical placement for match tables: replicated over the mesh.
+    Returned as a (name, np_array) -> device array fn so DeviceDeltaSync
+    can upload straight into the sharded layout."""
+    sh = NamedSharding(mesh, P())
+    return lambda _name, arr: jax.device_put(arr, sh)
+
+
+def bitmap_placement(mesh: Mesh):
+    """Canonical placement for subscriber bitmaps: lanes sharded on 'tp'."""
+    sh = NamedSharding(mesh, P(None, "tp"))
+    return lambda _name, arr: jax.device_put(arr, sh)
+
+
+def place_batch(mesh: Mesh, bytes_mat, lengths):
+    """Canonical placement for a topic batch: rows sharded on 'dp'."""
+    bm = jax.device_put(bytes_mat, NamedSharding(mesh, P("dp", None)))
+    ln = jax.device_put(lengths, NamedSharding(mesh, P("dp")))
+    return bm, ln
+
+
 def shard_shape_inputs(
     mesh: Mesh,
     shape_tables: Dict,
@@ -246,18 +267,16 @@ def shard_shape_inputs(
     bytes_mat,
     lengths,
 ):
-    """`shard_inputs` for the serving (shape) engine — the ONE place the
-    canonical layout is declared for its callers (dryrun, tests)."""
-
-    def repl(d):
-        return {
-            k: jax.device_put(v, NamedSharding(mesh, P()))
-            for k, v in d.items()
-        }
-
-    st = repl(shape_tables)
-    nt = repl(nfa_tables) if nfa_tables is not None else None
-    sb = jax.device_put(sub_bitmaps, NamedSharding(mesh, P(None, "tp")))
-    bm = jax.device_put(bytes_mat, NamedSharding(mesh, P("dp", None)))
-    ln = jax.device_put(lengths, NamedSharding(mesh, P("dp")))
+    """`shard_inputs` for the serving (shape) engine — built from the
+    canonical placement helpers above (the ONE place the layout is
+    declared for every caller: dryrun, tests, DeviceRouter mesh mode)."""
+    tp = table_placement(mesh)
+    st = {k: tp(k, v) for k, v in shape_tables.items()}
+    nt = (
+        {k: tp(k, v) for k, v in nfa_tables.items()}
+        if nfa_tables is not None
+        else None
+    )
+    sb = bitmap_placement(mesh)("sub_bitmaps", sub_bitmaps)
+    bm, ln = place_batch(mesh, bytes_mat, lengths)
     return st, nt, sb, bm, ln

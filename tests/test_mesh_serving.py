@@ -100,7 +100,6 @@ def test_cluster_forward_rides_device_batch_path():
     """Two in-process nodes: node A (owner) forwards a batch to node B;
     B's forward handler dispatches through the device batch path and
     messages.routed.device increments on BOTH nodes."""
-    bus, (a, b, c), clock = None, (None, None, None), None
     _, nodes = make_cluster(2)
     a, b = nodes
     for n in nodes:
@@ -197,18 +196,22 @@ def test_mesh_shape_config_validation():
     load_config({"router": {"mesh_shape": [4, 2]}})
 
 
-def test_mesh_table_placement_cached_across_batches():
-    """Unchanged tables are NOT re-placed across batches; a subscribe
-    invalidates the cache."""
+def test_mesh_tables_synced_sharded_and_reused():
+    """Mesh-mode mirrors upload straight into the canonical sharding and
+    are NOT re-placed across batches; churn flows as delta scatters."""
     b = mesh_broker()
     got, deliver = collector()
     b.subscribe("s1", "c1", "k/#", pkt.SubOpts(), deliver)
     b.dispatch_batch_folded([Message(topic=f"k/{i}") for i in range(8)])
-    placed1 = b._device._mesh_placed
-    assert placed1 is not None
+    dev = b._device
+    bits1 = dev._bits_sync._arrays["sub_bitmaps"]
+    # placed with the canonical lane sharding, not single-device
+    assert "tp" in str(bits1.sharding.spec)
     b.dispatch_batch_folded([Message(topic=f"k/{i}") for i in range(8)])
-    assert b._device._mesh_placed is placed1  # cache hit
+    assert dev._bits_sync._arrays["sub_bitmaps"] is bits1  # no re-upload
+    # a subscribe reaches the mirror as a delta scatter, sharding kept
     b.subscribe("s2", "c2", "k2/#", pkt.SubOpts(), lambda m, o: None)
     b.dispatch_batch_folded([Message(topic=f"k/{i}") for i in range(8)])
-    assert b._device._mesh_placed is not placed1  # invalidated
+    bits2 = dev._bits_sync._arrays["sub_bitmaps"]
+    assert "tp" in str(bits2.sharding.spec)
     assert len(got) == 24

@@ -1,0 +1,99 @@
+"""Operability tail: structured log formatters (runtime-switchable) and
+MQTT reason-code tables (emqx_logger_jsonfmt / emqx_reason_codes parity).
+"""
+
+import json
+import logging
+
+import pytest
+
+from emqx_tpu.mqtt import reason_codes as RC
+from emqx_tpu.observe import logfmt
+
+
+def test_text_and_json_formatters(capsys):
+    h = logfmt.setup_logging("info", "text")
+    log = logging.getLogger("emqx_tpu.test")
+    log.info("hello %s", "world")
+    err = capsys.readouterr().err
+    assert "[info] emqx_tpu.test: hello world" in err
+
+    logfmt.set_formatter("json")
+    log.warning("boom", extra={"ctx_clientid": "c1"})
+    err = capsys.readouterr().err
+    obj = json.loads(err.strip().splitlines()[-1])
+    assert obj["level"] == "warning"
+    assert obj["msg"] == "boom"
+    assert obj["clientid"] == "c1"
+    assert "time" in obj
+
+    logfmt.set_formatter("text")
+
+
+def test_log_level_and_validation():
+    logfmt.setup_logging("info", "text")
+    logfmt.set_level("debug")
+    assert logging.getLogger("emqx_tpu").level == logging.DEBUG
+    logfmt.set_level("warning")
+    with pytest.raises(ValueError):
+        logfmt.set_level("verbose")
+    with pytest.raises(ValueError):
+        logfmt.set_formatter("yaml")
+
+
+def test_log_to_file(tmp_path):
+    f = tmp_path / "broker.log"
+    logfmt.setup_logging("info", "json", str(f))
+    logging.getLogger("emqx_tpu.filetest").error("to-file")
+    logfmt.setup_logging("info", "text")  # restore + close the file
+    obj = json.loads(f.read_text().strip())
+    assert obj["msg"] == "to-file" and obj["level"] == "error"
+
+
+def test_runtime_config_switches_formatter():
+    import asyncio
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+
+    async def run():
+        app = BrokerApp(load_config({
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"enable": False},
+            "router": {"enable_tpu": False},
+        }))
+        await app.start()
+        try:
+            app.config_handler.update("log", {"formatter": "json"})
+            h = logfmt._handler
+            assert isinstance(h.formatter, logfmt.JsonFormatter)
+            app.config_handler.update("log", {"formatter": "text"})
+            assert isinstance(h.formatter, logfmt.TextFormatter)
+            with pytest.raises(Exception):
+                app.config_handler.update("log", {"formatter": "bogus"})
+        finally:
+            await app.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_reason_code_tables():
+    assert RC.name(0x00) == "success"
+    assert RC.text(0x87) == "Not authorized"
+    assert RC.name(0x8E) == "session_taken_over"
+    assert RC.name(0x9B) == "qos_not_supported"
+    assert RC.name(0xFF).startswith("unknown_")
+    # v3 CONNACK names
+    assert RC.name(5, version=4) == "unauthorized_client"
+    assert "not authorized" in RC.text(5, version=4)
+
+
+def test_reason_code_compat_mapping():
+    # v5 -> v3.1.1 CONNACK compatibility (emqx_reason_codes:compat/1)
+    assert RC.compat_connack(0x00) == 0
+    assert RC.compat_connack(0x84) == 1  # unsupported protocol version
+    assert RC.compat_connack(0x85) == 2  # clientid not valid
+    assert RC.compat_connack(0x86) == 4  # bad username or password
+    assert RC.compat_connack(0x87) == 5  # not authorized
+    assert RC.compat_connack(0x8A) == 5  # banned
+    assert RC.compat_connack(0x89) == 3  # server busy -> unavailable
