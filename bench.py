@@ -161,20 +161,37 @@ def _build_mixed_10m(rng):
             masks.append((tuple(plus), depth))
     masks = masks[:64]
     assert len(masks) == 64, len(masks)
-    per_family = (10_000_000 - len(filters)) // 64
+    id_digits = [A, 500, C, 400, 300, 200, 100]  # per-level id spaces
+    # family sizes are bounded by each family's literal-tuple space —
+    # shallow wildcard families simply cannot carry 150k DISTINCT
+    # filters — so the sparse budget is distributed space-aware and the
+    # roomy (deep) families absorb the remainder. Levels draw ids
+    # INDEPENDENTLY (a single shared draw makes the tuple periodic with
+    # the lcm of the digit spaces and nearly every filter a duplicate).
+    budget = 10_000_000 - len(filters)
+    per_family = budget // 64
+    spaces = []
+    for plus, depth in masks:
+        sp = 1
+        for lvl in range(1, depth):
+            if lvl not in plus:
+                sp *= id_digits[min(lvl - 1, 6)]
+        spaces.append(sp)
+    sizes = [min(per_family, max(1000, sp // 2)) for sp in spaces]
+    shortfall = budget - sum(sizes)
+    roomy = [i for i, sp in enumerate(spaces) if sp > 20 * per_family]
+    for i in roomy:
+        sizes[i] += shortfall // len(roomy)
     # last two families stay smaller so the residual NFA (where they
     # land after the 64-shape device table fills) builds quickly
-    sizes = [per_family] * 62 + [50_000, 50_000]
-    id_digits = [A, 50, C, 40, 30, 20, 10]  # per-level id spaces
+    sizes[62] = min(sizes[62], 50_000)
+    sizes[63] = min(sizes[63], 50_000)
     for fam, ((plus, depth), sz) in enumerate(zip(masks, sizes)):
-        ha = rng.integers(0, 1 << 62, size=sz, dtype=np.int64)
-        cols = {}
-        for lvl in range(1, depth):
-            if lvl in plus:
-                continue
-            cols[lvl] = (ha + fam * 1_000_003 + lvl * 7919) % id_digits[
-                min(lvl - 1, 6)
-            ]
+        cols = {
+            lvl: rng.integers(0, id_digits[min(lvl - 1, 6)], size=sz)
+            for lvl in range(1, depth)
+            if lvl not in plus
+        }
         for k in range(sz):
             parts = ["v"]
             for lvl in range(1, depth):
@@ -351,7 +368,9 @@ def bench_config(name, rng, measure_updates=False):
 
     del stage, shape_tables, nfa_tables, sub_bitmaps
     out = {
-        "subscriptions": len(filters) * spf,
+        # DISTINCT filters actually indexed (duplicates dedupe on add),
+        # not the generated-list length
+        "subscriptions": len(index) * spf,
         "distinct_shapes": index.shapes.m_active(),
         "residual_nfa_filters": index.residual_count,
         "flagged_row_rate": round(flag_rate, 5),

@@ -54,9 +54,14 @@ class BrokerApp:
         if c.node.name:
             set_node_name(c.node.name)
 
-        from emqx_tpu.observe.logfmt import setup_logging
+        from emqx_tpu.config.schema import LogConfig
+        from emqx_tpu.observe import logfmt
 
-        setup_logging(c.log.level, c.log.formatter, c.log.to_file)
+        # logging is process-global: a second in-process app (cluster
+        # tests, embedded brokers) with DEFAULT log config must not
+        # clobber an earlier app's explicit handler setup
+        if logfmt._handler is None or c.log != LogConfig():
+            logfmt.setup_logging(c.log.level, c.log.formatter, c.log.to_file)
 
         self.hooks = Hooks()
         self.router = Router(

@@ -115,6 +115,24 @@ class BridgeManager:
                 timeout=cfg.get("request_timeout", 5.0),
             )
             return SqlSink(conn, cfg.get("sql", ""))
+        if btype == "mongodb":
+            from emqx_tpu.integration.mongodb import MongoConnector
+
+            conn = MongoConnector(
+                host=cfg.get("host", "127.0.0.1"),
+                port=cfg.get("port", 27017),
+                username=cfg.get("username", ""),
+                password=cfg.get("password", ""),
+                database=cfg.get("database", "mqtt"),
+                auth_source=cfg.get("auth_source", "admin"),
+                timeout=cfg.get("request_timeout", 5.0),
+            )
+            conn.sink_collection = cfg.get("collection", "mqtt_messages")
+            conn.sink_template = cfg.get(
+                "payload_template",
+                {"topic": "${topic}", "payload": "${payload}"},
+            )
+            return conn
         if btype == "redis":
             from emqx_tpu.integration.redis import RedisConnector
             from emqx_tpu.utils.placeholder import render

@@ -1,0 +1,360 @@
+"""LDAP connector: from-scratch BER/LDAPv3 client + authn provider.
+
+Parity: apps/emqx_connector/src/emqx_connector_ldap.erl (eldap client)
+and the LDAP authentication it backs.
+
+No LDAP library exists in this image, so LDAPv3 (RFC 4511) is spoken
+directly over a minimal BER codec: BindRequest/BindResponse (simple
+auth), SearchRequest with equality/AND filters, SearchResultEntry/Done,
+UnbindRequest. That subset is exactly what directory-backed MQTT auth
+uses.
+
+Two authn modes (both present in directory deployments and in the
+reference's eldap usage):
+
+- ``bind``: build the user's DN from a template and simple-bind with
+  the client's password — the directory itself verifies the credential
+- ``search``: bind as a service account, search for the user entry, and
+  compare a password-hash attribute locally
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hmac
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.auth import DENY, IGNORE, OK, Provider, _hash_password
+from emqx_tpu.integration.resource import Resource
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.utils.placeholder import render
+
+log = logging.getLogger("emqx_tpu.integration.ldap")
+
+
+class LdapError(Exception):
+    """Transport/protocol failure (connection must be reset)."""
+
+
+class LdapResultError(LdapError):
+    """Non-zero LDAP resultCode; stream still aligned."""
+
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(f"ldap result {code}: {message}")
+        self.code = code
+
+
+# -- BER (definite lengths only) ---------------------------------------------
+
+
+def ber(tag: int, content: bytes) -> bytes:
+    n = len(content)
+    if n < 0x80:
+        return bytes([tag, n]) + content
+    nb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([tag, 0x80 | len(nb)]) + nb + content
+
+
+def ber_int(v: int, tag: int = 0x02) -> bytes:
+    if v == 0:
+        return ber(tag, b"\x00")
+    out = v.to_bytes((v.bit_length() + 8) // 8, "big", signed=True)
+    return ber(tag, out.lstrip(b"\x00") or b"\x00") if v > 0 else ber(tag, out)
+
+
+def ber_str(s, tag: int = 0x04) -> bytes:
+    return ber(tag, s.encode() if isinstance(s, str) else bytes(s))
+
+
+def ber_read(data: bytes, pos: int) -> Tuple[int, bytes, int]:
+    """-> (tag, content, next_pos)"""
+    tag = data[pos]
+    n = data[pos + 1]
+    pos += 2
+    if n & 0x80:
+        k = n & 0x7F
+        n = int.from_bytes(data[pos : pos + k], "big")
+        pos += k
+    return tag, data[pos : pos + n], pos + n
+
+
+def ber_read_int(content: bytes) -> int:
+    return int.from_bytes(content, "big", signed=True)
+
+
+# filter builders (RFC 4511 §4.5.1)
+def eq_filter(attr: str, value: str) -> bytes:
+    return ber(0xA3, ber_str(attr) + ber_str(value))
+
+
+def and_filter(*filters: bytes) -> bytes:
+    return ber(0xA0, b"".join(filters))
+
+
+SCOPE_BASE, SCOPE_ONE, SCOPE_SUB = 0, 1, 2
+
+
+class LdapConnector(Resource):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 389,
+        bind_dn: str = "",
+        bind_password: str = "",
+        base_dn: str = "",
+        timeout: float = 5.0,
+    ):
+        self.host = host
+        self.port = port
+        self.bind_dn = bind_dn
+        self.bind_password = bind_password
+        self.base_dn = base_dn
+        self.timeout = timeout
+        self._r: Optional[asyncio.StreamReader] = None
+        self._w: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self._mid = 0
+
+    # -- framing -------------------------------------------------------------
+    async def _read_message(self) -> Tuple[int, int, bytes]:
+        """-> (message id, protocol-op tag, op content)"""
+        hdr = await self._r.readexactly(2)
+        n = hdr[1]
+        if n & 0x80:
+            k = n & 0x7F
+            ext = await self._r.readexactly(k)
+            n = int.from_bytes(ext, "big")
+            body = await self._r.readexactly(n)
+        else:
+            body = await self._r.readexactly(n)
+        _tag, mid_content, pos = ber_read(body, 0)
+        mid = ber_read_int(mid_content)
+        op_tag, op_content, _ = ber_read(body, pos)
+        return mid, op_tag, op_content
+
+    async def _send_op(self, op: bytes) -> int:
+        self._mid += 1
+        self._w.write(ber(0x30, ber_int(self._mid) + op))
+        return self._mid
+
+    @staticmethod
+    def _parse_result(content: bytes) -> Tuple[int, str]:
+        _t, code_c, pos = ber_read(content, 0)
+        _t, _matched, pos = ber_read(content, pos)
+        _t, diag, _pos = ber_read(content, pos)
+        return ber_read_int(code_c), diag.decode("utf-8", "replace")
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> None:
+        self._r, self._w = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout
+        )
+        if self.bind_dn:
+            await self.bind(self.bind_dn, self.bind_password)
+
+    async def stop(self) -> None:
+        if self._w is not None:
+            try:
+                self._mid += 1
+                # UnbindRequest [APPLICATION 2] NULL
+                self._w.write(ber(0x30, ber_int(self._mid) + b"\x42\x00"))
+                self._w.close()
+                await self._w.wait_closed()
+            except Exception:
+                pass
+            self._r = self._w = None
+
+    async def health_check(self) -> bool:
+        try:
+            # base-scope search of the root DSE is the standard liveness op
+            await self.search("", SCOPE_BASE, None, ["objectClass"])
+            return True
+        except LdapResultError:
+            return True  # server answered; stream healthy
+        except Exception:
+            return False
+
+    # -- operations ----------------------------------------------------------
+    async def bind(self, dn: str, password: str) -> None:
+        """Simple bind; raises LdapResultError on invalid credentials."""
+        async with self._lock:
+            try:
+                op = ber(
+                    0x60,  # BindRequest [APPLICATION 0]
+                    ber_int(3) + ber_str(dn) + ber_str(password, tag=0x80),
+                )
+                mid = await self._send_op(op)
+                rmid, op_tag, content = await asyncio.wait_for(
+                    self._read_message(), self.timeout
+                )
+                if rmid != mid or op_tag != 0x61:
+                    raise LdapError(f"unexpected bind reply {op_tag:#x}")
+                code, diag = self._parse_result(content)
+                if code != 0:
+                    raise LdapResultError(code, diag)
+            except LdapResultError:
+                raise
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                    OSError, LdapError) as e:
+                self._drop()
+                raise LdapError(f"connection reset: {e}") from e
+
+    async def search(
+        self,
+        base_dn: str,
+        scope: int,
+        filt: Optional[bytes],
+        attributes: List[str],
+    ) -> List[Tuple[str, Dict[str, List[bytes]]]]:
+        """-> [(dn, {attr: [values]})]; `filt` from eq_filter/and_filter
+        (None = present(objectClass), the match-everything filter)."""
+        if filt is None:
+            filt = ber(0x87, b"objectClass")  # present filter
+        async with self._lock:
+            try:
+                op = ber(
+                    0x63,  # SearchRequest [APPLICATION 3]
+                    ber_str(base_dn)
+                    + ber(0x0A, bytes([scope]))
+                    + ber(0x0A, b"\x00")  # neverDerefAliases
+                    + ber_int(0)  # sizeLimit
+                    + ber_int(0)  # timeLimit
+                    + ber(0x01, b"\x00")  # typesOnly FALSE
+                    + filt
+                    + ber(0x30, b"".join(ber_str(a) for a in attributes)),
+                )
+                mid = await self._send_op(op)
+                out = []
+                while True:
+                    rmid, op_tag, content = await asyncio.wait_for(
+                        self._read_message(), self.timeout
+                    )
+                    if rmid != mid:
+                        continue
+                    if op_tag == 0x64:  # SearchResultEntry
+                        _t, dn, pos = ber_read(content, 0)
+                        _t, attrs_seq, _ = ber_read(content, pos)
+                        attrs: Dict[str, List[bytes]] = {}
+                        p = 0
+                        while p < len(attrs_seq):
+                            _t, pa, p = ber_read(attrs_seq, p)
+                            _t, name, q = ber_read(pa, 0)
+                            _t, vals_set, _ = ber_read(pa, q)
+                            vals = []
+                            v = 0
+                            while v < len(vals_set):
+                                _t, val, v = ber_read(vals_set, v)
+                                vals.append(val)
+                            attrs[name.decode()] = vals
+                        out.append((dn.decode("utf-8", "replace"), attrs))
+                    elif op_tag == 0x65:  # SearchResultDone
+                        code, diag = self._parse_result(content)
+                        if code != 0:
+                            raise LdapResultError(code, diag)
+                        return out
+                    else:
+                        raise LdapError(f"unexpected search reply {op_tag:#x}")
+            except LdapResultError:
+                raise
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                    OSError, LdapError) as e:
+                self._drop()
+                raise LdapError(f"connection reset: {e}") from e
+
+    def _drop(self) -> None:
+        try:
+            if self._w is not None:
+                self._w.close()
+        except Exception:
+            pass
+        self._r = self._w = None
+
+
+class LdapAuthProvider(Provider):
+    """Directory-backed authentication.
+
+    mode="bind": render the user DN template (e.g.
+    ``cn=${username},ou=mqtt,dc=example,dc=com``) and simple-bind with
+    the client's password on a DEDICATED connection — the directory is
+    the credential authority.
+    mode="search": search under base_dn for the user entry via the
+    service connection and compare the password-hash attribute locally.
+    """
+
+    def __init__(
+        self,
+        conn: LdapConnector,
+        mode: str = "bind",
+        dn_template: str = "cn=${username},${base_dn}",
+        filter_attr: str = "uid",
+        hash_attr: str = "userPassword",
+        algo: str = "plain",
+    ):
+        self.conn = conn
+        self.mode = mode
+        self.dn_template = dn_template
+        self.filter_attr = filter_attr
+        self.hash_attr = hash_attr
+        self.algo = algo
+
+    def authenticate(self, client_info, credentials):
+        return IGNORE, None
+
+    async def authenticate_async(self, client_info, credentials):
+        if credentials.get("enhanced_auth"):
+            return IGNORE, None
+        username = client_info.get("username") or ""
+        if not username:
+            return IGNORE, None
+        password = credentials.get("password") or b""
+        env = {
+            "username": username,
+            "clientid": client_info.get("client_id", ""),
+            "base_dn": self.conn.base_dn,
+        }
+        try:
+            if self.mode == "bind":
+                return await self._auth_bind(env, password)
+            return await self._auth_search(env, password)
+        except LdapResultError as e:
+            if e.code == 49:  # invalidCredentials
+                return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD
+            log.warning("ldap authn result %s", e)
+            return IGNORE, None
+        except Exception as e:
+            log.warning("ldap authn failed: %s", e)
+            return IGNORE, None
+
+    async def _auth_bind(self, env, password):
+        dn = render(self.dn_template, env)
+        probe = LdapConnector(
+            host=self.conn.host,
+            port=self.conn.port,
+            timeout=self.conn.timeout,
+        )
+        await probe.start()
+        try:
+            await probe.bind(dn, password.decode("utf-8", "replace"))
+            return OK, None
+        finally:
+            await probe.stop()
+
+    async def _auth_search(self, env, password):
+        rows = await self.conn.search(
+            self.conn.base_dn,
+            SCOPE_SUB,
+            eq_filter(self.filter_attr, env["username"]),
+            [self.hash_attr, "isSuperuser", "salt"],
+        )
+        if not rows:
+            return IGNORE, None
+        _dn, attrs = rows[0]
+        stored = (attrs.get(self.hash_attr) or [b""])[0]
+        salt = (attrs.get("salt") or [b""])[0]
+        cand = _hash_password(password, self.algo, salt)
+        if hmac.compare_digest(cand, stored) or hmac.compare_digest(
+            cand.hex().encode(), stored
+        ):
+            return OK, None
+        return DENY, pkt.RC_BAD_USERNAME_OR_PASSWORD

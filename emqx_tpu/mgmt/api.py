@@ -871,6 +871,61 @@ class MgmtApi:
                 ),
                 conn,
             )
+        if backend == "ldap":
+            from emqx_tpu.integration.ldap import (
+                LdapAuthProvider,
+                LdapConnector,
+            )
+
+            server = body.get("server", "127.0.0.1:389")
+            host, _, port = server.partition(":")
+            conn = LdapConnector(
+                host=host or "127.0.0.1",
+                port=int(port or 389),
+                bind_dn=body.get("bind_dn", ""),
+                bind_password=body.get("bind_password", ""),
+                base_dn=body.get("base_dn", ""),
+            )
+            await conn.start()
+            return (
+                LdapAuthProvider(
+                    conn,
+                    mode=body.get("method", "bind"),
+                    dn_template=body.get(
+                        "dn_template", "cn=${username},${base_dn}"
+                    ),
+                    filter_attr=body.get("filter_attr", "uid"),
+                    hash_attr=body.get("hash_attr", "userPassword"),
+                    algo=body.get("password_hash_algorithm", "plain"),
+                ),
+                conn,
+            )
+        if backend == "mongodb":
+            from emqx_tpu.integration.mongodb import (
+                MongoAuthProvider,
+                MongoConnector,
+            )
+
+            server = body.get("server", "127.0.0.1:27017")
+            host, _, port = server.partition(":")
+            conn = MongoConnector(
+                host=host or "127.0.0.1",
+                port=int(port or 27017),
+                username=body.get("username", ""),
+                password=body.get("password", ""),
+                database=body.get("database", "mqtt"),
+                auth_source=body.get("auth_source", "admin"),
+            )
+            await conn.start()
+            return (
+                MongoAuthProvider(
+                    conn,
+                    collection=body.get("collection", "mqtt_user"),
+                    filter_template=body.get("filter"),
+                    algo=body.get("password_hash_algorithm", "sha256"),
+                ),
+                conn,
+            )
         if backend in ("mysql", "postgresql", "pgsql"):
             from emqx_tpu.integration.sql_common import DEFAULT_AUTHN_QUERY
 
@@ -1056,6 +1111,31 @@ class MgmtApi:
                 RedisAuthzSource(
                     conn,
                     key_template=body.get("cmd_key", "mqtt_acl:${username}"),
+                ),
+                conn,
+            )
+        if stype == "mongodb":
+            from emqx_tpu.integration.mongodb import (
+                MongoAuthzSource,
+                MongoConnector,
+            )
+
+            server = body.get("server", "127.0.0.1:27017")
+            host, _, port = server.partition(":")
+            conn = MongoConnector(
+                host=host or "127.0.0.1",
+                port=int(port or 27017),
+                username=body.get("username", ""),
+                password=body.get("password", ""),
+                database=body.get("database", "mqtt"),
+                auth_source=body.get("auth_source", "admin"),
+            )
+            await conn.start()
+            return (
+                MongoAuthzSource(
+                    conn,
+                    collection=body.get("collection", "mqtt_acl"),
+                    filter_template=body.get("filter"),
                 ),
                 conn,
             )

@@ -624,6 +624,17 @@ class DeviceRouter:
             # grow the bitmap matrix to cover every live filter id BEFORE
             # the snapshot — a matched fid must always gather a real row
             self.subtab.pack(idx.num_filters_capacity)
+            if self.mesh is not None:
+                tp = self.mesh.shape["tp"]
+                if self.subtab.width_words % tp:
+                    # fail HERE with the config fix, before the sharded
+                    # upload inside the delta sync raises an opaque
+                    # NamedSharding divisibility error
+                    raise ValueError(
+                        f"subscriber bitmap width "
+                        f"{self.subtab.width_words} not divisible by "
+                        f"mesh tp={tp}; use a power-of-two tp"
+                    )
             bits = self._bits_sync.sync(self.subtab)["sub_bitmaps"]
         else:
             bits = None
@@ -768,12 +779,8 @@ class DeviceRouter:
 
         cfg = self.config
         dp = self.mesh.shape["dp"]
-        tp = self.mesh.shape["tp"]
-        if bits.shape[1] % tp:
-            raise ValueError(
-                f"subscriber bitmap width {bits.shape[1]} not divisible "
-                f"by mesh tp={tp}; use a power-of-two tp"
-            )
+        # (bitmap-width/tp divisibility is checked in _device_args,
+        # before the sharded upload)
         # batch rows must split evenly over dp (shard_map constraint);
         # mat was padded to a pow2 >= 64 — round up to a dp multiple for
         # non-pow2 dp sizes

@@ -101,7 +101,9 @@ def text(code: int, version: int = 5) -> str:
 
 def compat_connack(v5_code: int) -> Optional[int]:
     """v5 CONNACK reason -> v3.1.1 return code; None when the v5 code
-    has no v3 analog (emqx_reason_codes:compat(connack, _))."""
+    has no listed v3 analog (emqx_reason_codes:compat(connack, _)) —
+    the caller picks its own fallback (the channel uses server
+    unavailable)."""
     if v5_code == 0:
         return 0
-    return _COMPAT_CONNACK.get(v5_code, 3)
+    return _COMPAT_CONNACK.get(v5_code)

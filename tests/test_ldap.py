@@ -1,0 +1,223 @@
+"""LDAP wire client against a scripted BER/LDAPv3 server.
+
+Parity target: emqx_connector_ldap.erl (eldap) driven by the reference's
+LDAP docker-compose matrix; the stub speaks real BER over TCP.
+"""
+
+import asyncio
+import functools
+import hashlib
+
+import pytest
+
+from emqx_tpu.broker.auth import DENY, IGNORE, OK
+from emqx_tpu.integration.ldap import (
+    SCOPE_SUB,
+    LdapAuthProvider,
+    LdapConnector,
+    LdapError,
+    LdapResultError,
+    and_filter,
+    ber,
+    ber_int,
+    ber_read,
+    ber_read_int,
+    ber_str,
+    eq_filter,
+)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+class StubLdap:
+    """BER LDAPv3 server: simple bind + equality-filter search.
+
+    entries: {dn: {"password": str, attrs: {name: [bytes]}}}
+    """
+
+    def __init__(self, entries=None):
+        self.entries = entries or {}
+        self.binds = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        self.server.close()
+
+    def _result(self, mid, app_tag, code, diag=""):
+        op = ber(app_tag, ber(0x0A, bytes([code])) + ber_str("") +
+                 ber_str(diag))
+        return ber(0x30, ber_int(mid) + op)
+
+    async def _client(self, r, w):
+        try:
+            while True:
+                hdr = await r.readexactly(2)
+                n = hdr[1]
+                if n & 0x80:
+                    k = n & 0x7F
+                    n = int.from_bytes(await r.readexactly(k), "big")
+                body = await r.readexactly(n)
+                _t, mid_c, pos = ber_read(body, 0)
+                mid = ber_read_int(mid_c)
+                op_tag, op, _ = ber_read(body, pos)
+                if op_tag == 0x60:  # bind
+                    _t, _ver, p = ber_read(op, 0)
+                    _t, dn, p = ber_read(op, p)
+                    _t, pw, _ = ber_read(op, p)
+                    dn_s, pw_s = dn.decode(), pw.decode()
+                    self.binds.append(dn_s)
+                    if dn_s == "" or (
+                        dn_s in self.entries
+                        and self.entries[dn_s].get("password") == pw_s
+                    ):
+                        w.write(self._result(mid, 0x61, 0))
+                    else:
+                        w.write(self._result(mid, 0x61, 49,
+                                             "invalid credentials"))
+                elif op_tag == 0x63:  # search
+                    _t, base, p = ber_read(op, 0)
+                    _t, _scope, p = ber_read(op, p)
+                    _t, _deref, p = ber_read(op, p)
+                    _t, _sl, p = ber_read(op, p)
+                    _t, _tl, p = ber_read(op, p)
+                    _t, _to, p = ber_read(op, p)
+                    ftag, fcontent, p = ber_read(op, p)
+                    want = None
+                    if ftag == 0xA3:
+                        _t, attr, q = ber_read(fcontent, 0)
+                        _t, val, _ = ber_read(fcontent, q)
+                        want = (attr.decode(), val)
+                    base_s = base.decode()
+                    for dn_s, ent in self.entries.items():
+                        if base_s and not dn_s.endswith(base_s):
+                            continue
+                        attrs = ent.get("attrs", {})
+                        if want is not None:
+                            if want[1] not in attrs.get(want[0], []):
+                                continue
+                        pa = b"".join(
+                            ber(0x30, ber_str(name) + ber(
+                                0x31, b"".join(ber_str(v) for v in vals)))
+                            for name, vals in attrs.items()
+                        )
+                        entry = ber(0x64, ber_str(dn_s) + ber(0x30, pa))
+                        w.write(ber(0x30, ber_int(mid) + entry))
+                    w.write(self._result(mid, 0x65, 0))
+                elif op_tag == 0x42:  # unbind
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            w.close()
+
+
+ENTRIES = {
+    "cn=u1,ou=mqtt,dc=ex": {
+        "password": "pw1",
+        "attrs": {"uid": [b"u1"], "userPassword": [b"pw1"]},
+    },
+    "cn=svc,dc=ex": {"password": "svcpw", "attrs": {}},
+    "cn=u2,ou=mqtt,dc=ex": {
+        "password": "unused",
+        "attrs": {
+            "uid": [b"u2"],
+            "userPassword": [
+                hashlib.sha256(b"saltYsecret2").hexdigest().encode()
+            ],
+            "salt": [b"saltY"],
+        },
+    },
+}
+
+
+@async_test
+async def test_bind_and_search():
+    stub = await StubLdap(ENTRIES).start()
+    conn = LdapConnector(port=stub.port, bind_dn="cn=svc,dc=ex",
+                         bind_password="svcpw", base_dn="dc=ex")
+    await conn.start()
+    assert await conn.health_check()
+    rows = await conn.search("dc=ex", SCOPE_SUB, eq_filter("uid", "u1"),
+                             ["userPassword"])
+    assert len(rows) == 1
+    dn, attrs = rows[0]
+    assert dn == "cn=u1,ou=mqtt,dc=ex"
+    assert attrs["userPassword"] == [b"pw1"]
+    assert await conn.search("dc=ex", SCOPE_SUB,
+                             eq_filter("uid", "ghost"), []) == []
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_bad_service_bind():
+    stub = await StubLdap(ENTRIES).start()
+    conn = LdapConnector(port=stub.port, bind_dn="cn=svc,dc=ex",
+                         bind_password="wrong")
+    with pytest.raises(LdapResultError) as e:
+        await conn.start()
+    assert e.value.code == 49
+    await stub.stop()
+
+
+@async_test
+async def test_authn_bind_mode():
+    stub = await StubLdap(ENTRIES).start()
+    conn = LdapConnector(port=stub.port, base_dn="ou=mqtt,dc=ex")
+    await conn.start()
+    prov = LdapAuthProvider(conn, mode="bind",
+                            dn_template="cn=${username},${base_dn}")
+    ci = {"username": "u1", "client_id": "c"}
+    res, _ = await prov.authenticate_async(ci, {"password": b"pw1"})
+    assert res == OK
+    res, rc = await prov.authenticate_async(ci, {"password": b"nope"})
+    assert res == DENY
+    res, _ = await prov.authenticate_async(
+        {"username": "", "client_id": "c"}, {"password": b"x"}
+    )
+    assert res == IGNORE
+    await conn.stop()
+    await stub.stop()
+
+
+@async_test
+async def test_authn_search_mode_hashed():
+    stub = await StubLdap(ENTRIES).start()
+    conn = LdapConnector(port=stub.port, bind_dn="cn=svc,dc=ex",
+                         bind_password="svcpw", base_dn="dc=ex")
+    await conn.start()
+    prov = LdapAuthProvider(conn, mode="search", filter_attr="uid",
+                            hash_attr="userPassword", algo="sha256")
+    ci = {"username": "u2", "client_id": "c"}
+    res, _ = await prov.authenticate_async(ci, {"password": b"secret2"})
+    assert res == OK
+    res, _ = await prov.authenticate_async(ci, {"password": b"bad"})
+    assert res == DENY
+    res, _ = await prov.authenticate_async(
+        {"username": "ghost", "client_id": "c"}, {"password": b"x"}
+    )
+    assert res == IGNORE
+    await conn.stop()
+    await stub.stop()
+
+
+def test_ber_roundtrip_long_lengths():
+    big = b"x" * 300  # forces the long-form length encoding
+    enc = ber(0x04, big)
+    tag, content, _ = ber_read(enc, 0)
+    assert tag == 0x04 and content == big
+    assert ber_read_int(ber_read(ber_int(-5), 0)[1]) == -5
+    assert ber_read_int(ber_read(ber_int(300), 0)[1]) == 300
+    f = and_filter(eq_filter("a", "1"), eq_filter("b", "2"))
+    tag, content, _ = ber_read(f, 0)
+    assert tag == 0xA0
