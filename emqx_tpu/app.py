@@ -47,6 +47,119 @@ def _register_builtin_gateways(registry) -> None:
     registry.register_type("lwm2m", Lwm2mGateway)
 
 
+def attach_guards(hooks: Hooks, c: AppConfig):
+    """Banned + flapping admission guards (emqx_banned / emqx_flapping)."""
+    banned = Banned()
+    banned.attach(hooks)
+    flapping = (
+        Flapping(
+            banned,
+            max_count=c.flapping.max_count,
+            window=c.flapping.window_time,
+            ban_time=c.flapping.ban_time,
+        )
+        if c.flapping.enable
+        else None
+    )
+    if flapping:
+        flapping.attach(hooks)
+    return banned, flapping
+
+
+def attach_authn(hooks: Hooks, c: AppConfig, channel_config: ChannelConfig):
+    """Authn chain + SCRAM enhanced auth from config (emqx_authn analog).
+
+    Shared by BrokerApp and the connection workers (transport/workers.py):
+    each worker rebuilds the same chain from the same config, so admission
+    semantics don't depend on which process accepted the socket."""
+    scram = None
+    authn = None
+    if c.authn.enable:
+        providers = []
+        if c.authn.users:
+            db = BuiltinDatabase(
+                user_id_type=c.authn.user_id_type,
+                algo=c.authn.password_hash,
+            )
+            for u in c.authn.users:
+                db.add_user(u.user_id, u.password, u.is_superuser)
+            providers.append(db)
+        if c.authn.jwt_secret:
+            providers.append(
+                JwtAuth(c.authn.jwt_secret.encode(), c.authn.jwt_verify_claims)
+            )
+        if c.authn.http_url:
+            from emqx_tpu.auth.http import HttpAuthProvider
+
+            providers.append(
+                HttpAuthProvider(
+                    c.authn.http_url,
+                    method=c.authn.http_method,
+                    timeout=c.authn.http_timeout,
+                )
+            )
+        if c.authn.jwks_endpoint:
+            from emqx_tpu.auth.jwks import JwksAuthProvider
+
+            providers.append(
+                JwksAuthProvider(
+                    c.authn.jwks_endpoint,
+                    refresh_interval=c.authn.jwks_refresh_interval,
+                    verify_claims=c.authn.jwks_verify_claims,
+                )
+            )
+        authn = AuthChain(providers, allow_anonymous=c.authn.allow_anonymous)
+        authn.attach(hooks)
+    if c.authn.scram_enable:
+        from emqx_tpu.auth.scram import ScramAuthenticator
+
+        scram = ScramAuthenticator(iterations=c.authn.scram_iterations)
+        for u in c.authn.scram_users:
+            scram.add_user(u.user_id, u.password, u.is_superuser)
+        channel_config.enhanced_auth[scram.METHOD] = scram
+    return authn, scram
+
+
+def attach_authz(hooks: Hooks, c: AppConfig):
+    """ACL rules + file ACL + network authz sources (emqx_authz analog)."""
+    authz_rules = [BrokerApp._acl_rule(r) for r in c.authz.rules]
+    if c.authz.acl_file:
+        from emqx_tpu.auth.file_acl import load as load_acl_file
+
+        authz_rules.extend(load_acl_file(c.authz.acl_file))
+    authz_sources = []
+    if c.authz.http_url:
+        from emqx_tpu.auth.http import HttpAuthzSource
+
+        authz_sources.append(
+            HttpAuthzSource(
+                c.authz.http_url,
+                method=c.authz.http_method,
+                timeout=c.authz.http_timeout,
+            )
+        )
+    authz = Authorizer(
+        rules=authz_rules,
+        no_match=c.authz.no_match,
+        deny_action=c.authz.deny_action,
+        sources=authz_sources,
+    )
+    authz.attach(hooks)
+    return authz
+
+
+def build_guard_hooks(c: AppConfig, hooks: Hooks) -> ChannelConfig:
+    """Worker-process hook stack: the admission-relevant slice of the
+    BrokerApp wiring (guards + authn + authz) against a fresh Hooks, plus
+    the ChannelConfig the worker's channels run with. Everything else
+    (retainer, rules, bridges, cluster) lives only in the router process."""
+    channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
+    attach_guards(hooks, c)
+    attach_authn(hooks, c, channel_config)
+    attach_authz(hooks, c)
+    return channel_config
+
+
 class BrokerApp:
     def __init__(self, config: Optional[AppConfig] = None):
         self.config = config or AppConfig()
@@ -134,20 +247,7 @@ class BrokerApp:
             )
 
         # extensions (reference L4, SURVEY.md §1)
-        self.banned = Banned()
-        self.banned.attach(self.hooks)
-        self.flapping = (
-            Flapping(
-                self.banned,
-                max_count=c.flapping.max_count,
-                window=c.flapping.window_time,
-                ban_time=c.flapping.ban_time,
-            )
-            if c.flapping.enable
-            else None
-        )
-        if self.flapping:
-            self.flapping.attach(self.hooks)
+        self.banned, self.flapping = attach_guards(self.hooks, c)
 
         self.retainer = Retainer(
             max_retained=c.retainer.max_retained_messages,
@@ -180,56 +280,9 @@ class BrokerApp:
                 ]
             ).attach(self.hooks)
 
-        self.scram = None
-        if c.authn.enable:
-            providers = []
-            if c.authn.users:
-                db = BuiltinDatabase(
-                    user_id_type=c.authn.user_id_type,
-                    algo=c.authn.password_hash,
-                )
-                for u in c.authn.users:
-                    db.add_user(u.user_id, u.password, u.is_superuser)
-                providers.append(db)
-            if c.authn.jwt_secret:
-                providers.append(
-                    JwtAuth(
-                        c.authn.jwt_secret.encode(), c.authn.jwt_verify_claims
-                    )
-                )
-            if c.authn.http_url:
-                from emqx_tpu.auth.http import HttpAuthProvider
-
-                providers.append(
-                    HttpAuthProvider(
-                        c.authn.http_url,
-                        method=c.authn.http_method,
-                        timeout=c.authn.http_timeout,
-                    )
-                )
-            if c.authn.jwks_endpoint:
-                from emqx_tpu.auth.jwks import JwksAuthProvider
-
-                providers.append(
-                    JwksAuthProvider(
-                        c.authn.jwks_endpoint,
-                        refresh_interval=c.authn.jwks_refresh_interval,
-                        verify_claims=c.authn.jwks_verify_claims,
-                    )
-                )
-            self.authn = AuthChain(
-                providers, allow_anonymous=c.authn.allow_anonymous
-            )
-            self.authn.attach(self.hooks)
-        else:
-            self.authn = None
-        if c.authn.scram_enable:
-            from emqx_tpu.auth.scram import ScramAuthenticator
-
-            self.scram = ScramAuthenticator(iterations=c.authn.scram_iterations)
-            for u in c.authn.scram_users:
-                self.scram.add_user(u.user_id, u.password, u.is_superuser)
-            self.channel_config.enhanced_auth[self.scram.METHOD] = self.scram
+        self.authn, self.scram = attach_authn(
+            self.hooks, c, self.channel_config
+        )
 
         # TLS-PSK identity store (emqx_psk analog)
         self.psk = None
@@ -270,29 +323,7 @@ class BrokerApp:
             )
             rule.enabled = spec.enable
 
-        authz_rules = [self._acl_rule(r) for r in c.authz.rules]
-        if c.authz.acl_file:
-            from emqx_tpu.auth.file_acl import load as load_acl_file
-
-            authz_rules.extend(load_acl_file(c.authz.acl_file))
-        authz_sources = []
-        if c.authz.http_url:
-            from emqx_tpu.auth.http import HttpAuthzSource
-
-            authz_sources.append(
-                HttpAuthzSource(
-                    c.authz.http_url,
-                    method=c.authz.http_method,
-                    timeout=c.authz.http_timeout,
-                )
-            )
-        self.authz = Authorizer(
-            rules=authz_rules,
-            no_match=c.authz.no_match,
-            deny_action=c.authz.deny_action,
-            sources=authz_sources,
-        )
-        self.authz.attach(self.hooks)
+        self.authz = attach_authz(self.hooks, c)
 
         # observability (reference L5 aux: SURVEY.md §5.1/§5.5)
         from emqx_tpu.observe.alarm import AlarmManager
@@ -434,6 +465,7 @@ class BrokerApp:
         self.telemetry = None  # Telemetry, set by start()
         self.config_handler = self._make_config_handler()
         self._tasks: List[asyncio.Task] = []
+        self.worker_pools: List = []  # WorkerPool, set by start()
         self.started_at: Optional[float] = None
 
     @staticmethod
@@ -493,6 +525,18 @@ class BrokerApp:
                 chan_cfg = dataclasses.replace(
                     chan_cfg, mountpoint=spec.mountpoint
                 )
+            if spec.workers > 0 and spec.type == "tcp":
+                # multi-process host data plane: the workers own the
+                # client port (SO_REUSEPORT); this process only runs the
+                # routing core + fabric (transport/workers.py)
+                from emqx_tpu.transport.workers import WorkerPool
+
+                pool = WorkerPool(
+                    self, spec.bind, spec.port, spec.workers, c
+                )
+                await pool.start()
+                self.worker_pools.append(pool)
+                continue
             await self.listeners.start_listener(
                 ListenerConfig(
                     name=spec.name,
@@ -665,6 +709,9 @@ class BrokerApp:
             await self.gateways.unload_all()
         if self.bridges is not None:
             await self.bridges.close()
+        for pool in self.worker_pools:
+            await pool.stop()
+        self.worker_pools.clear()
         await self.listeners.stop_all()
         # final checkpoint AFTER listeners close: connection teardown parks
         # live persistent sessions into cm._detached, so the snapshot

@@ -91,6 +91,15 @@ class SharedSub:
                     )
         return out
 
+    def subscriptions_sids(self) -> List[Tuple[str, str]]:
+        """(sid, original $share filter) pairs — worker-fabric cleanup."""
+        out = []
+        for real, groups in self._table.items():
+            for gname, g in groups.items():
+                for sid in g.members:
+                    out.append((sid, f"$share/{gname}/{real}"))
+        return out
+
     def route_filter(self, group: str, real: str) -> str:
         """The filter registered in the route table for a shared sub."""
         return real

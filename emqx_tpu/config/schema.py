@@ -48,6 +48,12 @@ class ListenerSpec:
     # topic namespace prefix for clients of this listener; supports
     # ${clientid}/${username} placeholders (emqx_mountpoint.erl parity)
     mountpoint: Optional[str] = None
+    # >0: serve this (tcp-only) listener from N connection-worker
+    # PROCESSES on a shared SO_REUSEPORT socket, speaking the batched
+    # fabric protocol to the router process (transport/workers.py) —
+    # the host-data-plane analog of the reference's process-per-
+    # connection parallelism (emqx_connection.erl:173-176)
+    workers: int = 0
 
 
 @dataclass

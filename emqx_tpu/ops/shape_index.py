@@ -107,6 +107,16 @@ def slot_hash(c1: int) -> int:
     return h
 
 
+def probe_step(c2: int) -> int:
+    """Double-hashing probe stride (odd => full cycle mod pow2 capacity).
+
+    Linear probing's clustering makes an 8-probe bound fail thousands of
+    placements at 10M entries even at 30% load (forcing capacity
+    doublings into the GBs); with a c2-derived stride the probe sequence
+    is uniform and P(8 occupied) ~ load^8."""
+    return (c2 | 1) & _M32
+
+
 class ShapeIndex:
     """Incrementally-maintained shape hash index (host side).
 
@@ -132,12 +142,42 @@ class ShapeIndex:
         self.arr_table = np.zeros((self._Tcap, 4), np.int32)
         self.arr_table[:, 2] = -1  # fid lane: -1 empty
         self._fill = 0  # non-empty slots (live + tombstones)
-        # filter -> (shape_id, c1, c2, fid); key -> filter for collisions
-        self._entries: Dict[str, Tuple[int, int, int, int]] = {}
-        self._by_key: Dict[Tuple[int, int], str] = {}
+        # filter -> (shape_id, c1, c2, fid); key -> filter for collisions.
+        # After a cold bulk load these dicts are materialized LAZILY from
+        # the stashed arrays (`_cold`) on first incremental access — dict
+        # construction for 10M filters costs ~1min the serving path may
+        # never need.
+        self._entries_d: Dict[str, Tuple[int, int, int, int]] = {}
+        self._by_key_d: Dict[Tuple[int, int], str] = {}
+        self._cold = None  # (names, sid_arr, c1_arr, c2_arr, fid_arr)
         self.epoch = 0
         self.oplog: list = []
         self.version = 0
+
+    # -- lazy host mirror --------------------------------------------------
+    def _materialize(self) -> None:
+        if self._cold is None:
+            return
+        names, sid, c1, c2, fid = self._cold
+        self._cold = None
+        sid_l = sid.tolist()
+        c1_l = c1.tolist()
+        c2_l = c2.tolist()
+        fid_l = fid.tolist()
+        self._entries_d = dict(zip(names, zip(sid_l, c1_l, c2_l, fid_l)))
+        self._by_key_d = dict(zip(zip(c1_l, c2_l), names))
+        if len(self._entries_d) != len(names):
+            raise RuntimeError("cold bulk load lost entries (dup names?)")
+
+    @property
+    def _entries(self) -> Dict[str, Tuple[int, int, int, int]]:
+        self._materialize()
+        return self._entries_d
+
+    @property
+    def _by_key(self) -> Dict[Tuple[int, int], str]:
+        self._materialize()
+        return self._by_key_d
 
     # -- delta protocol ----------------------------------------------------
     def _log(self, name: str, idx: int, val: int) -> None:
@@ -236,48 +276,84 @@ class ShapeIndex:
         if (self._fill + 1) * 2 > self._Tcap:
             self._rehash(self._Tcap * 2)
             return
-        slot = slot_hash(c1) & (self._Tcap - 1)
-        for p in range(MAX_PROBES):
-            idx = (slot + p) & (self._Tcap - 1)
-            f = self.arr_table[idx, 2]
-            if f == -1 or f == TOMB_FID:
-                if f == -1:
-                    self._fill += 1
-                base = idx * 4
-                for lane, val in enumerate(
-                    (np.int32(np.uint32(c1)), np.int32(np.uint32(c2)), fid, sid)
-                ):
-                    self.arr_table[idx, lane] = val
-                    self._log("shape_tab", base + lane, int(val))
-                return
-        self._rehash(self._Tcap * 2)
-
-    def _rehash(self, newT: int) -> None:
-        """Rebuild the table from `_entries` (vectorized placement).
-
-        Any placement within MAX_PROBES of an entry's home slot is valid
-        for lookup (host and device probe the full bound), so placement
-        runs in probe ROUNDS: in round p every still-unplaced entry bids
-        for its home+p slot, first bidder per empty slot wins. Entries
-        left after MAX_PROBES rounds double the table and retry.
-        """
-        ents = list(self._entries.values())
-        n = len(ents)
-        if n == 0:
-            tab = np.zeros((newT, 4), np.int32)
-            tab[:, 2] = -1
-            self._Tcap = newT
-            self.arr_table = tab
-            self._fill = 0
-            self._bump_epoch()
+        res = self._cuckoo_walk(self.arr_table, self._Tcap, (c1, c2, fid, sid))
+        if res is None:
+            self._rehash(self._Tcap * 2)
             return
-        sid = np.array([e[0] for e in ents], np.int64)
-        c1 = np.array([e[1] & 0xFFFFFFFF for e in ents], np.uint32)
-        c2 = np.array([e[2] & 0xFFFFFFFF for e in ents], np.uint32)
-        fid = np.array([e[3] for e in ents], np.int64)
+        writes, was_empty = res
+        if was_empty:
+            # _fill counts non-empty slots; a walk converts exactly ONE
+            # slot from empty/tombstone to live (displacements only move
+            # live entries between live slots)
+            self._fill += 1
+        for idx, row in writes:
+            base = idx * 4
+            for lane in range(4):
+                self._log("shape_tab", base + lane, int(row[lane]))
+
+    @staticmethod
+    def _probe_positions(c1: int, c2: int, Tcap: int):
+        home = slot_hash(c1)
+        step = probe_step(c2)
+        return [(home + p * step) & (Tcap - 1) for p in range(MAX_PROBES)]
+
+    @staticmethod
+    def _cuckoo_walk(tab, Tcap: int, entry, max_kicks: int = 512):
+        """Place `entry` = (c1u32, c2u32, fid, sid) into `tab` [T,4] i32,
+        displacing resident entries among THEIR OWN probe positions when
+        every position of the current entry is full (random-walk cuckoo
+        with MAX_PROBES choices). Lookup correctness only needs each
+        entry to sit at one of its probe positions, so displacement is
+        invisible to readers. Returns (writes, terminal_was_empty) where
+        `writes` is the list of (slot, row4) applied — or None when the
+        walk exceeds max_kicks (caller doubles the table).
+        """
+        writes = []
+        c1, c2, fid, sid = entry
+        seed = c1
+        for _kick in range(max_kicks):
+            pos = ShapeIndex._probe_positions(
+                int(np.uint32(c1)), int(np.uint32(c2)), Tcap
+            )
+            row = np.array(
+                [np.int32(np.uint32(c1)), np.int32(np.uint32(c2)), fid, sid],
+                np.int32,
+            )
+            for idx in pos:
+                f = tab[idx, 2]
+                if f == -1 or f == TOMB_FID:
+                    tab[idx] = row
+                    writes.append((idx, row))
+                    return writes, f == -1
+            # all positions full: evict a deterministic pseudo-random one
+            seed = _mix32(seed ^ (_kick * 0x9E3779B1))
+            vidx = pos[seed % MAX_PROBES]
+            victim = tab[vidx].copy()
+            tab[vidx] = row
+            writes.append((vidx, row))
+            c1 = int(np.uint32(victim[0]))
+            c2 = int(np.uint32(victim[1]))
+            fid = int(victim[2])
+            sid = int(victim[3])
+        return None
+
+    @staticmethod
+    def _build_table(sid, c1, c2, fid, newT: int):
+        """Vectorized double-hash placement -> (tab [T,4] i32, T).
+
+        Any placement within MAX_PROBES along an entry's (home, stride)
+        probe sequence is valid for lookup (host and device walk the same
+        sequence), so placement runs in probe ROUNDS: in round p every
+        still-unplaced entry bids for home + p*stride, first bidder per
+        empty slot wins. The tail left after MAX_PROBES rounds (~load^8
+        of the batch) is placed by cuckoo displacement; only if a walk
+        fails does the table double.
+        """
+        n = len(sid)
         with np.errstate(over="ignore"):
             home = c1 * np.uint32(SLOT_MUL)
             home = home ^ (home >> np.uint32(SLOT_SHIFT))
+            step = c2 | np.uint32(1)
         while True:
             tab = np.zeros((newT, 4), np.int32)
             tab[:, 2] = -1
@@ -285,7 +361,10 @@ class ShapeIndex:
             for p in range(MAX_PROBES):
                 if not len(unplaced):
                     break
-                idx = (home[unplaced] + np.uint32(p)) & np.uint32(newT - 1)
+                with np.errstate(over="ignore"):
+                    idx = (
+                        home[unplaced] + np.uint32(p) * step[unplaced]
+                    ) & np.uint32(newT - 1)
                 idx = idx.astype(np.int64)
                 free = tab[idx, 2] == -1
                 cand = unplaced[free]
@@ -300,9 +379,39 @@ class ShapeIndex:
                 placed_mask = np.zeros(n, bool)
                 placed_mask[win] = True
                 unplaced = unplaced[~placed_mask[unplaced]]
-            if not len(unplaced):
-                break
+            ok = True
+            for i in unplaced.tolist():
+                if (
+                    ShapeIndex._cuckoo_walk(
+                        tab,
+                        newT,
+                        (int(c1[i]), int(c2[i]), int(fid[i]), int(sid[i])),
+                    )
+                    is None
+                ):
+                    ok = False
+                    break
+            if ok:
+                return tab, newT
             newT *= 2
+
+    def _rehash(self, newT: int) -> None:
+        """Rebuild the table from `_entries` (vectorized placement)."""
+        ents = list(self._entries.values())
+        n = len(ents)
+        if n == 0:
+            tab = np.zeros((newT, 4), np.int32)
+            tab[:, 2] = -1
+            self._Tcap = newT
+            self.arr_table = tab
+            self._fill = 0
+            self._bump_epoch()
+            return
+        sid = np.array([e[0] for e in ents], np.int64)
+        c1 = np.array([e[1] & 0xFFFFFFFF for e in ents], np.uint32)
+        c2 = np.array([e[2] & 0xFFFFFFFF for e in ents], np.uint32)
+        fid = np.array([e[3] for e in ents], np.int64)
+        tab, newT = self._build_table(sid, c1, c2, fid, newT)
         self._Tcap = newT
         self.arr_table = tab
         self._fill = n
@@ -328,6 +437,100 @@ class ShapeIndex:
         self._entries[filter_] = (sid, c1, c2, fid)
         self._place(c1, c2, fid, sid)
         return True
+
+    def bulk_add_cold(
+        self,
+        names: List[str],
+        fids: np.ndarray,
+        masks: np.ndarray,
+        plens: np.ndarray,
+        hhs: np.ndarray,
+        s1: np.ndarray,
+        s2: np.ndarray,
+        unfit: np.ndarray,
+    ) -> List[Tuple[str, int]]:
+        """Fully-vectorized cold-start insert (empty index only).
+
+        The caller (RouteIndex._bulk_add_cold) has already tokenized the
+        DISTINCT filters and reduced each to its shape signature
+        (masks/plens/hhs) and pre-fold combined sums (s1/s2 — the masked
+        sum-products WITHOUT the shape-id fold, which is applied here once
+        shape ids are assigned). `unfit` marks rows parse_shape would
+        reject. Returns the rejected (filter, fid) pairs, in input order,
+        for the residual engine. Bit-identical to repeated `add`.
+        """
+        assert not self._entries, "bulk_add_cold requires an empty index"
+        n = len(names)
+        rej = np.zeros(n, dtype=bool)
+        rej |= unfit
+        # -- shape registration (first-occurrence order, like add) -------
+        key = (
+            (masks.astype(np.uint64) << np.uint64(8))
+            | (plens.astype(np.uint64) << np.uint64(1))
+            | hhs.astype(np.uint64)
+        )
+        key[unfit] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        uq_key, first_idx, inv = np.unique(
+            key, return_index=True, return_inverse=True
+        )
+        order = np.argsort(first_idx, kind="stable")
+        sid_of_group = np.full(len(uq_key), -1, dtype=np.int64)
+        group_counts = np.bincount(inv, minlength=len(uq_key))
+        for g in order.tolist():
+            i = int(first_idx[g])
+            if unfit[i]:
+                continue
+            sid = self._shape_for(int(masks[i]), int(plens[i]), bool(hhs[i]))
+            if sid is None:
+                continue  # shape overflow -> whole family is residual
+            sid_of_group[g] = sid
+            self._shape_refs[sid] += int(group_counts[g]) - 1
+        sids = sid_of_group[inv]
+        rej |= sids < 0
+        # -- combined hashes (sid fold applied post-registration) --------
+        with np.errstate(over="ignore"):
+            su = sids.astype(np.uint32)
+            c1 = _mix32_np(s1 ^ (su * np.uint32(FOLD1)))
+            c2 = _mix32_np(s2 ^ (su * np.uint32(FOLD2)))
+        # -- 64-bit key collisions: first (by input order) wins ----------
+        fit_idx = np.nonzero(~rej)[0]
+        ckey = (c1[fit_idx].astype(np.uint64) << np.uint64(32)) | c2[
+            fit_idx
+        ].astype(np.uint64)
+        srt = np.argsort(ckey, kind="stable")  # stable => input order
+        dup = np.zeros(len(ckey), dtype=bool)
+        dup[srt[1:]] = ckey[srt[1:]] == ckey[srt[:-1]]
+        for i in fit_idx[dup].tolist():
+            # true 64-bit collision between distinct filters: residual
+            self._shape_release(
+                int(sids[i]),
+                (int(masks[i]), int(plens[i]), bool(hhs[i])),
+            )
+            rej[i] = True
+        # -- vectorized placement ----------------------------------------
+        keep = np.nonzero(~rej)[0]
+        newT = self._Tcap
+        while (len(keep) + 1) * 2 > newT:
+            newT *= 2
+        tab, newT = self._build_table(
+            sids[keep], c1[keep], c2[keep], fids[keep], newT
+        )
+        self._Tcap = newT
+        self.arr_table = tab
+        self._fill = len(keep)
+        # -- host mirror (lazy: arrays stashed, dicts on first access) ----
+        if rej.any():
+            keep_names = [names[i] for i in keep.tolist()]
+            self._cold = (
+                keep_names, sids[keep], c1[keep], c2[keep], fids[keep]
+            )
+            rej_idx = np.nonzero(rej)[0].tolist()
+            out = [(names[i], int(fids[i])) for i in rej_idx]
+        else:
+            self._cold = (names, sids, c1, c2, fids)
+            out = []
+        self._bump_epoch()
+        return out
 
     def bulk_add(self, entries: List[Tuple[str, int]]) -> List[Tuple[str, int]]:
         """Vectorized insert of many (filter, fid) pairs; returns the
@@ -409,10 +612,11 @@ class ShapeIndex:
             return False
         sid, c1, c2, _fid = ent
         self._by_key.pop((c1, c2), None)
-        slot = slot_hash(c1) & (self._Tcap - 1)
+        slot = slot_hash(c1)
+        step = probe_step(c2)
         cc1, cc2 = np.int32(np.uint32(c1)), np.int32(np.uint32(c2))
         for p in range(MAX_PROBES):
-            idx = (slot + p) & (self._Tcap - 1)
+            idx = (slot + p * step) & (self._Tcap - 1)
             if (
                 self.arr_table[idx, 2] >= 0
                 and self.arr_table[idx, 0] == cc1
@@ -458,7 +662,9 @@ class ShapeIndex:
         return evicted
 
     def __len__(self) -> int:
-        return len(self._entries)
+        if self._cold is not None:
+            return len(self._entries_d) + len(self._cold[0])
+        return len(self._entries_d)
 
 
 # -- device kernel ---------------------------------------------------------
@@ -515,11 +721,12 @@ def shape_match_device(
     c2i = jax.lax.bitcast_convert_type(c2, jnp.int32)
     slot = c1 * jnp.uint32(SLOT_MUL)
     slot = slot ^ (slot >> SLOT_SHIFT)
+    step = c2 | jnp.uint32(1)  # double-hash stride (see probe_step)
     fid = jnp.full((B, M), -1, dtype=jnp.int32)
     found = jnp.zeros((B, M), dtype=bool)
     tmask = jnp.uint32(Tcap - 1)
     for p in range(probes):
-        idx = ((slot + jnp.uint32(p)) & tmask).astype(jnp.int32)
+        idx = ((slot + jnp.uint32(p) * step) & tmask).astype(jnp.int32)
         base4 = idx * 4  # flat row offset (4 x 1D gathers: the 2D form
         # would force the 32x-padded [T,4] layout back into HBM)
         r_c1 = tab[base4]

@@ -865,3 +865,113 @@ CONTEXT_FUNCS: Dict[str, Callable[[Dict], Any]] = {
 
 def context_flag(ctx: Dict, name) -> Any:
     return (ctx.get("flags") or {}).get(_s(name))
+
+
+# -- named operator forms + term codec + map conversion ----------------------
+# (parity with emqx_rule_funcs.erl exports '+'/2 '-'/2 '*'/2 '/'/2 'div'/2,
+# map/1, term_encode/1, term_decode/1. The SQL grammar reaches the
+# arithmetic ones as infix operators; the named forms exist so the
+# function surface matches the reference export list 1:1.)
+
+
+@func("+")
+def _op_add(x, y):
+    # numeric add; if either side is a string, implicit-concat like the
+    # reference ('+'(X, Y) when is_binary -> concat)
+    if isinstance(x, (bytes, str)) or isinstance(y, (bytes, str)):
+        return _concat(x, y)
+    a, b = _num(x), _num(y)
+    return None if a is None or b is None else a + b
+
+
+@func("-")
+def _op_sub(x, y):
+    a, b = _num(x), _num(y)
+    return None if a is None or b is None else a - b
+
+
+@func("*")
+def _op_mul(x, y):
+    a, b = _num(x), _num(y)
+    return None if a is None or b is None else a * b
+
+
+@func("/")
+def _op_div(x, y):
+    a, b = _num(x), _num(y)
+    if a is None or b is None or b == 0:
+        return None
+    return a / b
+
+
+@func("div")
+def _op_intdiv(x, y):
+    a, b = _num(x), _num(y)
+    if a is None or b is None or int(b) == 0:
+        return None
+    q = abs(int(a)) // abs(int(b))  # erlang div truncates toward zero
+    return q if (int(a) < 0) == (int(b) < 0) else -q
+
+
+@func("map")
+def _to_map(x):
+    """Coerce to a map (emqx_plugin_libs_rule:map/1): maps pass through,
+    JSON strings decode, key-value pair lists fold."""
+    if isinstance(x, dict):
+        return x
+    if isinstance(x, (bytes, str)):
+        try:
+            v = json.loads(_s(x))
+            return v if isinstance(v, dict) else None
+        except (ValueError, TypeError):
+            return None
+    if isinstance(x, list):
+        try:
+            return {str(k): v for k, v in x}
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def _term_tag(x):
+    if isinstance(x, bytes):
+        return {"t": "b", "v": base64.b64encode(x).decode()}
+    if isinstance(x, list):
+        return {"t": "l", "v": [_term_tag(i) for i in x]}
+    if isinstance(x, dict):
+        return {"t": "m", "v": {str(k): _term_tag(v) for k, v in x.items()}}
+    return {"t": "v", "v": x}
+
+
+def _term_untag(d):
+    t = d.get("t")
+    if t == "b":
+        return base64.b64decode(d["v"])
+    if t == "l":
+        return [_term_untag(i) for i in d["v"]]
+    if t == "m":
+        return {k: _term_untag(v) for k, v in d["v"].items()}
+    return d.get("v")
+
+
+@func("term_encode")
+def _term_encode(x):
+    """Self-describing binary term encoding (reference: term_to_binary —
+    a BEAM-native format; here a tagged-JSON framework-native one, so
+    encode/decode round-trips bytes/lists/maps losslessly)."""
+    try:
+        return b"\x01ET" + json.dumps(_term_tag(x)).encode()
+    except (TypeError, ValueError):
+        return None
+
+
+@func("term_decode")
+def _term_decode(x):
+    if isinstance(x, str):
+        x = x.encode("utf-8", "surrogatepass")
+    if not isinstance(x, bytes) or not x.startswith(b"\x01ET"):
+        return None
+    try:
+        return _term_untag(json.loads(x[3:].decode()))
+    except (ValueError, TypeError):
+        return None

@@ -116,6 +116,22 @@ def eval_expr(node, ctx: Dict):
             from emqx_tpu.rules.funcs import context_flag
 
             return context_flag(ctx, eval_expr(node.args[0], ctx))
+        if node.name == "topic" and len(node.args) == 1:
+            # topic(N): Nth level of the message topic, 1-based
+            # (emqx_rule_funcs.erl topic/1 -> lists:nth over tokens)
+            i = eval_expr(node.args[0], ctx)
+            toks = str(ctx.get("topic") or "").split("/")
+            if isinstance(i, (int, float)) and 1 <= int(i) <= len(toks):
+                return toks[int(i) - 1]
+            return None
+        if node.name == "payload" and len(node.args) == 1:
+            # payload(Path): nested get into the (decoded) payload map
+            # (emqx_rule_funcs.erl payload/1 -> nested_get(map_path(...)))
+            from emqx_tpu.rules.funcs import FUNCS as _F
+
+            return _F["map_path"](
+                eval_expr(node.args[0], ctx), ctx.get("payload")
+            )
         fn = FUNCS.get(node.name)
         if fn is None:
             raise RuleEvalError(f"unknown function {node.name!r}")

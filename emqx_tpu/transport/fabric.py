@@ -1,0 +1,173 @@
+"""Worker-fabric wire protocol: connection workers <-> router process.
+
+The reference scales its connection layer with one BEAM process per
+connection inside a single node (emqx_connection.erl:173-176 — the
+scheduler spreads them over cores). A Python host gets the same effect
+with OS processes: N connection WORKERS own the client sockets (accepting
+on a shared SO_REUSEPORT port, one asyncio loop + full Channel/Session
+stack each), while the ROUTER process owns the single DeviceRouter and
+the subscription tables. This module is the seam between them: a
+length-prefixed binary protocol over a unix-domain socket, batched in
+both directions so the device batch window keeps its shape.
+
+Frames (all little-endian, u32 length prefix EXCLUDES the 5-byte header):
+
+  [u32 len][u8 type][body]
+
+  HELLO (w->r): u16 worker_id
+  SUB   (w->r): json {h, sid, cid, f, qos, nl, rap, rh}
+  UNSUB (w->r): json {sid, f}
+  PUBB  (w->r): u32 n, n * pub_record
+  DLV   (r->w): u32 n, n * dlv_record
+
+  pub_record: u16 tlen, topic, u32 plen, payload,
+              u8 flags (qos | retain<<2 | dup<<3), u16 clen, from_client
+  dlv_record: u16 tlen, topic, u32 plen, payload,
+              u8 flags (pub qos | retain<<2 | retained<<3),
+              u16 clen, from_client, u16 ntargets, ntargets * u32 handle
+
+A delivery record carries the message ONCE per worker; per-subscription
+QoS downgrade happens worker-side in the Session (same code path as the
+in-process broker), so the router serializes each matched message once
+per worker, not once per subscriber.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Iterable, List, Tuple
+
+T_HELLO = 0
+T_SUB = 1
+T_UNSUB = 2
+T_PUBB = 3
+T_DLV = 4
+
+_HDR = struct.Struct("<IB")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def pack_frame(ftype: int, body: bytes) -> bytes:
+    return _HDR.pack(len(body), ftype) + body
+
+
+def pack_json(ftype: int, obj) -> bytes:
+    return pack_frame(ftype, json.dumps(obj).encode())
+
+
+def pack_pub_batch(msgs) -> bytes:
+    """msgs: iterable of Message."""
+    parts = [b""]
+    n = 0
+    for m in msgs:
+        t = m.topic.encode()
+        p = m.payload or b""
+        c = (m.from_client or "").encode()
+        flags = (m.qos & 3) | (4 if m.retain else 0) | (
+            8 if getattr(m, "dup", False) else 0
+        )
+        parts.append(
+            _U16.pack(len(t)) + t + _U32.pack(len(p)) + p
+            + bytes([flags]) + _U16.pack(len(c)) + c
+        )
+        n += 1
+    parts[0] = _U32.pack(n)
+    return pack_frame(T_PUBB, b"".join(parts))
+
+
+def unpack_pub_batch(body: bytes) -> List[Tuple[str, bytes, int, bool, bool, str]]:
+    """-> [(topic, payload, qos, retain, dup, from_client)]"""
+    (n,) = _U32.unpack_from(body, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        (tl,) = _U16.unpack_from(body, off)
+        off += 2
+        topic = body[off : off + tl].decode()
+        off += tl
+        (pl,) = _U32.unpack_from(body, off)
+        off += 4
+        payload = body[off : off + pl]
+        off += pl
+        flags = body[off]
+        off += 1
+        (cl,) = _U16.unpack_from(body, off)
+        off += 2
+        client = body[off : off + cl].decode()
+        off += cl
+        out.append(
+            (topic, payload, flags & 3, bool(flags & 4), bool(flags & 8),
+             client)
+        )
+    return out
+
+
+def pack_dlv_batch(records) -> bytes:
+    """records: [(msg, [handle, ...])]"""
+    parts = [b""]
+    n = 0
+    for m, handles in records:
+        t = m.topic.encode()
+        p = m.payload or b""
+        c = (m.from_client or "").encode()
+        flags = (m.qos & 3) | (4 if m.retain else 0) | (
+            8 if m.headers.get("retained") else 0
+        )
+        # ntargets is u16: split monster fan-outs across records rather
+        # than raise mid-flush (a 10M-sub broker CAN put >65535 matching
+        # subscriptions on one worker)
+        for lo in range(0, len(handles), 0xFFFF):
+            chunk = handles[lo : lo + 0xFFFF]
+            parts.append(
+                _U16.pack(len(t)) + t + _U32.pack(len(p)) + p
+                + bytes([flags]) + _U16.pack(len(c)) + c
+                + _U16.pack(len(chunk))
+                + b"".join(_U32.pack(h) for h in chunk)
+            )
+            n += 1
+    parts[0] = _U32.pack(n)
+    return pack_frame(T_DLV, b"".join(parts))
+
+
+def unpack_dlv_batch(body: bytes):
+    """-> [(topic, payload, qos, retain, retained, from_client, [handles])]"""
+    (n,) = _U32.unpack_from(body, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        (tl,) = _U16.unpack_from(body, off)
+        off += 2
+        topic = body[off : off + tl].decode()
+        off += tl
+        (pl,) = _U32.unpack_from(body, off)
+        off += 4
+        payload = body[off : off + pl]
+        off += pl
+        flags = body[off]
+        off += 1
+        (cl,) = _U16.unpack_from(body, off)
+        off += 2
+        client = body[off : off + cl].decode()
+        off += cl
+        (nh,) = _U16.unpack_from(body, off)
+        off += 2
+        handles = list(struct.unpack_from(f"<{nh}I", body, off))
+        off += 4 * nh
+        out.append(
+            (topic, payload, flags & 3, bool(flags & 4), bool(flags & 8),
+             client, handles)
+        )
+    return out
+
+
+async def read_frame(reader) -> Tuple[int, bytes]:
+    hdr = await reader.readexactly(5)
+    length, ftype = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"fabric frame too large: {length}")
+    body = await reader.readexactly(length) if length else b""
+    return ftype, body

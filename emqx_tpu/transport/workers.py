@@ -1,0 +1,517 @@
+"""Multi-process connection workers: the host data plane at scale.
+
+One Python event loop tops out near a thousand MQTT messages/s once it
+also pays codec + per-subscriber serialization. The reference never has
+this wall — every connection is a BEAM process spread over cores
+(emqx_connection.erl:173-176). The equivalent here:
+
+- N WORKER processes accept clients on a shared SO_REUSEPORT port (the
+  kernel load-balances accepts). Each runs the full Connection/Channel/
+  Session stack — codec, keepalive, QoS state, acks — against a
+  `WorkerBroker` proxy instead of the real Broker.
+- The ROUTER process keeps the single DeviceRouter, subscription tables,
+  retainer, rules, and cluster links. Workers speak the batched fabric
+  protocol (transport/fabric.py) to it over a unix socket: SUB/UNSUB
+  register proxy subscribers; publishes arrive in batches that ride the
+  ingest window onto the TPU kernel; deliveries return batched, one
+  record per (message, worker), fanned to sockets worker-side.
+
+Scope: worker listeners are the high-throughput serving path. Sessions
+live in their worker (no cross-worker takeover; persistent-session WAL
+stays with in-process listeners). Authn/authz/banned guards are rebuilt
+per worker from the same config, so admission semantics match.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.ops import topics as T
+from emqx_tpu.transport import fabric as F
+
+# ---------------------------------------------------------------------------
+# router side
+# ---------------------------------------------------------------------------
+
+
+class WorkerFabric:
+    """Router-process endpoint: UDS server the workers dial into.
+
+    For every worker SUB it registers a proxy subscriber with the real
+    Broker whose deliver() enqueues (msg, handle) into that worker's
+    outbox; outboxes flush once per loop tick with one DLV record per
+    message (per-subscriber QoS handling stays worker-side)."""
+
+    def __init__(self, app, uds_path: str):
+        self.app = app
+        self.broker = app.broker
+        self.uds_path = uds_path
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: Dict[int, asyncio.StreamWriter] = {}
+        # wid -> [(msg, [handles])]; one record per message per tick
+        self._outbox: Dict[int, List] = {}
+        self._outbox_last: Dict[int, Tuple[int, List[int]]] = {}
+        self._flush_scheduled = False
+        self._tasks: set = set()
+
+    async def start(self) -> None:
+        try:
+            os.unlink(self.uds_path)
+        except FileNotFoundError:
+            pass
+        self._server = await asyncio.start_unix_server(
+            self._on_worker, path=self.uds_path
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._tasks):
+            t.cancel()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+        try:
+            os.unlink(self.uds_path)
+        except FileNotFoundError:
+            pass
+
+    async def _on_worker(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._tasks.add(task)
+        wid = -1
+        try:
+            ftype, body = await F.read_frame(reader)
+            if ftype != F.T_HELLO:
+                return
+            wid = int.from_bytes(body[:2], "little")
+            self._writers[wid] = writer
+            while True:
+                ftype, body = await F.read_frame(reader)
+                if ftype == F.T_SUB:
+                    self._on_sub(wid, body)
+                elif ftype == F.T_UNSUB:
+                    self._on_unsub(wid, body)
+                elif ftype == F.T_PUBB:
+                    await self._on_pub_batch(body)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            self._tasks.discard(task)
+            if wid >= 0:
+                self._writers.pop(wid, None)
+                self._outbox.pop(wid, None)
+                self._drop_worker_subs(wid)
+            writer.close()
+
+    # -- subscribe side ---------------------------------------------------
+    def _sid(self, wid: int, sid: str) -> str:
+        return f"w{wid}|{sid}"
+
+    def _on_sub(self, wid: int, body: bytes) -> None:
+        import json
+
+        d = json.loads(body)
+        handle = int(d["h"])
+        opts = pkt.SubOpts(
+            qos=int(d.get("qos", 0)),
+            no_local=bool(d.get("nl", False)),
+            retain_as_published=bool(d.get("rap", False)),
+            retain_handling=int(d.get("rh", 0)),
+        )
+        filter_ = d["f"]
+        _group, real = T.parse_share(filter_)
+        # rh=1 semantics key on THIS CLIENT's prior subscription, which
+        # only the worker-side session knows (channel.py sets
+        # opts._existing); broker-wide existence would suppress replay
+        # for every later client
+        existing = bool(d.get("ex", False))
+
+        def deliver(msg, _opts, _wid=wid, _h=handle):
+            self.enqueue(_wid, _h, msg)
+
+        self.broker.subscribe(
+            self._sid(wid, d["sid"]), d.get("cid", ""), filter_, opts, deliver
+        )
+        # retained replay (the worker-side channel hooks have no retainer;
+        # semantics per emqx_retainer: never for $share, rh=2 never,
+        # rh=1 only for fresh subscriptions)
+        ret = getattr(self.app, "retainer", None)
+        if (
+            ret is not None
+            and ret.enabled
+            and _group is None
+            and opts.retain_handling != 2
+            and not (opts.retain_handling == 1 and existing)
+        ):
+            for m in ret.match(real):
+                import copy
+
+                mm = copy.copy(m)
+                mm.headers = dict(m.headers, retained=True)
+                self.enqueue(wid, handle, mm)
+
+    def _on_unsub(self, wid: int, body: bytes) -> None:
+        import json
+
+        d = json.loads(body)
+        self.broker.unsubscribe(self._sid(wid, d["sid"]), d["f"])
+
+    def _drop_worker_subs(self, wid: int) -> None:
+        """Worker died: every subscription it proxied is gone."""
+        prefix = f"w{wid}|"
+        drops = []
+        for f, entry in list(self.broker._subs.items()):
+            for sid in list(entry):
+                if sid.startswith(prefix):
+                    drops.append((sid, f))
+        for sid, f in drops:
+            self.broker.unsubscribe(sid, f)
+        # shared groups: walk the registry the same way
+        for sid, f in self.broker.shared.subscriptions_sids():
+            if sid.startswith(prefix):
+                self.broker.unsubscribe(sid, f)
+
+    # -- publish side -----------------------------------------------------
+    async def _on_pub_batch(self, body: bytes) -> None:
+        for topic, payload, qos, retain, dup, client in F.unpack_pub_batch(
+            body
+        ):
+            msg = Message(
+                topic=topic,
+                payload=payload,
+                qos=qos,
+                retain=retain,
+                dup=dup,
+                from_client=client,
+            )
+            await self.broker.apublish_enqueue(msg)
+
+    # -- delivery side ----------------------------------------------------
+    def enqueue(self, wid: int, handle: int, msg) -> None:
+        if wid not in self._writers:
+            return
+        box = self._outbox.setdefault(wid, [])
+        last = self._outbox_last.get(wid)
+        if last is not None and last[0] == id(msg) and box:
+            last[1].append(handle)
+        else:
+            handles = [handle]
+            box.append((msg, handles))
+            self._outbox_last[wid] = (id(msg), handles)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        self._outbox_last.clear()
+        boxes, self._outbox = self._outbox, {}
+        for wid, records in boxes.items():
+            w = self._writers.get(wid)
+            if w is None or w.is_closing():
+                continue
+            try:
+                w.write(F.pack_dlv_batch(records))
+            except Exception:
+                # one worker's dead pipe (or a malformed record) must not
+                # lose the OTHER workers' deliveries in this tick
+                self.broker.metrics.inc("fabric.flush.errors")
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+
+class WorkerBroker:
+    """Broker facade inside a worker: same surface Channel/CM consume
+    (subscribe/unsubscribe/apublish/metrics/hooks), forwarding over the
+    fabric link. Deliveries come back by subscription handle."""
+
+    def __init__(self, hooks, metrics):
+        self.hooks = hooks
+        self.metrics = metrics
+        self._link_w: Optional[asyncio.StreamWriter] = None
+        self._subs: Dict[int, Tuple] = {}  # handle -> (deliver, opts)
+        self._byname: Dict[Tuple[str, str], int] = {}
+        self._next_handle = 1
+        self._pub_buf: List[Message] = []
+        self._pub_scheduled = False
+
+    # fabric glue
+    def attach_link(self, writer) -> None:
+        self._link_w = writer
+
+    def _send(self, data: bytes) -> None:
+        if self._link_w is not None and not self._link_w.is_closing():
+            self._link_w.write(data)
+
+    # Broker surface ------------------------------------------------------
+    def subscribe(self, sid, client_id, filter_, opts, deliver) -> None:
+        key = (sid, filter_)
+        h = self._byname.get(key)
+        if h is None:
+            h = self._next_handle
+            self._next_handle += 1
+            self._byname[key] = h
+        self._subs[h] = (deliver, opts)
+        self._send(
+            F.pack_json(
+                F.T_SUB,
+                {
+                    "h": h,
+                    "sid": sid,
+                    "cid": client_id,
+                    "f": filter_,
+                    "qos": opts.qos,
+                    "nl": opts.no_local,
+                    "rap": opts.retain_as_published,
+                    "rh": opts.retain_handling,
+                    # per-client resubscribe flag set by the worker-side
+                    # channel (rh=1 retained-replay suppression)
+                    "ex": bool(getattr(opts, "_existing", False)),
+                },
+            )
+        )
+
+    def unsubscribe(self, sid, filter_) -> bool:
+        h = self._byname.pop((sid, filter_), None)
+        if h is None:
+            return False
+        self._subs.pop(h, None)
+        self._send(F.pack_json(F.T_UNSUB, {"sid": sid, "f": filter_}))
+        return True
+
+    def drop_session_subs(self, sid, filters) -> None:
+        for f in list(filters):
+            self.unsubscribe(sid, f)
+
+    def _enqueue_pub(self, msg: Message) -> int:
+        self.metrics.inc("messages.received")
+        self._pub_buf.append(msg)
+        if not self._pub_scheduled:
+            self._pub_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_pubs)
+        return 0
+
+    def _flush_pubs(self) -> None:
+        self._pub_scheduled = False
+        buf, self._pub_buf = self._pub_buf, []
+        if buf:
+            self._send(F.pack_pub_batch(buf))
+
+    async def apublish_enqueue(self, msg: Message):
+        msg = await self.hooks.arun_fold("message.publish", (), msg)
+        if msg is None or msg.headers.get("allow_publish") is False:
+            self.metrics.inc("messages.dropped")
+            return 0
+        return self._enqueue_pub(msg)
+
+    async def apublish(self, msg: Message) -> int:
+        return await self.apublish_enqueue(msg)
+
+    def publish(self, msg: Message) -> int:
+        msg = self.hooks.run_fold("message.publish", (), msg)
+        if msg is None or msg.headers.get("allow_publish") is False:
+            return 0
+        return self._enqueue_pub(msg)
+
+    # delivery ------------------------------------------------------------
+    def on_delivery(self, topic, payload, qos, retain, retained, client,
+                    handles) -> None:
+        msg = Message(
+            topic=topic,
+            payload=payload,
+            qos=qos,
+            retain=retain,
+            from_client=client,
+        )
+        if retained:
+            msg.headers["retained"] = True
+        for h in handles:
+            ent = self._subs.get(h)
+            if ent is None:
+                continue
+            deliver, opts = ent
+            try:
+                deliver(msg, opts)
+            except Exception:
+                self.metrics.inc("delivery.errors")
+
+
+def worker_main(
+    wid: int,
+    bind: str,
+    port: int,
+    uds_path: str,
+    config,
+) -> None:
+    """Entry point of a spawned connection worker (own interpreter; the
+    TPU is never touched here — jax stays uninitialized)."""
+    asyncio.run(_worker_async(wid, bind, port, uds_path, config))
+
+
+async def _worker_async(wid, bind, port, uds_path, config) -> None:
+    from emqx_tpu.app import build_guard_hooks
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.metrics import Metrics
+    from emqx_tpu.transport.connection import Connection
+
+    hooks = Hooks()
+    metrics = Metrics()
+    broker = WorkerBroker(hooks, metrics)
+    channel_config = build_guard_hooks(config, hooks)
+    cm = ChannelManager(broker)
+
+    # fabric link to the router process (retry: the router may still be
+    # binding the UDS when workers spawn)
+    for attempt in range(100):
+        try:
+            reader, writer = await asyncio.open_unix_connection(uds_path)
+            break
+        except (FileNotFoundError, ConnectionRefusedError):
+            await asyncio.sleep(0.05 * (attempt + 1))
+    else:
+        raise RuntimeError(f"worker {wid}: router fabric not reachable")
+    writer.write(F.pack_frame(F.T_HELLO, wid.to_bytes(2, "little")))
+    broker.attach_link(writer)
+
+    async def pump_link():
+        try:
+            while True:
+                ftype, body = await F.read_frame(reader)
+                if ftype == F.T_DLV:
+                    for rec in F.unpack_dlv_batch(body):
+                        broker.on_delivery(*rec)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            os._exit(0)  # router gone: worker has nothing to serve
+
+    link_task = asyncio.create_task(pump_link())
+
+    conns: set = set()
+
+    async def on_client(r, w):
+        conn = Connection(broker, cm, r, w, channel_config)
+        task = asyncio.current_task()
+        conns.add(task)
+        try:
+            await conn.run()
+        finally:
+            conns.discard(task)
+
+    server = await asyncio.start_server(
+        on_client, bind, port, reuse_port=True
+    )
+    try:
+        await asyncio.gather(server.serve_forever(), link_task)
+    except asyncio.CancelledError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# pool management (router side)
+# ---------------------------------------------------------------------------
+
+
+class WorkerPool:
+    """Spawns and supervises the worker processes for one listener.
+
+    Workers launch as `python -m emqx_tpu.transport.workers ...` with the
+    app config re-serialized to JSON — plain subprocesses, no
+    multiprocessing __main__ re-import (which breaks under embedding
+    hosts) and no pickle coupling."""
+
+    def __init__(self, app, bind: str, port: int, n_workers: int, config):
+        self.app = app
+        self.bind = bind
+        self.port = port
+        self.n = n_workers
+        self.config = config
+        base = f"emqx-tpu-fabric-{os.getpid()}-{port}"
+        self.uds_path = os.path.join(tempfile.gettempdir(), base + ".sock")
+        self._cfg_path = os.path.join(tempfile.gettempdir(), base + ".json")
+        self.fabric = WorkerFabric(app, self.uds_path)
+        self._procs: List = []
+
+    async def start(self) -> None:
+        import dataclasses
+        import json
+        import subprocess
+        import sys
+
+        await self.fabric.start()
+        with open(self._cfg_path, "w") as f:
+            json.dump(dataclasses.asdict(self.config), f, default=str)
+        for wid in range(self.n):
+            p = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "emqx_tpu.transport.workers",
+                    "--wid", str(wid),
+                    "--bind", self.bind,
+                    "--port", str(self.port),
+                    "--uds", self.uds_path,
+                    "--config", self._cfg_path,
+                ],
+            )
+            self._procs.append(p)
+
+    async def wait_ready(self, timeout: float = 30.0) -> None:
+        """Block until every worker has dialed the fabric."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while len(self.fabric._writers) < self.n:
+            if loop.time() > deadline:
+                raise TimeoutError(
+                    f"{len(self.fabric._writers)}/{self.n} workers ready"
+                )
+            await asyncio.sleep(0.05)
+
+    async def stop(self) -> None:
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
+        self._procs.clear()
+        await self.fabric.stop()
+        try:
+            os.unlink(self._cfg_path)
+        except FileNotFoundError:
+            pass
+
+
+def _cli() -> None:
+    import argparse
+    import json
+
+    from emqx_tpu.config.schema import load_config
+
+    ap = argparse.ArgumentParser(prog="emqx_tpu.transport.workers")
+    ap.add_argument("--wid", type=int, required=True)
+    ap.add_argument("--bind", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--uds", required=True)
+    ap.add_argument("--config", required=True)
+    a = ap.parse_args()
+    with open(a.config) as f:
+        c = load_config(json.load(f))
+    worker_main(a.wid, a.bind, a.port, a.uds, c)
+
+
+if __name__ == "__main__":
+    _cli()

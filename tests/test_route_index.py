@@ -151,7 +151,12 @@ def test_place_within_device_probe_bound():
     # (caught at 100k filters: entries at probe distance >= 5)
     import inspect
 
-    from emqx_tpu.ops.shape_index import SHAPE_PROBES, shape_match_device, slot_hash
+    from emqx_tpu.ops.shape_index import (
+        SHAPE_PROBES,
+        probe_step,
+        shape_match_device,
+        slot_hash,
+    )
 
     sig = inspect.signature(shape_match_device)
     assert sig.parameters["probes"].default >= SHAPE_PROBES
@@ -160,9 +165,10 @@ def test_place_within_device_probe_bound():
     for i in range(5000):
         si.add(f"org/{i % 30}/dev/{i % 997}/x{i}", i)
     for f, (sid, c1, c2, fid) in si._entries.items():
-        base = slot_hash(c1) & (si._Tcap - 1)
+        base = slot_hash(c1)
+        step = probe_step(c2)
         for p in range(SHAPE_PROBES):
-            idx = (base + p) & (si._Tcap - 1)
+            idx = (base + p * step) & (si._Tcap - 1)
             if (
                 si.arr_table[idx, 2] == fid
                 and si.arr_table[idx, 3] == sid
