@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# One-command 2-node cluster FVT (no docker needed — the process analog
+# of the reference's docker-compose FVT rig,
+# .github/workflows/run_fvt_tests.yaml:47-113):
+#
+#   bash deploy/fvt.sh
+#
+# Boots two clustered brokers as local processes, waits for readiness,
+# runs deploy/fvt_drive.py (independent-client cross-node suite), and
+# tears everything down. Exit code = suite result.
+set -u
+cd "$(dirname "$0")/.."
+WORK=$(mktemp -d)
+trap 'kill $P1 $P2 2>/dev/null; wait $P1 $P2 2>/dev/null; rm -rf "$WORK"' EXIT
+
+cat > "$WORK/n1.json" <<EOF
+{
+  "node": {"name": "n1@127.0.0.1"},
+  "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+  "dashboard": {"enable": false},
+  "router": {"enable_tpu": ${FVT_TPU:-false}},
+  "cluster": {"enable": true, "listen_port": 0}
+}
+EOF
+
+python -m emqx_tpu -c "$WORK/n1.json" > "$WORK/n1.log" 2>&1 &
+P1=$!
+for i in $(seq 1 100); do
+  grep -q "cluster bus on" "$WORK/n1.log" && break
+  sleep 0.3
+done
+MQTT1=$(grep -oE "listener tcp:default on 127.0.0.1:[0-9]+" "$WORK/n1.log" | grep -oE "[0-9]+$")
+BUS1=$(grep -oE "cluster bus on 127.0.0.1:[0-9]+" "$WORK/n1.log" | grep -oE "[0-9]+$")
+if [ -z "${MQTT1:-}" ] || [ -z "${BUS1:-}" ]; then
+  echo "node1 failed to boot:"; cat "$WORK/n1.log"; exit 1
+fi
+echo "node1 up: mqtt=$MQTT1 bus=$BUS1"
+
+cat > "$WORK/n2.json" <<EOF
+{
+  "node": {"name": "n2@127.0.0.1"},
+  "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+  "dashboard": {"enable": false},
+  "router": {"enable_tpu": ${FVT_TPU:-false}},
+  "cluster": {"enable": true, "listen_port": 0,
+              "seeds": [{"node": "n1@127.0.0.1", "host": "127.0.0.1",
+                         "port": $BUS1}]}
+}
+EOF
+
+python -m emqx_tpu -c "$WORK/n2.json" > "$WORK/n2.log" 2>&1 &
+P2=$!
+for i in $(seq 1 100); do
+  grep -q "cluster bus on" "$WORK/n2.log" && break
+  sleep 0.3
+done
+MQTT2=$(grep -oE "listener tcp:default on 127.0.0.1:[0-9]+" "$WORK/n2.log" | grep -oE "[0-9]+$")
+if [ -z "${MQTT2:-}" ]; then
+  echo "node2 failed to boot:"; cat "$WORK/n2.log"; exit 1
+fi
+echo "node2 up: mqtt=$MQTT2 (joining node1)"
+sleep 2  # membership join + bootstrap
+
+python deploy/fvt_drive.py "$MQTT1" "$MQTT2"
+RC=$?
+if [ $RC -ne 0 ]; then
+  echo "--- node1 log tail ---"; tail -20 "$WORK/n1.log"
+  echo "--- node2 log tail ---"; tail -20 "$WORK/n2.log"
+fi
+exit $RC

@@ -57,6 +57,12 @@ async def serve(args) -> int:
             f"emqx_tpu mgmt api on {config.dashboard.bind}:{app.mgmt_server.port}",
             flush=True,
         )
+    if app.cluster_bus is not None:
+        print(
+            f"emqx_tpu cluster bus on "
+            f"{app.cluster_bus.host}:{app.cluster_bus.port}",
+            flush=True,
+        )
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

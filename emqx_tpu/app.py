@@ -479,6 +479,54 @@ class BrokerApp:
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         c = self.config
+        # config-driven clustering (ekka autocluster analog): bus + node
+        # wrap the broker BEFORE listeners accept, so the first subscribe
+        # already replicates its route
+        self.cluster_bus = None
+        self.cluster_node = None
+        if c.cluster.enable:
+            from emqx_tpu.cluster.node import ClusterNode
+            from emqx_tpu.cluster.tcp_transport import TcpBus
+
+            self.cluster_bus = TcpBus(
+                node_name(), host=c.cluster.bind, port=c.cluster.listen_port
+            )
+            self.cluster_node = ClusterNode(
+                node_name(),
+                self.cluster_bus,
+                broker=self.broker,
+                loop=asyncio.get_running_loop(),
+            )
+            self.broker.cluster = self.cluster_node
+            for s in c.cluster.seeds:
+                self.cluster_bus.add_peer(s.node, s.host, s.port)
+            if c.cluster.seeds:
+                self._tasks.append(
+                    asyncio.get_running_loop().create_task(
+                        self._cluster_join([s.node for s in c.cluster.seeds])
+                    )
+                )
+            # liveness: periodic heartbeat + failure detection (the
+            # tests drive Membership.heartbeat() manually; a live app
+            # needs the ticker)
+            from emqx_tpu.cluster.membership import HEARTBEAT_INTERVAL
+
+            async def _beat():
+                while True:
+                    await asyncio.sleep(HEARTBEAT_INTERVAL)
+                    node = self.cluster_node
+                    if node is None:
+                        return
+                    try:
+                        await asyncio.get_running_loop().run_in_executor(
+                            None, node.membership.heartbeat
+                        )
+                    except Exception:
+                        pass
+
+            self._tasks.append(
+                asyncio.get_running_loop().create_task(_beat())
+            )
         # publish batch aggregator: live connection traffic rides the device
         # route path (broker/ingest.py) once the loop is running
         if c.router.ingest_enable and c.router.enable_tpu:
@@ -688,6 +736,61 @@ class BrokerApp:
 
         return FunctionOutput(fn, name=f"bridge:{bridge_id}")
 
+    async def _cluster_join(self, seeds: List[str]) -> None:
+        """Dial seeds until one admits us (peers may still be booting)."""
+        loop = asyncio.get_running_loop()
+        for _attempt in range(120):
+            for seed in seeds:
+                try:
+                    ok = await loop.run_in_executor(
+                        None, self.cluster_node.join, seed
+                    )
+                    if ok:
+                        logging.getLogger("emqx_tpu").info(
+                            "joined cluster via %s", seed
+                        )
+                        return
+                except Exception:
+                    pass
+            await asyncio.sleep(0.5)
+        logging.getLogger("emqx_tpu").warning(
+            "cluster join failed after all retries: %s", seeds
+        )
+
+    async def drain(self, cluster_node=None, peer: Optional[str] = None):
+        """Rolling-restart drain (the relup analog, r3 verdict item 7;
+        reference tooling: scripts/update_appup.escript + node evacuation):
+        stop accepting, close live connections (persistent sessions park
+        into the CM + WAL checkpoint), and — when this broker is a
+        cluster member — hand every parked session to `peer` over the
+        sess v2 protocol so the process can exit with zero message loss
+        (ClusterNode.drain_to). The caller restarts/replaces the process;
+        a restarted single node restores sessions from the WAL."""
+        out = {"handed_off": 0}
+        for pool in self.worker_pools:
+            await pool.stop()
+        self.worker_pools.clear()
+        await self.listeners.stop_all()
+        if self.gateways is not None:
+            await self.gateways.unload_all()
+            self.gateways = None
+        out["detached_sessions"] = self.cm.detached_count()
+        if self.session_persistence is not None:
+            self.session_persistence.flush(force=True)
+        node = cluster_node or getattr(self, "cluster_node", None)
+        if node is not None:
+            if not peer:
+                peers = node.membership.peers()
+                peer = peers[0] if peers else None
+            if peer:
+                # async variant: rpc round-trips off-loop so inbound
+                # forwards keep banking mid-drain
+                out["handed_off"] = await node.drain_to_async(peer)
+                self.broker.cluster = None
+                self.cluster_node = None
+        self.broker.metrics.inc("node.drained")
+        return out
+
     async def stop(self) -> None:
         if self.broker.ingest is not None:
             await self.broker.ingest.stop()
@@ -713,6 +816,15 @@ class BrokerApp:
             await pool.stop()
         self.worker_pools.clear()
         await self.listeners.stop_all()
+        if getattr(self, "cluster_node", None) is not None:
+            try:
+                self.cluster_node.leave()
+            except Exception:
+                pass
+            self.cluster_node = None
+        if getattr(self, "cluster_bus", None) is not None:
+            self.cluster_bus.stop()
+            self.cluster_bus = None
         # final checkpoint AFTER listeners close: connection teardown parks
         # live persistent sessions into cm._detached, so the snapshot
         # includes clients that were still connected at shutdown

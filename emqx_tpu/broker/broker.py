@@ -75,6 +75,10 @@ class Broker:
         self._device = None  # lazy DeviceRouter
         self.mesh = None  # jax Mesh => SPMD serving (set by app/tests)
         self.ingest = None  # BatchIngest, attached by the app
+        # ClusterNode, attached by the app when cluster.enable: routes
+        # replicate on first/last subscriber, publishes forward to remote
+        # route owners (emqx_broker.erl:278-293 forward regime)
+        self.cluster = None
 
     # -- subscribe side ---------------------------------------------------
     def subscribe(
@@ -91,7 +95,11 @@ class Broker:
         if group is not None:
             # one route ref per group (matched by delete on group-empty)
             if self.shared.subscribe(group, real, sub):
-                self.router.add_route(self.shared.route_filter(group, real))
+                rk = self.shared.route_filter(group, real)
+                self.router.add_route(rk)
+                if self.cluster is not None:
+                    self.cluster._replicate_add(rk)
+                    self.cluster.shared_join(real, group)
             fid = self.router.filter_id(real)
             if fid is not None:
                 gid = self.grouptab.ensure_group(fid, real, group)
@@ -104,6 +112,8 @@ class Broker:
             entry[sid] = sub
             if first:
                 self.router.add_route(real)
+                if self.cluster is not None:
+                    self.cluster._replicate_add(real)
             if prev is not None:
                 # re-subscribe with fresh opts: keep the slot, swap the sub
                 sub.slot = prev.slot
@@ -123,7 +133,11 @@ class Broker:
             if empty:
                 if fid is not None:
                     self.grouptab.drop_group(fid, real, group)
-                self.router.delete_route(self.shared.route_filter(group, real))
+                rk = self.shared.route_filter(group, real)
+                self.router.delete_route(rk)
+                if self.cluster is not None:
+                    self.cluster._replicate_delete(rk)
+                    self.cluster.shared_leave(real, group)
             elif removed and fid is not None:
                 gid = self.grouptab.gid_of(real, group)
                 g = self.shared.group(real, group)
@@ -145,6 +159,8 @@ class Broker:
         if not entry:
             del self._subs[real]
             self.router.delete_route(real)
+            if self.cluster is not None:
+                self.cluster._replicate_delete(real)
         self.metrics.gauge_set("subscriptions.count", self.subscription_count())
         return True
 
@@ -213,8 +229,13 @@ class Broker:
             return 0
         return self._dispatch_routed(msg)
 
-    def _dispatch_routed(self, msg: Message) -> int:
+    def _dispatch_routed(self, msg: Message, forward: bool = True) -> int:
+        """Local dispatch + cluster forward. `forward=False` marks the
+        RECEIVING half of a cluster forward — it must never re-forward,
+        or every forwarded batch cascades node-to-node forever."""
         n = self._route_dispatch(msg, self.router.match(msg.topic))
+        if forward and self.cluster is not None:
+            n += self.cluster.forward_batch_remote([msg])[0]
         if n == 0:
             self.hooks.run("message.dropped", msg, "no_subscribers")
             self.metrics.inc("messages.dropped.no_subscribers")
@@ -229,23 +250,41 @@ class Broker:
                 msgs2.append(m)
         return sum(self.dispatch_batch_folded(msgs2))
 
-    def dispatch_batch_folded(self, msgs: Sequence[Message]) -> List[int]:
+    def dispatch_batch_folded(
+        self, msgs: Sequence[Message], forward: bool = True
+    ) -> List[int]:
         """Route + dispatch already-folded messages as one device step.
 
         The full flagship pipeline: tokenize + NFA match + bitmap fan-out in
         one jitted route_step, then host delivery straight from subscriber
         bits. Rows the kernel flags (too deep / overflow) fall back to the
         authoritative CPU path per row; batches too small to amortize a
-        dispatch skip the device entirely.
+        dispatch skip the device entirely. `forward=False` = receiving
+        half of a cluster forward (never re-forward).
         """
         r = self.router
         if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
-            return [self._dispatch_routed(m) for m in msgs]
+            if forward and self.cluster is not None and len(msgs) > 1:
+                # keep remote fan-out batched per destination node even
+                # on the CPU branch (one forward_batch per node, not one
+                # per message per node)
+                fwd = self.cluster.forward_batch_remote(msgs)
+                out = []
+                for i, m in enumerate(msgs):
+                    n = self._route_dispatch(
+                        m, self.router.match(m.topic)
+                    ) + fwd[i]
+                    if n == 0:
+                        self.hooks.run("message.dropped", m, "no_subscribers")
+                        self.metrics.inc("messages.dropped.no_subscribers")
+                    out.append(n)
+                return out
+            return [self._dispatch_routed(m, forward) for m in msgs]
         dev = self._device_router()
         results = dev.route(
             [m.topic for m in msgs], self._client_hashes(msgs)
         )
-        return self._dispatch_device_results(msgs, results)
+        return self._dispatch_device_results(msgs, results, forward)
 
     async def adispatch_batch_folded(self, msgs: Sequence[Message]) -> List[int]:
         """`dispatch_batch_folded` with the kernel launch + readback (and
@@ -290,9 +329,16 @@ class Broker:
 
         return [stable_hash(m.from_client) for m in msgs]
 
-    def _dispatch_device_results(self, msgs, results) -> List[int]:
+    def _dispatch_device_results(
+        self, msgs, results, forward: bool = True
+    ) -> List[int]:
         matched, _mcount, flags, bitmaps, picks = results
         r = self.router
+        fwd = (
+            self.cluster.forward_batch_remote(msgs)
+            if forward and self.cluster is not None
+            else None
+        )
         out: List[int] = []
         fell_back = 0
         touched_gids: set = set()
@@ -309,6 +355,8 @@ class Broker:
                 n = self._dispatch_row(
                     m, bitmaps[i], row[row >= 0], msg_picks, touched_gids
                 )
+            if fwd is not None:
+                n += fwd[i]
             if n == 0:
                 self.hooks.run("message.dropped", m, "no_subscribers")
                 self.metrics.inc("messages.dropped.no_subscribers")

@@ -21,6 +21,7 @@ analog of the reference's slave-node CT harness
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from emqx_tpu.broker.broker import Broker
@@ -42,9 +43,24 @@ class ClusterNode:
         clock: Optional[Callable[[], float]] = None,
         broker: Optional[Broker] = None,
         forward_mode: str = "async",
+        loop=None,
     ) -> None:
+        """`loop`: when this node wraps a LIVE BrokerApp broker, incoming
+        rpc handlers must run on the app's event loop — a forward's
+        dispatch writes to client sockets, which asyncio transports only
+        allow from their own thread. The bus thread then blocks on the
+        loop's result (calls need replies); casts drain the same way."""
         self.name = name
         self.bus = bus
+        self._loop = loop
+        # app mode: replication rpcs must not block the event loop on a
+        # peer round-trip (and an in-process peer pair would deadlock) —
+        # a SINGLE worker preserves add/delete ordering per node
+        self._repl_pool = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"repl-{name}")
+            if loop is not None
+            else None
+        )
         self.broker = broker or Broker()
         self.routes = ClusterRouteTable(name)
         self.membership = Membership(name, bus, clock=clock)
@@ -58,9 +74,18 @@ class ClusterNode:
         # locally parked sessions + the replicated clientid -> owner map
         self._parked: Dict[str, Dict] = {}
         self._parked_owner: Dict[str, str] = {}
+        # (real, group) -> set of nodes holding members; the MIN node is
+        # the group leader and the only one that dispatches — a group
+        # spanning nodes delivers exactly once (emqx_shared_sub's
+        # cluster-wide mnesia member table, leader-gated here)
+        self._shared_nodes: Dict[Tuple[str, str], set] = {}
         self._register_protos()
         self.membership.monitor(self._on_membership)
         bus.attach(name, self._handle)
+        # the broker replicates routes / shared membership through this
+        # node from now on (broker.subscribe/unsubscribe seam)
+        self.broker.cluster = self
+        self.broker.shared.leader_check = self.shared_leader
 
     # -- wiring ------------------------------------------------------------
     def _handle(self, from_node: str, payload):
@@ -68,6 +93,19 @@ class ClusterNode:
         if kind == "membership":
             return self.membership.handle(from_node, payload)
         if kind == "rpc":
+            if self._loop is not None and not self._loop.is_closed():
+                import concurrent.futures
+
+                fut: concurrent.futures.Future = concurrent.futures.Future()
+
+                def run():
+                    try:
+                        fut.set_result(self.rpc.handle(from_node, payload))
+                    except BaseException as e:  # reply errors to caller
+                        fut.set_exception(e)
+
+                self._loop.call_soon_threadsafe(run)
+                return fut.result(timeout=30)
             return self.rpc.handle(from_node, payload)
         return None
 
@@ -109,6 +147,15 @@ class ClusterNode:
             },
         )
         self.rpc.registry.register(
+            "shared",
+            1,
+            {
+                "join": self._proto_shared_join,
+                "leave": self._proto_shared_leave,
+                "dump": self._proto_shared_dump,
+            },
+        )
+        self.rpc.registry.register(
             "sess",
             1,
             {
@@ -117,6 +164,21 @@ class ClusterNode:
                 "resume_begin": self._proto_resume_begin,
                 "resume_end": self._proto_resume_end,
                 "dump_parked": self._proto_dump_parked,
+            },
+        )
+        # v2 adds the drain/rolling-upgrade handoff (BPAPI discipline:
+        # v1 is frozen, new behavior = new version carrying the union)
+        self.rpc.registry.register(
+            "sess",
+            2,
+            {
+                "insert_parked": self._proto_insert_parked,
+                "delete_parked": self._proto_delete_parked,
+                "resume_begin": self._proto_resume_begin,
+                "resume_end": self._proto_resume_end,
+                "dump_parked": self._proto_dump_parked,
+                "park_remote": self._proto_park_remote,
+                "park_append": self._proto_park_append,
             },
         )
 
@@ -136,6 +198,12 @@ class ClusterNode:
                     if n == node:
                         del self._channels[cid]
             self.rpc.forget_peer(node)
+            # shared-group leadership: a dead node's members are gone;
+            # surviving member nodes take over dispatch
+            for key, nodes in list(self._shared_nodes.items()):
+                nodes.discard(node)
+                if not nodes:
+                    self._shared_nodes.pop(key, None)
             self.broker.metrics.inc("cluster.nodedown.routes_purged", purged)
         elif event == "node_up":
             self.rpc.forget_peer(node)  # re-negotiate BPAPI versions
@@ -160,9 +228,18 @@ class ClusterNode:
         self._parked_owner.update(
             self.rpc.call(seed, "sess", "dump_parked")
         )
+        # shared-group membership bootstrap + announce our own groups
+        for r, g, nodes in self.rpc.call(seed, "shared", "dump"):
+            self._shared_nodes.setdefault((r, g), set()).update(nodes)
+        for real, groups in self.broker.shared._table.items():
+            for gname in groups:
+                self.shared_join(real, gname)
         return True
 
     def leave(self) -> None:
+        if self._repl_pool is not None:
+            self._repl_pool.shutdown(wait=True)  # flush pending replication
+            self._repl_pool = None
         self.membership.leave()
         self.rpc.stop()
         self.bus.detach(self.name)
@@ -176,60 +253,45 @@ class ClusterNode:
         opts: pkt.SubOpts,
         deliver,
     ) -> None:
-        group, real = T.parse_share(filter_)
-        route_key = (
-            self.broker.shared.route_filter(group, real)
-            if group is not None
-            else real
-        )
-        first = not self.broker.has_local_subs(route_key)
+        """Route replication + shared membership happen inside the broker
+        seam (broker.cluster points back here), so library callers and
+        the live app share one code path."""
         self.broker.subscribe(sid, client_id, filter_, opts, deliver)
-        if first:
-            self._replicate_add(route_key)
 
     def unsubscribe(self, sid: str, filter_: str) -> bool:
-        group, real = T.parse_share(filter_)
-        route_key = (
-            self.broker.shared.route_filter(group, real)
-            if group is not None
-            else real
-        )
-        removed = self.broker.unsubscribe(sid, filter_)
-        if removed and not self.broker.has_local_subs(route_key):
-            self._replicate_delete(route_key)
-        return removed
+        return self.broker.unsubscribe(sid, filter_)
 
     def _replicate_add(self, filter_: str) -> None:
         self.routes.add_route(filter_, self.name)
-        peers = self.membership.peers()
-        if T.wildcard(filter_):
-            # transactional: wait for every reachable peer (maybe_trans,
-            # emqx_router.erl:118-121 — a torn trie edge breaks matching)
-            for p in peers:
-                try:
-                    self.rpc.call(p, "route", "add_route", filter_, self.name)
-                except RpcError:
-                    pass  # peer down: membership GC will reconcile
-        else:
-            for p in peers:
-                self.rpc.cast(
-                    p, "route", "add_route", filter_, self.name, key=filter_
-                )
+        self._replicate("add_route", filter_)
 
     def _replicate_delete(self, filter_: str) -> None:
         self.routes.delete_route(filter_, self.name)
-        for p in self.membership.peers():
+        self._replicate("delete_route", filter_)
+
+    def _replicate(self, method: str, filter_: str) -> None:
+        """Wildcards replicate transactionally (maybe_trans,
+        emqx_router.erl:118-121 — a torn trie edge breaks matching);
+        exact topics ride ordered casts. In app mode both ship through
+        the replication worker so the event loop never blocks on a peer
+        round-trip (ordering preserved: one worker, FIFO submits)."""
+        peers = self.membership.peers()
+
+        def one(p):
             if T.wildcard(filter_):
                 try:
-                    self.rpc.call(
-                        p, "route", "delete_route", filter_, self.name
-                    )
+                    self.rpc.call(p, "route", method, filter_, self.name)
                 except RpcError:
-                    pass
+                    pass  # peer down: membership GC will reconcile
             else:
-                self.rpc.cast(
-                    p, "route", "delete_route", filter_, self.name, key=filter_
-                )
+                self.rpc.cast(p, "route", method, filter_, self.name, key=filter_)
+
+        if self._repl_pool is not None:
+            for p in peers:
+                self._repl_pool.submit(one, p)
+        else:
+            for p in peers:
+                one(p)
 
     # -- publish side ------------------------------------------------------
     def publish(self, msg: Message) -> int:
@@ -267,6 +329,100 @@ class ClusterNode:
             total += sum(1 for _ in batch)
         return total
 
+    # -- cluster-wide shared groups ----------------------------------------
+    def shared_join(self, real: str, group: str) -> None:
+        """First local member of (real, group): announce membership so
+        every node agrees on the group leader."""
+        self._shared_nodes.setdefault((real, group), set()).add(self.name)
+        self._shared_cast("join", real, group)
+
+    def shared_leave(self, real: str, group: str) -> None:
+        self._proto_shared_leave(real, group, self.name)
+        self._shared_cast("leave", real, group)
+
+    def _shared_cast(self, method: str, real: str, group: str) -> None:
+        def one(p):
+            self.rpc.cast(p, "shared", method, real, group, self.name,
+                          key=real)
+
+        for p in self.membership.peers():
+            if self._repl_pool is not None:
+                self._repl_pool.submit(one, p)
+            else:
+                one(p)
+
+    def shared_leader(self, real: str, group: str) -> bool:
+        """This node dispatches (real, group) iff it is the MIN of the
+        nodes holding members. A local group not yet announced (race)
+        defaults to dispatching — transient dup beats transient loss."""
+        s = self._shared_nodes.get((real, group))
+        if not s:
+            return True
+        cands = set(s)
+        cands.add(self.name)  # dispatch_groups only asks when local members exist
+        return self.name == min(cands)
+
+    def _proto_shared_join(self, real: str, group: str, node: str) -> None:
+        self._shared_nodes.setdefault((real, group), set()).add(node)
+
+    def _proto_shared_leave(self, real: str, group: str, node: str) -> None:
+        s = self._shared_nodes.get((real, group))
+        if s is not None:
+            s.discard(node)
+            if not s:
+                self._shared_nodes.pop((real, group), None)
+
+    def _proto_shared_dump(self):
+        return [
+            (r, g, sorted(nodes))
+            for (r, g), nodes in self._shared_nodes.items()
+        ]
+
+    def forward_batch_remote(self, msgs: Sequence[Message]) -> List[int]:
+        """Forward already-locally-dispatched messages to their REMOTE
+        route owners — the publish half the app's broker delegates here
+        when cluster mode is on (local dispatch stays on the device batch
+        path; this adds one forward_batch per destination node).
+        Returns per-message remote destination counts.
+
+        Batches carrying any QoS>0 message use a confirmed rpc.call
+        (at-least-once, matching _dispatch_dests' per-message semantics);
+        pure-QoS0 batches ride casts. In app mode the calls go through
+        the replication worker so the event loop never blocks on a peer
+        round-trip; failures count in messages.forward.failed."""
+        all_dests = self.routes.match_dests_batch([m.topic for m in msgs])
+        out = [0] * len(msgs)
+        per_node: Dict[str, List[Tuple[Message, List[str]]]] = {}
+        confirm: Dict[str, bool] = {}
+        for i, (m, dests) in enumerate(zip(msgs, all_dests)):
+            for node, filters in dests.items():
+                if node == self.name:
+                    continue
+                per_node.setdefault(node, []).append((m, filters))
+                if m.qos > 0:
+                    confirm[node] = True
+                out[i] += 1
+
+        def send(node, batch):
+            if confirm.get(node) or self.forward_mode == "sync":
+                try:
+                    self.rpc.call(node, "broker", "forward_batch", batch)
+                except RpcError:
+                    self.broker.metrics.inc(
+                        "messages.forward.failed", len(batch)
+                    )
+            else:
+                self.rpc.cast(
+                    node, "broker", "forward_batch", batch, key=node
+                )
+
+        for node, batch in per_node.items():
+            if self._repl_pool is not None:
+                self._repl_pool.submit(send, node, batch)
+            else:
+                send(node, batch)
+        return out
+
     def _dispatch_dests(self, msg: Message, dests: Dict[str, List[str]]) -> int:
         n = 0
         if not dests:
@@ -300,7 +456,9 @@ class ClusterNode:
         through to the per-message host dispatch inside
         dispatch_batch_folded itself."""
         msgs = [m for m, _fs in batch]
-        return sum(self.broker.dispatch_batch_folded(msgs))
+        # forward=False: this IS the receiving half — re-forwarding here
+        # would cascade batches between route owners forever
+        return sum(self.broker.dispatch_batch_folded(msgs, forward=False))
 
     # -- channel registry (emqx_cm_registry parity) ------------------------
     def register_channel(self, client_id: str, sid: str) -> None:
@@ -470,6 +628,105 @@ class ClusterNode:
         for p in self.membership.peers():
             self.rpc.cast(p, "sess", "delete_parked", client_id)
         return park["pending"]
+
+    def _proto_park_remote(
+        self, client_id: str, session_json: Dict, deadline: float
+    ) -> bool:
+        """Drain handoff phase 1 (sess v2): adopt a parked session from a
+        draining peer. Routes go live HERE before the drainer drops its
+        own, so an in-flight message lands in at least one bank."""
+        self.park_session(client_id, session_json, deadline)
+        return True
+
+    def _proto_park_append(self, client_id: str, pendings) -> int:
+        """Drain handoff phase 2: banked messages transferred AFTER the
+        drainer's routes dropped (possible duplicates with phase-1 banking
+        are QoS1 at-least-once, never loss)."""
+        park = self._parked.get(client_id)
+        if park is None:
+            return 0
+        park["pending"].extend(pendings)
+        return len(pendings)
+
+    def _drain_one(self, peer: str, cid: str, rpc_call) -> bool:
+        """Hand one parked session to `peer`; `rpc_call` performs the
+        blocking calls (directly, or via an executor in app mode)."""
+        park = self._parked.get(cid)
+        if park is None:
+            return False
+        rpc_call(
+            peer, "sess", "park_remote", cid, park["session"],
+            park["deadline"],
+        )
+        # peer's routes + ownership are live; drop ours, THEN flush the
+        # bank — a message in the gap forwards to the peer (new owner),
+        # one before it banks here and transfers below
+        sid = f"parked:{cid}"
+        for f in park["session"].get("subscriptions", {}):
+            self.unsubscribe(sid, f)
+        self._parked.pop(cid, None)
+        if park["pending"]:
+            rpc_call(
+                peer, "sess", "park_append", cid, list(park["pending"])
+            )
+        return True
+
+    def drain_to(self, peer: str) -> int:
+        """Rolling-upgrade drain (the relup analog, r3 verdict item 7;
+        reference tooling: scripts/update_appup.escript — here the
+        idiomatic equivalent is session handoff over the live protocol):
+        every session parked on THIS node is re-parked on `peer` with the
+        two-phase ordering above, then this node leaves the cluster.
+        Returns the number of sessions handed off. The caller (node
+        script / BrokerApp.drain) stops its listeners first so no new
+        sessions appear mid-drain."""
+        n = sum(
+            self._drain_one(peer, cid, self.rpc.call)
+            for cid in list(self._parked)
+        )
+        self.leave()
+        return n
+
+    async def drain_to_async(self, peer: str) -> int:
+        """`drain_to` for app mode: the blocking rpc round-trips run in
+        an executor so the event loop keeps serving inbound forwards —
+        a message arriving mid-drain must still reach a bank (state
+        mutations stay on the loop thread between the calls)."""
+        import asyncio
+        import functools
+
+        loop = asyncio.get_running_loop()
+
+        def rpc_sync(*a):
+            return self.rpc.call(*a)
+
+        n = 0
+        for cid in list(self._parked):
+            park = self._parked.get(cid)
+            if park is None:
+                continue
+            await loop.run_in_executor(
+                None,
+                functools.partial(
+                    rpc_sync, peer, "sess", "park_remote", cid,
+                    park["session"], park["deadline"],
+                ),
+            )
+            sid = f"parked:{cid}"
+            for f in park["session"].get("subscriptions", {}):
+                self.unsubscribe(sid, f)
+            self._parked.pop(cid, None)
+            pend = list(park["pending"])
+            if pend:
+                await loop.run_in_executor(
+                    None,
+                    functools.partial(
+                        rpc_sync, peer, "sess", "park_append", cid, pend
+                    ),
+                )
+            n += 1
+        await loop.run_in_executor(None, self.leave)
+        return n
 
     # -- cluster config txn (emqx_cluster_rpc multicall parity) ------------
     def config_multicall(self, op: str, args: tuple) -> Dict[str, object]:

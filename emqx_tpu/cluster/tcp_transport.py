@@ -79,7 +79,12 @@ class _PeerConn:
         self._next_id = 0
         self._pending: Dict[int, list] = {}  # rid -> [event, ok, value]
         self.alive = True
-        _send_frame(self.sock, ("hello", 0, bus.node))
+        # hello carries (name, listen_host, listen_port) so the accepting
+        # side can auto-register the dialer as a peer — a seed node then
+        # reaches joiners it was never configured with (autocluster join)
+        _send_frame(
+            self.sock, ("hello", 0, (bus.node, bus.host, bus.port))
+        )
         t = threading.Thread(target=self._reader, daemon=True)
         t.start()
 
@@ -247,7 +252,14 @@ class TcpBus:
             kind, _rid, payload = _recv_frame(sock)
             if kind != "hello":
                 return
-            peer = payload
+            if isinstance(payload, tuple):
+                peer, phost, pport = payload
+                with self._lock:
+                    # learn the dialer's listen address (don't clobber an
+                    # explicit add_peer with a stale announce)
+                    self._peers.setdefault(peer, (phost, pport))
+            else:  # legacy hello: bare node name
+                peer = payload
             wlock = threading.Lock()
             while True:
                 kind, rid, payload = _recv_frame(sock)

@@ -35,6 +35,26 @@ class NodeConfig:
 
 
 @dataclass
+class ClusterSeed:
+    node: str = ""  # peer node name, e.g. "n2@127.0.0.1"
+    host: str = "127.0.0.1"
+    port: int = 0  # the peer's cluster bus port
+
+
+@dataclass
+class ClusterConfig:
+    """Config-driven clustering (ekka/mria autocluster analog): the app
+    starts a TcpBus + ClusterNode around its broker, dials the seeds,
+    and joins the first reachable one. Routes replicate and publishes
+    forward over the bus (cluster/node.py)."""
+
+    enable: bool = False
+    bind: str = "127.0.0.1"
+    listen_port: int = 0  # 0 = ephemeral (printed at boot)
+    seeds: List[ClusterSeed] = field(default_factory=list)
+
+
+@dataclass
 class ListenerSpec:
     name: str = "default"
     type: str = "tcp"  # tcp | ssl | ws | wss
@@ -357,6 +377,7 @@ class GatewaySpec:
 @dataclass
 class AppConfig:
     node: NodeConfig = field(default_factory=NodeConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     listeners: List[ListenerSpec] = field(default_factory=lambda: [ListenerSpec()])
     mqtt: MqttCaps = field(default_factory=MqttCaps)
     session: SessionConfig = field(default_factory=SessionConfig)

