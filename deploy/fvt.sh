@@ -11,6 +11,8 @@
 set -u
 cd "$(dirname "$0")/.."
 WORK=$(mktemp -d)
+P1=""
+P2=""
 trap 'kill $P1 $P2 2>/dev/null; wait $P1 $P2 2>/dev/null; rm -rf "$WORK"' EXIT
 
 cat > "$WORK/n1.json" <<EOF

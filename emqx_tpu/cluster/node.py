@@ -105,7 +105,9 @@ class ClusterNode:
                         fut.set_exception(e)
 
                 self._loop.call_soon_threadsafe(run)
-                return fut.result(timeout=30)
+                # generous: a forwarded batch can trigger a jit compile
+                # (~10-40s cold) before the handler returns
+                return fut.result(timeout=120)
             return self.rpc.handle(from_node, payload)
         return None
 
@@ -458,7 +460,39 @@ class ClusterNode:
         msgs = [m for m, _fs in batch]
         # forward=False: this IS the receiving half — re-forwarding here
         # would cascade batches between route owners forever
+        if self._loop is not None:
+            # app mode: the handler runs ON the event loop; the async
+            # dispatch offloads any kernel launch/compile to an executor
+            # thread so the loop (and the sender's bus thread, which
+            # waits on this handler) isn't pinned for a cold compile
+            self._loop.create_task(
+                self._adispatch_forwarded(msgs)
+            )
+            return len(msgs)
         return sum(self.broker.dispatch_batch_folded(msgs, forward=False))
+
+    async def _adispatch_forwarded(self, msgs) -> None:
+        try:
+            r = self.broker.router
+            if r.enable_tpu and len(msgs) >= r.min_tpu_batch:
+                dev = self.broker._device_router()
+                args = dev.prepare()
+                import asyncio as _aio
+
+                results = await _aio.get_running_loop().run_in_executor(
+                    None,
+                    dev.route_prepared,
+                    args,
+                    [m.topic for m in msgs],
+                    self.broker._client_hashes(msgs),
+                )
+                self.broker._dispatch_device_results(
+                    msgs, results, forward=False
+                )
+            else:
+                self.broker.dispatch_batch_folded(msgs, forward=False)
+        except Exception:
+            self.broker.metrics.inc("cluster.forward.dispatch_errors")
 
     # -- channel registry (emqx_cm_registry parity) ------------------------
     def register_channel(self, client_id: str, sid: str) -> None:
@@ -644,13 +678,28 @@ class ClusterNode:
         are QoS1 at-least-once, never loss)."""
         park = self._parked.get(client_id)
         if park is None:
-            return 0
+            # the client resumed HERE between phase 1 and phase 2: its
+            # session routes are live again — re-inject the backlog
+            # through the normal publish path (dup-safe, never dropped)
+            for m in pendings:
+                self.publish(self._msg_from(m))
+            return len(pendings)
         park["pending"].extend(pendings)
         return len(pendings)
 
     def _drain_one(self, peer: str, cid: str, rpc_call) -> bool:
         """Hand one parked session to `peer`; `rpc_call` performs the
-        blocking calls (directly, or via an executor in app mode)."""
+        blocking calls (directly, or via an executor in app mode).
+
+        Ordering: phase 1 makes the peer's park live (messages may now
+        bank on BOTH sides — dups are at-least-once). Our routes then
+        stay up while the bank drains in rounds, so a third node whose
+        route table still lists us keeps landing messages in a bank that
+        WILL be transferred; only once a sweep finds the bank empty do
+        the local routes drop, and a final sweep ships any straggler
+        that raced the drop. The residual window is a forward in flight
+        after the final sweep — the same in-flight bound the resume
+        marker protocol has (emqx_session_router.erl:171-220)."""
         park = self._parked.get(cid)
         if park is None:
             return False
@@ -658,14 +707,14 @@ class ClusterNode:
             peer, "sess", "park_remote", cid, park["session"],
             park["deadline"],
         )
-        # peer's routes + ownership are live; drop ours, THEN flush the
-        # bank — a message in the gap forwards to the peer (new owner),
-        # one before it banks here and transfers below
+        while park["pending"]:
+            batch, park["pending"] = park["pending"], []
+            rpc_call(peer, "sess", "park_append", cid, batch)
         sid = f"parked:{cid}"
         for f in park["session"].get("subscriptions", {}):
             self.unsubscribe(sid, f)
         self._parked.pop(cid, None)
-        if park["pending"]:
+        if park["pending"]:  # raced the route drop: final sweep
             rpc_call(
                 peer, "sess", "park_append", cid, list(park["pending"])
             )
@@ -712,16 +761,26 @@ class ClusterNode:
                     park["session"], park["deadline"],
                 ),
             )
+            # drain the bank in rounds with routes still up (see
+            # _drain_one's ordering comment), then drop + final sweep
+            while park["pending"]:
+                batch, park["pending"] = park["pending"], []
+                await loop.run_in_executor(
+                    None,
+                    functools.partial(
+                        rpc_sync, peer, "sess", "park_append", cid, batch
+                    ),
+                )
             sid = f"parked:{cid}"
             for f in park["session"].get("subscriptions", {}):
                 self.unsubscribe(sid, f)
             self._parked.pop(cid, None)
-            pend = list(park["pending"])
-            if pend:
+            if park["pending"]:
                 await loop.run_in_executor(
                     None,
                     functools.partial(
-                        rpc_sync, peer, "sess", "park_append", cid, pend
+                        rpc_sync, peer, "sess", "park_append", cid,
+                        list(park["pending"]),
                     ),
                 )
             n += 1

@@ -1,0 +1,270 @@
+/* Native MQTT wire codec: the host data plane's hot path in C.
+ *
+ * The reference's codec is BEAM-native binary pattern matching
+ * (apps/emqx/src/emqx_frame.erl:115-170 parse, :559-580 serialize);
+ * a Python host pays ~10-20us per packet in pure-Python parsing. This
+ * extension does the three per-message operations in C:
+ *
+ *   split_frames(buf, max_size)    -> ([(header, body_bytes)...], consumed)
+ *   parse_publish(flags, body, v5) -> (topic, pid|None, props|None, payload)
+ *   serialize_publish(topic_utf8, payload, qos, retain, dup, pid, props)
+ *                                  -> complete wire frame, one allocation
+ *
+ * Anything outside the hot path (CONNECT, SUBSCRIBE, v5 property maps)
+ * stays in the Python reference codec (emqx_tpu/mqtt/frame.py), which
+ * differentially tests this module.  Built with the CPython C API —
+ * no third-party binding dependency.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* -- varint ---------------------------------------------------------- */
+
+static int
+read_varint(const unsigned char *p, Py_ssize_t len, Py_ssize_t off,
+            unsigned int *val, Py_ssize_t *end)
+{
+    unsigned int mult = 1, v = 0;
+    for (int i = 0; i < 4; i++) {
+        if (off + i >= len)
+            return 1; /* need more */
+        unsigned char b = p[off + i];
+        v += (unsigned int)(b & 0x7F) * mult;
+        if (!(b & 0x80)) {
+            *val = v;
+            *end = off + i + 1;
+            return 0;
+        }
+        mult *= 128;
+    }
+    return -1; /* malformed */
+}
+
+static Py_ssize_t
+write_varint(unsigned char *out, unsigned int n)
+{
+    Py_ssize_t i = 0;
+    do {
+        unsigned char b = n % 128;
+        n /= 128;
+        out[i++] = n ? (b | 0x80) : b;
+    } while (n);
+    return i;
+}
+
+/* -- split_frames ----------------------------------------------------- */
+
+static PyObject *
+split_frames(PyObject *self, PyObject *args)
+{
+    Py_buffer view;
+    unsigned long max_size;
+    if (!PyArg_ParseTuple(args, "y*k", &view, &max_size))
+        return NULL;
+    const unsigned char *p = (const unsigned char *)view.buf;
+    Py_ssize_t len = view.len, off = 0;
+    PyObject *frames = PyList_New(0);
+    if (!frames) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    while (len - off >= 2) {
+        unsigned int rem;
+        Py_ssize_t body_off;
+        int rc = read_varint(p, len, off + 1, &rem, &body_off);
+        if (rc == 1)
+            break; /* partial varint */
+        if (rc < 0) {
+            Py_DECREF(frames);
+            PyBuffer_Release(&view);
+            PyErr_SetString(PyExc_ValueError, "malformed_varint");
+            return NULL;
+        }
+        if (rem > max_size) {
+            Py_DECREF(frames);
+            PyBuffer_Release(&view);
+            PyErr_SetString(PyExc_ValueError, "frame_too_large");
+            return NULL;
+        }
+        if (body_off + (Py_ssize_t)rem > len)
+            break; /* partial body */
+        PyObject *body = PyBytes_FromStringAndSize(
+            (const char *)p + body_off, (Py_ssize_t)rem);
+        if (!body)
+            goto fail;
+        PyObject *tup = Py_BuildValue("(iN)", (int)p[off], body);
+        if (!tup)
+            goto fail;
+        if (PyList_Append(frames, tup) < 0) {
+            Py_DECREF(tup);
+            goto fail;
+        }
+        Py_DECREF(tup);
+        off = body_off + (Py_ssize_t)rem;
+    }
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(Nn)", frames, off);
+fail:
+    Py_DECREF(frames);
+    PyBuffer_Release(&view);
+    return NULL;
+}
+
+/* -- parse_publish ----------------------------------------------------- */
+
+static PyObject *
+parse_publish(PyObject *self, PyObject *args)
+{
+    int flags, v5;
+    Py_buffer view;
+    if (!PyArg_ParseTuple(args, "iy*i", &flags, &view, &v5))
+        return NULL;
+    const unsigned char *p = (const unsigned char *)view.buf;
+    Py_ssize_t len = view.len, off = 0;
+    int qos = (flags >> 1) & 3;
+    if (qos == 3) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError, "bad_qos");
+        return NULL;
+    }
+    if (len < 2)
+        goto truncated;
+    Py_ssize_t tlen = ((Py_ssize_t)p[0] << 8) | p[1];
+    off = 2;
+    if (off + tlen > len)
+        goto truncated;
+    PyObject *topic = PyUnicode_DecodeUTF8(
+        (const char *)p + off, tlen, "strict");
+    if (!topic) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    off += tlen;
+    PyObject *pid = Py_None;
+    Py_INCREF(Py_None);
+    if (qos > 0) {
+        if (off + 2 > len) {
+            Py_DECREF(topic);
+            Py_DECREF(pid);
+            goto truncated;
+        }
+        Py_DECREF(pid);
+        pid = PyLong_FromLong(((long)p[off] << 8) | p[off + 1]);
+        off += 2;
+    }
+    PyObject *props = Py_None;
+    Py_INCREF(Py_None);
+    if (v5) {
+        unsigned int plen;
+        Py_ssize_t pend;
+        int rc = read_varint(p, len, off, &plen, &pend);
+        if (rc != 0 || pend + (Py_ssize_t)plen > len) {
+            Py_DECREF(topic);
+            Py_DECREF(pid);
+            Py_DECREF(props);
+            goto truncated;
+        }
+        if (plen > 0) {
+            Py_DECREF(props);
+            props = PyBytes_FromStringAndSize(
+                (const char *)p + pend, (Py_ssize_t)plen);
+            if (!props) {
+                Py_DECREF(topic);
+                Py_DECREF(pid);
+                PyBuffer_Release(&view);
+                return NULL;
+            }
+        }
+        off = pend + (Py_ssize_t)plen;
+    }
+    PyObject *payload = PyBytes_FromStringAndSize(
+        (const char *)p + off, len - off);
+    PyBuffer_Release(&view);
+    if (!payload) {
+        Py_DECREF(topic);
+        Py_DECREF(pid);
+        Py_DECREF(props);
+        return NULL;
+    }
+    return Py_BuildValue("(NNNN)", topic, pid, props, payload);
+truncated:
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "frame_truncated");
+    return NULL;
+}
+
+/* -- serialize_publish -------------------------------------------------- */
+
+static PyObject *
+serialize_publish(PyObject *self, PyObject *args)
+{
+    Py_buffer topic, payload, props;
+    int qos, retain, dup, pid, v5;
+    if (!PyArg_ParseTuple(args, "y*y*iiiiy*i", &topic, &payload, &qos,
+                          &retain, &dup, &pid, &props, &v5))
+        return NULL;
+    if (topic.len > 0xFFFF) {
+        PyBuffer_Release(&topic);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&props);
+        PyErr_SetString(PyExc_ValueError, "utf8_string_too_long");
+        return NULL;
+    }
+    /* body = topic_len(2) + topic + [pid(2)] + [props] + payload */
+    Py_ssize_t body = 2 + topic.len + (qos > 0 ? 2 : 0)
+                      + (v5 ? props.len : 0) + payload.len;
+    unsigned char hdr[6];
+    hdr[0] = (unsigned char)((3 << 4) | ((dup ? 1 : 0) << 3)
+                             | ((qos & 3) << 1) | (retain ? 1 : 0));
+    Py_ssize_t vlen = write_varint(hdr + 1, (unsigned int)body);
+    PyObject *out = PyBytes_FromStringAndSize(NULL, 1 + vlen + body);
+    if (!out) {
+        PyBuffer_Release(&topic);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&props);
+        return NULL;
+    }
+    unsigned char *w = (unsigned char *)PyBytes_AS_STRING(out);
+    memcpy(w, hdr, 1 + vlen);
+    w += 1 + vlen;
+    *w++ = (unsigned char)(topic.len >> 8);
+    *w++ = (unsigned char)(topic.len & 0xFF);
+    memcpy(w, topic.buf, topic.len);
+    w += topic.len;
+    if (qos > 0) {
+        *w++ = (unsigned char)((pid >> 8) & 0xFF);
+        *w++ = (unsigned char)(pid & 0xFF);
+    }
+    if (v5) {
+        memcpy(w, props.buf, props.len);
+        w += props.len;
+    }
+    memcpy(w, payload.buf, payload.len);
+    PyBuffer_Release(&topic);
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&props);
+    return out;
+}
+
+/* -- module ----------------------------------------------------------- */
+
+static PyMethodDef methods[] = {
+    {"split_frames", split_frames, METH_VARARGS,
+     "split_frames(buf, max_size) -> ([(header, body)...], consumed)"},
+    {"parse_publish", parse_publish, METH_VARARGS,
+     "parse_publish(flags, body, v5) -> (topic, pid, props_raw, payload)"},
+    {"serialize_publish", serialize_publish, METH_VARARGS,
+     "serialize_publish(topic, payload, qos, retain, dup, pid, props, v5)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_codec", "native MQTT wire codec", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__codec(void)
+{
+    return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,90 @@
+"""Loader for the native MQTT codec (_codec.c).
+
+Builds the C extension on first import when a compiler is available and
+no prebuilt .so exists (cc -O2 -shared, ~1s; cached next to the source),
+then exposes `split_frames` / `parse_publish` / `serialize_publish`.
+`available` is False when the build fails or the platform lacks a
+toolchain — callers (mqtt/frame.py) fall back to the pure-Python
+reference codec, which stays the semantic source of truth and
+differentially tests this module (tests/test_codec_native.py).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+
+log = logging.getLogger("emqx_tpu.codec")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_codec.c")
+_SO = os.path.join(
+    _DIR, "_codec" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
+)
+
+available = False
+split_frames = None
+parse_publish = None
+serialize_publish = None
+
+
+def _build() -> bool:
+    cc = os.environ.get("CC", "cc")
+    inc = sysconfig.get_path("include")
+    cmd = [
+        cc, "-O2", "-fPIC", "-shared", "-o", _SO, _SRC, f"-I{inc}",
+    ]
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info("native codec build unavailable: %s", e)
+        return False
+    if r.returncode != 0:
+        log.info("native codec build failed: %s", r.stderr[-500:])
+        return False
+    return True
+
+
+def _load() -> None:
+    global available, split_frames, parse_publish, serialize_publish
+    if not os.path.exists(_SO) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+    ):
+        if not _build():
+            return
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "emqx_tpu.mqtt._codec", _SO
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as e:  # corrupt/ABI-mismatched .so: rebuild once
+        log.info("native codec load failed (%s); rebuilding", e)
+        try:
+            os.unlink(_SO)
+        except OSError:
+            pass
+        if not _build():
+            return
+        spec = importlib.util.spec_from_file_location(
+            "emqx_tpu.mqtt._codec", _SO
+        )
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+        except Exception:
+            return
+    split_frames = mod.split_frames
+    parse_publish = mod.parse_publish
+    serialize_publish = mod.serialize_publish
+    available = True
+
+
+if os.environ.get("EMQX_TPU_NO_NATIVE_CODEC") != "1":
+    _load()

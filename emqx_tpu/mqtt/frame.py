@@ -7,9 +7,12 @@ size and varint bounds, parses/serializes v3.1, v3.1.1 and v5 packets
 including MQTT5 properties, and auto-switches the session's protocol version
 when CONNECT is seen.
 
-Python reference implementation; the C++ codec in
-`emqx_tpu/mqtt/codec_native` accelerates the same wire format and is
-differentially tested against this module.
+This module is the semantic SOURCE OF TRUTH; the C extension
+(`emqx_tpu/mqtt/_codec.c`, loaded via `codec_native`) accelerates the
+hot path — frame splitting and PUBLISH parse/serialize — and is
+differentially tested against it (tests/test_codec_native.py). Anything
+the native path cannot express exactly (strict-mode errors, v5 property
+maps, exotic inputs) falls back here.
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ from __future__ import annotations
 import struct
 from typing import List, Optional, Tuple
 
+from emqx_tpu.mqtt import codec_native as _nc
 from emqx_tpu.mqtt import packet as pkt
 
 
@@ -198,15 +202,82 @@ class Parser:
         self.max_size = max_size
         self.strict = strict
         self._buf = bytearray()
+        # bytes needed to complete the frame at the buffer head (None =
+        # unknown): lets feed() skip re-copy/re-scan of a growing buffer
+        # while a large fragmented frame accumulates
+        self._need: Optional[int] = None
 
     def feed(self, data: bytes) -> List[pkt.Packet]:
         self._buf += data
         out: List[pkt.Packet] = []
+        if _nc.available:
+            # native frame split + PUBLISH fast path (one C call per
+            # read instead of per-byte python varint walking). While a
+            # large frame is known-incomplete, skip the copy + rescan
+            # entirely (a fragmented 100MB PUBLISH would otherwise
+            # re-copy the growing buffer on every TCP segment).
+            if self._need is not None and len(self._buf) < self._need:
+                return out
+            self._need = None
+            try:
+                frames, consumed = _nc.split_frames(
+                    bytes(self._buf), self.max_size
+                )
+            except ValueError as e:
+                raise FrameError(str(e))
+            del self._buf[:consumed]
+            if len(self._buf) >= 2:
+                try:
+                    rem, body_off = decode_varint(self._buf, 1)
+                    if rem > self.max_size:
+                        raise FrameError("frame_too_large", size=rem)
+                    self._need = body_off + rem
+                except _NeedMore:
+                    self._need = None  # header itself incomplete
+            for header, body in frames:
+                ptype, flags = header >> 4, header & 0x0F
+                if ptype == pkt.PUBLISH:
+                    out.append(self._p_publish_native(flags, body))
+                else:
+                    out.append(
+                        self._parse_packet(ptype, flags, memoryview(body))
+                    )
+            return out
         while True:
             p = self._try_parse_one()
             if p is None:
                 return out
             out.append(p)
+
+    def _p_publish_native(self, flags: int, body: bytes) -> pkt.Publish:
+        """PUBLISH via the C parser; strict-mode checks and v5 property
+        decoding stay in Python. Any native rejection re-runs the python
+        parser so error reasons match the reference codec exactly."""
+        v5 = self.version == pkt.MQTT_V5
+        try:
+            topic, packet_id, props_raw, payload = _nc.parse_publish(
+                flags, body, 1 if v5 else 0
+            )
+        except (ValueError, UnicodeDecodeError):
+            return self._p_publish(flags, memoryview(body))
+        if self.strict and ("#" in topic or "+" in topic):
+            raise FrameError("topic_name_with_wildcard", topic=topic)
+        if self.strict and packet_id == 0 and ((flags >> 1) & 3) > 0:
+            raise FrameError("zero_packet_id")
+        props: pkt.Properties = {}
+        if props_raw is not None:
+            props, _ = decode_properties(
+                memoryview(encode_varint(len(props_raw)) + props_raw), 0
+            )
+        return pkt.Publish(
+            topic=topic,
+            payload=payload,
+            qos=(flags >> 1) & 3,
+            retain=bool(flags & 1),
+            dup=bool(flags & 8),
+            packet_id=packet_id,
+            properties=props,
+        )
 
     def _try_parse_one(self) -> Optional[pkt.Packet]:
         buf = self._buf
@@ -498,10 +569,24 @@ def serialize(p, version: int = pkt.MQTT_V4) -> bytes:
         flags = (
             (0x8 if p.dup else 0) | (p.qos << 1) | (0x1 if p.retain else 0)
         )
+        if p.qos > 0 and not p.packet_id:
+            raise FrameError("missing_packet_id")
+        if _nc.available:
+            try:
+                return _nc.serialize_publish(
+                    p.topic.encode("utf-8"),
+                    p.payload or b"",
+                    p.qos,
+                    1 if p.retain else 0,
+                    1 if p.dup else 0,
+                    p.packet_id or 0,
+                    encode_properties(p.properties) if v5 else b"",
+                    1 if v5 else 0,
+                )
+            except ValueError as e:
+                raise FrameError(str(e))
         body = bytearray(encode_utf8(p.topic))
         if p.qos > 0:
-            if not p.packet_id:
-                raise FrameError("missing_packet_id")
             body += struct.pack(">H", p.packet_id)
         if v5:
             body += encode_properties(p.properties)

@@ -108,7 +108,7 @@ def unpack_pub_batch(body: bytes) -> List[Tuple[str, bytes, int, bool, bool, str
 
 def pack_dlv_batch(records) -> bytes:
     """records: [(msg, [handle, ...])]"""
-    parts = [b""]
+    out = bytearray(9)  # frame header (5) + count (4), patched below
     n = 0
     for m, handles in records:
         t = m.topic.encode()
@@ -117,20 +117,22 @@ def pack_dlv_batch(records) -> bytes:
         flags = (m.qos & 3) | (4 if m.retain else 0) | (
             8 if m.headers.get("retained") else 0
         )
+        head = (
+            _U16.pack(len(t)) + t + _U32.pack(len(p)) + p
+            + bytes([flags]) + _U16.pack(len(c)) + c
+        )
         # ntargets is u16: split monster fan-outs across records rather
         # than raise mid-flush (a 10M-sub broker CAN put >65535 matching
         # subscriptions on one worker)
         for lo in range(0, len(handles), 0xFFFF):
             chunk = handles[lo : lo + 0xFFFF]
-            parts.append(
-                _U16.pack(len(t)) + t + _U32.pack(len(p)) + p
-                + bytes([flags]) + _U16.pack(len(c)) + c
-                + _U16.pack(len(chunk))
-                + b"".join(_U32.pack(h) for h in chunk)
-            )
+            out += head
+            out += _U16.pack(len(chunk))
+            out += struct.pack(f"<{len(chunk)}I", *chunk)
             n += 1
-    parts[0] = _U32.pack(n)
-    return pack_frame(T_DLV, b"".join(parts))
+    out[0:5] = _HDR.pack(len(out) - 5, T_DLV)
+    out[5:9] = _U32.pack(n)
+    return bytes(out)
 
 
 def unpack_dlv_batch(body: bytes):
