@@ -286,7 +286,9 @@ class Broker:
         )
         return self._dispatch_device_results(msgs, results, forward)
 
-    async def adispatch_batch_folded(self, msgs: Sequence[Message]) -> List[int]:
+    async def adispatch_batch_folded(
+        self, msgs: Sequence[Message], forward: bool = True
+    ) -> List[int]:
         """`dispatch_batch_folded` with the kernel launch + readback (and
         any jit recompile, which can take tens of seconds on a real chip)
         offloaded to an executor thread so the event loop keeps serving
@@ -294,7 +296,7 @@ class Broker:
         the loop thread — they touch mutable broker state."""
         r = self.router
         if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
-            return [self._dispatch_routed(m) for m in msgs]
+            return self.dispatch_batch_folded(msgs, forward)
         dev = self._device_router()
         args = dev.prepare()
         results = await asyncio.get_running_loop().run_in_executor(
@@ -304,7 +306,7 @@ class Broker:
             [m.topic for m in msgs],
             self._client_hashes(msgs),
         )
-        return self._dispatch_device_results(msgs, results)
+        return self._dispatch_device_results(msgs, results, forward)
 
     def _device_router(self):
         if self._device is None:

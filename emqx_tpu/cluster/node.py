@@ -74,6 +74,10 @@ class ClusterNode:
         # locally parked sessions + the replicated clientid -> owner map
         self._parked: Dict[str, Dict] = {}
         self._parked_owner: Dict[str, str] = {}
+        # guards park["pending"] swaps vs concurrent banking: in library
+        # (sync) mode rpc handlers run on bus threads while drain_to
+        # runs on the caller thread
+        self._park_lock = threading.Lock()
         # (real, group) -> set of nodes holding members; the MIN node is
         # the group leader and the only one that dispatches — a group
         # spanning nodes delivers exactly once (emqx_shared_sub's
@@ -94,13 +98,40 @@ class ClusterNode:
             return self.membership.handle(from_node, payload)
         if kind == "rpc":
             if self._loop is not None and not self._loop.is_closed():
+                import asyncio as _aio
                 import concurrent.futures
 
                 fut: concurrent.futures.Future = concurrent.futures.Future()
 
                 def run():
                     try:
-                        fut.set_result(self.rpc.handle(from_node, payload))
+                        res = self.rpc.handle(from_node, payload)
+                        # ASYNC handler (e.g. forward_batch's device
+                        # dispatch): the reply — and thus the sender's
+                        # QoS1 confirm — resolves only after the actual
+                        # dispatch completes, while the loop stays free
+                        if (
+                            isinstance(res, tuple)
+                            and len(res) == 2
+                            and res[0] == "ok"
+                            and _aio.iscoroutine(res[1])
+                        ):
+                            t = self._loop.create_task(res[1])
+
+                            def done(t):
+                                exc = (
+                                    t.exception()
+                                    if not t.cancelled()
+                                    else _aio.CancelledError()
+                                )
+                                if exc:
+                                    fut.set_exception(exc)
+                                else:
+                                    fut.set_result(("ok", t.result()))
+
+                            t.add_done_callback(done)
+                        else:
+                            fut.set_result(res)
                     except BaseException as e:  # reply errors to caller
                         fut.set_exception(e)
 
@@ -461,38 +492,16 @@ class ClusterNode:
         # forward=False: this IS the receiving half — re-forwarding here
         # would cascade batches between route owners forever
         if self._loop is not None:
-            # app mode: the handler runs ON the event loop; the async
-            # dispatch offloads any kernel launch/compile to an executor
-            # thread so the loop (and the sender's bus thread, which
-            # waits on this handler) isn't pinned for a cold compile
-            self._loop.create_task(
-                self._adispatch_forwarded(msgs)
-            )
-            return len(msgs)
+            # app mode: return a coroutine — the rpc marshal resolves the
+            # reply when the dispatch ACTUALLY completes (QoS1 confirm =
+            # delivered/banked) while any kernel launch/compile runs in
+            # an executor thread, keeping the event loop free
+            return self._afwd(msgs)
         return sum(self.broker.dispatch_batch_folded(msgs, forward=False))
 
-    async def _adispatch_forwarded(self, msgs) -> None:
-        try:
-            r = self.broker.router
-            if r.enable_tpu and len(msgs) >= r.min_tpu_batch:
-                dev = self.broker._device_router()
-                args = dev.prepare()
-                import asyncio as _aio
-
-                results = await _aio.get_running_loop().run_in_executor(
-                    None,
-                    dev.route_prepared,
-                    args,
-                    [m.topic for m in msgs],
-                    self.broker._client_hashes(msgs),
-                )
-                self.broker._dispatch_device_results(
-                    msgs, results, forward=False
-                )
-            else:
-                self.broker.dispatch_batch_folded(msgs, forward=False)
-        except Exception:
-            self.broker.metrics.inc("cluster.forward.dispatch_errors")
+    async def _afwd(self, msgs) -> int:
+        res = await self.broker.adispatch_batch_folded(msgs, forward=False)
+        return sum(res)
 
     # -- channel registry (emqx_cm_registry parity) ------------------------
     def register_channel(self, client_id: str, sid: str) -> None:
@@ -574,7 +583,8 @@ class ClusterNode:
             qos = min(msg.qos, opts.qos)
             if qos == 0:
                 return
-            park["pending"].append(msg_to_json(msg))
+            with self._park_lock:
+                park["pending"].append(msg_to_json(msg))
 
         for f, opts_json in session_json.get("subscriptions", {}).items():
             self.subscribe(sid, client_id, f, subopts_from_json(opts_json), deliver)
@@ -648,7 +658,8 @@ class ClusterNode:
         if park is None:
             return None
         park["marker"] = to_node
-        pending, park["pending"] = park["pending"], []
+        with self._park_lock:
+            pending, park["pending"] = park["pending"], []
         return park["session"], pending
 
     def _proto_resume_end(self, client_id: str):
@@ -684,7 +695,8 @@ class ClusterNode:
             for m in pendings:
                 self.publish(self._msg_from(m))
             return len(pendings)
-        park["pending"].extend(pendings)
+        with self._park_lock:
+            park["pending"].extend(pendings)
         return len(pendings)
 
     def _drain_one(self, peer: str, cid: str, rpc_call) -> bool:
@@ -708,7 +720,8 @@ class ClusterNode:
             park["deadline"],
         )
         while park["pending"]:
-            batch, park["pending"] = park["pending"], []
+            with self._park_lock:
+                batch, park["pending"] = park["pending"], []
             rpc_call(peer, "sess", "park_append", cid, batch)
         sid = f"parked:{cid}"
         for f in park["session"].get("subscriptions", {}):
@@ -764,7 +777,8 @@ class ClusterNode:
             # drain the bank in rounds with routes still up (see
             # _drain_one's ordering comment), then drop + final sweep
             while park["pending"]:
-                batch, park["pending"] = park["pending"], []
+                with self._park_lock:
+                    batch, park["pending"] = park["pending"], []
                 await loop.run_in_executor(
                     None,
                     functools.partial(

@@ -40,6 +40,10 @@ from emqx_tpu.utils.node import node_name
 # from it (emqx_dashboard_swagger generates both from one schema source)
 ROUTES = [
     ("get", "/api/v5/status", "status", "Node and broker liveness", "node"),
+    ("get", "/api/v5/cluster", "cluster_info", "Cluster membership", "node"),
+    ("post", "/api/v5/nodes/drain", "node_drain",
+     "Drain this node: stop accepting, park/hand off sessions "
+     "(rolling-upgrade orchestration)", "node"),
     ("get", "/api/v5/metrics", "metrics", "Counter metrics", "metrics"),
     ("get", "/api/v5/stats", "stats", "Gauge statistics", "metrics"),
     ("get", "/api/v5/clients", "clients", "List connected clients", "clients"),
@@ -259,6 +263,35 @@ class MgmtApi:
                 "retained": len(self.app.retainer),
             }
         )
+
+    async def cluster_info(self, request):
+        """Membership + route-table view (emqx_mgmt_api_nodes analog)."""
+        node = getattr(self.app, "cluster_node", None)
+        if node is None:
+            return web.json_response(
+                {"enabled": False, "nodes": [node_name()]}
+            )
+        return web.json_response(
+            {
+                "enabled": True,
+                "name": node.name,
+                "running_nodes": node.membership.running_nodes(),
+                "stats": node.stats(),
+            }
+        )
+
+    async def node_drain(self, request):
+        """Rolling-upgrade drain (see BrokerApp.drain): body may name the
+        handoff peer ({"peer": "n2@host"}); defaults to the first live
+        peer. The caller stops/replaces the process afterwards."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        out = await self.app.drain(peer=body.get("peer"))
+        return web.json_response(out)
 
     async def metrics(self, request):
         return web.json_response(self.broker.metrics.snapshot())

@@ -114,8 +114,13 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if cmd in ("status", "metrics", "stats", "subscriptions", "routes", "configs"):
+    if cmd in ("status", "metrics", "stats", "subscriptions", "routes",
+               "configs", "cluster"):
         code, out = _call(f"{base}/{cmd}", a.key)
+    elif cmd == "drain":
+        # `emqx_tpu_ctl drain [peer_node]` — rolling-upgrade drain
+        body = {"peer": rest[0]} if rest else {}
+        code, out = _call(f"{base}/nodes/drain", a.key, "POST", body)
     elif cmd == "clients":
         code, out = _call(f"{base}/clients", a.key)
     elif cmd == "client":

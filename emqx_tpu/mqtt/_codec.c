@@ -214,6 +214,13 @@ serialize_publish(PyObject *self, PyObject *args)
     /* body = topic_len(2) + topic + [pid(2)] + [props] + payload */
     Py_ssize_t body = 2 + topic.len + (qos > 0 ? 2 : 0)
                       + (v5 ? props.len : 0) + payload.len;
+    if (body > 0xFFFFFFF) { /* varint ceiling, matching encode_varint */
+        PyBuffer_Release(&topic);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&props);
+        PyErr_SetString(PyExc_ValueError, "varint_out_of_range");
+        return NULL;
+    }
     unsigned char hdr[6];
     hdr[0] = (unsigned char)((3 << 4) | ((dup ? 1 : 0) << 3)
                              | ((qos & 3) << 1) | (retain ? 1 : 0));

@@ -34,8 +34,11 @@ serialize_publish = None
 def _build() -> bool:
     cc = os.environ.get("CC", "cc")
     inc = sysconfig.get_path("include")
+    # compile to a per-pid temp then rename: N worker processes may race
+    # the first build, and a sibling must never dlopen a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = [
-        cc, "-O2", "-fPIC", "-shared", "-o", _SO, _SRC, f"-I{inc}",
+        cc, "-O2", "-fPIC", "-shared", "-o", tmp, _SRC, f"-I{inc}",
     ]
     try:
         r = subprocess.run(
@@ -46,7 +49,12 @@ def _build() -> bool:
         return False
     if r.returncode != 0:
         log.info("native codec build failed: %s", r.stderr[-500:])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+    os.replace(tmp, _SO)  # atomic on POSIX
     return True
 
 

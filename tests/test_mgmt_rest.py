@@ -331,3 +331,36 @@ async def test_new_endpoints_in_openapi():
             assert p in paths, p
     finally:
         await app.stop()
+
+
+@async_test
+async def test_cluster_info_and_drain_endpoints():
+    """GET /cluster reflects membership state; POST /nodes/drain runs the
+    rolling-upgrade orchestration (r3 verdict item 7's control surface)."""
+    app = BrokerApp(_app_config(session={"expiry_interval": 3600}))
+    await app.start()
+    try:
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/cluster") as r:
+                body = await r.json()
+                assert r.status == 200 and body["enabled"] is False
+
+            # persistent session to be parked by the drain
+            port = list(app.listeners.list().values())[0].port
+            c = Client("drainee", version=pkt.MQTT_V5, clean_start=False,
+                       properties={"Session-Expiry-Interval": 3600})
+            await c.connect("127.0.0.1", port)
+            await c.subscribe("d/#", qos=1)
+            await c.disconnect()
+            await asyncio.sleep(0.05)
+
+            async with s.post(f"{api}/nodes/drain", json={}) as r:
+                body = await r.json()
+                assert r.status == 200
+                assert body["detached_sessions"] == 1
+            # drained: the MQTT listener no longer accepts
+            with pytest.raises(OSError):
+                await asyncio.open_connection("127.0.0.1", port)
+    finally:
+        await app.stop()
