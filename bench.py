@@ -352,56 +352,87 @@ def bench_config(name, rng, measure_updates=False):
     _mark(f"{name}: latency done; updates={measure_updates}")
     upd_s = None
     vis_ms = None
-    if measure_updates:
-        # delta-overlay update cost: one subscribe + device sync, post-warm
-        # (incl. host-mirror materialization, which the cold bulk load
-        # defers — a live broker pays it on its first churn op, not per op)
-        from emqx_tpu.ops.nfa import DeviceDeltaSync
-
-        sync = DeviceDeltaSync()
-        sync.sync(index.shapes)
-        index.add("warmmat/0/+/x/#")  # materialize lazy host mirrors
-        sync.sync(index.shapes)
-        t1 = time.perf_counter()
-        n_upd = 50
-        for i in range(n_upd):
-            index.add(f"delta/{i}/+/x/#")
-            sync.sync(index.shapes)
-        upd_s = (time.perf_counter() - t1) / n_upd
-
-        # SUBSCRIBE-VISIBILITY at full scale (r3 verdict item 6): wall
-        # time from a fresh subscribe (host add) to a ROUTED batch whose
-        # kernel provably matches it — the serving pipeline syncs deltas
-        # at every batch's prepare(), so this is the whole non-delivery
-        # window a new subscriber can observe. Uses a shape family the
-        # table already holds (a NEW shape would pay a one-off ~10-40s
-        # XLA recompile, which is a different, once-per-shape cost).
-        vtopic = ["delta/vis/q/x/tail"] * BATCH
-        vb, vl, _ = encode_topics(vtopic, MAX_BYTES)
-
-        def vis_step(tabs):
-            return shape_route_step(
-                tabs,
-                nfa_tables,
-                None,
-                vb,
-                vl,
-                m_active=index.shapes.m_active(),
-                with_nfa=with_nfa,
-                salt=index.salt,
-                **CFG,
+    # NON-FATAL phase: the dev tunnel occasionally drops a remote_compile
+    # mid-body; losing the OPTIONAL update/visibility fields must never
+    # lose the whole config's captured throughput (r3's one lesson)
+    try:
+        if measure_updates:
+            upd_s, vis_ms = _measure_updates(
+                index, nfa_tables, with_nfa
             )
+    except AssertionError:
+        raise  # correctness gate (visibility/mcount), never optional
+    except Exception as e:
+        _mark(f"{name}: update/visibility phase failed ({e!r}); continuing")
+    return _bench_config_tail(
+        name, index, filters, topics, spf, insert_s, stage, step, tpu_rps,
+        lats, upd_s, vis_ms, hbm_mb, shape_tables, nfa_tables, sub_bitmaps,
+    )
 
-        # warm the (tables, batch, no-bitmaps) signature: the one-off XLA
-        # compile (~4s) is a different cost than the per-subscribe window
-        o = vis_step(sync.sync(index.shapes))
-        assert int(np.asarray(o["mcount"])[0]) == 0  # not subscribed yet
-        t1 = time.perf_counter()
-        index.add("delta/vis/+/x/#")
-        vo = vis_step(sync.sync(index.shapes))
-        vmc = int(np.asarray(vo["mcount"])[0])
-        vis_ms = (time.perf_counter() - t1) * 1e3
-        assert vmc >= 1, "fresh subscription not visible to the kernel"
+
+def _measure_updates(index, nfa_tables, with_nfa):
+    """Update-sync + subscribe-visibility measurements (mixed configs)."""
+    import jax  # noqa: F401  (device work below)
+
+    from emqx_tpu.models.router_model import shape_route_step
+    from emqx_tpu.ops.tokenizer import encode_topics
+
+    # delta-overlay update cost: one subscribe + device sync, post-warm
+    # (incl. host-mirror materialization, which the cold bulk load
+    # defers — a live broker pays it on its first churn op, not per op)
+    from emqx_tpu.ops.nfa import DeviceDeltaSync
+
+    sync = DeviceDeltaSync()
+    sync.sync(index.shapes)
+    index.add("warmmat/0/+/x/#")  # materialize lazy host mirrors
+    sync.sync(index.shapes)
+    t1 = time.perf_counter()
+    n_upd = 50
+    for i in range(n_upd):
+        index.add(f"delta/{i}/+/x/#")
+        sync.sync(index.shapes)
+    upd_s = (time.perf_counter() - t1) / n_upd
+
+    # SUBSCRIBE-VISIBILITY at full scale (r3 verdict item 6): wall
+    # time from a fresh subscribe (host add) to a ROUTED batch whose
+    # kernel provably matches it — the serving pipeline syncs deltas
+    # at every batch's prepare(), so this is the whole non-delivery
+    # window a new subscriber can observe. Uses a shape family the
+    # table already holds (a NEW shape would pay a one-off ~10-40s
+    # XLA recompile, which is a different, once-per-shape cost).
+    vtopic = ["delta/vis/q/x/tail"] * BATCH
+    vb, vl, _ = encode_topics(vtopic, MAX_BYTES)
+
+    def vis_step(tabs):
+        return shape_route_step(
+            tabs,
+            nfa_tables,
+            None,
+            vb,
+            vl,
+            m_active=index.shapes.m_active(),
+            with_nfa=with_nfa,
+            salt=index.salt,
+            **CFG,
+        )
+
+    # warm the (tables, batch, no-bitmaps) signature: the one-off XLA
+    # compile (~4s) is a different cost than the per-subscribe window
+    o = vis_step(sync.sync(index.shapes))
+    assert int(np.asarray(o["mcount"])[0]) == 0  # not subscribed yet
+    t1 = time.perf_counter()
+    index.add("delta/vis/+/x/#")
+    vo = vis_step(sync.sync(index.shapes))
+    vmc = int(np.asarray(vo["mcount"])[0])
+    vis_ms = (time.perf_counter() - t1) * 1e3
+    assert vmc >= 1, "fresh subscription not visible to the kernel"
+    return upd_s, vis_ms
+
+
+def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
+                       step, tpu_rps, lats, upd_s, vis_ms, hbm_mb,
+                       shape_tables, nfa_tables, sub_bitmaps):
+    import jax  # noqa: F401
 
     _mark(f"{name}: cpu baseline + correctness")
     # flagged rows (frontier / depth overflow) fall back per-row on the

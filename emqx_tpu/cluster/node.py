@@ -61,6 +61,14 @@ class ClusterNode:
             if loop is not None
             else None
         )
+        # forwards get their OWN ordered worker: a slow receiver (cold
+        # jit compile holds the confirmed reply up to ~40s) must not
+        # stall route replication / shared-group / drain traffic
+        self._fwd_pool = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"fwd-{name}")
+            if loop is not None
+            else None
+        )
         self.broker = broker or Broker()
         self.routes = ClusterRouteTable(name)
         self.membership = Membership(name, bus, clock=clock)
@@ -273,6 +281,9 @@ class ClusterNode:
         if self._repl_pool is not None:
             self._repl_pool.shutdown(wait=True)  # flush pending replication
             self._repl_pool = None
+        if self._fwd_pool is not None:
+            self._fwd_pool.shutdown(wait=True)  # flush in-flight forwards
+            self._fwd_pool = None
         self.membership.leave()
         self.rpc.stop()
         self.bus.detach(self.name)
@@ -450,8 +461,8 @@ class ClusterNode:
                 )
 
         for node, batch in per_node.items():
-            if self._repl_pool is not None:
-                self._repl_pool.submit(send, node, batch)
+            if self._fwd_pool is not None:
+                self._fwd_pool.submit(send, node, batch)
             else:
                 send(node, batch)
         return out
@@ -491,7 +502,9 @@ class ClusterNode:
         msgs = [m for m, _fs in batch]
         # forward=False: this IS the receiving half — re-forwarding here
         # would cascade batches between route owners forever
-        if self._loop is not None:
+        # (same gate as the _handle marshal: a CLOSED loop must take the
+        # sync path, or the reply would carry a never-awaited coroutine)
+        if self._loop is not None and not self._loop.is_closed():
             # app mode: return a coroutine — the rpc marshal resolves the
             # reply when the dispatch ACTUALLY completes (QoS1 confirm =
             # delivered/banked) while any kernel launch/compile runs in

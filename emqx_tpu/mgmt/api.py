@@ -746,7 +746,14 @@ class MgmtApi:
 
     # -- listeners (emqx_mgmt_api_listeners analog) ------------------------
     async def listeners_list(self, request):
-        return web.json_response({"data": self.app.listeners.describe()})
+        rows = self.app.listeners.describe()
+        # worker-pool listeners (multi-process data plane) are owned by
+        # the worker processes, not the in-process registry — surface
+        # them so the operator sees every serving port
+        rows += [
+            pool.describe() for pool in getattr(self.app, "worker_pools", [])
+        ]
+        return web.json_response({"data": rows})
 
     @staticmethod
     def _listener_id(request):

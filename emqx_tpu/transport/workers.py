@@ -481,6 +481,23 @@ class WorkerPool:
             )
             self._procs.append(p)
 
+    def describe(self) -> dict:
+        """Listener-style status row (mgmt REST surface)."""
+        alive = sum(1 for p in self._procs if p.poll() is None)
+        return {
+            "id": f"tcp:workers:{self.port}",
+            "type": "tcp",
+            "name": f"workers:{self.port}",
+            "bind": f"{self.bind}:{self.port}",
+            "running": alive > 0,
+            "workers": self.n,
+            "workers_alive": alive,
+            "workers_connected": len(self.fabric._writers),
+            "max_connections": 0,
+            "current_connections": 0,
+            "port": self.port,
+        }
+
     async def wait_ready(self, timeout: float = 30.0) -> None:
         """Block until every worker has dialed the fabric."""
         loop = asyncio.get_running_loop()
