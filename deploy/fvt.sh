@@ -27,9 +27,9 @@ EOF
 
 python -m emqx_tpu -c "$WORK/n1.json" > "$WORK/n1.log" 2>&1 &
 P1=$!
-for i in $(seq 1 100); do
+for i in $(seq 1 300); do
   grep -q "cluster bus on" "$WORK/n1.log" && break
-  sleep 0.3
+  sleep 0.5
 done
 MQTT1=$(grep -oE "listener tcp:default on 127.0.0.1:[0-9]+" "$WORK/n1.log" | grep -oE "[0-9]+$")
 BUS1=$(grep -oE "cluster bus on 127.0.0.1:[0-9]+" "$WORK/n1.log" | grep -oE "[0-9]+$")
@@ -52,9 +52,9 @@ EOF
 
 python -m emqx_tpu -c "$WORK/n2.json" > "$WORK/n2.log" 2>&1 &
 P2=$!
-for i in $(seq 1 100); do
+for i in $(seq 1 300); do
   grep -q "cluster bus on" "$WORK/n2.log" && break
-  sleep 0.3
+  sleep 0.5
 done
 MQTT2=$(grep -oE "listener tcp:default on 127.0.0.1:[0-9]+" "$WORK/n2.log" | grep -oE "[0-9]+$")
 if [ -z "${MQTT2:-}" ]; then

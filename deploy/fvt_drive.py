@@ -41,13 +41,12 @@ async def drive(p1: int, p2: int) -> None:
     m = await s2.recv(15)
     assert (m["topic"], m["payload"]) == ("rev/z", b"back"), m
 
-    # retained on node1, replayed to a fresh subscriber on node1
-    # (retained stores are node-local, matching the reference's default
-    # retainer storage; cross-node retained sync is mnesia-backed there)
+    # retained on node1, replayed to a fresh subscriber on NODE2: the
+    # retained store replicates cluster-wide (emqx_retainer_mnesia parity)
     await pub1.publish("keep/r", b"held", qos=0, retain=True)
-    await asyncio.sleep(0.5)
+    await asyncio.sleep(1.0)
     s3 = MiniClient("fvt-s3")
-    await s3.connect("127.0.0.1", p1)
+    await s3.connect("127.0.0.1", p2)
     await s3.subscribe([("keep/#", 0)])
     m = await s3.recv(15)
     assert (m["topic"], m["payload"], m["retain"]) == (

@@ -498,6 +498,10 @@ class BrokerApp:
                 loop=asyncio.get_running_loop(),
             )
             self.broker.cluster = self.cluster_node
+            if c.retainer.enable:
+                # retained set/clear replicate cluster-wide + join-time
+                # bootstrap (emqx_retainer_mnesia parity)
+                self.cluster_node.attach_retainer(self.retainer, self.hooks)
             for s in c.cluster.seeds:
                 self.cluster_bus.add_peer(s.node, s.host, s.port)
             if c.cluster.seeds:

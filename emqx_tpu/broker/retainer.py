@@ -217,6 +217,23 @@ class Retainer:
             self.delete(t)
         return len(removed)
 
+    def all_messages(self, limit: Optional[int] = None) -> List[Message]:
+        """Every stored message, INCLUDING '$'-rooted topics (a plain
+        store walk, not wildcard matching — cluster bootstrap needs the
+        full set, which `match('#')` would under-report per MQTT rules)."""
+        out: List[Message] = []
+
+        def walk(node: _Node) -> None:
+            if limit is not None and len(out) >= limit:
+                return
+            if node.msg is not None:
+                out.append(node.msg)
+            for c in node.children.values():
+                walk(c)
+
+        walk(self._root)
+        return out
+
     def topics(self) -> List[str]:
         out: List[str] = []
 

@@ -91,6 +91,10 @@ class ClusterNode:
         # spanning nodes delivers exactly once (emqx_shared_sub's
         # cluster-wide mnesia member table, leader-gated here)
         self._shared_nodes: Dict[Tuple[str, str], set] = {}
+        self._retainer = None  # set by attach_retainer (app mode)
+        # topics touched by LIVE retain casts while a join-time bootstrap
+        # is in flight: the (older) dump must not resurrect them
+        self._retain_boot_seen: Optional[set] = None
         self._register_protos()
         self.membership.monitor(self._on_membership)
         bus.attach(name, self._handle)
@@ -197,6 +201,14 @@ class ClusterNode:
             },
         )
         self.rpc.registry.register(
+            "retain",
+            1,
+            {
+                "store": self._proto_retain_store,
+                "dump": self._proto_retain_dump,
+            },
+        )
+        self.rpc.registry.register(
             "sess",
             1,
             {
@@ -275,6 +287,52 @@ class ClusterNode:
         for real, groups in self.broker.shared._table.items():
             for gname in groups:
                 self.shared_join(real, gname)
+        # retained-store bootstrap, both directions (late joiner catches
+        # up on the seed's set; its own pre-join retained pushes out like
+        # routes do). The dump applies ON THE LOOP in app mode — the
+        # retainer trie has no lock, and live casts are already
+        # loop-marshalled; `_retain_boot_seen` stops the older dump from
+        # resurrecting a topic a concurrent live cast just set/cleared.
+        if self._retainer is not None:
+            self._retain_boot_seen = set()
+            try:
+                dump = self.rpc.call(seed, "retain", "dump")
+                local = self._retainer.all_messages()
+
+                def apply():
+                    seen = self._retain_boot_seen or set()
+                    for mjson in dump:
+                        if mjson.get("topic") not in seen:
+                            self._proto_retain_store(mjson)
+
+                if self._loop is not None and not self._loop.is_closed():
+                    import concurrent.futures
+
+                    fut: "concurrent.futures.Future" = (
+                        concurrent.futures.Future()
+                    )
+
+                    def run():
+                        try:
+                            fut.set_result(apply())
+                        except BaseException as e:
+                            fut.set_exception(e)
+
+                    self._loop.call_soon_threadsafe(run)
+                    fut.result(timeout=120)
+                else:
+                    apply()
+                for m in local:
+                    self._replicate_retain(m)
+            except RpcError as e:
+                import logging
+
+                logging.getLogger("emqx_tpu.cluster").warning(
+                    "retained bootstrap from %s failed: %s", seed, e
+                )
+                self.broker.metrics.inc("cluster.retain.bootstrap_failed")
+            finally:
+                self._retain_boot_seen = None
         return True
 
     def leave(self) -> None:
@@ -372,6 +430,74 @@ class ClusterNode:
             self.rpc.cast(node, "broker", "forward_batch", batch, key=node)
             total += sum(1 for _ in batch)
         return total
+
+    # -- cluster-wide retained store ---------------------------------------
+    def attach_retainer(self, retainer, hooks) -> None:
+        """Replicate the retained store cluster-wide (the reference's
+        retainer rides a replicated mnesia table, emqx_retainer_mnesia;
+        here retained set/clear ops ride ordered casts and a join-time
+        bootstrap): a subscriber on ANY node replays retained messages
+        published on any other."""
+        self._retainer = retainer
+
+        def on_pub(msg):
+            if (
+                msg is not None
+                and msg.retain
+                and not msg.headers.get("retain_replicated")
+            ):
+                self._replicate_retain(msg)
+            return None
+
+        # priority below the retainer's own store hook: replicate what
+        # was actually accepted locally
+        hooks.add("message.publish", on_pub, priority=90,
+                  tag="cluster.retain_replicate")
+
+    def _replicate_retain(self, msg: Message) -> None:
+        from emqx_tpu.storage.codec import msg_to_json
+
+        mjson = msg_to_json(msg)
+
+        def one(p):
+            self.rpc.cast(p, "retain", "store", mjson, key=msg.topic)
+
+        for p in self.membership.peers():
+            if self._repl_pool is not None:
+                self._repl_pool.submit(one, p)
+            else:
+                one(p)
+
+    RETAIN_DUMP_CAP = 100_000
+
+    def _proto_retain_store(self, mjson) -> None:
+        if self._retainer is None:
+            return
+        msg = self._msg_from(mjson)
+        if self._retain_boot_seen is not None:
+            # a live cast during OUR bootstrap window: the dump snapshot
+            # is older than this op and must not override it
+            self._retain_boot_seen.add(msg.topic)
+        # straight into the store — NOT the publish fold — so replicas
+        # never re-replicate or re-dispatch (empty payload = clear, the
+        # same MQTT semantics on_publish already implements)
+        msg.headers["retain_replicated"] = True
+        self._retainer.on_publish(msg)
+
+    def _proto_retain_dump(self):
+        """Join-time bootstrap: the seed's retained set ('$'-rooted
+        topics included — a plain store walk). Capped: one RPC reply is
+        not a streaming protocol; past the cap the joiner converges via
+        live replication only (paged streaming is the upgrade path)."""
+        from emqx_tpu.storage.codec import msg_to_json
+
+        if self._retainer is None:
+            return []
+        msgs = self._retainer.all_messages(limit=self.RETAIN_DUMP_CAP + 1)
+        if len(msgs) > self.RETAIN_DUMP_CAP:
+            self.broker.metrics.inc("cluster.retain.dump_truncated")
+            msgs = msgs[: self.RETAIN_DUMP_CAP]
+        return [msg_to_json(m) for m in msgs]
 
     # -- cluster-wide shared groups ----------------------------------------
     def shared_join(self, real: str, group: str) -> None:

@@ -94,6 +94,22 @@ def test_two_apps_cluster_cross_node_delivery():
             m3 = await s1.recv(15)
             assert m3.payload == b"local-fwd"
 
+            # retained replicates cluster-wide: stored via node1,
+            # replayed to a fresh subscriber on node2
+            await pub1.publish("kp/x", b"held", qos=0, retain=True)
+            await _poll(lambda: len(app2.retainer) >= 1)
+            s3 = Client(client_id="xs3")
+            await s3.connect("127.0.0.1", p2)
+            await s3.subscribe("kp/#", qos=0)
+            m4 = await s3.recv(15)
+            assert (m4.topic, m4.payload, m4.retain) == (
+                "kp/x", b"held", True
+            )
+            # clearing (empty retained payload) replicates too
+            await pub1.publish("kp/x", b"", qos=0, retain=True)
+            await _poll(lambda: len(app2.retainer) == 0)
+            await s3.disconnect()
+
             # unsubscribe un-replicates
             await s1.unsubscribe("xn/+/t")
             await _poll(
@@ -103,6 +119,45 @@ def test_two_apps_cluster_cross_node_delivery():
                 await c.disconnect()
         finally:
             await app2.stop()
+            await app1.stop()
+
+    asyncio.run(run())
+
+
+def test_late_joiner_bootstraps_retained_store():
+    """A node that joins AFTER retained messages were stored catches up
+    from the seed's dump (the mnesia-table bootstrap analog)."""
+
+    async def run():
+        app1 = BrokerApp(_cfg("boot1@127.0.0.1"))
+        await app1.start()
+        try:
+            p1 = list(app1.listeners.list().values())[0].port
+            pub = Client(client_id="bp")
+            await pub.connect("127.0.0.1", p1)
+            # qos1: PUBACK confirms the broker processed the store
+            await pub.publish("pre/a", b"old1", qos=1, retain=True)
+            await pub.publish("pre/b", b"old2", qos=1, retain=True)
+            await pub.disconnect()
+            await _poll(lambda: len(app1.retainer) == 2)
+
+            app2 = BrokerApp(
+                _cfg("boot2@127.0.0.1",
+                     seeds=[("boot1@127.0.0.1", app1.cluster_bus.port)])
+            )
+            await app2.start()
+            try:
+                await _poll(lambda: len(app2.retainer) == 2)
+                p2 = list(app2.listeners.list().values())[0].port
+                s = Client(client_id="bs")
+                await s.connect("127.0.0.1", p2)
+                await s.subscribe("pre/#", qos=0)
+                got = sorted([(await s.recv(10)).payload for _ in range(2)])
+                assert got == [b"old1", b"old2"]
+                await s.disconnect()
+            finally:
+                await app2.stop()
+        finally:
             await app1.stop()
 
     asyncio.run(run())
