@@ -1,0 +1,8 @@
+{{- define "emqx-tpu.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 53 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "emqx-tpu.labels" -}}
+app.kubernetes.io/name: emqx-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -457,29 +457,62 @@ class WorkerPool:
         self.fabric = WorkerFabric(app, self.uds_path)
         self._procs: List = []
 
+    # supervision: a crashed worker respawns (one-for-one, like the
+    # reference's esockd supervisor over connection processes); a worker
+    # that dies repeatedly within the window stays down to avoid a
+    # crash-loop eating the host
+    RESPAWN_WINDOW_S = 60.0
+    MAX_RESPAWNS_PER_WINDOW = 5
+
+    def _spawn(self, wid: int):
+        import subprocess
+        import sys
+
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "emqx_tpu.transport.workers",
+                "--wid", str(wid),
+                "--bind", self.bind,
+                "--port", str(self.port),
+                "--uds", self.uds_path,
+                "--config", self._cfg_path,
+            ],
+        )
+
     async def start(self) -> None:
         import dataclasses
         import json
-        import subprocess
-        import sys
 
         await self.fabric.start()
         with open(self._cfg_path, "w") as f:
             json.dump(dataclasses.asdict(self.config), f, default=str)
         for wid in range(self.n):
-            p = subprocess.Popen(
-                [
-                    sys.executable,
-                    "-m",
-                    "emqx_tpu.transport.workers",
-                    "--wid", str(wid),
-                    "--bind", self.bind,
-                    "--port", str(self.port),
-                    "--uds", self.uds_path,
-                    "--config", self._cfg_path,
-                ],
-            )
-            self._procs.append(p)
+            self._procs.append(self._spawn(wid))
+        self._respawns: List[float] = []
+        self._supervisor = asyncio.get_running_loop().create_task(
+            self._supervise()
+        )
+
+    async def _supervise(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(2.0)
+            for wid, p in enumerate(self._procs):
+                if p.poll() is None:
+                    continue
+                now = loop.time()
+                self._respawns = [
+                    t for t in self._respawns
+                    if now - t < self.RESPAWN_WINDOW_S
+                ]
+                if len(self._respawns) >= self.MAX_RESPAWNS_PER_WINDOW:
+                    self.app.broker.metrics.inc("fabric.worker.crash_loop")
+                    continue
+                self._respawns.append(now)
+                self.app.broker.metrics.inc("fabric.worker.respawns")
+                self._procs[wid] = self._spawn(wid)
 
     def describe(self) -> dict:
         """Listener-style status row (mgmt REST surface)."""
@@ -510,6 +543,14 @@ class WorkerPool:
             await asyncio.sleep(0.05)
 
     async def stop(self) -> None:
+        sup = getattr(self, "_supervisor", None)
+        if sup is not None:
+            sup.cancel()
+            try:
+                await sup
+            except asyncio.CancelledError:
+                pass
+            self._supervisor = None
         for p in self._procs:
             p.terminate()
         for p in self._procs:

@@ -184,3 +184,41 @@ def test_worker_death_cleans_subscriptions(worker_app):
         assert not app.broker._subs
 
     loop.run_until_complete(asyncio.wait_for(scenario(), 60))
+
+
+def test_worker_respawn_after_crash(worker_app):
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt.client import Client
+
+    async def scenario():
+        pool = app.worker_pools[0]
+        # kill one worker; the supervisor respawns it and it re-dials
+        pool._procs[0].kill()
+
+        async def until(cond, timeout=25):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while not cond():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+
+        await until(
+            lambda: app.broker.metrics.get("fabric.worker.respawns") >= 1
+        )
+        await until(lambda: len(pool.fabric._writers) == pool.n)
+        await until(
+            lambda: all(p.poll() is None for p in pool._procs)
+        )
+        # the pool serves clients again end-to-end
+        sub = Client(client_id="rs")
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe("rs/#", qos=0)
+        pub = Client(client_id="rp")
+        await pub.connect("127.0.0.1", port)
+        await asyncio.sleep(0.3)
+        await pub.publish("rs/1", b"back", qos=0)
+        m = await asyncio.wait_for(sub.recv(10), 15)
+        assert m.payload == b"back"
+        await sub.disconnect()
+        await pub.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(scenario(), 60))
