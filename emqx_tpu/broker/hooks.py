@@ -32,7 +32,11 @@ STOP = object()  # sentinel return: stop the chain (keep current acc)
 
 class Hooks:
     def __init__(self) -> None:
-        self._table: Dict[str, List[Tuple[int, str, Callable]]] = {}
+        # chain entries: (priority, tag, callback, is_coroutine_fn) —
+        # coroutine-ness is classified ONCE at registration; the fold
+        # paths run per message and inspect.iscoroutinefunction there
+        # measured as the single largest hook-framework cost
+        self._table: Dict[str, List[Tuple[int, str, Callable, bool]]] = {}
 
     def add(
         self,
@@ -44,7 +48,9 @@ class Hooks:
         """Register; higher priority runs first (emqx_hooks.erl ordering)."""
         chain = self._table.setdefault(name, [])
         tag = tag or getattr(callback, "__qualname__", repr(callback))
-        chain.append((priority, tag, callback))
+        chain.append(
+            (priority, tag, callback, inspect.iscoroutinefunction(callback))
+        )
         chain.sort(key=lambda e: -e[0])
 
     def delete(self, name: str, callback_or_tag) -> None:
@@ -62,8 +68,8 @@ class Hooks:
         only fire on `arun`); the async channel path uses arun/arun_fold so
         client-originated traffic always reaches async extensions (exhook).
         """
-        for _, _, cb in self._table.get(name, ()):  # snapshot-free; small N
-            if inspect.iscoroutinefunction(cb):
+        for _, _, cb, is_coro in self._table.get(name, ()):
+            if is_coro:
                 continue
             if cb(*args) is STOP:
                 return
@@ -75,8 +81,8 @@ class Hooks:
         ('stop', final_acc); or raises StopAndReturn(final).
         Coroutine-function callbacks are skipped (see `run`).
         """
-        for _, _, cb in self._table.get(name, ()):
-            if inspect.iscoroutinefunction(cb):
+        for _, _, cb, is_coro in self._table.get(name, ()):
+            if is_coro:
                 continue
             try:
                 r = cb(*args, acc)
@@ -110,7 +116,7 @@ class Hooks:
         exhook gRPC sidecar) suspends only the calling connection's task,
         never the event loop (ADVICE r1: emqx_exhook blocking finding).
         """
-        for _, _, cb in self._table.get(name, ()):
+        for _, _, cb, _is_coro in self._table.get(name, ()):
             r = cb(*args)
             if inspect.isawaitable(r):
                 r = await r
@@ -118,8 +124,11 @@ class Hooks:
                 return
 
     async def arun_fold(self, name: str, args: tuple, acc: Any) -> Any:
-        """Async `run_fold`: awaits coroutine callbacks along the chain."""
-        for _, _, cb in self._table.get(name, ()):
+        """Async `run_fold`: awaits coroutine callbacks along the chain.
+        (isawaitable stays per-result: a SYNC callback may still return
+        an awaitable it built — only the registration-time coroutine
+        check is cached.)"""
+        for _, _, cb, _is_coro in self._table.get(name, ()):
             try:
                 r = cb(*args, acc)
                 if inspect.isawaitable(r):

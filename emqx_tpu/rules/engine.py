@@ -198,8 +198,15 @@ class RuleEngine:
         )
 
     def _on_publish(self, msg: Optional[Message]):
-        """'message.publish' fold callback: fire rules, pass msg through."""
+        """'message.publish' fold callback: fire rules, pass msg through.
+
+        Fast path: with no enabled rules there is nothing to select —
+        skip building the event context entirely (this hook runs on
+        EVERY publish; the context dict was ~9us/msg of pure overhead
+        on rule-less brokers)."""
         if msg is None:
+            return None
+        if not any(r.enabled for r in self._rules.values()):
             return None
         self._fire(EV.message_publish(msg), from_rule=msg.headers.get("from_rule"))
         return None
