@@ -206,7 +206,13 @@ class RuleEngine:
         on rule-less brokers)."""
         if msg is None:
             return None
-        if not any(r.enabled for r in self._rules.values()):
+        # O(1) for the common rule-less broker; with rules registered the
+        # any() scan is noise next to _fire's own per-rule work, and a
+        # cached flag would silently bypass rules if an external
+        # `.enabled = True` forgot to refresh it
+        if not self._rules or not any(
+            r.enabled for r in self._rules.values()
+        ):
             return None
         self._fire(EV.message_publish(msg), from_rule=msg.headers.get("from_rule"))
         return None

@@ -17,8 +17,14 @@ Frames (all little-endian, u32 length prefix EXCLUDES the 5-byte header):
   HELLO (w->r): u16 worker_id
   SUB   (w->r): json {h, sid, cid, f, qos, nl, rap, rh}
   UNSUB (w->r): json {sid, f}
-  PUBB  (w->r): u32 n, n * pub_record
+  PUBB  (w->r): u32 seq, u32 n, n * pub_record
   DLV   (r->w): u32 n, n * dlv_record
+  PUBB_ACK (r->w): u32 seq, u32 n, n * i32 delivery_count
+
+A PUBB is acked AFTER the router dispatched (or banked) every message
+in it, with per-message delivery counts — the worker-side channel
+holds each QoS1/2 client ack on that confirmation, so the at-least-once
+boundary sits at the router, not at the worker's socket buffer.
 
   pub_record: u16 tlen, topic, u32 plen, payload,
               u8 flags (qos | retain<<2 | dup<<3), u16 clen, from_client
@@ -43,6 +49,7 @@ T_SUB = 1
 T_UNSUB = 2
 T_PUBB = 3
 T_DLV = 4
+T_PUBB_ACK = 5
 
 _HDR = struct.Struct("<IB")
 _U16 = struct.Struct("<H")
@@ -59,7 +66,7 @@ def pack_json(ftype: int, obj) -> bytes:
     return pack_frame(ftype, json.dumps(obj).encode())
 
 
-def pack_pub_batch(msgs) -> bytes:
+def pack_pub_batch(msgs, seq: int = 0) -> bytes:
     """msgs: iterable of Message."""
     parts = [b""]
     n = 0
@@ -75,14 +82,15 @@ def pack_pub_batch(msgs) -> bytes:
             + bytes([flags]) + _U16.pack(len(c)) + c
         )
         n += 1
-    parts[0] = _U32.pack(n)
+    parts[0] = _U32.pack(seq) + _U32.pack(n)
     return pack_frame(T_PUBB, b"".join(parts))
 
 
-def unpack_pub_batch(body: bytes) -> List[Tuple[str, bytes, int, bool, bool, str]]:
-    """-> [(topic, payload, qos, retain, dup, from_client)]"""
-    (n,) = _U32.unpack_from(body, 0)
-    off = 4
+def unpack_pub_batch(body: bytes):
+    """-> (seq, [(topic, payload, qos, retain, dup, from_client)])"""
+    (seq,) = _U32.unpack_from(body, 0)
+    (n,) = _U32.unpack_from(body, 4)
+    off = 8
     out = []
     for _ in range(n):
         (tl,) = _U16.unpack_from(body, off)
@@ -103,7 +111,21 @@ def unpack_pub_batch(body: bytes) -> List[Tuple[str, bytes, int, bool, bool, str
             (topic, payload, flags & 3, bool(flags & 4), bool(flags & 8),
              client)
         )
-    return out
+    return seq, out
+
+
+def pack_pub_ack(seq: int, counts) -> bytes:
+    return pack_frame(
+        T_PUBB_ACK,
+        _U32.pack(seq) + _U32.pack(len(counts))
+        + struct.pack(f"<{len(counts)}i", *counts),
+    )
+
+
+def unpack_pub_ack(body: bytes):
+    (seq,) = _U32.unpack_from(body, 0)
+    (n,) = _U32.unpack_from(body, 4)
+    return seq, list(struct.unpack_from(f"<{n}i", body, 8))
 
 
 def pack_dlv_batch(records) -> bytes:

@@ -98,7 +98,7 @@ class WorkerFabric:
                 elif ftype == F.T_UNSUB:
                     self._on_unsub(wid, body)
                 elif ftype == F.T_PUBB:
-                    await self._on_pub_batch(body)
+                    await self._on_pub_batch(writer, body)
         except (
             asyncio.IncompleteReadError,
             ConnectionResetError,
@@ -182,10 +182,16 @@ class WorkerFabric:
                 self.broker.unsubscribe(sid, f)
 
     # -- publish side -----------------------------------------------------
-    async def _on_pub_batch(self, body: bytes) -> None:
-        for topic, payload, qos, retain, dup, client in F.unpack_pub_batch(
-            body
-        ):
+    async def _on_pub_batch(self, writer, body: bytes) -> None:
+        # `writer` is the CONNECTION's stream, not a wid lookup: a stale
+        # ack task must die with its (closed) connection, never resolve a
+        # respawned worker's identically-numbered batch
+        seq, records = F.unpack_pub_batch(body)
+        results = []
+        # enqueue INLINE (per-publisher ordering is an MQTT contract);
+        # only the confirm-wait runs as a task so the next frame parses
+        # while this batch's ingest window flushes
+        for topic, payload, qos, retain, dup, client in records:
             msg = Message(
                 topic=topic,
                 payload=payload,
@@ -194,7 +200,33 @@ class WorkerFabric:
                 dup=dup,
                 from_client=client,
             )
-            await self.broker.apublish_enqueue(msg)
+            results.append(await self.broker.apublish_enqueue(msg))
+        if not any(r[2] > 0 for r in records):
+            return  # pure-QoS0 batch: the worker holds no PUBACKs on it
+        t = asyncio.get_running_loop().create_task(
+            self._ack_pub_batch(writer, seq, results)
+        )
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _ack_pub_batch(self, writer, seq: int, results) -> None:
+        """Confirm AFTER every message dispatched/banked (ingest futures
+        resolve at the batch-window flush) with per-message delivery
+        counts — the worker holds client PUBACKs on this."""
+        counts = []
+        for r in results:
+            if isinstance(r, int):
+                counts.append(r)
+            else:
+                try:
+                    counts.append(int(await r))
+                except Exception:
+                    counts.append(0)
+        if not writer.is_closing():
+            try:
+                writer.write(F.pack_pub_ack(seq, counts))
+            except Exception:
+                self.broker.metrics.inc("fabric.flush.errors")
 
     # -- delivery side ----------------------------------------------------
     def enqueue(self, wid: int, handle: int, msg) -> None:
@@ -258,8 +290,16 @@ class WorkerBroker:
         self._subs: Dict[int, Tuple] = {}  # handle -> (deliver, opts)
         self._byname: Dict[Tuple[str, str], int] = {}
         self._next_handle = 1
-        self._pub_buf: List[Message] = []
+        # publish buffer entries: (msg, future) — the future resolves
+        # with the message's delivery count when the router acks the
+        # batch (PUBB_ACK), which is when the channel releases the
+        # client's PUBACK
+        self._pub_buf: List[Tuple[Message, Optional["asyncio.Future"]]] = []
         self._pub_scheduled = False
+        self._next_seq = 1
+        # seq -> (futures, safety TimerHandle cancelled on ack)
+        self._inflight: Dict[int, Tuple[list, object]] = {}
+        self.ACK_TIMEOUT_S = 60.0
 
     # fabric glue
     def attach_link(self, writer) -> None:
@@ -309,21 +349,64 @@ class WorkerBroker:
         for f in list(filters):
             self.unsubscribe(sid, f)
 
-    def _enqueue_pub(self, msg: Message) -> int:
+    def _enqueue_pub(self, msg: Message):
+        """QoS>0 returns a Future resolved by the router's PUBB_ACK (the
+        client's PUBACK waits on it); QoS0 is fire-and-forget — coupling
+        it to the ack round-trip measured ~4x e2e throughput loss for a
+        guarantee QoS0 never promises."""
         self.metrics.inc("messages.received")
-        self._pub_buf.append(msg)
+        fut = None
+        if msg.qos > 0:
+            fut = asyncio.get_running_loop().create_future()
+        self._pub_buf.append((msg, fut))
         if not self._pub_scheduled:
             self._pub_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush_pubs)
-        return 0
+        return fut if fut is not None else 0
 
     def _flush_pubs(self) -> None:
         self._pub_scheduled = False
         buf, self._pub_buf = self._pub_buf, []
-        if buf:
-            self._send(F.pack_pub_batch(buf))
+        if not buf:
+            return
+        seq = self._next_seq
+        self._next_seq += 1
+        futs = [f for _, f in buf]
+        if any(f is not None for f in futs):
+            # safety: a lost ack (router bug / torn link mid-restart)
+            # must not wedge every publisher's PUBACK forever
+            timer = asyncio.get_running_loop().call_later(
+                self.ACK_TIMEOUT_S, self._expire_batch, seq
+            )
+            self._inflight[seq] = (futs, timer)
+        self._send(F.pack_pub_batch([m for m, _ in buf], seq))
+
+    def _expire_batch(self, seq: int) -> None:
+        ent = self._inflight.pop(seq, None)
+        if ent:
+            self.metrics.inc("fabric.puback.timeouts")
+            for f in ent[0]:
+                if f is not None and not f.done():
+                    # -1 = the 'never no-subscribers' sentinel (see
+                    # channel._send_pub_ack): a late-but-delivered batch
+                    # must not tell v5 clients NO_MATCHING_SUBSCRIBERS
+                    f.set_result(-1)
+
+    def on_pub_ack(self, seq: int, counts) -> None:
+        ent = self._inflight.pop(seq, None)
+        if not ent:
+            return
+        futs, timer = ent
+        timer.cancel()
+        for f, n in zip(futs, counts):
+            if f is not None and not f.done():
+                f.set_result(n)
 
     async def apublish_enqueue(self, msg: Message):
+        """-> int (dropped) or a Future resolving with the delivery count
+        once the router CONFIRMS the batch — same contract as the real
+        Broker's ingest path, so the channel's ack queue holds each
+        QoS1/2 PUBACK until the message is actually routed."""
         msg = await self.hooks.arun_fold("message.publish", (), msg)
         if msg is None or msg.headers.get("allow_publish") is False:
             self.metrics.inc("messages.dropped")
@@ -331,13 +414,15 @@ class WorkerBroker:
         return self._enqueue_pub(msg)
 
     async def apublish(self, msg: Message) -> int:
-        return await self.apublish_enqueue(msg)
+        r = await self.apublish_enqueue(msg)
+        return r if isinstance(r, int) else await r
 
     def publish(self, msg: Message) -> int:
         msg = self.hooks.run_fold("message.publish", (), msg)
         if msg is None or msg.headers.get("allow_publish") is False:
             return 0
-        return self._enqueue_pub(msg)
+        self._enqueue_pub(msg)  # fire-and-forget (sync callers: will, sys)
+        return 0
 
     # delivery ------------------------------------------------------------
     def on_delivery(self, topic, payload, qos, retain, retained, client,
@@ -407,6 +492,8 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
                 if ftype == F.T_DLV:
                     for rec in F.unpack_dlv_batch(body):
                         broker.on_delivery(*rec)
+                elif ftype == F.T_PUBB_ACK:
+                    broker.on_pub_ack(*F.unpack_pub_ack(body))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             os._exit(0)  # router gone: worker has nothing to serve
 

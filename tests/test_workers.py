@@ -32,12 +32,19 @@ def test_pub_batch_roundtrip():
                 from_client="c1"),
         Message(topic="t", payload=b"", qos=0, from_client=""),
     ]
-    frame = F.pack_pub_batch(msgs)
+    frame = F.pack_pub_batch(msgs, seq=42)
     ftype = frame[4]
     assert ftype == F.T_PUBB
-    out = F.unpack_pub_batch(frame[5:])
+    seq, out = F.unpack_pub_batch(frame[5:])
+    assert seq == 42
     assert out[0] == ("a/b", b"x" * 10, 1, True, False, "c1")
     assert out[1] == ("t", b"", 0, False, False, "")
+
+
+def test_pub_ack_roundtrip():
+    frame = F.pack_pub_ack(7, [3, 0, 12])
+    assert frame[4] == F.T_PUBB_ACK
+    assert F.unpack_pub_ack(frame[5:]) == (7, [3, 0, 12])
 
 
 def test_dlv_batch_roundtrip():
@@ -218,6 +225,38 @@ def test_worker_respawn_after_crash(worker_app):
         await pub.publish("rs/1", b"back", qos=0)
         m = await asyncio.wait_for(sub.recv(10), 15)
         assert m.payload == b"back"
+        await sub.disconnect()
+        await pub.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(scenario(), 60))
+
+
+def test_qos1_puback_confirmed_by_router(worker_app):
+    """QoS1 at-least-once boundary: the client's PUBACK arrives only
+    after the router confirmed the batch (PUBB_ACK), and the v5
+    no-matching-subscribers reason code reflects the router's true
+    delivery count."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.mqtt.client import Client
+
+    async def scenario():
+        sub = Client(client_id="qs")
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe("qc/#", qos=1)
+        pub = Client(client_id="qp", version=pkt.MQTT_V5)
+        await pub.connect("127.0.0.1", port)
+        await asyncio.sleep(0.3)
+        # matched publish: rc success
+        ack = await pub.publish("qc/1", b"m", qos=1)
+        assert ack.reason_code == pkt.RC_SUCCESS
+        m = await asyncio.wait_for(sub.recv(10), 10)
+        assert m.payload == b"m"
+        # unmatched publish: the router's count=0 surfaces as the v5
+        # NO_MATCHING_SUBSCRIBERS code — proof the ack carried the
+        # router's verdict, not a local guess
+        ack2 = await pub.publish("nobody/home", b"x", qos=1)
+        assert ack2.reason_code == pkt.RC_NO_MATCHING_SUBSCRIBERS
         await sub.disconnect()
         await pub.disconnect()
 
