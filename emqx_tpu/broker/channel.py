@@ -14,6 +14,7 @@ machine serves TCP, TLS, WebSocket and in-process tests.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import secrets
 import time
 from collections import deque
@@ -586,6 +587,7 @@ class Channel:
             "client.subscribe", (self.client_info(),), p.filters
         )
         rcs: List[int] = []
+        pending: List[tuple] = []  # (rcs index, router-confirm future)
         for f, opts in filters:
             try:
                 T.validate(f)
@@ -617,15 +619,29 @@ class Channel:
             mf = MP.mount(self.mountpoint, f)
             existing = mf in self.session.subscriptions
             opts._existing = existing  # for retain_handling=1 semantics
-            self.broker.subscribe(
+            r = self.broker.subscribe(
                 self.client_id, self.client_id, mf, opts,
                 self._make_deliverer(opts),
             )
+            if inspect.isawaitable(r):
+                # worker-fabric broker: collect the router's confirm and
+                # await AFTER the loop — all SUB frames are already on
+                # the wire, so N filters cost one round-trip, not N (the
+                # in-process broker registers synchronously, r is None)
+                pending.append((len(rcs), r))
             self.session.subscriptions[mf] = opts
             await self.hooks.arun(
                 "session.subscribed", self.client_info(), mf, opts, self
             )
             rcs.append(qos)  # granted qos == success codes 0..2
+        for idx, fut in pending:
+            ok = await fut
+            if self.session is None or self.state != "connected":
+                return  # kicked/took-over while awaiting the router
+            if ok is False:
+                # router never confirmed (fabric link down / timeout):
+                # the client must NOT believe it is subscribed
+                rcs[idx] = pkt.RC_UNSPECIFIED_ERROR
         self._send(pkt.Suback(packet_id=p.packet_id, reason_codes=rcs))
 
     def _make_deliverer(self, opts: pkt.SubOpts):

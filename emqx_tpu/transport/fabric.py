@@ -50,6 +50,12 @@ T_UNSUB = 2
 T_PUBB = 3
 T_DLV = 4
 T_PUBB_ACK = 5
+# SUB confirm (router -> worker, body = json {h}): sent after the
+# router registered the subscription + enqueued retained replay. The
+# worker holds the client's SUBACK on it, so SUBACK keeps the
+# reference's meaning — the subscription is ROUTABLE, broker-wide
+# (emqx_broker.erl:127-160 is synchronous for the same reason).
+T_SUB_ACK = 6
 
 _HDR = struct.Struct("<IB")
 _U16 = struct.Struct("<H")

@@ -94,7 +94,11 @@ class WorkerFabric:
             while True:
                 ftype, body = await F.read_frame(reader)
                 if ftype == F.T_SUB:
-                    self._on_sub(wid, body)
+                    h = self._on_sub(wid, body)
+                    # confirm AFTER registration + retained enqueue:
+                    # the worker releases the client's SUBACK on this
+                    if not writer.is_closing():
+                        writer.write(F.pack_json(F.T_SUB_ACK, {"h": h}))
                 elif ftype == F.T_UNSUB:
                     self._on_unsub(wid, body)
                 elif ftype == F.T_PUBB:
@@ -117,7 +121,9 @@ class WorkerFabric:
     def _sid(self, wid: int, sid: str) -> str:
         return f"w{wid}|{sid}"
 
-    def _on_sub(self, wid: int, body: bytes) -> None:
+    def _on_sub(self, wid: int, body: bytes) -> int:
+        """Register a worker subscription; returns its handle (the read
+        loop confirms it back as SUB_ACK after this returns)."""
         import json
 
         d = json.loads(body)
@@ -159,6 +165,7 @@ class WorkerFabric:
                 mm = copy.copy(m)
                 mm.headers = dict(m.headers, retained=True)
                 self.enqueue(wid, handle, mm)
+        return handle
 
     def _on_unsub(self, wid: int, body: bytes) -> None:
         import json
@@ -299,6 +306,10 @@ class WorkerBroker:
         self._next_seq = 1
         # seq -> (futures, safety TimerHandle cancelled on ack)
         self._inflight: Dict[int, Tuple[list, object]] = {}
+        # handle -> (future resolved by the router's SUB_ACK, safety
+        # timer cancelled on ack); the channel holds the client's SUBACK
+        # on the future: SUBACK == routable
+        self._sub_acks: Dict[int, Tuple["asyncio.Future", object]] = {}
         self.ACK_TIMEOUT_S = 60.0
 
     # fabric glue
@@ -310,7 +321,11 @@ class WorkerBroker:
             self._link_w.write(data)
 
     # Broker surface ------------------------------------------------------
-    def subscribe(self, sid, client_id, filter_, opts, deliver) -> None:
+    def subscribe(self, sid, client_id, filter_, opts, deliver):
+        """Returns a future resolved when the router CONFIRMS the
+        subscription (SUB_ACK) — the channel awaits it before SUBACK, so
+        a publish racing the SUBACK still delivers (the in-process
+        broker's subscribe is synchronous for the same contract)."""
         key = (sid, filter_)
         h = self._byname.get(key)
         if h is None:
@@ -318,6 +333,22 @@ class WorkerBroker:
             self._next_handle += 1
             self._byname[key] = h
         self._subs[h] = (deliver, opts)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if self._link_w is None or self._link_w.is_closing():
+            # fail fast: no link, no registration — the channel turns
+            # False into a SUBACK failure code instead of stalling 30s
+            fut.set_result(False)
+            return fut
+        ent = self._sub_acks.get(h)
+        if ent is not None and not ent[0].done():
+            fut = ent[0]  # re-subscribe racing its own confirm
+        else:
+            timer = loop.call_later(
+                30.0,
+                lambda: fut.done() or fut.set_result(False),
+            )
+            self._sub_acks[h] = (fut, timer)
         self._send(
             F.pack_json(
                 F.T_SUB,
@@ -336,6 +367,16 @@ class WorkerBroker:
                 },
             )
         )
+        return fut
+
+    def on_sub_ack(self, h: int) -> None:
+        ent = self._sub_acks.pop(h, None)
+        if ent is None:
+            return
+        fut, timer = ent
+        timer.cancel()
+        if not fut.done():
+            fut.set_result(True)
 
     def unsubscribe(self, sid, filter_) -> bool:
         h = self._byname.pop((sid, filter_), None)
@@ -494,6 +535,10 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
                         broker.on_delivery(*rec)
                 elif ftype == F.T_PUBB_ACK:
                     broker.on_pub_ack(*F.unpack_pub_ack(body))
+                elif ftype == F.T_SUB_ACK:
+                    import json as _json
+
+                    broker.on_sub_ack(int(_json.loads(body)["h"]))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             os._exit(0)  # router gone: worker has nothing to serve
 

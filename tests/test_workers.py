@@ -261,3 +261,28 @@ def test_qos1_puback_confirmed_by_router(worker_app):
         await pub.disconnect()
 
     loop.run_until_complete(asyncio.wait_for(scenario(), 60))
+
+
+def test_suback_means_routable_no_sleep(worker_app):
+    """SUBACK is held for the router's SUB_ACK: a publish fired the
+    moment SUBACK returns must deliver — no propagation sleeps (the
+    reference's subscribe is synchronous; the fabric keeps the
+    contract)."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt.client import Client
+
+    async def scenario():
+        pub = Client(client_id="nr-p")
+        await pub.connect("127.0.0.1", port)
+        for i in range(5):
+            sub = Client(client_id=f"nr-s{i}")
+            await sub.connect("127.0.0.1", port)
+            await sub.subscribe(f"nsl/{i}/#", qos=1)
+            # immediately — no sleep
+            await pub.publish(f"nsl/{i}/t", b"now", qos=1)
+            m = await asyncio.wait_for(sub.recv(10), 10)
+            assert m.payload == b"now", i
+            await sub.disconnect()
+        await pub.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(scenario(), 60))
