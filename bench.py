@@ -519,14 +519,18 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
 # update-sync measured — r3 verdict item 3) runs FIRST in its own fresh
 # process; every config emits a BENCH_PARTIAL stderr line on completion
 # so a gate timeout still leaves captured numbers (r3 verdict item 1d)
+# priority order = skip order inverted: when the wall budget runs out,
+# whatever remains is skipped, so the verdict-critical configs (10M
+# scales, e2e serving, retained storm) run first and the small
+# single-shape tables absorb the squeeze
 CONFIGS = [
     "mixed_10m",
     "share_10m",
+    "e2e_serving",
     "mixed_1m",
+    "retained_5m",
     "plus_100k",
     "exact_1k",
-    "retained_5m",
-    "e2e_serving",
 ]
 # run only if budget remains after the required sweep (>=300s headroom)
 EXTRAS = ["retained_spot"]
