@@ -589,17 +589,20 @@ def bench_retained(rng):
         storm_s = s if storm_s is None else min(storm_s, s)
     total = sum(len(v) for v in res.values())
 
-    _mark("retained_5m: device done; cpu trie baseline (500k sample)")
-    # CPU baseline on a 10x smaller store, scaled (full 5M python trie
-    # build would dominate the bench run); per-subscriber walk as the
-    # reference does (emqx_retainer_mnesia match_messages per subscribe)
-    cpu = Retainer(max_retained=N, device_threshold=1 << 62)
-    for t in topics[::10]:
+    _mark("retained_5m: device done; cpu trie baseline (direct, 2.5M)")
+    # CPU baseline measured DIRECTLY (no sample-and-scale: the r4 spot
+    # check measured the walk growing only ~1.3x per 5x store — the old
+    # linear extrapolation OVERSTATED the cpu cost ~4x). A half-size
+    # 2.5M store keeps the build inside the budget and is CONSERVATIVE:
+    # sublinear growth means the true 5M walk costs more than measured.
+    CPU_N = N // 2
+    cpu = Retainer(max_retained=CPU_N, device_threshold=1 << 62)
+    for t in topics[:CPU_N]:
         cpu._insert(Message(topic=t, payload=b"r", retain=True))
     t0 = _t.perf_counter()
     for f in filters[:4]:
         cpu.match(f)
-    cpu_per_sub_s = (_t.perf_counter() - t0) / 4 * 10  # scale to 5M
+    cpu_per_sub_s = (_t.perf_counter() - t0) / 4  # DIRECT, unscaled
     cpu_storm_s = cpu_per_sub_s * STORM
     hbm_mb = sum(b.nbytes for b in dev._host_b) / 1e6
     return {
@@ -608,8 +611,15 @@ def bench_retained(rng):
         "unique_filters": len(set(filters)),
         "storm_s": round(storm_s, 2),
         "per_subscriber_ms": round(storm_s / STORM * 1e3, 3),
-        "cpu_trie_scaled_per_subscriber_ms": round(cpu_per_sub_s * 1e3, 1),
+        "cpu_store_topics": CPU_N,
+        "cpu_trie_direct_per_subscriber_ms": round(cpu_per_sub_s * 1e3, 1),
         "speedup": round(cpu_storm_s / storm_s, 1),
+        "speedup_note": (
+            "cpu baseline walked DIRECTLY on a 2.5M store (conservative:"
+            " retained_spot measured the walk growing sublinearly, so"
+            " the true 5M walk costs more; the pre-r4 linear"
+            " extrapolation overstated the baseline ~4x)"
+        ),
         "matched_pairs": total,
         "bulk_load_s": round(build_s, 1),
         "hbm_mb": round(hbm_mb, 1),
@@ -618,44 +628,60 @@ def bench_retained(rng):
 
 
 def bench_retained_spot() -> dict:
-    """UNSCALED CPU-baseline spot check (r3 verdict item 9): build the
-    FULL 5M-topic python store and walk a handful of storm filters
-    directly — no sample-and-scale — to validate the linear scaling
-    assumption behind retained_5m's speedup number."""
+    """UNSCALED CPU-baseline linearity check (r3 verdict item 9):
+    retained_5m's speedup divides by a baseline measured on a 1/10-size
+    store and scaled linearly. This config validates that scaling with
+    two DIRECT measurements of the same leading-wildcard walk — a 500k
+    store and a 5x-larger 2.5M store — and reports the measured growth
+    ratio against the linear prediction (5.0). No sampling, no scaling:
+    each walk runs on the store it's measured on."""
     import time as _t
 
     from emqx_tpu.broker.message import Message
     from emqx_tpu.broker.retainer import Retainer
 
-    N = 5_000_000
     SITES = 2048
     DEVIDS = 100003
-    _mark("retained_spot: building FULL 5M cpu store")
-    cpu = Retainer(max_retained=N, device_threshold=1 << 62)
-    for i in range(N):
-        cpu._insert(
-            Message(
-                topic=f"site/{i % SITES}/dev/{i % DEVIDS}/ch/{i}",
-                payload=b"r",
-                retain=True,
+    FILTERS = [f"site/+/dev/{d}/ch/#" for d in (7, 1009, 4021)]
+
+    def build_and_walk(n):
+        cpu = Retainer(max_retained=n, device_threshold=1 << 62)
+        for i in range(n):
+            cpu._insert(
+                Message(
+                    topic=f"site/{i % SITES}/dev/{i % DEVIDS}/ch/{i}",
+                    payload=b"r",
+                    retain=True,
+                )
             )
-        )
-    _mark("retained_spot: store built; walking filters")
-    per = []
-    for d in (7, 1009, 4021):
-        t0 = _t.perf_counter()
-        res = cpu.match(f"site/+/dev/{d}/ch/#")
-        per.append((_t.perf_counter() - t0, len(res)))
+        per = []
+        for f in FILTERS:
+            t0 = _t.perf_counter()
+            res = cpu.match(f)
+            per.append((_t.perf_counter() - t0, len(res)))
+        return per
+
+    _mark("retained_spot: 500k store direct walk")
+    small = build_and_walk(500_000)
+    _mark("retained_spot: 2.5M store direct walk")
+    big = build_and_walk(2_500_000)
+    s_ms = [round(s * 1e3, 2) for s, _ in small]
+    b_ms = [round(s * 1e3, 2) for s, _ in big]
+    ratios = [
+        round(b / s, 2) for (s, _), (b, _) in zip(small, big) if s > 0
+    ]
     return {
-        "store_topics": N,
-        "filters_walked": 3,
-        "unscaled_cpu_per_subscriber_ms": [
-            round(s * 1e3, 1) for s, _ in per
-        ],
-        "matched_per_filter": [m for _, m in per],
+        "filters_walked": FILTERS,
+        "store_500k_per_subscriber_ms": s_ms,
+        "store_2500k_per_subscriber_ms": b_ms,
+        "measured_growth_ratio": ratios,
+        "linear_prediction": 5.0,
         "note": (
-            "full-store walk, no subsampling: validates retained_5m's "
-            "scaled cpu baseline (same filter family)"
+            "direct (unscaled) walks at two store sizes validate the "
+            "linear extrapolation behind retained_5m's scaled cpu "
+            "baseline; a measured ratio near 5.0 confirms the "
+            "per-subscriber walk is linear in store size for this "
+            "leading-wildcard family"
         ),
     }
 
