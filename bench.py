@@ -527,8 +527,8 @@ CONFIGS = [
     "mixed_10m",
     "share_10m",
     "e2e_serving",
-    "mixed_1m",
     "retained_5m",
+    "mixed_1m",
     "plus_100k",
     "exact_1k",
 ]
