@@ -382,16 +382,29 @@ def _measure_updates(index, nfa_tables, with_nfa):
     # defers — a live broker pays it on its first churn op, not per op)
     from emqx_tpu.ops.nfa import DeviceDeltaSync
 
+    phase_t0 = time.perf_counter()
+    PHASE_CAP_S = 120.0  # a degraded-tunnel run must not let this
+    # OPTIONAL phase starve the remaining configs (observed 335s)
     sync = DeviceDeltaSync()
     sync.sync(index.shapes)
     index.add("warmmat/0/+/x/#")  # materialize lazy host mirrors
     sync.sync(index.shapes)
     t1 = time.perf_counter()
     n_upd = 20  # enough for a stable mean; 50 cost ~90s at 10M scale
+    done_upd = 0
     for i in range(n_upd):
         index.add(f"delta/{i}/+/x/#")
         sync.sync(index.shapes)
-    upd_s = (time.perf_counter() - t1) / n_upd
+        done_upd += 1
+        if (
+            done_upd >= 5
+            and time.perf_counter() - phase_t0 > PHASE_CAP_S / 2
+        ):
+            break  # degraded tunnel: 5+ samples give a usable mean
+    upd_s = (time.perf_counter() - t1) / done_upd
+    if time.perf_counter() - phase_t0 > PHASE_CAP_S:
+        _mark("updates: phase cap hit; skipping visibility measure")
+        return upd_s, None
 
     # SUBSCRIBE-VISIBILITY at full scale (r3 verdict item 6): wall
     # time from a fresh subscribe (host add) to a ROUTED batch whose
