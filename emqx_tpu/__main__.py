@@ -52,6 +52,13 @@ async def serve(args) -> int:
             f"{l.config.bind}:{l.port}",
             flush=True,
         )
+    for pool in app.worker_pools:
+        row = pool.describe()
+        print(
+            f"emqx_tpu listener {row['id']} on {row['bind']} "
+            f"({row['workers']} workers)",
+            flush=True,
+        )
     if app.mgmt_server is not None:
         print(
             f"emqx_tpu mgmt api on {config.dashboard.bind}:{app.mgmt_server.port}",

@@ -617,7 +617,8 @@ class Channel:
             qos = min(opts.qos, self.config.caps.max_qos_allowed)
             opts.qos = qos
             mf = MP.mount(self.mountpoint, f)
-            existing = mf in self.session.subscriptions
+            prior_opts = self.session.subscriptions.get(mf)
+            existing = prior_opts is not None
             opts._existing = existing  # for retain_handling=1 semantics
             r = self.broker.subscribe(
                 self.client_id, self.client_id, mf, opts,
@@ -628,13 +629,13 @@ class Channel:
                 # await AFTER the loop — all SUB frames are already on
                 # the wire, so N filters cost one round-trip, not N (the
                 # in-process broker registers synchronously, r is None)
-                pending.append((len(rcs), r))
+                pending.append((len(rcs), mf, prior_opts, r))
             self.session.subscriptions[mf] = opts
             await self.hooks.arun(
                 "session.subscribed", self.client_info(), mf, opts, self
             )
             rcs.append(qos)  # granted qos == success codes 0..2
-        for idx, fut in pending:
+        for idx, mf, prior, fut in pending:
             ok = await fut
             if self.session is None or self.state != "connected":
                 return  # kicked/took-over while awaiting the router
@@ -642,6 +643,26 @@ class Channel:
                 # router never confirmed (fabric link down / timeout):
                 # the client must NOT believe it is subscribed
                 rcs[idx] = pkt.RC_UNSPECIFIED_ERROR
+                if prior is None:
+                    # fresh subscribe: roll back the local registration
+                    # so a late-registering SUB can't deliver to a
+                    # client that was told it failed, and a later
+                    # re-subscribe replays retained (rh=1) as fresh
+                    self.broker.unsubscribe(self.client_id, mf)
+                    if self.session.subscriptions.pop(mf, None) is not None:
+                        await self.hooks.arun(
+                            "session.unsubscribed", self.client_info(), mf
+                        )
+                else:
+                    # failed UPGRADE of an established filter: the
+                    # previously confirmed subscription stays live with
+                    # its prior options (tearing it down would silently
+                    # stop a flow the client still believes is active)
+                    self.session.subscriptions[mf] = prior
+                    self.broker.subscribe(
+                        self.client_id, self.client_id, mf, prior,
+                        self._make_deliverer(prior),
+                    )
         self._send(pkt.Suback(packet_id=p.packet_id, reason_codes=rcs))
 
     def _make_deliverer(self, opts: pkt.SubOpts):

@@ -46,15 +46,17 @@ class SharedSub:
         # real_filter -> {group -> _Group}
         self._table: Dict[str, Dict[str, _Group]] = {}
         self._rng = _random.Random(0xEC0)
-        # cluster mode: (real, group) -> bool; only the group's LEADER
-        # node dispatches, so a group spanning nodes still delivers each
-        # message exactly once (emqx_shared_sub's cluster-wide pick —
-        # mnesia member table there, leader-gated local pick here)
+        # cluster mode: (real, group, msg) -> bool; exactly one member
+        # node dispatches each message. Every member node already holds
+        # the message (route forwarding), so rotating the dispatcher
+        # per message balances the group cluster-wide with zero extra
+        # RPC (the reference picks among cluster-wide members,
+        # emqx_shared_sub.erl:234-285)
         self.leader_check = None
 
-    def _is_leader(self, real: str, group: str) -> bool:
+    def _is_leader(self, real: str, group: str, msg=None) -> bool:
         lc = self.leader_check
-        return True if lc is None else lc(real, group)
+        return True if lc is None else lc(real, group, msg)
 
     # -- membership -------------------------------------------------------
     def subscribe(self, group: str, real: str, sub) -> bool:
@@ -153,8 +155,8 @@ class SharedSub:
         g = self.group(real, gname)
         if g is None or not g.members:
             return 0
-        if not self._is_leader(real, gname):
-            return 0  # another node's members own this group's pick
+        if not self._is_leader(real, gname, msg):
+            return 0  # another node's members own this message's pick
         sids = list(g.members.keys())
         i = idx % len(sids) if sids else 0
         candidates = sids[i:] + sids[:i]
@@ -186,8 +188,8 @@ class SharedSub:
             return 0
         n = 0
         for gname, g in groups.items():
-            if not self._is_leader(real, gname):
-                continue  # another node's members own this group's pick
+            if not self._is_leader(real, gname, msg):
+                continue  # another node's members own this message's pick
             for sid in self._pick(g, msg):
                 sub = g.members.get(sid)
                 if sub is None:

@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.shared_sub import stable_hash
 from emqx_tpu.cluster.cluster_rpc import ClusterRpcLog
 from emqx_tpu.cluster.membership import Membership
 from emqx_tpu.cluster.route_sync import ClusterRouteTable
@@ -86,11 +87,13 @@ class ClusterNode:
         # (sync) mode rpc handlers run on bus threads while drain_to
         # runs on the caller thread
         self._park_lock = threading.Lock()
-        # (real, group) -> set of nodes holding members; the MIN node is
-        # the group leader and the only one that dispatches — a group
-        # spanning nodes delivers exactly once (emqx_shared_sub's
-        # cluster-wide mnesia member table, leader-gated here)
+        # (real, group) -> set of nodes holding members; exactly one of
+        # them dispatches each message (per-message rotation in
+        # shared_leader) — a group spanning nodes delivers exactly once
+        # (emqx_shared_sub's cluster-wide mnesia member table)
         self._shared_nodes: Dict[Tuple[str, str], set] = {}
+        # cached sorted candidate lists, invalidated on membership change
+        self._shared_cands: Dict[Tuple[str, str], List[str]] = {}
         self._retainer = None  # set by attach_retainer (app mode)
         # topics touched by LIVE retain casts while a join-time bootstrap
         # is in flight: the (older) dump must not resurrect them
@@ -257,6 +260,7 @@ class ClusterNode:
                 nodes.discard(node)
                 if not nodes:
                     self._shared_nodes.pop(key, None)
+            self._shared_cands.clear()
             self.broker.metrics.inc("cluster.nodedown.routes_purged", purged)
         elif event == "node_up":
             self.rpc.forget_peer(node)  # re-negotiate BPAPI versions
@@ -284,6 +288,7 @@ class ClusterNode:
         # shared-group membership bootstrap + announce our own groups
         for r, g, nodes in self.rpc.call(seed, "shared", "dump"):
             self._shared_nodes.setdefault((r, g), set()).update(nodes)
+            self._shared_cands.pop((r, g), None)
         for real, groups in self.broker.shared._table.items():
             for gname in groups:
                 self.shared_join(real, gname)
@@ -297,13 +302,20 @@ class ClusterNode:
             self._retain_boot_seen = set()
             try:
                 dump = self.rpc.call(seed, "retain", "dump")
-                local = self._retainer.all_messages()
 
                 def apply():
+                    # the local pre-join snapshot is taken ON THE LOOP
+                    # too (and BEFORE the dump applies, so the seed's
+                    # own set never re-replicates back out): the
+                    # retainer trie has no lock and listeners already
+                    # serve during join retries — an executor-thread
+                    # walk could tear mid-mutation
+                    local = self._retainer.all_messages()
                     seen = self._retain_boot_seen or set()
                     for mjson in dump:
                         if mjson.get("topic") not in seen:
                             self._proto_retain_store(mjson)
+                    return local
 
                 if self._loop is not None and not self._loop.is_closed():
                     import concurrent.futures
@@ -319,9 +331,9 @@ class ClusterNode:
                             fut.set_exception(e)
 
                     self._loop.call_soon_threadsafe(run)
-                    fut.result(timeout=120)
+                    local = fut.result(timeout=120)
                 else:
-                    apply()
+                    local = apply()
                 for m in local:
                     self._replicate_retain(m)
             except RpcError as e:
@@ -504,6 +516,7 @@ class ClusterNode:
         """First local member of (real, group): announce membership so
         every node agrees on the group leader."""
         self._shared_nodes.setdefault((real, group), set()).add(self.name)
+        self._shared_cands.pop((real, group), None)
         self._shared_cast("join", real, group)
 
     def shared_leave(self, real: str, group: str) -> None:
@@ -521,19 +534,45 @@ class ClusterNode:
             else:
                 one(p)
 
-    def shared_leader(self, real: str, group: str) -> bool:
-        """This node dispatches (real, group) iff it is the MIN of the
-        nodes holding members. A local group not yet announced (race)
-        defaults to dispatching — transient dup beats transient loss."""
+    def shared_leader(self, real: str, group: str, msg=None) -> bool:
+        """Pick the dispatching node for (real, group) per MESSAGE
+        across the cluster-wide member-node set. Every member node holds
+        the message already (route forwarding), so rotating the
+        dispatcher balances the group across nodes with no extra RPC —
+        the reference picks among cluster-wide members the same way
+        (emqx_shared_sub.erl:234-285). Hash strategies stay keyed (same
+        client/topic -> same node -> same member); sticky keeps a single
+        dispatching node so the group genuinely sticks to one member.
+        A local group not yet announced (race) defaults to dispatching —
+        transient dup beats transient loss."""
         s = self._shared_nodes.get((real, group))
         if not s:
             return True
-        cands = set(s)
-        cands.add(self.name)  # dispatch_groups only asks when local members exist
-        return self.name == min(cands)
+        # dispatch only asks when local members exist; the sorted
+        # candidate list is cached per group (per-message sorting would
+        # tax the hot path) and invalidated on membership changes
+        cands = self._shared_cands.get((real, group))
+        if cands is None:
+            cands = sorted(set(s) | {self.name})
+            self._shared_cands[(real, group)] = cands
+        if len(cands) == 1:
+            return True
+        strategy = self.broker.shared.strategy
+        if strategy == "sticky" or msg is None:
+            return self.name == cands[0]
+        if strategy == "hash_clientid":
+            key = stable_hash(msg.from_client)
+        elif strategy == "hash_topic":
+            key = stable_hash(msg.topic)
+        else:  # random / round_robin: rotate per message (mid is
+            # GUID-stable across the forward path, so all member nodes
+            # agree on the same dispatcher)
+            key = stable_hash(f"{msg.from_client}|{msg.mid}")
+        return self.name == cands[key % len(cands)]
 
     def _proto_shared_join(self, real: str, group: str, node: str) -> None:
         self._shared_nodes.setdefault((real, group), set()).add(node)
+        self._shared_cands.pop((real, group), None)
 
     def _proto_shared_leave(self, real: str, group: str, node: str) -> None:
         s = self._shared_nodes.get((real, group))
@@ -541,6 +580,7 @@ class ClusterNode:
             s.discard(node)
             if not s:
                 self._shared_nodes.pop((real, group), None)
+        self._shared_cands.pop((real, group), None)
 
     def _proto_shared_dump(self):
         return [

@@ -62,10 +62,25 @@ _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 
 MAX_FRAME = 64 * 1024 * 1024
+# soft per-frame body cap for senders: batches above this split into
+# multiple frames so a large tick (pipelined max-size publishes, a huge
+# fan-out delivery flush) can never hit the receiver's MAX_FRAME reject,
+# which would tear down the whole fabric link
+MAX_BODY = 8 * 1024 * 1024
 
 
 def pack_frame(ftype: int, body: bytes) -> bytes:
     return _HDR.pack(len(body), ftype) + body
+
+
+def pub_record_size(m) -> int:
+    """Serialized size of one pub_record (sender-side chunking)."""
+    return (
+        9
+        + len(m.topic.encode())
+        + len(m.payload or b"")
+        + len((m.from_client or "").encode())
+    )
 
 
 def pack_json(ftype: int, obj) -> bytes:
@@ -134,8 +149,11 @@ def unpack_pub_ack(body: bytes):
     return seq, list(struct.unpack_from(f"<{n}i", body, 8))
 
 
-def pack_dlv_batch(records) -> bytes:
-    """records: [(msg, [handle, ...])]"""
+def pack_dlv_batches(records, max_body: float = MAX_BODY):
+    """records: [(msg, [handle, ...])] -> yields one or more DLV frames,
+    each body bounded by ~max_body (always at least one record per
+    frame), so a huge delivery tick can't exceed the receiver's
+    MAX_FRAME and tear the fabric link."""
     out = bytearray(9)  # frame header (5) + count (4), patched below
     n = 0
     for m, handles in records:
@@ -154,13 +172,27 @@ def pack_dlv_batch(records) -> bytes:
         # subscriptions on one worker)
         for lo in range(0, len(handles), 0xFFFF):
             chunk = handles[lo : lo + 0xFFFF]
+            rec_len = len(head) + 2 + 4 * len(chunk)
+            if n and len(out) + rec_len > max_body:
+                out[0:5] = _HDR.pack(len(out) - 5, T_DLV)
+                out[5:9] = _U32.pack(n)
+                yield bytes(out)
+                out = bytearray(9)
+                n = 0
             out += head
             out += _U16.pack(len(chunk))
             out += struct.pack(f"<{len(chunk)}I", *chunk)
             n += 1
-    out[0:5] = _HDR.pack(len(out) - 5, T_DLV)
-    out[5:9] = _U32.pack(n)
-    return bytes(out)
+    if n:
+        out[0:5] = _HDR.pack(len(out) - 5, T_DLV)
+        out[5:9] = _U32.pack(n)
+        yield bytes(out)
+
+
+def pack_dlv_batch(records) -> bytes:
+    """Single-frame variant (tests / small ticks)."""
+    frames = list(pack_dlv_batches(records, max_body=float("inf")))
+    return frames[0] if frames else pack_frame(T_DLV, _U32.pack(0))
 
 
 def unpack_dlv_batch(body: bytes):

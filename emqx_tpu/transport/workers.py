@@ -53,6 +53,11 @@ class WorkerFabric:
         self.uds_path = uds_path
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: Dict[int, asyncio.StreamWriter] = {}
+        # wid -> {(full_sid, filter)}: explicit registry of the broker
+        # subscriptions each worker proxies (worker-death cleanup walks
+        # this, never a sid-prefix match that could catch an in-process
+        # client whose id happens to start with "w{wid}|")
+        self._fabric_subs: Dict[int, set] = {}
         # wid -> [(msg, [handles])]; one record per message per tick
         self._outbox: Dict[int, List] = {}
         self._outbox_last: Dict[int, Tuple[int, List[int]]] = {}
@@ -145,9 +150,10 @@ class WorkerFabric:
         def deliver(msg, _opts, _wid=wid, _h=handle):
             self.enqueue(_wid, _h, msg)
 
-        self.broker.subscribe(
-            self._sid(wid, d["sid"]), d.get("cid", ""), filter_, opts, deliver
-        )
+        full_sid = self._sid(wid, d["sid"])
+        self.broker.subscribe(full_sid, d.get("cid", ""), filter_, opts,
+                              deliver)
+        self._fabric_subs.setdefault(wid, set()).add((full_sid, filter_))
         # retained replay (the worker-side channel hooks have no retainer;
         # semantics per emqx_retainer: never for $share, rh=2 never,
         # rh=1 only for fresh subscriptions)
@@ -171,22 +177,16 @@ class WorkerFabric:
         import json
 
         d = json.loads(body)
-        self.broker.unsubscribe(self._sid(wid, d["sid"]), d["f"])
+        full_sid = self._sid(wid, d["sid"])
+        self.broker.unsubscribe(full_sid, d["f"])
+        subs = self._fabric_subs.get(wid)
+        if subs is not None:
+            subs.discard((full_sid, d["f"]))
 
     def _drop_worker_subs(self, wid: int) -> None:
         """Worker died: every subscription it proxied is gone."""
-        prefix = f"w{wid}|"
-        drops = []
-        for f, entry in list(self.broker._subs.items()):
-            for sid in list(entry):
-                if sid.startswith(prefix):
-                    drops.append((sid, f))
-        for sid, f in drops:
+        for sid, f in self._fabric_subs.pop(wid, set()):
             self.broker.unsubscribe(sid, f)
-        # shared groups: walk the registry the same way
-        for sid, f in self.broker.shared.subscriptions_sids():
-            if sid.startswith(prefix):
-                self.broker.unsubscribe(sid, f)
 
     # -- publish side -----------------------------------------------------
     async def _on_pub_batch(self, writer, body: bytes) -> None:
@@ -273,7 +273,8 @@ class WorkerFabric:
                         "fabric.flush.dropped", len(records)
                     )
                     continue
-                w.write(F.pack_dlv_batch(records))
+                for frame in F.pack_dlv_batches(records):
+                    w.write(frame)
             except Exception:
                 # one worker's dead pipe (or a malformed record) must not
                 # lose the OTHER workers' deliveries in this tick
@@ -383,6 +384,15 @@ class WorkerBroker:
         if h is None:
             return False
         self._subs.pop(h, None)
+        ent = self._sub_acks.pop(h, None)
+        if ent is not None:
+            # unsubscribing a confirm-pending handle (e.g. the channel's
+            # failed-subscribe rollback): cancel the timer and resolve
+            # so nothing leaks or waits on an ack that can't arrive
+            fut, timer = ent
+            timer.cancel()
+            if not fut.done():
+                fut.set_result(False)
         self._send(F.pack_json(F.T_UNSUB, {"sid": sid, "f": filter_}))
         return True
 
@@ -410,17 +420,32 @@ class WorkerBroker:
         buf, self._pub_buf = self._pub_buf, []
         if not buf:
             return
-        seq = self._next_seq
-        self._next_seq += 1
-        futs = [f for _, f in buf]
-        if any(f is not None for f in futs):
-            # safety: a lost ack (router bug / torn link mid-restart)
-            # must not wedge every publisher's PUBACK forever
-            timer = asyncio.get_running_loop().call_later(
-                self.ACK_TIMEOUT_S, self._expire_batch, seq
-            )
-            self._inflight[seq] = (futs, timer)
-        self._send(F.pack_pub_batch([m for m, _ in buf], seq))
+        # chunk below the fabric frame cap: ~64 pipelined max-size
+        # publishes in one tick would otherwise exceed the receiver's
+        # MAX_FRAME and tear down the link
+        start = 0
+        while start < len(buf):
+            size = 8
+            end = start
+            while end < len(buf):
+                r = F.pub_record_size(buf[end][0])
+                if end > start and size + r > F.MAX_BODY:
+                    break
+                size += r
+                end += 1
+            chunk = buf[start:end]
+            start = end
+            seq = self._next_seq
+            self._next_seq += 1
+            futs = [f for _, f in chunk]
+            if any(f is not None for f in futs):
+                # safety: a lost ack (router bug / torn link mid-restart)
+                # must not wedge every publisher's PUBACK forever
+                timer = asyncio.get_running_loop().call_later(
+                    self.ACK_TIMEOUT_S, self._expire_batch, seq
+                )
+                self._inflight[seq] = (futs, timer)
+            self._send(F.pack_pub_batch([m for m, _ in chunk], seq))
 
     def _expire_batch(self, seq: int) -> None:
         ent = self._inflight.pop(seq, None)

@@ -59,6 +59,77 @@ def test_dlv_batch_roundtrip():
     assert handles == [7, 9, 4000000]
 
 
+def test_dlv_batches_split_below_frame_cap():
+    """A huge delivery tick splits into multiple frames, each under the
+    soft cap (one oversized frame would hit the receiver's MAX_FRAME
+    reject and tear the fabric link)."""
+    msgs = [
+        (Message(topic=f"t/{i}", payload=b"z" * 300_000, from_client="p"),
+         [i, i + 1])
+        for i in range(40)
+    ]
+    frames = list(F.pack_dlv_batches(msgs, max_body=1_000_000))
+    assert len(frames) > 1
+    total = []
+    for frame in frames:
+        assert len(frame) - 5 <= 1_000_000 + 300_100  # cap + one record
+        assert frame[4] == F.T_DLV
+        total.extend(F.unpack_dlv_batch(frame[5:]))
+    assert [t for t, *_ in total] == [f"t/{i}" for i in range(40)]
+
+
+def test_flush_pubs_chunks_below_frame_cap():
+    """Worker-side publish flush splits an oversized tick into several
+    PUBB frames, each with its own seq — and the acks resolve the right
+    futures."""
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.metrics import Metrics
+    from emqx_tpu.transport.workers import WorkerBroker
+
+    class CaptureWriter:
+        def __init__(self):
+            self.chunks = []
+
+        def is_closing(self):
+            return False
+
+        def write(self, data):
+            self.chunks.append(bytes(data))
+
+    async def run():
+        wb = WorkerBroker(Hooks(), Metrics())
+        w = CaptureWriter()
+        wb.attach_link(w)
+        old_cap = F.MAX_BODY
+        F.MAX_BODY = 1_000_000
+        try:
+            futs = []
+            for i in range(12):
+                r = wb._enqueue_pub(
+                    Message(topic=f"big/{i}", payload=b"q" * 400_000,
+                            qos=1, from_client="c")
+                )
+                futs.append(r)
+            await asyncio.sleep(0)  # let the scheduled flush run
+        finally:
+            F.MAX_BODY = old_cap
+        assert len(w.chunks) >= 4  # 12 * 400k over a 1MB cap
+        seqs = set()
+        n_records = 0
+        for frame in w.chunks:
+            assert frame[4] == F.T_PUBB
+            assert len(frame) - 5 <= 1_000_000 + 400_100
+            seq, recs = F.unpack_pub_batch(frame[5:])
+            seqs.add(seq)
+            n_records += len(recs)
+            # ack each chunk: its futures must resolve independently
+            wb.on_pub_ack(seq, [1] * len(recs))
+        assert n_records == 12 and len(seqs) == len(w.chunks)
+        assert all(f.done() and f.result() == 1 for f in futs)
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
 # -- live pool ---------------------------------------------------------------
 
 
