@@ -1,0 +1,86 @@
+"""Profile the single-process serving hot path (no TPU: host plane only)."""
+import asyncio
+import cProfile
+import pstats
+import socket
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+async def main():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+    from emqx_tpu.mqtt.client import Client
+
+    workers = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    port = _free_port()
+    app = BrokerApp(load_config({
+        "listeners": [{"port": port, "bind": "127.0.0.1",
+                       "workers": workers}],
+        "dashboard": {"enable": False},
+        "router": {"enable_tpu": False},
+    }))
+    await app.start()
+    if workers:
+        await app.worker_pools[0].wait_ready()
+
+    N_SUB, N_PUB, PER = 8, 8, 1500
+    subs = []
+    for i in range(N_SUB):
+        c = Client(client_id=f"s{i}", keepalive=0)
+        await c.connect("127.0.0.1", port)
+        await c.subscribe("bench/+/t", qos=0)
+        subs.append(c)
+    pubs = []
+    for i in range(N_PUB):
+        c = Client(client_id=f"p{i}", keepalive=0)
+        await c.connect("127.0.0.1", port)
+        pubs.append(c)
+    await asyncio.sleep(0.5)
+
+    total = N_PUB * PER
+
+    async def pump(p, i):
+        for j in range(PER):
+            await p.publish(f"bench/{i}/t", b"x" * 64, qos=0)
+            if j % 200 == 0:
+                await asyncio.sleep(0)
+
+    async def drain(c):
+        got = 0
+        while got < total:
+            await c.recv(120)
+            got += 1
+        return got
+
+    import os
+    prof = os.environ.get("PROF", "1") == "1"
+    pr = cProfile.Profile()
+    t0 = time.perf_counter()
+    if prof:
+        pr.enable()
+    await asyncio.wait_for(
+        asyncio.gather(*[pump(p, i) for i, p in enumerate(pubs)],
+                       *[drain(c) for c in subs]), 600)
+    if prof:
+        pr.disable()
+    wall = time.perf_counter() - t0
+    print(f"workers={workers} msgs/s={total / wall:.0f} "
+          f"dlv/s={total * N_SUB / wall:.0f} wall={wall:.1f}s")
+    if prof:
+        st = pstats.Stats(pr)
+        st.sort_stats("cumulative").print_stats(35)
+    for c in subs + pubs:
+        await c.disconnect()
+    await app.stop()
+
+
+asyncio.run(main())

@@ -37,11 +37,68 @@ Prints exactly ONE JSON line:
 
 from __future__ import annotations
 
+import hashlib
+import inspect
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# -- persistent caches -------------------------------------------------------
+# The r4 gate captured only 2/8 configs: the sweep's wall was dominated
+# by rebuilding identical artifacts every run — XLA compiles (~40-80s per
+# program over the dev tunnel), 10M-filter table builds (85-215s), and
+# in-process Python-trie CPU baselines (~90-150s). All three are
+# deterministic functions of the workload definition, so they cache on
+# disk keyed by a fingerprint of the defining source + parameters; any
+# code change invalidates the key and the artifact rebuilds. A cold
+# cache still completes (the budget skip logic below is unchanged) —
+# the cache only decides HOW MUCH of the sweep fits the budget.
+CACHE_DIR = os.environ.get(
+    "BENCH_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache"),
+)
+
+
+def _enable_xla_cache() -> None:
+    """Persistent XLA compilation cache (validated against the axon
+    backend: 3.2s cold -> 0.8s warm for a toy program; ~40-80s -> ~2s
+    for route_step). Safe to call before any jax use."""
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(CACHE_DIR, "xla")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # cache is an optimization, never a gate
+        _mark(f"xla cache unavailable: {e!r}")
+
+
+def _cache_path(tag: str, *fingerprint) -> str:
+    h = hashlib.sha256()
+    for part in fingerprint:
+        h.update(repr(part).encode())
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    return os.path.join(CACHE_DIR, f"{tag}-{h.hexdigest()[:16]}")
+
+
+def _cache_get_json(path: str):
+    try:
+        with open(path + ".json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _cache_put_json(path: str, obj) -> None:
+    tmp = f"{path}.json.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path + ".json")
+
 
 BATCH = 8192
 MAX_BYTES = 64
@@ -61,6 +118,28 @@ _T0 = time.perf_counter()
 
 def _mark(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _workload_fingerprint():
+    """Anything that defines a config's workload: a change rebuilds."""
+    return (
+        inspect.getsource(build_config),
+        inspect.getsource(_build_mixed_10m),
+        BATCH, TIMED_BATCHES, CPU_SAMPLE, MAX_BYTES,
+        sorted(CFG.items()),
+    )
+
+
+def _tables_fingerprint():
+    """Everything the device tables are a function of (module sources):
+    a change to any indexing/kernel code invalidates cached tables."""
+    from emqx_tpu.models import router_model
+    from emqx_tpu.ops import nfa, route_index, shape_index, tokenizer
+
+    return tuple(
+        inspect.getsource(m)
+        for m in (route_index, shape_index, nfa, tokenizer, router_model)
+    )
 
 
 def _zipf_ids(rng, n, k):
@@ -248,6 +327,20 @@ def bench_config(name, rng, measure_updates=False):
     from emqx_tpu.ops.route_index import RouteIndex
     from emqx_tpu.ops.tokenizer import encode_topics
 
+    # table-artifact fast path: share_10m needs no live index (no update
+    # phase), so its 215s build caches as a .npz of the device tables +
+    # staged topics; the timed loops, latency, and the device-vs-host
+    # correctness comparison still run fresh on the chip every sweep
+    art_path = None
+    if name == "share_10m" and not measure_updates:
+        art_path = _cache_path(
+            "tables-share_10m", _workload_fingerprint(),
+            _tables_fingerprint(),
+        )
+        res = _bench_from_artifact(name, art_path)
+        if res is not None:
+            return res
+
     _mark(f"{name}: building")
     filters, topics, spf = build_config(name, rng)
 
@@ -364,10 +457,149 @@ def bench_config(name, rng, measure_updates=False):
         raise  # correctness gate (visibility/mcount), never optional
     except Exception as e:
         _mark(f"{name}: update/visibility phase failed ({e!r}); continuing")
-    return _bench_config_tail(
+    res = _bench_config_tail(
         name, index, filters, topics, spf, insert_s, stage, step, tpu_rps,
         lats, upd_s, vis_ms, hbm_mb, shape_tables, nfa_tables, sub_bitmaps,
     )
+    check = res.pop("_check", None)
+    if art_path is not None and check is not None:
+        try:
+            _save_table_artifact(
+                art_path, index, subs, bytes_mat, lengths, spf, res, check
+            )
+        except Exception as e:  # cache write is never a gate
+            _mark(f"{name}: artifact save failed ({e!r}); continuing")
+    return res
+
+
+def _save_table_artifact(art_path, index, subs, bytes_mat, lengths, spf,
+                         res, check) -> None:
+    """Persist device tables + staged topics + the host-verified
+    correctness reference (the 256 per-topic match counts the tail just
+    checked against an independent host-side count)."""
+    snap = index.shapes.device_snapshot()
+    nfa_snap = (
+        index.nfa.device_snapshot() if index.residual_count > 0 else {}
+    )
+    t0 = time.perf_counter()
+    # tmp must END in .npz (np.savez appends it otherwise and the
+    # atomic rename would miss the real file)
+    tmp = f"{art_path}.{os.getpid()}.tmp.npz"
+    np.savez(
+        tmp,
+        **{f"shape_{k}": v for k, v in snap.items()},
+        **{f"nfa_{k}": v for k, v in nfa_snap.items()},
+        subs=subs.pack(index.num_filters_capacity),
+        bytes_mat=bytes_mat,
+        lengths=lengths,
+    )
+    os.replace(tmp, art_path + ".npz")
+    _cache_put_json(
+        art_path,
+        {
+            "salt": int(index.salt),
+            "m_active": int(index.shapes.m_active()),
+            "spf": spf,
+            "result": res,
+            "check": check,
+        },
+    )
+    _mark(f"artifact saved in {time.perf_counter() - t0:.1f}s")
+
+
+def _bench_from_artifact(name, art_path):
+    """Cache-hit runner: rebuild step() from the persisted tables and run
+    the TIMED phases fresh on the chip. Returns None on any miss."""
+    meta = _cache_get_json(art_path)
+    if meta is None or not os.path.exists(art_path + ".npz"):
+        return None
+    import jax
+
+    from emqx_tpu.models.router_model import shape_route_step
+
+    _mark(f"{name}: loading cached tables")
+    z = np.load(art_path + ".npz")
+    shape_tables = {
+        k[6:]: jax.device_put(z[k]) for k in z.files
+        if k.startswith("shape_")
+    }
+    nfa_tables = {
+        k[4:]: jax.device_put(z[k]) for k in z.files if k.startswith("nfa_")
+    } or None
+    sub_bitmaps = jax.device_put(z["subs"])
+    bytes_mat, lengths = z["bytes_mat"], z["lengths"]
+    hbm_mb = (
+        sum(z[k].nbytes for k in z.files
+            if k.startswith(("shape_", "nfa_")))
+        + z["subs"].nbytes
+    ) / 1e6
+    m_active, salt = meta["m_active"], meta["salt"]
+    with_nfa = nfa_tables is not None
+
+    step = lambda bm, ln: shape_route_step(  # noqa: E731
+        shape_tables, nfa_tables, sub_bitmaps, bm, ln,
+        m_active=m_active, with_nfa=with_nfa, salt=salt, **CFG,
+    )
+    stage = [
+        (
+            jax.device_put(bytes_mat[b * BATCH : (b + 1) * BATCH]),
+            jax.device_put(lengths[b * BATCH : (b + 1) * BATCH]),
+        )
+        for b in range(TIMED_BATCHES)
+    ]
+    _mark(f"{name}: cached tables up; compiling")
+    jax.block_until_ready(step(*stage[0]))
+    _mark(f"{name}: compiled; timing")
+    rates = []
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(REPEATS):
+            for bm, ln in stage:
+                last = step(bm, ln)
+        jax.block_until_ready(last["stats"]["matches"])
+        rates.append(BATCH * TIMED_BATCHES * REPEATS
+                     / (time.perf_counter() - t0))
+    tpu_rps = float(np.median(rates))
+    lats = []
+    for b in range(LAT_BATCHES):
+        bm, ln = stage[b % TIMED_BATCHES]
+        t1 = time.perf_counter()
+        jax.block_until_ready(step(bm, ln))
+        lats.append(time.perf_counter() - t1)
+    lats = np.array(lats)
+    # correctness: the device must reproduce the match counts that were
+    # verified against the independent host-side count when the artifact
+    # was built (tables + topics are deterministic)
+    o = step(*stage[0])
+    flags0 = np.asarray(o["flags"])[:256]
+    mcount0 = np.asarray(o["mcount"])[:256]
+    want = np.asarray(meta["check"]["mcount256"])
+    wflags = np.asarray(meta["check"]["flags256"])
+    ok = (flags0.astype(bool) == wflags.astype(bool)).all() and (
+        mcount0[~flags0.astype(bool)] == want[~wflags.astype(bool)]
+    ).all()
+    assert ok, f"{name}: cached-table correctness mismatch"
+    total_matches = int(np.asarray(o["mcount"]).sum())
+    total_fanout = int(
+        np.unpackbits(
+            np.ascontiguousarray(np.asarray(o["bitmaps"])).view(np.uint8)
+        ).sum()
+    )
+    out = dict(meta["result"])
+    out.update(
+        {
+            "tpu_rps": round(tpu_rps, 1),
+            "batch_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2),
+            "batch_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+            "matches_per_topic": round(total_matches / BATCH, 3),
+            "fanout_bits_per_topic": round(total_fanout / BATCH, 3),
+            "hbm_mb": round(hbm_mb, 1),
+            "speedup": round(tpu_rps / out["cpu_trie_rps"], 2),
+            "cached_tables": True,
+        }
+    )
+    return out
 
 
 def _measure_updates(index, nfa_tables, with_nfa):
@@ -467,25 +699,42 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
     n_topics_pass = BATCH
     flag_rate = float(flags0.mean())
     assert flag_rate < 0.01, (name, flag_rate)
+    from emqx_tpu.broker import trie as _trie_mod
     from emqx_tpu.broker.trie import TopicTrie
 
     cpu_subsample = 10 if len(filters) > 2_000_000 else 1
-    trie = TopicTrie()
-    for f in filters[::cpu_subsample]:
-        trie.insert(f)
-    sample = topics[:CPU_SAMPLE]
-    t1 = time.perf_counter()
-    sum(len(trie.match(t)) for t in sample)
-    cpu_s = time.perf_counter() - t1
-    cpu_rps = len(sample) / cpu_s
-    if cpu_subsample == 1:
+    # CPU-baseline measurement cache: the in-process Python trie is a
+    # deterministic function of (workload, trie code, subsample) — the
+    # 1M-filter builds were 90-150s of every sweep. On a hit, the
+    # device-vs-host correctness check switches to the shape-inversion
+    # count (the same independent check the 10M configs always use).
+    cpu_key = _cache_path(
+        f"cpu-{name}", _workload_fingerprint(),
+        inspect.getsource(_trie_mod), cpu_subsample,
+    )
+    cpu_cached = _cache_get_json(cpu_key)
+    trie = None
+    if cpu_cached is not None:
+        cpu_rps = cpu_cached["cpu_rps"]
+        _mark(f"{name}: cpu baseline from cache ({cpu_rps:.0f} rps)")
+    else:
+        trie = TopicTrie()
+        for f in filters[::cpu_subsample]:
+            trie.insert(f)
+        sample = topics[:CPU_SAMPLE]
+        t1 = time.perf_counter()
+        sum(len(trie.match(t)) for t in sample)
+        cpu_s = time.perf_counter() - t1
+        cpu_rps = len(sample) / cpu_s
+        _cache_put_json(cpu_key, {"cpu_rps": cpu_rps})
+    if trie is not None and cpu_subsample == 1:
         # matched counts must agree with the trie on a workload sample
         for i in range(256):
             if not flags0[i]:
                 assert mcount0[i] == len(trie.match(topics[i])), (name, i)
     else:
-        # 10M-scale: independent host check via shape inversion (set
-        # lookups) + residual trie — no 10M python trie build
+        # independent host check via shape inversion (set lookups) +
+        # residual trie — works at any scale, no full python trie build
         res_trie = TopicTrie()
         for f in index._residual:
             res_trie.insert(f)
@@ -525,6 +774,12 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
         out["update_sync_ms"] = round(upd_s * 1e3, 3)
     if vis_ms is not None:
         out["subscribe_visibility_ms"] = round(vis_ms, 3)
+    # host-verified per-topic counts: consumed by the table-artifact
+    # cache as the cache-hit correctness reference (popped before emit)
+    out["_check"] = {
+        "mcount256": mcount0[:256].astype(int).tolist(),
+        "flags256": flags0[:256].astype(int).tolist(),
+    }
     return out
 
 
@@ -608,14 +863,28 @@ def bench_retained(rng):
     # linear extrapolation OVERSTATED the cpu cost ~4x). A half-size
     # 2.5M store keeps the build inside the budget and is CONSERVATIVE:
     # sublinear growth means the true 5M walk costs more than measured.
+    # The measurement caches (pure CPU, deterministic in workload +
+    # retainer code): the 2.5M store build was ~150s of every sweep.
+    from emqx_tpu.broker import retainer as _ret_mod
+
     CPU_N = N // 2
-    cpu = Retainer(max_retained=CPU_N, device_threshold=1 << 62)
-    for t in topics[:CPU_N]:
-        cpu._insert(Message(topic=t, payload=b"r", retain=True))
-    t0 = _t.perf_counter()
-    for f in filters[:4]:
-        cpu.match(f)
-    cpu_per_sub_s = (_t.perf_counter() - t0) / 4  # DIRECT, unscaled
+    cpu_key = _cache_path(
+        "cpu-retained_5m", N, SITES, DEVIDS, STORM, CPU_N,
+        inspect.getsource(_ret_mod),
+    )
+    cached = _cache_get_json(cpu_key)
+    if cached is not None:
+        cpu_per_sub_s = cached["cpu_per_sub_s"]
+        _mark("retained_5m: cpu baseline from cache")
+    else:
+        cpu = Retainer(max_retained=CPU_N, device_threshold=1 << 62)
+        for t in topics[:CPU_N]:
+            cpu._insert(Message(topic=t, payload=b"r", retain=True))
+        t0 = _t.perf_counter()
+        for f in filters[:4]:
+            cpu.match(f)
+        cpu_per_sub_s = (_t.perf_counter() - t0) / 4  # DIRECT, unscaled
+        _cache_put_json(cpu_key, {"cpu_per_sub_s": cpu_per_sub_s})
     cpu_storm_s = cpu_per_sub_s * STORM
     hbm_mb = sum(b.nbytes for b in dev._host_b) / 1e6
     return {
@@ -657,6 +926,20 @@ def bench_retained_spot() -> dict:
     DEVIDS = 100003
     FILTERS = [f"site/+/dev/{d}/ch/#" for d in (7, 1009, 4021)]
 
+    # pure-CPU validator, deterministic in (workload, retainer code):
+    # the whole result caches — two store builds were ~150s per sweep
+    from emqx_tpu.broker import retainer as _ret_mod
+
+    key = _cache_path(
+        "retained_spot", SITES, DEVIDS, FILTERS,
+        inspect.getsource(_ret_mod),
+        inspect.getsource(bench_retained_spot),
+    )
+    cached = _cache_get_json(key)
+    if cached is not None:
+        _mark("retained_spot: result from cache (pure-CPU validator)")
+        return dict(cached, cached_result=True)
+
     def build_and_walk(n):
         cpu = Retainer(max_retained=n, device_threshold=1 << 62)
         for i in range(n):
@@ -683,7 +966,7 @@ def bench_retained_spot() -> dict:
     ratios = [
         round(b / s, 2) for (s, _), (b, _) in zip(small, big) if s > 0
     ]
-    return {
+    res = {
         "filters_walked": FILTERS,
         "store_500k_per_subscriber_ms": s_ms,
         "store_2500k_per_subscriber_ms": b_ms,
@@ -697,6 +980,8 @@ def bench_retained_spot() -> dict:
             "leading-wildcard family"
         ),
     }
+    _cache_put_json(key, res)
+    return res
 
 
 E2E_WORKER_COUNTS = (0, 4)  # host data-plane scaling curve (r3 item 2)
@@ -967,6 +1252,8 @@ def bench_e2e() -> dict:
 
 def run_one(name: str) -> None:
     """Child-process entry: one config, one JSON line on stdout."""
+    if name != "_e2e_driver":
+        _enable_xla_cache()
     if name == "_e2e_driver":
         e2e_driver(
             int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
@@ -1073,17 +1360,24 @@ def main() -> None:
                     ),
                     "skipped_configs": skipped,
                     "wall_s": round(time.perf_counter() - _T0, 1),
+                    # the note reflects the ACTUAL run (r4 shipped a
+                    # hardcoded "all swept" string in a 2/8 capture)
                     "note": (
-                        "headline = median of 3 timing loops on the "
+                        f"captured {len(results)}/"
+                        f"{len(CONFIGS) + len(EXTRAS)} configs: "
+                        + (", ".join(results) if results else "none")
+                        + (
+                            f"; SKIPPED: {', '.join(skipped)}"
+                            if skipped
+                            else "; full sweep, zero skips"
+                        )
+                        + ". headline = median of 3 timing loops on the "
                         "shape-DIVERSE 10M config (66 wildcard shapes, "
-                        "residual NFA engaged; r3 verdict item 3), first "
-                        "config in a fresh process (tunnel degrades after "
-                        "readback bursts; one process per config). "
+                        "residual NFA engaged), one fresh process per "
+                        "config (tunnel degrades after readback bursts). "
                         "per-batch p50/p99 include dev-tunnel dispatch "
                         "overhead; e2e_serving latencies are "
-                        "socket-to-socket incl. the ingest window. All 5 "
-                        "BASELINE configs swept plus mixed_10m and "
-                        "e2e_serving."
+                        "socket-to-socket incl. the ingest window."
                     ),
                     "configs": results,
                 },

@@ -540,6 +540,7 @@ class BrokerApp:
                 self.broker,
                 max_batch=c.router.ingest_max_batch,
                 window_us=c.router.ingest_window_us,
+                pipeline=c.router.ingest_pipeline,
             )
             self.broker.ingest.start()
         # restore durable state BEFORE listeners accept clients
@@ -612,7 +613,8 @@ class BrokerApp:
             from emqx_tpu.gateway.registry import GatewayRegistry
 
             self.gateways = GatewayRegistry(
-                self.broker, self.hooks, retainer=self.retainer
+                self.broker, self.hooks, retainer=self.retainer,
+                psk=self.psk,
             )
             _register_builtin_gateways(self.gateways)
             for gspec in c.gateways:

@@ -45,6 +45,28 @@ class Subscriber:
         self.filter = ""  # the real (share-stripped) subscription filter
 
 
+class PendingDispatch:
+    """A launched-but-unsettled batch dispatch (adispatch_begin).
+
+    `ready`: side-effect-free future resolving when the device round
+    trip completes (never triggers fan-out — safe to race/poll).
+    `complete()`: coroutine performing the host fan-out + returning
+    per-message delivery counts; callers invoke it in launch order.
+    Awaiting the object is shorthand for awaiting complete()."""
+
+    __slots__ = ("ready", "_complete")
+
+    def __init__(self, ready, complete):
+        self.ready = ready
+        self._complete = complete
+
+    def complete(self):
+        return self._complete()
+
+    def __await__(self):
+        return self._complete().__await__()
+
+
 class Broker:
     def __init__(
         self,
@@ -294,19 +316,52 @@ class Broker:
         offloaded to an executor thread so the event loop keeps serving
         every other connection. Table packing/upload and delivery stay on
         the loop thread — they touch mutable broker state."""
+        return await self.adispatch_begin(msgs, forward)
+
+    def adispatch_begin(
+        self, msgs: Sequence[Message], forward: bool = True
+    ) -> "PendingDispatch":
+        """Launch the device dispatch for a batch NOW (table snapshot +
+        executor kernel submit) and return a PendingDispatch. This is
+        the ingest pipeline's seam: batch N+1's upload+launch overlaps
+        batch N's readback round-trip (the dominant wall on a tunneled
+        chip; on real hardware it overlaps host fan-out with device
+        compute).
+
+        The host FAN-OUT runs only inside `complete()` (equivalently:
+        awaiting the object) — NEVER autonomously when the device work
+        finishes — so callers settling batches in launch (FIFO) order
+        preserve MQTT's per-publisher delivery ordering across batches.
+        `ready` is a side-effect-free future signalling that the device
+        round-trip finished (pipeline pacing only)."""
+        loop = asyncio.get_running_loop()
         r = self.router
         if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
-            return self.dispatch_batch_folded(msgs, forward)
+            ready = loop.create_future()
+            ready.set_result(None)
+
+            async def _cpu():
+                # CPU batches defer dispatch to settle time too: a small
+                # batch settling before an in-flight device batch would
+                # invert cross-batch delivery order
+                return self.dispatch_batch_folded(msgs, forward)
+
+            return PendingDispatch(ready, _cpu)
         dev = self._device_router()
         args = dev.prepare()
-        results = await asyncio.get_running_loop().run_in_executor(
+        fut = loop.run_in_executor(
             None,
             dev.route_prepared,
             args,
             [m.topic for m in msgs],
             self._client_hashes(msgs),
         )
-        return self._dispatch_device_results(msgs, results, forward)
+
+        async def _complete():
+            results = await fut
+            return self._dispatch_device_results(msgs, results, forward)
+
+        return PendingDispatch(fut, _complete)
 
     def _device_router(self):
         if self._device is None:

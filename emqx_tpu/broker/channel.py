@@ -27,6 +27,7 @@ from emqx_tpu.broker import mountpoint as MP
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.session import Session, SessionConfig
 from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.mqtt.frame import serialize
 from emqx_tpu.ops import topics as T
 from emqx_tpu.utils.tracepoints import atp, tp
 
@@ -98,6 +99,8 @@ class Channel:
         self._ack_queue: deque = deque()
         self._ack_task: Optional[asyncio.Task] = None
         self._ack_drained: Optional[asyncio.Event] = None
+        # hot-path client_info snapshot (see _ci_snapshot)
+        self._ci: Optional[Dict] = None
 
     # -- helpers ----------------------------------------------------------
     def _send(self, p) -> None:
@@ -122,6 +125,16 @@ class Channel:
             **self.auth_attrs,
         }
 
+    def _ci_snapshot(self) -> Dict:
+        """Read-only client_info for the per-message hot paths (deliver /
+        publish-authorize hooks): building the dict fresh per delivery was
+        one of the larger host-plane costs. Rebuilt whenever the identity
+        attributes change (connect completion, re-auth)."""
+        ci = self._ci
+        if ci is None:
+            ci = self._ci = self.client_info()
+        return ci
+
     # -- inbound dispatch -------------------------------------------------
     async def handle_in(self, p) -> None:
         self.broker.metrics.inc("packets.received")
@@ -142,7 +155,7 @@ class Channel:
         if t == pkt.PUBACK:
             acked, more = self.session.puback(p.packet_id)
             if acked is not None:
-                self.hooks.run("message.acked", self.client_info(), acked)
+                self.hooks.run("message.acked", self._ci_snapshot(), acked)
                 self._delivery_completed(acked)
             for q in more:
                 self._send(q)
@@ -174,7 +187,7 @@ class Channel:
         if t == pkt.PUBCOMP:
             completed, more = self.session.pubcomp(p.packet_id)
             if completed is not None:
-                self.hooks.run("message.acked", self.client_info(), completed)
+                self.hooks.run("message.acked", self._ci_snapshot(), completed)
                 self._delivery_completed(completed)
             for q in more:
                 self._send(q)
@@ -234,6 +247,7 @@ class Channel:
             self.auth_attrs.update(
                 {k: v for k, v in attrs.items() if k != "username"}
             )
+            self._ci = None  # re-auth may change identity attributes
             self._send(
                 pkt.Auth(
                     reason_code=pkt.RC_SUCCESS,
@@ -359,7 +373,17 @@ class Channel:
         self.mountpoint = MP.replvar(
             self.config.mountpoint, self.client_info()
         )
-        session, present = self.cm.open_session(self)
+        r = self.cm.open_session(self)
+        if inspect.isawaitable(r):
+            # worker-fabric CM: the open resolves at the router (one
+            # round trip covers node-wide discard/takeover/resume)
+            r = await r
+            if self.state not in ("idle", "authenticating") or (
+                self.sink is not None
+                and getattr(self.sink, "_closing", False)
+            ):
+                return  # kicked while awaiting the router
+        session, present = r
         self.session = session
         if self.version == pkt.MQTT_V5:
             # v5 default expiry is 0 unless the client asks otherwise
@@ -370,6 +394,7 @@ class Channel:
             session.config.expiry_interval = 0
         self.state = "connected"
         self.connected_at = time.time()
+        self._ci = None  # identity finalized: next hot-path use snapshots
         props: pkt.Properties = {}
         if self.version == pkt.MQTT_V5:
             if assigned:
@@ -443,7 +468,8 @@ class Channel:
             return self._close("retain_disabled", pkt.RC_RETAIN_NOT_SUPPORTED)
 
         allowed = await self.hooks.arun_fold(
-            "client.authorize", (self.client_info(), "publish", topic), "allow"
+            "client.authorize", (self._ci_snapshot(), "publish", topic),
+            "allow",
         )
         if allowed != "allow":
             self.broker.metrics.inc("messages.dropped.not_authorized")
@@ -753,9 +779,51 @@ class Channel:
             if self.session is not None and msg.qos > 0:
                 self.session.mqueue.in_(msg)
             return
+        # QoS0 fan-out fast path: serialize ONCE per (version, retain,
+        # topic) and write the same bytes to every subscriber socket —
+        # per-subscriber Publish construction + serialization was a top
+        # per-delivery cost with fan-out 8 (the cache rides the Message
+        # object, shared across its mount-variant copies)
+        # retained-store replays are EXCLUDED: those Message objects live
+        # as long as the store, and the cache would pin one serialized
+        # copy per (version, retain, topic) variant against each of
+        # millions of stored messages
+        qos0 = (
+            msg.qos == 0 or (opts is not None and opts.qos == 0)
+        ) and not msg.headers.get("retained")
+        sb = getattr(self.sink, "send_bytes", None)
+        if qos0 and sb is not None:
+            retain = (
+                msg.retain
+                if (opts is not None and opts.retain_as_published)
+                else bool(msg.headers.get("retained"))
+            )
+            fb = getattr(msg, "_fb", None)
+            if fb is None:
+                fb = {}
+                msg._fb = fb
+            key = (self.version, retain, msg.topic)
+            buf = fb.get(key)
+            if buf is None:
+                buf = fb[key] = serialize(
+                    pkt.Publish(
+                        topic=msg.topic,
+                        payload=msg.payload,
+                        qos=0,
+                        retain=retain,
+                        packet_id=None,
+                        properties=dict(msg.properties),
+                    ),
+                    self.version,
+                )
+            self.hooks.run("message.delivered", self._ci_snapshot(), msg)
+            sb(buf)
+            self.broker.metrics.inc("packets.sent")
+            self._delivery_completed(msg)
+            return
         out = self.session.deliver(msg, opts)
         for q in out:
-            self.hooks.run("message.delivered", self.client_info(), msg)
+            self.hooks.run("message.delivered", self._ci_snapshot(), msg)
             self._send(q)
             if q.type == pkt.PUBLISH and q.qos == 0:
                 # QoS0 completes at send; QoS1/2 complete at PUBACK/PUBCOMP
@@ -765,7 +833,7 @@ class Channel:
     def _delivery_completed(self, msg: Message) -> None:
         self.hooks.run(
             "delivery.completed",
-            self.client_info(),
+            self._ci_snapshot(),
             msg,
             time.time() - msg.timestamp,
         )

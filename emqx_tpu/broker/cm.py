@@ -25,6 +25,11 @@ class ChannelManager:
         self.broker = broker
         self._channels: Dict[str, object] = {}  # client_id -> Channel
         self._detached: Dict[str, Tuple[Session, float]] = {}
+        # worker fabrics (transport/workers.WorkerFabric) register here:
+        # a client LIVE on a connection worker reconnecting via an
+        # in-process listener must still take its session over
+        # (node-wide emqx_cm semantics; emqx_cm.erl:346-366)
+        self.fabrics: List[object] = []
 
     def get_channel(self, client_id: str):
         return self._channels.get(client_id)
@@ -39,8 +44,36 @@ class ChannelManager:
         return list(self._channels)
 
     # -- session lifecycle -------------------------------------------------
-    def open_session(self, channel) -> Tuple[Session, bool]:
-        """-> (session, session_present). Handles discard and takeover."""
+    def open_session(self, channel):
+        """-> (session, session_present), or an AWAITABLE of the same
+        when the session is live on a connection worker (the channel
+        handles both). The in-process path stays synchronous — the
+        asyncio loop is the per-clientid lock."""
+        cid = channel.client_id
+        for fab in self.fabrics:
+            if fab.owns(cid):
+                return self._open_via_fabric(channel, fab)
+        return self._open_local(channel)
+
+    async def _open_via_fabric(self, channel, fab) -> Tuple[Session, bool]:
+        """Take the live worker session over (or discard it) first, then
+        run the normal local open with the taken state."""
+        sj = await fab.take_session(
+            channel.client_id, channel.clean_start
+        )
+        remote = None
+        if sj is not None and not channel.clean_start:
+            from emqx_tpu.storage.codec import session_from_json
+
+            try:
+                remote = session_from_json(sj, channel.config.session)
+            except Exception:
+                remote = None
+        return self._open_local(channel, remote=remote)
+
+    def _open_local(
+        self, channel, remote: Optional[Session] = None
+    ) -> Tuple[Session, bool]:
         cid = channel.client_id
         old = self._channels.pop(cid, None)
         session: Optional[Session] = None
@@ -61,6 +94,12 @@ class ChannelManager:
                 self.broker.hooks.run("session.resumed", cid)
                 present = True
                 tp("cm.resumed", cid=cid)
+            elif remote is not None:
+                # taken over from a connection worker (fabric bridge)
+                session = remote
+                self.broker.hooks.run("session.takenover", cid)
+                present = True
+                tp("cm.takenover", cid=cid)
         if session is None:
             session = Session(cid, channel.config.session)
             self.broker.hooks.run("session.created", cid)

@@ -234,6 +234,48 @@ class Retainer:
         walk(self._root)
         return out
 
+    def messages_page(
+        self, after: Optional[str], limit: int
+    ) -> Tuple[List[Message], Optional[str]]:
+        """Ordered page of stored messages strictly AFTER topic `after`
+        (None = from the start): the cursor walk behind cluster
+        bootstrap and REST pagination (paged-read parity with
+        emqx_retainer_mnesia.erl:146-152). Ordering is word-tuple
+        lexicographic (parent before children, children sorted), and the
+        resume descent prunes subtrees before the cursor — a page costs
+        O(limit * depth + cursor depth), never a full store walk.
+        Returns (msgs, next_cursor); next_cursor None = no more pages."""
+        out: List[Message] = []
+
+        def walk(node: _Node, bound) -> None:
+            # bound: remaining cursor words under this subtree;
+            # None = subtree is entirely after the cursor,
+            # []   = cursor topic ends exactly at this node
+            if len(out) >= limit:
+                return
+            if node.msg is not None and bound is None:
+                out.append(node.msg)
+            if bound:
+                w0 = bound[0]
+                for w in sorted(node.children):
+                    if len(out) >= limit:
+                        return
+                    if w < w0:
+                        continue
+                    walk(
+                        node.children[w],
+                        bound[1:] if w == w0 else None,
+                    )
+            else:
+                for w in sorted(node.children):
+                    if len(out) >= limit:
+                        return
+                    walk(node.children[w], None)
+
+        walk(self._root, after.split("/") if after else None)
+        nxt = out[-1].topic if len(out) >= limit else None
+        return out, nxt
+
     def topics(self) -> List[str]:
         out: List[str] = []
 

@@ -211,6 +211,18 @@ class ClusterNode:
                 "dump": self._proto_retain_dump,
             },
         )
+        # v2 adds the PAGED bootstrap read (a 5-10M retained store must
+        # not ship as one multi-GB RPC reply); v1 stays frozen for
+        # old-version peers (BPAPI evolution rules)
+        self.rpc.registry.register(
+            "retain",
+            2,
+            {
+                "store": self._proto_retain_store,
+                "dump": self._proto_retain_dump,
+                "dump_page": self._proto_retain_dump_page,
+            },
+        )
         self.rpc.registry.register(
             "sess",
             1,
@@ -301,39 +313,35 @@ class ClusterNode:
         if self._retainer is not None:
             self._retain_boot_seen = set()
             try:
-                dump = self.rpc.call(seed, "retain", "dump")
 
-                def apply():
-                    # the local pre-join snapshot is taken ON THE LOOP
-                    # too (and BEFORE the dump applies, so the seed's
-                    # own set never re-replicates back out): the
-                    # retainer trie has no lock and listeners already
-                    # serve during join retries — an executor-thread
-                    # walk could tear mid-mutation
-                    local = self._retainer.all_messages()
+                def apply_page(page):
                     seen = self._retain_boot_seen or set()
-                    for mjson in dump:
+                    for mjson in page:
                         if mjson.get("topic") not in seen:
                             self._proto_retain_store(mjson)
-                    return local
 
-                if self._loop is not None and not self._loop.is_closed():
-                    import concurrent.futures
-
-                    fut: "concurrent.futures.Future" = (
-                        concurrent.futures.Future()
-                    )
-
-                    def run():
-                        try:
-                            fut.set_result(apply())
-                        except BaseException as e:
-                            fut.set_exception(e)
-
-                    self._loop.call_soon_threadsafe(run)
-                    local = fut.result(timeout=120)
+                # the local pre-join snapshot is taken ON THE LOOP (and
+                # BEFORE any page applies, so the seed's own set never
+                # re-replicates back out): the retainer trie has no lock
+                # and listeners already serve during join retries — an
+                # executor-thread walk could tear mid-mutation
+                local = self._call_on_loop(self._retainer.all_messages)
+                if self.rpc.supported_version(seed, "retain") >= 2:
+                    # paged bootstrap: bounded pages instead of one
+                    # multi-GB reply at 5-10M retained messages; each
+                    # page applies on the loop before the next is pulled
+                    cursor = None
+                    while True:
+                        page, cursor = self.rpc.call(
+                            seed, "retain", "dump_page", cursor,
+                            self.RETAIN_PAGE_MAX,
+                        )
+                        self._call_on_loop(lambda p=page: apply_page(p))
+                        if cursor is None:
+                            break
                 else:
-                    local = apply()
+                    dump = self.rpc.call(seed, "retain", "dump")
+                    self._call_on_loop(lambda: apply_page(dump))
                 for m in local:
                     self._replicate_retain(m)
             except RpcError as e:
@@ -346,6 +354,24 @@ class ClusterNode:
             finally:
                 self._retain_boot_seen = None
         return True
+
+    def _call_on_loop(self, fn, timeout: float = 120.0):
+        """Run `fn` on the app event loop (when one is attached) from a
+        bus/executor thread; synchronous fallback in library mode."""
+        if self._loop is None or self._loop.is_closed():
+            return fn()
+        import concurrent.futures
+
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:
+                fut.set_exception(e)
+
+        self._loop.call_soon_threadsafe(run)
+        return fut.result(timeout=timeout)
 
     def leave(self) -> None:
         if self._repl_pool is not None:
@@ -497,10 +523,8 @@ class ClusterNode:
         self._retainer.on_publish(msg)
 
     def _proto_retain_dump(self):
-        """Join-time bootstrap: the seed's retained set ('$'-rooted
-        topics included — a plain store walk). Capped: one RPC reply is
-        not a streaming protocol; past the cap the joiner converges via
-        live replication only (paged streaming is the upgrade path)."""
+        """LEGACY (retain v1) join-time bootstrap: the seed's retained
+        set in one reply, capped. v2 peers use the paged read."""
         from emqx_tpu.storage.codec import msg_to_json
 
         if self._retainer is None:
@@ -510,6 +534,22 @@ class ClusterNode:
             self.broker.metrics.inc("cluster.retain.dump_truncated")
             msgs = msgs[: self.RETAIN_DUMP_CAP]
         return [msg_to_json(m) for m in msgs]
+
+    RETAIN_PAGE_MAX = 5000
+
+    def _proto_retain_dump_page(self, after, limit):
+        """Paged bootstrap read (retain v2): ordered cursor walk, each
+        page a bounded RPC reply — a 5-10M-message store bootstraps in
+        bounded memory (emqx_retainer_mnesia.erl:146-152 paged-read
+        parity). Returns (page_json, next_cursor | None)."""
+        from emqx_tpu.storage.codec import msg_to_json
+
+        if self._retainer is None:
+            return [], None
+        msgs, nxt = self._retainer.messages_page(
+            after, min(int(limit), self.RETAIN_PAGE_MAX)
+        )
+        return [msg_to_json(m) for m in msgs], nxt
 
     # -- cluster-wide shared groups ----------------------------------------
     def shared_join(self, real: str, group: str) -> None:

@@ -89,6 +89,9 @@ class RouterConfig:
     ingest_enable: bool = True
     ingest_window_us: int = 1000
     ingest_max_batch: int = 4096
+    # device dispatches in flight at once (batch N+1's upload/launch
+    # overlaps batch N's readback); settlement stays FIFO for ordering
+    ingest_pipeline: int = 2
     # SPMD serving over a device mesh: [dp, tp] axis sizes. [0, 0] (the
     # default) = single-device serving; set e.g. [4, 2] on an 8-chip
     # host to run dist_shape_route_step on the live dispatch path.

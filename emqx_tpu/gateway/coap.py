@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from emqx_tpu.broker import mountpoint as MP
 from emqx_tpu.broker.message import Message
 from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.transport.dtls import DtlsUdpGatewayMixin
 from emqx_tpu.mqtt import packet as pkt
 from emqx_tpu.ops import topics as T
 
@@ -620,7 +621,7 @@ def _parse_qos(s: Optional[str], default: int) -> int:
     return min(max(q, 0), 2)
 
 
-class CoapGateway(Gateway):
+class CoapGateway(DtlsUdpGatewayMixin, Gateway):
     """UDP endpoint + per-peer CoAP channels (emqx_coap_impl.erl)."""
 
     def __init__(self, name: str, config: Dict):
@@ -629,38 +630,29 @@ class CoapGateway(Gateway):
         self.notify_type = config.get("notify_type", "qos")  # qos|con|non
         self.max_block_size = config.get("max_block_size", DEFAULT_BLOCK_SIZE)
         self._transport = None
+        self._dtls = None  # DtlsEndpoint when transport == "dtls"
         self._chans: Dict[Tuple[str, int], CoapChannel] = {}
         self._reaper: Optional[asyncio.Task] = None
 
-    def sendto(self, data: bytes, peer) -> None:
-        if self._transport is not None:
-            self._transport.sendto(data, peer)
-
-    def forget(self, peer) -> None:
-        self._chans.pop(peer, None)
+    def _plain_datagram(self, data: bytes, addr) -> None:
+        m = decode_message(data)
+        if m is None:
+            return
+        chan = self._chans.get(addr)
+        if chan is None:
+            chan = CoapChannel(self, addr)
+            self._chans[addr] = chan
+        chan.handle(m)
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
-        gw = self
-
-        class Proto(asyncio.DatagramProtocol):
-            def connection_made(self, transport):
-                gw._transport = transport
-
-            def datagram_received(self, data, addr):
-                m = decode_message(data)
-                if m is None:
-                    return
-                chan = gw._chans.get(addr)
-                if chan is None:
-                    chan = CoapChannel(gw, addr)
-                    gw._chans[addr] = chan
-                chan.handle(m)
-
+        # transport: udp | dtls (emqx_gateway_schema.erl:361-371 parity;
+        # dtls = PSK-only DTLS 1.2, transport/dtls.py)
+        self._init_dtls()
         host = self.config.get("bind", "127.0.0.1")
         port = self.config.get("port", 5683)
         self._endpoint = await loop.create_datagram_endpoint(
-            Proto, local_addr=(host, port)
+            self._make_proto(), local_addr=(host, port)
         )
         self.port = self._endpoint[0].get_extra_info("sockname")[1]
         self._reaper = loop.create_task(self._reap_loop())

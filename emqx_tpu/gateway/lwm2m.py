@@ -39,6 +39,7 @@ from emqx_tpu.broker.message import Message
 from emqx_tpu.gateway import coap as C
 from emqx_tpu.gateway import lwm2m_codec as LC
 from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.transport.dtls import DtlsUdpGatewayMixin
 from emqx_tpu.mqtt import packet as pkt
 
 log = logging.getLogger("emqx_tpu.gateway.lwm2m")
@@ -473,7 +474,7 @@ class Lwm2mChannel:
         self.gw.forget(self.peer)
 
 
-class Lwm2mGateway(Gateway):
+class Lwm2mGateway(DtlsUdpGatewayMixin, Gateway):
     """UDP endpoint + per-endpoint channels (emqx_lwm2m_impl.erl)."""
 
     def __init__(self, name: str, config: Dict):
@@ -484,6 +485,7 @@ class Lwm2mGateway(Gateway):
         self.lifetime_max = config.get("lifetime_max", 86400 * 7)
         self.mountpoint = config.get("mountpoint", "lwm2m/{ep}/")
         self._transport = None
+        self._dtls = None  # DtlsEndpoint when transport == "dtls"
         self._chans: Dict[Tuple[str, int], Lwm2mChannel] = {}
         self._reaper: Optional[asyncio.Task] = None
 
@@ -492,38 +494,28 @@ class Lwm2mGateway(Gateway):
             "${endpoint_name}", ep
         )
 
-    def sendto(self, data: bytes, peer) -> None:
-        if self._transport is not None:
-            self._transport.sendto(data, peer)
-
-    def forget(self, peer) -> None:
-        self._chans.pop(peer, None)
-
     def find_channel(self, endpoint: str) -> Optional[Lwm2mChannel]:
         return self.cm.get(endpoint)
 
+    def _plain_datagram(self, data: bytes, addr) -> None:
+        m = C.decode_message(data)
+        if m is None:
+            return
+        chan = self._chans.get(addr)
+        if chan is None:
+            chan = Lwm2mChannel(self, addr)
+            self._chans[addr] = chan
+        chan.handle(m)
+
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
-        gw = self
-
-        class Proto(asyncio.DatagramProtocol):
-            def connection_made(self, transport):
-                gw._transport = transport
-
-            def datagram_received(self, data, addr):
-                m = C.decode_message(data)
-                if m is None:
-                    return
-                chan = gw._chans.get(addr)
-                if chan is None:
-                    chan = Lwm2mChannel(gw, addr)
-                    gw._chans[addr] = chan
-                chan.handle(m)
-
+        # transport: udp | dtls — LwM2M in the field is DTLS-first
+        # (emqx_gateway_schema.erl:361-371,399 parity)
+        self._init_dtls()
         host = self.config.get("bind", "127.0.0.1")
         port = self.config.get("port", 5783)
         self._endpoint = await loop.create_datagram_endpoint(
-            Proto, local_addr=(host, port)
+            self._make_proto(), local_addr=(host, port)
         )
         self.port = self._endpoint[0].get_extra_info("sockname")[1]
         self._reaper = loop.create_task(self._reap_loop())

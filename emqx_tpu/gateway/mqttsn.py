@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from emqx_tpu.gateway.base import Gateway, GwClientInfo, GwSession
+from emqx_tpu.transport.dtls import DtlsUdpGatewayMixin
 from emqx_tpu.mqtt import packet as pkt
 from emqx_tpu.ops import topics as T
 
@@ -524,7 +525,7 @@ class SnChannel:
         self._send(PUBLISH, body)
 
 
-class SnGateway(Gateway):
+class SnGateway(DtlsUdpGatewayMixin, Gateway):
     """UDP endpoint + per-peer channels + discovery."""
 
     def __init__(self, name: str, config: Dict):
@@ -534,41 +535,31 @@ class SnGateway(Gateway):
         }
         self.gw_id = config.get("gateway_id", 1)
         self._transport = None
+        self._dtls = None  # DtlsEndpoint when transport == "dtls"
         self._chans: Dict[Tuple[str, int], SnChannel] = {}
         self._reaper: Optional[asyncio.Task] = None
 
-    def sendto(self, data: bytes, peer) -> None:
-        if self._transport is not None:
-            self._transport.sendto(data, peer)
-
-    def forget(self, peer) -> None:
-        self._chans.pop(peer, None)
+    def _plain_datagram(self, data: bytes, addr) -> None:
+        f = decode(data)
+        if f is None:
+            return
+        if f.type == SEARCHGW:
+            self.sendto(encode(GWINFO, bytes([self.gw_id])), addr)
+            return
+        chan = self._chans.get(addr)
+        if chan is None:
+            chan = SnChannel(self, addr)
+            self._chans[addr] = chan
+        chan.enqueue(f)
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
-        gw = self
-
-        class Proto(asyncio.DatagramProtocol):
-            def connection_made(self, transport):
-                gw._transport = transport
-
-            def datagram_received(self, data, addr):
-                f = decode(data)
-                if f is None:
-                    return
-                if f.type == SEARCHGW:
-                    gw.sendto(encode(GWINFO, bytes([gw.gw_id])), addr)
-                    return
-                chan = gw._chans.get(addr)
-                if chan is None:
-                    chan = SnChannel(gw, addr)
-                    gw._chans[addr] = chan
-                chan.enqueue(f)
-
+        # transport: udp | dtls (emqx_gateway_schema.erl:361-371 parity)
+        self._init_dtls()
         host = self.config.get("bind", "127.0.0.1")
         port = self.config.get("port", 1884)
         self._endpoint = await loop.create_datagram_endpoint(
-            Proto, local_addr=(host, port)
+            self._make_proto(), local_addr=(host, port)
         )
         self.port = self._endpoint[0].get_extra_info("sockname")[1]
         self._reaper = loop.create_task(self._reap_loop())

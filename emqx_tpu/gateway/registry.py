@@ -48,10 +48,11 @@ class GatewayRegistry:
     """Registered gateway types + running instances
     (emqx_gateway.erl registry + per-gateway supervision tree)."""
 
-    def __init__(self, broker, hooks, retainer=None):
+    def __init__(self, broker, hooks, retainer=None, psk=None):
         self.broker = broker
         self.hooks = hooks
         self.retainer = retainer
+        self.psk = psk  # broker-wide PSK store (dtls listeners)
         self._types: Dict[str, Callable] = {}  # type name -> Gateway class
         self._running: Dict[str, object] = {}  # instance name -> Gateway
 
@@ -73,6 +74,7 @@ class GatewayRegistry:
         gw.broker = self.broker
         gw.hooks = self.hooks
         gw.retainer = self.retainer
+        gw.psk_store = self.psk
         await gw.start()
         self._running[name] = gw
         log.info("gateway %s (%s) started", name, type_name)

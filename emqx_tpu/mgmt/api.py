@@ -523,9 +523,25 @@ class MgmtApi:
         )
 
     async def retained_list(self, request):
-        topics = self.app.retainer.topics()
+        # cursor-paged (a multi-million-message store must not dump in
+        # one response; emqx_retainer_mnesia paged-read parity): pass
+        # ?limit= and the meta.cursor of the previous page
+        try:
+            limit = min(int(request.query.get("limit", 10000)), 100000)
+        except ValueError:
+            limit = 10000
+        cursor = request.query.get("cursor") or None
+        msgs, nxt = self.app.retainer.messages_page(cursor, limit)
         return web.json_response(
-            {"data": topics, "meta": {"count": len(topics)}}
+            {
+                "data": [m.topic for m in msgs],
+                "meta": {
+                    "count": len(self.app.retainer),
+                    "limit": limit,
+                    "cursor": nxt,
+                    "hasnext": nxt is not None,
+                },
+            }
         )
 
     async def retained_del(self, request):

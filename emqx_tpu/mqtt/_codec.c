@@ -254,6 +254,444 @@ serialize_publish(PyObject *self, PyObject *args)
     return out;
 }
 
+/* -- worker-fabric record codec ---------------------------------------
+ *
+ * The router<->worker fabric (transport/fabric.py) moves every message
+ * of the multi-process host data plane; packing its records in Python
+ * was the single largest router-process cost in the serving profile.
+ * Wire format mirrors fabric.py exactly (it differentially tests this):
+ *
+ *   pub_record: u16 tlen, topic, u32 plen, payload,
+ *               u8 flags (qos | retain<<2 | dup<<3), u16 clen, client
+ *   dlv_record: pub_record head (flags bit3 = retained)
+ *               + u16 ntargets + ntargets * u32 handle
+ *   frame:      u32 len (excl. 5-byte header), u8 type, body
+ */
+
+#define FAB_T_PUBB 3
+#define FAB_T_DLV 4
+
+typedef struct {
+    const char *topic; Py_ssize_t tlen;
+    const char *payload; Py_ssize_t plen;
+    const char *client; Py_ssize_t clen;
+    unsigned char flags;
+    PyObject *handles; /* borrowed; NULL for pub records */
+    Py_ssize_t nh;     /* len(handles) */
+} fab_rec;
+
+/* Read one message's wire fields.  Returns 0 ok, -1 error.
+ * `retained_hdr`: for DLV records bit3 comes from headers["retained"];
+ * for PUBB records it is the dup flag. */
+static int
+fab_read_msg(PyObject *msg, fab_rec *r, int is_dlv)
+{
+    PyObject *topic = PyObject_GetAttrString(msg, "topic");
+    if (!topic) return -1;
+    r->topic = PyUnicode_AsUTF8AndSize(topic, &r->tlen);
+    Py_DECREF(topic); /* interned in the Message; borrow survives */
+    if (!r->topic) return -1;
+    if (r->tlen > 0xFFFF) {
+        PyErr_SetString(PyExc_ValueError, "fabric topic too long");
+        return -1;
+    }
+    PyObject *payload = PyObject_GetAttrString(msg, "payload");
+    if (!payload) return -1;
+    if (payload == Py_None) { r->payload = ""; r->plen = 0; }
+    else if (PyBytes_Check(payload)) {
+        r->payload = PyBytes_AS_STRING(payload);
+        r->plen = PyBytes_GET_SIZE(payload);
+    } else {
+        Py_DECREF(payload);
+        PyErr_SetString(PyExc_TypeError, "payload must be bytes");
+        return -1;
+    }
+    Py_DECREF(payload); /* Message holds a ref; borrow survives */
+    PyObject *client = PyObject_GetAttrString(msg, "from_client");
+    if (!client) return -1;
+    if (client == Py_None) { r->client = ""; r->clen = 0; }
+    else {
+        r->client = PyUnicode_AsUTF8AndSize(client, &r->clen);
+        if (!r->client) { Py_DECREF(client); return -1; }
+    }
+    Py_DECREF(client);
+    if (r->clen > 0xFFFF) {
+        PyErr_SetString(PyExc_ValueError, "fabric client id too long");
+        return -1;
+    }
+    PyObject *qos = PyObject_GetAttrString(msg, "qos");
+    if (!qos) return -1;
+    long q = PyLong_AsLong(qos);
+    Py_DECREF(qos);
+    if (q == -1 && PyErr_Occurred()) return -1;
+    PyObject *retain = PyObject_GetAttrString(msg, "retain");
+    if (!retain) return -1;
+    int ret = PyObject_IsTrue(retain);
+    Py_DECREF(retain);
+    if (ret < 0) return -1;
+    int bit3 = 0;
+    if (is_dlv) {
+        PyObject *headers = PyObject_GetAttrString(msg, "headers");
+        if (!headers) return -1;
+        if (PyDict_Check(headers)) {
+            PyObject *rv = PyDict_GetItemString(headers, "retained");
+            bit3 = rv ? PyObject_IsTrue(rv) : 0;
+        }
+        Py_DECREF(headers);
+        if (bit3 < 0) return -1;
+    } else {
+        PyObject *dup = PyObject_GetAttrString(msg, "dup");
+        if (dup) { bit3 = PyObject_IsTrue(dup); Py_DECREF(dup); }
+        else { PyErr_Clear(); bit3 = 0; }
+        if (bit3 < 0) return -1;
+    }
+    r->flags = (unsigned char)((q & 3) | (ret ? 4 : 0) | (bit3 ? 8 : 0));
+    return 0;
+}
+
+static void
+fab_write_head(unsigned char **wp, const fab_rec *r)
+{
+    unsigned char *w = *wp;
+    *w++ = (unsigned char)(r->tlen & 0xFF);
+    *w++ = (unsigned char)(r->tlen >> 8);
+    memcpy(w, r->topic, r->tlen); w += r->tlen;
+    *w++ = (unsigned char)(r->plen & 0xFF);
+    *w++ = (unsigned char)((r->plen >> 8) & 0xFF);
+    *w++ = (unsigned char)((r->plen >> 16) & 0xFF);
+    *w++ = (unsigned char)((r->plen >> 24) & 0xFF);
+    memcpy(w, r->payload, r->plen); w += r->plen;
+    *w++ = r->flags;
+    *w++ = (unsigned char)(r->clen & 0xFF);
+    *w++ = (unsigned char)(r->clen >> 8);
+    memcpy(w, r->client, r->clen); w += r->clen;
+    *wp = w;
+}
+
+/* pack_dlv_frames(records, max_body) -> [frame_bytes, ...]
+ * records: [(msg, [handle, ...])]; splits >0xFFFF handle fan-outs and
+ * bounds each frame body by ~max_body (always >= 1 record per frame). */
+static PyObject *
+pack_dlv_frames(PyObject *self, PyObject *args)
+{
+    PyObject *records;
+    Py_ssize_t max_body;
+    if (!PyArg_ParseTuple(args, "On", &records, &max_body))
+        return NULL;
+    PyObject *seq = PySequence_Fast(records, "records must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n_in = PySequence_Fast_GET_SIZE(seq);
+    fab_rec *recs = PyMem_Malloc(
+        (n_in ? n_in : 1) * sizeof(fab_rec));
+    if (!recs) { Py_DECREF(seq); return PyErr_NoMemory(); }
+    PyObject *frames = PyList_New(0);
+    if (!frames) { PyMem_Free(recs); Py_DECREF(seq); return NULL; }
+
+    Py_ssize_t n_recs = 0;
+    for (Py_ssize_t i = 0; i < n_in; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject *msg, *handles;
+        if (!PyArg_ParseTuple(item, "OO", &msg, &handles))
+            goto fail;
+        Py_ssize_t nh = PyList_Check(handles)
+            ? PyList_GET_SIZE(handles) : PySequence_Size(handles);
+        if (nh < 0) goto fail;
+        if (nh == 0)
+            continue; /* no targets: the Python codec emits nothing */
+        if (fab_read_msg(msg, &recs[n_recs], 1) < 0)
+            goto fail;
+        recs[n_recs].handles = handles;
+        recs[n_recs].nh = nh;
+        n_recs++;
+    }
+    n_in = n_recs;
+
+    /* emit frames: walk records, splitting handle lists at 0xFFFF and
+     * frames at max_body */
+    Py_ssize_t i = 0, hoff = 0;
+    while (i < n_in) {
+        /* measure this frame */
+        Py_ssize_t body = 4, n_rec = 0;
+        Py_ssize_t j = i, jh = hoff;
+        while (j < n_in) {
+            Py_ssize_t total_h = recs[j].nh;
+            Py_ssize_t chunk = total_h - jh;
+            if (chunk > 0xFFFF) chunk = 0xFFFF;
+            Py_ssize_t rec_len = 9 + recs[j].tlen + recs[j].plen
+                                 + recs[j].clen + 2 + 4 * chunk;
+            /* boundary matches fabric.pack_dlv_batches exactly (it
+             * counts the 5-byte frame header too) so the two codecs
+             * produce byte-identical frame splits */
+            if (n_rec && 5 + body + rec_len > max_body)
+                break;
+            body += rec_len;
+            n_rec++;
+            jh += chunk;
+            if (jh >= total_h) { j++; jh = 0; }
+        }
+        PyObject *frame = PyBytes_FromStringAndSize(NULL, 5 + body);
+        if (!frame) goto fail;
+        unsigned char *w = (unsigned char *)PyBytes_AS_STRING(frame);
+        *w++ = (unsigned char)(body & 0xFF);
+        *w++ = (unsigned char)((body >> 8) & 0xFF);
+        *w++ = (unsigned char)((body >> 16) & 0xFF);
+        *w++ = (unsigned char)((body >> 24) & 0xFF);
+        *w++ = FAB_T_DLV;
+        *w++ = (unsigned char)(n_rec & 0xFF);
+        *w++ = (unsigned char)((n_rec >> 8) & 0xFF);
+        *w++ = (unsigned char)((n_rec >> 16) & 0xFF);
+        *w++ = (unsigned char)((n_rec >> 24) & 0xFF);
+        /* fill */
+        Py_ssize_t emitted = 0;
+        while (emitted < n_rec) {
+            PyObject *hl = recs[i].handles;
+            Py_ssize_t total_h = recs[i].nh;
+            Py_ssize_t chunk = total_h - hoff;
+            if (chunk > 0xFFFF) chunk = 0xFFFF;
+            fab_write_head(&w, &recs[i]);
+            *w++ = (unsigned char)(chunk & 0xFF);
+            *w++ = (unsigned char)(chunk >> 8);
+            for (Py_ssize_t k = 0; k < chunk; k++) {
+                PyObject *h = PyList_Check(hl)
+                    ? PyList_GET_ITEM(hl, hoff + k)
+                    : NULL;
+                unsigned long hv;
+                if (h) hv = PyLong_AsUnsignedLong(h);
+                else {
+                    PyObject *hi = PySequence_GetItem(hl, hoff + k);
+                    if (!hi) { Py_DECREF(frame); goto fail; }
+                    hv = PyLong_AsUnsignedLong(hi);
+                    Py_DECREF(hi);
+                }
+                if (hv == (unsigned long)-1 && PyErr_Occurred()) {
+                    Py_DECREF(frame); goto fail;
+                }
+                *w++ = (unsigned char)(hv & 0xFF);
+                *w++ = (unsigned char)((hv >> 8) & 0xFF);
+                *w++ = (unsigned char)((hv >> 16) & 0xFF);
+                *w++ = (unsigned char)((hv >> 24) & 0xFF);
+            }
+            emitted++;
+            hoff += chunk;
+            if (hoff >= total_h) { i++; hoff = 0; }
+        }
+        if (PyList_Append(frames, frame) < 0) {
+            Py_DECREF(frame); goto fail;
+        }
+        Py_DECREF(frame);
+    }
+    PyMem_Free(recs);
+    Py_DECREF(seq);
+    return frames;
+fail:
+    PyMem_Free(recs);
+    Py_DECREF(seq);
+    Py_DECREF(frames);
+    return NULL;
+}
+
+/* unpack_dlv_batch(body) ->
+ *   [(topic, payload, qos, retain, retained, client, [handles])] */
+static PyObject *
+unpack_dlv_batch(PyObject *self, PyObject *args)
+{
+    Py_buffer view;
+    if (!PyArg_ParseTuple(args, "y*", &view))
+        return NULL;
+    const unsigned char *p = (const unsigned char *)view.buf;
+    Py_ssize_t len = view.len, off = 4;
+    if (len < 4) goto truncated;
+    unsigned long n = (unsigned long)p[0] | ((unsigned long)p[1] << 8)
+        | ((unsigned long)p[2] << 16) | ((unsigned long)p[3] << 24);
+    PyObject *out = PyList_New(0);
+    if (!out) { PyBuffer_Release(&view); return NULL; }
+    for (unsigned long i = 0; i < n; i++) {
+        if (off + 2 > len) goto trunc_out;
+        Py_ssize_t tlen = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8);
+        off += 2;
+        if (off + tlen + 4 > len) goto trunc_out;
+        PyObject *topic = PyUnicode_DecodeUTF8(
+            (const char *)p + off, tlen, "strict");
+        if (!topic) goto err_out;
+        off += tlen;
+        Py_ssize_t plen = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8)
+            | ((Py_ssize_t)p[off+2] << 16) | ((Py_ssize_t)p[off+3] << 24);
+        off += 4;
+        if (off + plen + 3 > len) { Py_DECREF(topic); goto trunc_out; }
+        PyObject *payload = PyBytes_FromStringAndSize(
+            (const char *)p + off, plen);
+        if (!payload) { Py_DECREF(topic); goto err_out; }
+        off += plen;
+        unsigned char flags = p[off++];
+        Py_ssize_t clen = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8);
+        off += 2;
+        if (off + clen + 2 > len) {
+            Py_DECREF(topic); Py_DECREF(payload); goto trunc_out;
+        }
+        PyObject *client = PyUnicode_DecodeUTF8(
+            (const char *)p + off, clen, "strict");
+        if (!client) { Py_DECREF(topic); Py_DECREF(payload); goto err_out; }
+        off += clen;
+        Py_ssize_t nh = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8);
+        off += 2;
+        if (off + 4 * nh > len) {
+            Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+            goto trunc_out;
+        }
+        PyObject *handles = PyList_New(nh);
+        if (!handles) {
+            Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+            goto err_out;
+        }
+        for (Py_ssize_t k = 0; k < nh; k++) {
+            unsigned long hv = (unsigned long)p[off]
+                | ((unsigned long)p[off+1] << 8)
+                | ((unsigned long)p[off+2] << 16)
+                | ((unsigned long)p[off+3] << 24);
+            off += 4;
+            PyObject *h = PyLong_FromUnsignedLong(hv);
+            if (!h) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                Py_DECREF(handles); goto err_out;
+            }
+            PyList_SET_ITEM(handles, k, h);
+        }
+        PyObject *tup = Py_BuildValue(
+            "(NNiOON N)", topic, payload, (int)(flags & 3),
+            (flags & 4) ? Py_True : Py_False,
+            (flags & 8) ? Py_True : Py_False,
+            client, handles);
+        if (!tup) goto err_out;
+        if (PyList_Append(out, tup) < 0) { Py_DECREF(tup); goto err_out; }
+        Py_DECREF(tup);
+    }
+    PyBuffer_Release(&view);
+    return out;
+trunc_out:
+    Py_DECREF(out);
+    goto truncated;
+err_out:
+    Py_DECREF(out);
+    PyBuffer_Release(&view);
+    return NULL;
+truncated:
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "dlv_batch_truncated");
+    return NULL;
+}
+
+/* pack_pub_batch(msgs, seq) -> one PUBB frame (worker -> router) */
+static PyObject *
+pack_pub_batch_c(PyObject *self, PyObject *args)
+{
+    PyObject *msgs;
+    unsigned long seqno;
+    if (!PyArg_ParseTuple(args, "Ok", &msgs, &seqno))
+        return NULL;
+    PyObject *seq = PySequence_Fast(msgs, "msgs must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    fab_rec *recs = PyMem_Malloc((n ? n : 1) * sizeof(fab_rec));
+    if (!recs) { Py_DECREF(seq); return PyErr_NoMemory(); }
+    Py_ssize_t body = 8;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (fab_read_msg(PySequence_Fast_GET_ITEM(seq, i),
+                         &recs[i], 0) < 0) {
+            PyMem_Free(recs); Py_DECREF(seq); return NULL;
+        }
+        body += 9 + recs[i].tlen + recs[i].plen + recs[i].clen;
+    }
+    PyObject *frame = PyBytes_FromStringAndSize(NULL, 5 + body);
+    if (!frame) { PyMem_Free(recs); Py_DECREF(seq); return NULL; }
+    unsigned char *w = (unsigned char *)PyBytes_AS_STRING(frame);
+    *w++ = (unsigned char)(body & 0xFF);
+    *w++ = (unsigned char)((body >> 8) & 0xFF);
+    *w++ = (unsigned char)((body >> 16) & 0xFF);
+    *w++ = (unsigned char)((body >> 24) & 0xFF);
+    *w++ = FAB_T_PUBB;
+    *w++ = (unsigned char)(seqno & 0xFF);
+    *w++ = (unsigned char)((seqno >> 8) & 0xFF);
+    *w++ = (unsigned char)((seqno >> 16) & 0xFF);
+    *w++ = (unsigned char)((seqno >> 24) & 0xFF);
+    *w++ = (unsigned char)(n & 0xFF);
+    *w++ = (unsigned char)((n >> 8) & 0xFF);
+    *w++ = (unsigned char)((n >> 16) & 0xFF);
+    *w++ = (unsigned char)((n >> 24) & 0xFF);
+    for (Py_ssize_t i = 0; i < n; i++)
+        fab_write_head(&w, &recs[i]);
+    PyMem_Free(recs);
+    Py_DECREF(seq);
+    return frame;
+}
+
+/* unpack_pub_batch(body) ->
+ *   (seq, [(topic, payload, qos, retain, dup, client)]) */
+static PyObject *
+unpack_pub_batch_c(PyObject *self, PyObject *args)
+{
+    Py_buffer view;
+    if (!PyArg_ParseTuple(args, "y*", &view))
+        return NULL;
+    const unsigned char *p = (const unsigned char *)view.buf;
+    Py_ssize_t len = view.len, off = 8;
+    if (len < 8) goto truncated;
+    unsigned long seqno = (unsigned long)p[0] | ((unsigned long)p[1] << 8)
+        | ((unsigned long)p[2] << 16) | ((unsigned long)p[3] << 24);
+    unsigned long n = (unsigned long)p[4] | ((unsigned long)p[5] << 8)
+        | ((unsigned long)p[6] << 16) | ((unsigned long)p[7] << 24);
+    PyObject *out = PyList_New(0);
+    if (!out) { PyBuffer_Release(&view); return NULL; }
+    for (unsigned long i = 0; i < n; i++) {
+        if (off + 2 > len) goto trunc_out;
+        Py_ssize_t tlen = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8);
+        off += 2;
+        if (off + tlen + 4 > len) goto trunc_out;
+        PyObject *topic = PyUnicode_DecodeUTF8(
+            (const char *)p + off, tlen, "strict");
+        if (!topic) goto err_out;
+        off += tlen;
+        Py_ssize_t plen = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8)
+            | ((Py_ssize_t)p[off+2] << 16) | ((Py_ssize_t)p[off+3] << 24);
+        off += 4;
+        if (off + plen + 3 > len) { Py_DECREF(topic); goto trunc_out; }
+        PyObject *payload = PyBytes_FromStringAndSize(
+            (const char *)p + off, plen);
+        if (!payload) { Py_DECREF(topic); goto err_out; }
+        off += plen;
+        unsigned char flags = p[off++];
+        Py_ssize_t clen = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8);
+        off += 2;
+        if (off + clen > len) {
+            Py_DECREF(topic); Py_DECREF(payload); goto trunc_out;
+        }
+        PyObject *client = PyUnicode_DecodeUTF8(
+            (const char *)p + off, clen, "strict");
+        if (!client) { Py_DECREF(topic); Py_DECREF(payload); goto err_out; }
+        off += clen;
+        PyObject *tup = Py_BuildValue(
+            "(NNiOON)", topic, payload, (int)(flags & 3),
+            (flags & 4) ? Py_True : Py_False,
+            (flags & 8) ? Py_True : Py_False,
+            client);
+        if (!tup) goto err_out;
+        if (PyList_Append(out, tup) < 0) { Py_DECREF(tup); goto err_out; }
+        Py_DECREF(tup);
+    }
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(kN)", seqno, out);
+trunc_out:
+    Py_DECREF(out);
+    goto truncated;
+err_out:
+    Py_DECREF(out);
+    PyBuffer_Release(&view);
+    return NULL;
+truncated:
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "pub_batch_truncated");
+    return NULL;
+}
+
 /* -- module ----------------------------------------------------------- */
 
 static PyMethodDef methods[] = {
@@ -263,6 +701,16 @@ static PyMethodDef methods[] = {
      "parse_publish(flags, body, v5) -> (topic, pid, props_raw, payload)"},
     {"serialize_publish", serialize_publish, METH_VARARGS,
      "serialize_publish(topic, payload, qos, retain, dup, pid, props, v5)"},
+    {"pack_dlv_frames", pack_dlv_frames, METH_VARARGS,
+     "pack_dlv_frames(records, max_body) -> [frame, ...]"},
+    {"unpack_dlv_batch", unpack_dlv_batch, METH_VARARGS,
+     "unpack_dlv_batch(body) -> [(topic, payload, qos, retain, retained,"
+     " client, [handles])]"},
+    {"pack_pub_batch", pack_pub_batch_c, METH_VARARGS,
+     "pack_pub_batch(msgs, seq) -> PUBB frame"},
+    {"unpack_pub_batch", unpack_pub_batch_c, METH_VARARGS,
+     "unpack_pub_batch(body) -> (seq, [(topic, payload, qos, retain, dup,"
+     " client)])"},
     {NULL, NULL, 0, NULL},
 };
 

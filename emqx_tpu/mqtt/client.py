@@ -134,7 +134,7 @@ class Client:
                 if not data:
                     break
                 for p in self._parser.feed(data):
-                    await self._handle(p)
+                    self._handle(p)
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
@@ -144,20 +144,23 @@ class Client:
                     fut.set_exception(MqttError("connection closed"))
             self._pending.clear()
 
-    async def _handle(self, p) -> None:
+    def _handle(self, p) -> None:
+        # sync on purpose: the inbox queue is unbounded (put never
+        # blocks), and an await per inbound packet dominated receiver
+        # CPU under delivery floods
         t = p.type
         if t == pkt.CONNACK:
             self._resolve((pkt.CONNACK, 0), p)
         elif t == pkt.PUBLISH:
             if p.qos == 0:
-                await self.messages.put(p)
+                self.messages.put_nowait(p)
             elif p.qos == 1:
-                await self.messages.put(p)
+                self.messages.put_nowait(p)
                 self._send(pkt.PubAck(packet_id=p.packet_id))
             else:
                 if p.packet_id not in self._await_rel:
                     self._await_rel.add(p.packet_id)
-                    await self.messages.put(p)
+                    self.messages.put_nowait(p)
                 rec = pkt.PubAck(packet_id=p.packet_id)
                 rec.type = pkt.PUBREC
                 self._send(rec)
@@ -232,7 +235,12 @@ class Client:
         )
         if qos == 0:
             self._send(p)
-            await self._writer.drain()
+            # drain only past a buffer high-water mark: an await
+            # round-trip per QoS0 publish dominated flood-side CPU
+            # (the WS stream adapter has no transport: always drain)
+            tr = getattr(self._writer, "transport", None)
+            if tr is None or tr.get_write_buffer_size() > 65536:
+                await self._writer.drain()
             return None
         p.packet_id = self._next_pid()
         ack_t = pkt.PUBACK if qos == 1 else pkt.PUBCOMP
@@ -242,7 +250,12 @@ class Client:
         return await self._request((pkt.PINGRESP, 0), pkt.PingReq(), timeout)
 
     async def recv(self, timeout: float = 5.0) -> pkt.Publish:
-        return await asyncio.wait_for(self.messages.get(), timeout)
+        # fast path: a queued message skips the wait_for timeout
+        # machinery entirely (it dominated receiver-side CPU in floods)
+        try:
+            return self.messages.get_nowait()
+        except asyncio.QueueEmpty:
+            return await asyncio.wait_for(self.messages.get(), timeout)
 
     async def disconnect(self, reason_code: int = 0) -> None:
         try:

@@ -29,6 +29,11 @@ available = False
 split_frames = None
 parse_publish = None
 serialize_publish = None
+# worker-fabric record codec (transport/fabric.py hot path)
+pack_dlv_frames = None
+unpack_dlv_batch = None
+pack_pub_batch = None
+unpack_pub_batch = None
 
 
 def _build() -> bool:
@@ -88,9 +93,15 @@ def _load() -> None:
             spec.loader.exec_module(mod)
         except Exception:
             return
+    global pack_dlv_frames, unpack_dlv_batch, pack_pub_batch
+    global unpack_pub_batch
     split_frames = mod.split_frames
     parse_publish = mod.parse_publish
     serialize_publish = mod.serialize_publish
+    pack_dlv_frames = getattr(mod, "pack_dlv_frames", None)
+    unpack_dlv_batch = getattr(mod, "unpack_dlv_batch", None)
+    pack_pub_batch = getattr(mod, "pack_pub_batch", None)
+    unpack_pub_batch = getattr(mod, "unpack_pub_batch", None)
     available = True
 
 

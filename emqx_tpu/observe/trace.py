@@ -180,16 +180,19 @@ class TraceManager:
                 return p.hex()
 
         def on_publish(msg, acc=None):
-            self.log(
-                "PUBLISH",
-                {
-                    "clientid": msg.from_client or None,
-                    "topic": msg.topic,
-                    "qos": msg.qos,
-                    "retain": msg.retain,
-                    "payload": payload_preview(msg),
-                },
-            )
+            # no active traces: skip the meta-dict build — this and
+            # on_delivered run per message/delivery
+            if self._specs:
+                self.log(
+                    "PUBLISH",
+                    {
+                        "clientid": msg.from_client or None,
+                        "topic": msg.topic,
+                        "qos": msg.qos,
+                        "retain": msg.retain,
+                        "payload": payload_preview(msg),
+                    },
+                )
             return acc if acc is not None else msg
 
         def on_subscribed(ci, topic, opts, _ch=None):
@@ -234,6 +237,8 @@ class TraceManager:
             )
 
         def on_delivered(ci, msg):
+            if not self._specs:
+                return
             self.log(
                 "DELIVER",
                 {

@@ -164,37 +164,52 @@ class RuleEngine:
         return list(self._rules.values())
 
     # -- hook wiring (emqx_rule_events parity) ----------------------------
+    def _any_enabled(self) -> bool:
+        """Fast gate for the per-message event hooks: building an event
+        context (dict of ~10 fields) on every delivery/ack is pure
+        overhead on a rule-less broker — the dominant per-delivery cost
+        in the r4 serving profile. Same live-check semantics as
+        _on_publish: no cached flag, so an externally toggled
+        `rule.enabled = True` is honored immediately."""
+        rules = self._rules
+        return bool(rules) and any(r.enabled for r in rules.values())
+
     def attach(self, hooks: Hooks) -> None:
         hooks.add("message.publish", self._on_publish, priority=120)
         hooks.add(
             "message.delivered",
-            lambda ci, msg: self._fire(EV.message_delivered(ci, msg)),
+            lambda ci, msg: self._any_enabled()
+            and self._fire(EV.message_delivered(ci, msg)),
         )
         hooks.add(
             "message.acked",
-            lambda ci, m: self._fire(EV.message_acked(ci, m)),
+            lambda ci, m: self._any_enabled()
+            and self._fire(EV.message_acked(ci, m)),
         )
         hooks.add(
             "message.dropped",
-            lambda msg, reason: self._fire(EV.message_dropped(msg, reason)),
+            lambda msg, reason: self._any_enabled()
+            and self._fire(EV.message_dropped(msg, reason)),
         )
         hooks.add(
             "client.connected",
-            lambda ci, _ch: self._fire(EV.client_connected(ci)),
+            lambda ci, _ch: self._any_enabled()
+            and self._fire(EV.client_connected(ci)),
         )
         hooks.add(
             "client.disconnected",
-            lambda ci, reason: self._fire(EV.client_disconnected(ci, reason)),
+            lambda ci, reason: self._any_enabled()
+            and self._fire(EV.client_disconnected(ci, reason)),
         )
         hooks.add(
             "session.subscribed",
-            lambda ci, f, opts, _ch=None: self._fire(
-                EV.session_subscribed(ci, f, opts)
-            ),
+            lambda ci, f, opts, _ch=None: self._any_enabled()
+            and self._fire(EV.session_subscribed(ci, f, opts)),
         )
         hooks.add(
             "session.unsubscribed",
-            lambda ci, f: self._fire(EV.session_unsubscribed(ci, f)),
+            lambda ci, f: self._any_enabled()
+            and self._fire(EV.session_unsubscribed(ci, f)),
         )
 
     def _on_publish(self, msg: Optional[Message]):

@@ -62,6 +62,16 @@ class Connection:
         except Exception:
             self.close("send_error")
 
+    def send_bytes(self, b: bytes) -> None:
+        """Pre-serialized frame (the channel's QoS0 fan-out cache:
+        serialize once per message, write to every subscriber socket)."""
+        if self._closing:
+            return
+        try:
+            self.writer.write(b)
+        except Exception:
+            self.close("send_error")
+
     def close(self, reason: str) -> None:
         if self._closing:
             return

@@ -56,6 +56,14 @@ T_PUBB_ACK = 5
 # reference's meaning — the subscription is ROUTABLE, broker-wide
 # (emqx_broker.erl:127-160 is synchronous for the same reason).
 T_SUB_ACK = 6
+# Session ops (json, both directions): the router brokers emqx_cm
+# semantics ACROSS workers — open (w->r: resolve takeover/resume at
+# CONNECT), take/discard (r->w: hand over / kill a live channel),
+# state (w->r: serialized session after take), open_ack (r->w),
+# park (w->r: disconnect with expiry>0 -> router-side detached store,
+# WAL-backed when persistence is on), resume_done (w->r: new channel
+# installed; router flushes handoff-banked messages), closed (w->r).
+T_SESS = 7
 
 _HDR = struct.Struct("<IB")
 _U16 = struct.Struct("<H")
@@ -224,6 +232,36 @@ def unpack_dlv_batch(body: bytes):
              client, handles)
         )
     return out
+
+
+# -- native acceleration ------------------------------------------------
+# The C codec (mqtt/_codec.c) implements the same wire format; the pure-
+# Python functions above stay the semantic reference and differentially
+# test it (tests/test_codec_native.py). Packing DLV batches in Python
+# was the largest router-process cost in the serving profile.
+from emqx_tpu.mqtt import codec_native as _nc  # noqa: E402
+
+_py_pack_dlv_batches = pack_dlv_batches
+_py_pack_pub_batch = pack_pub_batch
+_py_unpack_pub_batch = unpack_pub_batch
+_py_unpack_dlv_batch = unpack_dlv_batch
+
+if _nc.pack_dlv_frames is not None:
+
+    def pack_dlv_batches(records, max_body: float = MAX_BODY):  # noqa: F811
+        if max_body == float("inf"):
+            max_body = 1 << 62
+        if not isinstance(records, list):
+            records = list(records)
+        return _nc.pack_dlv_frames(records, int(max_body))
+
+    def pack_pub_batch(msgs, seq: int = 0) -> bytes:  # noqa: F811
+        if not isinstance(msgs, list):
+            msgs = list(msgs)
+        return _nc.pack_pub_batch(msgs, seq)
+
+    unpack_pub_batch = _nc.unpack_pub_batch  # noqa: F811
+    unpack_dlv_batch = _nc.unpack_dlv_batch  # noqa: F811
 
 
 async def read_frame(reader) -> Tuple[int, bytes]:

@@ -16,10 +16,13 @@ this wall — every connection is a BEAM process spread over cores
   ingest window onto the TPU kernel; deliveries return batched, one
   record per (message, worker), fanned to sockets worker-side.
 
-Scope: worker listeners are the high-throughput serving path. Sessions
-live in their worker (no cross-worker takeover; persistent-session WAL
-stays with in-process listeners). Authn/authz/banned guards are rebuilt
-per worker from the same config, so admission semantics match.
+Scope: worker listeners are the high-throughput serving path. Authn/
+authz/banned guards are rebuilt per worker from the same config, so
+admission semantics match. Workers survive a router-process restart:
+connections hold, the fabric link re-dials, subscriptions and unacked
+publish batches replay (emqx_machine_boot's restart-without-dropping-
+esockd layering). Delivery overflow parks per subscriber with a bounded
+drop-oldest queue (emqx_mqueue parity at the seam).
 """
 
 from __future__ import annotations
@@ -62,6 +65,18 @@ class WorkerFabric:
         self._outbox: Dict[int, List] = {}
         self._outbox_last: Dict[int, Tuple[int, List[int]]] = {}
         self._flush_scheduled = False
+        # congestion parking: wid -> {handle -> deque[msg]} + drain tasks
+        self._parked: Dict[int, Dict[int, object]] = {}
+        self._drainers: Dict[int, asyncio.Task] = {}
+        # emqx_cm across workers: cid -> owning wid (live channels);
+        # takes pending the owner's state reply, keyed by a ROUTER-
+        # generated token (worker request ids are only unique per
+        # worker): token -> (owner_wid, cid, reply_fn); sessions
+        # mid-resume (snapshot shipped, handoff bankers still live)
+        self._owner: Dict[str, int] = {}
+        self._take_pending: Dict[int, Tuple[int, str, object]] = {}
+        self._next_take = 1
+        self._resuming: Dict[str, dict] = {}
         self._tasks: set = set()
 
     async def start(self) -> None:
@@ -72,12 +87,27 @@ class WorkerFabric:
         self._server = await asyncio.start_unix_server(
             self._on_worker, path=self.uds_path
         )
+        # the router's own CM consults us at open_session so a client
+        # live on a WORKER reconnecting via an in-process listener
+        # (ws/ssl) still takes its session over (node-wide emqx_cm)
+        cm = getattr(self.app, "cm", None)
+        if cm is not None and hasattr(cm, "fabrics") and \
+                self not in cm.fabrics:
+            cm.fabrics.append(self)
 
     async def stop(self) -> None:
+        cm = getattr(self.app, "cm", None)
+        if cm is not None and hasattr(cm, "fabrics") and \
+                self in cm.fabrics:
+            cm.fabrics.remove(self)
         if self._server is not None:
             self._server.close()
         for t in list(self._tasks):
             t.cancel()
+        for d in list(self._drainers.values()):
+            d.cancel()
+        self._drainers.clear()
+        self._parked.clear()
         if self._server is not None:
             await self._server.wait_closed()
             self._server = None
@@ -108,6 +138,10 @@ class WorkerFabric:
                     self._on_unsub(wid, body)
                 elif ftype == F.T_PUBB:
                     await self._on_pub_batch(writer, body)
+                elif ftype == F.T_SESS:
+                    import json
+
+                    self._on_sess(wid, writer, json.loads(body))
         except (
             asyncio.IncompleteReadError,
             ConnectionResetError,
@@ -119,7 +153,23 @@ class WorkerFabric:
             if wid >= 0:
                 self._writers.pop(wid, None)
                 self._outbox.pop(wid, None)
+                self._parked.pop(wid, None)
+                d = self._drainers.pop(wid, None)
+                if d is not None:
+                    d.cancel()
                 self._drop_worker_subs(wid)
+                for cid in [
+                    c for c, w in self._owner.items() if w == wid
+                ]:
+                    self._owner.pop(cid, None)
+                # takes waiting on this (now dead) owner fail fast
+                # instead of leaking / stalling requesters 30s
+                for tk in [
+                    t for t, (ow, _c, _r) in self._take_pending.items()
+                    if ow == wid
+                ]:
+                    _ow, _cid, reply = self._take_pending.pop(tk)
+                    reply(None, False)
             writer.close()
 
     # -- subscribe side ---------------------------------------------------
@@ -161,6 +211,7 @@ class WorkerFabric:
         if (
             ret is not None
             and ret.enabled
+            and not d.get("nr")  # link-reconnect replay: never retained
             and _group is None
             and opts.retain_handling != 2
             and not (opts.retain_handling == 1 and existing)
@@ -187,6 +238,250 @@ class WorkerFabric:
         """Worker died: every subscription it proxied is gone."""
         for sid, f in self._fabric_subs.pop(wid, set()):
             self.broker.unsubscribe(sid, f)
+
+    # -- session ops (emqx_cm parity across workers) ----------------------
+    # The router process is the node-level session registry: a client
+    # reconnecting onto ANY worker (or an in-process listener) finds its
+    # session — takeover of live channels, resume of parked ones, and
+    # persistent parking into the app CM's detached store (WAL-backed
+    # when session persistence is enabled). Reference:
+    # emqx_cm.erl:245-273 open_session, :346-366 takeover_session.
+
+    def _sess_reply(self, writer, r: int, sess_json, present: bool) -> None:
+        if writer is not None and not writer.is_closing():
+            writer.write(F.pack_json(F.T_SESS, {
+                "op": "open_ack", "r": r, "sess": sess_json,
+                "present": bool(present),
+            }))
+
+    def _on_sess(self, wid: int, writer, d: dict) -> None:
+        op = d.get("op")
+        if op == "open":
+            self._sess_open(wid, writer, d)
+        elif op == "state":
+            self._sess_state(d)
+        elif op == "park":
+            self._sess_park(wid, d)
+        elif op == "resume_done":
+            self._sess_resume_done(wid, d["cid"])
+        elif op == "claim":
+            # link-reconnect replay: the worker re-announces its live
+            # channels (the drop-path cleared their owner entries)
+            self._owner[d["cid"]] = wid
+        elif op == "closed":
+            if self._owner.get(d["cid"]) == wid:
+                self._owner.pop(d["cid"], None)
+
+    def _sess_open(self, wid: int, writer, d: dict) -> None:
+        from emqx_tpu.storage.codec import session_to_json
+
+        cid, clean, r = d["cid"], bool(d.get("clean")), int(d["r"])
+        self._gc_resuming()
+        cm = getattr(self.app, "cm", None)
+        # live on a worker (possibly this one — the take round trip is
+        # uniform): hand over or kill the old channel there
+        own = self._owner.get(cid)
+        if own is not None and own in self._writers:
+            ow = self._writers[own]
+            if clean:
+                ow.write(F.pack_json(F.T_SESS, {"op": "discard",
+                                                "cid": cid}))
+                self._drop_parked(cid)
+                self._owner[cid] = wid
+                self._sess_reply(writer, r, None, False)
+            else:
+                def reply(sj, present, _w=writer, _r=r):
+                    self._sess_reply(_w, _r, sj, present)
+
+                self._begin_take(own, cid, reply)
+                self._owner[cid] = wid
+            return
+        # live on an in-process listener of the router
+        old = cm.get_channel(cid) if cm is not None else None
+        if old is not None:
+            cm._channels.pop(cid, None)
+            sess = old.kick("discarded" if clean else "takenover")
+            self.broker.hooks.run(
+                "session.discarded" if clean else "session.takenover", cid
+            )
+            sj = None
+            if sess is not None:
+                if not clean:
+                    sj = session_to_json(sess)
+                self.broker.drop_session_subs(
+                    cid, list(sess.subscriptions)
+                )
+            if clean:
+                self._drop_parked(cid)
+            self._owner[cid] = wid
+            self._sess_reply(writer, r, sj, sj is not None)
+            return
+        if clean:
+            self._drop_parked(cid)
+            self._owner[cid] = wid
+            self._sess_reply(writer, r, None, False)
+            return
+        # parked in the router CM's detached store (covers sessions
+        # parked by ANY worker, in-process listeners, and
+        # persistence-restored ones)
+        ent = cm._detached.pop(cid, None) if cm is not None else None
+        if ent is not None:
+            sess, _deadline = ent
+            sj = session_to_json(sess)
+            # bankers stay live until resume_done: messages arriving
+            # during the handoff keep banking into this Session object
+            self._resuming[cid] = {
+                "sess": sess,
+                "n0": len(sess.mqueue),
+                "wid": wid,
+                "ts": asyncio.get_running_loop().time(),
+            }
+            self.broker.hooks.run("session.resumed", cid)
+            self.broker.metrics.inc("fabric.sess.resumes")
+            self._owner[cid] = wid
+            self._sess_reply(writer, r, sj, True)
+            return
+        self._owner[cid] = wid
+        self._sess_reply(writer, r, None, False)
+
+    def _begin_take(self, owner_wid: int, cid: str, reply) -> None:
+        """Send 'take' to the live owner; `reply(sess_json, present)`
+        fires on its state reply (or on owner death)."""
+        tk = self._next_take
+        self._next_take += 1
+        self._take_pending[tk] = (owner_wid, cid, reply)
+        self._writers[owner_wid].write(
+            F.pack_json(F.T_SESS, {"op": "take", "cid": cid, "r": tk})
+        )
+
+    def _sess_state(self, d: dict) -> None:
+        """A worker handed over a live session after 'take'."""
+        ent = self._take_pending.pop(int(d["r"]), None)
+        if ent is None:
+            return
+        _owner_wid, _cid, reply = ent
+        self.broker.metrics.inc("fabric.sess.takeovers")
+        reply(d.get("sess"), d.get("sess") is not None)
+
+    def _sess_park(self, wid: int, d: dict) -> None:
+        """Worker client disconnected with expiry > 0: the session parks
+        in the ROUTER's detached store — same store as in-process
+        listeners, so persistence (WAL + snapshot + restore) and expiry
+        sweep apply unchanged, and any future connect finds it."""
+        from emqx_tpu.broker.persistent_session import (
+            make_detached_deliverer,
+        )
+        from emqx_tpu.storage.codec import session_from_json
+
+        cid = d["cid"]
+        if self._owner.get(cid) == wid:
+            self._owner.pop(cid, None)
+        cm = getattr(self.app, "cm", None)
+        if cm is None:
+            return
+        scfg = getattr(
+            getattr(self.app, "config", None), "session", None
+        )
+        from emqx_tpu.broker.session import SessionConfig
+
+        import time as _t
+
+        sess = session_from_json(d["sess"], scfg or SessionConfig())
+        deadline = _t.time() + float(d.get("expiry", 0))
+        # plain banker now; the persistence hook (if attached) replaces
+        # it under the same (sid, filter) key with the WAL-backed one
+        deliver = make_detached_deliverer(sess, None, cid)
+        for f, opts in sess.subscriptions.items():
+            self.broker.subscribe(cid, cid, f, opts, deliver)
+        cm._detached[cid] = (sess, deadline)
+        self.broker.hooks.run("session.detached", cid)
+
+    def _sess_resume_done(self, wid: int, cid: str) -> None:
+        """The new worker installed the session and its SUB frames are
+        registered (they precede resume_done on the FIFO link): drop the
+        handoff bankers and forward anything banked after the snapshot."""
+        ent = self._resuming.pop(cid, None)
+        if ent is None:
+            return
+        sess = ent["sess"]
+        self.broker.drop_session_subs(cid, list(sess.subscriptions))
+        extras = list(sess.mqueue.peek_all())[ent["n0"]:]
+        if not extras:
+            return
+        full_sid = self._sid(wid, cid)
+        for sub_sid, f in list(self._fabric_subs.get(wid, ())):
+            if sub_sid != full_sid:
+                continue
+            _group, real = T.parse_share(f)
+            entry = self.broker._subs.get(real, {})
+            sub = entry.get(full_sid)
+            if sub is None:
+                continue
+            for m in extras:
+                if T.match(m.topic, real):
+                    try:
+                        sub.deliver(m, sub.opts)
+                    except Exception:
+                        self.broker.metrics.inc("delivery.errors")
+
+    # -- in-process takeover bridge (ChannelManager.fabrics) --------------
+    def owns(self, cid: str) -> bool:
+        """True when a live WORKER channel holds this client id."""
+        return self._owner.get(cid) in self._writers
+
+    def take_session(self, cid: str, clean: bool) -> "asyncio.Future":
+        """Take (or discard) a live worker session on behalf of an
+        in-process listener's CONNECT. Resolves with the serialized
+        session json (None for clean/absent/dead-owner)."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        own = self._owner.get(cid)
+        w = self._writers.get(own)
+        if w is None or w.is_closing():
+            fut.set_result(None)
+            return fut
+        if clean:
+            w.write(F.pack_json(F.T_SESS, {"op": "discard", "cid": cid}))
+            self._owner.pop(cid, None)
+            fut.set_result(None)
+            return fut
+
+        def reply(sj, _present):
+            if not fut.done():
+                fut.set_result(sj)
+
+        self._begin_take(own, cid, reply)
+        self._owner.pop(cid, None)
+        # safety: a wedged worker must not stall the CONNECT forever
+        loop.call_later(
+            10.0, lambda: fut.done() or fut.set_result(None)
+        )
+        return fut
+
+    def _drop_parked(self, cid: str) -> None:
+        cm = getattr(self.app, "cm", None)
+        if cm is not None and cid in cm._detached:
+            cm._drop_detached(cid)
+
+    RESUME_GC_S = 120.0
+
+    def _gc_resuming(self) -> None:
+        """A resume the worker never completed (client vanished between
+        CONNECT and install): re-park so the session isn't leaked."""
+        now = asyncio.get_running_loop().time()
+        cm = getattr(self.app, "cm", None)
+        for cid in [
+            c for c, e in self._resuming.items()
+            if now - e["ts"] > self.RESUME_GC_S
+        ]:
+            ent = self._resuming.pop(cid)
+            if cm is not None:
+                import time as _t
+
+                sess = ent["sess"]
+                cm._detached[cid] = (
+                    sess, _t.time() + sess.config.expiry_interval
+                )
 
     # -- publish side -----------------------------------------------------
     async def _on_pub_batch(self, writer, body: bytes) -> None:
@@ -252,9 +547,14 @@ class WorkerFabric:
             asyncio.get_running_loop().call_soon(self._flush)
 
     # a worker that stops reading its UDS must not grow this process's
-    # write buffer without bound: past the high-water mark its deliveries
-    # drop (the mqueue-overflow analog at the fabric seam)
+    # write buffer without bound. Past the high-water mark, deliveries
+    # PARK in per-subscriber bounded queues (mqueue-overflow parity at
+    # the fabric seam, emqx_mqueue.erl: per-session bound + drop-oldest)
+    # and a drain task replays them in order once the pipe recovers —
+    # one slow worker degrades only its over-quota subscribers, never
+    # whole delivery batches
     WRITE_HIGH_WATER = 32 * 1024 * 1024
+    PARK_CAP = 1000  # per subscriber handle (SessionConfig.max_mqueue)
 
     def _flush(self) -> None:
         self._flush_scheduled = False
@@ -266,12 +566,14 @@ class WorkerFabric:
                 continue
             try:
                 if (
-                    w.transport.get_write_buffer_size()
+                    wid in self._parked
+                    or w.transport.get_write_buffer_size()
                     > self.WRITE_HIGH_WATER
                 ):
-                    self.broker.metrics.inc(
-                        "fabric.flush.dropped", len(records)
-                    )
+                    # congested (or actively draining a prior backlog —
+                    # direct writes would reorder per-subscriber flows):
+                    # park per handle, bounded, dropping the OLDEST
+                    self._park(wid, records)
                     continue
                 for frame in F.pack_dlv_batches(records):
                     w.write(frame)
@@ -279,6 +581,76 @@ class WorkerFabric:
                 # one worker's dead pipe (or a malformed record) must not
                 # lose the OTHER workers' deliveries in this tick
                 self.broker.metrics.inc("fabric.flush.errors")
+
+    def _park(self, wid: int, records) -> None:
+        import collections
+
+        queues = self._parked.setdefault(wid, {})
+        for msg, handles in records:
+            for h in handles:
+                q = queues.get(h)
+                if q is None:
+                    q = queues[h] = collections.deque()
+                if len(q) >= self.PARK_CAP:
+                    q.popleft()  # drop-oldest (emqx_mqueue default)
+                    self.broker.metrics.inc("fabric.parked.dropped")
+                q.append(msg)
+        if wid not in self._drainers:
+            t = asyncio.get_running_loop().create_task(
+                self._drain_parked(wid)
+            )
+            self._drainers[wid] = t
+            t.add_done_callback(
+                lambda _t, _w=wid: self._drainers.pop(_w, None)
+            )
+
+    DRAIN_CHUNK = 256  # records per drain write burst
+
+    async def _drain_parked(self, wid: int) -> None:
+        """Replay a congested worker's parked deliveries in per-subscriber
+        order once its pipe drains below the transport's write high-water
+        mark."""
+        while True:
+            w = self._writers.get(wid)
+            queues = self._parked.get(wid)
+            if queues is None or not queues:
+                self._parked.pop(wid, None)
+                return
+            if w is None or w.is_closing():
+                # worker died: its subscriptions are being dropped; the
+                # parked backlog dies with them
+                self._parked.pop(wid, None)
+                return
+            try:
+                await w.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                self._parked.pop(wid, None)
+                return
+            if w.transport.get_write_buffer_size() > self.WRITE_HIGH_WATER:
+                # still over OUR high-water (transport limits are lower):
+                # yield and re-check rather than spin
+                await asyncio.sleep(0.01)
+                continue
+            burst = []
+            n = 0
+            for h in list(queues):
+                q = queues.get(h)
+                while q and n < self.DRAIN_CHUNK:
+                    burst.append((q.popleft(), [h]))
+                    n += 1
+                if q is not None and not q:
+                    del queues[h]
+                if n >= self.DRAIN_CHUNK:
+                    break
+            if burst:
+                try:
+                    for frame in F.pack_dlv_batches(burst):
+                        w.write(frame)
+                    self.broker.metrics.inc("fabric.parked.replayed", n)
+                except Exception:
+                    self.broker.metrics.inc("fabric.flush.errors")
+                    self._parked.pop(wid, None)
+                    return
 
 
 # ---------------------------------------------------------------------------
@@ -294,10 +666,14 @@ class WorkerBroker:
     def __init__(self, hooks, metrics):
         self.hooks = hooks
         self.metrics = metrics
+        self.cm = None  # WorkerChannelManager, set after construction
         self._link_w: Optional[asyncio.StreamWriter] = None
         self._subs: Dict[int, Tuple] = {}  # handle -> (deliver, opts)
         self._byname: Dict[Tuple[str, str], int] = {}
         self._next_handle = 1
+        # session RPC: reqid -> (future, safety timer)
+        self._sess_reqs: Dict[int, Tuple["asyncio.Future", object]] = {}
+        self._next_sess_req = 1
         # publish buffer entries: (msg, future) — the future resolves
         # with the message's delivery count when the router acks the
         # batch (PUBB_ACK), which is when the channel releases the
@@ -305,8 +681,9 @@ class WorkerBroker:
         self._pub_buf: List[Tuple[Message, Optional["asyncio.Future"]]] = []
         self._pub_scheduled = False
         self._next_seq = 1
-        # seq -> (futures, safety TimerHandle cancelled on ack)
-        self._inflight: Dict[int, Tuple[list, object]] = {}
+        # seq -> (futures, safety TimerHandle cancelled on ack, msgs —
+        # kept for re-send across a router-restart link blip)
+        self._inflight: Dict[int, Tuple[list, object, list]] = {}
         # handle -> (future resolved by the router's SUB_ACK, safety
         # timer cancelled on ack); the channel holds the client's SUBACK
         # on the future: SUBACK == routable
@@ -317,16 +694,145 @@ class WorkerBroker:
     def attach_link(self, writer) -> None:
         self._link_w = writer
 
+    def detach_link(self) -> None:
+        """Link lost (router blip): hold all local state; _send becomes a
+        no-op until reattach_link replays it."""
+        self._link_w = None
+
+    def reattach_link(self, writer) -> None:
+        """Re-dialed after a router restart: replay every live
+        subscription (the new router process has empty tables) and
+        re-send unacked QoS>0 publish batches (at-least-once across the
+        blip; the 60s ack timer keeps bounding each batch)."""
+        self._link_w = writer
+        for (sid, filter_), h in list(self._byname.items()):
+            ent = self._subs.get(h)
+            if ent is None:
+                continue
+            _deliver, opts = ent
+            self._send(
+                F.pack_json(
+                    F.T_SUB,
+                    {
+                        "h": h,
+                        "sid": sid,
+                        "cid": sid,
+                        "f": filter_,
+                        "qos": opts.qos,
+                        "nl": opts.no_local,
+                        "rap": opts.retain_as_published,
+                        "rh": opts.retain_handling,
+                        "ex": True,
+                        # replay of an ESTABLISHED subscription: never
+                        # re-deliver retained messages the client already
+                        # got at its real SUBSCRIBE
+                        "nr": True,
+                    },
+                )
+            )
+        for seq in sorted(self._inflight):
+            futs, _timer, msgs = self._inflight[seq]
+            if any(f is not None and not f.done() for f in futs):
+                self._send(F.pack_pub_batch(msgs, seq))
+        # re-announce live channels: the router's drop-path cleared
+        # their session-owner entries when the link fell
+        if self.cm is not None:
+            for cid in list(self.cm._channels):
+                self._send(
+                    F.pack_json(F.T_SESS, {"op": "claim", "cid": cid})
+                )
+
     def _send(self, data: bytes) -> None:
         if self._link_w is not None and not self._link_w.is_closing():
             self._link_w.write(data)
 
+    # session RPC ---------------------------------------------------------
+    SESS_TIMEOUT_S = 30.0
+
+    def sess_open(self, cid: str, clean: bool) -> "asyncio.Future":
+        """Ask the router to resolve this client's session (takeover /
+        resume / fresh) — emqx_cm.open_session, brokered node-wide.
+        Resolves to (sess_json | None, present)."""
+        loop = asyncio.get_running_loop()
+        r = self._next_sess_req
+        self._next_sess_req += 1
+        fut = loop.create_future()
+        timer = loop.call_later(
+            self.SESS_TIMEOUT_S,
+            lambda: fut.done() or fut.set_result((None, False)),
+        )
+        self._sess_reqs[r] = (fut, timer)
+        self._send(F.pack_json(F.T_SESS, {
+            "op": "open", "r": r, "cid": cid, "clean": bool(clean),
+        }))
+        return fut
+
+    def sess_park(self, cid: str, sess_json, expiry: float) -> None:
+        self._send(F.pack_json(F.T_SESS, {
+            "op": "park", "cid": cid, "sess": sess_json,
+            "expiry": float(expiry),
+        }))
+
+    def sess_resume_done(self, cid: str) -> None:
+        self._send(F.pack_json(F.T_SESS, {"op": "resume_done",
+                                          "cid": cid}))
+
+    def sess_closed(self, cid: str) -> None:
+        self._send(F.pack_json(F.T_SESS, {"op": "closed", "cid": cid}))
+
+    def on_sess(self, d: dict) -> None:
+        """Inbound session op from the router (pump_link)."""
+        from emqx_tpu.storage.codec import session_to_json
+
+        op = d.get("op")
+        if op == "open_ack":
+            ent = self._sess_reqs.pop(int(d["r"]), None)
+            if ent is None:
+                return
+            fut, timer = ent
+            timer.cancel()
+            if not fut.done():
+                fut.set_result((d.get("sess"), bool(d.get("present"))))
+        elif op in ("take", "discard") and self.cm is not None:
+            cid = d["cid"]
+            ch = self.cm._channels.pop(cid, None)
+            det = self.cm._detached.pop(cid, None)
+            sj = None
+            if ch is not None:
+                sess = ch.kick(
+                    "takenover" if op == "take" else "discarded"
+                )
+                self.hooks.run(
+                    "session.takenover" if op == "take"
+                    else "session.discarded",
+                    cid,
+                )
+                if sess is not None:
+                    if op == "take":
+                        sj = session_to_json(sess)
+                    self.drop_session_subs(
+                        cid, list(sess.subscriptions)
+                    )
+            elif det is not None:
+                sess, _dl = det
+                if op == "take":
+                    sj = session_to_json(sess)
+                self.drop_session_subs(cid, list(sess.subscriptions))
+            if op == "take":
+                self._send(F.pack_json(F.T_SESS, {
+                    "op": "state", "r": int(d["r"]), "cid": cid,
+                    "sess": sj,
+                }))
+
     # Broker surface ------------------------------------------------------
-    def subscribe(self, sid, client_id, filter_, opts, deliver):
+    def subscribe(self, sid, client_id, filter_, opts, deliver,
+                  replay_retained: bool = True):
         """Returns a future resolved when the router CONFIRMS the
         subscription (SUB_ACK) — the channel awaits it before SUBACK, so
         a publish racing the SUBACK still delivers (the in-process
-        broker's subscribe is synchronous for the same contract)."""
+        broker's subscribe is synchronous for the same contract).
+        `replay_retained=False` marks session-resume re-registrations,
+        which must never re-deliver retained messages."""
         key = (sid, filter_)
         h = self._byname.get(key)
         if h is None:
@@ -336,11 +842,9 @@ class WorkerBroker:
         self._subs[h] = (deliver, opts)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        if self._link_w is None or self._link_w.is_closing():
-            # fail fast: no link, no registration — the channel turns
-            # False into a SUBACK failure code instead of stalling 30s
-            fut.set_result(False)
-            return fut
+        # NOTE: a down link (router restarting) does NOT fail fast — the
+        # registration is recorded locally, reattach_link replays it, and
+        # the 30s confirm timer bounds the client's SUBACK wait
         ent = self._sub_acks.get(h)
         if ent is not None and not ent[0].done():
             fut = ent[0]  # re-subscribe racing its own confirm
@@ -365,6 +869,7 @@ class WorkerBroker:
                     # per-client resubscribe flag set by the worker-side
                     # channel (rh=1 retained-replay suppression)
                     "ex": bool(getattr(opts, "_existing", False)),
+                    **({} if replay_retained else {"nr": True}),
                 },
             )
         )
@@ -438,14 +943,15 @@ class WorkerBroker:
             seq = self._next_seq
             self._next_seq += 1
             futs = [f for _, f in chunk]
+            msgs = [m for m, _ in chunk]
             if any(f is not None for f in futs):
                 # safety: a lost ack (router bug / torn link mid-restart)
                 # must not wedge every publisher's PUBACK forever
                 timer = asyncio.get_running_loop().call_later(
                     self.ACK_TIMEOUT_S, self._expire_batch, seq
                 )
-                self._inflight[seq] = (futs, timer)
-            self._send(F.pack_pub_batch([m for m, _ in chunk], seq))
+                self._inflight[seq] = (futs, timer, msgs)
+            self._send(F.pack_pub_batch(msgs, seq))
 
     def _expire_batch(self, seq: int) -> None:
         ent = self._inflight.pop(seq, None)
@@ -462,7 +968,7 @@ class WorkerBroker:
         ent = self._inflight.pop(seq, None)
         if not ent:
             return
-        futs, timer = ent
+        futs, timer, _msgs = ent
         timer.cancel()
         for f, n in zip(futs, counts):
             if f is not None and not f.done():
@@ -513,6 +1019,126 @@ class WorkerBroker:
                 self.metrics.inc("delivery.errors")
 
 
+class WorkerChannelManager:
+    """emqx_cm semantics ACROSS workers: session open/takeover/resume and
+    persistent parking are brokered by the router process, so a client
+    reconnecting onto a DIFFERENT worker (or an in-process listener of
+    the router) still finds its session. Reference:
+    emqx_cm.erl:245-273 open_session, :346-366 takeover_session —
+    there the registry is node-level; here the router process is the
+    node."""
+
+    def __init__(self, broker: "WorkerBroker"):
+        self.broker = broker
+        broker.cm = self
+        self._channels: Dict[str, object] = {}
+        # transient only (mid-takeover stash); authoritative parking
+        # lives in the ROUTER's detached store
+        self._detached: Dict[str, Tuple] = {}
+
+    def get_channel(self, client_id: str):
+        return self._channels.get(client_id)
+
+    def channel_count(self) -> int:
+        return len(self._channels)
+
+    def client_ids(self):
+        return list(self._channels)
+
+    def open_session(self, channel):
+        """Awaitable (the channel awaits it): one router round trip
+        resolves discard/takeover/resume node-wide."""
+        return self._open_async(channel)
+
+    async def _open_async(self, channel):
+        from emqx_tpu.broker.session import Session
+        from emqx_tpu.storage.codec import session_from_json
+
+        cid = channel.client_id
+        sj, present = await self.broker.sess_open(
+            cid, channel.clean_start
+        )
+        session = None
+        if sj is not None:
+            try:
+                session = session_from_json(sj, channel.config.session)
+            except Exception:
+                self.broker.metrics.inc("fabric.sess.decode_errors")
+        if session is not None:
+            self.broker.hooks.run("session.resumed", cid)
+            for f, opts in session.subscriptions.items():
+                # re-registration of a live session: confirm futures are
+                # intentionally not awaited (CONNACK carries `present`;
+                # deliveries begin as each SUB registers) and retained
+                # must not replay
+                self.broker.subscribe(
+                    cid, cid, f, opts, channel._make_deliverer(opts),
+                    replay_retained=False,
+                )
+            # SUB frames precede resume_done on the FIFO link: the
+            # router flushes handoff-banked messages to the handles
+            # registered above
+            self.broker.sess_resume_done(cid)
+        else:
+            session = Session(cid, channel.config.session)
+            self.broker.hooks.run("session.created", cid)
+            present = False
+        # same-worker concurrent CONNECT race: both were awaiting the
+        # router; the loser installed first and must be kicked
+        old = self._channels.pop(cid, None)
+        if old is not None and old is not channel:
+            old.kick("takenover")
+        self._channels[cid] = channel
+        self.broker.metrics.gauge_set(
+            "connections.count", len(self._channels)
+        )
+        return session, bool(present)
+
+    def on_channel_closed(self, channel, reason: str) -> None:
+        from emqx_tpu.storage.codec import session_to_json
+
+        cid = channel.client_id
+        if self._channels.get(cid) is not channel:
+            return  # already replaced by takeover/discard
+        del self._channels[cid]
+        self.broker.metrics.gauge_set(
+            "connections.count", len(self._channels)
+        )
+        sess = channel.session
+        if sess is None:
+            return
+        expiry = sess.config.expiry_interval
+        if expiry > 0:
+            # park at the ROUTER: survives this worker, resumable from
+            # any worker/listener, WAL-backed when persistence is on
+            self.broker.sess_park(cid, session_to_json(sess), expiry)
+            self.broker.drop_session_subs(
+                cid, list(sess.subscriptions)
+            )
+            self.broker.hooks.run("session.detached", cid)
+        else:
+            self.broker.drop_session_subs(
+                cid, list(sess.subscriptions)
+            )
+            self.broker.hooks.run("session.terminated", cid, reason)
+            self.broker.sess_closed(cid)
+
+    def kick_client(self, client_id: str) -> bool:
+        ch = self._channels.pop(client_id, None)
+        if ch is None:
+            return False
+        sess = ch.kick("kicked")
+        if sess is not None:
+            self.broker.drop_session_subs(
+                client_id, list(sess.subscriptions)
+            )
+        self.broker.sess_closed(client_id)
+        return True
+
+    def sweep_expired(self, now=None) -> int:
+        return 0  # expiry lives with the router's detached store
+
+
 def worker_main(
     wid: int,
     bind: str,
@@ -527,7 +1153,6 @@ def worker_main(
 
 async def _worker_async(wid, bind, port, uds_path, config) -> None:
     from emqx_tpu.app import build_guard_hooks
-    from emqx_tpu.broker.cm import ChannelManager
     from emqx_tpu.broker.hooks import Hooks
     from emqx_tpu.broker.metrics import Metrics
     from emqx_tpu.transport.connection import Connection
@@ -536,7 +1161,7 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
     metrics = Metrics()
     broker = WorkerBroker(hooks, metrics)
     channel_config = build_guard_hooks(config, hooks)
-    cm = ChannelManager(broker)
+    cm = WorkerChannelManager(broker)
 
     # fabric link to the router process (retry: the router may still be
     # binding the UDS when workers spawn)
@@ -551,21 +1176,56 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
     writer.write(F.pack_frame(F.T_HELLO, wid.to_bytes(2, "little")))
     broker.attach_link(writer)
 
-    async def pump_link():
-        try:
-            while True:
-                ftype, body = await F.read_frame(reader)
-                if ftype == F.T_DLV:
-                    for rec in F.unpack_dlv_batch(body):
-                        broker.on_delivery(*rec)
-                elif ftype == F.T_PUBB_ACK:
-                    broker.on_pub_ack(*F.unpack_pub_ack(body))
-                elif ftype == F.T_SUB_ACK:
-                    import json as _json
+    # a router-process blip must not drop every client on this worker
+    # (the reference's layered supervision restarts subsystems without
+    # dropping esockd connections, emqx_machine_boot restart ordering):
+    # hold connections, re-dial the (pid-stable) UDS path, replay SUBs
+    # and unacked publish batches. Only a router gone past the window
+    # ends the worker.
+    RECONNECT_WINDOW_S = 60.0
 
-                    broker.on_sub_ack(int(_json.loads(body)["h"]))
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            os._exit(0)  # router gone: worker has nothing to serve
+    async def pump_link():
+        nonlocal reader, writer
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                while True:
+                    ftype, body = await F.read_frame(reader)
+                    if ftype == F.T_DLV:
+                        for rec in F.unpack_dlv_batch(body):
+                            broker.on_delivery(*rec)
+                    elif ftype == F.T_PUBB_ACK:
+                        broker.on_pub_ack(*F.unpack_pub_ack(body))
+                    elif ftype == F.T_SUB_ACK:
+                        import json as _json
+
+                        broker.on_sub_ack(int(_json.loads(body)["h"]))
+                    elif ftype == F.T_SESS:
+                        import json as _json
+
+                        broker.on_sess(_json.loads(body))
+            except (
+                asyncio.IncompleteReadError,
+                ConnectionResetError,
+                ValueError,
+            ):
+                pass
+            broker.detach_link()
+            broker.metrics.inc("fabric.link.lost")
+            deadline = loop.time() + RECONNECT_WINDOW_S
+            nc = None
+            while loop.time() < deadline:
+                try:
+                    nc = await asyncio.open_unix_connection(uds_path)
+                    break
+                except (FileNotFoundError, ConnectionRefusedError, OSError):
+                    await asyncio.sleep(0.25)
+            if nc is None:
+                os._exit(0)  # router gone for good: nothing to serve
+            reader, writer = nc
+            writer.write(F.pack_frame(F.T_HELLO, wid.to_bytes(2, "little")))
+            broker.reattach_link(writer)
+            broker.metrics.inc("fabric.link.reconnected")
 
     link_task = asyncio.create_task(pump_link())
 
@@ -608,7 +1268,13 @@ class WorkerPool:
         self.port = port
         self.n = n_workers
         self.config = config
-        base = f"emqx-tpu-fabric-{os.getpid()}-{port}"
+        # pid-free path: a RESTARTED router process rebinds the same
+        # socket, so surviving workers can re-dial it. bind+port key the
+        # broker instance on this host (pid in the name would break
+        # restart re-dial; bind alone distinguishes two brokers sharing
+        # a port number on different addresses)
+        safe_bind = bind.replace(":", "_").replace("/", "_")
+        base = f"emqx-tpu-fabric-{safe_bind}-{port}"
         self.uds_path = os.path.join(tempfile.gettempdir(), base + ".sock")
         self._cfg_path = os.path.join(tempfile.gettempdir(), base + ".json")
         self.fabric = WorkerFabric(app, self.uds_path)

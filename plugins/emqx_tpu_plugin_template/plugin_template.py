@@ -1,0 +1,78 @@
+"""Template plugin for emqx_tpu (reference analog: emqx_plugin_template,
+shipped out-of-tree for EMQX; apps/emqx_plugins/src/emqx_plugins.erl:72-91
+is the install/start/stop flow that loads this).
+
+Demonstrates the full extension surface a plugin gets:
+- hook registration on the SAME hookpoints as built-in extensions
+  (message.publish fold, client.connected notification),
+- broker publish access (a periodic stats topic),
+- clean symmetric teardown (every hook removed, the task cancelled).
+
+Install/start/stop/uninstall via the REST API:
+    POST /api/v5/plugins/install          (multipart: the .tar.gz)
+    PUT  /api/v5/plugins/{ref}/start
+    PUT  /api/v5/plugins/{ref}/stop
+    DELETE /api/v5/plugins/{ref}
+"""
+
+import asyncio
+import json
+import time
+
+TAG = "plugin_template"
+STATS_TOPIC = "$plugins/template/stats"
+_state = {}
+
+
+def _on_publish(msg):
+    """message.publish fold: count and annotate (never block the path)."""
+    if msg is None or msg.topic.startswith("$"):
+        return None
+    _state["published"] = _state.get("published", 0) + 1
+    msg.headers["seen_by_template"] = True
+    return None
+
+
+def _on_connected(client_info, _channel):
+    _state["connected"] = _state.get("connected", 0) + 1
+
+
+async def _stats_loop(app):
+    from emqx_tpu.broker.message import Message
+
+    while True:
+        await asyncio.sleep(5.0)
+        app.broker.publish(
+            Message(
+                topic=STATS_TOPIC,
+                payload=json.dumps(
+                    {
+                        "published": _state.get("published", 0),
+                        "connected": _state.get("connected", 0),
+                        "ts": int(time.time() * 1000),
+                    }
+                ).encode(),
+            )
+        )
+
+
+def plugin_start(app):
+    _state.clear()
+    _state["started_at"] = time.time()
+    app.hooks.add("message.publish", _on_publish, priority=50, tag=TAG)
+    app.hooks.add("client.connected", _on_connected, tag=TAG)
+    try:
+        _state["task"] = asyncio.get_running_loop().create_task(
+            _stats_loop(app)
+        )
+    except RuntimeError:
+        _state["task"] = None  # library mode: no loop, hooks still work
+
+
+def plugin_stop(app):
+    app.hooks.delete("message.publish", TAG)
+    app.hooks.delete("client.connected", TAG)
+    task = _state.get("task")
+    if task is not None:
+        task.cancel()
+    _state.clear()

@@ -319,16 +319,16 @@ async def test_qos1_retry_on_missing_ack():
     async with TestBed() as tb:
         sub = await tb.client("retry1")
         await sub.subscribe("r/t", qos=1)
-        # monkey-patch client to swallow its PUBACK
+        # monkey-patch client to swallow its PUBACK (_handle is sync)
         orig = sub._handle
 
         seen = []
 
-        async def no_ack(p):
+        def no_ack(p):
             if p.type == pkt.PUBLISH and p.qos == 1:
                 seen.append(p)
                 return  # no ack sent
-            await orig(p)
+            orig(p)
 
         sub._handle = no_ack
         publ = await tb.client("retry2")

@@ -298,3 +298,36 @@ def test_shared_sub_members_on_different_nodes_exactly_once(cluster3):
         a.publish(Message(topic="xs/t", qos=1))
     [n.flush() for n in (a, b, c)]
     assert len(got_c) == before + 5 and len(got_b) <= 24
+
+
+def test_retained_bootstrap_paged_100k(cluster3):
+    """A joiner bootstraps a >=100k-message retained store via the v2
+    PAGED read — bounded pages, full convergence (the v1 single-reply
+    dump capped at RETAIN_DUMP_CAP and truncated beyond it)."""
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.retainer import Retainer
+
+    bus, (a, b, c), _ = cluster3
+    ra = Retainer(max_retained=200_000, device_threshold=1 << 62)
+    rb = Retainer(max_retained=200_000, device_threshold=1 << 62)
+    a.attach_retainer(ra, a.broker.hooks)
+    N = 100_500
+    for i in range(N):
+        ra._insert(
+            Message(topic=f"bk/{i % 37}/x/{i}", payload=b"v", retain=True)
+        )
+    pages = []
+    orig_call = b.rpc.call
+
+    def counting_call(node, api, method, *args, **kw):
+        r = orig_call(node, api, method, *args, **kw)
+        if api == "retain" and method == "dump_page":
+            pages.append(len(r[0]))
+        return r
+
+    b.rpc.call = counting_call
+    b.attach_retainer(rb, b.broker.hooks)
+    assert b.join(a.name)
+    assert len(rb) == N  # full store converged
+    assert max(pages) <= a.RETAIN_PAGE_MAX  # bounded chunks
+    assert len(pages) >= N // a.RETAIN_PAGE_MAX  # genuinely paged

@@ -238,3 +238,75 @@ async def test_ingest_stop_drains_pending():
     await ing.stop()
     assert await task == 1
     assert got == ["t"]
+
+
+@async_test
+async def test_ingest_pipeline_overlaps_and_settles_fifo():
+    """With pipeline depth 2, batch N+1's LAUNCH happens while batch N's
+    dispatch is still in flight — and settlement (delivery + PUBACK
+    futures) stays strictly FIFO even when the later batch's device work
+    finishes first (per-publisher delivery ordering across batches)."""
+    events = []
+
+    class SlowFastBroker:
+        class router:
+            min_tpu_batch = 1
+            enable_tpu = True
+
+        def __init__(self):
+            self.n = 0
+
+        def adispatch_begin(self, msgs, forward=True):
+            from emqx_tpu.broker.broker import PendingDispatch
+
+            i = self.n
+            self.n += 1
+            events.append(("launch", i))
+            delay = 0.2 if i == 0 else 0.0  # batch 0 slow, batch 1 fast
+            loop = asyncio.get_running_loop()
+            ready = loop.create_future()
+            loop.call_later(
+                delay,
+                lambda: (
+                    events.append(("device_done", i)),
+                    ready.done() or ready.set_result(None),
+                ),
+            )
+
+            async def complete():
+                await ready
+                # the FAN-OUT side effect: must stay FIFO across batches
+                events.append(("fanout", i))
+                return [1] * len(msgs)
+
+            return PendingDispatch(ready, complete)
+
+    b = SlowFastBroker()
+    ing = BatchIngest(b, max_batch=4, window_us=0, pipeline=2)
+    ing.start()
+    futs = []
+    for k in range(8):  # two full batches
+        f = ing.enqueue(Message(topic=f"p/{k}"))
+        f.add_done_callback(
+            lambda _f, _i=k // 4: events.append(("settle", _i))
+        )
+        futs.append(f)
+        if k == 3:
+            await asyncio.sleep(0.05)  # let batch 0 launch first
+    counts = await asyncio.gather(*futs)
+    await ing.stop()
+    assert counts == [1] * 8
+    launches = [i for ev, i in events if ev == "launch"]
+    settles = [i for ev, i in events if ev == "settle"]
+    fanouts = [i for ev, i in events if ev == "fanout"]
+    assert launches == [0, 1]
+    # batch 1's device work finished FIRST (it's instant)...
+    assert events.index(("device_done", 1)) < events.index(
+        ("device_done", 0)
+    )
+    # ...but the host FAN-OUT (delivery) runs strictly FIFO...
+    assert fanouts == [0, 1]
+    # ...and so do the PUBACK futures
+    assert settles == [0] * 4 + [1] * 4
+    # overlap: batch 1 launched BEFORE batch 0's device work completed
+    assert events.index(("launch", 1)) < events.index(("device_done", 0))

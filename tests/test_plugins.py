@@ -172,3 +172,47 @@ async def test_plugins_autostart_and_telemetry(tmp_path):
     finally:
         await app.stop()
     assert app.plugins.list()[0]["running"] is False  # stopped at shutdown
+
+
+def test_shipped_template_plugin_end_to_end(tmp_path):
+    """The IN-REPO template package (plugins/emqx_tpu_plugin_template)
+    installs, starts, hooks live traffic, and stops cleanly — the
+    emqx_plugin_template analog shipping with the framework
+    (emqx_plugins.erl:72-91 flow)."""
+    import pathlib
+    import tarfile as _tar
+
+    src = (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "plugins" / "emqx_tpu_plugin_template"
+    )
+    pkg = tmp_path / "emqx_tpu_plugin_template-1.0.0.tar.gz"
+    with _tar.open(pkg, "w:gz") as t:
+        for f in src.iterdir():
+            t.add(f, arcname=f.name)
+    app = _app(tmp_path)
+    pm = app._plugin_manager()
+    p = pm.install(str(pkg))
+    ref = "emqx_tpu_plugin_template-1.0.0"
+    assert p.ref == ref and not p.running
+    pm.start(ref)
+    from emqx_tpu.broker.message import Message
+
+    app.broker.publish(Message(topic="demo/t", payload=b"x"))
+    app.broker.publish(Message(topic="$sys/skip", payload=b"x"))
+    assert p.module._state["published"] == 1  # '$' topics excluded
+    # annotation hook ran on the message path
+    got = []
+    app.broker.subscribe(
+        "s", "s", "demo/#", __import__("emqx_tpu.mqtt.packet",
+                                       fromlist=["SubOpts"]).SubOpts(),
+        lambda m, o: got.append(m),
+    )
+    app.broker.publish(Message(topic="demo/u", payload=b"y"))
+    assert got and got[0].headers.get("seen_by_template") is True
+    pm.stop(ref)
+    app.broker.publish(Message(topic="demo/t", payload=b"z"))
+    assert p.module._state == {}  # torn down symmetrically
+    pm.uninstall(ref)
+    assert all(pl["name"] != "emqx_tpu_plugin_template"
+               for pl in pm.list())

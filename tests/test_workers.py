@@ -357,3 +357,279 @@ def test_suback_means_routable_no_sleep(worker_app):
         await pub.disconnect()
 
     loop.run_until_complete(asyncio.wait_for(scenario(), 60))
+
+
+def test_router_fabric_restart_no_qos1_loss(worker_app):
+    """Restart the router-side fabric mid-traffic: workers hold their
+    client connections, re-dial the (pid-stable) UDS path, replay
+    subscriptions and unacked publish batches — no QoS1 message lost
+    (reference analog: emqx_machine_boot restarts subsystems without
+    dropping esockd connections)."""
+    import emqx_tpu.transport.workers as W
+    from emqx_tpu.mqtt.client import Client
+
+    loop, app, port = worker_app
+    pool = app.worker_pools[0]
+
+    async def run():
+        sub = Client(client_id="rs-sub")
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe("rr/#", qos=1)
+        pub = Client(client_id="rs-pub")
+        await pub.connect("127.0.0.1", port)
+
+        await pub.publish("rr/a", b"before", qos=1)
+        m = await sub.recv(10)
+        assert m.payload == b"before"
+
+        # router fabric goes down...
+        await pool.fabric.stop()
+        # ...client connections are STILL alive; a publish now is
+        # buffered worker-side (PUBACK held on the router confirm)
+        pub_task = asyncio.get_running_loop().create_task(
+            pub.publish("rr/b", b"during", qos=1, timeout=60)
+        )
+        await asyncio.sleep(0.5)
+        assert not pub_task.done()  # held, not failed
+
+        # ...and comes back (same UDS path, fresh process state)
+        pool.fabric = W.WorkerFabric(app, pool.uds_path)
+        await pool.fabric.start()
+        # wait for both workers to re-dial (0.25s poll loop worker-side;
+        # generous under full-suite CPU load on the 1-core box)
+        for _ in range(240):
+            if len(pool.fabric._writers) >= 2:
+                break
+            await asyncio.sleep(0.25)
+
+        # the held publish completes and delivers (sub replayed its SUB)
+        await asyncio.wait_for(pub_task, 90)
+        m = await sub.recv(60)
+        assert m.payload == b"during"
+
+        # traffic after the blip flows normally
+        await pub.publish("rr/c", b"after", qos=1)
+        m = await sub.recv(30)
+        assert m.payload == b"after"
+        for c in (sub, pub):
+            await c.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 240))
+
+
+def test_fabric_seam_parks_per_subscriber_no_batch_drop():
+    """Past the write high-water mark the fabric parks deliveries in
+    per-subscriber bounded queues (drop-oldest) instead of dropping the
+    whole batch; the backlog replays in order when the pipe drains."""
+    from types import SimpleNamespace
+
+    from emqx_tpu.broker.metrics import Metrics
+    from emqx_tpu.transport.workers import WorkerFabric
+
+    class FakeTransport:
+        def __init__(self):
+            self.size = 0
+
+        def get_write_buffer_size(self):
+            return self.size
+
+    class FakeWriter:
+        def __init__(self):
+            self.transport = FakeTransport()
+            self.frames = []
+
+        def is_closing(self):
+            return False
+
+        def write(self, data):
+            self.frames.append(bytes(data))
+
+        async def drain(self):
+            return
+
+    async def run():
+        metrics = Metrics()
+        app = SimpleNamespace(
+            broker=SimpleNamespace(metrics=metrics), retainer=None
+        )
+        fab = WorkerFabric(app, "/tmp/unused.sock")
+        w = FakeWriter()
+        fab._writers[0] = w
+        # congested: everything parks, nothing written, nothing dropped
+        w.transport.size = WorkerFabric.WRITE_HIGH_WATER + 1
+        for i in range(10):
+            fab.enqueue(0, 7, Message(topic=f"pk/{i}", payload=b"x"))
+            fab.enqueue(0, 9, Message(topic=f"pk/{i}", payload=b"x"))
+        await asyncio.sleep(0.05)
+        assert w.frames == []
+        assert 0 in fab._parked and len(fab._parked[0][7]) == 10
+        # per-subscriber cap drops OLDEST for that subscriber only
+        old_cap = WorkerFabric.PARK_CAP
+        WorkerFabric.PARK_CAP = 12
+        try:
+            for i in range(10, 16):
+                fab.enqueue(0, 7, Message(topic=f"pk/{i}", payload=b"x"))
+            await asyncio.sleep(0.05)
+        finally:
+            WorkerFabric.PARK_CAP = old_cap
+        assert len(fab._parked[0][7]) == 12
+        assert fab._parked[0][7][0].topic == "pk/4"  # oldest dropped
+        assert len(fab._parked[0][9]) == 10  # other subscriber untouched
+        assert metrics.get("fabric.parked.dropped") == 4
+        # pipe recovers: backlog replays in per-subscriber order
+        w.transport.size = 0
+        await asyncio.sleep(0.2)
+        assert fab._parked.get(0) in (None, {})
+        got = [
+            (t, handles)
+            for f in w.frames
+            for t, _p, _q, _r, _rt, _c, handles in F.unpack_dlv_batch(
+                f[5:]
+            )
+        ]
+        seq7 = [t for t, hs in got if hs == [7]]
+        assert seq7 == [f"pk/{i}" for i in range(4, 16)]
+        seq9 = [t for t, hs in got if hs == [9]]
+        assert seq9 == [f"pk/{i}" for i in range(10)]
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+# -- full session semantics on the worker path (emqx_cm parity) --------------
+
+
+def test_worker_session_park_resume_and_offline_banking(worker_app):
+    """A persistent session on a worker listener parks at the ROUTER on
+    disconnect (same detached store as in-process listeners — WAL/expiry
+    apply), banks QoS1 messages published while away, and resumes from
+    WHICHEVER worker the reconnect lands on, delivering the backlog
+    (emqx_cm.erl:245-273 node-level open_session parity)."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt.client import Client
+
+    async def run():
+        c = Client(client_id="ps1", clean_start=False)
+        await c.connect("127.0.0.1", port)
+        assert not c.connack.session_present
+        await c.subscribe("ps/#", qos=1)
+        await c.disconnect()
+        # parked at the router, in the shared detached store
+        for _ in range(100):
+            if "ps1" in app.cm._detached:
+                break
+            await asyncio.sleep(0.05)
+        assert "ps1" in app.cm._detached
+
+        # offline publish banks into the parked session
+        pub = Client(client_id="ps-pub")
+        await pub.connect("127.0.0.1", port)
+        await pub.publish("ps/news", b"while-away", qos=1)
+        await asyncio.sleep(0.2)
+
+        # reconnect (lands on a kernel-chosen worker): session present,
+        # backlog delivered without re-subscribing
+        for round_ in range(6):
+            c2 = Client(client_id="ps1", clean_start=False)
+            await c2.connect("127.0.0.1", port)
+            assert c2.connack.session_present, round_
+            if round_ == 0:
+                m = await c2.recv(15)
+                assert (m.topic, m.payload) == ("ps/news", b"while-away")
+                assert m.qos == 1
+            # still subscribed: live publish reaches the session
+            await pub.publish("ps/live", b"%d" % round_, qos=1)
+            m = await c2.recv(15)
+            assert m.payload == b"%d" % round_
+            await c2.disconnect()
+            await asyncio.sleep(0.2)
+        # clean reconnect discards the parked session
+        c3 = Client(client_id="ps1", clean_start=True)
+        await c3.connect("127.0.0.1", port)
+        assert not c3.connack.session_present
+        await asyncio.sleep(0.2)
+        assert "ps1" not in app.cm._detached
+        await c3.disconnect()
+        await pub.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 90))
+
+
+def test_worker_duplicate_clientid_takeover(worker_app):
+    """Same client id connects twice (possibly on different workers):
+    the old channel is kicked, the session — subscriptions included —
+    moves to the new connection (emqx_cm.erl:346-366
+    takeover_session)."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt.client import Client
+
+    async def run():
+        a = Client(client_id="dup1", clean_start=False)
+        await a.connect("127.0.0.1", port)
+        await a.subscribe("dp/#", qos=1)
+
+        b = Client(client_id="dup1", clean_start=False)
+        await b.connect("127.0.0.1", port)
+        assert b.connack.session_present  # took the live session over
+        # the old connection is dead
+        await asyncio.wait_for(a.closed.wait(), 10)
+
+        pub = Client(client_id="dp-pub")
+        await pub.connect("127.0.0.1", port)
+        await asyncio.sleep(0.3)  # b's carried SUB registers
+        await pub.publish("dp/x", b"to-new-owner", qos=1)
+        m = await b.recv(15)
+        assert (m.topic, m.payload) == ("dp/x", b"to-new-owner")
+        await b.disconnect()
+        await pub.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 60))
+
+
+def test_inprocess_listener_takes_over_worker_session():
+    """Mixed-listener node: a client LIVE on a connection worker
+    reconnects via the IN-PROCESS listener — the worker channel is
+    kicked and the session (subscriptions included) moves over
+    (node-wide emqx_cm: the CM consults the worker fabric's owner
+    registry)."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+    from emqx_tpu.mqtt.client import Client
+
+    wport, iport = _free_port(), _free_port()
+    app = BrokerApp(load_config({
+        "listeners": [
+            {"port": wport, "bind": "127.0.0.1", "workers": 2,
+             "name": "wpool"},
+            {"port": iport, "bind": "127.0.0.1", "name": "plain"},
+        ],
+        "dashboard": {"enable": False},
+        "router": {"enable_tpu": False},
+    }))
+
+    async def run():
+        await app.start()
+        await app.worker_pools[0].wait_ready()
+        a = Client(client_id="mix1", clean_start=False)
+        await a.connect("127.0.0.1", wport)  # lands on a worker
+        await a.subscribe("mx/#", qos=1)
+
+        b = Client(client_id="mix1", clean_start=False)
+        await b.connect("127.0.0.1", iport)  # in-process listener
+        assert b.connack.session_present  # took the worker session over
+        await asyncio.wait_for(a.closed.wait(), 10)  # old channel kicked
+
+        pub = Client(client_id="mx-pub")
+        await pub.connect("127.0.0.1", iport)
+        await asyncio.sleep(0.3)
+        await pub.publish("mx/t", b"crossed", qos=1)
+        m = await b.recv(15)
+        assert (m.topic, m.payload) == ("mx/t", b"crossed")
+        for c in (b, pub):
+            await c.disconnect()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(run(), 90))
+    finally:
+        loop.run_until_complete(app.stop())
+        loop.close()
