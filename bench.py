@@ -1323,7 +1323,9 @@ def main() -> None:
                 text=True,
                 # kill at the remaining budget (+ a little grace), not a
                 # blanket floor: a late config must not overrun the gate
-                timeout=max(60, left - 5),
+                # (a too-small remainder kills the child -> ONE skipped
+                # config, by design)
+                timeout=max(10, left - 5),
             )
         except subprocess.TimeoutExpired as e:
             sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")

@@ -646,9 +646,24 @@ class Channel:
             prior_opts = self.session.subscriptions.get(mf)
             existing = prior_opts is not None
             opts._existing = existing  # for retain_handling=1 semantics
+            sub_kw = {}
+            if (
+                getattr(self.broker, "supports_raw_lane", False)
+                and opts.qos == 0
+                and not self.mountpoint
+                and not self.hooks.callbacks("message.delivered")
+                and not self.hooks.callbacks("delivery.completed")
+            ):
+                # QoS0 fast lane (worker fabric): the router ships
+                # pre-serialized frames written straight to this socket
+                # — only when no per-delivery work would be skipped
+                sub_kw = {
+                    "raw_sink": self.sink,
+                    "raw_version": self.version,
+                }
             r = self.broker.subscribe(
                 self.client_id, self.client_id, mf, opts,
-                self._make_deliverer(opts),
+                self._make_deliverer(opts), **sub_kw,
             )
             if inspect.isawaitable(r):
                 # worker-fabric broker: collect the router's confirm and

@@ -262,10 +262,16 @@ serialize_publish(PyObject *self, PyObject *args)
  * Wire format mirrors fabric.py exactly (it differentially tests this):
  *
  *   pub_record: u16 tlen, topic, u32 plen, payload,
- *               u8 flags (qos | retain<<2 | dup<<3), u16 clen, client
+ *               u8 flags (qos | retain<<2 | dup<<3 | has_props<<4),
+ *               u16 clen, client, [u32 pblen, props] (iff has_props)
  *   dlv_record: pub_record head (flags bit3 = retained)
  *               + u16 ntargets + ntargets * u32 handle
  *   frame:      u32 len (excl. 5-byte header), u8 type, body
+ *
+ * The PACK functions here never set has_props (the Python wrapper
+ * routes props-carrying batches to the reference packer); the UNPACK
+ * functions handle both forms, returning the raw props block for the
+ * wrapper to decode.
  */
 
 #define FAB_T_PUBB 3
@@ -532,11 +538,37 @@ unpack_dlv_batch(PyObject *self, PyObject *args)
             (const char *)p + off, clen, "strict");
         if (!client) { Py_DECREF(topic); Py_DECREF(payload); goto err_out; }
         off += clen;
+        /* flags bit 4: optional MQTT5 property block (raw bytes here;
+         * the Python wrapper decodes) */
+        PyObject *props = Py_None;
+        Py_INCREF(Py_None);
+        if (flags & 0x10) {
+            if (off + 4 > len) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                Py_DECREF(props); goto trunc_out;
+            }
+            Py_ssize_t pbl = (Py_ssize_t)p[off]
+                | ((Py_ssize_t)p[off+1] << 8)
+                | ((Py_ssize_t)p[off+2] << 16)
+                | ((Py_ssize_t)p[off+3] << 24);
+            off += 4;
+            if (off + pbl + 2 > len) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                Py_DECREF(props); goto trunc_out;
+            }
+            Py_DECREF(props);
+            props = PyBytes_FromStringAndSize((const char *)p + off, pbl);
+            if (!props) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                goto err_out;
+            }
+            off += pbl;
+        }
         Py_ssize_t nh = (Py_ssize_t)p[off] | ((Py_ssize_t)p[off+1] << 8);
         off += 2;
         if (off + 4 * nh > len) {
             Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
-            goto trunc_out;
+            Py_DECREF(props); goto trunc_out;
         }
         PyObject *handles = PyList_New(nh);
         if (!handles) {
@@ -557,10 +589,10 @@ unpack_dlv_batch(PyObject *self, PyObject *args)
             PyList_SET_ITEM(handles, k, h);
         }
         PyObject *tup = Py_BuildValue(
-            "(NNiOON N)", topic, payload, (int)(flags & 3),
+            "(NNiOONNN)", topic, payload, (int)(flags & 3),
             (flags & 4) ? Py_True : Py_False,
             (flags & 8) ? Py_True : Py_False,
-            client, handles);
+            client, props, handles);
         if (!tup) goto err_out;
         if (PyList_Append(out, tup) < 0) { Py_DECREF(tup); goto err_out; }
         Py_DECREF(tup);
@@ -668,11 +700,37 @@ unpack_pub_batch_c(PyObject *self, PyObject *args)
             (const char *)p + off, clen, "strict");
         if (!client) { Py_DECREF(topic); Py_DECREF(payload); goto err_out; }
         off += clen;
+        /* flags bit 4: optional MQTT5 property block (raw bytes; the
+         * Python wrapper decodes) */
+        PyObject *pprops = Py_None;
+        Py_INCREF(Py_None);
+        if (flags & 0x10) {
+            if (off + 4 > len) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                Py_DECREF(pprops); goto trunc_out;
+            }
+            Py_ssize_t pbl = (Py_ssize_t)p[off]
+                | ((Py_ssize_t)p[off+1] << 8)
+                | ((Py_ssize_t)p[off+2] << 16)
+                | ((Py_ssize_t)p[off+3] << 24);
+            off += 4;
+            if (off + pbl > len) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                Py_DECREF(pprops); goto trunc_out;
+            }
+            Py_DECREF(pprops);
+            pprops = PyBytes_FromStringAndSize((const char *)p + off, pbl);
+            if (!pprops) {
+                Py_DECREF(topic); Py_DECREF(payload); Py_DECREF(client);
+                goto err_out;
+            }
+            off += pbl;
+        }
         PyObject *tup = Py_BuildValue(
-            "(NNiOON)", topic, payload, (int)(flags & 3),
+            "(NNiOONN)", topic, payload, (int)(flags & 3),
             (flags & 4) ? Py_True : Py_False,
             (flags & 8) ? Py_True : Py_False,
-            client);
+            client, pprops);
         if (!tup) goto err_out;
         if (PyList_Append(out, tup) < 0) { Py_DECREF(tup); goto err_out; }
         Py_DECREF(tup);

@@ -27,10 +27,18 @@ holds each QoS1/2 client ack on that confirmation, so the at-least-once
 boundary sits at the router, not at the worker's socket buffer.
 
   pub_record: u16 tlen, topic, u32 plen, payload,
-              u8 flags (qos | retain<<2 | dup<<3), u16 clen, from_client
+              u8 flags (qos | retain<<2 | dup<<3 | has_props<<4),
+              u16 clen, from_client,
+              [u32 pblen, props_block]           (iff has_props)
   dlv_record: u16 tlen, topic, u32 plen, payload,
-              u8 flags (pub qos | retain<<2 | retained<<3),
-              u16 clen, from_client, u16 ntargets, ntargets * u32 handle
+              u8 flags (pub qos | retain<<2 | retained<<3 |
+                        has_props<<4),
+              u16 clen, from_client,
+              [u32 pblen, props_block],          (iff has_props)
+              u16 ntargets, ntargets * u32 handle
+
+props_block is the MQTT5 encoded property block (frame.encode_properties
+output) — v5 publish properties survive the worker fabric end to end.
 
 A delivery record carries the message ONCE per worker; per-subscription
 QoS downgrade happens worker-side in the Session (same code path as the
@@ -56,6 +64,14 @@ T_PUBB_ACK = 5
 # reference's meaning — the subscription is ROUTABLE, broker-wide
 # (emqx_broker.erl:127-160 is synchronous for the same reason).
 T_SUB_ACK = 6
+# RAW delivery (r->w): pre-serialized MQTT PUBLISH frames for the QoS0
+# fast lane — the router serializes once per (message, version, retain)
+# and the worker writes the bytes straight to subscriber sockets,
+# bypassing the per-delivery Channel/Session work (eligibility is
+# negotiated per subscription via the SUB json's "fl" field: qos 0, no
+# mountpoint, empty delivered/completed hook chains worker-side).
+#   body: u32 n, n * (u32 blen, frame_bytes, u16 nh, nh * u32 handle)
+T_RAW = 8
 # Session ops (json, both directions): the router brokers emqx_cm
 # semantics ACROSS workers — open (w->r: resolve takeover/resume at
 # CONNECT), take/discard (r->w: hand over / kill a live channel),
@@ -95,6 +111,19 @@ def pack_json(ftype: int, obj) -> bytes:
     return pack_frame(ftype, json.dumps(obj).encode())
 
 
+def _encode_props(props) -> bytes:
+    from emqx_tpu.mqtt.frame import encode_properties
+
+    return encode_properties(props)
+
+
+def _decode_props(blob: bytes):
+    from emqx_tpu.mqtt.frame import decode_properties
+
+    props, _off = decode_properties(blob, 0)
+    return props
+
+
 def pack_pub_batch(msgs, seq: int = 0) -> bytes:
     """msgs: iterable of Message."""
     parts = [b""]
@@ -103,20 +132,26 @@ def pack_pub_batch(msgs, seq: int = 0) -> bytes:
         t = m.topic.encode()
         p = m.payload or b""
         c = (m.from_client or "").encode()
+        props = getattr(m, "properties", None)
         flags = (m.qos & 3) | (4 if m.retain else 0) | (
             8 if getattr(m, "dup", False) else 0
-        )
-        parts.append(
+        ) | (0x10 if props else 0)
+        rec = (
             _U16.pack(len(t)) + t + _U32.pack(len(p)) + p
             + bytes([flags]) + _U16.pack(len(c)) + c
         )
+        if props:
+            pb = _encode_props(props)
+            rec += _U32.pack(len(pb)) + pb
+        parts.append(rec)
         n += 1
     parts[0] = _U32.pack(seq) + _U32.pack(n)
     return pack_frame(T_PUBB, b"".join(parts))
 
 
 def unpack_pub_batch(body: bytes):
-    """-> (seq, [(topic, payload, qos, retain, dup, from_client)])"""
+    """-> (seq, [(topic, payload, qos, retain, dup, from_client,
+    props | None)])"""
     (seq,) = _U32.unpack_from(body, 0)
     (n,) = _U32.unpack_from(body, 4)
     off = 8
@@ -136,9 +171,15 @@ def unpack_pub_batch(body: bytes):
         off += 2
         client = body[off : off + cl].decode()
         off += cl
+        props = None
+        if flags & 0x10:
+            (pbl,) = _U32.unpack_from(body, off)
+            off += 4
+            props = _decode_props(body[off : off + pbl])
+            off += pbl
         out.append(
             (topic, payload, flags & 3, bool(flags & 4), bool(flags & 8),
-             client)
+             client, props)
         )
     return seq, out
 
@@ -168,13 +209,17 @@ def pack_dlv_batches(records, max_body: float = MAX_BODY):
         t = m.topic.encode()
         p = m.payload or b""
         c = (m.from_client or "").encode()
+        props = getattr(m, "properties", None)
         flags = (m.qos & 3) | (4 if m.retain else 0) | (
             8 if m.headers.get("retained") else 0
-        )
+        ) | (0x10 if props else 0)
         head = (
             _U16.pack(len(t)) + t + _U32.pack(len(p)) + p
             + bytes([flags]) + _U16.pack(len(c)) + c
         )
+        if props:
+            pb = _encode_props(props)
+            head += _U32.pack(len(pb)) + pb
         # ntargets is u16: split monster fan-outs across records rather
         # than raise mid-flush (a 10M-sub broker CAN put >65535 matching
         # subscriptions on one worker)
@@ -204,7 +249,8 @@ def pack_dlv_batch(records) -> bytes:
 
 
 def unpack_dlv_batch(body: bytes):
-    """-> [(topic, payload, qos, retain, retained, from_client, [handles])]"""
+    """-> [(topic, payload, qos, retain, retained, from_client,
+    props | None, [handles])]"""
     (n,) = _U32.unpack_from(body, 0)
     off = 4
     out = []
@@ -223,13 +269,19 @@ def unpack_dlv_batch(body: bytes):
         off += 2
         client = body[off : off + cl].decode()
         off += cl
+        props = None
+        if flags & 0x10:
+            (pbl,) = _U32.unpack_from(body, off)
+            off += 4
+            props = _decode_props(body[off : off + pbl])
+            off += pbl
         (nh,) = _U16.unpack_from(body, off)
         off += 2
         handles = list(struct.unpack_from(f"<{nh}I", body, off))
         off += 4 * nh
         out.append(
             (topic, payload, flags & 3, bool(flags & 4), bool(flags & 8),
-             client, handles)
+             client, props, handles)
         )
     return out
 
@@ -253,15 +305,79 @@ if _nc.pack_dlv_frames is not None:
             max_body = 1 << 62
         if not isinstance(records, list):
             records = list(records)
+        if any(getattr(m, "properties", None) for m, _h in records):
+            # props-carrying batches take the (rarer) Python packer;
+            # the C packer handles the propless hot path
+            return _py_pack_dlv_batches(records, max_body)
         return _nc.pack_dlv_frames(records, int(max_body))
 
     def pack_pub_batch(msgs, seq: int = 0) -> bytes:  # noqa: F811
         if not isinstance(msgs, list):
             msgs = list(msgs)
+        if any(getattr(m, "properties", None) for m in msgs):
+            return _py_pack_pub_batch(msgs, seq)
         return _nc.pack_pub_batch(msgs, seq)
 
-    unpack_pub_batch = _nc.unpack_pub_batch  # noqa: F811
-    unpack_dlv_batch = _nc.unpack_dlv_batch  # noqa: F811
+    def unpack_pub_batch(body: bytes):  # noqa: F811
+        seq, recs = _nc.unpack_pub_batch(body)
+        # the C layer returns the raw props block (or None); decode here
+        return seq, [
+            r if r[6] is None else r[:6] + (_decode_props(r[6]),)
+            for r in recs
+        ]
+
+    def unpack_dlv_batch(body: bytes):  # noqa: F811
+        return [
+            r if r[6] is None else r[:6] + (_decode_props(r[6]), r[7])
+            for r in _nc.unpack_dlv_batch(body)
+        ]
+
+
+def pack_raw_batches(records, max_body: float = MAX_BODY):
+    """records: [(frame_bytes, [handle, ...])] -> one or more T_RAW
+    frames, each body bounded by ~max_body."""
+    out = bytearray(9)
+    n = 0
+    for buf, handles in records:
+        # nh is u16: split monster fan-outs across records (same rule
+        # as pack_dlv_batches — a 10M-sub broker CAN put >65535
+        # matching subscriptions on one worker)
+        for lo in range(0, len(handles), 0xFFFF):
+            chunk = handles[lo : lo + 0xFFFF]
+            rec_len = 4 + len(buf) + 2 + 4 * len(chunk)
+            if n and len(out) + rec_len > max_body:
+                out[0:5] = _HDR.pack(len(out) - 5, T_RAW)
+                out[5:9] = _U32.pack(n)
+                yield bytes(out)
+                out = bytearray(9)
+                n = 0
+            out += _U32.pack(len(buf))
+            out += buf
+            out += _U16.pack(len(chunk))
+            out += struct.pack(f"<{len(chunk)}I", *chunk)
+            n += 1
+    if n:
+        out[0:5] = _HDR.pack(len(out) - 5, T_RAW)
+        out[5:9] = _U32.pack(n)
+        yield bytes(out)
+
+
+def unpack_raw_batch(body: bytes):
+    """-> [(frame_bytes, [handles])]"""
+    (n,) = _U32.unpack_from(body, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        (bl,) = _U32.unpack_from(body, off)
+        off += 4
+        buf = body[off : off + bl]
+        off += bl
+        (nh,) = _U16.unpack_from(body, off)
+        off += 2
+        handles = list(struct.unpack_from(f"<{nh}I", body, off))
+        off += 4 * nh
+        out.append((buf, handles))
+    return out
 
 
 async def read_frame(reader) -> Tuple[int, bytes]:

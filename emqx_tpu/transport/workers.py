@@ -65,9 +65,13 @@ class WorkerFabric:
         self._outbox: Dict[int, List] = {}
         self._outbox_last: Dict[int, Tuple[int, List[int]]] = {}
         self._flush_scheduled = False
-        # congestion parking: wid -> {handle -> deque[msg]} + drain tasks
+        # congestion parking: wid -> {handle -> deque[msg|raw bytes]}
+        # + drain tasks
         self._parked: Dict[int, Dict[int, object]] = {}
         self._drainers: Dict[int, asyncio.Task] = {}
+        # QoS0 fast lane: wid -> [(frame_bytes, [handles])]
+        self._raw_outbox: Dict[int, List] = {}
+        self._raw_last: Dict[int, Tuple] = {}
         # emqx_cm across workers: cid -> owning wid (live channels);
         # takes pending the owner's state reply, keyed by a ROUTER-
         # generated token (worker request ids are only unique per
@@ -153,6 +157,7 @@ class WorkerFabric:
             if wid >= 0:
                 self._writers.pop(wid, None)
                 self._outbox.pop(wid, None)
+                self._raw_outbox.pop(wid, None)
                 self._parked.pop(wid, None)
                 d = self._drainers.pop(wid, None)
                 if d is not None:
@@ -196,9 +201,24 @@ class WorkerFabric:
         # opts._existing); broker-wide existence would suppress replay
         # for every later client
         existing = bool(d.get("ex", False))
+        # QoS0 fast lane ("fl": protocol version): the router ships a
+        # pre-serialized PUBLISH the worker writes straight to the
+        # subscriber socket. Retained replays stay on the message path
+        # (their Message objects are store-owned; see channel._fb note).
+        fl = d.get("fl")
+        if fl:
+            rap = bool(d.get("rap", False))
 
-        def deliver(msg, _opts, _wid=wid, _h=handle):
-            self.enqueue(_wid, _h, msg)
+            def deliver(msg, _opts, _wid=wid, _h=handle, _v=int(fl),
+                        _rap=rap):
+                if msg.headers.get("retained"):
+                    self.enqueue(_wid, _h, msg)
+                else:
+                    self.enqueue_raw(_wid, _h, _v, _rap, msg)
+        else:
+
+            def deliver(msg, _opts, _wid=wid, _h=handle):
+                self.enqueue(_wid, _h, msg)
 
         full_sid = self._sid(wid, d["sid"])
         self.broker.subscribe(full_sid, d.get("cid", ""), filter_, opts,
@@ -493,7 +513,7 @@ class WorkerFabric:
         # enqueue INLINE (per-publisher ordering is an MQTT contract);
         # only the confirm-wait runs as a task so the next frame parses
         # while this batch's ingest window flushes
-        for topic, payload, qos, retain, dup, client in records:
+        for topic, payload, qos, retain, dup, client, props in records:
             msg = Message(
                 topic=topic,
                 payload=payload,
@@ -501,6 +521,7 @@ class WorkerFabric:
                 retain=retain,
                 dup=dup,
                 from_client=client,
+                properties=props or {},
             )
             results.append(await self.broker.apublish_enqueue(msg))
         if not any(r[2] > 0 for r in records):
@@ -546,6 +567,62 @@ class WorkerFabric:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
 
+    def enqueue_raw(self, wid: int, handle: int, version: int, rap: bool,
+                    msg) -> None:
+        """QoS0 fast lane: serialize the PUBLISH once per (version,
+        retain, topic) — the cache rides the Message — and queue the
+        bytes for direct socket writes worker-side. Congested workers
+        fall back to the message path (parked per subscriber there)."""
+        if wid not in self._writers:
+            return
+        if wid in self._parked:
+            return self.enqueue(wid, handle, msg)
+        retain = bool(msg.retain and rap)
+        fb = getattr(msg, "_fb", None)
+        if fb is None:
+            fb = {}
+            msg._fb = fb
+        # the (version, retain, topic) key is SHARED with the in-process
+        # channel's QoS0 frame cache — safe because both producers emit
+        # identical bytes: v5 frames here carry the full encoded
+        # properties, exactly like channel.handle_deliver's serialize
+        key = (version, retain, msg.topic)
+        buf = fb.get(key)
+        if buf is None:
+            from emqx_tpu.mqtt import codec_native as _nc
+
+            v5 = version == pkt.MQTT_V5
+            if _nc.serialize_publish is not None:
+                from emqx_tpu.mqtt.frame import encode_properties
+
+                props = encode_properties(msg.properties) if v5 else b""
+                buf = _nc.serialize_publish(
+                    msg.topic.encode(), msg.payload or b"", 0,
+                    1 if retain else 0, 0, 0, props, 1 if v5 else 0,
+                )
+            else:
+                from emqx_tpu.mqtt.frame import serialize
+
+                buf = serialize(
+                    pkt.Publish(topic=msg.topic,
+                                payload=msg.payload or b"",
+                                qos=0, retain=retain, packet_id=None,
+                                properties=dict(msg.properties)),
+                    version,
+                )
+            fb[key] = buf
+        box = self._raw_outbox.setdefault(wid, [])
+        last = self._raw_last.get(wid)
+        if last is not None and last[0] is buf and box:
+            last[1].append(handle)
+        else:
+            handles = [handle]
+            box.append((buf, handles))
+            self._raw_last[wid] = (buf, handles)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
     # a worker that stops reading its UDS must not grow this process's
     # write buffer without bound. Past the high-water mark, deliveries
     # PARK in per-subscriber bounded queues (mqueue-overflow parity at
@@ -559,8 +636,12 @@ class WorkerFabric:
     def _flush(self) -> None:
         self._flush_scheduled = False
         self._outbox_last.clear()
+        self._raw_last.clear()
         boxes, self._outbox = self._outbox, {}
-        for wid, records in boxes.items():
+        raws, self._raw_outbox = self._raw_outbox, {}
+        for wid in boxes.keys() | raws.keys():
+            records = boxes.get(wid, ())
+            raw_records = raws.get(wid, ())
             w = self._writers.get(wid)
             if w is None or w.is_closing():
                 continue
@@ -572,11 +653,22 @@ class WorkerFabric:
                 ):
                     # congested (or actively draining a prior backlog —
                     # direct writes would reorder per-subscriber flows):
-                    # park per handle, bounded, dropping the OLDEST
-                    self._park(wid, records)
+                    # park per handle, bounded, dropping the OLDEST.
+                    # Raw-lane bufs park as bufs (replayed verbatim).
+                    if records:
+                        self._park(wid, records)
+                    if raw_records:
+                        self._park(wid, raw_records)
                     continue
-                for frame in F.pack_dlv_batches(records):
-                    w.write(frame)
+                if records:
+                    for frame in F.pack_dlv_batches(records):
+                        w.write(frame)
+                if raw_records:
+                    for frame in F.pack_raw_batches(raw_records):
+                        w.write(frame)
+                    self.broker.metrics.inc(
+                        "fabric.raw.records", len(raw_records)
+                    )
             except Exception:
                 # one worker's dead pipe (or a malformed record) must not
                 # lose the OTHER workers' deliveries in this tick
@@ -631,26 +723,44 @@ class WorkerFabric:
                 # yield and re-check rather than spin
                 await asyncio.sleep(0.01)
                 continue
-            burst = []
             n = 0
-            for h in list(queues):
-                q = queues.get(h)
-                while q and n < self.DRAIN_CHUNK:
-                    burst.append((q.popleft(), [h]))
-                    n += 1
-                if q is not None and not q:
-                    del queues[h]
-                if n >= self.DRAIN_CHUNK:
-                    break
-            if burst:
-                try:
-                    for frame in F.pack_dlv_batches(burst):
-                        w.write(frame)
+            try:
+                for h in list(queues):
+                    q = queues.get(h)
+                    run: list = []
+                    while q and n < self.DRAIN_CHUNK:
+                        run.append(q.popleft())
+                        n += 1
+                    if q is not None and not q:
+                        del queues[h]
+                    # a subscriber's queue may interleave Message
+                    # records (DLV path) and raw-lane bufs: emit
+                    # same-type runs in pop order so per-subscriber
+                    # ordering holds
+                    i = 0
+                    while i < len(run):
+                        j = i
+                        is_raw = isinstance(run[i], (bytes, bytearray))
+                        while j < len(run) and isinstance(
+                            run[j], (bytes, bytearray)
+                        ) == is_raw:
+                            j += 1
+                        seg = [(x, [h]) for x in run[i:j]]
+                        packer = (
+                            F.pack_raw_batches if is_raw
+                            else F.pack_dlv_batches
+                        )
+                        for frame in packer(seg):
+                            w.write(frame)
+                        i = j
+                    if n >= self.DRAIN_CHUNK:
+                        break
+                if n:
                     self.broker.metrics.inc("fabric.parked.replayed", n)
-                except Exception:
-                    self.broker.metrics.inc("fabric.flush.errors")
-                    self._parked.pop(wid, None)
-                    return
+            except Exception:
+                self.broker.metrics.inc("fabric.flush.errors")
+                self._parked.pop(wid, None)
+                return
 
 
 # ---------------------------------------------------------------------------
@@ -669,6 +779,8 @@ class WorkerBroker:
         self.cm = None  # WorkerChannelManager, set after construction
         self._link_w: Optional[asyncio.StreamWriter] = None
         self._subs: Dict[int, Tuple] = {}  # handle -> (deliver, opts)
+        # QoS0 fast lane: handle -> sink with send_bytes (raw writes)
+        self._raw_sinks: Dict[int, object] = {}
         self._byname: Dict[Tuple[str, str], int] = {}
         self._next_handle = 1
         # session RPC: reqid -> (future, safety timer)
@@ -710,6 +822,8 @@ class WorkerBroker:
             if ent is None:
                 continue
             _deliver, opts = ent
+            ent_raw = self._raw_sinks.get(h)
+            fl = ent_raw[1] if ent_raw else 0
             self._send(
                 F.pack_json(
                     F.T_SUB,
@@ -727,6 +841,7 @@ class WorkerBroker:
                         # re-deliver retained messages the client already
                         # got at its real SUBSCRIBE
                         "nr": True,
+                        **({"fl": fl} if fl else {}),
                     },
                 )
             )
@@ -825,14 +940,22 @@ class WorkerBroker:
                 }))
 
     # Broker surface ------------------------------------------------------
+    # channels probe this before offering a raw-lane sink (the
+    # in-process Broker has no fabric seam to shortcut)
+    supports_raw_lane = True
+
     def subscribe(self, sid, client_id, filter_, opts, deliver,
-                  replay_retained: bool = True):
+                  replay_retained: bool = True, raw_sink=None,
+                  raw_version: int = 0):
         """Returns a future resolved when the router CONFIRMS the
         subscription (SUB_ACK) — the channel awaits it before SUBACK, so
         a publish racing the SUBACK still delivers (the in-process
         broker's subscribe is synchronous for the same contract).
         `replay_retained=False` marks session-resume re-registrations,
-        which must never re-deliver retained messages."""
+        which must never re-deliver retained messages. `raw_sink` opts
+        this subscription into the QoS0 fast lane: the router ships
+        pre-serialized PUBLISH frames and on_raw writes them straight
+        to the sink, bypassing the channel."""
         key = (sid, filter_)
         h = self._byname.get(key)
         if h is None:
@@ -840,6 +963,12 @@ class WorkerBroker:
             self._next_handle += 1
             self._byname[key] = h
         self._subs[h] = (deliver, opts)
+        if raw_sink is not None:
+            self._raw_sinks[h] = (raw_sink, int(raw_version))
+        else:
+            # re-subscribe that no longer qualifies (e.g. QoS upgrade)
+            # must leave the fast lane
+            self._raw_sinks.pop(h, None)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         # NOTE: a down link (router restarting) does NOT fail fast — the
@@ -870,6 +999,8 @@ class WorkerBroker:
                     # channel (rh=1 retained-replay suppression)
                     "ex": bool(getattr(opts, "_existing", False)),
                     **({} if replay_retained else {"nr": True}),
+                    **({"fl": raw_version} if raw_sink is not None
+                       else {}),
                 },
             )
         )
@@ -889,6 +1020,7 @@ class WorkerBroker:
         if h is None:
             return False
         self._subs.pop(h, None)
+        self._raw_sinks.pop(h, None)
         ent = self._sub_acks.pop(h, None)
         if ent is not None:
             # unsubscribing a confirm-pending handle (e.g. the channel's
@@ -997,14 +1129,37 @@ class WorkerBroker:
         return 0
 
     # delivery ------------------------------------------------------------
+    def on_raw(self, records) -> None:
+        """QoS0 fast lane: pre-serialized PUBLISH frames from the
+        router, written straight to subscriber sockets (the negotiated
+        eligibility guarantees no channel-side work is being skipped:
+        qos 0, no mountpoint, empty delivered/completed chains)."""
+        sinks = self._raw_sinks
+        sent = errs = 0
+        for buf, handles in records:
+            for h in handles:
+                ent = sinks.get(h)
+                if ent is None:
+                    continue
+                try:
+                    ent[0].send_bytes(buf)
+                    sent += 1
+                except Exception:
+                    errs += 1
+        if sent:
+            self.metrics.inc("packets.sent", sent)
+        if errs:
+            self.metrics.inc("delivery.errors", errs)
+
     def on_delivery(self, topic, payload, qos, retain, retained, client,
-                    handles) -> None:
+                    props, handles) -> None:
         msg = Message(
             topic=topic,
             payload=payload,
             qos=qos,
             retain=retain,
             from_client=client,
+            properties=props or {},
         )
         if retained:
             msg.headers["retained"] = True
@@ -1194,6 +1349,8 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
                     if ftype == F.T_DLV:
                         for rec in F.unpack_dlv_batch(body):
                             broker.on_delivery(*rec)
+                    elif ftype == F.T_RAW:
+                        broker.on_raw(F.unpack_raw_batch(body))
                     elif ftype == F.T_PUBB_ACK:
                         broker.on_pub_ack(*F.unpack_pub_ack(body))
                     elif ftype == F.T_SUB_ACK:
@@ -1404,6 +1561,25 @@ def _cli() -> None:
     a = ap.parse_args()
     with open(a.config) as f:
         c = load_config(json.load(f))
+    prof_dir = os.environ.get("EMQX_TPU_WORKER_PROFILE")
+    if prof_dir:
+        # perf tooling: profile this worker's whole life, dump on exit
+        # (SIGTERM mapped to sys.exit so the pool's terminate() still
+        # flushes the profile)
+        import cProfile
+        import signal as _sig
+
+        pr = cProfile.Profile()
+
+        def _dump(*_):
+            pr.disable()
+            pr.dump_stats(
+                os.path.join(prof_dir, f"worker-{a.wid}.prof")
+            )
+            os._exit(0)
+
+        _sig.signal(_sig.SIGTERM, _dump)
+        pr.enable()
     worker_main(a.wid, a.bind, a.port, a.uds, c)
 
 

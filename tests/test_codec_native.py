@@ -183,6 +183,11 @@ def test_fabric_native_parity():
     big = Message(topic="big", payload=b"p" * 100, from_client="x")
     big.headers["retained"] = True
     recs.append((big, list(range(70_000))))
+    # a props-carrying message routes through the Python packer but
+    # must round-trip through BOTH unpackers identically
+    pm = Message(topic="p/t", payload=b"q", from_client="c",
+                 properties={"Content-Type": "text/x"})
+    recs.append((pm, [3, 9]))
     for cap in (300, 2000, 10**9, float("inf")):
         fa = list(FB.pack_dlv_batches(recs, cap))
         fb = list(FB._py_pack_dlv_batches(recs, cap))
@@ -190,7 +195,8 @@ def test_fabric_native_parity():
         ua = [r for f in fa for r in FB.unpack_dlv_batch(f[5:])]
         ub = [r for f in fa for r in FB._py_unpack_dlv_batch(f[5:])]
         assert ua == ub
-        # every handle delivered exactly once, in order
-        assert sum(len(r[6]) for r in ua) == sum(
+        # every handle delivered exactly once, in order (handles are
+        # the LAST field; r[6] is the optional props dict)
+        assert sum(len(r[-1]) for r in ua) == sum(
             len(h) for _, h in recs
         )

@@ -37,8 +37,8 @@ def test_pub_batch_roundtrip():
     assert ftype == F.T_PUBB
     seq, out = F.unpack_pub_batch(frame[5:])
     assert seq == 42
-    assert out[0] == ("a/b", b"x" * 10, 1, True, False, "c1")
-    assert out[1] == ("t", b"", 0, False, False, "")
+    assert out[0] == ("a/b", b"x" * 10, 1, True, False, "c1", None)
+    assert out[1] == ("t", b"", 0, False, False, "", None)
 
 
 def test_pub_ack_roundtrip():
@@ -52,7 +52,8 @@ def test_dlv_batch_roundtrip():
     m.headers["retained"] = True
     frame = F.pack_dlv_batch([(m, [7, 9, 4000000])])
     out = F.unpack_dlv_batch(frame[5:])
-    topic, payload, qos, retain, retained, client, handles = out[0]
+    topic, payload, qos, retain, retained, client, props, handles = out[0]
+    assert props is None
     assert (topic, payload, qos, retain, retained, client) == (
         "t/1", b"p", 2, False, True, "pub"
     )
@@ -483,7 +484,7 @@ def test_fabric_seam_parks_per_subscriber_no_batch_drop():
         got = [
             (t, handles)
             for f in w.frames
-            for t, _p, _q, _r, _rt, _c, handles in F.unpack_dlv_batch(
+            for t, _p, _q, _r, _rt, _c, _pr, handles in F.unpack_dlv_batch(
                 f[5:]
             )
         ]
@@ -633,3 +634,86 @@ def test_inprocess_listener_takes_over_worker_session():
     finally:
         loop.run_until_complete(app.stop())
         loop.close()
+
+
+def test_qos0_raw_fast_lane_engaged(worker_app):
+    """QoS0 subscriptions on the worker path negotiate the raw fast
+    lane: the router ships pre-serialized PUBLISH frames (counted in
+    fabric.raw.records) and delivery still honors topics/payloads —
+    while a QoS1 subscription stays on the message path."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt.client import Client
+
+    async def run():
+        s0 = Client(client_id="fl0")
+        await s0.connect("127.0.0.1", port)
+        await s0.subscribe("fl/a", qos=0)
+        s1 = Client(client_id="fl1")
+        await s1.connect("127.0.0.1", port)
+        await s1.subscribe("fl/a", qos=1)
+        pub = Client(client_id="flp")
+        await pub.connect("127.0.0.1", port)
+        await asyncio.sleep(0.2)
+        for i in range(5):
+            await pub.publish("fl/a", b"r%d" % i, qos=0)
+        for c, name in ((s0, "s0"), (s1, "s1")):
+            got = [await c.recv(10) for _ in range(5)]
+            assert [m.payload for m in got] == [
+                b"r%d" % i for i in range(5)
+            ], name
+            assert all(m.topic == "fl/a" and m.qos == 0 for m in got)
+        assert app.broker.metrics.get("fabric.raw.records") >= 5
+        for c in (s0, s1, pub):
+            await c.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 60))
+
+
+def test_raw_batches_split_monster_fanout_and_frame_cap():
+    """pack_raw_batches splits >65535-handle fan-outs across records
+    (u16 nh) and bounds frames below the cap, like the DLV packer."""
+    buf = b"\x30\x05\x00\x01tXY"  # any opaque frame bytes
+    frames = list(F.pack_raw_batches([(buf, list(range(70_000)))],
+                                     max_body=100_000))
+    assert len(frames) >= 2
+    got = [rec for f in frames for rec in F.unpack_raw_batch(f[5:])]
+    assert all(b == buf for b, _ in got)
+    assert sum(len(h) for _, h in got) == 70_000
+    assert max(len(h) for _, h in got) <= 0xFFFF
+    # many SMALL records split below the cap (one record may exceed it)
+    small = [(buf, [i]) for i in range(30_000)]
+    sframes = list(F.pack_raw_batches(small, max_body=100_000))
+    assert len(sframes) >= 2
+    assert all(len(f) - 5 <= 100_000 + len(buf) + 300 for f in sframes)
+    assert sum(len(F.unpack_raw_batch(f[5:])) for f in sframes) == 30_000
+
+
+def test_raw_fast_lane_v5_properties_preserved(worker_app):
+    """A v5 publish with properties delivered through the raw fast lane
+    carries them (the DLV message path historically dropped publish
+    properties; the raw lane must not regress v5 clients)."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.mqtt.client import Client
+
+    async def run():
+        sub = Client(client_id="v5s", version=pkt.MQTT_V5)
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe("v5/t", qos=0)
+        pub = Client(client_id="v5p", version=pkt.MQTT_V5)
+        await pub.connect("127.0.0.1", port)
+        await asyncio.sleep(0.2)
+        await pub.publish(
+            "v5/t", b"hi", qos=0,
+            properties={"Content-Type": "text/x", "User-Property":
+                        [("k", "v")]},
+        )
+        m = await sub.recv(10)
+        assert m.payload == b"hi"
+        assert m.properties.get("Content-Type") == "text/x"
+        assert ("k", "v") in m.properties.get("User-Property", [])
+        assert app.broker.metrics.get("fabric.raw.records") >= 1
+        for c in (sub, pub):
+            await c.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 60))
