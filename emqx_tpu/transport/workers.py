@@ -50,12 +50,24 @@ class WorkerFabric:
     outbox; outboxes flush once per loop tick with one DLV record per
     message (per-subscriber QoS handling stays worker-side)."""
 
-    def __init__(self, app, uds_path: str):
+    def __init__(self, app, uds_path: str, expected_workers: int = 0):
         self.app = app
         self.broker = app.broker
         self.uds_path = uds_path
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: Dict[int, asyncio.StreamWriter] = {}
+        # boot gate: a RESTARTED router must not dispatch one worker's
+        # re-sent publish batches before ANOTHER worker's subscription
+        # replay has registered (cross-link ordering — each link's own
+        # FIFO already orders its SUBs before its PUBBs). PUBBs buffer
+        # until every expected worker reports replay_done, or the
+        # force-open timer fires (a worker lost for good must not wedge
+        # publishers).
+        self.expected_workers = expected_workers
+        self._pub_gate_open = expected_workers == 0
+        self._boot_ready: set = set()
+        self._held_pubs: List = []
+        self._gate_timer = None
         # wid -> {(full_sid, filter)}: explicit registry of the broker
         # subscriptions each worker proxies (worker-death cleanup walks
         # this, never a sid-prefix match that could catch an in-process
@@ -91,6 +103,10 @@ class WorkerFabric:
         self._server = await asyncio.start_unix_server(
             self._on_worker, path=self.uds_path
         )
+        if not self._pub_gate_open:
+            self._gate_timer = asyncio.get_running_loop().call_later(
+                10.0, self._open_pub_gate
+            )
         # the router's own CM consults us at open_session so a client
         # live on a WORKER reconnecting via an in-process listener
         # (ws/ssl) still takes its session over (node-wide emqx_cm)
@@ -141,7 +157,10 @@ class WorkerFabric:
                 elif ftype == F.T_UNSUB:
                     self._on_unsub(wid, body)
                 elif ftype == F.T_PUBB:
-                    await self._on_pub_batch(writer, body)
+                    if self._pub_gate_open:
+                        await self._on_pub_batch(writer, body)
+                    else:
+                        self._held_pubs.append((writer, body))
                 elif ftype == F.T_SESS:
                     import json
 
@@ -267,6 +286,30 @@ class WorkerFabric:
     # when session persistence is enabled). Reference:
     # emqx_cm.erl:245-273 open_session, :346-366 takeover_session.
 
+    def _open_pub_gate(self) -> None:
+        if self._gate_timer is not None:
+            self._gate_timer.cancel()
+            self._gate_timer = None
+        if self._pub_gate_open:
+            return
+        if self._held_pubs:
+            t = asyncio.get_running_loop().create_task(self._drain_held())
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+        else:
+            self._pub_gate_open = True
+
+    async def _drain_held(self) -> None:
+        # the gate stays CLOSED while draining: new PUBBs keep appending
+        # behind the held ones so per-link order is preserved
+        try:
+            while self._held_pubs:
+                writer, body = self._held_pubs.pop(0)
+                if not writer.is_closing():
+                    await self._on_pub_batch(writer, body)
+        finally:
+            self._pub_gate_open = True
+
     def _sess_reply(self, writer, r: int, sess_json, present: bool) -> None:
         if writer is not None and not writer.is_closing():
             writer.write(F.pack_json(F.T_SESS, {
@@ -284,6 +327,16 @@ class WorkerFabric:
             self._sess_park(wid, d)
         elif op == "resume_done":
             self._sess_resume_done(wid, d["cid"])
+        elif op == "replay_done":
+            # this worker's boot/reconnect flight (SUB replays etc.) is
+            # fully on the wire; once every expected worker reports in,
+            # held publish batches flow (cross-link ordering gate)
+            self._boot_ready.add(wid)
+            if (
+                not self._pub_gate_open
+                and len(self._boot_ready) >= self.expected_workers
+            ):
+                self._open_pub_gate()
         elif op == "claim":
             # link-reconnect replay: the worker re-announces its live
             # channels (the drop-path cleared their owner entries)
@@ -1330,6 +1383,9 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
         raise RuntimeError(f"worker {wid}: router fabric not reachable")
     writer.write(F.pack_frame(F.T_HELLO, wid.to_bytes(2, "little")))
     broker.attach_link(writer)
+    # boot flight complete (nothing to replay on first dial): the router
+    # holds cross-worker publish dispatch until every worker reports in
+    writer.write(F.pack_json(F.T_SESS, {"op": "replay_done"}))
 
     # a router-process blip must not drop every client on this worker
     # (the reference's layered supervision restarts subsystems without
@@ -1363,7 +1419,11 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
                         broker.on_sess(_json.loads(body))
             except (
                 asyncio.IncompleteReadError,
-                ConnectionResetError,
+                # OSError covers ConnectionResetError AND BrokenPipeError
+                # — a write racing the router's shutdown surfaces on the
+                # read waiter as EPIPE, and must trigger the re-dial, not
+                # kill the worker (and its clients) with it
+                OSError,
                 ValueError,
             ):
                 pass
@@ -1382,6 +1442,7 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
             reader, writer = nc
             writer.write(F.pack_frame(F.T_HELLO, wid.to_bytes(2, "little")))
             broker.reattach_link(writer)
+            writer.write(F.pack_json(F.T_SESS, {"op": "replay_done"}))
             broker.metrics.inc("fabric.link.reconnected")
 
     link_task = asyncio.create_task(pump_link())
@@ -1434,7 +1495,8 @@ class WorkerPool:
         base = f"emqx-tpu-fabric-{safe_bind}-{port}"
         self.uds_path = os.path.join(tempfile.gettempdir(), base + ".sock")
         self._cfg_path = os.path.join(tempfile.gettempdir(), base + ".json")
-        self.fabric = WorkerFabric(app, self.uds_path)
+        self.fabric = WorkerFabric(app, self.uds_path,
+                                   expected_workers=n_workers)
         self._procs: List = []
 
     # supervision: a crashed worker respawns (one-for-one, like the

@@ -394,7 +394,8 @@ def test_router_fabric_restart_no_qos1_loss(worker_app):
         assert not pub_task.done()  # held, not failed
 
         # ...and comes back (same UDS path, fresh process state)
-        pool.fabric = W.WorkerFabric(app, pool.uds_path)
+        pool.fabric = W.WorkerFabric(app, pool.uds_path,
+                                     expected_workers=2)
         await pool.fabric.start()
         # wait for both workers to re-dial (0.25s poll loop worker-side;
         # generous under full-suite CPU load on the 1-core box)
