@@ -237,3 +237,46 @@ async def test_lwm2m_register_over_dtls():
     finally:
         dev.close()
         await registry.unload_all()
+
+
+@async_test
+async def test_coap_pubsub_over_dtls():
+    """CoAP ps/{topic} publish over a dtls listener reaches the broker
+    (the same mixin serves all three UDP gateways; CoAP is the second
+    protocol proven over it)."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.gateway.coap import CoapGateway
+    from emqx_tpu.gateway.registry import GatewayRegistry
+    from emqx_tpu.mqtt import packet as pkt
+    from tests.test_coap import CON, POST
+
+    hooks = Hooks()
+    broker = Broker(hooks=hooks)
+    registry = GatewayRegistry(broker, hooks)
+    registry.register_type("coap", CoapGateway)
+    gw = await registry.load(
+        "coap",
+        {"port": 0, "transport": "dtls",
+         "psk": {"coap-dev": "636f6170"}},  # hex "coap"
+    )
+    got = []
+    broker.subscribe(
+        "obs", "obs", "cd/#", pkt.SubOpts(qos=0),
+        lambda m, o: got.append(m),
+    )
+    dev = DtlsCoapClient("coap-dev", b"coap")
+    try:
+        await dev.connect(gw.port)
+        dev.request(
+            CON, POST, path=("ps", "cd", "t1"),
+            queries=("clientid=coap-dev",), payload=b"over-dtls",
+        )
+        resp = await dev.recv()
+        assert (resp["code"] >> 5) == 2, resp  # 2.xx success
+        await asyncio.sleep(0.1)
+        assert got and got[0].payload == b"over-dtls"
+        assert got[0].topic == "cd/t1"
+    finally:
+        dev.close()
+        await registry.unload_all()

@@ -364,3 +364,41 @@ async def test_cluster_info_and_drain_endpoints():
                 await asyncio.open_connection("127.0.0.1", port)
     finally:
         await app.stop()
+
+
+@async_test
+async def test_retained_rest_cursor_pagination():
+    """GET /retainer/messages pages with cursor+limit (paged-read parity
+    with emqx_retainer_mnesia — a huge store must not dump in one
+    response)."""
+    from emqx_tpu.broker.message import Message
+
+    app = BrokerApp(_app_config())
+    await app.start()
+    try:
+        for i in range(250):
+            app.retainer.on_publish(
+                Message(topic=f"rp/{i:03d}", payload=b"v", retain=True)
+            )
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        got, cursor, pages = [], None, 0
+        async with aiohttp.ClientSession() as s:
+            while True:
+                url = f"{api}/retainer/messages?limit=100"
+                if cursor:
+                    url += f"&cursor={cursor}"
+                async with s.get(url) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                got.extend(body["data"])
+                pages += 1
+                assert len(body["data"]) <= 100
+                assert body["meta"]["count"] == 250
+                cursor = body["meta"]["cursor"]
+                if not body["meta"]["hasnext"]:
+                    break
+        assert pages >= 3
+        assert sorted(got) == [f"rp/{i:03d}" for i in range(250)]
+        assert len(set(got)) == 250  # no dupes across pages
+    finally:
+        await app.stop()

@@ -718,3 +718,52 @@ def test_raw_fast_lane_v5_properties_preserved(worker_app):
             await c.disconnect()
 
     loop.run_until_complete(asyncio.wait_for(run(), 60))
+
+
+def test_worker_takes_over_inprocess_session():
+    """The reverse of the fabric bridge: a client LIVE on the IN-PROCESS
+    listener reconnects via a worker — the router's session broker
+    kicks the in-process channel and hands the session (subscriptions
+    included) to the worker (node-wide emqx_cm, both directions)."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+    from emqx_tpu.mqtt.client import Client
+
+    wport, iport = _free_port(), _free_port()
+    app = BrokerApp(load_config({
+        "listeners": [
+            {"port": wport, "bind": "127.0.0.1", "workers": 2,
+             "name": "wpool"},
+            {"port": iport, "bind": "127.0.0.1", "name": "plain"},
+        ],
+        "dashboard": {"enable": False},
+        "router": {"enable_tpu": False},
+    }))
+
+    async def run():
+        await app.start()
+        await app.worker_pools[0].wait_ready()
+        a = Client(client_id="rev1", clean_start=False)
+        await a.connect("127.0.0.1", iport)  # in-process listener
+        await a.subscribe("rv/#", qos=1)
+
+        b = Client(client_id="rev1", clean_start=False)
+        await b.connect("127.0.0.1", wport)  # lands on a worker
+        assert b.connack.session_present  # took the in-process session
+        await asyncio.wait_for(a.closed.wait(), 10)
+
+        pub = Client(client_id="rv-pub")
+        await pub.connect("127.0.0.1", iport)
+        await asyncio.sleep(0.3)
+        await pub.publish("rv/t", b"crossed-back", qos=1)
+        m = await b.recv(15)
+        assert (m.topic, m.payload) == ("rv/t", b"crossed-back")
+        for c in (b, pub):
+            await c.disconnect()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(run(), 90))
+    finally:
+        loop.run_until_complete(app.stop())
+        loop.close()
