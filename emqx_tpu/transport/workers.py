@@ -90,6 +90,9 @@ class WorkerFabric:
         # worker): token -> (owner_wid, cid, reply_fn); sessions
         # mid-resume (snapshot shipped, handoff bankers still live)
         self._owner: Dict[str, int] = {}
+        # negotiated session expiry per live worker client (sent by the
+        # worker after CONNACK): worker-crash parking keys on it
+        self._owner_expiry: Dict[str, float] = {}
         self._take_pending: Dict[int, Tuple[int, str, object]] = {}
         self._next_take = 1
         self._resuming: Dict[str, dict] = {}
@@ -186,6 +189,7 @@ class WorkerFabric:
                     c for c, w in self._owner.items() if w == wid
                 ]:
                     self._owner.pop(cid, None)
+                    self._owner_expiry.pop(cid, None)
                 # takes waiting on this (now dead) owner fail fast
                 # instead of leaking / stalling requesters 30s
                 for tk in [
@@ -274,9 +278,55 @@ class WorkerFabric:
             subs.discard((full_sid, d["f"]))
 
     def _drop_worker_subs(self, wid: int) -> None:
-        """Worker died: every subscription it proxied is gone."""
-        for sid, f in self._fabric_subs.pop(wid, set()):
+        """Worker died: every subscription it proxied is gone — but
+        sessions with a positive expiry are RECONSTRUCTED and parked
+        first (subscriptions + future offline banking survive the
+        crash; in-flight/queued state died with the worker process).
+        The reference's node keeps sessions across connection-process
+        crashes the same way (the channel process dies, emqx_cm keeps
+        the session)."""
+        dropped = self._fabric_subs.pop(wid, set())
+        # (cid, filter) -> opts, harvested before the registry drops
+        crash_park: Dict[str, Dict] = {}
+        for sid, f in dropped:
+            cid = sid.split("|", 1)[1] if "|" in sid else sid
+            expiry = self._owner_expiry.get(cid, 0)
+            if expiry > 0:
+                _g, real = T.parse_share(f)
+                sub = self.broker._subs.get(real, {}).get(sid)
+                if sub is not None:
+                    crash_park.setdefault(cid, {})[f] = sub.opts
             self.broker.unsubscribe(sid, f)
+        cm = getattr(self.app, "cm", None)
+        if cm is None:
+            return
+        from emqx_tpu.broker.persistent_session import (
+            make_detached_deliverer,
+        )
+        from emqx_tpu.broker.session import Session, SessionConfig
+
+        import time as _t
+
+        for cid, subs in crash_park.items():
+            if cid in cm._detached or cm.get_channel(cid) is not None:
+                continue
+            if self._owner.get(cid) not in (None, wid):
+                # already reconnected onto ANOTHER worker before this
+                # cleanup ran: the live session wins, nothing to park
+                continue
+            scfg = getattr(
+                getattr(self.app, "config", None), "session", None
+            )
+            sess = Session(cid, scfg or SessionConfig())
+            expiry = self._owner_expiry.get(cid, 0)
+            sess.config.expiry_interval = expiry
+            sess.subscriptions = dict(subs)
+            deliver = make_detached_deliverer(sess, None, cid)
+            for f, opts in subs.items():
+                self.broker.subscribe(cid, cid, f, opts, deliver)
+            cm._detached[cid] = (sess, _t.time() + expiry)
+            self.broker.hooks.run("session.detached", cid)
+            self.broker.metrics.inc("fabric.sess.crash_parked")
 
     # -- session ops (emqx_cm parity across workers) ----------------------
     # The router process is the node-level session registry: a client
@@ -337,6 +387,10 @@ class WorkerFabric:
                 and len(self._boot_ready) >= self.expected_workers
             ):
                 self._open_pub_gate()
+        elif op == "opened":
+            # post-CONNACK: the session's negotiated expiry is final
+            self._owner[d["cid"]] = wid
+            self._owner_expiry[d["cid"]] = float(d.get("expiry", 0))
         elif op == "claim":
             # link-reconnect replay: the worker re-announces its live
             # channels (the drop-path cleared their owner entries)
@@ -344,6 +398,7 @@ class WorkerFabric:
         elif op == "closed":
             if self._owner.get(d["cid"]) == wid:
                 self._owner.pop(d["cid"], None)
+                self._owner_expiry.pop(d["cid"], None)
 
     def _sess_open(self, wid: int, writer, d: dict) -> None:
         from emqx_tpu.storage.codec import session_to_json
@@ -449,6 +504,7 @@ class WorkerFabric:
         cid = d["cid"]
         if self._owner.get(cid) == wid:
             self._owner.pop(cid, None)
+        self._owner_expiry.pop(cid, None)
         cm = getattr(self.app, "cm", None)
         if cm is None:
             return
@@ -935,6 +991,13 @@ class WorkerBroker:
         }))
         return fut
 
+    def sess_opened(self, cid: str, expiry: float) -> None:
+        """Post-CONNACK: tell the router this session's negotiated
+        expiry (worker-crash parking keys on it)."""
+        self._send(F.pack_json(F.T_SESS, {
+            "op": "opened", "cid": cid, "expiry": float(expiry),
+        }))
+
     def sess_park(self, cid: str, sess_json, expiry: float) -> None:
         self._send(F.pack_json(F.T_SESS, {
             "op": "park", "cid": cid, "sess": sess_json,
@@ -1240,6 +1303,15 @@ class WorkerChannelManager:
         self.broker = broker
         broker.cm = self
         self._channels: Dict[str, object] = {}
+        # after CONNACK the negotiated expiry is final (v5 property /
+        # v4 clean_start zeroing applied): announce it for crash parking
+        broker.hooks.add(
+            "client.connected",
+            lambda ci, ch: broker.sess_opened(
+                ch.client_id, ch.session.config.expiry_interval
+            ) if getattr(ch, "session", None) is not None else None,
+            tag="worker_cm.opened",
+        )
         # transient only (mid-takeover stash); authoritative parking
         # lives in the ROUTER's detached store
         self._detached: Dict[str, Tuple] = {}

@@ -767,3 +767,53 @@ def test_worker_takes_over_inprocess_session():
     finally:
         loop.run_until_complete(app.stop())
         loop.close()
+
+
+def test_worker_crash_parks_persistent_sessions(worker_app):
+    """A WORKER process crash must not lose its clients' persistent
+    sessions: the router reconstructs them from its subscription
+    registry and parks them (subscriptions + future offline banking
+    survive; in-flight state honestly dies with the process) — the
+    reference's emqx_cm keeps sessions across connection-process
+    crashes the same way."""
+    loop, app, port = worker_app
+    from emqx_tpu.mqtt.client import Client
+
+    async def run():
+        c = Client(client_id="cp1", clean_start=False)  # v4 persistent
+        await c.connect("127.0.0.1", port)
+        await c.subscribe("cp/#", qos=1)
+        await asyncio.sleep(0.3)  # 'opened' (expiry) reaches the router
+
+        # kill every worker process: its clients die with it
+        for p in app.worker_pools[0]._procs:
+            p.kill()
+        for _ in range(100):
+            if "cp1" in app.cm._detached:
+                break
+            await asyncio.sleep(0.1)
+        assert "cp1" in app.cm._detached  # crash-parked at the router
+        assert app.broker.metrics.get("fabric.sess.crash_parked") >= 1
+
+        # offline publish banks into the reconstructed session
+        # (retry: worker respawn takes a supervisor tick + bind)
+        pub = Client(client_id="cp-pub")
+        for _ in range(60):
+            try:
+                await pub.connect("127.0.0.1", port)
+                break
+            except OSError:
+                await asyncio.sleep(0.5)
+        await pub.publish("cp/news", b"after-crash", qos=1)
+        await asyncio.sleep(0.3)
+
+        # reconnect: session present, banked message delivered
+        c2 = Client(client_id="cp1", clean_start=False)
+        await c2.connect("127.0.0.1", port)
+        assert c2.connack.session_present
+        m = await c2.recv(15)
+        assert (m.topic, m.payload) == ("cp/news", b"after-crash")
+        await c2.disconnect()
+        await pub.disconnect()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 90))
