@@ -817,3 +817,77 @@ def test_worker_crash_parks_persistent_sessions(worker_app):
         await pub.disconnect()
 
     loop.run_until_complete(asyncio.wait_for(run(), 90))
+
+
+def test_worker_session_survives_full_broker_restart(tmp_path):
+    """A session parked from a WORKER listener rides the shared
+    persistence layer: snapshot + restore across a FULL broker restart,
+    then resume from a worker of the NEW broker instance (the verdict's
+    'persistent-session WAL for worker sessions', proven end to end)."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+    from emqx_tpu.mqtt.client import Client
+
+    port = _free_port()
+
+    def mk_app():
+        return BrokerApp(load_config({
+            "listeners": [
+                {"port": port, "bind": "127.0.0.1", "workers": 2}
+            ],
+            "dashboard": {"enable": False},
+            "router": {"enable_tpu": False},
+            "durability": {"enable": True, "data_dir": str(tmp_path)},
+        }))
+
+    loop = asyncio.new_event_loop()
+    app = mk_app()
+
+    async def phase1():
+        await app.start()
+        await app.worker_pools[0].wait_ready()
+        c = Client(client_id="wps1", clean_start=False)
+        await c.connect("127.0.0.1", port)
+        await c.subscribe("wp/#", qos=1)
+        await c.disconnect()
+        for _ in range(100):
+            if "wps1" in app.cm._detached:
+                break
+            await asyncio.sleep(0.05)
+        assert "wps1" in app.cm._detached
+        # bank an offline message BEFORE the restart
+        pub = Client(client_id="wp-pub")
+        await pub.connect("127.0.0.1", port)
+        await pub.publish("wp/x", b"pre-restart", qos=1)
+        await asyncio.sleep(0.3)
+        await pub.disconnect()
+        await app.stop()  # flushes the session snapshot
+
+    loop.run_until_complete(asyncio.wait_for(phase1(), 90))
+
+    app2 = mk_app()
+
+    async def phase2():
+        await app2.start()
+        await app2.worker_pools[0].wait_ready()
+        assert "wps1" in app2.cm._detached  # restored from disk
+        c2 = Client(client_id="wps1", clean_start=False)
+        await c2.connect("127.0.0.1", port)
+        assert c2.connack.session_present
+        m = await c2.recv(15)
+        assert (m.topic, m.payload) == ("wp/x", b"pre-restart")
+        # still subscribed after restart+resume
+        pub = Client(client_id="wp-pub2")
+        await pub.connect("127.0.0.1", port)
+        await asyncio.sleep(0.3)
+        await pub.publish("wp/y", b"post-restart", qos=1)
+        m = await c2.recv(15)
+        assert m.payload == b"post-restart"
+        await c2.disconnect()
+        await pub.disconnect()
+
+    try:
+        loop.run_until_complete(asyncio.wait_for(phase2(), 90))
+    finally:
+        loop.run_until_complete(app2.stop())
+        loop.close()
