@@ -1267,6 +1267,114 @@ def bench_e2e() -> dict:
     }
 
 
+def hotpath_stats() -> None:
+    """`--hotpath-stats`: drive a small in-process publish workload through
+    the real ingest -> device-route -> dispatch pipeline, then print ONE
+    JSON line of flight-recorder numbers (batch_p50/p99 from the new
+    histograms, fallback rate, batch occupancy). This is the before/after
+    read for hot-path perf PRs — same series the /metrics/hotpath REST
+    endpoint and the Prometheus scrape export on a live broker."""
+    import asyncio
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.mqtt import packet as pkt
+
+    N_SUBS = 32
+    N_MSGS = 4096
+    MAX_BATCH = 256
+
+    async def run():
+        broker = Broker(router=Router(min_tpu_batch=8), hooks=Hooks())
+        sink = []
+        for i in range(N_SUBS):
+            broker.subscribe(
+                f"s{i}", f"c{i}", f"hot/{i}/+", pkt.SubOpts(),
+                lambda m, o: sink.append(m.topic),
+            )
+        ing = BatchIngest(broker, max_batch=MAX_BATCH, window_us=500)
+        broker.ingest = ing
+        ing.start()
+        # warm the compile outside the recorded window? No — the flight
+        # recorder's job is to SHOW the cold-start spike; report both by
+        # warming first and resetting nothing (p99 includes the compile
+        # only if it landed inside the run, exactly like a live broker)
+        await ing.submit(Message(topic="hot/0/warm", payload=b"w"))
+        t0 = time.perf_counter()
+        futs = [
+            ing.enqueue(
+                Message(topic=f"hot/{i % N_SUBS}/x", payload=b"p")
+            )
+            for i in range(N_MSGS)
+        ]
+        counts = await asyncio.gather(*futs)
+        wall = time.perf_counter() - t0
+        await ing.stop()
+        m = broker.metrics
+
+        def hist_ms(name):
+            h = m.histogram(name)
+            if h is None or h.count == 0:
+                return None
+            return {
+                "count": h.count,
+                "p50_ms": round(h.p50 * 1e3, 3),
+                "p99_ms": round(h.p99 * 1e3, 3),
+            }
+
+        def hist_raw(name):
+            h = m.histogram(name)
+            if h is None or h.count == 0:
+                return None
+            return {
+                "count": h.count,
+                "mean": round(h.sum / h.count, 3),
+                "p50": round(h.p50, 3),
+                "p99": round(h.p99, 3),
+            }
+
+        dev = m.get("messages.routed.device")
+        fb = m.get("messages.routed.device_fallback")
+        batch_lat = m.histogram("router.device.seconds")
+        print(
+            json.dumps(
+                {
+                    "metric": "hotpath_flight_recorder",
+                    "value": round(
+                        batch_lat.p50 * 1e3, 3
+                    ) if batch_lat and batch_lat.count else None,
+                    "unit": "batch_p50_ms",
+                    "detail": {
+                        "messages": N_MSGS,
+                        "deliveries": int(sum(counts)),
+                        "msgs_per_s": round(N_MSGS / wall, 1),
+                        "batch_p50_ms": hist_ms("router.device.seconds"),
+                        "ingest_settle": hist_ms("ingest.settle.seconds"),
+                        "ingest_window_wait": hist_ms(
+                            "ingest.window.wait.seconds"
+                        ),
+                        "batch_size": hist_raw("ingest.batch.size"),
+                        "batch_occupancy": hist_raw(
+                            "ingest.batch.occupancy"
+                        ),
+                        "pipeline_depth": m.gauge("ingest.pipeline.depth"),
+                        "routed_device": dev,
+                        "routed_device_fallback": fb,
+                        "fallback_rate": round(fb / (dev + fb), 5)
+                        if dev + fb
+                        else None,
+                        "dispatch_fanout": hist_raw("dispatch.fanout"),
+                    },
+                }
+            )
+        )
+
+    asyncio.run(run())
+
+
 def run_one(name: str) -> None:
     """Child-process entry: one config, one JSON line on stdout."""
     if name != "_e2e_driver":
@@ -1303,6 +1411,9 @@ def main() -> None:
     import subprocess
 
     if len(sys.argv) > 1:
+        if sys.argv[1] == "--hotpath-stats":
+            hotpath_stats()
+            return
         run_one(sys.argv[1])
         return
 

@@ -326,7 +326,7 @@ class BrokerApp:
         self.authz = attach_authz(self.hooks, c)
 
         # observability (reference L5 aux: SURVEY.md §5.1/§5.5)
-        from emqx_tpu.observe.alarm import AlarmManager
+        from emqx_tpu.observe.alarm import AlarmManager, FallbackRateWatch
         from emqx_tpu.observe.event_message import EventMessage
         from emqx_tpu.observe.exporters import StatsdExporter
         from emqx_tpu.observe.monitors import OsMon, SysMon, VmMon
@@ -343,6 +343,17 @@ class BrokerApp:
             validity_period=ob.alarm_validity_period,
         )
         self.transport_ctx.alarms = self.alarms
+        self.fallback_watch = (
+            FallbackRateWatch(
+                self.alarms,
+                self.broker.metrics,
+                threshold=ob.tpu_fallback_alarm_threshold,
+                window=ob.tpu_fallback_alarm_window,
+                min_rows=ob.tpu_fallback_alarm_min_rows,
+            )
+            if ob.tpu_fallback_alarm_enable and c.router.enable_tpu
+            else None
+        )
         self.sys_mon = SysMon(self.alarms) if ob.sys_mon_enable else None
         self.os_mon = OsMon(self.alarms) if ob.os_mon_enable else None
         self.vm_mon = VmMon(self.alarms) if ob.vm_mon_enable else None
@@ -880,6 +891,9 @@ class BrokerApp:
                     self.vm_mon.check(now)
                 self.slow_subs.sweep(now)
                 self.alarms.sweep(now)
+                if self.fallback_watch is not None:
+                    self.fallback_watch.check(now)
+                self.trace.sweep(now)
                 self.license.tick(now)
                 self.topic_metrics.tick_rates(now)
                 if (

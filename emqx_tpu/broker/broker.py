@@ -28,6 +28,7 @@ from emqx_tpu.broker.router import Router
 from emqx_tpu.broker.shared_sub import SharedSub
 from emqx_tpu.mqtt import packet as pkt
 from emqx_tpu.ops import topics as T
+from emqx_tpu.utils.tracepoints import tp
 
 # deliverer: called with (msg, subopts); returns True if accepted
 Deliverer = Callable[[Message, pkt.SubOpts], None]
@@ -374,6 +375,7 @@ class Broker:
                 grouptab=self.grouptab,
                 share_strategy=self.shared.strategy,
                 mesh=self.mesh,
+                metrics=self.metrics,
             )
         return self._device
 
@@ -402,6 +404,7 @@ class Broker:
         for i, m in enumerate(msgs):
             if flags[i]:
                 fell_back += 1
+                tp("dispatch.fallback", topic=m.topic)
                 n = self._route_dispatch(m, r.match(m.topic))
             else:
                 # matched rows are SPARSE (-1 holes between engines)
@@ -423,6 +426,7 @@ class Broker:
         if fell_back:
             self.metrics.inc("messages.routed.device_fallback", fell_back)
         self.metrics.inc("messages.routed.device", len(msgs) - fell_back)
+        tp("dispatch.batch", n=len(msgs), fallback=fell_back)
         return out
 
     def _dispatch_row(
@@ -479,6 +483,7 @@ class Broker:
                     and T.match(msg.topic, name)
                 ):
                     n += self.shared.dispatch_groups(name, msg)
+        self.metrics.observe("dispatch.fanout", n)
         if n:
             self.metrics.inc("messages.delivered", n)
         return n
@@ -526,6 +531,7 @@ class Broker:
                         continue
                     n += self._deliver_one(sub, msg)
             n += self.shared.dispatch_groups(f, msg)
+        self.metrics.observe("dispatch.fanout", n)
         if n:
             self.metrics.inc("messages.delivered", n)
         return n

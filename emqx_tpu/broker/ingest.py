@@ -16,16 +16,25 @@ turning N concurrent publishes into one route_step kernel launch
 Backpressure: `submit` awaits the flush result, so a publisher's PUBACK
 reflects actual dispatch; the pending list is bounded only by connection
 count x inflight windows, which the per-connection limiters already cap.
+
+Flight recorder: every latency/throughput tradeoff this loop makes is
+recorded into the broker's metrics (docs/observability.md) — batch size and
+occupancy, window hold time, pipeline depth, per-message enqueue->settle
+latency, and launch/dispatch failures — plus `ingest.launch`/`ingest.settle`
+tracepoints keyed by batch seq for causal assertions in tests.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import List, Optional, Tuple
 
 from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.utils.tracepoints import tp
 
 log = logging.getLogger("emqx_tpu.ingest")
 
@@ -48,10 +57,13 @@ class BatchIngest:
         # compute). Settlement stays strictly FIFO so per-publisher
         # delivery order holds across batches.
         self.pipeline = max(1, pipeline)
-        self._pending: List[Tuple[Message, asyncio.Future]] = []
-        self._inflight: deque = deque()  # (batch, awaitable)
+        self.metrics: Metrics = getattr(broker, "metrics", None) or Metrics()
+        # (msg, puback future, enqueue perf_counter timestamp)
+        self._pending: List[Tuple[Message, asyncio.Future, float]] = []
+        self._inflight: deque = deque()  # (seq, batch, awaitable)
         self._event = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
+        self._seq = 0
         self.running = False
 
     def start(self) -> None:
@@ -71,8 +83,8 @@ class BatchIngest:
         # drain launched-but-unsettled batches first (FIFO), then
         # anything still pending, so no publisher hangs on shutdown
         while self._inflight:
-            batch, pd = self._inflight.popleft()
-            await self._finish(batch, pd.complete())
+            seq, batch, pd = self._inflight.popleft()
+            await self._finish(seq, batch, pd.complete())
         while self._pending:
             batch = self._pending[: self.max_batch]
             del self._pending[: self.max_batch]
@@ -82,30 +94,45 @@ class BatchIngest:
         """Enqueue one folded message; the future resolves with its
         delivery count when the batch flushes."""
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((msg, fut))
+        self._pending.append((msg, fut, time.perf_counter()))
         self._event.set()
         return fut
 
     async def submit(self, msg: Message) -> int:
         return await self.enqueue(msg)
 
-    async def _settle(self, batch: List[Tuple[Message, asyncio.Future]]) -> None:
+    async def _settle(self, batch) -> None:
+        seq = self._next_seq(len(batch))
         await self._finish(
-            batch, self.broker.adispatch_begin([m for m, _ in batch])
+            seq, batch, self.broker.adispatch_begin([m for m, _, _ in batch])
         )
 
-    async def _finish(self, batch, aw) -> None:
+    def _next_seq(self, n: int) -> int:
+        seq = self._seq
+        self._seq += 1
+        self.metrics.observe("ingest.batch.size", n)
+        self.metrics.observe("ingest.batch.occupancy", n / self.max_batch)
+        tp("ingest.launch", batch=seq, n=n)
+        return seq
+
+    async def _finish(self, seq: int, batch, aw) -> None:
         try:
             results = await aw
         except Exception as e:  # noqa: BLE001 — flusher must survive
             log.exception("batch dispatch failed; failing %d publishes", len(batch))
-            for _, fut in batch:
+            self.metrics.inc("ingest.dispatch.errors")
+            for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
             return
-        for (_, fut), n in zip(batch, results):
+        now = time.perf_counter()
+        for (_, fut, _), n in zip(batch, results):
             if not fut.done():
                 fut.set_result(n)
+        self.metrics.observe_many(
+            "ingest.settle.seconds", [now - t0 for _, _, t0 in batch]
+        )
+        tp("ingest.settle", batch=seq, n=len(batch))
 
     def _engage_threshold(self) -> int:
         # below this pending count the device path won't engage anyway
@@ -127,7 +154,11 @@ class BatchIngest:
                 and len(self._pending) < self.max_batch
             ):
                 # real concurrency: hold the window open to fill the batch
+                t0 = time.perf_counter()
                 await asyncio.sleep(self.window_s)
+                self.metrics.observe(
+                    "ingest.window.wait.seconds", time.perf_counter() - t0
+                )
             # while a dispatch is in flight, only launch another for a
             # FULL batch: eagerly draining small batches would multiply
             # device round-trips and shrink per-dispatch amortization
@@ -145,24 +176,29 @@ class BatchIngest:
                 # (pd.complete()), in FIFO order — pd.ready is the
                 # side-effect-free pacing signal (per-publisher
                 # cross-batch ordering).
+                seq = self._next_seq(len(batch))
                 try:
                     pd = self.broker.adispatch_begin(
-                        [m for m, _ in batch]
+                        [m for m, _, _ in batch]
                     )
                 except Exception as e:  # noqa: BLE001 — flusher survives
                     log.exception("batch launch failed")
-                    for _, fut in batch:
+                    self.metrics.inc("ingest.launch.errors")
+                    for _, fut, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
                 else:
-                    self._inflight.append((batch, pd))
+                    self._inflight.append((seq, batch, pd))
+                    self.metrics.gauge_set(
+                        "ingest.pipeline.depth", len(self._inflight)
+                    )
             if not self._inflight:
                 if not self._pending:
                     self._event.clear()
                 continue
             if len(self._inflight) >= self.pipeline:
-                b, pd = self._inflight.popleft()
-                await self._finish(b, pd.complete())
+                seq, b, pd = self._inflight.popleft()
+                await self._finish(seq, b, pd.complete())
             elif not batch or not self._pending:
                 # dispatch in flight, nothing launchable: settle when
                 # the device work completes OR re-check the moment new
@@ -170,7 +206,7 @@ class BatchIngest:
                 # event is cleared first so only NEW enqueues wake us —
                 # otherwise a partial backlog would busy-spin this loop.
                 self._event.clear()
-                oldest_ready = self._inflight[0][1].ready
+                oldest_ready = self._inflight[0][2].ready
                 ev = asyncio.ensure_future(self._event.wait())
                 try:
                     await asyncio.wait(
@@ -181,5 +217,5 @@ class BatchIngest:
                     if not ev.done():
                         ev.cancel()
                 if oldest_ready.done():
-                    b, pd = self._inflight.popleft()
-                    await self._finish(b, pd.complete())
+                    seq, b, pd = self._inflight.popleft()
+                    await self._finish(seq, b, pd.complete())

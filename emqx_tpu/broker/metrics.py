@@ -1,22 +1,204 @@
-"""Broker metrics: counters + gauges.
+"""Broker metrics: counters + gauges + fixed-bucket histograms.
 
 Parity with the reference's counter families (apps/emqx/src/emqx_metrics.erl:
 89-104: bytes/packets/messages/deliveries; emqx_stats.erl gauges). Names use
 the reference's dotted style so the management API and Prometheus exporter
-surface familiar series."""
+surface familiar series.
+
+Two additions over the reference's flat counter tables:
+
+- a fixed-bucket `Histogram` (count/sum/cumulative buckets, lock-safe,
+  p50/p95/p99 accessors) for the hot-path flight recorder — ingest batch
+  occupancy, device match latency, dispatch fan-out;
+- an explicit metric-kind REGISTRY: every series name is declared once with
+  its kind (counter | gauge | histogram), so the exporters render `# TYPE`
+  lines from declarations instead of guessing from name substrings, and
+  `tools/check_metric_names.py` can statically reject typo'd series names.
+"""
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import defaultdict
-from typing import Dict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# shared bucket ladders (upper bounds; +Inf is implicit)
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+SIZE_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+)
+RATIO_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0,
+)
+FANOUT_BUCKETS: Tuple[float, ...] = (
+    0, 1, 2, 4, 8, 16, 32, 64, 256, 1024, 4096,
+)
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str  # COUNTER | GAUGE | HISTOGRAM
+    help: str = ""
+    # histogram-only: upper bucket bounds; None => LATENCY_BUCKETS
+    buckets: Optional[Tuple[float, ...]] = None
+    # histogram-only: "seconds" lets the StatsD exporter render timers
+    unit: str = ""
+
+
+_REGISTRY: Dict[str, MetricSpec] = {}
+
+
+def declare(
+    name: str,
+    kind: str,
+    help: str = "",
+    buckets: Optional[Sequence[float]] = None,
+    unit: str = "",
+) -> MetricSpec:
+    """Register a series name with its kind. Re-declaring with the same
+    kind is a no-op; a conflicting kind is a programming error."""
+    if kind not in (COUNTER, GAUGE, HISTOGRAM):
+        raise ValueError(f"unknown metric kind {kind!r}")
+    prev = _REGISTRY.get(name)
+    if prev is not None:
+        if prev.kind != kind:
+            raise ValueError(
+                f"metric {name!r} re-declared as {kind}, was {prev.kind}"
+            )
+        return prev
+    s = MetricSpec(
+        name=name,
+        kind=kind,
+        help=help,
+        buckets=tuple(buckets) if buckets is not None else None,
+        unit=unit,
+    )
+    _REGISTRY[name] = s
+    return s
+
+
+def spec(name: str) -> Optional[MetricSpec]:
+    return _REGISTRY.get(name)
+
+
+def kind_of(name: str) -> Optional[str]:
+    s = _REGISTRY.get(name)
+    return s.kind if s is not None else None
+
+
+def registry() -> Dict[str, MetricSpec]:
+    """Snapshot of every declared series (tools/check_metric_names.py)."""
+    return dict(_REGISTRY)
+
+
+class Histogram:
+    """Fixed-bucket histogram: counts per upper bound + sum + total count.
+
+    Prometheus-shaped (cumulative `_bucket{le=...}` + `_sum`/`_count`),
+    lock-safe (`observe` runs from executor threads on the device-dispatch
+    path). Percentiles interpolate linearly inside the landing bucket —
+    exact enough for p50/p95/p99 dashboards, never a per-sample store.
+    """
+
+    __slots__ = ("bounds", "_counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS):
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def observe_many(self, values: Sequence[float]) -> None:
+        """Batch observe under one lock acquisition (settle loops record
+        thousands of per-message latencies per batch)."""
+        if not len(values):
+            return
+        idxs = [bisect.bisect_left(self.bounds, v) for v in values]
+        with self._lock:
+            for i in idxs:
+                self._counts[i] += 1
+            self.sum += float(sum(values))
+            self.count += len(values)
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]. 0.0 when empty; the last finite bound when the
+        quantile lands in the +Inf overflow bucket."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            prev_cum = cum
+            cum += c
+            if cum >= rank:
+                if i >= len(self.bounds):  # +Inf bucket
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - prev_cum) / c if c else 1.0
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self.bounds[-1]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(0.50)
+
+    @property
+    def p95(self) -> float:
+        return self.percentile(0.95)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(0.99)
+
+    def snapshot(self) -> Dict:
+        """-> {"count", "sum", "buckets": [(le, cumulative_count), ...]}
+        with a final (inf, count) entry — exactly the exposition shape."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+            s = self.sum
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for le, c in zip(self.bounds, counts):
+            cum += c
+            out.append((le, cum))
+        out.append((float("inf"), total))
+        return {"count": total, "sum": s, "buckets": out}
 
 
 class Metrics:
     def __init__(self) -> None:
         self._counters: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self.started_at = time.time()
 
@@ -28,17 +210,159 @@ class Metrics:
         return self._counters.get(name, 0)
 
     def gauge_set(self, name: str, value: float) -> None:
-        self._gauges[name] = value
+        with self._lock:
+            self._gauges[name] = value
 
     def gauge(self, name: str) -> float:
-        return self._gauges.get(name, 0.0)
+        with self._lock:
+            return self._gauges.get(name, 0.0)
+
+    # -- histograms --------------------------------------------------------
+    def _histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.get(name)
+                if h is None:
+                    s = _REGISTRY.get(name)
+                    h = Histogram(
+                        s.buckets
+                        if s is not None and s.buckets is not None
+                        else LATENCY_BUCKETS
+                    )
+                    self._histograms[name] = h
+        return h
+
+    def observe(self, name: str, value: float) -> None:
+        self._histogram(name).observe(value)
+
+    def observe_many(self, name: str, values: Sequence[float]) -> None:
+        self._histogram(name).observe_many(values)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        return self._histograms.get(name)
+
+    def histograms(self) -> Dict[str, Dict]:
+        """name -> Histogram.snapshot() for every recorded histogram."""
+        with self._lock:
+            items = list(self._histograms.items())
+        return {name: h.snapshot() for name, h in items}
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
-        out.update(self._gauges)
+            out.update(self._gauges)
         out["uptime_seconds"] = time.time() - self.started_at
         return out
 
 
 default_metrics = Metrics()
+
+
+# -- series declarations ---------------------------------------------------
+# Every name passed to Metrics.inc/gauge_set/observe anywhere in emqx_tpu/
+# must be declared here (enforced by tools/check_metric_names.py, run as a
+# tier-1 test). Grouped by subsystem.
+
+# packets / messages (emqx_metrics.erl families)
+declare("packets.sent", COUNTER, "MQTT packets written to clients")
+declare("packets.received", COUNTER, "MQTT packets read from clients")
+declare("messages.received", COUNTER, "messages entering dispatch")
+declare("messages.delivered", COUNTER, "deliveries handed to subscribers")
+declare("messages.dropped", COUNTER, "messages dropped before dispatch")
+declare("messages.dropped.no_subscribers", COUNTER)
+declare("messages.dropped.not_authorized", COUNTER)
+declare("messages.dispatch_error", COUNTER)
+declare("messages.routed.device", COUNTER,
+        "batch rows routed by the device kernel")
+declare("messages.routed.device_fallback", COUNTER,
+        "batch rows the device flagged; routed by the CPU trie")
+declare("messages.forward.failed", COUNTER)
+declare("delivery.errors", COUNTER)
+
+# admission / overload
+declare("limiter.refused.connection", COUNTER)
+declare("limiter.dropped.message_routing", COUNTER)
+declare("olp.refused", COUNTER)
+declare("node.drained", COUNTER)
+
+# worker fabric (transport/workers.py)
+declare("fabric.sess.crash_parked", COUNTER)
+declare("fabric.sess.resumes", COUNTER)
+declare("fabric.sess.takeovers", COUNTER)
+declare("fabric.sess.decode_errors", COUNTER)
+declare("fabric.flush.errors", COUNTER)
+declare("fabric.parked.dropped", COUNTER)
+declare("fabric.parked.replayed", COUNTER)
+declare("fabric.puback.timeouts", COUNTER)
+declare("fabric.raw.records", COUNTER)
+declare("fabric.link.lost", COUNTER)
+declare("fabric.link.reconnected", COUNTER)
+declare("fabric.worker.crash_loop", COUNTER)
+declare("fabric.worker.respawns", COUNTER)
+
+# cluster
+declare("cluster.nodedown.routes_purged", COUNTER)
+declare("cluster.retain.bootstrap_failed", COUNTER)
+declare("cluster.retain.dump_truncated", COUNTER)
+
+# gauges (emqx_stats.erl analogs + monitor extras)
+declare("connections.count", GAUGE)
+declare("subscriptions.count", GAUGE)
+declare("topics.count", GAUGE)
+declare("retained.count", GAUGE)
+declare("delayed.count", GAUGE)
+declare("sessions.restored", GAUGE)
+declare("cpu.usage", GAUGE)
+declare("mem.usage", GAUGE)
+declare("tasks.count", GAUGE)
+declare("uptime_seconds", GAUGE)
+
+# -- hot-path flight recorder (ingest -> matcher -> dispatch) --------------
+declare("ingest.batch.size", HISTOGRAM,
+        "messages per launched ingest batch", buckets=SIZE_BUCKETS)
+declare("ingest.batch.occupancy", HISTOGRAM,
+        "launched batch size / max_batch", buckets=RATIO_BUCKETS)
+declare("ingest.window.wait.seconds", HISTOGRAM,
+        "time the adaptive batch window was held open",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("ingest.settle.seconds", HISTOGRAM,
+        "per-message enqueue -> settle (PUBACK-visible) latency",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("ingest.pipeline.depth", GAUGE,
+        "device dispatches in flight after the last launch")
+declare("ingest.launch.errors", COUNTER,
+        "batch launches that raised before reaching the device")
+declare("ingest.dispatch.errors", COUNTER,
+        "batch dispatches that raised at settle time")
+
+declare("matcher.rows", COUNTER, "topic rows offered to TpuMatcher")
+declare("matcher.batch.size", HISTOGRAM, buckets=SIZE_BUCKETS)
+declare("matcher.device.seconds", HISTOGRAM,
+        "TpuMatcher device match wall time (launch + readback)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("matcher.sync.seconds", HISTOGRAM,
+        "DeviceDeltaSync upload time (full or delta)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("matcher.fallback.rows", COUNTER,
+        "rows flagged to the CPU trie (any cause)")
+declare("matcher.fallback.rows.too_deep", COUNTER,
+        "rows whose topic exceeds MatcherConfig.max_levels")
+declare("matcher.fallback.rows.frontier_overflow", COUNTER,
+        "rows whose NFA frontier overflowed MatcherConfig.frontier")
+declare("matcher.fallback.rows.match_overflow", COUNTER,
+        "rows with more matches than MatcherConfig.max_matches")
+declare("matcher.fallback.rows.too_long", COUNTER,
+        "rows whose topic exceeds MatcherConfig.max_bytes")
+
+declare("router.batch.size", HISTOGRAM,
+        "topic rows per serving-path device batch", buckets=SIZE_BUCKETS)
+declare("router.device.seconds", HISTOGRAM,
+        "serving-path route_step wall time (launch + readback)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("router.sync.seconds", HISTOGRAM,
+        "serving-path table snapshot + delta upload time",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+
+declare("dispatch.fanout", HISTOGRAM,
+        "deliveries per dispatched message", buckets=FANOUT_BUCKETS)

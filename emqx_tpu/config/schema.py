@@ -309,6 +309,15 @@ class ObserveConfig:
     os_mon_enable: bool = True
     vm_mon_enable: bool = True
     sys_mon_enable: bool = True
+    # hot-path flight recorder: alarm when the TPU route path's
+    # fallback-row rate (device-flagged rows routed by the CPU trie)
+    # exceeds the threshold over a sliding window — sustained fallback
+    # means the fast path has degraded to per-message CPU matching
+    # (observe/alarm.py FallbackRateWatch)
+    tpu_fallback_alarm_enable: bool = True
+    tpu_fallback_alarm_threshold: float = 0.2
+    tpu_fallback_alarm_window: float = 10.0
+    tpu_fallback_alarm_min_rows: int = 64
 
 
 @dataclass
@@ -562,6 +571,10 @@ def _validate(cfg: AppConfig) -> None:
             )
     if cfg.authz.deny_action not in ("ignore", "disconnect"):
         raise ConfigError("authz.deny_action must be ignore|disconnect")
+    if not 0.0 < cfg.observe.tpu_fallback_alarm_threshold <= 1.0:
+        raise ConfigError(
+            "observe.tpu_fallback_alarm_threshold must be in (0, 1]"
+        )
     if not 0 <= cfg.mqtt.max_qos_allowed <= 2:
         raise ConfigError("mqtt.max_qos_allowed must be 0..2")
     for r in cfg.rules:

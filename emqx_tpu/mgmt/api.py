@@ -45,6 +45,9 @@ ROUTES = [
      "Drain this node: stop accepting, park/hand off sessions "
      "(rolling-upgrade orchestration)", "node"),
     ("get", "/api/v5/metrics", "metrics", "Counter metrics", "metrics"),
+    ("get", "/api/v5/metrics/hotpath", "metrics_hotpath",
+     "Hot-path flight recorder: ingest/matcher/dispatch p50/p99, "
+     "fallback rate, batch occupancy", "metrics"),
     ("get", "/api/v5/stats", "stats", "Gauge statistics", "metrics"),
     ("get", "/api/v5/clients", "clients", "List connected clients", "clients"),
     ("get", "/api/v5/clients/{clientid}", "client_one", "One client", "clients"),
@@ -295,6 +298,77 @@ class MgmtApi:
 
     async def metrics(self, request):
         return web.json_response(self.broker.metrics.snapshot())
+
+    async def metrics_hotpath(self, request):
+        """Flight-recorder summary of the ingest -> matcher -> dispatch
+        pipeline: histogram percentiles, fallback rates, batch occupancy
+        (docs/observability.md). The before/after read for perf PRs."""
+        m = self.broker.metrics
+
+        def hist(name, scale=1.0):
+            h = m.histogram(name)
+            if h is None or h.count == 0:
+                return None
+            return {
+                "count": h.count,
+                "mean": (h.sum / h.count) * scale,
+                "p50": h.p50 * scale,
+                "p95": h.p95 * scale,
+                "p99": h.p99 * scale,
+            }
+
+        routed_dev = m.get("messages.routed.device")
+        routed_fb = m.get("messages.routed.device_fallback")
+        routed_total = routed_dev + routed_fb
+        occ = m.histogram("ingest.batch.occupancy")
+        out = {
+            "ingest": {
+                "batch_size": hist("ingest.batch.size"),
+                "batch_occupancy_mean": (
+                    occ.sum / occ.count if occ and occ.count else None
+                ),
+                "window_wait_ms": hist("ingest.window.wait.seconds", 1e3),
+                "settle_ms": hist("ingest.settle.seconds", 1e3),
+                "pipeline_depth": m.gauge("ingest.pipeline.depth"),
+                "launch_errors": m.get("ingest.launch.errors"),
+                "dispatch_errors": m.get("ingest.dispatch.errors"),
+            },
+            "matcher": {
+                "device_ms": hist("matcher.device.seconds", 1e3),
+                "sync_ms": hist("matcher.sync.seconds", 1e3),
+                "batch_size": hist("matcher.batch.size"),
+                "rows": m.get("matcher.rows"),
+                "fallback_rows": m.get("matcher.fallback.rows"),
+                "fallback_by_cause": {
+                    cause: m.get(f"matcher.fallback.rows.{cause}")
+                    for cause in (
+                        "too_deep",
+                        "frontier_overflow",
+                        "match_overflow",
+                        "too_long",
+                    )
+                },
+            },
+            "router": {
+                "device_ms": hist("router.device.seconds", 1e3),
+                "sync_ms": hist("router.sync.seconds", 1e3),
+                "batch_size": hist("router.batch.size"),
+            },
+            "dispatch": {
+                "fanout": hist("dispatch.fanout"),
+                "routed_device": routed_dev,
+                "routed_device_fallback": routed_fb,
+                "fallback_rate": (
+                    routed_fb / routed_total if routed_total else None
+                ),
+            },
+            "alarms": {
+                "tpu_fallback_rate_active": self.app.alarms.is_active(
+                    "tpu_fallback_rate"
+                ),
+            },
+        }
+        return web.json_response(out)
 
     async def stats(self, request):
         return web.json_response(
@@ -610,7 +684,11 @@ class MgmtApi:
             extra["mem.usage"] = self.app.os_mon.mem_usage
         if self.app.vm_mon is not None:
             extra["tasks.count"] = self.app.vm_mon.task_count
-        body = prometheus_exposition(self.broker.metrics.snapshot(), extra)
+        body = prometheus_exposition(
+            self.broker.metrics.snapshot(),
+            extra,
+            histograms=self.broker.metrics.histograms(),
+        )
         return web.Response(text=body, content_type="text/plain")
 
     async def trace_list(self, request):

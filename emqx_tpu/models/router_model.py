@@ -72,7 +72,9 @@ def route_step_impl(
     Returns dict with matched [B,K], mcount [B], flags [B], bitmaps [B,W],
     stats {routed, matches, fanout_bits}.
     """
-    matched, mcount, flags = batch_match_bytes_impl(
+    # cause breakdown is unused on this path (XLA dead-code-eliminates it);
+    # the serving path folds all causes into one fallback flag per row
+    matched, mcount, flags, _causes = batch_match_bytes_impl(
         tables,
         bytes_mat,
         lengths,
@@ -152,7 +154,7 @@ def shape_route_step_impl(
     flags = nwords > max_levels
     if with_nfa:
         syms = tok.vocab_lookup_device(nfa_tables, h1, h2, probes)
-        m2, _c2, f2 = batch_match_syms(
+        m2, _c2, f2, _causes2 = batch_match_syms(
             nfa_tables,
             syms,
             nwords,
@@ -598,6 +600,7 @@ class DeviceRouter:
         grouptab: Optional[GroupTable] = None,
         share_strategy: str = "round_robin",
         mesh=None,
+        metrics=None,
     ):
         """`mesh`: a jax.sharding.Mesh with ("dp", "tp") axes — when set,
         batches execute the SPMD dist_shape_route_step (tables replicated,
@@ -616,6 +619,8 @@ class DeviceRouter:
         self.subtab = subtab  # None => match-only (no fan-out bitmaps)
         self.grouptab = grouptab  # None => host-side $share pick
         self.mesh = mesh
+        # hot-path flight recorder (router.* series); None = don't record
+        self.metrics = metrics
         self.share_strategy = STRATEGY_IDS.get(share_strategy, 1)
         config = config or MatcherConfig()
         if config.probes < MAX_PROBES:
@@ -695,7 +700,15 @@ class DeviceRouter:
         that mutates the index/subtab (the event loop): packing walks live
         Python structures. The returned tuple is immutable device state
         safe to hand to `route_prepared` on a worker thread."""
-        return self._device_args()
+        import time
+
+        t0 = time.perf_counter()
+        args = self._device_args()
+        if self.metrics is not None:
+            self.metrics.observe(
+                "router.sync.seconds", time.perf_counter() - t0
+            )
+        return args
 
     def route(self, topics, client_hashes=None):
         """Batch route: returns host np arrays (matched [B,K] sparse,
@@ -715,6 +728,19 @@ class DeviceRouter:
         loaded and the strategy is hash_clientid.
         Returns (matched, mcount, flags, bitmaps[, pick_gid, pick_idx]).
         """
+        import time
+
+        t0 = time.perf_counter()
+        out = self._route_prepared(args, topics, client_hashes)
+        if self.metrics is not None:
+            # Histogram.observe is lock-safe: this runs on executor threads
+            self.metrics.observe(
+                "router.device.seconds", time.perf_counter() - t0
+            )
+            self.metrics.observe("router.batch.size", len(topics))
+        return out
+
+    def _route_prepared(self, args, topics, client_hashes=None):
         from emqx_tpu.broker.shared_sub import stable_hash
         from emqx_tpu.ops import tokenizer as tok
 

@@ -116,3 +116,87 @@ class AlarmManager:
             self._publish(topic, json.dumps(alarm.to_json()).encode())
         except Exception:
             pass
+
+
+class FallbackRateWatch:
+    """Level-triggered alarm on the TPU-path fallback-row rate.
+
+    Sustained fallback means the device kernel has effectively degraded to
+    the CPU trie (frontier/match caps too small for the live workload, or
+    topics deeper/longer than the compiled budgets) — the broker still
+    answers correctly, but at per-message CPU cost. This watch reads the
+    flight-recorder counters (broker serving path + TpuMatcher), computes
+    the fallback rate over a sliding window, and (de)activates one alarm
+    against the configured threshold.
+
+    Windows with fewer than `min_rows` routed rows are ignored in BOTH
+    directions: too little traffic neither raises nor clears the alarm
+    (an idle broker must not flap an operator page)."""
+
+    ALARM = "tpu_fallback_rate"
+
+    def __init__(
+        self,
+        alarms: AlarmManager,
+        metrics,
+        threshold: float = 0.2,
+        window: float = 10.0,
+        min_rows: int = 64,
+    ):
+        self.alarms = alarms
+        self.metrics = metrics
+        self.threshold = threshold
+        self.window = window
+        self.min_rows = min_rows
+        self._last_at: Optional[float] = None
+        self._last_fallback = 0
+        self._last_total = 0
+
+    def _totals(self) -> tuple:
+        m = self.metrics
+        fallback = m.get("messages.routed.device_fallback") + m.get(
+            "matcher.fallback.rows"
+        )
+        total = (
+            m.get("messages.routed.device")
+            + m.get("messages.routed.device_fallback")
+            + m.get("matcher.rows")
+        )
+        return fallback, total
+
+    def check(self, now: Optional[float] = None) -> Optional[float]:
+        """Evaluate once per elapsed window; returns the window's fallback
+        rate when a window closed (None otherwise). Call from the
+        housekeeping tick."""
+        now = now if now is not None else time.time()
+        if self._last_at is None:
+            self._last_at = now
+            self._last_fallback, self._last_total = self._totals()
+            return None
+        if now - self._last_at < self.window:
+            return None
+        fallback, total = self._totals()
+        d_fb = fallback - self._last_fallback
+        d_total = total - self._last_total
+        self._last_at = now
+        self._last_fallback, self._last_total = fallback, total
+        if d_total < self.min_rows:
+            return None
+        rate = d_fb / d_total
+        self.alarms.ensure(
+            self.ALARM,
+            rate > self.threshold,
+            details={
+                "rate": round(rate, 4),
+                "threshold": self.threshold,
+                "window_seconds": self.window,
+                "fallback_rows": d_fb,
+                "routed_rows": d_total,
+            },
+            message=(
+                f"TPU route fallback rate {rate:.1%} over the last "
+                f"{self.window:g}s exceeds {self.threshold:.1%}: the "
+                "device fast path is degrading to the CPU trie"
+            ),
+        )
+        return rate

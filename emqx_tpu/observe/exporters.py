@@ -4,6 +4,13 @@ Reference surface: apps/emqx_prometheus (scrape endpoint
 /api/v5/prometheus/stats + push-gateway client), apps/emqx_statsd (same
 metric families over statsd UDP). Metric names follow the reference's
 prometheus naming (emqx_ prefix, dots -> underscores).
+
+Metric KIND (counter/gauge/histogram) comes from the declaration registry
+in emqx_tpu.broker.metrics — never from name-substring guessing — so a new
+series renders with the right `# TYPE` the moment it is declared.
+Histograms render as real Prometheus histogram families
+(`_bucket{le=...}` / `_sum` / `_count`); StatsD renders seconds-unit
+histograms as timers.
 """
 
 from __future__ import annotations
@@ -12,15 +19,27 @@ import asyncio
 import socket
 from typing import Dict, Optional
 
+from emqx_tpu.broker.metrics import GAUGE, kind_of, spec
+
 
 def _prom_name(name: str) -> str:
     return "emqx_" + name.replace(".", "_").replace("-", "_")
 
 
+def _fmt_le(le: float) -> str:
+    return "+Inf" if le == float("inf") else f"{le:g}"
+
+
 def prometheus_exposition(
-    metrics_snapshot: Dict[str, float], extra_gauges: Optional[Dict] = None
+    metrics_snapshot: Dict[str, float],
+    extra_gauges: Optional[Dict] = None,
+    histograms: Optional[Dict[str, Dict]] = None,
 ) -> str:
-    """Render one scrape body (text exposition format 0.0.4)."""
+    """Render one scrape body (text exposition format 0.0.4).
+
+    `histograms`: Metrics.histograms() snapshots — rendered as
+    `# TYPE ... histogram` families with _bucket/_sum/_count lines.
+    """
     lines = []
     merged = dict(metrics_snapshot)
     if extra_gauges:
@@ -28,11 +47,17 @@ def prometheus_exposition(
     for name in sorted(merged):
         v = merged[name]
         pname = _prom_name(name)
-        kind = "counter" if ("." in name and not name.endswith("count")
-                             and "usage" not in name
-                             and "uptime" not in name) else "gauge"
+        kind = kind_of(name) or "untyped"
         lines.append(f"# TYPE {pname} {kind}")
         lines.append(f"{pname} {float(v):g}")
+    for name in sorted(histograms or ()):
+        snap = histograms[name]
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        for le, cum in snap["buckets"]:
+            lines.append(f'{pname}_bucket{{le="{_fmt_le(le)}"}} {cum}')
+        lines.append(f"{pname}_sum {float(snap['sum']):g}")
+        lines.append(f"{pname}_count {snap['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -54,20 +79,40 @@ class StatsdExporter:
         self._task: Optional[asyncio.Task] = None
         self._sock: Optional[socket.socket] = None
         self._last: Dict[str, float] = {}
+        # per-histogram (count, sum) at the previous render
+        self._last_hist: Dict[str, tuple] = {}
 
     def render(self) -> bytes:
-        """counters -> statsd 'c' deltas; gauges -> 'g'."""
+        """counters -> statsd 'c' deltas; gauges -> 'g'; seconds-unit
+        histograms -> '|ms' timers (mean of the interval) + percentile
+        gauges."""
         snap = self.metrics.snapshot()
         out = []
         for name, v in sorted(snap.items()):
             sname = f"{self.prefix}.{name}"
-            if name.endswith("count") or "usage" in name or "uptime" in name:
+            if kind_of(name) == GAUGE:
                 out.append(f"{sname}:{float(v):g}|g")
-            else:
+            else:  # counters (declared or not) push as deltas
                 delta = v - self._last.get(name, 0)
                 self._last[name] = v
                 if delta:
                     out.append(f"{sname}:{float(delta):g}|c")
+        hists = getattr(self.metrics, "histograms", None)
+        for name, h in sorted(hists().items() if hists else ()):
+            sname = f"{self.prefix}.{name}"
+            lc, ls = self._last_hist.get(name, (0, 0.0))
+            dc, ds = h["count"] - lc, h["sum"] - ls
+            self._last_hist[name] = (h["count"], h["sum"])
+            if dc <= 0:
+                continue
+            s = spec(name)
+            if s is not None and s.unit == "seconds":
+                # statsd timers are per-observation ms; we hold aggregates,
+                # so push the interval mean as one weighted timer line
+                out.append(f"{sname}:{ds / dc * 1e3:g}|ms|@{1.0 / dc:g}")
+            else:
+                out.append(f"{sname}.mean:{ds / dc:g}|g")
+            out.append(f"{sname}.count:{float(dc):g}|c")
         return "\n".join(out).encode()
 
     async def _loop(self) -> None:

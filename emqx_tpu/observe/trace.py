@@ -151,12 +151,31 @@ class TraceManager:
             f.close()
         self._files.clear()
 
+    def sweep(self, now: Optional[float] = None) -> None:
+        """Close file handles of specs whose window has passed. A spec
+        that expires via `end_at` keeps status "stopped" without anyone
+        calling stop() — without this, its handle leaks until delete()
+        or process exit (the finished trace stays on disk for download)."""
+        now = now or time.time()
+        for name, spec in self._specs.items():
+            if name in self._files and spec.status(now) == "stopped":
+                self._files.pop(name).close()
+
     # -- logging -----------------------------------------------------------
     def log(self, event: str, meta: Dict) -> None:
         now = time.time()
         line = None
+        stopped = None
         for name, spec in self._specs.items():
-            if spec.status(now) != "running" or not spec.matches(meta):
+            status = spec.status(now)
+            if status != "running":
+                # expired-window specs surface here first: close their
+                # files inline so the hot path never carries leaked fds
+                # ("waiting" specs keep theirs — they start later)
+                if status == "stopped" and name in self._files:
+                    stopped = [name] if stopped is None else stopped + [name]
+                continue
+            if not spec.matches(meta):
                 continue
             f = self._files.get(name)
             if f is None:
@@ -169,6 +188,9 @@ class TraceManager:
                 line = f"{ts}.{int(now * 1000) % 1000:03d} [{event}] {kv}\n"
             f.write(line)
             f.flush()
+        if stopped:
+            for name in stopped:
+                self._files.pop(name).close()
 
     # -- hook wiring (the reference traces these ops inline) ----------------
     def attach(self, hooks) -> None:

@@ -123,7 +123,10 @@ def batch_match_syms(
     syms: int32 [B, L] dense word symbols (-1 = OOV/absent)
     nwords: int32 [B]; dollar: bool [B]
     -> matched int32 [B, K] filter ids (-1 padded), mcount int32 [B],
-       flags bool [B] (overflow or too-deep => host must fall back)
+       flags bool [B] (overflow or too-deep => host must fall back),
+       causes {too_deep, frontier_overflow, match_overflow} bool [B]
+       (per-cause breakdown of flags — the flight recorder counts WHY
+       the fast path missed, not just that it did)
     """
     import jax
     import jax.numpy as jnp
@@ -176,8 +179,15 @@ def batch_match_syms(
     endhash = jnp.where(fin, tables["hash_filter"][fr_safe], -1)
     matched, mcount = _append(matched, mcount, endhash, K)
 
-    flags = fover | (mcount > K) | ~done
-    return matched, jnp.minimum(mcount, K), flags
+    too_deep = ~done
+    mover = mcount > K
+    flags = fover | mover | too_deep
+    causes = {
+        "too_deep": too_deep,
+        "frontier_overflow": fover,
+        "match_overflow": mover,
+    }
+    return matched, jnp.minimum(mcount, K), flags, causes
 
 
 def batch_match_bytes_impl(
@@ -220,9 +230,19 @@ def _pad_pow2(n: int, lo: int = 256) -> int:
 class TpuMatcher:
     """Host-facing wrapper: owns packed tables on device, pads batches,
     decodes matches back to filter names, and falls back to a caller-provided
-    exact matcher for flagged rows."""
+    exact matcher for flagged rows.
 
-    def __init__(self, builder: NfaBuilder, config: MatcherConfig = MatcherConfig()):
+    Records the hot-path flight-recorder series (`matcher.*`, see
+    docs/observability.md): device match wall time, batch size, delta-sync
+    upload time, and fallback-flagged row counts broken down by cause."""
+
+    def __init__(
+        self,
+        builder: NfaBuilder,
+        config: MatcherConfig = MatcherConfig(),
+        metrics=None,
+    ):
+        from emqx_tpu.broker.metrics import default_metrics
         from emqx_tpu.ops.nfa import DeviceDeltaSync
 
         self.builder = builder
@@ -231,14 +251,22 @@ class TpuMatcher:
 
             config = dataclasses.replace(config, probes=MAX_PROBES)
         self.config = config
+        self.metrics = metrics if metrics is not None else default_metrics
         self._sync = DeviceDeltaSync()
         self._salt = 0
 
     def _tables(self):
         # delta-overlay sync: subscription churn reaches the device as
         # scatters, not full re-uploads (see nfa.DeviceDeltaSync)
+        import time
+
         self._salt = self.builder.salt
-        return self._sync.sync(self.builder)
+        t0 = time.perf_counter()
+        tables = self._sync.sync(self.builder)
+        self.metrics.observe(
+            "matcher.sync.seconds", time.perf_counter() - t0
+        )
+        return tables
 
     def match_batch(
         self, topics: Sequence[str], fallback=None
@@ -248,6 +276,8 @@ class TpuMatcher:
         `fallback(topic) -> list[str]` handles rows the device flags
         (too deep / overflow); defaults to raising if flagged.
         """
+        import time
+
         cfg = self.config
         tables = self._tables()
         B = len(topics)
@@ -256,7 +286,8 @@ class TpuMatcher:
         if Bp != B:
             mat = np.pad(mat, ((0, Bp - B), (0, 0)))
             lens = np.pad(lens, (0, Bp - B))
-        matched, mcount, flags = batch_match_bytes(
+        t0 = time.perf_counter()
+        matched, mcount, flags, causes = batch_match_bytes(
             tables,
             mat,
             lens,
@@ -269,6 +300,7 @@ class TpuMatcher:
         matched = np.asarray(matched[:B])
         mcount = np.asarray(mcount[:B])
         flags = np.asarray(flags[:B]) | too_long
+        self._record(B, time.perf_counter() - t0, flags, causes, too_long)
         out: List[List[str]] = []
         for i in range(B):
             if flags[i]:
@@ -286,3 +318,23 @@ class TpuMatcher:
                         names.append(name)
                 out.append(names)
         return out
+
+    def _record(self, B, wall_s, flags, causes, too_long) -> None:
+        """Flight-recorder write-back for one matched batch."""
+        m = self.metrics
+        m.observe("matcher.device.seconds", wall_s)
+        m.observe("matcher.batch.size", B)
+        m.inc("matcher.rows", B)
+        fell = int(np.count_nonzero(flags))
+        if not fell:
+            return
+        m.inc("matcher.fallback.rows", fell)
+        # causes are independent bits: one row can be both too deep and
+        # frontier-overflowed; the per-cause counters count each bit
+        for cause, arr in causes.items():
+            n = int(np.count_nonzero(np.asarray(arr[:B])))
+            if n:
+                m.inc(f"matcher.fallback.rows.{cause}", n)
+        n_long = int(np.count_nonzero(too_long))
+        if n_long:
+            m.inc("matcher.fallback.rows.too_long", n_long)

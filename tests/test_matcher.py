@@ -163,7 +163,7 @@ def test_host_tokenize_matches_device_path():
     syms = np.stack([r[0] for r in rows])
     nwords = np.array([r[1] for r in rows], dtype=np.int32)
     dollar = np.array([r[2] for r in rows])
-    matched, mcount, flags = batch_match_syms(
+    matched, mcount, flags, causes = batch_match_syms(
         tables, syms, nwords, dollar, frontier=8, max_matches=8, probes=8
     )
     got = sorted(
@@ -173,6 +173,8 @@ def test_host_tokenize_matches_device_path():
     assert got == ["dev/+/temp", "dev/1/temp"]
     assert int(mcount[1]) == 0
     assert not bool(np.asarray(flags).any())
+    for arr in causes.values():
+        assert not bool(np.asarray(arr).any())
 
 
 def test_invalid_add_does_not_corrupt_builder():

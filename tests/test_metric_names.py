@@ -1,0 +1,36 @@
+"""Tier-1 lint: every static metric name used in emqx_tpu/ is declared in
+the metric-kind registry (tools/check_metric_names.py wired into the test
+run, per the flight-recorder design: exporters render # TYPE from
+declarations, so an undeclared series is invisible to every dashboard)."""
+
+import importlib.util
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "check_metric_names", ROOT / "tools" / "check_metric_names.py"
+)
+checker = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(checker)
+
+
+def test_every_static_metric_name_is_declared():
+    bad = checker.find_violations(ROOT / "emqx_tpu")
+    assert not bad, "\n".join(
+        f"{p}:{ln}: undeclared metric name {name!r}" for p, ln, name in bad
+    )
+
+
+def test_checker_sees_the_hot_path_call_sites():
+    # the lint is only as good as its scan: it must actually see the
+    # flight-recorder call sites it exists to guard
+    names = {n for _, _, n in checker.find_call_sites(ROOT / "emqx_tpu")}
+    for expected in (
+        "ingest.batch.size",
+        "matcher.device.seconds",
+        "router.device.seconds",
+        "dispatch.fanout",
+        "messages.routed.device",
+    ):
+        assert expected in names, expected

@@ -181,6 +181,87 @@ def test_topic_metrics_counting_and_rates():
     assert tm.metrics("m/#") is None
 
 
+# -- histograms (hot-path flight recorder) ---------------------------------
+
+def test_histogram_bucket_boundaries():
+    from emqx_tpu.broker.metrics import Histogram
+
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(106.65)
+    # cumulative, with observations AT a bound landing in that bucket
+    assert snap["buckets"] == [
+        (0.1, 2), (1.0, 4), (10.0, 5), (float("inf"), 6),
+    ]
+
+
+def test_histogram_percentile_math():
+    from emqx_tpu.broker.metrics import Histogram
+
+    h = Histogram(buckets=(1.0, 2.0, 4.0))
+    for _ in range(50):
+        h.observe(0.5)
+    for _ in range(50):
+        h.observe(3.0)
+    # p50 falls exactly at the end of the first bucket
+    assert h.p50 == pytest.approx(1.0)
+    # p99 interpolates inside the (2, 4] bucket
+    assert 2.0 < h.p99 <= 4.0
+    # quantiles landing in the +Inf bucket report the last finite bound
+    h2 = Histogram(buckets=(1.0,))
+    h2.observe(99.0)
+    assert h2.p99 == 1.0
+    # empty histogram
+    assert Histogram(buckets=(1.0,)).p50 == 0.0
+
+
+def test_histogram_concurrent_observe():
+    import threading
+
+    from emqx_tpu.broker.metrics import Histogram
+
+    h = Histogram(buckets=(0.5, 1.5))
+    N, T = 2000, 8
+
+    def worker():
+        for i in range(N):
+            h.observe(1.0 if i % 2 else 2.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = h.snapshot()
+    assert snap["count"] == N * T
+    assert snap["buckets"][-1] == (float("inf"), N * T)
+    assert snap["sum"] == pytest.approx(1.5 * N * T)
+
+
+def test_metrics_observe_uses_registry_buckets():
+    from emqx_tpu.broker.metrics import Metrics, spec
+
+    m = Metrics()
+    m.observe("ingest.batch.size", 3)
+    h = m.histogram("ingest.batch.size")
+    assert h.bounds == tuple(spec("ingest.batch.size").buckets)
+    m.observe_many("ingest.settle.seconds", [0.001, 0.002, 5.0])
+    assert m.histogram("ingest.settle.seconds").count == 3
+
+
+def test_registry_rejects_kind_conflicts():
+    from emqx_tpu.broker import metrics as M
+
+    M.declare("messages.received", M.COUNTER)  # same kind: no-op
+    with pytest.raises(ValueError):
+        M.declare("messages.received", M.GAUGE)
+    assert M.kind_of("messages.received") == M.COUNTER
+    assert M.kind_of("no.such.series") is None
+
+
 # -- exporters -------------------------------------------------------------
 
 def test_prometheus_exposition_format():
@@ -195,6 +276,40 @@ def test_prometheus_exposition_format():
     assert "emqx_connections_count 2" in body
     assert "# TYPE emqx_messages_received counter" in body
     assert "# TYPE emqx_connections_count gauge" in body
+
+
+def test_prometheus_kind_from_registry_not_name_heuristic():
+    from emqx_tpu.broker.metrics import Metrics
+
+    m = Metrics()
+    # names the old substring heuristic ("usage"/"uptime"/endswith count)
+    # classified WRONG or by accident: kind now comes from declarations
+    m.inc("messages.dropped.no_subscribers", 2)  # counter w/ dots
+    body = prometheus_exposition(
+        m.snapshot(),
+        {"cpu.usage": 0.5, "retained.count": 4},
+    )
+    assert "# TYPE emqx_messages_dropped_no_subscribers counter" in body
+    assert "# TYPE emqx_cpu_usage gauge" in body
+    assert "# TYPE emqx_retained_count gauge" in body
+    assert "# TYPE emqx_uptime_seconds gauge" in body
+    # an undeclared series renders untyped rather than mis-typed
+    body2 = prometheus_exposition({"some.adhoc.series": 1})
+    assert "# TYPE emqx_some_adhoc_series untyped" in body2
+
+
+def test_prometheus_histogram_exposition():
+    from emqx_tpu.broker.metrics import Metrics
+
+    m = Metrics()
+    m.observe_many("matcher.device.seconds", [0.0002, 0.003, 0.03])
+    body = prometheus_exposition(m.snapshot(), histograms=m.histograms())
+    assert "# TYPE emqx_matcher_device_seconds histogram" in body
+    assert 'emqx_matcher_device_seconds_bucket{le="0.00025"} 1' in body
+    assert 'emqx_matcher_device_seconds_bucket{le="0.005"} 2' in body
+    assert 'emqx_matcher_device_seconds_bucket{le="+Inf"} 3' in body
+    assert "emqx_matcher_device_seconds_count 3" in body
+    assert "emqx_matcher_device_seconds_sum 0.0332" in body
 
 
 def test_statsd_render_counters_as_deltas():
@@ -312,11 +427,21 @@ async def test_event_messages_and_observe_rest(tmp_path=None):
             async with s.get(f"{api}/mqtt/topic_metrics") as r:
                 tm = await r.json()
                 assert tm[0]["metrics"]["messages.in"] == 1
-            # prometheus scrape
+            # prometheus scrape (histogram families included: the CPU-path
+            # dispatch still records per-message fan-out)
             async with s.get(f"{api}/prometheus/stats") as r:
                 body = await r.text()
                 assert "emqx_messages_received" in body
                 assert "emqx_connections_count 2" in body
+                assert "# TYPE emqx_dispatch_fanout histogram" in body
+                assert 'emqx_dispatch_fanout_bucket{le="+Inf"}' in body
+            # hot-path flight recorder summary
+            async with s.get(f"{api}/metrics/hotpath") as r:
+                assert r.status == 200
+                hp = await r.json()
+                assert hp["dispatch"]["fanout"]["count"] >= 1
+                assert hp["matcher"]["fallback_by_cause"]["too_deep"] == 0
+                assert hp["alarms"]["tpu_fallback_rate_active"] is False
             # alarms endpoint (activate one by hand)
             app.alarms.activate("test_alarm", {"k": 1}, "manual")
             async with s.get(f"{api}/alarms?activated=true") as r:
@@ -332,6 +457,167 @@ async def test_event_messages_and_observe_rest(tmp_path=None):
         await other.disconnect()
     finally:
         await app.stop()
+
+
+# -- hot-path flight recorder ----------------------------------------------
+
+def test_matcher_fallback_counter_by_cause_and_histogram_exposition():
+    """Acceptance gate: a topic exceeding MatcherConfig.max_levels bumps
+    the too_deep fallback counter, and the recorded device-latency
+    histogram renders as a real `# TYPE ... histogram` family."""
+    from emqx_tpu.broker.metrics import Metrics
+    from emqx_tpu.ops.matcher import MatcherConfig, TpuMatcher
+    from emqx_tpu.ops.nfa import NfaBuilder
+
+    m = Metrics()
+    builder = NfaBuilder()
+    builder.add("a/#")
+    matcher = TpuMatcher(builder, MatcherConfig(max_levels=4), metrics=m)
+    deep = "a/" + "/".join("x" for _ in range(10))  # 11 levels > 4
+    got = matcher.match_batch([deep, "a/b"], fallback=lambda t: ["cpu"])
+    assert got == [["cpu"], ["a/#"]]
+    assert m.get("matcher.rows") == 2
+    assert m.get("matcher.fallback.rows") == 1
+    assert m.get("matcher.fallback.rows.too_deep") == 1
+    assert m.get("matcher.fallback.rows.frontier_overflow") == 0
+    assert m.get("matcher.fallback.rows.match_overflow") == 0
+    assert m.get("matcher.fallback.rows.too_long") == 0
+    assert m.histogram("matcher.device.seconds").count == 1
+    assert m.histogram("matcher.sync.seconds").count >= 1
+    body = prometheus_exposition(m.snapshot(), histograms=m.histograms())
+    assert "# TYPE emqx_matcher_device_seconds histogram" in body
+    assert 'emqx_matcher_device_seconds_bucket{le="+Inf"} 1' in body
+    assert "emqx_matcher_device_seconds_count 1" in body
+    assert "emqx_matcher_fallback_rows_too_deep 1" in body
+
+
+def test_matcher_fallback_too_long_counted():
+    from emqx_tpu.broker.metrics import Metrics
+    from emqx_tpu.ops.matcher import MatcherConfig, TpuMatcher
+    from emqx_tpu.ops.nfa import NfaBuilder
+
+    m = Metrics()
+    builder = NfaBuilder()
+    builder.add("a/#")
+    matcher = TpuMatcher(builder, MatcherConfig(max_bytes=32), metrics=m)
+    got = matcher.match_batch(["a/" + "y" * 100], fallback=lambda t: ["cpu"])
+    assert got == [["cpu"]]
+    assert m.get("matcher.fallback.rows.too_long") == 1
+    assert m.get("matcher.fallback.rows") == 1
+
+
+def test_fallback_rate_alarm_trigger_and_clear():
+    from emqx_tpu.broker.metrics import Metrics
+    from emqx_tpu.observe.alarm import FallbackRateWatch
+
+    m = Metrics()
+    am = AlarmManager()
+    w = FallbackRateWatch(am, m, threshold=0.5, window=1.0, min_rows=10)
+    t = 1000.0
+    assert w.check(t) is None  # first call only arms the baseline
+    # window 1: 48/50 rows fell back -> alarm
+    m.inc("messages.routed.device", 2)
+    m.inc("messages.routed.device_fallback", 48)
+    rate = w.check(t + 1.5)
+    assert rate == pytest.approx(0.96)
+    assert am.is_active(FallbackRateWatch.ALARM)
+    details = am.list(activated=True)[0]["details"]
+    assert details["fallback_rows"] == 48 and details["routed_rows"] == 50
+    # window 2: healthy traffic -> alarm clears
+    m.inc("messages.routed.device", 500)
+    rate = w.check(t + 3.0)
+    assert rate == pytest.approx(0.0)
+    assert not am.is_active(FallbackRateWatch.ALARM)
+    # window 3: idle (below min_rows) flaps NEITHER direction
+    m.inc("messages.routed.device_fallback", 3)
+    assert w.check(t + 4.5) is None
+    assert not am.is_active(FallbackRateWatch.ALARM)
+    # matcher-path counters feed the same rate
+    m.inc("matcher.rows", 40)
+    m.inc("matcher.fallback.rows", 39)
+    assert w.check(t + 6.0) == pytest.approx(39 / 40)
+    assert am.is_active(FallbackRateWatch.ALARM)
+
+
+def test_ingest_flight_recorder_series():
+    """A real batch through BatchIngest records size/occupancy/settle."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.mqtt import packet as pkt
+
+    async def go():
+        broker = Broker(router=Router(min_tpu_batch=1), hooks=Hooks())
+        got = []
+        broker.subscribe(
+            "s1", "c1", "fr/+", pkt.SubOpts(), lambda msg, o: got.append(msg)
+        )
+        ing = BatchIngest(broker, max_batch=64, window_us=0)
+        ing.start()
+        futs = [
+            ing.enqueue(Message(topic=f"fr/{i}", payload=b"x"))
+            for i in range(8)
+        ]
+        counts = await asyncio.gather(*futs)
+        await ing.stop()
+        assert counts == [1] * 8 and len(got) == 8
+        m = broker.metrics
+        bs = m.histogram("ingest.batch.size")
+        assert bs is not None and bs.count >= 1 and bs.sum == 8
+        occ = m.histogram("ingest.batch.occupancy")
+        assert occ is not None and 0 < occ.sum / occ.count <= 1.0
+        st = m.histogram("ingest.settle.seconds")
+        assert st is not None and st.count == 8 and st.p99 >= st.p50 >= 0
+        assert m.get("ingest.launch.errors") == 0
+        assert m.get("ingest.dispatch.errors") == 0
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_ingest_launch_error_counted():
+    from emqx_tpu.broker.ingest import BatchIngest
+
+    async def go():
+        class BoomBroker:
+            class router:
+                min_tpu_batch = 1
+                enable_tpu = True
+
+            def adispatch_begin(self, msgs, forward=True):
+                raise RuntimeError("device on fire")
+
+        ing = BatchIngest(BoomBroker(), window_us=0)
+        ing.start()
+        fut = ing.enqueue(Message(topic="t"))
+        with pytest.raises(RuntimeError):
+            await fut
+        await ing.stop()
+        assert ing.metrics.get("ingest.launch.errors") == 1
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_trace_expired_window_closes_file(tmp_path):
+    from emqx_tpu.observe.trace import TraceManager
+
+    tm = TraceManager(base_dir=str(tmp_path))
+    now = time.time()
+    tm.create("leaky", "topic", "a/#", end_at=now + 0.05)
+    tm.create("waiting", "topic", "b/#", start_at=now + 3600)
+    assert "leaky" in tm._files and "waiting" in tm._files
+    time.sleep(0.06)
+    # the hot logging path closes the expired spec's handle...
+    tm.log("PUBLISH", {"topic": "a/b"})
+    assert "leaky" not in tm._files
+    # ...but never a waiting spec's (it starts later)
+    assert "waiting" in tm._files
+    # finished trace stays downloadable from disk
+    assert tm.read("leaky") == ""
+    # housekeeping sweep covers the no-traffic case too
+    tm.create("leaky2", "clientid", "c", end_at=now + 0.05)
+    tm.sweep(now=now + 10)
+    assert "leaky2" not in tm._files
+    tm.close()
 
 
 @async_test

@@ -137,6 +137,46 @@ async def test_shared_sub_redispatch_causality():
 
 
 @async_test
+async def test_ingest_launch_settle_dispatch_causality():
+    """Hot-path flight recorder tracepoints: every launched ingest batch
+    settles exactly once (same seq), the device dispatch tracepoint fires
+    between them, and settles arrive in launch (FIFO) order even with the
+    pipeline overlapping batches."""
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.router import Router
+
+    broker = Broker(router=Router(min_tpu_batch=1), hooks=Hooks())
+    got = []
+    broker.subscribe(
+        "s1", "c1", "hp/+", pkt.SubOpts(), lambda m, o: got.append(m.topic)
+    )
+    with TraceCollector() as t:
+        ing = BatchIngest(broker, max_batch=4, window_us=0, pipeline=2)
+        ing.start()
+        futs = [
+            ing.enqueue(Message(topic=f"hp/{i}", payload=b"x"))
+            for i in range(10)
+        ]
+        counts = await asyncio.gather(*futs)
+        await ing.stop()
+        assert counts == [1] * 10 and len(got) == 10
+        launches = t.projection("ingest.launch")
+        settles = t.projection("ingest.settle")
+        assert launches and sum(e["n"] for e in launches) == 10
+        # every settle is preceded by its launch, one-to-one by batch seq
+        assert t.causally_ordered("ingest.launch", "ingest.settle", "batch")
+        assert t.pairs("ingest.launch", "ingest.settle", "batch")
+        # FIFO settlement: seqs settle in launch order
+        assert [e["batch"] for e in settles] == sorted(
+            e["batch"] for e in settles
+        )
+        # the dispatch half emitted its batch tracepoint too
+        dispatched = t.projection("dispatch.batch")
+        assert sum(e["n"] for e in dispatched) == 10
+        assert all(e["fallback"] == 0 for e in dispatched)
+
+
+@async_test
 async def test_detach_resume_causality_under_load():
     """Messages banked during detach are causally between detach and
     resume; nothing delivers to the dead channel."""

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Static metric-name lint: every `metrics.inc/observe/gauge_set` call site
+in emqx_tpu/ must name a series declared in the metric-kind registry
+(emqx_tpu.broker.metrics). Catches typo'd series names at test time —
+a misspelled counter otherwise just creates a silent parallel series that
+no dashboard, exporter, or alarm ever reads.
+
+Scans with `ast`: any Call whose func is an attribute named inc/observe/
+gauge_set and whose first argument is a string literal. Dynamic names
+(f-strings, variables) are skipped — they must be composed from declared
+prefixes (e.g. matcher.fallback.rows.<cause>, all declared explicitly).
+
+Run directly (exit 1 on violations) or via tests/test_metric_names.py
+(tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+METHODS = ("inc", "observe", "observe_many", "gauge_set")
+
+
+def find_call_sites(root: Path):
+    """-> [(path, lineno, name)] for every static-name metric call."""
+    sites = []
+    for path in sorted(root.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            sites.append((path, e.lineno or 0, f"<unparseable: {e.msg}>"))
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                sites.append((path, node.lineno, node.args[0].value))
+    return sites
+
+
+def find_violations(root: Path):
+    """-> [(path, lineno, name)] of call sites naming undeclared series."""
+    from emqx_tpu.broker.metrics import registry
+
+    declared = set(registry())
+    return [
+        (path, lineno, name)
+        for path, lineno, name in find_call_sites(root)
+        if name not in declared
+    ]
+
+
+def main(argv) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else (
+        Path(__file__).resolve().parents[1] / "emqx_tpu"
+    )
+    sys.path.insert(0, str(root.parent))
+    bad = find_violations(root)
+    if not bad:
+        print(f"metric names OK ({len(find_call_sites(root))} call sites)")
+        return 0
+    for path, lineno, name in bad:
+        print(f"{path}:{lineno}: undeclared metric name {name!r}")
+    print(
+        f"{len(bad)} undeclared metric name(s); declare them in "
+        "emqx_tpu/broker/metrics.py (see the series declarations block)"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
