@@ -117,10 +117,10 @@ class Histogram:
         bounds = tuple(sorted(float(b) for b in buckets))
         if not bounds:
             raise ValueError("histogram needs at least one bucket bound")
-        self.bounds = bounds
-        self._counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
-        self.sum = 0.0
-        self.count = 0
+        self.bounds = bounds  # immutable after construction
+        self._counts = [0] * (len(bounds) + 1)  # guarded-by: _lock
+        self.sum = 0.0  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -196,9 +196,9 @@ class Histogram:
 
 class Metrics:
     def __init__(self) -> None:
-        self._counters: Dict[str, int] = defaultdict(int)
-        self._gauges: Dict[str, float] = {}
-        self._histograms: Dict[str, Histogram] = {}
+        self._counters: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
+        self._gauges: Dict[str, float] = {}  # guarded-by: _lock
+        self._histograms: Dict[str, Histogram] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.started_at = time.time()
 
@@ -219,7 +219,9 @@ class Metrics:
 
     # -- histograms --------------------------------------------------------
     def _histogram(self, name: str) -> Histogram:
-        h = self._histograms.get(name)
+        # double-checked locking: the dict read is GIL-atomic and the
+        # slow path re-checks under _lock
+        h = self._histograms.get(name)  # lint: disable=LK001
         if h is None:
             with self._lock:
                 h = self._histograms.get(name)
@@ -240,7 +242,8 @@ class Metrics:
         self._histogram(name).observe_many(values)
 
     def histogram(self, name: str) -> Optional[Histogram]:
-        return self._histograms.get(name)
+        with self._lock:
+            return self._histograms.get(name)
 
     def histograms(self) -> Dict[str, Dict]:
         """name -> Histogram.snapshot() for every recorded histogram."""

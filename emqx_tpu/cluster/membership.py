@@ -38,8 +38,8 @@ class Membership:
         self._bus = bus
         self._clock = clock or time.monotonic
         self._lock = threading.Lock()
-        self._last_seen: Dict[str, float] = {}
-        self._alive: Dict[str, bool] = {node: True}
+        self._last_seen: Dict[str, float] = {}  # guarded-by: _lock
+        self._alive: Dict[str, bool] = {node: True}  # guarded-by: _lock
         self._callbacks: List[MembershipCallback] = []
 
     # -- ekka:monitor(membership) parity ----------------------------------

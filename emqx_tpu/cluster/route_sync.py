@@ -32,7 +32,7 @@ class ClusterRouteTable:
         self.node = node
         self._router = router or Router(enable_tpu=False)
         # filter -> nodes having >=1 local subscriber on it
-        self._dests: Dict[str, Set[str]] = {}
+        self._dests: Dict[str, Set[str]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- replica writes (applied locally AND via RPC from peers) ----------

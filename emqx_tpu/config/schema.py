@@ -386,6 +386,27 @@ class GatewaySpec:
     opts: Dict[str, Any] = field(default_factory=dict)
 
 
+# Every key a gateway may read from `GatewaySpec.opts` (the free-form
+# dict above). The gateways read these with `self.config.get("key")`;
+# tools/analysis (CK002) statically rejects reads of undeclared keys, so
+# a typo'd opt surfaces at lint time instead of silently hitting the
+# default. Add new keys HERE when a gateway grows a knob.
+GATEWAY_OPT_KEYS = frozenset({
+    # shared listener plumbing
+    "bind", "port", "mountpoint", "transport", "psk",
+    # mqtt-sn
+    "predefined", "gateway_id",
+    # lwm2m
+    "qos", "lifetime", "lifetime_min", "lifetime_max",
+    # stomp
+    "heartbeat_ms",
+    # coap
+    "heartbeat", "notify_type", "max_block_size", "retainer",
+    # exproto
+    "node", "adapter_bind",
+})
+
+
 @dataclass
 class AppConfig:
     node: NodeConfig = field(default_factory=NodeConfig)

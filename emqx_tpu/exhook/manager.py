@@ -164,7 +164,7 @@ class ExhookServer:
             max_workers=max(1, pool_size),
             thread_name_prefix=f"exhook-{name}-valued",
         )
-        self._notify_backlog = 0  # guarded by _notify_lock (worker thread
+        self._notify_backlog = 0  # guarded-by: _notify_lock (worker thread
         self._notify_lock = threading.Lock()  # decrements, loop increments)
         self._notify_backlog_max = 1000
         self._consec_failures = 0

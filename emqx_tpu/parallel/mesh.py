@@ -19,6 +19,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from emqx_tpu.models.router_model import route_step_impl, shape_route_step_impl
 
+# -- shard_map compat -------------------------------------------------------
+# jax moved shard_map from jax.experimental to the top level around 0.4.35;
+# this image's 0.4.37 only ships the experimental spelling. Resolve once at
+# import; HAS_SHARD_MAP lets callers (and mesh tests) skip fast on images
+# with neither instead of stalling or dying on AttributeError mid-dispatch.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except Exception:  # pragma: no cover - images without any shard_map
+        _shard_map = None
+
+HAS_SHARD_MAP = _shard_map is not None
+
+
+def shard_map(*args, **kwargs):
+    """`jax.shard_map` under either spelling; RuntimeError when absent."""
+    if _shard_map is None:
+        raise RuntimeError(
+            "this jax installation provides neither jax.shard_map nor "
+            "jax.experimental.shard_map.shard_map; mesh serving is "
+            "unavailable (check emqx_tpu.parallel.mesh.HAS_SHARD_MAP)"
+        )
+    return _shard_map(*args, **kwargs)
+
 
 def make_mesh(
     n_devices: Optional[int] = None,
@@ -109,7 +134,7 @@ def _dist_step_fn(
         return _reduce_stats(out)
 
     table_specs = {k: P() for k in table_keys}
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(table_specs, P(None, "tp"), P("dp", None), P("dp")),
@@ -207,7 +232,7 @@ def _dist_shape_step_fn(
     nfa_specs = {k: P() for k in nfa_keys} if with_nfa else None
     group_specs = {k: P() for k in group_keys} if with_groups else None
     per_topic = P("dp") if with_groups else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(

@@ -33,7 +33,27 @@ import struct
 import time
 from typing import Callable, Dict, Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# `cryptography` is imported lazily: the module must stay importable on
+# hosts without it (gateways default to plain UDP), and a DTLS listener
+# should fail at START time with an actionable error, not at import.
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - exercised on slim images
+    AESGCM = None
+
+HAVE_AESGCM = AESGCM is not None
+
+
+def require_dtls_support() -> None:
+    """Raise a clear error when the AEAD backend is unavailable; called
+    when a `transport: dtls` listener actually starts."""
+    if AESGCM is None:
+        raise RuntimeError(
+            "DTLS support requires the 'cryptography' package "
+            "(AES-128-GCM AEAD); install it or switch the gateway "
+            "listener back to `transport: udp`"
+        )
+
 
 # record content types
 CT_CCS = 20
@@ -134,6 +154,7 @@ class _Cipher:
     """One direction of AES-128-GCM record protection (RFC 5288)."""
 
     def __init__(self, key: bytes, iv_salt: bytes):
+        require_dtls_support()
         self.aead = AESGCM(key)
         self.salt = iv_salt
 
@@ -463,6 +484,7 @@ class DtlsUdpGatewayMixin:
 
     def _init_dtls(self) -> None:
         if self.config.get("transport") == "dtls":
+            require_dtls_support()
             self._dtls = build_endpoint_for_gateway(
                 self, self._plain_datagram
             )

@@ -1595,13 +1595,20 @@ class WorkerPool:
             ],
         )
 
-    async def start(self) -> None:
+    def _write_worker_config(self) -> None:
         import dataclasses
         import json
 
-        await self.fabric.start()
         with open(self._cfg_path, "w") as f:
             json.dump(dataclasses.asdict(self.config), f, default=str)
+
+    async def start(self) -> None:
+        await self.fabric.start()
+        # config snapshot for the worker processes: written off-loop (the
+        # dump can hit a slow tmpdir while listeners are already serving)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write_worker_config
+        )
         for wid in range(self.n):
             self._procs.append(self._spawn(wid))
         self._respawns: List[float] = []

@@ -1,0 +1,43 @@
+"""Async-blocking fixture: calls that stall the event loop."""
+
+import socket
+import subprocess
+import time
+from time import sleep
+
+import requests
+
+
+async def sleepy():
+    time.sleep(1)  # AB001
+
+
+async def sleepy_from_import():
+    sleep(1)  # AB001 (alias-resolved)
+
+
+async def fetch(url):
+    return requests.get(url)  # AB002
+
+
+async def resolve(host):
+    return socket.getaddrinfo(host, 80)  # AB002
+
+
+async def slurp(path):
+    with open(path) as f:  # AB003
+        return f.read()
+
+
+async def shell(cmd):
+    return subprocess.run(cmd)  # AB004
+
+
+async def block_on(fut):
+    return fut.result()  # AB005
+
+
+async def sysexec(cmd):
+    import os
+
+    return os.system(cmd)  # AB004

@@ -1,0 +1,36 @@
+"""Async-blocking fixture: patterns the checker must accept."""
+
+import asyncio
+import time
+
+
+async def napping():
+    await asyncio.sleep(1)
+
+
+async def offloaded(path):
+    loop = asyncio.get_running_loop()
+
+    def _read():
+        # blocking I/O inside an executor thunk is exactly right
+        with open(path) as f:
+            return f.read()
+
+    return await loop.run_in_executor(None, _read)
+
+
+async def awaited_result(fut):
+    return await fut
+
+
+async def result_with_timeout(fut):
+    # result(timeout=0) is a non-blocking poll, not a blocking wait
+    return fut.result(0)
+
+
+def sync_sleep_is_fine():
+    time.sleep(0.001)
+
+
+async def suppressed():
+    time.sleep(0)  # lint: disable=AB001

@@ -1,0 +1,56 @@
+"""Config-key fixture: a mini AppConfig tree + drifting readers.
+
+Lives under a `gateway/` dir so the CK002 string-key scope applies.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class RouterConfig:
+    enable_tpu: bool = True
+    min_batch: int = 64
+
+    def effective_batch(self) -> int:
+        return self.min_batch
+
+
+@dataclass
+class AppConfig:
+    router: RouterConfig = field(default_factory=RouterConfig)
+    never_read_anywhere: int = 0  # CK003: dead key
+
+
+GATEWAY_OPT_KEYS = frozenset({"bind", "port"})
+
+
+def good_reads(cfg: AppConfig) -> int:
+    if cfg.router.enable_tpu:
+        return cfg.router.effective_batch()
+    return cfg.router.min_batch
+
+
+def bad_read(cfg: AppConfig) -> int:
+    return cfg.router.min_btach  # CK001: typo'd field
+
+
+class Holder:
+    def __init__(self, config: Optional[AppConfig] = None):
+        self.config = config or AppConfig()
+
+    def ok(self) -> bool:
+        return self.config.router.enable_tpu
+
+    def drifts(self) -> bool:
+        return self.config.router.enable_gpu  # CK001 via self.config
+
+
+class GatewayLike:
+    def __init__(self, config: Dict):
+        self.config = config
+
+    def start(self):
+        host = self.config.get("bind", "0.0.0.0")
+        port = self.config.get("prot", 1883)  # CK002: typo'd opt key
+        return host, port

@@ -1,0 +1,54 @@
+"""Jit-purity fixture: impurities reachable from jit/shard_map roots."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+CACHE = {}
+
+
+def helper_sync(x):
+    return x.sum().item()  # JP001 (reachable via kernel -> helper_sync)
+
+
+def helper_cast(x):
+    return float(jnp.max(x))  # JP002
+
+
+def helper_clock(x):
+    return x * time.time()  # JP004
+
+
+def helper_mutates(x):
+    CACHE["last"] = x  # JP003
+    return x
+
+
+def helper_branches(x):
+    if jnp.any(x > 0):  # JP005
+        return x
+    return -x
+
+
+def kernel(x):
+    y = helper_sync(x)
+    y = y + helper_cast(x)
+    y = y + helper_clock(x)
+    helper_mutates(x)
+    return helper_branches(x) + y
+
+
+kernel_jit = jax.jit(kernel)
+
+
+def scan_body(carry, x):
+    CACHE["n"] = carry  # JP003: reachable as a lax.scan body argument
+    return carry, x
+
+
+def outer(xs):
+    return jax.lax.scan(scan_body, 0, xs)
+
+
+outer_jit = jax.jit(outer)

@@ -1,0 +1,27 @@
+"""Jit-purity fixture: pure kernels and host-side code left alone."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def pure_helper(x, n):
+    # static python ints (shape params) are fine to branch on
+    if n > 4:
+        x = x * 2
+    return jnp.where(x > 0, x, -x)
+
+
+def kernel(x, n=8):
+    return pure_helper(x, n).sum()
+
+
+kernel_jit = jax.jit(kernel)
+
+
+def host_side(x):
+    # NOT reachable from any jit root: host code may sync and read clocks
+    t0 = time.time()
+    v = x.item()
+    return v, time.time() - t0

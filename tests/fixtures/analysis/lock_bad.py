@@ -1,0 +1,47 @@
+"""Lock-discipline fixture: every access pattern the checker must flag."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._n = 0  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def bump(self):
+        self._n += 1  # LK001: write outside the lock
+
+    def read(self):
+        return self._n  # LK001: read outside the lock
+
+    def locked_then_not(self):
+        with self._lock:
+            self._n += 1  # fine
+        self._n += 1  # LK001: after the with block
+
+
+class RegistryStyle:
+    GUARDED_BY = {"_table": "_mu"}
+
+    def __init__(self):
+        self._table = {}
+        self._mu = threading.Lock()
+
+    def put(self, k, v):
+        self._table[k] = v  # LK001: GUARDED_BY route
+
+
+class MissingLock:
+    def __init__(self):
+        self._x = 1  # guarded-by: _lock_that_does_not_exist
+
+
+class WrongLock:
+    def __init__(self):
+        self._a = 0  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+
+    def oops(self):
+        with self._other:
+            self._a += 1  # LK001: held the WRONG lock

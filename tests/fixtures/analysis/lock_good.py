@@ -1,0 +1,45 @@
+"""Lock-discipline fixture: compliant patterns the checker must accept."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._n = 0  # guarded-by: _lock
+        self._free = 0  # unguarded attr: never checked
+        self._lock = threading.Lock()
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+
+    def read_free(self):
+        return self._free
+
+    def _bump_locked(self):  # holds-lock: _lock
+        self._n += 1
+
+    def nested_ok(self):
+        with self._lock:
+            def helper():
+                return self._n  # lexically under the with: fine
+            return helper()
+
+    def suppressed(self):
+        return self._n  # lint: disable=LK001
+
+
+class RegistryStyle:
+    GUARDED_BY = {"_table": "_mu"}
+
+    def __init__(self):
+        self._table = {}
+        self._mu = threading.Lock()
+
+    def put(self, k, v):
+        with self._mu:
+            self._table[k] = v

@@ -1,0 +1,29 @@
+"""Metric-name fixture: declares a tiny registry, then drifts from it."""
+
+COUNTER = "counter"
+
+
+def declare(name, kind, help=""):
+    pass
+
+
+declare("messages.received", COUNTER)
+declare("messages.dropped", COUNTER)
+
+
+class M:
+    def inc(self, name, n=1):
+        pass
+
+    def gauge_set(self, name, v):
+        pass
+
+
+def good(m: M):
+    m.inc("messages.received")
+    m.inc("messages.dropped", 2)
+
+
+def bad(m: M):
+    m.inc("messages.recieved")  # MN001: typo'd series
+    m.gauge_set("sessions.active", 1)  # MN001: never declared

@@ -1,0 +1,193 @@
+"""tpu_lint (tools/analysis): fixture-driven checker tests + the tier-1
+run-on-repo gate.
+
+The repo gate is the contract from the static-analysis PR: `emqx_tpu/`
+stays clean of non-baseline findings — deleting a `with self._lock:`
+around a guarded attribute, adding `time.sleep` to an `async def`,
+typo'ing a config field or metric series name all fail this test.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.analysis import Baseline, run_analysis  # noqa: E402
+
+FIXTURES = ROOT / "tests" / "fixtures" / "analysis"
+
+
+def codes_by_file(report):
+    out = {}
+    for f in report.findings:
+        out.setdefault(Path(f.path).name, set()).add(f.code)
+    return out
+
+
+def run_fixtures(checks):
+    return run_analysis(FIXTURES, checks=checks)
+
+
+# -- lock discipline --------------------------------------------------------
+
+def test_lock_checker_flags_unlocked_access():
+    report = run_fixtures(["lock"])
+    by_file = codes_by_file(report)
+    assert "LK001" in by_file.get("lock_bad.py", set())
+    assert "LK002" in by_file.get("lock_bad.py", set())
+    bad = [
+        f for f in report.findings
+        if f.path.endswith("lock_bad.py") and f.code == "LK001"
+    ]
+    # bump, read, locked_then_not, RegistryStyle.put, WrongLock.oops
+    assert len(bad) == 5, [f.render() for f in bad]
+    assert {f.symbol for f in bad} == {
+        "Counter.bump", "Counter.read", "Counter.locked_then_not",
+        "RegistryStyle.put", "WrongLock.oops",
+    }
+
+
+def test_lock_checker_accepts_compliant_and_annotated():
+    report = run_fixtures(["lock"])
+    good = [f for f in report.findings if f.path.endswith("lock_good.py")]
+    assert not good, [f.render() for f in good]
+    # the inline `# lint: disable=LK001` in lock_good.py was counted
+    assert report.suppressed >= 1
+
+
+# -- async blocking ---------------------------------------------------------
+
+def test_async_checker_flags_blocking_calls():
+    report = run_fixtures(["async"])
+    bad = {
+        (f.code, f.symbol)
+        for f in report.findings
+        if f.path.endswith("async_bad.py")
+    }
+    assert ("AB001", "sleepy") in bad
+    assert ("AB001", "sleepy_from_import") in bad  # from-import alias
+    assert ("AB002", "fetch") in bad
+    assert ("AB002", "resolve") in bad
+    assert ("AB003", "slurp") in bad
+    assert ("AB004", "shell") in bad
+    assert ("AB004", "sysexec") in bad
+    assert ("AB005", "block_on") in bad
+
+
+def test_async_checker_accepts_executor_and_sync_code():
+    report = run_fixtures(["async"])
+    good = [f for f in report.findings if f.path.endswith("async_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+# -- jit purity -------------------------------------------------------------
+
+def test_jit_checker_flags_reachable_impurities():
+    report = run_fixtures(["jit"])
+    bad = {
+        (f.code, f.symbol)
+        for f in report.findings
+        if f.path.endswith("jit_bad.py")
+    }
+    assert ("JP001", "helper_sync") in bad
+    assert ("JP002", "helper_cast") in bad
+    assert ("JP003", "helper_mutates") in bad
+    assert ("JP004", "helper_clock") in bad
+    assert ("JP005", "helper_branches") in bad
+    # reachable because it is passed BY NAME to lax.scan inside a root
+    assert ("JP003", "scan_body") in bad
+
+
+def test_jit_checker_ignores_host_side_code():
+    report = run_fixtures(["jit"])
+    good = [f for f in report.findings if f.path.endswith("jit_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+# -- config keys ------------------------------------------------------------
+
+def test_config_checker_flags_drift_and_dead_keys():
+    report = run_fixtures(["config"])
+    bad = {
+        (f.code, f.detail)
+        for f in report.findings
+        if f.path.endswith("config_fixture.py")
+    }
+    assert ("CK001", "RouterConfig.min_btach") in bad
+    assert ("CK001", "RouterConfig.enable_gpu") in bad  # via self.config
+    assert ("CK002", "prot") in bad
+    assert ("CK003", "never_read_anywhere") in bad
+    # compliant reads (fields, methods, declared opt keys) stay silent
+    details = {d for _, d in bad}
+    assert "RouterConfig.enable_tpu" not in details
+    assert "RouterConfig.effective_batch" not in details
+    assert "bind" not in details
+
+
+# -- metric names -----------------------------------------------------------
+
+def test_metric_checker_flags_undeclared_series():
+    report = run_fixtures(["metrics"])
+    bad = {
+        f.detail for f in report.findings
+        if f.path.endswith("metrics_fixture.py")
+    }
+    assert bad == {"messages.recieved", "sessions.active"}
+
+
+# -- the tier-1 repo gate ---------------------------------------------------
+
+def test_repo_is_clean_of_non_baseline_findings():
+    baseline = Baseline.load(ROOT / "tools" / "analysis" / "baseline.json")
+    report = run_analysis(ROOT / "emqx_tpu", baseline=baseline)
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+    # the baseline must not rot: every entry still matches a real finding
+    assert not report.stale_baseline, report.stale_baseline
+
+
+def test_repo_scan_is_fast_enough_for_ci():
+    report = run_analysis(ROOT / "emqx_tpu")
+    assert report.elapsed < 30.0, report.elapsed
+    assert report.files > 100  # it really scanned the tree
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *args],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_exit_codes_and_json():
+    # findings -> 1, with machine-readable output
+    p = _cli(str(FIXTURES), "--format", "json", "--no-baseline")
+    assert p.returncode == 1, p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["clean"] is False
+    assert {f["code"] for f in doc["findings"]} >= {
+        "LK001", "AB001", "JP001", "CK001", "MN001",
+    }
+    # clean tree -> 0 (the metrics fixture's good half, checked alone,
+    # has no violations in lock scope)
+    p = _cli(str(FIXTURES), "--checks", "lock", "--format", "json")
+    assert p.returncode == 1  # lock_bad still fails
+    # internal error (bogus root) -> 2
+    p = _cli(str(FIXTURES / "does_not_exist"))
+    assert p.returncode == 2
+
+
+def test_cli_clean_tree_exits_zero(tmp_path):
+    mod = tmp_path / "clean.py"
+    mod.write_text("def fine():\n    return 1\n")
+    p = _cli(str(tmp_path))
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 finding(s)" in p.stdout

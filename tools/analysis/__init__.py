@@ -1,0 +1,32 @@
+"""tpu_lint: project-specific AST static analysis for emqx_tpu.
+
+`python -m tools.analysis` runs five checkers over `emqx_tpu/` and fails
+(exit 1) on any finding not recorded in the checked-in baseline:
+
+- lock discipline (LK*): attributes annotated `# guarded-by: <lock>` (or
+  listed in a class-level `GUARDED_BY` dict) may only be touched inside
+  `with self.<lock>:` blocks — the PR 1 gauge-bypass bug class;
+- async blocking calls (AB*): `time.sleep`, sync socket/file I/O,
+  `requests.*`, bare `Future.result()`, subprocess, sync DB clients inside
+  `async def` bodies — anything that stalls the broker's event loop;
+- jit purity (JP*): functions reachable from `jax.jit` / `shard_map` call
+  sites must not sync to host (`.item()`), cast tracers to Python
+  scalars, mutate globals, read wall-clock/RNG, or branch on tracer
+  truthiness — trace-impurity breaks TrieJax-style kernel caching;
+- config-key drift (CK*): attribute paths on typed `AppConfig` dataclass
+  trees must exist in `config/schema.py`; gateway `config.get("key")`
+  reads must name a declared gateway opt key; schema keys nothing reads
+  are reported as dead;
+- metric names (MN*): every static `metrics.inc/observe/gauge_set` series
+  name must be `declare()`d in the metric-kind registry (the former
+  `tools/check_metric_names.py`, now a checker here).
+
+See docs/static_analysis.md for codes, suppression, and extension.
+"""
+
+from tools.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Report,
+    run_analysis,
+)

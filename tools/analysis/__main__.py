@@ -1,0 +1,109 @@
+"""CLI: `python -m tools.analysis [root] [options]`.
+
+Exit-code contract (wired into CI):
+  0  clean (no non-baseline findings)
+  1  findings
+  2  internal analyzer error
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+from pathlib import Path
+
+from tools.analysis.core import Baseline, run_analysis
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+DEFAULT_ROOT = Path(__file__).resolve().parents[2] / "emqx_tpu"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="tpu_lint: project static analysis for emqx_tpu",
+    )
+    p.add_argument(
+        "root", nargs="?", default=None,
+        help=f"tree to scan (default: {DEFAULT_ROOT})",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (default text)",
+    )
+    p.add_argument(
+        "--checks", default=None,
+        help="comma-separated subset of checks to run "
+             "(lock,async,jit,config,metrics)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE}; only applied "
+        "when scanning the default root unless given explicitly)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="record current non-baseline findings into the baseline "
+        "file (new entries get a TODO justification to fill in)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    root = Path(args.root) if args.root else DEFAULT_ROOT
+    if not root.is_dir():
+        print(f"error: scan root {root} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = None
+    if args.baseline:
+        baseline_path = Path(args.baseline)
+    elif root.resolve() == DEFAULT_ROOT.resolve():
+        baseline_path = DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline = Baseline(path=baseline_path)
+    elif baseline_path is not None:
+        baseline = Baseline.load(baseline_path)
+    else:
+        baseline = Baseline()
+
+    checks = (
+        [c.strip() for c in args.checks.split(",") if c.strip()]
+        if args.checks else None
+    )
+    try:
+        report = run_analysis(root, baseline=baseline, checks=checks)
+    except Exception:
+        traceback.print_exc()
+        return 2
+
+    if args.write_baseline:
+        target = baseline_path or DEFAULT_BASELINE
+        for f in report.findings:
+            baseline.entries.setdefault(
+                f.fingerprint, "TODO: justify this grandfathered finding"
+            )
+        baseline.save(target)
+        print(
+            f"baseline: {len(report.findings)} finding(s) recorded into "
+            f"{target}"
+        )
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        print(report.render_text())
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

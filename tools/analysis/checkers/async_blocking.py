@@ -1,0 +1,143 @@
+"""AB: blocking calls inside `async def` bodies.
+
+One stalled coroutine stalls the whole broker — ingest batching, PINGREQ
+deadlines, and the device dispatch pipeline all share the loop. The
+checker walks every async function body (there are ~350 across broker/,
+transport/, gateway/, mgmt/) and flags calls that are known to block the
+thread. Nested *sync* defs and lambdas are skipped: they are usually
+`run_in_executor` / `to_thread` thunks, which is exactly where blocking
+calls belong.
+
+Codes:
+  AB001  time.sleep                      -> use `await asyncio.sleep`
+  AB002  sync network I/O (requests/urllib/socket/http.client/smtplib)
+  AB003  sync file I/O (builtin open, os.fsync)
+  AB004  subprocess / os.system
+  AB005  bare Future.result() (blocks; asyncio results want `await`)
+  AB006  sync DB clients (sqlite3/psycopg2/pymongo/mysql.connector)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    enclosing_symbols,
+    import_aliases,
+    resolve_call_name,
+)
+
+# canonical dotted name (exact) -> code
+EXACT = {
+    "time.sleep": "AB001",
+    "socket.create_connection": "AB002",
+    "socket.getaddrinfo": "AB002",
+    "socket.gethostbyname": "AB002",
+    "urllib.request.urlopen": "AB002",
+    "open": "AB003",
+    "io.open": "AB003",
+    "os.fsync": "AB003",
+    "os.system": "AB004",
+    "subprocess.run": "AB004",
+    "subprocess.call": "AB004",
+    "subprocess.check_call": "AB004",
+    "subprocess.check_output": "AB004",
+    "sqlite3.connect": "AB006",
+}
+
+# canonical dotted prefix -> code
+PREFIXES = {
+    "requests.": "AB002",
+    "http.client.": "AB002",
+    "smtplib.": "AB002",
+    "ftplib.": "AB002",
+    "telnetlib.": "AB002",
+    "psycopg2.": "AB006",
+    "pymongo.": "AB006",
+    "mysql.connector.": "AB006",
+}
+
+_MESSAGES = {
+    "AB001": "blocking time.sleep in async code (use asyncio.sleep)",
+    "AB002": "synchronous network I/O on the event loop",
+    "AB003": "synchronous file I/O on the event loop",
+    "AB004": "subprocess/system call blocks the event loop",
+    "AB005": "bare Future.result() blocks (await it, or it is a sync "
+             "future that belongs in an executor)",
+    "AB006": "synchronous DB client call on the event loop",
+}
+
+
+class AsyncBlockingChecker(Checker):
+    name = "async"
+    codes = {
+        code: msg for code, msg in _MESSAGES.items()
+    }
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        aliases = import_aliases(mod.tree)
+        symbols = enclosing_symbols(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan_async_body(
+                    mod, node, aliases,
+                    symbols.get(node, node.name), findings,
+                )
+        return findings
+
+    def _scan_async_body(self, mod, fn, aliases, symbol, findings) -> None:
+        for stmt in fn.body:
+            self._walk(mod, stmt, aliases, symbol, findings)
+
+    def _walk(self, mod, node, aliases, symbol, findings) -> None:
+        # nested defs/lambdas run elsewhere (executor thunks, callbacks):
+        # they are not awaited in this body, so skip them — nested async
+        # defs get their own top-level visit
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            code, name = self._classify(node, aliases)
+            if code is not None:
+                findings.append(Finding(
+                    code=code,
+                    path=mod.rel,
+                    line=node.lineno,
+                    symbol=symbol,
+                    detail=name,
+                    message=f"{name}: {_MESSAGES[code]}",
+                ))
+        for child in ast.iter_child_nodes(node):
+            self._walk(mod, child, aliases, symbol, findings)
+
+    def _classify(self, call: ast.Call, aliases) -> tuple:
+        name = resolve_call_name(call.func, aliases)
+        if name is not None:
+            if name in EXACT:
+                return EXACT[name], name
+            for prefix, code in PREFIXES.items():
+                if name.startswith(prefix):
+                    return code, name
+        # <expr>.result() with no args: concurrent.futures blocking read
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "result"
+            and not call.args
+            and not call.keywords
+        ):
+            return "AB005", self._recv_name(call.func) or "result"
+        return None, None
+
+    @staticmethod
+    def _recv_name(func: ast.Attribute) -> Optional[str]:
+        base = func.value
+        if isinstance(base, ast.Name):
+            return f"{base.id}.result"
+        if isinstance(base, ast.Attribute):
+            return f"{base.attr}.result"
+        return "result"

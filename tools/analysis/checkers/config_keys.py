@@ -1,0 +1,396 @@
+"""CK: config-key drift against the `config/schema.py` dataclass tree.
+
+The AppConfig dataclass tree is the single source of config truth
+(schema, defaults, REST payload, env overrides). Python only catches a
+misspelled field when the code path actually runs; this checker catches
+it statically:
+
+  CK001  attribute path on a typed dataclass object that the schema
+         does not declare (`cfg.router.ingest_windw_us`)
+  CK002  string config-key read (`config.get("...")` in the gateway
+         layer, or a dotted `cfg.get("a.b")`) not declared in the
+         schema (gateway keys: `GATEWAY_OPT_KEYS` in config/schema.py)
+  CK003  schema key nothing in emqx_tpu/ ever reads (dead key)
+
+Typing is inferred, never guessed: a chain is only validated when its
+root is (a) a parameter/variable annotated with a known dataclass, or
+(b) `self.X` where `__init__` assigns X from such a parameter or a
+dataclass constructor. Everything else is left alone — gateway `config`
+dicts, channel/session configs on untyped paths, etc.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+_MESSAGES = {
+    "CK001": "config attribute not declared in the schema",
+    "CK002": "string config key not declared in the schema",
+    "CK003": "schema key is never read anywhere (dead key)",
+}
+
+
+class _DcInfo:
+    __slots__ = ("name", "fields", "members", "mod", "lines",
+                 "_raw_annotations")
+
+    def __init__(self, name: str, mod: ParsedModule):
+        self.name = name
+        self.mod = mod
+        self.fields: Dict[str, Optional[str]] = {}  # field -> dc type name
+        self.members: Set[str] = set()  # methods/properties/class attrs
+        self.lines: Dict[str, int] = {}
+
+
+def _is_dataclass_decorated(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == "dataclass":
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == "dataclass":
+            return True
+    return False
+
+
+class ConfigKeyChecker(Checker):
+    name = "config"
+    codes = dict(_MESSAGES)
+
+    ROOT_CLASS = "AppConfig"
+    GATEWAY_KEY_REGISTRY = "GATEWAY_OPT_KEYS"
+    # modules whose `*.config.get("key")` reads are checked against the
+    # gateway opt-key registry
+    GATEWAY_SCOPES = ("/gateway/", "/transport/dtls.py")
+
+    # -- cross-module collection -------------------------------------------
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._dcs: Dict[str, _DcInfo] = {}
+        self._gateway_keys: Set[str] = set()
+        self._attr_reads: Set[str] = set()
+        self._str_consts: Set[str] = set()
+
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) and \
+                        _is_dataclass_decorated(node):
+                    self._collect_dataclass(mod, node)
+                elif isinstance(node, ast.Attribute):
+                    self._attr_reads.add(node.attr)
+                elif isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    self._str_consts.add(node.value)
+                elif (
+                    isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name)
+                        and t.id == self.GATEWAY_KEY_REGISTRY
+                        for t in node.targets
+                    )
+                ):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            self._gateway_keys.add(sub.value)
+
+        self._resolve_field_types()
+        # only the dataclasses reachable from AppConfig are *config*
+        # classes; chains on other dataclasses (Message, wire frames...)
+        # are not config reads and are left alone
+        self._config_classes: Set[str] = set()
+        work = [self.ROOT_CLASS]
+        while work:
+            cname = work.pop()
+            if cname in self._config_classes or cname not in self._dcs:
+                continue
+            self._config_classes.add(cname)
+            work.extend(
+                t for t in self._dcs[cname].fields.values() if t
+            )
+
+    def _collect_dataclass(self, mod: ParsedModule, cls: ast.ClassDef):
+        info = _DcInfo(cls.name, mod)
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                info.fields[stmt.target.id] = None  # resolved later
+                info.lines[stmt.target.id] = stmt.lineno
+                info.members.add(stmt.target.id)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.members.add(stmt.name)
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        info.members.add(t.id)
+        # store annotation name candidates for second pass
+        info._raw_annotations = {  # type: ignore[attr-defined]
+            stmt.target.id: stmt.annotation
+            for stmt in cls.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+        }
+        self._dcs[cls.name] = info
+
+    def _resolve_field_types(self) -> None:
+        for info in self._dcs.values():
+            raw = getattr(info, "_raw_annotations", {})
+            for fname, ann in raw.items():
+                names = [
+                    n.id for n in ast.walk(ann) if isinstance(n, ast.Name)
+                ]
+                dc = next((n for n in names if n in self._dcs), None)
+                info.fields[fname] = dc
+
+    def _ann_dc(self, ann) -> Optional[str]:
+        """Config-class name when the annotation IS that class (directly,
+        or `Optional[C]`); containers (`List[C]`, `Dict[str, C]`) do NOT
+        type the variable as C."""
+        if isinstance(ann, ast.Name) and ann.id in self._config_classes:
+            return ann.id
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str) \
+                and ann.value in self._config_classes:
+            return ann.value
+        if (
+            isinstance(ann, ast.Subscript)
+            and isinstance(ann.value, ast.Name)
+            and ann.value.id == "Optional"
+        ):
+            return self._ann_dc(ann.slice)
+        return None
+
+    # -- per-module checks --------------------------------------------------
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                attr_types = self._class_attr_types(node)
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._check_function(
+                            mod, item, f"{node.name}.{item.name}",
+                            attr_types, findings,
+                        )
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(
+                    mod, node, node.name, {}, findings
+                )
+        if self._applies_gateway_scope(mod):
+            self._check_string_keys(mod, findings)
+        return findings
+
+    def _applies_gateway_scope(self, mod: ParsedModule) -> bool:
+        probe = "/" + mod.rel
+        return any(s in probe for s in self.GATEWAY_SCOPES)
+
+    # annotated-parameter / constructor typing for `self.X`
+    def _class_attr_types(self, cls: ast.ClassDef) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        init = next(
+            (
+                s for s in cls.body
+                if isinstance(s, ast.FunctionDef) and s.name == "__init__"
+            ),
+            None,
+        )
+        if init is None:
+            return out
+        param_types = self._annotated_params(init)
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                continue
+            dc = self._expr_dc_type(node.value, param_types)
+            if dc is not None:
+                out[t.attr] = dc
+        return out
+
+    def _annotated_params(self, fn) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        args = list(fn.args.posonlyargs) + list(fn.args.args) + \
+            list(fn.args.kwonlyargs)
+        for a in args:
+            if a.annotation is None:
+                continue
+            dc = self._ann_dc(a.annotation)
+            if dc is not None:
+                out[a.arg] = dc
+        return out
+
+    def _expr_dc_type(self, expr, param_types: Dict[str, str]) \
+            -> Optional[str]:
+        """Type of an expression when confidently a known dataclass."""
+        if isinstance(expr, ast.Name):
+            return param_types.get(expr.id)
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+                and expr.func.id in self._config_classes:
+            return expr.func.id
+        if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.Or):
+            # `config or AppConfig()` — all branches must agree
+            kinds = {
+                self._expr_dc_type(v, param_types) for v in expr.values
+            }
+            kinds.discard(None)
+            if len(kinds) == 1:
+                return kinds.pop()
+        return None
+
+    def _check_function(self, mod, fn, symbol, attr_types, findings):
+        param_types = self._annotated_params(fn)
+        # local annotated variables
+        local_types = dict(param_types)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                dc = self._ann_dc(node.annotation)
+                if dc is not None:
+                    local_types[node.target.id] = dc
+
+        # only outermost attribute of each chain (inner nodes are the
+        # `.value` of another Attribute)
+        inner = {
+            id(n.value) for n in ast.walk(fn)
+            if isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Attribute)
+        }
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Attribute) or id(node) in inner:
+                continue
+            chain: List[str] = []
+            base = node
+            while isinstance(base, ast.Attribute):
+                chain.append(base.attr)
+                base = base.value
+            chain.reverse()
+            root_type = None
+            if isinstance(base, ast.Name):
+                root_type = local_types.get(base.id)
+            if root_type is None and (
+                isinstance(base, ast.Name) and base.id == "self"
+                and chain and chain[0] in attr_types
+            ):
+                root_type = attr_types[chain[0]]
+                chain = chain[1:]
+            if root_type is None or not chain:
+                continue
+            self._validate_chain(
+                mod, node, symbol, root_type, chain, findings
+            )
+
+    def _validate_chain(self, mod, node, symbol, root_type, chain,
+                        findings):
+        cur = self._dcs.get(root_type)
+        consumed: List[str] = []
+        for attr in chain:
+            if cur is None:
+                return
+            consumed.append(attr)
+            if attr in cur.fields:
+                nxt = cur.fields[attr]
+                cur = self._dcs.get(nxt) if nxt else None
+                continue
+            if attr in cur.members:
+                return  # method/property/class attr: fine, stop typing
+            findings.append(Finding(
+                code="CK001",
+                path=mod.rel,
+                line=node.lineno,
+                symbol=symbol,
+                detail=f"{cur.name}.{attr}",
+                message=(
+                    f"{'.'.join([root_type] + consumed)}: {attr!r} is not "
+                    f"a field of {cur.name} (config/schema.py drift)"
+                ),
+            ))
+            return
+
+    # -- CK002: string keys -------------------------------------------------
+    def _check_string_keys(self, mod: ParsedModule, findings) -> None:
+        from tools.analysis.core import enclosing_symbols
+
+        syms = enclosing_symbols(mod.tree)
+
+        def nearest_symbol(target):
+            best = "<module>"
+            for n, s in syms.items():
+                if (
+                    n.lineno <= target.lineno
+                    and getattr(n, "end_lineno", 1 << 30) >=
+                    (target.end_lineno or target.lineno)
+                ):
+                    best = s
+            return best
+
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            recv = node.func.value
+            recv_attr = (
+                recv.attr if isinstance(recv, ast.Attribute)
+                else recv.id if isinstance(recv, ast.Name) else ""
+            )
+            if recv_attr not in ("config", "cfg"):
+                continue
+            key = node.args[0].value
+            if key in self._gateway_keys:
+                continue
+            findings.append(Finding(
+                code="CK002",
+                path=mod.rel,
+                line=node.lineno,
+                symbol=nearest_symbol(node),
+                detail=key,
+                message=(
+                    f"config key {key!r} not declared in "
+                    f"config/schema.py {self.GATEWAY_KEY_REGISTRY}"
+                ),
+            ))
+
+    # -- CK003: dead keys ---------------------------------------------------
+    def finalize(self) -> Iterable[Finding]:
+        if self.ROOT_CLASS not in self._dcs:
+            return ()
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+        work = [self.ROOT_CLASS]
+        while work:
+            cname = work.pop()
+            if cname in seen:
+                continue
+            seen.add(cname)
+            info = self._dcs[cname]
+            for fname, ftype in info.fields.items():
+                if ftype:
+                    work.append(ftype)
+                    continue  # container nodes are "read" via their leaves
+                if fname in self._attr_reads or fname in self._str_consts:
+                    continue
+                findings.append(Finding(
+                    code="CK003",
+                    path=info.mod.rel,
+                    line=info.lines.get(fname, 1),
+                    symbol=cname,
+                    detail=fname,
+                    message=(
+                        f"schema key {cname}.{fname} is never read "
+                        "anywhere in the scanned tree (dead key?)"
+                    ),
+                ))
+        return findings

@@ -1,0 +1,322 @@
+"""JP: trace purity for code reachable from `jax.jit` / `shard_map`.
+
+TrieJax-style kernel acceleration only pays off when the route-step
+kernels stay trace-pure: an `.item()` forces a device sync inside the
+step, wall-clock/RNG reads bake one trace's value into every later call
+of the compiled program, global mutation silently runs once at trace
+time, and branching on a tracer raises (or worse, retraces per batch).
+
+The checker finds jit roots — functions decorated with `@jax.jit` /
+`@partial(jax.jit, ...)`, or passed by name to `jax.jit(...)` /
+`shard_map(...)` — and follows the call graph across modules (import-
+alias aware), including function names passed as arguments inside
+reachable code (`lax.scan(body, ...)` bodies). Every reachable function
+body is then screened:
+
+  JP001  .item()/.tolist()/.block_until_ready(): host sync inside trace
+  JP002  float()/int()/bool() over a jnp/jax expression: tracer cast
+  JP003  global mutation (global stmt, or writes to module-level state)
+  JP004  wall-clock / RNG read (time.*, datetime.now, random, os.urandom)
+  JP005  if/while/assert on a jnp/jax expression: tracer truthiness
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    dotted_name,
+    import_aliases,
+    resolve_call_name,
+)
+
+JIT_WRAPPERS = ("jax.jit", "jit", "jax.experimental.shard_map.shard_map",
+                "jax.shard_map", "shard_map")
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+WALLCLOCK = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.process_time", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "os.urandom",
+}
+WALLCLOCK_PREFIXES = ("random.", "numpy.random.")
+MUTATORS = {"append", "add", "update", "extend", "setdefault", "pop",
+            "clear", "insert", "remove", "popitem"}
+
+_MESSAGES = {
+    "JP001": "host sync inside a jitted function",
+    "JP002": "Python scalar cast of a traced jnp/jax expression",
+    "JP003": "global state mutation inside a jitted function (runs once "
+             "at trace time, not per call)",
+    "JP004": "wall-clock/RNG read inside a jitted function (frozen at "
+             "trace time)",
+    "JP005": "truthiness branch on a jnp/jax expression (tracer boolean)",
+}
+
+
+def _module_dotted(rel: str) -> str:
+    dn = rel[:-3].replace("/", ".")
+    if dn.endswith(".__init__"):
+        dn = dn[: -len(".__init__")]
+    return dn
+
+
+class _FnInfo:
+    __slots__ = ("mod", "node", "symbol")
+
+    def __init__(self, mod: ParsedModule, node, symbol: str):
+        self.mod = mod
+        self.node = node
+        self.symbol = symbol
+
+
+class JitPurityChecker(Checker):
+    name = "jit"
+    codes = dict(_MESSAGES)
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        # function tables + aliases + module globals for every module
+        self._aliases: Dict[str, Dict[str, str]] = {}
+        self._funcs: Dict[Tuple[str, str], List[_FnInfo]] = {}
+        self._globals: Dict[str, Set[str]] = {}
+        self._mods: Dict[str, ParsedModule] = {}
+        roots: List[Tuple[str, str]] = []
+
+        for mod in modules:
+            dn = _module_dotted(mod.rel)
+            self._mods[dn] = mod
+            aliases = import_aliases(mod.tree)
+            self._aliases[dn] = aliases
+            g: Set[str] = set()
+            for stmt in mod.tree.body:
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        g.add(t.id)
+            self._globals[dn] = g
+
+            syms: Dict[ast.AST, str] = {}
+
+            def collect(node, prefix):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        sym = (
+                            f"{prefix}.{child.name}" if prefix
+                            else child.name
+                        )
+                        syms[child] = sym
+                        self._funcs.setdefault(
+                            (dn, child.name), []
+                        ).append(_FnInfo(mod, child, sym))
+                        collect(child, sym)
+                    elif isinstance(child, ast.ClassDef):
+                        collect(
+                            child,
+                            f"{prefix}.{child.name}" if prefix
+                            else child.name,
+                        )
+                    else:
+                        collect(child, prefix)
+
+            collect(mod.tree, "")
+            roots.extend(self._find_roots(dn, mod, aliases))
+
+        self._reachable = self._traverse(roots)
+
+    # -- root discovery ----------------------------------------------------
+    def _find_roots(self, dn, mod, aliases) -> List[Tuple[str, str]]:
+        roots: List[Tuple[str, str]] = []
+
+        def is_jit_wrapper(node) -> bool:
+            name = resolve_call_name(node, aliases)
+            # `partial(jax.jit, ...)` decorators
+            if name in ("functools.partial", "partial"):
+                return False
+            return name in JIT_WRAPPERS
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    name = resolve_call_name(target, aliases)
+                    if name in JIT_WRAPPERS:
+                        roots.append((dn, node.name))
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and name in ("functools.partial", "partial")
+                        and dec.args
+                        and resolve_call_name(dec.args[0], aliases)
+                        in JIT_WRAPPERS
+                    ):
+                        roots.append((dn, node.name))
+            elif isinstance(node, ast.Call) and is_jit_wrapper(node.func):
+                for arg in node.args[:1]:
+                    roots.extend(self._ref_targets(dn, arg, aliases))
+        return roots
+
+    def _ref_targets(self, dn, node, aliases) -> List[Tuple[str, str]]:
+        """Resolve a function *reference* (not call) to table keys."""
+        if isinstance(node, ast.Name):
+            canon = aliases.get(node.id)
+            if canon and "." in canon:
+                mod_part, _, fn_part = canon.rpartition(".")
+                return [(mod_part, fn_part), (dn, node.id)]
+            return [(dn, node.id)]
+        dn_full = dotted_name(node)
+        if dn_full:
+            head, _, rest = dn_full.partition(".")
+            canon = aliases.get(head, head)
+            full = f"{canon}.{rest}" if rest else canon
+            mod_part, _, fn_part = full.rpartition(".")
+            return [(mod_part, fn_part)]
+        return []
+
+    # -- reachability ------------------------------------------------------
+    def _traverse(self, roots) -> List[_FnInfo]:
+        seen: Set[Tuple[str, str]] = set()
+        reachable: List[_FnInfo] = []
+        work = [r for r in roots]
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for info in self._funcs.get(key, []):
+                reachable.append(info)
+                dn = _module_dotted(info.mod.rel)
+                work.extend(self._edges(dn, info.node))
+        return reachable
+
+    def _edges(self, dn, fn) -> List[Tuple[str, str]]:
+        aliases = self._aliases[dn]
+        out: List[Tuple[str, str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # direct calls
+            out.extend(self._ref_targets(dn, node.func, aliases))
+            # function names passed as arguments (lax.scan/cond bodies,
+            # shard_map closures): follow them too
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    out.extend(self._ref_targets(dn, arg, aliases))
+        return out
+
+    # -- screening ---------------------------------------------------------
+    def finalize(self) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        flagged: Set[Tuple[str, int, str]] = set()
+        for info in self._reachable:
+            dn = _module_dotted(info.mod.rel)
+            for f in self._screen(dn, info):
+                key = (f.path, f.line, f.code)
+                if key not in flagged:
+                    flagged.add(key)
+                    findings.append(f)
+        return findings
+
+    def _screen(self, dn, info: _FnInfo) -> Iterable[Finding]:
+        mod, fn = info.mod, info.node
+        aliases = self._aliases[dn]
+        mod_globals = self._globals[dn]
+        findings: List[Finding] = []
+
+        def emit(code, node, detail):
+            findings.append(Finding(
+                code=code,
+                path=mod.rel,
+                line=node.lineno,
+                symbol=info.symbol,
+                detail=detail,
+                message=f"{detail}: {_MESSAGES[code]}",
+            ))
+
+        def has_jax_call(node) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = resolve_call_name(sub.func, aliases)
+                    if name and (
+                        name.startswith("jax.")
+                        or name.startswith("jnp.")
+                        or name.startswith("jax.numpy")
+                    ):
+                        return True
+            return False
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                # nested defs are separate reachable entries
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                self._screen_node(
+                    child, aliases, mod_globals, emit, has_jax_call
+                )
+                walk(child)
+
+        walk(fn)
+        return findings
+
+    def _screen_node(self, node, aliases, mod_globals, emit, has_jax_call):
+        if isinstance(node, ast.Global):
+            for n in node.names:
+                emit("JP003", node, f"global {n}")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id in mod_globals \
+                        and base is not t:
+                    emit("JP003", node, base.id)
+        elif isinstance(node, ast.Call):
+            name = resolve_call_name(node.func, aliases)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in HOST_SYNC_METHODS
+            ):
+                emit("JP001", node, f".{node.func.attr}()")
+            elif name in WALLCLOCK or (
+                name is not None
+                and name.startswith(WALLCLOCK_PREFIXES)
+            ):
+                emit("JP004", node, name)
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and has_jax_call(node.args[0])
+            ):
+                emit("JP002", node, f"{node.func.id}(...)")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATORS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in mod_globals
+            ):
+                emit("JP003", node,
+                     f"{node.func.value.id}.{node.func.attr}")
+        elif isinstance(node, (ast.If, ast.While)):
+            if has_jax_call(node.test):
+                emit("JP005", node.test, "if/while")
+        elif isinstance(node, ast.Assert):
+            if has_jax_call(node.test):
+                emit("JP005", node.test, "assert")
+        elif isinstance(node, ast.IfExp):
+            if has_jax_call(node.test):
+                emit("JP005", node.test, "ifexp")

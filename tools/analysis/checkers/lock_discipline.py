@@ -1,0 +1,176 @@
+"""LK: guarded-attribute lock discipline.
+
+An attribute becomes *guarded* two ways:
+
+- a trailing `# guarded-by: <lock>` comment on the line that first
+  assigns it (`self._counters = ...  # guarded-by: _lock`), or
+- a class-level `GUARDED_BY = {"_counters": "_lock", ...}` dict literal.
+
+Every other `self.<attr>` load/store in that class must then sit
+lexically inside `with self.<lock>:`. A method whose *caller* holds the
+lock is annotated with a trailing `# holds-lock: <lock>` on its `def`
+line. `__init__` is exempt (the object is not shared while it is being
+constructed).
+
+This is the PR 1 bug class made mechanical: `Metrics.snapshot` read the
+gauge table without `_lock` while executor threads wrote it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List
+
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(\w+)")
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'attr' when node is `self.attr`, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock"
+    codes = {
+        "LK001": "guarded attribute accessed outside its lock",
+        "LK002": "guarded-by annotation names a lock the class never "
+                 "creates",
+    }
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(mod, node))
+        return findings
+
+    # -- per class ---------------------------------------------------------
+    def _guarded_attrs(self, mod: ParsedModule,
+                       cls: ast.ClassDef) -> Dict[str, str]:
+        """attr -> lock name, from comments and GUARDED_BY."""
+        guarded: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            # GUARDED_BY = {"attr": "lock"} at class level
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "GUARDED_BY"
+                    for t in node.targets
+                )
+                and isinstance(node.value, ast.Dict)
+            ):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        guarded[k.value] = v.value
+            # trailing `# guarded-by: <lock>` on a self.X assignment
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                m = _GUARDED_RE.search(mod.line_text(node.lineno))
+                if m:
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            guarded[attr] = m.group(1)
+        return guarded
+
+    def _check_class(self, mod: ParsedModule,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        guarded = self._guarded_attrs(mod, cls)
+        if not guarded:
+            return ()
+        findings: List[Finding] = []
+        symbol_base = cls.name
+
+        # the lock itself must exist as an attribute somewhere in the class
+        created = {
+            _self_attr(t)
+            for node in ast.walk(cls)
+            if isinstance(node, ast.Assign)
+            for t in node.targets
+        }
+        for attr, lock in sorted(guarded.items()):
+            if lock not in created:
+                findings.append(Finding(
+                    code="LK002",
+                    path=mod.rel,
+                    line=cls.lineno,
+                    symbol=symbol_base,
+                    detail=f"{attr}->{lock}",
+                    message=(
+                        f"attribute {attr!r} is guarded-by {lock!r} but "
+                        f"the class never assigns self.{lock}"
+                    ),
+                ))
+
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            held = set()
+            m = _HOLDS_RE.search(mod.line_text(item.lineno))
+            if m:
+                held.add(m.group(1))
+            self._walk(
+                mod, item, guarded, frozenset(held),
+                f"{symbol_base}.{item.name}", findings,
+            )
+        return findings
+
+    def _walk(self, mod, node, guarded, held, symbol, findings) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_held = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired = {
+                    _self_attr(it.context_expr)
+                    for it in child.items
+                    if _self_attr(it.context_expr)
+                }
+                if acquired:
+                    # the body runs under the lock(s); the item exprs
+                    # themselves (the `self._lock` reads) do not
+                    for it in child.items:
+                        self._walk(
+                            mod, it, guarded, held, symbol, findings
+                        )
+                    for stmt in child.body:
+                        self._walk(
+                            mod, stmt, guarded,
+                            frozenset(held | acquired), symbol, findings,
+                        )
+                    continue
+            attr = _self_attr(child)
+            if attr and attr in guarded and guarded[attr] not in child_held:
+                findings.append(Finding(
+                    code="LK001",
+                    path=mod.rel,
+                    line=child.lineno,
+                    symbol=symbol,
+                    detail=attr,
+                    message=(
+                        f"self.{attr} accessed outside "
+                        f"`with self.{guarded[attr]}:` (guarded-by "
+                        f"{guarded[attr]!r})"
+                    ),
+                ))
+                continue  # don't re-flag sub-attributes of the same access
+            self._walk(mod, child, guarded, child_held, symbol, findings)

@@ -1,0 +1,348 @@
+"""Analyzer core: parsed-module cache, finding model, baseline, runner.
+
+Every checker gets the same `ParsedModule` objects (one `ast.parse` per
+file, shared), emits `Finding`s, and may run a cross-module pre-pass
+(`begin`) and post-pass (`finalize`) — the jit-purity call graph and the
+dead-config-key scan need whole-project views.
+
+Findings are identified by a *fingerprint* that deliberately excludes the
+line number (`code|path|symbol|detail`), so the checked-in baseline
+survives unrelated edits to the same file. Inline suppression:
+`# lint: disable=CODE[,CODE...]` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Z0-9, ]+)")
+
+# generated protobuf modules: huge, machine-written, not ours to lint
+EXCLUDE_GLOBS = ("*_pb2.py",)
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str  # e.g. "LK001"
+    path: str  # posix path relative to the scan root's parent
+    line: int
+    symbol: str  # enclosing "Class.method" / "func" / "<module>"
+    detail: str  # stable token (attr/call/key name) for the fingerprint
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}|{self.path}|{self.symbol}|{self.detail}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.code} [{self.symbol}] "
+            f"{self.message}"
+        )
+
+    def to_json(self) -> Dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "detail": self.detail,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class ParsedModule:
+    """One parsed source file, shared by every checker."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # posix, relative to scan root's parent
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=str(path))
+        except SyntaxError as e:
+            self.syntax_error = e
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def disabled_codes(self, lineno: int) -> frozenset:
+        """Codes suppressed on this physical line via `# lint: disable=`."""
+        m = _DISABLE_RE.search(self.line_text(lineno))
+        if not m:
+            return frozenset()
+        return frozenset(c.strip() for c in m.group(1).split(",") if c.strip())
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        codes = self.disabled_codes(lineno)
+        return code in codes or "ALL" in codes
+
+
+class Checker:
+    """Base checker. Subclasses set `name` + `codes` and override
+    `check` (per module) and/or `begin`/`finalize` (cross-module)."""
+
+    name: str = ""
+    codes: Dict[str, str] = {}
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        pass
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+class Baseline:
+    """Checked-in grandfather list: fingerprint -> justification."""
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None,
+                 path: Optional[Path] = None):
+        self.entries: Dict[str, str] = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls(path=path)
+        data = json.loads(path.read_text())
+        return cls(entries=data.get("entries", {}), path=path)
+
+    def save(self, path: Optional[Path] = None) -> None:
+        path = path or self.path
+        assert path is not None
+        doc = {
+            "version": 1,
+            "note": (
+                "Grandfathered tpu_lint findings. Keys are finding "
+                "fingerprints (code|path|symbol|detail); values JUSTIFY "
+                "why the finding is intentional. New code must not add "
+                "entries without a real justification."
+            ),
+            "entries": dict(sorted(self.entries.items())),
+        }
+        path.write_text(json.dumps(doc, indent=2) + "\n")
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.fingerprint in self.entries
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)  # non-baseline
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    checks: List[str] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    elapsed: float = 0.0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> Dict:
+        return {
+            "clean": self.clean,
+            "files": self.files,
+            "checks": self.checks,
+            "elapsed_seconds": round(self.elapsed, 3),
+            "suppressed": self.suppressed,
+            "baselined": len(self.baselined),
+            "stale_baseline": self.stale_baseline,
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+    def render_text(self) -> str:
+        out = []
+        for f in sorted(self.findings, key=lambda f: (f.path, f.line, f.code)):
+            out.append(f.render())
+        out.append(
+            f"tpu_lint: {len(self.findings)} finding(s), "
+            f"{len(self.baselined)} baselined, {self.suppressed} "
+            f"suppressed, {self.files} files, "
+            f"{self.elapsed:.2f}s [{', '.join(self.checks)}]"
+        )
+        if self.stale_baseline:
+            out.append(
+                f"note: {len(self.stale_baseline)} stale baseline "
+                "entr(y/ies) no longer match any finding — prune them:"
+            )
+            out.extend(f"  {fp}" for fp in self.stale_baseline)
+        return "\n".join(out)
+
+
+def iter_sources(root: Path) -> List[Path]:
+    paths = []
+    for p in sorted(root.rglob("*.py")):
+        if any(p.match(g) for g in EXCLUDE_GLOBS):
+            continue
+        paths.append(p)
+    return paths
+
+
+def parse_modules(root: Path) -> List[ParsedModule]:
+    root = root.resolve()
+    base = root.parent
+    mods = []
+    for p in iter_sources(root):
+        rel = p.relative_to(base).as_posix()
+        mods.append(ParsedModule(p, rel, p.read_text(errors="replace")))
+    return mods
+
+
+def default_checkers() -> List[Checker]:
+    from tools.analysis.checkers.async_blocking import AsyncBlockingChecker
+    from tools.analysis.checkers.config_keys import ConfigKeyChecker
+    from tools.analysis.checkers.jit_purity import JitPurityChecker
+    from tools.analysis.checkers.lock_discipline import LockDisciplineChecker
+    from tools.analysis.checkers.metric_names import MetricNameChecker
+
+    return [
+        LockDisciplineChecker(),
+        AsyncBlockingChecker(),
+        JitPurityChecker(),
+        ConfigKeyChecker(),
+        MetricNameChecker(),
+    ]
+
+
+def run_analysis(
+    root: Path,
+    checkers: Optional[Sequence[Checker]] = None,
+    baseline: Optional[Baseline] = None,
+    checks: Optional[Sequence[str]] = None,
+) -> Report:
+    """Run the selected checkers over every .py under `root`."""
+    t0 = time.monotonic()
+    if checkers is None:
+        checkers = default_checkers()
+    if checks:
+        want = set(checks)
+        unknown = want - {c.name for c in checkers}
+        if unknown:
+            raise ValueError(
+                f"unknown check(s) {sorted(unknown)}; available: "
+                f"{sorted(c.name for c in checkers)}"
+            )
+        checkers = [c for c in checkers if c.name in want]
+    baseline = baseline or Baseline()
+    modules = parse_modules(Path(root))
+    by_rel = {m.rel: m for m in modules}
+
+    raw: List[Finding] = []
+    # parse failures are findings, not crashes: a file the analyzer cannot
+    # see is a file none of the checkers guard
+    for m in modules:
+        if m.syntax_error is not None:
+            raw.append(Finding(
+                code="GEN001",
+                path=m.rel,
+                line=m.syntax_error.lineno or 0,
+                symbol="<module>",
+                detail="syntax-error",
+                message=f"unparseable file: {m.syntax_error.msg}",
+            ))
+    parseable = [m for m in modules if m.tree is not None]
+    for c in checkers:
+        c.begin(parseable)
+    for c in checkers:
+        for m in parseable:
+            raw.extend(c.check(m))
+    for c in checkers:
+        raw.extend(c.finalize())
+
+    report = Report(files=len(modules), checks=[c.name for c in checkers])
+    seen_fps = set()
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.suppressed(f.line, f.code):
+            report.suppressed += 1
+            continue
+        seen_fps.add(f.fingerprint)
+        if f in baseline:
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    report.stale_baseline = sorted(
+        fp for fp in baseline.entries if fp not in seen_fps
+    )
+    report.elapsed = time.monotonic() - t0
+    return report
+
+
+# -- shared AST helpers (used by several checkers) --------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Attribute/Name chain -> 'a.b.c', else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> canonical dotted name, from module-level imports.
+    `import time as t` -> {'t': 'time'};
+    `from time import sleep` -> {'sleep': 'time.sleep'}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def resolve_call_name(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a call target, import-alias aware."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    canon = aliases.get(head, head)
+    return f"{canon}.{rest}" if rest else canon
+
+
+def enclosing_symbols(tree: ast.Module) -> Dict[ast.AST, str]:
+    """Map each function/class def node -> dotted symbol name."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                sym = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = sym
+                walk(child, sym)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out

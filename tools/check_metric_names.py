@@ -1,55 +1,52 @@
 #!/usr/bin/env python
-"""Static metric-name lint: every `metrics.inc/observe/gauge_set` call site
-in emqx_tpu/ must name a series declared in the metric-kind registry
-(emqx_tpu.broker.metrics). Catches typo'd series names at test time —
-a misspelled counter otherwise just creates a silent parallel series that
-no dashboard, exporter, or alarm ever reads.
+"""DEPRECATED thin wrapper: the metric-name lint now lives in
+`tools/analysis` (checker `metrics`, code MN001), alongside the other
+project checkers. Prefer:
 
-Scans with `ast`: any Call whose func is an attribute named inc/observe/
-gauge_set and whose first argument is a string literal. Dynamic names
-(f-strings, variables) are skipped — they must be composed from declared
-prefixes (e.g. matcher.fallback.rows.<cause>, all declared explicitly).
+    python -m tools.analysis --checks metrics
 
-Run directly (exit 1 on violations) or via tests/test_metric_names.py
-(tier-1).
+This wrapper keeps the old entry point and its small API
+(`find_call_sites` / `find_violations` / `main`) working for existing
+invocations (tests/test_metric_names.py, CI scripts). Unlike the old
+script it never imports broker code: the declared set is collected
+statically from the `declare(...)` calls in the scanned tree.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-METHODS = ("inc", "observe", "observe_many", "gauge_set")
+_REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from tools.analysis.checkers.metric_names import (  # noqa: E402
+    call_sites,
+    declared_names,
+)
+from tools.analysis.core import parse_modules  # noqa: E402
 
 
 def find_call_sites(root: Path):
     """-> [(path, lineno, name)] for every static-name metric call."""
     sites = []
-    for path in sorted(root.rglob("*.py")):
-        try:
-            tree = ast.parse(path.read_text(), filename=str(path))
-        except SyntaxError as e:
-            sites.append((path, e.lineno or 0, f"<unparseable: {e.msg}>"))
+    for mod in parse_modules(Path(root)):
+        if mod.syntax_error is not None:
+            sites.append((
+                mod.path, mod.syntax_error.lineno or 0,
+                f"<unparseable: {mod.syntax_error.msg}>",
+            ))
             continue
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in METHODS
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-            ):
-                sites.append((path, node.lineno, node.args[0].value))
+        for lineno, name in call_sites(mod):
+            sites.append((mod.path, lineno, name))
     return sites
 
 
 def find_violations(root: Path):
     """-> [(path, lineno, name)] of call sites naming undeclared series."""
-    from emqx_tpu.broker.metrics import registry
-
-    declared = set(registry())
+    mods = [m for m in parse_modules(Path(root)) if m.tree is not None]
+    declared = declared_names(mods)
     return [
         (path, lineno, name)
         for path, lineno, name in find_call_sites(root)
@@ -58,10 +55,12 @@ def find_violations(root: Path):
 
 
 def main(argv) -> int:
-    root = Path(argv[1]) if len(argv) > 1 else (
-        Path(__file__).resolve().parents[1] / "emqx_tpu"
+    print(
+        "note: tools/check_metric_names.py is deprecated; use "
+        "`python -m tools.analysis --checks metrics`",
+        file=sys.stderr,
     )
-    sys.path.insert(0, str(root.parent))
+    root = Path(argv[1]) if len(argv) > 1 else (_REPO_ROOT / "emqx_tpu")
     bad = find_violations(root)
     if not bad:
         print(f"metric names OK ({len(find_call_sites(root))} call sites)")
