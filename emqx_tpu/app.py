@@ -183,6 +183,8 @@ class BrokerApp:
                 frontier=c.router.frontier,
                 max_matches=c.router.max_matches,
                 max_bytes=c.router.max_bytes,
+                fanout_compact=c.router.fanout_compact,
+                fanout_slots=c.router.fanout_slots,
             ),
             min_tpu_batch=c.router.min_tpu_batch,
             enable_tpu=c.router.enable_tpu,

@@ -391,7 +391,18 @@ class Broker:
     def _dispatch_device_results(
         self, msgs, results, forward: bool = True
     ) -> List[int]:
-        matched, _mcount, flags, bitmaps, picks = results
+        """Fan one routed batch out to local subscribers.
+
+        `results` is a `RouteResult`. On the compact path
+        (`results.slots`) non-overflow rows dispatch straight from their
+        slot-id lists — zero `unpackbits` — while overflow rows decode
+        the dense rows of the masked second transfer; with compaction
+        off every row decodes `results.bitmaps`. The match/fid memos are
+        PER BATCH: the same (topic, filter) staleness re-verify and the
+        same fid -> (name, has_groups) resolution used to repeat once
+        per delivery."""
+        matched, flags = results.matched, results.flags
+        picks = results.picks
         r = self.router
         fwd = (
             self.cluster.forward_batch_remote(msgs)
@@ -401,6 +412,9 @@ class Broker:
         out: List[int] = []
         fell_back = 0
         touched_gids: set = set()
+        match_memo: Dict[Tuple[str, str], bool] = {}
+        fid_memo: Dict[int, Tuple[Optional[str], bool]] = {}
+        compact = results.slots is not None
         for i, m in enumerate(msgs):
             if flags[i]:
                 fell_back += 1
@@ -412,8 +426,17 @@ class Broker:
                 msg_picks = (
                     (picks[0][i], picks[1][i]) if picks is not None else None
                 )
+                if compact and not results.overflow[i]:
+                    srow = results.slots[i]
+                    bits, slots = None, srow[srow >= 0]
+                elif compact:
+                    bits = results.dense_rows[results.dense_index[i]]
+                    slots = None
+                else:
+                    bits, slots = results.bitmaps[i], None
                 n = self._dispatch_row(
-                    m, bitmaps[i], row[row >= 0], msg_picks, touched_gids
+                    m, bits, row[row >= 0], msg_picks, touched_gids,
+                    slots=slots, match_memo=match_memo, fid_memo=fid_memo,
                 )
             if fwd is not None:
                 n += fwd[i]
@@ -430,22 +453,41 @@ class Broker:
         return out
 
     def _dispatch_row(
-        self, msg: Message, bits: np.ndarray, fids, picks=None,
-        touched_gids: Optional[set] = None,
+        self, msg: Message, bits: Optional[np.ndarray], fids, picks=None,
+        touched_gids: Optional[set] = None, *, slots=None,
+        match_memo: Optional[Dict] = None,
+        fid_memo: Optional[Dict] = None,
     ) -> int:
         """Deliver one routed message from its device outputs: subscriber
-        bitmap -> slots -> plain subs; matched filter ids -> shared groups.
+        slot list (compact path) or bitmap (dense path) -> plain subs;
+        matched filter ids -> shared groups.
         When `picks` is given ((gids, idxs) from the device $share pick),
         group delivery goes straight to the picked member with host-side
         failover only; otherwise the host runs the full pick."""
         self.metrics.inc("messages.received")
+        if match_memo is None:
+            match_memo = {}
+        if fid_memo is None:
+            fid_memo = {}
         n = 0
-        slots = np.nonzero(
-            np.unpackbits(bits.view(np.uint8), bitorder="little")
-        )[0]
-        nslots = len(self._slot_subs)
+        topic = msg.topic
+        if slots is None:
+            # dense decode. ascontiguousarray: readback rows can be
+            # strided (axon backend / fancy-indexed fallback rows) and
+            # ndarray.view raises on non-contiguous buffers
+            if not bits.flags.c_contiguous:
+                bits = np.ascontiguousarray(bits)
+            slots = np.nonzero(
+                np.unpackbits(bits.view(np.uint8), bitorder="little")
+            )[0]
+        else:
+            slots = np.asarray(slots)
+        # batched bounds filter before the Python delivery loop (slots
+        # past the local table can only be another node's lanes)
+        if len(slots):
+            slots = slots[slots < len(self._slot_subs)]
         for slot in slots:
-            sub = self._slot_subs[slot] if slot < nslots else None
+            sub = self._slot_subs[slot]
             if sub is None:
                 continue
             if sub.opts.no_local and sub.client_id == msg.from_client:
@@ -454,8 +496,13 @@ class Broker:
             # filter ids freed during an in-flight batch can be reused by
             # unrelated subscriptions — verify the sub's filter really
             # matches before delivering (misdelivery is worse than a
-            # topic-match check per delivery)
-            if not T.match(msg.topic, sub.filter):
+            # topic-match check per delivery). Memoized per batch: the
+            # match is a pure string function of (topic, filter)
+            ok = match_memo.get((topic, sub.filter))
+            if ok is None:
+                ok = T.match(topic, sub.filter)
+                match_memo[(topic, sub.filter)] = ok
+            if not ok:
                 continue
             n += self._deliver_one(sub, msg)
         if picks is not None:
@@ -469,19 +516,34 @@ class Broker:
                     continue  # group dropped while the batch was in flight
                 real, gname = info
                 # staleness net, same as slots: re-verify the filter
-                if not T.match(msg.topic, real):
+                ok = match_memo.get((topic, real))
+                if ok is None:
+                    ok = T.match(topic, real)
+                    match_memo[(topic, real)] = ok
+                if not ok:
                     continue
                 n += self.shared.dispatch_picked(real, gname, int(idx), msg)
                 if touched_gids is not None:
                     touched_gids.add(int(gid))
         else:
             for fid in fids:
-                name = self.router.filter_name(int(fid))
-                if (
-                    name is not None
-                    and self.shared.has_groups(name)
-                    and T.match(msg.topic, name)
-                ):
+                fid = int(fid)
+                ent = fid_memo.get(fid)
+                if ent is None:
+                    name = self.router.filter_name(fid)
+                    ent = (
+                        name,
+                        name is not None and self.shared.has_groups(name),
+                    )
+                    fid_memo[fid] = ent
+                name, has_g = ent
+                if not has_g:
+                    continue
+                ok = match_memo.get((topic, name))
+                if ok is None:
+                    ok = T.match(topic, name)
+                    match_memo[(topic, name)] = ok
+                if ok:
                     n += self.shared.dispatch_groups(name, msg)
         self.metrics.observe("dispatch.fanout", n)
         if n:

@@ -43,6 +43,12 @@ RATIO_BUCKETS: Tuple[float, ...] = (
 FANOUT_BUCKETS: Tuple[float, ...] = (
     0, 1, 2, 4, 8, 16, 32, 64, 256, 1024, 4096,
 )
+# device->host transfer sizes (bytes; pow4 ladder from 4KB to 256MB —
+# a dense 4096-row bitmap batch at 1M slots is ~512MB, compacted ~1MB)
+READBACK_BUCKETS: Tuple[float, ...] = (
+    4096, 16384, 65536, 262144, 1048576, 4194304,
+    16777216, 67108864, 268435456,
+)
 
 
 @dataclass(frozen=True)
@@ -369,3 +375,13 @@ declare("router.sync.seconds", HISTOGRAM,
 
 declare("dispatch.fanout", HISTOGRAM,
         "deliveries per dispatched message", buckets=FANOUT_BUCKETS)
+declare("dispatch.readback.bytes", HISTOGRAM,
+        "device->host bytes read back per routed batch (compact slot "
+        "lists + masked overflow rows, or full dense bitmaps)",
+        buckets=READBACK_BUCKETS)
+declare("dispatch.compact.rows", COUNTER,
+        "batch rows dispatched from the compact slot list (no dense "
+        "bitmap decode)")
+declare("dispatch.compact.overflow.rows", COUNTER,
+        "rows whose fan-out exceeded the Kslot cap (dense-row fallback "
+        "via the masked second transfer)")

@@ -84,6 +84,14 @@ class RouterConfig:
     frontier: int = 32
     max_matches: int = 64
     max_bytes: int = 256
+    # sparse fan-out compaction (docs/observability.md "readback
+    # budget"): read back O(matches) compact slot lists per batch
+    # instead of dense [B, W] subscriber bitmaps; rows whose fan-out
+    # exceeds the cap fall back to a masked dense transfer
+    fanout_compact: bool = True
+    # per-row compact-slot cap Kslot: 0 = auto-size from the
+    # dispatch.fanout histogram p99 (grow-only, pow2); > 0 pins it
+    fanout_slots: int = 0
     # ingest-side adaptive batch window (broker/ingest.py): collect
     # concurrent publishes into one device route_step
     ingest_enable: bool = True
@@ -582,6 +590,10 @@ def _validate(cfg: AppConfig) -> None:
         raise ConfigError(
             "router.mesh_shape: tp must be a power of two (subscriber "
             "bitmap lanes are power-of-two words)"
+        )
+    if cfg.router.fanout_slots < 0:
+        raise ConfigError(
+            "router.fanout_slots must be >= 0 (0 = auto-size)"
         )
     from emqx_tpu.broker.limiter import TYPES as _LIMITER_TYPES
 

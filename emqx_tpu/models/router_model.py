@@ -22,7 +22,7 @@ arrays, emqx_metrics.erl:439).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +55,59 @@ def fanout_bitmaps(sub_bitmaps, matched):
     )
 
 
+def compact_fanout_slots(bitmaps, kslot: int):
+    """On-device sparse fan-out compaction: set bits -> slot-id lists.
+
+    Makes the device->host readback O(matches) instead of O(B x W):
+    instead of shipping the dense ``[B, W]`` uint32 bitmap matrix, ship
+    ``slots [B, kslot]`` int32 (ascending slot ids, -1 padded),
+    ``count [B]`` (UNCAPPED total set bits), and ``overflow [B]`` (count
+    > kslot: the row's dense bitmap must be fetched instead, so
+    correctness never depends on the cap).
+
+    Two stages keep peak memory O(B * kslot * 32), not O(B * W * 32)
+    (W grows with the connection table; expanding every word's 32 bits
+    first would materialize the whole slot universe per row):
+
+      1. left-pack the NONZERO words (index + value) with the same
+         iota + prefix-sum + capped scatter as the matched-fid
+         compaction (`ops.matcher._compact`). A nonzero word carries
+         >= 1 set bit, so > kslot nonzero words implies count > kslot —
+         word-stage drops only ever happen on rows already flagged
+         overflow;
+      2. expand only the packed words into their 32 candidate slots and
+         left-pack those into the final [B, kslot] buffer.
+    """
+    from emqx_tpu.ops.matcher import _compact
+
+    B, W = bitmaps.shape
+    kw = min(kslot, W)  # a row cannot have more nonzero words than W
+    nz = bitmaps != 0
+    pos = jnp.cumsum(nz.astype(jnp.int32), axis=1) - 1
+    idx = jnp.where(nz & (pos < kw), pos, kw)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    widx = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (B, W))
+    pwidx = jnp.full((B, kw), -1, jnp.int32).at[rows, idx].set(
+        widx, mode="drop"
+    )
+    pword = jnp.zeros((B, kw), jnp.uint32).at[rows, idx].set(
+        bitmaps, mode="drop"
+    )
+    # unpacked holes have pword == 0, so every candidate they produce
+    # is already -1 — no extra validity mask needed
+    bit = (
+        pword[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)
+    ) & jnp.uint32(1)
+    cand = jnp.where(
+        bit.astype(bool),
+        pwidx[:, :, None] * 32 + jnp.arange(32, dtype=jnp.int32),
+        jnp.int32(-1),
+    ).reshape(B, kw * 32)
+    slots, _ = _compact(cand, kslot)
+    count = jnp.sum(popcount32(bitmaps).astype(jnp.int32), axis=1)
+    return slots, count, count > kslot
+
+
 def route_step_impl(
     tables: Dict,
     sub_bitmaps,
@@ -66,11 +119,15 @@ def route_step_impl(
     frontier: int = 32,
     max_matches: int = 64,
     probes: int = 8,
+    kslot: int = 0,
 ):
     """Full forward step: tokenize + match + fanout + stats. Jittable.
 
     Returns dict with matched [B,K], mcount [B], flags [B], bitmaps [B,W],
-    stats {routed, matches, fanout_bits}.
+    stats {routed, matches, fanout_bits}. With ``kslot > 0`` the output
+    additionally carries the sparse fan-out compaction
+    (`compact_fanout_slots`): slots [B, kslot], slot_count [B],
+    overflow [B].
     """
     # cause breakdown is unused on this path (XLA dead-code-eliminates it);
     # the serving path folds all causes into one fallback flag per row
@@ -90,17 +147,23 @@ def route_step_impl(
         "matches": jnp.sum(mcount),
         "fanout_bits": jnp.sum(popcount32(bitmaps).astype(jnp.int32)),
     }
-    return {
+    out = {
         "matched": matched,
         "mcount": mcount,
         "flags": flags,
         "bitmaps": bitmaps,
         "stats": stats,
     }
+    if kslot > 0:
+        slots, scount, sovf = compact_fanout_slots(bitmaps, kslot)
+        out["slots"] = slots
+        out["slot_count"] = scount
+        out["overflow"] = sovf
+    return out
 
 
 route_step = partial(jax.jit, static_argnames=(
-    "salt", "max_levels", "frontier", "max_matches", "probes"
+    "salt", "max_levels", "frontier", "max_matches", "probes", "kslot"
 ))(route_step_impl)
 
 
@@ -126,6 +189,7 @@ def shape_route_step_impl(
     with_groups: bool = False,
     share_strategy: int = 0,
     dp_axis: Optional[str] = None,
+    kslot: int = 0,
 ):
     """The serving-path kernel: shape index + (residual NFA) + fanout.
 
@@ -134,6 +198,12 @@ def shape_route_step_impl(
     when residual filters exist (`with_nfa`), ORs subscriber bitmaps over
     every matched fid. `matched` is SPARSE ([B, M(+K)] with -1 holes), not
     prefix-compacted.
+
+    ``kslot > 0`` adds the sparse fan-out compaction stage
+    (`compact_fanout_slots`): the output dict grows slots [B, kslot] /
+    slot_count [B] / overflow [B], so the host can read back O(matches)
+    compact slot lists and fetch dense bitmap rows only for the
+    (rare, overflow-flagged) rows whose fan-out exceeds the cap.
     """
     import jax.numpy as jnp
 
@@ -189,7 +259,7 @@ def shape_route_step_impl(
         "matches": jnp.sum(mcount),
         "fanout_bits": fanout_bits,
     }
-    return {
+    out = {
         "matched": matched,
         "mcount": mcount,
         "flags": flags,
@@ -198,6 +268,12 @@ def shape_route_step_impl(
         "pick_idx": pick_idx,
         "stats": stats,
     }
+    if kslot > 0 and bitmaps is not None:
+        slots, scount, sovf = compact_fanout_slots(bitmaps, kslot)
+        out["slots"] = slots
+        out["slot_count"] = scount
+        out["overflow"] = sovf
+    return out
 
 
 shape_route_step = partial(
@@ -214,6 +290,7 @@ shape_route_step = partial(
         "with_groups",
         "share_strategy",
         "dp_axis",
+        "kslot",
     ),
 )(shape_route_step_impl)
 
@@ -580,6 +657,41 @@ class SubscriberTable:
         return {"sub_bitmaps": self.arr}
 
 
+class RouteResult(NamedTuple):
+    """Host-side outputs of one routed batch (all numpy, device-free).
+
+    Exactly ONE of the fan-out encodings is populated per row:
+
+    - compact path (``slots is not None`` and not ``overflow[i]``):
+      ``slots[i]`` holds the row's subscriber slot ids (-1 holes allowed
+      anywhere — mesh serving concatenates per-shard segments);
+    - dense path: ``bitmaps[i]`` (compaction off) or
+      ``dense_rows[dense_index[i]]`` (compaction on, row overflowed the
+      Kslot cap — the masked second transfer of the fallback contract).
+
+    ``readback_bytes`` is the device->host transfer this batch actually
+    paid (the `dispatch.readback.bytes` series).
+    """
+
+    matched: np.ndarray  # [B, K] sparse fids, -1 holes
+    mcount: np.ndarray  # [B]
+    flags: np.ndarray  # [B] host-must-fallback rows
+    bitmaps: Optional[np.ndarray]  # [B, W] dense (None on compact path)
+    picks: Optional[tuple]  # (pick_gid [B,P], pick_idx [B,P]) | None
+    slots: Optional[np.ndarray] = None  # [B, Kslot] int32, -1 pad
+    slot_count: Optional[np.ndarray] = None  # [B] total set bits (uncapped)
+    overflow: Optional[np.ndarray] = None  # [B] bool: fanout > Kslot
+    dense_rows: Optional[np.ndarray] = None  # [n_overflow, W] uint32
+    dense_index: Optional[Dict[int, int]] = None  # batch row -> dense_rows row
+    readback_bytes: int = 0
+
+
+# floor for the auto-sized compact-slot cap: below this the slot list is
+# cheaper than the program bookkeeping either way, and a tiny cap would
+# overflow constantly while the fanout histogram warms up
+KSLOT_MIN = 64
+
+
 class DeviceRouter:
     """Serving-path engine: owns the device mirrors of the shape index, the
     residual NFA tables, and the subscriber bitmaps; runs
@@ -655,6 +767,42 @@ class DeviceRouter:
         import itertools
 
         self._rand_seq = itertools.count(0xEC0)
+        # auto-sized compact-slot cap (grow-only so the jit program is
+        # stable; only _device_args — loop thread — mutates it)
+        self._kslot = 0
+
+    def _fanout_kslot(self, width_words: int) -> int:
+        """Static Kslot for the next batch; 0 = compaction off.
+
+        An explicit ``config.fanout_slots`` pins the cap (pow2-padded to
+        avoid one recompile per odd value). Auto mode (0) sizes from the
+        `dispatch.fanout` histogram p99 with 2x headroom, pow2-padded and
+        GROW-ONLY — shrinking on a quiet period would recompile the
+        serving program twice for zero readback win — and turns
+        compaction off entirely while the slot universe (W*32) is no
+        wider than the compact output would be.
+        """
+        cfg = self.config
+        if not cfg.fanout_compact or self.subtab is None:
+            return 0
+        if cfg.fanout_slots > 0:
+            return _next_pow2(cfg.fanout_slots)
+        want = KSLOT_MIN
+        if self.metrics is not None:
+            h = self.metrics.histogram("dispatch.fanout")
+            # 256 observations before trusting p99: the first batches
+            # after boot are not a fan-out distribution yet
+            if h is not None and h.count >= 256:
+                want = max(want, 2 * max(1, int(h.p99)))
+        k = max(self._kslot, _next_pow2(want))
+        self._kslot = k
+        if self.mesh is not None:
+            # per-shard compaction: each tp shard emits its own kslot-wide
+            # list, so the win condition is against the LOCAL lane width
+            width_words = max(1, width_words // self.mesh.shape["tp"])
+        if k >= width_words * 32:
+            return 0  # dense rows are already the smaller readback
+        return k
 
     def _device_args(self):
         idx = self.index
@@ -674,8 +822,10 @@ class DeviceRouter:
                         f"mesh tp={tp}; use a power-of-two tp"
                     )
             bits = self._bits_sync.sync(self.subtab)["sub_bitmaps"]
+            kslot = self._fanout_kslot(self.subtab.width_words)
         else:
             bits = None
+            kslot = 0
         shape_tables = self._shape_sync.sync(idx.shapes)
         with_nfa = idx.residual_count > 0
         nfa_tables = self._nfa_sync.sync(idx.nfa) if with_nfa else None
@@ -693,6 +843,7 @@ class DeviceRouter:
             m_active,
             with_nfa,
             group_tables,
+            kslot,
         )
 
     def prepare(self):
@@ -711,8 +862,7 @@ class DeviceRouter:
         return args
 
     def route(self, topics, client_hashes=None):
-        """Batch route: returns host np arrays (matched [B,K] sparse,
-        mcount [B], flags [B], bitmaps [B,W], picks|None)."""
+        """Batch route: returns a host-side `RouteResult` (all numpy)."""
         return self.route_prepared(
             self._device_args(), topics, client_hashes
         )
@@ -726,7 +876,7 @@ class DeviceRouter:
         `client_hashes` ([B] uint32, stable_hash of each publisher id)
         feeds the device $share pick; required only when a group table is
         loaded and the strategy is hash_clientid.
-        Returns (matched, mcount, flags, bitmaps[, pick_gid, pick_idx]).
+        Returns a `RouteResult`.
         """
         import time
 
@@ -738,6 +888,19 @@ class DeviceRouter:
                 "router.device.seconds", time.perf_counter() - t0
             )
             self.metrics.observe("router.batch.size", len(topics))
+            if out.bitmaps is not None or out.slots is not None:
+                self.metrics.observe(
+                    "dispatch.readback.bytes", out.readback_bytes
+                )
+            if out.slots is not None:
+                n_ovf = int(np.count_nonzero(out.overflow))
+                self.metrics.inc(
+                    "dispatch.compact.rows", len(topics) - n_ovf
+                )
+                if n_ovf:
+                    self.metrics.inc(
+                        "dispatch.compact.overflow.rows", n_ovf
+                    )
         return out
 
     def _route_prepared(self, args, topics, client_hashes=None):
@@ -753,6 +916,7 @@ class DeviceRouter:
             m_active,
             with_nfa,
             group_tables,
+            kslot,
         ) = args
         B = len(topics)
         Bp = max(64, _next_pow2(B))
@@ -785,7 +949,7 @@ class DeviceRouter:
         if self.mesh is not None and bits is not None:
             return self._route_mesh(
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
-                mat, lens, B, too_long, group_tables, ch, th, rand,
+                mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
             )
         out = shape_route_step(
             shape_tables,
@@ -806,7 +970,26 @@ class DeviceRouter:
             probes=cfg.probes,
             with_groups=with_groups,
             share_strategy=self.share_strategy,
+            kslot=kslot,
         )
+        return self._readback(out, B, too_long, with_groups, kslot)
+
+    def _readback(self, out, B, too_long, with_groups, kslot, mesh=False):
+        """Pull one batch's outputs to host -> `RouteResult`.
+
+        This is THE bandwidth boundary the compaction stage exists for:
+        with ``kslot`` on, only the O(matches) compact arrays cross the
+        link, plus one masked second transfer of the dense bitmap rows
+        for the (overflow-flagged) rows the cap could not hold. Dense
+        ``bitmaps`` rows of the full batch transfer only when compaction
+        is off (or for match-only callers, never).
+
+        ``mesh``: single-device overflow is derived on host from
+        ``slot_count > kslot`` (one fewer device->host transfer — each
+        transfer pays a full RTT on a tunneled chip); the mesh kernel's
+        overflow is per-shard (any tp shard over its local cap) and must
+        be read back.
+        """
         matched = np.asarray(out["matched"][:B])
         mcount = np.asarray(out["mcount"][:B])
         flags = np.asarray(out["flags"][:B]) | too_long
@@ -817,17 +1000,52 @@ class DeviceRouter:
             )
         else:
             picks = None
+        readback = matched.nbytes + mcount.nbytes + flags.nbytes
+        if picks is not None:
+            readback += picks[0].nbytes + picks[1].nbytes
         if out["bitmaps"] is None:
-            return matched, mcount, flags, None, picks
+            return RouteResult(
+                matched, mcount, flags, None, picks,
+                readback_bytes=readback,
+            )
+        if kslot:
+            slots = np.asarray(out["slots"][:B])
+            slot_count = np.asarray(out["slot_count"][:B])
+            readback += slots.nbytes + slot_count.nbytes
+            if mesh:
+                overflow = np.asarray(out["overflow"][:B])
+                readback += overflow.nbytes
+            else:
+                overflow = slot_count > kslot
+            dense_rows = dense_index = None
+            ovf_idx = np.nonzero(overflow)[0]
+            if ovf_idx.size:
+                # masked second transfer: ONLY the rows whose fan-out
+                # exceeded the cap come back dense (device-side gather)
+                dense_rows = np.ascontiguousarray(
+                    np.asarray(out["bitmaps"][ovf_idx])
+                )
+                dense_index = {int(r): j for j, r in enumerate(ovf_idx)}
+                readback += dense_rows.nbytes
+            return RouteResult(
+                matched, mcount, flags, None, picks,
+                slots=slots, slot_count=slot_count, overflow=overflow,
+                dense_rows=dense_rows, dense_index=dense_index,
+                readback_bytes=readback,
+            )
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
         bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
-        return matched, mcount, flags, bitmaps, picks
+        readback += bitmaps.nbytes
+        return RouteResult(
+            matched, mcount, flags, bitmaps, picks,
+            readback_bytes=readback,
+        )
 
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
-        rand=None,
+        rand=None, kslot=0,
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
@@ -876,17 +1094,9 @@ class DeviceRouter:
             max_matches=cfg.max_matches,
             probes=cfg.probes,
             share_strategy=self.share_strategy,
+            kslot=kslot,
         )
-        matched = np.asarray(out["matched"][:B])
-        mcount = np.asarray(out["mcount"][:B])
-        flags = np.asarray(out["flags"][:B]) | too_long
-        bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
-        picks = (
-            (np.asarray(out["pick_gid"][:B]), np.asarray(out["pick_idx"][:B]))
-            if with_groups
-            else None
-        )
-        return matched, mcount, flags, bitmaps, picks
+        return self._readback(out, B, too_long, with_groups, kslot, mesh=True)
 
     def match_batch(
         self, topics: Sequence[str], fallback=None
@@ -902,7 +1112,8 @@ class DeviceRouter:
         """
         from emqx_tpu.ops import topics as T
 
-        matched, _mcount, flags, _bits, _picks = self.route(topics)
+        res = self.route(topics)
+        matched, flags = res.matched, res.flags
         out: List[List[str]] = []
         for i, t in enumerate(topics):
             if flags[i]:

@@ -51,6 +51,14 @@ class MatcherConfig:
     # (nfa.MAX_PROBES) or lookups would silently miss — TpuMatcher clamps.
     probes: int = MAX_PROBES
     max_bytes: int = 256  # topic byte budget for the device tokenizer
+    # sparse fan-out compaction (router_model.compact_fanout_slots):
+    # read back O(matches) slot lists instead of dense [B, W] bitmaps;
+    # overflow rows fall back to a masked dense transfer, so the cap is
+    # a bandwidth knob, never a correctness one
+    fanout_compact: bool = True
+    # per-row compact-slot cap: 0 = auto-size from the dispatch.fanout
+    # histogram p99 (grow-only, pow2-padded); > 0 pins it (pow2-padded)
+    fanout_slots: int = 0
 
 
 def _probe_edges(tables, node, sym, probes: int):

@@ -9,6 +9,7 @@ def declare(name, kind, help=""):
 
 declare("messages.received", COUNTER)
 declare("messages.dropped", COUNTER)
+declare("dispatch.readback.bytes", "histogram")
 
 
 class M:
@@ -18,12 +19,17 @@ class M:
     def gauge_set(self, name, v):
         pass
 
+    def observe(self, name, v):
+        pass
+
 
 def good(m: M):
     m.inc("messages.received")
     m.inc("messages.dropped", 2)
+    m.observe("dispatch.readback.bytes", 4096)
 
 
 def bad(m: M):
     m.inc("messages.recieved")  # MN001: typo'd series
     m.gauge_set("sessions.active", 1)  # MN001: never declared
+    m.observe("dispatch.readback.bytez", 1)  # MN001: typo'd series

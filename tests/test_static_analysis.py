@@ -136,7 +136,9 @@ def test_metric_checker_flags_undeclared_series():
         f.detail for f in report.findings
         if f.path.endswith("metrics_fixture.py")
     }
-    assert bad == {"messages.recieved", "sessions.active"}
+    assert bad == {
+        "messages.recieved", "sessions.active", "dispatch.readback.bytez",
+    }
 
 
 # -- the tier-1 repo gate ---------------------------------------------------
