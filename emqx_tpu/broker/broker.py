@@ -452,7 +452,7 @@ class Broker:
         tp("dispatch.batch", n=len(msgs), fallback=fell_back)
         return out
 
-    def _dispatch_row(
+    def _dispatch_row(  # readback-site
         self, msg: Message, bits: Optional[np.ndarray], fids, picks=None,
         touched_gids: Optional[set] = None, *, slots=None,
         match_memo: Optional[Dict] = None,

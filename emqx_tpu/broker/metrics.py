@@ -13,7 +13,8 @@ Two additions over the reference's flat counter tables:
 - an explicit metric-kind REGISTRY: every series name is declared once with
   its kind (counter | gauge | histogram), so the exporters render `# TYPE`
   lines from declarations instead of guessing from name substrings, and
-  `tools/check_metric_names.py` can statically reject typo'd series names.
+  the MN checker (`python -m tools.analysis --checks metrics`) can
+  statically reject typo'd series names.
 """
 
 from __future__ import annotations
@@ -104,7 +105,8 @@ def kind_of(name: str) -> Optional[str]:
 
 
 def registry() -> Dict[str, MetricSpec]:
-    """Snapshot of every declared series (tools/check_metric_names.py)."""
+    """Snapshot of every declared series (runtime mirror of the set the
+    MN checker collects statically)."""
     return dict(_REGISTRY)
 
 
@@ -270,8 +272,8 @@ default_metrics = Metrics()
 
 # -- series declarations ---------------------------------------------------
 # Every name passed to Metrics.inc/gauge_set/observe anywhere in emqx_tpu/
-# must be declared here (enforced by tools/check_metric_names.py, run as a
-# tier-1 test). Grouped by subsystem.
+# must be declared here (enforced by the MN checker in tools/analysis, run
+# as a tier-1 test). Grouped by subsystem.
 
 # packets / messages (emqx_metrics.erl families)
 declare("packets.sent", COUNTER, "MQTT packets written to clients")

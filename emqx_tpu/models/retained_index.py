@@ -241,7 +241,7 @@ class DeviceRetainedIndex:
             )
         return outs
 
-    def match(self, filter_: str) -> Optional[List[str]]:
+    def match(self, filter_: str) -> Optional[List[str]]:  # readback-site
         """Retained topics matching `filter_`, or None when the filter
         itself exceeds the device budget (caller falls back to CPU)."""
         if len(T.words(filter_)) > self.max_levels:
@@ -265,7 +265,7 @@ class DeviceRetainedIndex:
                     out.append(t)
         return out
 
-    def warm(self, filters: List[str]) -> None:
+    def warm(self, filters: List[str]) -> None:  # readback-site
         """Upload chunks + compile the storm program WITHOUT reading
         results back (`match_many` works unwarmed, it just pays the XLA
         compile inline; the program is keyed on the filter table's size
@@ -279,7 +279,9 @@ class DeviceRetainedIndex:
             self._launch_all(shape_tables, nfa_tables, kwargs)
         )
 
-    def match_many(self, filters: List[str]) -> Dict[str, np.ndarray]:
+    def match_many(  # readback-site
+        self, filters: List[str]
+    ) -> Dict[str, np.ndarray]:
         """Answer a replay STORM: many wildcard subscribes in one pass.
 
         All filters enter ONE shape table; each chunk launch matches every

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from emqx_tpu.ops.contract import device_contract
 from emqx_tpu.ops.matcher import batch_match_bytes_impl
 from emqx_tpu.ops.nfa import _next_pow2
 
@@ -55,6 +56,16 @@ def fanout_bitmaps(sub_bitmaps, matched):
     )
 
 
+@device_contract(
+    "compact_fanout_slots",
+    # the whole point of the stage: outputs scale with B*kslot, never
+    # with the bitmap width W
+    out_bounds={
+        "slots": lambda cfg: cfg["B"] * cfg["kslot"] * 4,
+        "count": lambda cfg: cfg["B"] * 4,
+        "overflow": lambda cfg: cfg["B"],
+    },
+)
 def compact_fanout_slots(bitmaps, kslot: int):
     """On-device sparse fan-out compaction: set bits -> slot-id lists.
 
@@ -162,9 +173,18 @@ def route_step_impl(
     return out
 
 
-route_step = partial(jax.jit, static_argnames=(
+route_step = device_contract(
+    "route_step",
+    # single-device program: no collectives may appear, and the compact
+    # outputs stay O(B*kslot) regardless of bitmap width
+    collectives=(),
+    out_bounds={
+        "slots": lambda cfg: cfg["B"] * cfg["kslot"] * 4,
+        "slot_count": lambda cfg: cfg["B"] * 4,
+    },
+)(partial(jax.jit, static_argnames=(
     "salt", "max_levels", "frontier", "max_matches", "probes", "kslot"
-))(route_step_impl)
+))(route_step_impl))
 
 
 def shape_route_step_impl(
@@ -276,7 +296,14 @@ def shape_route_step_impl(
     return out
 
 
-shape_route_step = partial(
+shape_route_step = device_contract(
+    "shape_route_step",
+    collectives=(),
+    out_bounds={
+        "slots": lambda cfg: cfg["B"] * cfg["kslot"] * 4,
+        "slot_count": lambda cfg: cfg["B"] * 4,
+    },
+)(partial(
     jax.jit,
     static_argnames=(
         "m_active",
@@ -292,7 +319,7 @@ shape_route_step = partial(
         "dp_axis",
         "kslot",
     ),
-)(shape_route_step_impl)
+)(shape_route_step_impl))
 
 
 STRATEGY_IDS = {
@@ -974,7 +1001,9 @@ class DeviceRouter:
         )
         return self._readback(out, B, too_long, with_groups, kslot)
 
-    def _readback(self, out, B, too_long, with_groups, kslot, mesh=False):
+    def _readback(  # readback-site
+        self, out, B, too_long, with_groups, kslot, mesh=False
+    ):
         """Pull one batch's outputs to host -> `RouteResult`.
 
         This is THE bandwidth boundary the compaction stage exists for:
@@ -984,37 +1013,53 @@ class DeviceRouter:
         ``bitmaps`` rows of the full batch transfer only when compaction
         is off (or for match-only callers, never).
 
+        Everything the batch needs crosses in ONE `jax.device_get` of a
+        trimmed dict (sliced to the live rows): each separate `asarray`
+        pull used to pay its own sync + RTT — eight of them per batch on
+        the group+compact path — where one coalesced transfer pays one.
+        Only the overflow fetch remains a (rare, masked) second
+        transfer, because which rows need it is decided by `slot_count`,
+        which must be on host first.
+
         ``mesh``: single-device overflow is derived on host from
-        ``slot_count > kslot`` (one fewer device->host transfer — each
-        transfer pays a full RTT on a tunneled chip); the mesh kernel's
-        overflow is per-shard (any tp shard over its local cap) and must
-        be read back.
+        ``slot_count > kslot`` (one fewer array on the link); the mesh
+        kernel's overflow is per-shard (any tp shard over its local cap)
+        and must be read back.
         """
-        matched = np.asarray(out["matched"][:B])
-        mcount = np.asarray(out["mcount"][:B])
-        flags = np.asarray(out["flags"][:B]) | too_long
+        pulls = {
+            "matched": out["matched"][:B],
+            "mcount": out["mcount"][:B],
+            "flags": out["flags"][:B],
+        }
         if with_groups:
-            picks = (
-                np.asarray(out["pick_gid"][:B]),
-                np.asarray(out["pick_idx"][:B]),
-            )
-        else:
-            picks = None
-        readback = matched.nbytes + mcount.nbytes + flags.nbytes
-        if picks is not None:
-            readback += picks[0].nbytes + picks[1].nbytes
+            pulls["pick_gid"] = out["pick_gid"][:B]
+            pulls["pick_idx"] = out["pick_idx"][:B]
+        if out["bitmaps"] is not None:
+            if kslot:
+                pulls["slots"] = out["slots"][:B]
+                pulls["slot_count"] = out["slot_count"][:B]
+                if mesh:
+                    pulls["overflow"] = out["overflow"][:B]
+            else:
+                pulls["bitmaps"] = out["bitmaps"][:B]
+        host = jax.device_get(pulls)
+        matched = host["matched"]
+        mcount = host["mcount"]
+        flags = host["flags"] | too_long
+        picks = (
+            (host["pick_gid"], host["pick_idx"]) if with_groups else None
+        )
+        readback = sum(v.nbytes for v in host.values())
         if out["bitmaps"] is None:
             return RouteResult(
                 matched, mcount, flags, None, picks,
                 readback_bytes=readback,
             )
         if kslot:
-            slots = np.asarray(out["slots"][:B])
-            slot_count = np.asarray(out["slot_count"][:B])
-            readback += slots.nbytes + slot_count.nbytes
+            slots = host["slots"]
+            slot_count = host["slot_count"]
             if mesh:
-                overflow = np.asarray(out["overflow"][:B])
-                readback += overflow.nbytes
+                overflow = host["overflow"]
             else:
                 overflow = slot_count > kslot
             dense_rows = dense_index = None
@@ -1023,7 +1068,7 @@ class DeviceRouter:
                 # masked second transfer: ONLY the rows whose fan-out
                 # exceeded the cap come back dense (device-side gather)
                 dense_rows = np.ascontiguousarray(
-                    np.asarray(out["bitmaps"][ovf_idx])
+                    jax.device_get(out["bitmaps"][ovf_idx])
                 )
                 dense_index = {int(r): j for j, r in enumerate(ovf_idx)}
                 readback += dense_rows.nbytes
@@ -1035,8 +1080,7 @@ class DeviceRouter:
             )
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
-        bitmaps = np.ascontiguousarray(out["bitmaps"][:B])
-        readback += bitmaps.nbytes
+        bitmaps = np.ascontiguousarray(host["bitmaps"])
         return RouteResult(
             matched, mcount, flags, bitmaps, picks,
             readback_bytes=readback,

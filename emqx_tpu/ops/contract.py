@@ -1,0 +1,86 @@
+"""Device-contract registry: declares what a kernel's compiled artifact
+is ALLOWED to look like, next to the kernel itself.
+
+PR 3 made the serving hot path's cost profile a contract — O(matches)
+readback, a fixed collective set over the ('dp', 'tp') mesh, no dtype
+widening — and the `@device_contract` decorator is where that contract
+is *written down*. The decorator only registers; it never wraps, so jit
+caching, `lru_cache`d builders and call signatures are untouched. The
+semantic auditor (`tools/analysis/device_contract`, run via
+`python -m tools.analysis --contracts` and the tier-1 suite) traces
+every registered kernel with `jax.make_jaxpr` over a small config
+matrix — abstract tracing only, nothing executes — and checks the
+jaxpr against the declaration + a golden snapshot under
+`tests/fixtures/analysis/jaxprs/`.
+
+This module is import-light on purpose (stdlib only): product modules
+pay nothing for declaring a contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Optional
+
+# kernel name -> contract (module-level: populated at import of the
+# decorated modules; the auditor imports them explicitly)
+REGISTRY: Dict[str, "DeviceContract"] = {}
+
+
+@dataclass(frozen=True)
+class DeviceContract:
+    """What the compiled artifact of one kernel may contain.
+
+    name         registry key (also the snapshot file name)
+    fn           the registered callable (jit-wrapped fn, plain
+                 traceable fn, or a builder returning a jitted fn)
+    kind         'jit'     — trace `fn` directly
+                 'builder' — call `fn(...)` first (mesh step builders),
+                             then trace what it returns
+    collectives  EXACT set of collective primitives the kernel's traces
+                 may contain, matrix-wide: every traced config must stay
+                 a subset, and the union over the matrix must equal the
+                 declaration (so it can neither grow nor rot silently)
+    forbid_dtypes  dtype names that may appear NOWHERE in the jaxpr —
+                 not as a convert_element_type target, not in any
+                 intermediate or output aval (default: the f64/i64
+                 widenings that double readback and HBM for free)
+    out_bounds   per-output byte bounds: output name -> fn(cfg) -> max
+                 bytes (`cfg` is the audit config dict). This is how
+                 "compact outputs are O(B*Kslot), not O(B*W)" is pinned.
+    """
+
+    name: str
+    fn: Callable = None  # type: ignore[assignment]
+    kind: str = "jit"
+    collectives: FrozenSet[str] = frozenset()
+    forbid_dtypes: tuple = ("float64", "int64", "uint64")
+    out_bounds: Dict[str, Callable[[dict], int]] = field(
+        default_factory=dict
+    )
+
+
+def device_contract(
+    name: str,
+    *,
+    kind: str = "jit",
+    collectives=(),
+    forbid_dtypes=("float64", "int64", "uint64"),
+    out_bounds: Optional[Dict[str, Callable[[dict], int]]] = None,
+    registry: Optional[Dict[str, DeviceContract]] = None,
+):
+    """Register a kernel's device contract; returns the fn unchanged."""
+    reg = REGISTRY if registry is None else registry
+
+    def register(fn):
+        reg[name] = DeviceContract(
+            name=name,
+            fn=fn,
+            kind=kind,
+            collectives=frozenset(collectives),
+            forbid_dtypes=tuple(forbid_dtypes),
+            out_bounds=dict(out_bounds or {}),
+        )
+        return fn
+
+    return register

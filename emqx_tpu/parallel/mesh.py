@@ -22,6 +22,7 @@ from emqx_tpu.models.router_model import (
     route_step_impl,
     shape_route_step_impl,
 )
+from emqx_tpu.ops.contract import device_contract
 
 # -- shard_map compat -------------------------------------------------------
 # jax moved shard_map from jax.experimental to the top level around 0.4.35;
@@ -114,6 +115,14 @@ def _reduce_stats(out, with_groups: bool = False):
     return out
 
 
+@device_contract(
+    "dist_step",
+    kind="builder",
+    # the ONLY cross-chip traffic the NFA serving step may compile to:
+    # the stats psums over ('dp','tp'). A new collective here is a new
+    # ICI dependency and must be a deliberate contract change.
+    collectives=("psum",),
+)
 @lru_cache(maxsize=32)
 def _dist_step_fn(
     mesh: Mesh,
@@ -189,6 +198,20 @@ def dist_route_step(
     return fn(tables, sub_bitmaps, bytes_mat, lengths)
 
 
+@device_contract(
+    "dist_shape_step",
+    kind="builder",
+    # stats psum over ('dp','tp') + the kslot>0 per-shard compaction's
+    # lane-offset rebase (axis_index) and count/overflow psum over 'tp'
+    collectives=("psum", "axis_index"),
+    out_bounds={
+        # per-shard compaction concatenates over tp: [B, kslot * tp]
+        "slots": lambda cfg: (
+            cfg["B"] * cfg["kslot"] * cfg.get("tp", 1) * 4
+        ),
+        "slot_count": lambda cfg: cfg["B"] * 4,
+    },
+)
 @lru_cache(maxsize=32)
 def _dist_shape_step_fn(
     mesh: Mesh,

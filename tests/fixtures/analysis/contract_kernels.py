@@ -1,0 +1,40 @@
+"""Fixture kernels for the device-contract audit's negative tests.
+
+`good_kernel` honors its contract (int32 in, int32 out). The "seeded
+mutation" `mutated_kernel` is the same kernel with a dtype widening —
+an `.astype(float32)` the contract forbids — standing in for the real
+regression class (a stray `convert_element_type` doubling the readback).
+Two registries expose them under the SAME contract name, so a test can
+audit the good one, snapshot it, then swap in the mutation and watch
+both the dtype check and the golden-snapshot diff fire.
+"""
+
+from emqx_tpu.ops.contract import device_contract
+
+REG_GOOD = {}
+REG_MUTATED = {}
+
+_CONTRACT = dict(
+    collectives=(),
+    # the fixture kernel must stay integer end to end: float32 here
+    # plays the role f64 plays for the real kernels (jax's default
+    # x64-disabled mode silently downcasts a literal f64, so the
+    # fixture forbids a dtype that CAN appear)
+    forbid_dtypes=("float32", "float64", "int64"),
+    out_bounds={"out": lambda cfg: cfg["B"] * cfg["kslot"] * 4},
+)
+
+
+@device_contract("fx_kernel", registry=REG_GOOD, **_CONTRACT)
+def good_kernel(x, kslot):
+    import jax.numpy as jnp
+
+    return {"out": jnp.cumsum(x[:, :kslot], axis=1)}
+
+
+@device_contract("fx_kernel", registry=REG_MUTATED, **_CONTRACT)
+def mutated_kernel(x, kslot):
+    import jax.numpy as jnp
+
+    # the seeded contract break: a widening cast on the hot output
+    return {"out": jnp.cumsum(x[:, :kslot].astype(jnp.float32), axis=1)}

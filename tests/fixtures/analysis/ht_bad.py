@@ -1,0 +1,50 @@
+"""HT fixture (violations): unannotated transfers, taint through
+helpers and returns, and a stale annotation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def direct_pull(x):
+    out = kernel(x)
+    return np.asarray(out)  # HT001: no `# readback-site` on this def
+
+
+def scalar_pull(x):
+    out = kernel(x)
+    return float(out[0])  # HT001
+
+
+def sync_pull(x):
+    out = kernel(x)
+    out.block_until_ready()  # HT001 (device-only API, always flagged)
+    return out
+
+
+def _helper(out):
+    # HT001 via call-site taint: every caller hands this a device value
+    return out.tolist()
+
+
+def via_helper(x):
+    return _helper(kernel(x))
+
+
+def produces_device(x):
+    return kernel(x)  # return-taint
+
+
+def via_return(x):
+    vals = produces_device(x)
+    return np.asarray(vals)  # HT001
+
+
+def stale_annotation(rows):  # readback-site
+    # HT002: annotated, but no transfer call in the body
+    return [r + 1 for r in rows]

@@ -1,0 +1,38 @@
+"""HT fixture (compliant): transfers only inside `# readback-site`
+functions; host-data numpy calls are not transfers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+def readback(x):  # readback-site
+    out = kernel(x)
+    host = jax.device_get({"out": out})
+    return host["out"]
+
+
+def readback_multiline(x):  # readback-site
+    out = kernel(x)
+    return np.asarray(
+        out
+    )
+
+
+def host_only(rows):
+    # numpy over plain host data: no device value, no finding
+    arr = np.asarray(rows)
+    return float(arr.sum())
+
+
+def suppressed_site(x):
+    out = kernel(x)
+    return np.asarray(
+        out,
+        dtype=np.int32,
+    )  # lint: disable=HT001

@@ -1,0 +1,33 @@
+"""RT fixture (violations): traced args reaching shape positions."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leaky(n):
+    return jnp.zeros(n)  # RT001: n is traced
+
+
+@partial(jax.jit, static_argnames=("salt",))
+def wrong_static(x, salt, width):
+    # RT001: `width` is NOT in static_argnames (salt is)
+    return x.reshape(width, -1) + salt
+
+
+def _fill(m):
+    return jnp.arange(m)  # RT001 via propagation from leak_via_helper
+
+
+@jax.jit
+def leak_via_helper(k):
+    return _fill(k)
+
+
+def wrapped_impl(x, n):
+    return jnp.ones(n) + x  # RT001: jitted below without statics
+
+
+wrapped = partial(jax.jit)(wrapped_impl)

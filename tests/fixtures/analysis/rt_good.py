@@ -1,0 +1,38 @@
+"""RT fixture (compliant): shape positions fed from statics or from
+`.shape` (static under the trace)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def pinned(x, n):
+    return x + jnp.ones(n)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def pinned_by_num(x, width):
+    return x.reshape(width, -1)
+
+
+@jax.jit
+def shape_derived(x):
+    b, w = x.shape
+    flat = x.reshape(b * w)
+    return flat + jnp.arange(len(flat))
+
+
+def _helper(m):
+    return jnp.zeros(m)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def static_through_helper(x, k):
+    return x + _helper(k)
+
+
+sized_fill = partial(jax.jit, static_argnames=("fill",))(
+    lambda x, fill: jnp.full(x.shape, fill)
+)

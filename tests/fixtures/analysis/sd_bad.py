@@ -1,0 +1,29 @@
+"""SD fixture (violations): unbound axes and stray collectives."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def bad_axis_body(x):
+    # SD001: 'rows' is not an axis any Mesh in this tree binds
+    return jax.lax.psum(x, "rows")
+
+
+def stray_collective(x):
+    # SD002: never reached from a shard_map body
+    return jax.lax.pmax(x, "dp")
+
+
+def bad_spec():
+    # SD003: PartitionSpec names an unbound axis
+    return P("lanes", None)
+
+
+def build(mesh):
+    spec = P("dp", None)
+    return shard_map(
+        bad_axis_body, mesh=mesh, in_specs=(spec,), out_specs=spec
+    )

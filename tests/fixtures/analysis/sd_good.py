@@ -1,0 +1,34 @@
+"""SD fixture (compliant): collectives on mesh-bound axes, reached
+through shard_map; PartitionSpecs name only bound axes. Also seeds the
+checker's axis registry via the `Mesh(axis_names=...)` literal."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def make_mesh(devs):
+    return Mesh(np.array(devs).reshape(2, 2), axis_names=("dp", "tp"))
+
+
+def _lane_reduce(x):
+    # reached from the shard_map body: inside the mesh context
+    return jax.lax.pmax(x, "tp")
+
+
+def step_body(x):
+    s = jax.lax.psum(jnp.sum(x), "dp")
+    return _lane_reduce(x) + s + dynamic_axis(x, "dp")
+
+
+def build(mesh):
+    spec = P("dp", None)
+    fn = shard_map(step_body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return jax.jit(fn)
+
+
+def dynamic_axis(x, axis_name):
+    # non-literal axis: the checker does not judge what it cannot read
+    return jax.lax.psum(x, axis_name)

@@ -1,0 +1,150 @@
+"""Tier B of the device-contract auditor: jaxpr audit + golden
+snapshots (tools/analysis/device_contract).
+
+The positive gate traces every registered production kernel (route_step,
+shape_route_step, compact_fanout_slots, the mesh step builders) over the
+config matrix and holds them to their declared contracts AND the
+checked-in snapshots under tests/fixtures/analysis/jaxprs/. The negative
+tests prove the audit actually bites: a seeded dtype mutation in a
+fixture kernel must fail, and the --update-snapshots workflow must
+recover a clean run.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.analysis.device_contract import (  # noqa: E402
+    DEFAULT_SNAPSHOT_DIR,
+    run_audit,
+)
+
+jax = pytest.importorskip("jax")
+
+
+# -- the production-kernel gate ---------------------------------------------
+
+def test_registered_kernels_pass_against_checked_in_snapshots():
+    report = run_audit()
+    assert report.clean, "\n".join(report.problems)
+    # the registry really covered the serving kernels
+    assert {
+        "route_step", "shape_route_step", "compact_fanout_slots",
+    } <= set(report.kernels)
+    for name, configs in report.kernels.items():
+        assert configs, name
+
+
+def test_mesh_builders_are_audited_on_the_virtual_mesh():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU topology from conftest")
+    report = run_audit()
+    assert "dist_step" in report.kernels
+    assert "dist_shape_step" in report.kernels
+    # the declared collective contract was exercised, not vacuous
+    k8 = [
+        s for key, s in report.kernels["dist_shape_step"].items()
+        if "k8" in key
+    ]
+    assert k8 and any("axis_index" in s["collectives"] for s in k8)
+    assert all(
+        "psum" in s["collectives"]
+        for s in report.kernels["dist_step"].values()
+    )
+
+
+def test_compact_outputs_stay_o_b_kslot():
+    report = run_audit()
+    for key, summary in report.kernels["compact_fanout_slots"].items():
+        b, k = key.split("_")
+        B, K = int(b[1:]), int(k[1:])
+        spec = summary["outputs"]["slots"]
+        dims = [int(d) for d in spec.split("[")[1].rstrip("]").split(",")]
+        assert dims == [B, K], (key, spec)  # never [B, W*32]
+
+
+# -- fixture-kernel harness (for the negative tests) ------------------------
+
+def _harness_for(fn):
+    def harness(name):
+        from functools import partial
+
+        configs = [{"B": 4, "kslot": 4}]
+
+        def build(cfg):
+            x = np.zeros((cfg["B"], 8), np.int32)
+            return partial(fn, kslot=cfg["kslot"]), (x,)
+
+        return configs, build
+
+    return harness
+
+
+def _fixture_mod():
+    import importlib.util
+
+    path = ROOT / "tests" / "fixtures" / "analysis" / "contract_kernels.py"
+    spec = importlib.util.spec_from_file_location("contract_kernels", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_snapshot_workflow_and_seeded_mutation(tmp_path):
+    fx = _fixture_mod()
+
+    # 1. no snapshot yet: the audit refuses, pointing at the workflow
+    r = run_audit(
+        registry=fx.REG_GOOD, harness=_harness_for(fx.good_kernel),
+        snapshot_dir=tmp_path,
+    )
+    assert not r.clean
+    assert any("--update-snapshots" in p for p in r.problems)
+
+    # 2. refresh, then a clean rerun must pass
+    r = run_audit(
+        registry=fx.REG_GOOD, harness=_harness_for(fx.good_kernel),
+        snapshot_dir=tmp_path, update_snapshots=True,
+    )
+    assert r.updated == ["fx_kernel"]
+    r = run_audit(
+        registry=fx.REG_GOOD, harness=_harness_for(fx.good_kernel),
+        snapshot_dir=tmp_path,
+    )
+    assert r.clean, r.problems
+
+    # 3. the seeded mutation (a forbidden float32 widening on the same
+    # contract) must fail BOTH ways: the declaration check and the
+    # golden-snapshot diff
+    r = run_audit(
+        registry=fx.REG_MUTATED, harness=_harness_for(fx.mutated_kernel),
+        snapshot_dir=tmp_path,
+    )
+    assert not r.clean
+    assert any("forbidden dtype float32" in p for p in r.problems), (
+        r.problems
+    )
+    assert any("digest" in p for p in r.problems), r.problems
+
+    # 4. and --update-snapshots is NOT a silent escape hatch for a
+    # contract violation: the declaration check still fails
+    r = run_audit(
+        registry=fx.REG_MUTATED, harness=_harness_for(fx.mutated_kernel),
+        snapshot_dir=tmp_path, update_snapshots=True,
+    )
+    assert any("forbidden dtype float32" in p for p in r.problems)
+
+
+def test_checked_in_snapshots_exist_for_every_registered_kernel():
+    import emqx_tpu.models.router_model  # noqa: F401
+    import emqx_tpu.parallel.mesh  # noqa: F401
+    from emqx_tpu.ops.contract import REGISTRY
+
+    for name in REGISTRY:
+        assert (DEFAULT_SNAPSHOT_DIR / f"{name}.json").exists(), name

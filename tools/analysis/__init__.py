@@ -19,7 +19,7 @@
   are reported as dead;
 - metric names (MN*): every static `metrics.inc/observe/gauge_set` series
   name must be `declare()`d in the metric-kind registry (the former
-  `tools/check_metric_names.py`, now a checker here).
+  standalone metric-name script, now a checker here).
 
 See docs/static_analysis.md for codes, suppression, and extension.
 """

@@ -36,7 +36,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--checks", default=None,
         help="comma-separated subset of checks to run "
-             "(lock,async,jit,config,metrics)",
+             "(lock,async,jit,config,metrics,shard,transfer,retrace)",
+    )
+    p.add_argument(
+        "--changed-only", action="store_true",
+        help="only report findings in files touched per git (working "
+        "tree vs HEAD, plus untracked); the whole tree is still parsed "
+        "so cross-module checks stay exact",
+    )
+    p.add_argument(
+        "--jobs", type=int, default=0, metavar="N",
+        help="parse source files with N worker threads (0 = serial)",
+    )
+    p.add_argument(
+        "--contracts", action="store_true",
+        help="additionally run the jaxpr-level device-contract audit "
+        "(imports jax + kernel code; see tools/analysis/device_contract)",
+    )
+    p.add_argument(
+        "--update-snapshots", action="store_true",
+        help="with --contracts: refresh the golden jaxpr snapshots "
+        "instead of failing on a diff",
     )
     p.add_argument(
         "--baseline", default=None,
@@ -79,8 +99,20 @@ def main(argv=None) -> int:
         [c.strip() for c in args.checks.split(",") if c.strip()]
         if args.checks else None
     )
+    only_paths = None
+    if args.changed_only:
+        only_paths = _git_changed_paths(root)
+        if only_paths is None:
+            print(
+                "warning: --changed-only needs a git checkout; "
+                "running a full scan",
+                file=sys.stderr,
+            )
     try:
-        report = run_analysis(root, baseline=baseline, checks=checks)
+        report = run_analysis(
+            root, baseline=baseline, checks=checks, jobs=args.jobs,
+            only_paths=only_paths,
+        )
     except Exception:
         traceback.print_exc()
         return 2
@@ -98,11 +130,65 @@ def main(argv=None) -> int:
         )
         return 0
 
+    rc = 0 if report.clean else 1
+    audit_doc = None
+    if args.contracts or args.update_snapshots:
+        from tools.analysis.device_contract import run_audit
+
+        audit = run_audit(update_snapshots=args.update_snapshots)
+        audit_doc = audit.to_json()
+        if not audit.clean:
+            rc = max(rc, 1)
+
     if args.format == "json":
-        print(json.dumps(report.to_json(), indent=2))
+        doc = report.to_json()
+        if audit_doc is not None:
+            doc["contract_audit"] = audit_doc
+        print(json.dumps(doc, indent=2))
     else:
         print(report.render_text())
-    return 0 if report.clean else 1
+        if audit_doc is not None:
+            from tools.analysis.device_contract import render_audit
+
+            print(render_audit(audit_doc))
+    return rc
+
+
+def _git_changed_paths(root: Path):
+    """Changed + untracked .py files as `Finding.path`-style rel paths
+    (posix, relative to the scan root's parent), or None without git."""
+    import subprocess
+
+    base = root.resolve().parent
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--"],
+            cwd=base, capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=base, capture_output=True, text=True, timeout=30,
+        )
+        toplevel = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=base, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode or toplevel.returncode:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    top = Path(toplevel.stdout.strip())
+    out = set()
+    names = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        p = (top / name).resolve()
+        try:
+            out.add(p.relative_to(base).as_posix())
+        except ValueError:
+            continue  # outside the scan root's parent
+    return sorted(out)
 
 
 if __name__ == "__main__":

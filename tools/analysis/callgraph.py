@@ -1,0 +1,184 @@
+"""Cross-module call-graph scaffolding shared by the device-contract
+checkers (SD/HT/RT).
+
+The jit-purity checker grew the first project call graph; the
+sharding/host-transfer/retrace checkers need the same three ingredients
+— a (module, name) -> function-def table that includes nested defs, an
+import-alias-aware reference resolver, and call edges that follow
+function names passed as *arguments* (`lax.scan(body, ...)`,
+`shard_map(step, ...)`) — so they live here once.
+
+Resolution is by bare name within a module plus canonical dotted name
+across modules. Method calls through `self.` resolve by bare method
+name in the same module (over-approximate across classes, which is the
+right bias for taint-style analyses: a false edge can only make a
+checker more conservative).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Sequence, Set, Tuple
+
+from tools.analysis.core import (
+    ParsedModule,
+    dotted_name,
+    import_aliases,
+    resolve_call_name,
+)
+
+FuncKey = Tuple[str, str]  # (dotted module, bare function name)
+
+
+def module_dotted(rel: str) -> str:
+    dn = rel[:-3].replace("/", ".")
+    if dn.endswith(".__init__"):
+        dn = dn[: -len(".__init__")]
+    return dn
+
+
+class FnInfo:
+    __slots__ = ("mod", "node", "symbol", "dn")
+
+    def __init__(self, mod: ParsedModule, node: ast.AST, symbol: str,
+                 dn: str):
+        self.mod = mod
+        self.node = node
+        self.symbol = symbol
+        self.dn = dn
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.dn, self.node.name)  # type: ignore[attr-defined]
+
+
+class ProjectGraph:
+    """One pass over every parsed module: function table + aliases."""
+
+    def __init__(self, modules: Sequence[ParsedModule]):
+        self.mods: Dict[str, ParsedModule] = {}
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        self.funcs: Dict[FuncKey, List[FnInfo]] = {}
+        self.infos: List[FnInfo] = []
+        for mod in modules:
+            dn = module_dotted(mod.rel)
+            self.mods[dn] = mod
+            self.aliases[dn] = import_aliases(mod.tree)
+            self._collect(dn, mod)
+
+    def _collect(self, dn: str, mod: ParsedModule) -> None:
+        def walk(node: ast.AST, prefix: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    sym = f"{prefix}.{child.name}" if prefix else child.name
+                    info = FnInfo(mod, child, sym, dn)
+                    self.funcs.setdefault((dn, child.name), []).append(info)
+                    self.infos.append(info)
+                    walk(child, sym)
+                elif isinstance(child, ast.ClassDef):
+                    walk(
+                        child,
+                        f"{prefix}.{child.name}" if prefix else child.name,
+                    )
+                else:
+                    walk(child, prefix)
+
+        walk(mod.tree, "")
+
+    # -- resolution ---------------------------------------------------------
+    def ref_targets(self, dn: str, node: ast.AST) -> List[FuncKey]:
+        """Function *reference* (Name/Attribute, not a call) -> table keys."""
+        aliases = self.aliases.get(dn, {})
+        if isinstance(node, ast.Name):
+            canon = aliases.get(node.id)
+            if canon and "." in canon:
+                mod_part, _, fn_part = canon.rpartition(".")
+                return [(mod_part, fn_part), (dn, node.id)]
+            return [(dn, node.id)]
+        # `self.method` / `cls.method`: bare-name lookup in this module
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            return [(dn, node.attr)]
+        dn_full = dotted_name(node)
+        if dn_full:
+            head, _, rest = dn_full.partition(".")
+            canon = aliases.get(head, head)
+            full = f"{canon}.{rest}" if rest else canon
+            mod_part, _, fn_part = full.rpartition(".")
+            if mod_part:
+                return [(mod_part, fn_part)]
+        return []
+
+    def call_name(self, dn: str, node: ast.AST) -> str:
+        """Canonical dotted name of a call target ('' when unresolvable)."""
+        return resolve_call_name(node, self.aliases.get(dn, {})) or ""
+
+    def call_edges(self, dn: str, fn: ast.AST) -> List[FuncKey]:
+        """Call targets of `fn`, including fn names passed as arguments."""
+        out: List[FuncKey] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            out.extend(self.ref_targets(dn, node.func))
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    out.extend(self.ref_targets(dn, arg))
+        return out
+
+    def reachable_from(self, roots: Sequence[FuncKey]) -> Set[FuncKey]:
+        """Transitive closure over call_edges starting at `roots`."""
+        seen: Set[FuncKey] = set()
+        work = list(roots)
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for info in self.funcs.get(key, []):
+                work.extend(self.call_edges(info.dn, info.node))
+        return seen
+
+
+# -- shared syntax helpers --------------------------------------------------
+
+def header_lines(info: FnInfo) -> Iterator[str]:
+    """Source lines of a def's header: first decorator through the line
+    before the first body statement (annotation comments live here)."""
+    node = info.node
+    start = node.lineno
+    if node.decorator_list:
+        start = min(start, min(d.lineno for d in node.decorator_list))
+    body = getattr(node, "body", None)
+    end = body[0].lineno - 1 if body else node.lineno
+    end = max(end, node.lineno)
+    for ln in range(start, end + 1):
+        yield info.mod.line_text(ln)
+
+
+def str_constants(node: ast.AST) -> List[str]:
+    """String literals in an expression (a str, or a tuple/list of strs)."""
+    out: List[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+    return out
+
+
+def is_literal_axes(node: ast.AST) -> bool:
+    """True when the expression is entirely literal axis name(s)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts
+        )
+    return False

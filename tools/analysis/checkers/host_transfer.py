@@ -1,0 +1,474 @@
+"""HT: host-transfer discipline — device values cross to host only at
+annotated readback sites.
+
+PR 3 made the serving readback a budget (`dispatch.readback.bytes`);
+one stray `np.asarray(out[...])` on a device value silently adds a
+device->host transfer + sync and reverts it. The legal transfer points
+are *named*: a function is a sanctioned readback boundary iff its
+header carries a `# readback-site` comment. Everything else that pulls
+a device value to host is a finding.
+
+  HT001  device->host transfer outside a `# readback-site` function
+  HT002  `# readback-site` annotation on a function with no transfer
+         calls (stale annotation — the boundary moved)
+
+"Device value" is tracked, not guessed, by a light taint analysis:
+
+  sources   calls to jit-wrapped callables (decorated `@jax.jit` /
+            `@partial(jax.jit, ...)`, or `name = [device_contract(...)](
+            partial(jax.jit, ...)(impl))` module assignments), calls
+            through variables holding a jit-wrapped callable (e.g. the
+            builder pattern `fn = _dist_step_fn(...); fn(...)`), and
+            `jax.device_put`
+  flow      assignment, tuple unpack, subscript/attribute access,
+            arithmetic/comparison, list/tuple literals, `.append`,
+            `for` targets, `enumerate`/`zip`, comprehension targets;
+            function parameters and returns propagate through the
+            project call graph to a fixpoint
+  cleared   `.shape`/`.dtype`/`.ndim`/`.size`/`len()` (static metadata)
+            and the result of a transfer itself (it IS host data)
+
+  sinks     `np.*` calls over a tainted argument (asarray/array/
+            concatenate/count_nonzero/... — numpy converts implicitly),
+            `float()/int()/bool()` of tainted, `.item()`/`.tolist()` on
+            tainted, and — unconditionally, they are device-only APIs —
+            `.block_until_ready()`, `jax.block_until_ready`,
+            `jax.device_get`
+
+The checker cannot see through containers of containers or attribute
+stores (`self._dev[c]`), so it under-approximates; that is the right
+failure mode for a lint that gates CI.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.callgraph import (
+    FnInfo,
+    FuncKey,
+    ProjectGraph,
+    header_lines,
+    module_dotted,
+)
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+ANNOTATION = "# readback-site"
+
+JIT_WRAP_NAMES = {"jax.jit", "jit"}
+SHARD_WRAP_NAMES = {
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+ALWAYS_SINKS = {"jax.block_until_ready", "jax.device_get"}
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "weak_type"}
+TRANSFER_METHODS = {"item", "tolist", "block_until_ready"}
+PASSTHROUGH_BUILTINS = {"enumerate", "zip", "list", "tuple", "reversed",
+                        "sorted", "iter"}
+
+_MESSAGES = {
+    "HT001": "device->host transfer outside a `# readback-site` function",
+    "HT002": "stale `# readback-site` annotation (no transfer calls in "
+             "this function)",
+}
+
+# taint states
+HOST = 0
+TAINT = 1  # device value
+DEVCALL = 2  # a jit-wrapped callable (calling it yields a device value)
+
+
+def _is_jit_wrap_call(graph: ProjectGraph, dn: str, node: ast.AST) -> bool:
+    """`jax.jit(f)`, `partial(jax.jit, ...)(f)`, `shard_map(f, ...)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = graph.call_name(dn, node.func)
+    if name in JIT_WRAP_NAMES or name in SHARD_WRAP_NAMES:
+        return True
+    if isinstance(node.func, ast.Call):
+        inner = graph.call_name(dn, node.func.func)
+        if inner in PARTIAL_NAMES and node.func.args:
+            first = graph.call_name(dn, node.func.args[0])
+            return first in JIT_WRAP_NAMES or first in SHARD_WRAP_NAMES
+    return False
+
+
+class HostTransferChecker(Checker):
+    name = "transfer"
+    codes = dict(_MESSAGES)
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        g = self._graph = ProjectGraph(modules)
+        # module-level device callables: decorated jit fns + assignments
+        # whose RHS contains a jit-wrap call anywhere (covers the
+        # `device_contract(...)(partial(jax.jit, ...)(impl))` chain)
+        self._dev_callables: Set[FuncKey] = set()
+        for info in g.infos:
+            for dec in info.node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = g.call_name(info.dn, target)
+                if name in JIT_WRAP_NAMES or name in SHARD_WRAP_NAMES:
+                    self._dev_callables.add(info.key)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and name in PARTIAL_NAMES
+                    and dec.args
+                    and g.call_name(info.dn, dec.args[0]) in JIT_WRAP_NAMES
+                ):
+                    self._dev_callables.add(info.key)
+        for dn, mod in g.mods.items():
+            for stmt in mod.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if any(
+                    _is_jit_wrap_call(g, dn, sub)
+                    for sub in ast.walk(stmt.value)
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self._dev_callables.add((dn, t.id))
+        # cross-function facts, grown to a fixpoint
+        self._ret_taint: Set[FuncKey] = set()
+        self._ret_devcall: Set[FuncKey] = set()
+        self._param_taint: Dict[FuncKey, Set[str]] = {}
+        # screen only functions that can see device values: those in
+        # jax-importing modules, plus anything facts propagate into
+        jaxish = {
+            dn for dn, aliases in g.aliases.items()
+            if any(v == "jax" or v.startswith("jax.")
+                   for v in aliases.values())
+            or self._imports_jax(g.mods[dn].tree)
+        }
+        candidates = [i for i in g.infos if i.dn in jaxish]
+        extra_keys: Set[FuncKey] = set()
+        for _ in range(12):  # fixpoint (bounded; facts only grow)
+            before = (
+                len(self._ret_taint), len(self._ret_devcall),
+                sum(len(v) for v in self._param_taint.values()),
+                len(extra_keys),
+            )
+            todo = candidates + [
+                i for k in extra_keys for i in g.funcs.get(k, [])
+                if i.dn not in jaxish
+            ]
+            for info in todo:
+                self._screen(info, emit=None, new_keys=extra_keys)
+            after = (
+                len(self._ret_taint), len(self._ret_devcall),
+                sum(len(v) for v in self._param_taint.values()),
+                len(extra_keys),
+            )
+            if after == before:
+                break
+        self._final = candidates + [
+            i for k in extra_keys for i in g.funcs.get(k, [])
+            if i.dn not in jaxish
+        ]
+
+    @staticmethod
+    def _imports_jax(tree: ast.Module) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                if any(a.name == "jax" or a.name.startswith("jax.")
+                       for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and (
+                    node.module == "jax" or node.module.startswith("jax.")
+                ):
+                    return True
+        return False
+
+    def finalize(self) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str, str]] = set()
+
+        def emit(code: str, info: FnInfo, node: ast.AST, detail: str):
+            key = (info.mod.rel, node.lineno, code, detail)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                code=code, path=info.mod.rel, line=node.lineno,
+                symbol=info.symbol, detail=detail,
+                message=f"{detail}: {_MESSAGES[code]}",
+            ))
+
+        done: Set[int] = set()
+        for info in self._final:
+            if id(info.node) in done:
+                continue
+            done.add(id(info.node))
+            self._screen(info, emit=emit, new_keys=set())
+        return findings
+
+    # -- per-function taint walk -------------------------------------------
+    def _screen(self, info: FnInfo, emit, new_keys: Set[FuncKey]) -> None:
+        g = self._graph
+        dn = info.dn
+        fn = info.node
+        annotated = any(ANNOTATION in ln for ln in header_lines(info))
+        env: Dict[str, int] = {}
+        params = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+        for p in self._param_taint.get(info.key, ()):
+            env[p] = TAINT
+        sink_seen = False
+
+        def state(e: ast.AST) -> int:
+            nonlocal sink_seen
+            if isinstance(e, ast.Name):
+                if e.id in env:
+                    return env[e.id]
+                if (dn, e.id) in self._dev_callables:
+                    return DEVCALL
+                return HOST
+            if isinstance(e, ast.Starred):
+                return state(e.value)
+            if isinstance(e, ast.Attribute):
+                if e.attr in STATIC_ATTRS:
+                    return HOST
+                return TAINT if state(e.value) == TAINT else HOST
+            if isinstance(e, ast.Subscript):
+                return TAINT if state(e.value) == TAINT else HOST
+            if isinstance(e, (ast.Tuple, ast.List)):
+                return (
+                    TAINT
+                    if any(state(x) == TAINT for x in e.elts)
+                    else HOST
+                )
+            if isinstance(e, ast.BinOp):
+                return (
+                    TAINT
+                    if TAINT in (state(e.left), state(e.right))
+                    else HOST
+                )
+            if isinstance(e, ast.UnaryOp):
+                return state(e.operand)
+            if isinstance(e, ast.BoolOp):
+                return (
+                    TAINT
+                    if any(state(v) == TAINT for v in e.values)
+                    else HOST
+                )
+            if isinstance(e, ast.Compare):
+                ops = [e.left] + list(e.comparators)
+                return (
+                    TAINT if any(state(o) == TAINT for o in ops) else HOST
+                )
+            if isinstance(e, ast.IfExp):
+                return (
+                    TAINT
+                    if TAINT in (state(e.body), state(e.orelse))
+                    else HOST
+                )
+            if isinstance(e, ast.Call):
+                return call_state(e)
+            return HOST
+
+        def call_state(e: ast.Call) -> int:
+            nonlocal sink_seen
+            if _is_jit_wrap_call(g, dn, e):
+                return DEVCALL  # `partial(jax.jit, ...)(impl)` in a local
+            name = g.call_name(dn, e.func)
+            arg_states = [state(a) for a in e.args]
+            kw_states = {kw.arg: state(kw.value) for kw in e.keywords
+                         if kw.arg}
+            any_taint = (
+                TAINT in arg_states or TAINT in kw_states.values()
+            )
+            # ---- sinks ----
+            if name in ALWAYS_SINKS:
+                sink_seen = True
+                if emit and not annotated:
+                    emit("HT001", info, e, name.replace("jax.", "jax."))
+                return HOST  # the result of a transfer is host data
+            if (
+                isinstance(e.func, ast.Attribute)
+                and e.func.attr in TRANSFER_METHODS
+            ):
+                always = e.func.attr == "block_until_ready"
+                if always or state(e.func.value) == TAINT:
+                    sink_seen = True
+                    if emit and not annotated:
+                        emit("HT001", info, e, f".{e.func.attr}()")
+                    return HOST
+            if name.startswith("numpy."):
+                sink_seen = True  # syntactic transfer form (for HT002)
+                if any_taint:
+                    if emit and not annotated:
+                        emit(
+                            "HT001", info, e,
+                            f"np.{name.rpartition('.')[2]}",
+                        )
+                    return HOST
+                return HOST
+            if (
+                isinstance(e.func, ast.Name)
+                and e.func.id in ("float", "int", "bool")
+                and e.args
+                and arg_states and arg_states[0] == TAINT
+            ):
+                sink_seen = True
+                if emit and not annotated:
+                    emit("HT001", info, e, f"{e.func.id}(...)")
+                return HOST
+            # ---- sources / propagation ----
+            if name == "jax.device_put":
+                return TAINT
+            if (
+                isinstance(e.func, ast.Attribute)
+                and not name
+                and state(e.func.value) == TAINT
+            ):
+                # unknown method on a device value (`.sum()`, `.items()`,
+                # `.astype()`, ...) stays a device value
+                return TAINT
+            if state(e.func) == DEVCALL:
+                return TAINT
+            if name == "len":
+                return HOST
+            if (
+                isinstance(e.func, ast.Name)
+                and e.func.id in PASSTHROUGH_BUILTINS
+            ):
+                return TAINT if any_taint else HOST
+            targets = g.ref_targets(dn, e.func)
+            hit = [t for t in targets if t in g.funcs]
+            if any(t in self._dev_callables for t in targets):
+                return TAINT
+            if any(t in self._ret_devcall for t in hit):
+                return DEVCALL
+            # propagate tainted arguments into callee parameters
+            for t in hit:
+                for callee in g.funcs.get(t, []):
+                    cparams = [
+                        a.arg
+                        for a in callee.node.args.args
+                        + callee.node.args.kwonlyargs
+                    ]
+                    is_method = bool(cparams) and cparams[0] in (
+                        "self", "cls"
+                    )
+                    shift = 1 if (
+                        is_method
+                        and isinstance(e.func, ast.Attribute)
+                    ) else 0
+                    names: List[str] = []
+                    for i, s in enumerate(arg_states):
+                        if s == TAINT and i + shift < len(cparams):
+                            names.append(cparams[i + shift])
+                    for kwname, s in kw_states.items():
+                        if s == TAINT and kwname in cparams:
+                            names.append(kwname)
+                    if names:
+                        cur = self._param_taint.setdefault(t, set())
+                        if not set(names) <= cur:
+                            cur.update(names)
+                            new_keys.add(t)
+            if any(t in self._ret_taint for t in hit):
+                return TAINT
+            return HOST
+
+        def assign(target: ast.AST, st: int) -> None:
+            if isinstance(target, ast.Name):
+                env[target.id] = st
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    assign(elt, st)
+            elif isinstance(target, ast.Starred):
+                assign(target.value, st)
+            # attribute/subscript stores are not tracked
+
+        def walk(stmts: List[ast.stmt]) -> None:
+            nonlocal sink_seen
+            for s in stmts:
+                if isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue  # nested defs are separate entries
+                if isinstance(s, ast.Assign):
+                    st = state(s.value)
+                    for t in s.targets:
+                        assign(t, st)
+                elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+                    if getattr(s, "value", None) is not None:
+                        assign(s.target, state(s.value))
+                elif isinstance(s, ast.Return):
+                    if s.value is not None:
+                        st = state(s.value)
+                        if st == TAINT and info.key not in self._ret_taint:
+                            self._ret_taint.add(info.key)
+                        if (
+                            st == DEVCALL
+                            and info.key not in self._ret_devcall
+                        ):
+                            self._ret_devcall.add(info.key)
+                        if s.value is not None and _is_jit_wrap_call(
+                            g, dn, s.value
+                        ):
+                            self._ret_devcall.add(info.key)
+                elif isinstance(s, ast.Expr):
+                    st = state(s.value)
+                    # `acc.append(tainted)` taints the container
+                    v = s.value
+                    if (
+                        isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Attribute)
+                        and v.func.attr in ("append", "extend", "insert")
+                        and isinstance(v.func.value, ast.Name)
+                        and any(state(a) == TAINT for a in v.args)
+                    ):
+                        env[v.func.value.id] = TAINT
+                elif isinstance(s, ast.For):
+                    assign(s.target, state(s.iter))
+                    walk(s.body)
+                    walk(s.orelse)
+                elif isinstance(s, ast.While):
+                    state(s.test)
+                    walk(s.body)
+                    walk(s.orelse)
+                elif isinstance(s, ast.If):
+                    state(s.test)
+                    walk(s.body)
+                    walk(s.orelse)
+                elif isinstance(s, ast.With):
+                    for item in s.items:
+                        state(item.context_expr)
+                        if item.optional_vars is not None:
+                            assign(
+                                item.optional_vars,
+                                state(item.context_expr),
+                            )
+                    walk(s.body)
+                elif isinstance(s, ast.Try):
+                    walk(s.body)
+                    for h in s.handlers:
+                        walk(h.body)
+                    walk(s.orelse)
+                    walk(s.finalbody)
+                else:
+                    for sub in ast.walk(s):
+                        if isinstance(sub, ast.Call):
+                            state(sub)
+                # comprehensions: bind targets from their iterables so
+                # sinks inside see the taint
+                for sub in ast.walk(s):
+                    if isinstance(
+                        sub,
+                        (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                         ast.DictComp),
+                    ):
+                        for gen in sub.generators:
+                            assign(gen.target, state(gen.iter))
+                        if isinstance(sub, ast.DictComp):
+                            state(sub.key)
+                            state(sub.value)
+                        else:
+                            state(sub.elt)
+
+        walk(fn.body)
+        if emit and annotated and not sink_seen:
+            emit("HT002", info, fn, fn.name)
+        # make `self.attr` param-free functions visible: not tracked
+        _ = params

@@ -1,0 +1,206 @@
+"""SD: sharding discipline for mesh collectives and PartitionSpecs.
+
+PR 3 fixed the serving hot path's collective set over the ('dp', 'tp')
+mesh; nothing kept it fixed. A `psum` over an axis the mesh does not
+bind deadlocks (or mis-reduces) a multi-chip deployment, and a
+collective introduced in code the `shard_map` bodies never reach is
+either dead or — worse — a latent crash when someone wires it in. The
+axis-name registry is *sourced from the code*: every
+`Mesh(..., axis_names=(...))` literal in the scanned tree contributes
+(for `emqx_tpu/` that is `parallel/mesh.py`'s ('dp', 'tp') mesh — the
+single place the topology is declared).
+
+  SD001  collective names an axis the mesh registry does not bind
+  SD002  collective call outside any shard_map-reachable body
+  SD003  PartitionSpec names an axis the mesh registry does not bind
+
+Reachability follows the shared project call graph from every function
+passed to `shard_map(...)` — a collective in a helper *called from* a
+shard_map body (`_reduce_stats`, `share_pick_device`) is inside the
+mesh context and legal. Non-literal axis arguments (e.g. a `dp_axis`
+parameter threaded from a static arg) are not judged: the checker only
+validates what it can read.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set
+
+from tools.analysis.callgraph import (
+    FuncKey,
+    ProjectGraph,
+    is_literal_axes,
+    module_dotted,
+    str_constants,
+)
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+# canonical dotted names after import-alias resolution
+SHARD_MAP_NAMES = {
+    "jax.shard_map",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+COLLECTIVES = {
+    "jax.lax.psum",
+    "jax.lax.pmean",
+    "jax.lax.pmax",
+    "jax.lax.pmin",
+    "jax.lax.all_gather",
+    "jax.lax.all_to_all",
+    "jax.lax.ppermute",
+    "jax.lax.pshuffle",
+    "jax.lax.psum_scatter",
+    "jax.lax.axis_index",
+}
+# axis argument: position for the common collectives (after the operand),
+# axis_index takes it first
+_AXIS_ARG_POS = {name: (0 if name.endswith("axis_index") else 1)
+                 for name in COLLECTIVES}
+_AXIS_KWARGS = ("axis_name", "axis")
+
+PARTITION_SPEC_NAMES = {"jax.sharding.PartitionSpec", "PartitionSpec"}
+
+_MESSAGES = {
+    "SD001": "collective names an axis the mesh does not bind",
+    "SD002": "collective call outside any shard_map body (unreachable "
+             "from every shard_map-ped function)",
+    "SD003": "PartitionSpec names an axis the mesh does not bind",
+}
+
+
+def _short(name: str) -> str:
+    return name.rpartition(".")[2]
+
+
+class ShardingChecker(Checker):
+    name = "shard"
+    codes = dict(_MESSAGES)
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._graph = ProjectGraph(modules)
+        self._axes: Set[str] = set()
+        roots: List[FuncKey] = []
+        for mod in modules:
+            dn = module_dotted(mod.rel)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._graph.call_name(dn, node.func)
+                if _short(name) == "Mesh":
+                    self._axes.update(self._mesh_axes(node))
+                if name in SHARD_MAP_NAMES:
+                    body = self._shard_map_body(node)
+                    if body is not None:
+                        roots.extend(self._graph.ref_targets(dn, body))
+        self._reachable = self._graph.reachable_from(roots)
+
+    @staticmethod
+    def _mesh_axes(call: ast.Call) -> List[str]:
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                return str_constants(kw.value)
+        if len(call.args) >= 2:
+            return str_constants(call.args[1])
+        return []
+
+    @staticmethod
+    def _shard_map_body(call: ast.Call) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == "f":
+                return kw.value
+        if call.args:
+            return call.args[0]
+        return None
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        dn = module_dotted(mod.rel)
+        findings: List[Finding] = []
+        # symbol + enclosing-function lookup for reachability
+        enclosing: List[tuple] = []  # (node, key, symbol)
+
+        def collect(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    sym = f"{prefix}.{child.name}" if prefix else child.name
+                    enclosing.append((child, (dn, child.name), sym))
+                    collect(child, sym)
+                elif isinstance(child, ast.ClassDef):
+                    collect(
+                        child,
+                        f"{prefix}.{child.name}" if prefix else child.name,
+                    )
+                else:
+                    collect(child, prefix)
+
+        collect(mod.tree, "")
+
+        def owner(call: ast.Call):
+            """Innermost enclosing function of a call node."""
+            best = None
+            for fn, key, sym in enclosing:
+                if fn.lineno <= call.lineno <= (fn.end_lineno or fn.lineno):
+                    if best is None or fn.lineno >= best[0].lineno:
+                        best = (fn, key, sym)
+            return best
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._graph.call_name(dn, node.func)
+            if name in COLLECTIVES:
+                enc = owner(node)
+                symbol = enc[2] if enc else "<module>"
+                if enc is None or enc[1] not in self._reachable:
+                    findings.append(Finding(
+                        code="SD002", path=mod.rel, line=node.lineno,
+                        symbol=symbol, detail=_short(name),
+                        message=f"{_short(name)}: {_MESSAGES['SD002']}",
+                    ))
+                for axis in self._collective_axes(node, name):
+                    if self._axes and axis not in self._axes:
+                        findings.append(Finding(
+                            code="SD001", path=mod.rel, line=node.lineno,
+                            symbol=symbol,
+                            detail=f"{_short(name)}:{axis}",
+                            message=(
+                                f"{_short(name)} over axis {axis!r}: "
+                                f"{_MESSAGES['SD001']} (bound: "
+                                f"{sorted(self._axes)})"
+                            ),
+                        ))
+            elif name in PARTITION_SPEC_NAMES and self._axes:
+                enc = owner(node)
+                symbol = enc[2] if enc else "<module>"
+                for arg in node.args:
+                    for axis in str_constants(arg):
+                        if axis not in self._axes:
+                            findings.append(Finding(
+                                code="SD003", path=mod.rel,
+                                line=node.lineno, symbol=symbol,
+                                detail=f"P:{axis}",
+                                message=(
+                                    f"PartitionSpec axis {axis!r}: "
+                                    f"{_MESSAGES['SD003']} (bound: "
+                                    f"{sorted(self._axes)})"
+                                ),
+                            ))
+        return findings
+
+    @staticmethod
+    def _collective_axes(call: ast.Call, name: str) -> List[str]:
+        pos = _AXIS_ARG_POS.get(name, 1)
+        cand = None
+        if len(call.args) > pos:
+            cand = call.args[pos]
+        else:
+            for kw in call.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    cand = kw.value
+                    break
+        if cand is None or not is_literal_axes(cand):
+            return []
+        return str_constants(cand)

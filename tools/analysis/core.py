@@ -8,7 +8,10 @@ dead-config-key scan need whole-project views.
 Findings are identified by a *fingerprint* that deliberately excludes the
 line number (`code|path|symbol|detail`), so the checked-in baseline
 survives unrelated edits to the same file. Inline suppression:
-`# lint: disable=CODE[,CODE...]` on the flagged line.
+`# lint: disable=CODE[,CODE...]` anywhere on the flagged *statement* —
+for a multi-line call the directive may sit on any physical line of the
+statement (e.g. after the closing paren), not just the line the finding
+points at.
 """
 
 from __future__ import annotations
@@ -73,6 +76,8 @@ class ParsedModule:
         except SyntaxError as e:
             self.syntax_error = e
 
+        self._spans: Optional[List[tuple]] = None
+
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1]
@@ -85,9 +90,51 @@ class ParsedModule:
             return frozenset()
         return frozenset(c.strip() for c in m.group(1).split(",") if c.strip())
 
+    def _stmt_spans(self) -> List[tuple]:
+        """(start, end) physical-line spans of every statement.
+
+        Simple statements span their full source extent; compound
+        statements (if/for/def/...) contribute only their HEADER lines
+        (up to the first body statement), so a directive inside a block
+        never suppresses findings on the block's header and vice versa.
+        """
+        if self._spans is not None:
+            return self._spans
+        spans: List[tuple] = []
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.stmt):
+                    continue
+                start = node.lineno
+                end = getattr(node, "end_lineno", None) or start
+                body = getattr(node, "body", None)
+                if body and isinstance(body, list) and body \
+                        and isinstance(body[0], ast.AST):
+                    end = max(start, body[0].lineno - 1)
+                spans.append((start, end))
+        self._spans = spans
+        return spans
+
+    def stmt_lines(self, lineno: int) -> range:
+        """Physical lines of the innermost statement containing `lineno`."""
+        best = None
+        for start, end in self._stmt_spans():
+            if start <= lineno <= end:
+                if best is None or (end - start) < (best[1] - best[0]):
+                    best = (start, end)
+        if best is None:
+            return range(lineno, lineno + 1)
+        return range(best[0], best[1] + 1)
+
     def suppressed(self, lineno: int, code: str) -> bool:
-        codes = self.disabled_codes(lineno)
-        return code in codes or "ALL" in codes
+        # honor directives on ANY line of the flagged statement, so a
+        # `# lint: disable=` after the closing paren of a multi-line
+        # call still matches the finding (reported at the first line)
+        for ln in self.stmt_lines(lineno):
+            codes = self.disabled_codes(ln)
+            if code in codes or "ALL" in codes:
+                return True
+        return False
 
 
 class Checker:
@@ -195,22 +242,39 @@ def iter_sources(root: Path) -> List[Path]:
     return paths
 
 
-def parse_modules(root: Path) -> List[ParsedModule]:
+def parse_modules(root: Path, jobs: int = 0) -> List[ParsedModule]:
+    """Parse every source under `root`; `jobs > 1` parses concurrently.
+
+    The checker set keeps growing, and one `ast.parse` per file is the
+    analyzer's fixed cost — a thread pool overlaps the file reads and
+    the (C-level) parses so the tier-1 time budget survives the growth.
+    Results keep `iter_sources` order regardless of completion order.
+    """
     root = root.resolve()
     base = root.parent
-    mods = []
-    for p in iter_sources(root):
+    paths = iter_sources(root)
+
+    def load(p: Path) -> ParsedModule:
         rel = p.relative_to(base).as_posix()
-        mods.append(ParsedModule(p, rel, p.read_text(errors="replace")))
-    return mods
+        return ParsedModule(p, rel, p.read_text(errors="replace"))
+
+    if jobs and jobs > 1 and len(paths) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            return list(pool.map(load, paths))
+    return [load(p) for p in paths]
 
 
 def default_checkers() -> List[Checker]:
     from tools.analysis.checkers.async_blocking import AsyncBlockingChecker
     from tools.analysis.checkers.config_keys import ConfigKeyChecker
+    from tools.analysis.checkers.host_transfer import HostTransferChecker
     from tools.analysis.checkers.jit_purity import JitPurityChecker
     from tools.analysis.checkers.lock_discipline import LockDisciplineChecker
     from tools.analysis.checkers.metric_names import MetricNameChecker
+    from tools.analysis.checkers.retrace import RetraceChecker
+    from tools.analysis.checkers.sharding import ShardingChecker
 
     return [
         LockDisciplineChecker(),
@@ -218,6 +282,9 @@ def default_checkers() -> List[Checker]:
         JitPurityChecker(),
         ConfigKeyChecker(),
         MetricNameChecker(),
+        ShardingChecker(),
+        HostTransferChecker(),
+        RetraceChecker(),
     ]
 
 
@@ -226,8 +293,18 @@ def run_analysis(
     checkers: Optional[Sequence[Checker]] = None,
     baseline: Optional[Baseline] = None,
     checks: Optional[Sequence[str]] = None,
+    jobs: int = 0,
+    only_paths: Optional[Sequence[str]] = None,
 ) -> Report:
-    """Run the selected checkers over every .py under `root`."""
+    """Run the selected checkers over every .py under `root`.
+
+    `only_paths` (rel posix paths, as in `Finding.path`) restricts the
+    *reported* findings to those files — the whole tree is still parsed
+    and every cross-module pre/post pass still sees it, so call-graph
+    and registry checkers stay exact on a git-diff-scoped run. Staleness
+    of the baseline is not judged on a scoped run (a partial view cannot
+    tell a pruned finding from an out-of-scope one).
+    """
     t0 = time.monotonic()
     if checkers is None:
         checkers = default_checkers()
@@ -241,8 +318,9 @@ def run_analysis(
             )
         checkers = [c for c in checkers if c.name in want]
     baseline = baseline or Baseline()
-    modules = parse_modules(Path(root))
+    modules = parse_modules(Path(root), jobs=jobs)
     by_rel = {m.rel: m for m in modules}
+    only = frozenset(only_paths) if only_paths is not None else None
 
     raw: List[Finding] = []
     # parse failures are findings, not crashes: a file the analyzer cannot
@@ -274,13 +352,19 @@ def run_analysis(
             report.suppressed += 1
             continue
         seen_fps.add(f.fingerprint)
+        if only is not None and f.path not in only:
+            continue
         if f in baseline:
             report.baselined.append(f)
         else:
             report.findings.append(f)
-    report.stale_baseline = sorted(
-        fp for fp in baseline.entries if fp not in seen_fps
-    )
+    if only is None and not checks:
+        # staleness is only judged on a full, unscoped run: a checks
+        # subset or a changed-only view cannot tell a pruned finding
+        # from one its scope simply didn't produce
+        report.stale_baseline = sorted(
+            fp for fp in baseline.entries if fp not in seen_fps
+        )
     report.elapsed = time.monotonic() - t0
     return report
 
