@@ -1406,7 +1406,12 @@ def hotpath_stats() -> None:
     JSON line of flight-recorder numbers (batch_p50/p99 from the new
     histograms, fallback rate, batch occupancy). This is the before/after
     read for hot-path perf PRs — same series the /metrics/hotpath REST
-    endpoint and the Prometheus scrape export on a live broker."""
+    endpoint and the Prometheus scrape export on a live broker.
+
+    The same workload additionally runs a second time with causal span
+    recording attached at the DEFAULT sampling rate
+    (observe.trace_sample_rate), and the serving_rps delta is reported as
+    `span_overhead` — the acceptance gate is < 5% at default sampling."""
     import asyncio
 
     from emqx_tpu.broker.broker import Broker
@@ -1414,14 +1419,23 @@ def hotpath_stats() -> None:
     from emqx_tpu.broker.ingest import BatchIngest
     from emqx_tpu.broker.message import Message
     from emqx_tpu.broker.router import Router
+    from emqx_tpu.config.schema import ObserveConfig
     from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.observe.spans import SpanRecorder
 
     N_SUBS = 32
     N_MSGS = 4096
     MAX_BATCH = 256
 
-    async def run():
+    async def drive(with_spans: bool):
+        """One pass of the workload; returns (broker, wall_s, counts)."""
         broker = Broker(router=Router(min_tpu_batch=8), hooks=Hooks())
+        if with_spans:
+            # the DEFAULT sampling config, exactly as the app wires it
+            broker.spans = SpanRecorder(
+                metrics=broker.metrics,
+                sample_rate=ObserveConfig().trace_sample_rate,
+            )
         sink = []
         for i in range(N_SUBS):
             broker.subscribe(
@@ -1437,15 +1451,43 @@ def hotpath_stats() -> None:
         # only if it landed inside the run, exactly like a live broker)
         await ing.submit(Message(topic="hot/0/warm", payload=b"w"))
         t0 = time.perf_counter()
-        futs = [
-            ing.enqueue(
-                Message(topic=f"hot/{i % N_SUBS}/x", payload=b"p")
+        results = [
+            await broker.apublish_enqueue(
+                Message(
+                    topic=f"hot/{i % N_SUBS}/x", payload=b"p",
+                    # distinct clients => every publish is a fresh
+                    # sampling decision (flow-consistent hash would
+                    # otherwise collapse the workload to 32 flows)
+                    from_client=f"bench{i}",
+                )
             )
             for i in range(N_MSGS)
         ]
-        counts = await asyncio.gather(*futs)
+        futs = [r for r in results if not isinstance(r, int)]
+        counts = list(await asyncio.gather(*futs))
         wall = time.perf_counter() - t0
         await ing.stop()
+        return broker, wall, counts
+
+    async def run():
+        # throwaway pass: jit compiles land here, so the spans-off vs
+        # spans-on comparison below is warm-vs-warm (the first measured
+        # pass still reports its own cold numbers on a fresh process
+        # via the histograms when the warm pass didn't cover a shape)
+        await drive(with_spans=False)
+        broker, wall, counts = await drive(with_spans=False)
+        # second pass, spans on at default sampling: the overhead read
+        b2, wall_spans, counts2 = await drive(with_spans=True)
+        assert sum(counts) == sum(counts2), (sum(counts), sum(counts2))
+        rps_off = sum(counts) / wall
+        rps_on = sum(counts2) / wall_spans
+        span_overhead = {
+            "serving_rps_spans_off": round(rps_off, 1),
+            "serving_rps_spans_on": round(rps_on, 1),
+            "sample_rate": ObserveConfig().trace_sample_rate,
+            "spans_sampled": b2.metrics.get("trace.spans.sampled"),
+            "overhead_pct": round(100.0 * (1.0 - rps_on / rps_off), 2),
+        }
         m = broker.metrics
 
         def hist_ms(name):
@@ -1500,6 +1542,7 @@ def hotpath_stats() -> None:
                         if dev + fb
                         else None,
                         "dispatch_fanout": hist_raw("dispatch.fanout"),
+                        "span_overhead": span_overhead,
                     },
                 }
             )

@@ -9,6 +9,7 @@ extensions, listeners, management API and periodic housekeeping from one
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import List, Optional
 
@@ -407,6 +408,51 @@ class BrokerApp:
         self.event_message.attach(self.hooks)
         self.trace = TraceManager(base_dir=ob.trace_dir)
         self.trace.attach(self.hooks)
+        # causal span tracing (observe/spans.py): head-sampled publish ->
+        # batch -> device-step -> deliver spans; clients under an active
+        # TraceSpec always sample (self.trace.should_sample)
+        if ob.trace_spans_enable:
+            from emqx_tpu.observe.spans import OtlpFileExporter, SpanRecorder
+
+            self.spans = SpanRecorder(
+                metrics=self.broker.metrics,
+                sample_rate=ob.trace_sample_rate,
+                sample_clients=ob.trace_sample_clients,
+                sample_topics=ob.trace_sample_topics,
+                seed=ob.trace_sample_seed,
+                ring=ob.trace_span_ring,
+                exporter=(
+                    OtlpFileExporter(ob.trace_span_file)
+                    if ob.trace_span_file
+                    else None
+                ),
+                always_sample=self.trace.should_sample,
+            )
+            self.broker.spans = self.spans
+        else:
+            self.spans = None
+        # device runtime telemetry (observe/device_watch.py): compile /
+        # retrace watch + HBM & transfer gauges, polled from housekeeping
+        if c.router.enable_tpu:
+            from emqx_tpu.observe.alarm import RetraceStormWatch
+            from emqx_tpu.observe.device_watch import DeviceWatch
+
+            self.device_watch = DeviceWatch(self.broker.metrics)
+            self.retrace_watch = (
+                RetraceStormWatch(
+                    self.alarms,
+                    self.broker.metrics,
+                    threshold=ob.retrace_alarm_threshold,
+                    window=ob.retrace_alarm_window,
+                    warmup=ob.retrace_alarm_warmup,
+                    sustain=ob.retrace_alarm_sustain,
+                )
+                if ob.retrace_alarm_enable
+                else None
+            )
+        else:
+            self.device_watch = None
+            self.retrace_watch = None
         self.statsd = (
             StatsdExporter(
                 self.broker.metrics,
@@ -865,6 +911,8 @@ class BrokerApp:
             closer = getattr(src, "close", None)
             if closer is not None:
                 await closer()
+        if self.spans is not None:
+            self.spans.close()  # flush the OTLP file exporter buffer
         self.trace.close()
 
     async def _housekeeping(self) -> None:
@@ -895,6 +943,10 @@ class BrokerApp:
                 self.alarms.sweep(now)
                 if self.fallback_watch is not None:
                     self.fallback_watch.check(now)
+                if self.device_watch is not None:
+                    self.device_watch.poll(now)
+                if self.retrace_watch is not None:
+                    self.retrace_watch.check(now)
                 self.trace.sweep(now)
                 self.license.tick(now)
                 self.topic_metrics.tick_rates(now)

@@ -27,6 +27,7 @@ from emqx_tpu.broker.metrics import Metrics
 from emqx_tpu.broker.router import Router
 from emqx_tpu.broker.shared_sub import SharedSub
 from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.observe.spans import TRACE_HEADER
 from emqx_tpu.ops import topics as T
 from emqx_tpu.utils.tracepoints import tp
 
@@ -98,6 +99,10 @@ class Broker:
         self._device = None  # lazy DeviceRouter
         self.mesh = None  # jax Mesh => SPMD serving (set by app/tests)
         self.ingest = None  # BatchIngest, attached by the app
+        # SpanRecorder (observe/spans.py), attached by the app/tests:
+        # causal span tracing across the batch boundary. None = off; the
+        # hot path pays one attribute check per publish
+        self.spans = None
         # ClusterNode, attached by the app when cluster.enable: routes
         # replicate on first/last subscriber, publishes forward to remote
         # route owners (emqx_broker.erl:278-293 forward regime)
@@ -213,8 +218,13 @@ class Broker:
     # -- publish side -----------------------------------------------------
     def publish(self, msg: Message) -> int:
         """Route + dispatch one message; returns delivery count."""
+        rec = self.spans
+        sp = rec.publish_begin(msg) if rec is not None else None
         msg = self.hooks.run_fold("message.publish", (), msg)
-        return self._publish_folded(msg)
+        n = self._publish_folded(msg)
+        if sp is not None:
+            rec.finish_span(sp, n)
+        return n
 
     async def apublish(self, msg: Message) -> int:
         """Async `publish` for the connection path: awaits async hooks
@@ -236,14 +246,25 @@ class Broker:
         (emqx_connection.erl:125), without which one connection could never
         have more than one message in a batch.
         """
+        rec = self.spans
+        # span head BEFORE the fold: the publish span covers hook time,
+        # and the stamped context header rides into exhook sidecar calls
+        sp = rec.publish_begin(msg) if rec is not None else None
         msg = await self.hooks.arun_fold("message.publish", (), msg)
         if msg is None or msg.headers.get("allow_publish") is False:
             self.metrics.inc("messages.dropped")
+            if sp is not None:
+                rec.finish_span(sp, 0, status="error")
             return 0
         ing = self.ingest
         if ing is not None and ing.running:
+            # the publish span settles inside BatchIngest._finish (by
+            # context header) when the batch dispatch completes
             return ing.enqueue(msg)
-        return self._dispatch_routed(msg)
+        n = self._dispatch_routed(msg)
+        if sp is not None:
+            rec.finish_span(sp, n)
+        return n
 
     def _publish_folded(self, msg: Optional[Message]) -> int:
         """Shared tail of publish/apublish after the message.publish fold."""
@@ -256,7 +277,15 @@ class Broker:
         """Local dispatch + cluster forward. `forward=False` marks the
         RECEIVING half of a cluster forward — it must never re-forward,
         or every forwarded batch cascades node-to-node forever."""
+        rec = self.spans
+        t_ns = (
+            rec.now_ns()
+            if rec is not None and TRACE_HEADER in msg.headers
+            else 0
+        )
         n = self._route_dispatch(msg, self.router.match(msg.topic))
+        if t_ns:
+            rec.deliver(msg, n, start_ns=t_ns)
         if forward and self.cluster is not None:
             n += self.cluster.forward_batch_remote([msg])[0]
         if n == 0:
@@ -292,11 +321,20 @@ class Broker:
                 # on the CPU branch (one forward_batch per node, not one
                 # per message per node)
                 fwd = self.cluster.forward_batch_remote(msgs)
+                rec = self.spans
                 out = []
                 for i, m in enumerate(msgs):
+                    t_ns = (
+                        rec.now_ns()
+                        if rec is not None and TRACE_HEADER in m.headers
+                        else 0
+                    )
                     n = self._route_dispatch(
                         m, self.router.match(m.topic)
-                    ) + fwd[i]
+                    )
+                    if t_ns:
+                        rec.deliver(m, n, start_ns=t_ns)
+                    n += fwd[i]
                     if n == 0:
                         self.hooks.run("message.dropped", m, "no_subscribers")
                         self.metrics.inc("messages.dropped.no_subscribers")
@@ -304,10 +342,22 @@ class Broker:
                 return out
             return [self._dispatch_routed(m, forward) for m in msgs]
         dev = self._device_router()
+        rec = self.spans
+        t_launch = rec.now_ns() if rec is not None else 0
         results = dev.route(
             [m.topic for m in msgs], self._client_hashes(msgs)
         )
-        return self._dispatch_device_results(msgs, results, forward)
+        dsp = None
+        if rec is not None:
+            # sync path has no ingest batch span: the device-step span
+            # stands alone, linked to the sampled publishes directly
+            dsp = rec.device_step(
+                None, len(msgs), results, t_launch,
+                links=rec.publish_links(msgs),
+            )
+        return self._dispatch_device_results(
+            msgs, results, forward, device_span=dsp
+        )
 
     async def adispatch_batch_folded(
         self, msgs: Sequence[Message], forward: bool = True
@@ -320,7 +370,8 @@ class Broker:
         return await self.adispatch_begin(msgs, forward)
 
     def adispatch_begin(
-        self, msgs: Sequence[Message], forward: bool = True
+        self, msgs: Sequence[Message], forward: bool = True,
+        batch_span=None,
     ) -> "PendingDispatch":
         """Launch the device dispatch for a batch NOW (table snapshot +
         executor kernel submit) and return a PendingDispatch. This is
@@ -350,6 +401,8 @@ class Broker:
             return PendingDispatch(ready, _cpu)
         dev = self._device_router()
         args = dev.prepare()
+        rec = self.spans
+        t_launch = rec.now_ns() if rec is not None else 0
         fut = loop.run_in_executor(
             None,
             dev.route_prepared,
@@ -360,7 +413,20 @@ class Broker:
 
         async def _complete():
             results = await fut
-            return self._dispatch_device_results(msgs, results, forward)
+            dsp = None
+            if rec is not None:
+                # the batch span (ingest fan-in) parents the device-step
+                # span; batch-less callers get a standalone span linked
+                # straight to the sampled publishes
+                dsp = rec.device_step(
+                    batch_span, len(msgs), results, t_launch,
+                    links=rec.publish_links(msgs)
+                    if batch_span is None
+                    else (),
+                )
+            return self._dispatch_device_results(
+                msgs, results, forward, device_span=dsp
+            )
 
         return PendingDispatch(fut, _complete)
 
@@ -389,7 +455,7 @@ class Broker:
         return [stable_hash(m.from_client) for m in msgs]
 
     def _dispatch_device_results(
-        self, msgs, results, forward: bool = True
+        self, msgs, results, forward: bool = True, device_span=None
     ) -> List[int]:
         """Fan one routed batch out to local subscribers.
 
@@ -415,7 +481,13 @@ class Broker:
         match_memo: Dict[Tuple[str, str], bool] = {}
         fid_memo: Dict[int, Tuple[Optional[str], bool]] = {}
         compact = results.slots is not None
+        rec = self.spans
         for i, m in enumerate(msgs):
+            t_ns = (
+                rec.now_ns()
+                if rec is not None and TRACE_HEADER in m.headers
+                else 0
+            )
             if flags[i]:
                 fell_back += 1
                 tp("dispatch.fallback", topic=m.topic)
@@ -437,6 +509,11 @@ class Broker:
                 n = self._dispatch_row(
                     m, bits, row[row >= 0], msg_picks, touched_gids,
                     slots=slots, match_memo=match_memo, fid_memo=fid_memo,
+                )
+            if t_ns:
+                rec.deliver(
+                    m, n, start_ns=t_ns, device_span=device_span,
+                    fallback=bool(flags[i]),
                 )
             if fwd is not None:
                 n += fwd[i]
@@ -573,7 +650,18 @@ class Broker:
         local subscriber tables (emqx_broker:dispatch, emqx_broker.erl:
         505-530 via the forward path :278-293).
         """
-        return self._route_dispatch(msg, filters)
+        rec = self.spans
+        t_ns = (
+            rec.now_ns()
+            if rec is not None and TRACE_HEADER in msg.headers
+            else 0
+        )
+        n = self._route_dispatch(msg, filters)
+        if t_ns:
+            # the context rode the forward in the message headers: this
+            # deliver span keeps the ORIGIN node's trace_id
+            rec.deliver(msg, n, start_ns=t_ns, remote=True)
+        return n
 
     def has_local_subs(self, route_key: str) -> bool:
         """Any local subscriber (plain or shared-group) on this filter?"""

@@ -34,6 +34,7 @@ from typing import List, Optional, Tuple
 
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.observe.spans import TRACE_HEADER
 from emqx_tpu.utils.tracepoints import tp
 
 log = logging.getLogger("emqx_tpu.ingest")
@@ -60,7 +61,7 @@ class BatchIngest:
         self.metrics: Metrics = getattr(broker, "metrics", None) or Metrics()
         # (msg, puback future, enqueue perf_counter timestamp)
         self._pending: List[Tuple[Message, asyncio.Future, float]] = []
-        self._inflight: deque = deque()  # (seq, batch, awaitable)
+        self._inflight: deque = deque()  # (seq, batch, pending, batch_span)
         self._event = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._seq = 0
@@ -83,8 +84,8 @@ class BatchIngest:
         # drain launched-but-unsettled batches first (FIFO), then
         # anything still pending, so no publisher hangs on shutdown
         while self._inflight:
-            seq, batch, pd = self._inflight.popleft()
-            await self._finish(seq, batch, pd.complete())
+            seq, batch, pd, bsp = self._inflight.popleft()
+            await self._finish(seq, batch, pd.complete(), bsp)
         while self._pending:
             batch = self._pending[: self.max_batch]
             del self._pending[: self.max_batch]
@@ -102,36 +103,65 @@ class BatchIngest:
         return await self.enqueue(msg)
 
     async def _settle(self, batch) -> None:
-        seq = self._next_seq(len(batch))
+        seq, bsp = self._next_seq(batch)
         await self._finish(
-            seq, batch, self.broker.adispatch_begin([m for m, _, _ in batch])
+            seq, batch,
+            self.broker.adispatch_begin(
+                [m for m, _, _ in batch], batch_span=bsp
+            ),
+            bsp,
         )
 
-    def _next_seq(self, n: int) -> int:
+    def _next_seq(self, batch):
+        """Assign the batch seq + record launch-side telemetry. Returns
+        (seq, batch_span): the span is the fan-in node — every sampled
+        publish in the batch LINKS into it (same seq key as the
+        `ingest.launch` tracepoint), and it parents the device-step span.
+        None when nothing in the batch is sampled."""
+        n = len(batch)
         seq = self._seq
         self._seq += 1
         self.metrics.observe("ingest.batch.size", n)
         self.metrics.observe("ingest.batch.occupancy", n / self.max_batch)
         tp("ingest.launch", batch=seq, n=n)
-        return seq
+        rec = getattr(self.broker, "spans", None)
+        bsp = (
+            rec.batch_begin(seq, [m for m, _, _ in batch], self.max_batch)
+            if rec is not None
+            else None
+        )
+        return seq, bsp
 
-    async def _finish(self, seq: int, batch, aw) -> None:
+    async def _finish(self, seq: int, batch, aw, bsp=None) -> None:
+        rec = getattr(self.broker, "spans", None)
         try:
             results = await aw
         except Exception as e:  # noqa: BLE001 — flusher must survive
             log.exception("batch dispatch failed; failing %d publishes", len(batch))
             self.metrics.inc("ingest.dispatch.errors")
-            for _, fut, _ in batch:
+            for m, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
+                if rec is not None:
+                    rec.publish_finish(
+                        m.headers.get(TRACE_HEADER), 0, status="error"
+                    )
+            if rec is not None and bsp is not None:
+                rec.finish(bsp, {"error": str(e)}, status="error")
             return
         now = time.perf_counter()
-        for (_, fut, _), n in zip(batch, results):
+        for (m, fut, _), n in zip(batch, results):
             if not fut.done():
                 fut.set_result(n)
+            if rec is not None:
+                # settle the publish span by its context header (the
+                # fan-in edge back to the publisher's trace)
+                rec.publish_finish(m.headers.get(TRACE_HEADER), n)
         self.metrics.observe_many(
             "ingest.settle.seconds", [now - t0 for _, _, t0 in batch]
         )
+        if rec is not None and bsp is not None:
+            rec.finish(bsp)
         tp("ingest.settle", batch=seq, n=len(batch))
 
     def _engage_threshold(self) -> int:
@@ -176,19 +206,27 @@ class BatchIngest:
                 # (pd.complete()), in FIFO order — pd.ready is the
                 # side-effect-free pacing signal (per-publisher
                 # cross-batch ordering).
-                seq = self._next_seq(len(batch))
+                seq, bsp = self._next_seq(batch)
                 try:
                     pd = self.broker.adispatch_begin(
-                        [m for m, _, _ in batch]
+                        [m for m, _, _ in batch], batch_span=bsp
                     )
                 except Exception as e:  # noqa: BLE001 — flusher survives
                     log.exception("batch launch failed")
                     self.metrics.inc("ingest.launch.errors")
-                    for _, fut, _ in batch:
+                    rec = getattr(self.broker, "spans", None)
+                    for m, fut, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
+                        if rec is not None:
+                            rec.publish_finish(
+                                m.headers.get(TRACE_HEADER), 0,
+                                status="error",
+                            )
+                    if rec is not None and bsp is not None:
+                        rec.finish(bsp, {"error": str(e)}, status="error")
                 else:
-                    self._inflight.append((seq, batch, pd))
+                    self._inflight.append((seq, batch, pd, bsp))
                     self.metrics.gauge_set(
                         "ingest.pipeline.depth", len(self._inflight)
                     )
@@ -197,8 +235,8 @@ class BatchIngest:
                     self._event.clear()
                 continue
             if len(self._inflight) >= self.pipeline:
-                seq, b, pd = self._inflight.popleft()
-                await self._finish(seq, b, pd.complete())
+                seq, b, pd, bsp = self._inflight.popleft()
+                await self._finish(seq, b, pd.complete(), bsp)
             elif not batch or not self._pending:
                 # dispatch in flight, nothing launchable: settle when
                 # the device work completes OR re-check the moment new
@@ -217,5 +255,5 @@ class BatchIngest:
                     if not ev.done():
                         ev.cancel()
                 if oldest_ready.done():
-                    seq, b, pd = self._inflight.popleft()
-                    await self._finish(seq, b, pd.complete())
+                    seq, b, pd, bsp = self._inflight.popleft()
+                    await self._finish(seq, b, pd.complete(), bsp)

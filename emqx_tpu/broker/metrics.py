@@ -387,3 +387,28 @@ declare("dispatch.compact.rows", COUNTER,
 declare("dispatch.compact.overflow.rows", COUNTER,
         "rows whose fan-out exceeded the Kslot cap (dense-row fallback "
         "via the masked second transfer)")
+
+# -- device runtime telemetry (observe/device_watch.py) --------------------
+declare("device.compile.count", COUNTER,
+        "jit backend compiles observed (boot warmup + any retraces); "
+        "nonzero growth in steady state is a retrace storm")
+declare("device.compile.seconds", HISTOGRAM,
+        "wall seconds per observed backend compile (window mean when "
+        "only totals are available)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.compile.cache_size", GAUGE,
+        "summed jit-cache entries across @device_contract kernels and "
+        "built mesh step programs (flat in steady state)")
+declare("device.hbm.bytes", GAUGE,
+        "live device memory: allocator bytes_in_use, or summed live "
+        "array nbytes on backends without memory stats")
+declare("device.transfer.bytes", COUNTER,
+        "cumulative device->host readback bytes across all readback "
+        "sites (rate = sustained link bandwidth consumed)")
+
+# -- causal span tracing (observe/spans.py) --------------------------------
+declare("trace.spans.sampled", COUNTER,
+        "spans recorded into the ring (head-based sampling accepted)")
+declare("trace.spans.dropped", COUNTER,
+        "spans lost unfinished (open-registry overflow or a settle that "
+        "found no open span)")

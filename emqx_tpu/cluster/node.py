@@ -436,12 +436,19 @@ class ClusterNode:
     # -- publish side ------------------------------------------------------
     def publish(self, msg: Message) -> int:
         """Cluster publish: match once, dispatch local, forward per node."""
+        rec = getattr(self.broker, "spans", None)
+        sp = rec.publish_begin(msg) if rec is not None else None
         msg = self.broker.hooks.run_fold("message.publish", (), msg)
         if msg is None or msg.headers.get("allow_publish") is False:
             self.broker.metrics.inc("messages.dropped")
+            if sp is not None:
+                rec.finish_span(sp, 0, status="error")
             return 0
         dests = self.routes.match_dests(msg.topic)
-        return self._dispatch_dests(msg, dests)
+        n = self._dispatch_dests(msg, dests)
+        if sp is not None:
+            rec.finish_span(sp, n)
+        return n
 
     def publish_batch(self, msgs: Sequence[Message]) -> int:
         """One route-table match kernel for the whole batch, then fan out.
@@ -653,6 +660,15 @@ class ClusterNode:
                     confirm[node] = True
                 out[i] += 1
 
+        # span-context propagation is free — the `traceparent` header
+        # rides inside the pickled Message — but the hop itself is worth
+        # a span: record where each sampled trace LEFT this node
+        rec = getattr(self.broker, "spans", None)
+        if rec is not None:
+            for node, batch in per_node.items():
+                for m, _fs in batch:
+                    rec.forward(m, node)
+
         def send(node, batch):
             if confirm.get(node) or self.forward_mode == "sync":
                 try:
@@ -678,10 +694,13 @@ class ClusterNode:
         if not dests:
             self.broker.hooks.run("message.dropped", msg, "no_subscribers")
             return 0
+        rec = getattr(self.broker, "spans", None)
         for node, filters in dests.items():  # aggre: one entry per node
             if node == self.name:
                 n += self.broker.dispatch(filters, msg)
             else:
+                if rec is not None:
+                    rec.forward(msg, node)
                 if self.forward_mode == "sync" or msg.qos > 0:
                     try:
                         n += self.rpc.call(

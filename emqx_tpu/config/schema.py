@@ -326,6 +326,25 @@ class ObserveConfig:
     tpu_fallback_alarm_threshold: float = 0.2
     tpu_fallback_alarm_window: float = 10.0
     tpu_fallback_alarm_min_rows: int = 64
+    # causal span tracing (observe/spans.py): head-based sampling at the
+    # publish entry; one flow samples deterministically (seeded hash of
+    # client+topic), so repeated runs trace the same clients. Clients
+    # matched by an active TraceSpec always sample at 100%.
+    trace_spans_enable: bool = True
+    trace_sample_rate: float = 0.01  # base fraction of publish flows
+    # per-client / per-topic-filter rate overrides (most specific wins)
+    trace_sample_clients: Dict[str, float] = field(default_factory=dict)
+    trace_sample_topics: Dict[str, float] = field(default_factory=dict)
+    trace_sample_seed: int = 0
+    trace_span_ring: int = 2048  # recent spans kept for /trace/spans
+    trace_span_file: str = ""  # OTLP-shaped JSON lines sink ("" = off)
+    # device runtime telemetry (observe/device_watch.py): alarm when the
+    # jit compile rate stays nonzero after warmup (retrace storm)
+    retrace_alarm_enable: bool = True
+    retrace_alarm_threshold: int = 1  # compiles per window that count
+    retrace_alarm_window: float = 10.0
+    retrace_alarm_warmup: float = 60.0  # boot compiles never alarm
+    retrace_alarm_sustain: int = 2  # consecutive hot windows to trip
 
 
 @dataclass
@@ -608,6 +627,21 @@ def _validate(cfg: AppConfig) -> None:
         raise ConfigError(
             "observe.tpu_fallback_alarm_threshold must be in (0, 1]"
         )
+    for name, rate in [
+        ("observe.trace_sample_rate", cfg.observe.trace_sample_rate),
+        *(
+            (f"observe.trace_sample_clients[{k!r}]", v)
+            for k, v in cfg.observe.trace_sample_clients.items()
+        ),
+        *(
+            (f"observe.trace_sample_topics[{k!r}]", v)
+            for k, v in cfg.observe.trace_sample_topics.items()
+        ),
+    ]:
+        if not 0.0 <= float(rate) <= 1.0:
+            raise ConfigError(f"{name} must be in [0, 1]")
+    if cfg.observe.retrace_alarm_threshold < 1:
+        raise ConfigError("observe.retrace_alarm_threshold must be >= 1")
     if not 0 <= cfg.mqtt.max_qos_allowed <= 2:
         raise ConfigError("mqtt.max_qos_allowed must be 0..2")
     for r in cfg.rules:

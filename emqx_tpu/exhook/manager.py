@@ -222,8 +222,13 @@ class ExhookServer:
     def _breaker_open(self) -> bool:
         return time.monotonic() < self._broken_until
 
-    def call(self, method: str, request, hook: str):
+    def call(self, method: str, request, hook: str, metadata=None):
         """Blocking gRPC call -> (ok, response|None); metrics + breaker.
+
+        `metadata`: optional gRPC metadata tuples — the span context
+        (`traceparent`) rides here so a sidecar can join the broker's
+        trace (observe/spans.py; it is ALSO mirrored into the message
+        headers by the publish-span head).
 
         Runs on the server's worker thread (or any non-loop thread); never
         call from the event loop — use `acall`/`notify` there.
@@ -232,7 +237,9 @@ class ExhookServer:
             self.metrics[hook]["failed"] += 1
             return False, None
         try:
-            resp = getattr(self.stub, method)(request, timeout=self.timeout)
+            resp = getattr(self.stub, method)(
+                request, timeout=self.timeout, metadata=metadata
+            )
             self.metrics[hook]["succeed"] += 1
             self._consec_failures = 0
             return True, resp
@@ -246,7 +253,7 @@ class ExhookServer:
             log.debug("exhook %s %s failed: %s", self.name, method, e)
             return False, None
 
-    async def acall(self, method: str, request, hook: str):
+    async def acall(self, method: str, request, hook: str, metadata=None):
         """Awaitable `call` on the valued-lane worker; only the caller
         waits. A shut-down pool (unload raced with an in-flight packet)
         counts as a failure so failed_action applies."""
@@ -256,7 +263,8 @@ class ExhookServer:
         loop = asyncio.get_running_loop()
         try:
             return await loop.run_in_executor(
-                self._pool_valued, self.call, method, request, hook
+                self._pool_valued, self.call, method, request, hook,
+                metadata,
             )
         except RuntimeError:
             self.metrics[hook]["failed"] += 1
@@ -572,11 +580,17 @@ class ExhookManager:
         m = acc
         if m is None or m.is_sys():
             return None
+        # propagate the span context as gRPC metadata so a sidecar's own
+        # tracer can join the broker trace (the header string also rides
+        # inside pb.Message.headers via _msg_build)
+        ctx = m.headers.get("traceparent")
+        md = (("traceparent", ctx),) if isinstance(ctx, str) else None
         for s in self._servers_for("message.publish", m.topic):
             ok, resp = await s.acall(
                 "OnMessagePublish",
                 pb.MessagePublishRequest(message=_msg_build(m)),
                 "message.publish",
+                metadata=md,
             )
             if not ok:
                 if s.failed_action == "deny":

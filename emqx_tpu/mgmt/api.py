@@ -75,6 +75,9 @@ ROUTES = [
     ("post", "/api/v5/mqtt/topic_metrics", "topic_metrics_add", "Track a topic", "topic_metrics"),
     ("delete", "/api/v5/mqtt/topic_metrics/{topic:.+}", "topic_metrics_del", "Untrack a topic", "topic_metrics"),
     ("get", "/api/v5/prometheus/stats", "prometheus_stats", "Prometheus exposition", "metrics"),
+    ("get", "/api/v5/trace/spans", "trace_spans",
+     "Recent causal trace spans (publish -> batch -> device -> deliver "
+     "ring buffer, OTLP-shaped)", "trace"),
     ("get", "/api/v5/trace", "trace_list", "List packet traces", "trace"),
     ("post", "/api/v5/trace", "trace_create", "Create a packet trace", "trace"),
     ("delete", "/api/v5/trace/{name}", "trace_delete", "Delete a trace", "trace"),
@@ -362,9 +365,23 @@ class MgmtApi:
                     routed_fb / routed_total if routed_total else None
                 ),
             },
+            "device": {
+                "compile_count": m.get("device.compile.count"),
+                "compile_ms": hist("device.compile.seconds", 1e3),
+                "compile_cache_size": m.gauge("device.compile.cache_size"),
+                "hbm_bytes": m.gauge("device.hbm.bytes"),
+                "transfer_bytes": m.get("device.transfer.bytes"),
+            },
+            "trace": {
+                "spans_sampled": m.get("trace.spans.sampled"),
+                "spans_dropped": m.get("trace.spans.dropped"),
+            },
             "alarms": {
                 "tpu_fallback_rate_active": self.app.alarms.is_active(
                     "tpu_fallback_rate"
+                ),
+                "tpu_retrace_storm_active": self.app.alarms.is_active(
+                    "tpu_retrace_storm"
                 ),
             },
         }
@@ -690,6 +707,31 @@ class MgmtApi:
             histograms=self.broker.metrics.histograms(),
         )
         return web.Response(text=body, content_type="text/plain")
+
+    async def trace_spans(self, request):
+        """Recent causal spans (observe/spans.py ring buffer), newest
+        first, OTLP/JSON-shaped. Query: `limit` (default 100),
+        `trace_id` (filter to one trace — follow a single publish
+        through batch/device/deliver and across cluster forwards)."""
+        rec = getattr(self.app, "spans", None)
+        if rec is None:
+            return web.json_response(
+                {"data": [], "enabled": False}
+            )
+        try:
+            limit = int(request.query.get("limit", 100))
+        except ValueError:
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        return web.json_response(
+            {
+                "data": rec.recent(
+                    limit=limit, trace_id=request.query.get("trace_id")
+                ),
+                "enabled": True,
+                "sampled": self.broker.metrics.get("trace.spans.sampled"),
+                "dropped": self.broker.metrics.get("trace.spans.dropped"),
+            }
+        )
 
     async def trace_list(self, request):
         return web.json_response({"data": self.app.trace.list()})

@@ -915,6 +915,8 @@ class DeviceRouter:
                 "router.device.seconds", time.perf_counter() - t0
             )
             self.metrics.observe("router.batch.size", len(topics))
+            # cumulative link-bandwidth accounting (device_watch.py)
+            self.metrics.inc("device.transfer.bytes", out.readback_bytes)
             if out.bitmaps is not None or out.slots is not None:
                 self.metrics.observe(
                     "dispatch.readback.bytes", out.readback_bytes

@@ -76,10 +76,13 @@ class Client:
         ssl: object = None,
     ):
         if transport in ("ws", "wss"):
-            # MQTT-over-WebSocket (binary frames, "mqtt" subprotocol)
-            from websockets.asyncio.client import connect as ws_connect
+            # MQTT-over-WebSocket (binary frames, "mqtt" subprotocol).
+            # require_ws_support gives the actionable no-package error
+            # instead of a bare ModuleNotFoundError mid-connect
+            from emqx_tpu.transport.ws import _WsStream, require_ws_support
 
-            from emqx_tpu.transport.ws import _WsStream
+            require_ws_support()
+            from websockets.asyncio.client import connect as ws_connect
 
             scheme = "wss" if transport == "wss" else "ws"
             if transport == "wss" and ssl is None:

@@ -200,3 +200,82 @@ class FallbackRateWatch:
             ),
         )
         return rate
+
+
+class RetraceStormWatch:
+    """Level-triggered alarm on steady-state jit compile activity.
+
+    Boot compiles are normal (warmup, first table growth). A compile rate
+    that STAYS nonzero after warmup means some batch property keeps
+    leaking into a shape or static jit position — every "new" batch
+    recompiles the serving program, each compile costing seconds to tens
+    of seconds of device stall. The static RT checker predicts the common
+    sources; this watch observes the live symptom from the
+    `device.compile.count` counter (fed by `DeviceWatch.poll`).
+
+    Semantics: windows ending inside the warmup period only advance the
+    cursor. After warmup, `sustain` CONSECUTIVE windows each seeing
+    `threshold`+ compiles activate the alarm; any compile-free window
+    clears it (level-triggered, like FallbackRateWatch).
+    """
+
+    ALARM = "tpu_retrace_storm"
+
+    def __init__(
+        self,
+        alarms: AlarmManager,
+        metrics,
+        threshold: int = 1,
+        window: float = 10.0,
+        warmup: float = 60.0,
+        sustain: int = 2,
+    ):
+        self.alarms = alarms
+        self.metrics = metrics
+        self.threshold = max(1, int(threshold))
+        self.window = window
+        self.warmup = warmup
+        self.sustain = max(1, int(sustain))
+        self.started_at = time.time()
+        self._last_at: Optional[float] = None
+        self._last_count = 0
+        self._hot_windows = 0
+
+    def check(self, now: Optional[float] = None) -> Optional[int]:
+        """Evaluate once per elapsed window; returns the closed window's
+        compile count (None when no window closed)."""
+        now = now if now is not None else time.time()
+        if self._last_at is None:
+            self._last_at = now
+            self._last_count = self.metrics.get("device.compile.count")
+            return None
+        if now - self._last_at < self.window:
+            return None
+        count = self.metrics.get("device.compile.count")
+        d = count - self._last_count
+        self._last_at = now
+        self._last_count = count
+        if now < self.started_at + self.warmup:
+            return d  # boot compiles: observe, never alarm
+        self._hot_windows = self._hot_windows + 1 if d >= self.threshold else 0
+        self.alarms.ensure(
+            self.ALARM,
+            self._hot_windows >= self.sustain,
+            details={
+                "compiles_last_window": d,
+                "threshold": self.threshold,
+                "window_seconds": self.window,
+                "consecutive_hot_windows": self._hot_windows,
+                "compile_cache_size": self.metrics.gauge(
+                    "device.compile.cache_size"
+                ),
+            },
+            message=(
+                f"jit compile rate nonzero for {self._hot_windows} "
+                f"consecutive {self.window:g}s windows in steady state: "
+                "a batch property is leaking into a jit shape/static "
+                "position (retrace storm) — each recompile stalls the "
+                "serving path"
+            ),
+        )
+        return d

@@ -1,0 +1,492 @@
+"""Causal span tracing across the batch boundary.
+
+The flight recorder (PR 1) answers "how is the pipeline doing"; this
+module answers "why was THIS publish slow". A batched TPU pipeline
+destroys per-message causality — N publishes fan IN to one ingest batch,
+one `route_step` launch, then fan OUT to M deliveries — so a per-message
+trace needs more than parent/child edges. The model here is the OTLP
+span model (trace_id / span_id / parent + **links**):
+
+  mqtt.publish  ──link──▶  ingest.batch  ──parent──▶  router.device_step
+       │  (fan-in: each sampled publish                     ▲
+       │   links into exactly one batch)                    │ link
+       └──parent──▶  mqtt.deliver  ────────────────────────┘
+          (fan-out: deliver spans keep the PUBLISH trace_id, so one
+           message's trace survives publish → batch → device → deliver
+           — including across cluster forwards, where the context rides
+           the message headers — while the link to the device-step span
+           keeps batch attribution)
+
+Head-based sampling: the decision is made ONCE at the publish head from
+a deterministic seeded hash of (client, topic) — so one flow is either
+always traced or never, and repeated runs see the same sample — with
+per-client / per-topic-filter rate overrides and an always-sample escape
+hatch for clients matched by an active `TraceSpec` (emqx_trace-style
+debugging gets full fidelity). Downstream stages never re-sample: the
+presence of the `traceparent` header IS the decision.
+
+Export: a bounded in-memory ring (served by `GET /api/v5/trace/spans`)
+plus an optional OTLP-shaped JSON file exporter
+(`observe.trace_span_file`) a collector can tail.
+
+Reference analogs: emqx_trace / emqx_slow_subs measure per-message
+latency externally; OpenTelemetry semantic conventions shape the export.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from emqx_tpu.ops import topics as T
+
+# message-header key carrying the span context (W3C traceparent shape:
+# "00-<32 hex trace_id>-<16 hex span_id>-01"); rides cluster forwards
+# (headers pickle with the Message) and exhook calls (stringified into
+# pb.Message.headers AND sent as gRPC metadata)
+TRACE_HEADER = "traceparent"
+
+
+def format_ctx(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_ctx(header: Optional[str]) -> Optional[Tuple[str, str]]:
+    """traceparent string -> (trace_id, span_id) | None."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+@dataclass
+class Span:
+    """One span. Times are unix nanoseconds (the OTLP convention)."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    start_ns: int = 0
+    end_ns: int = 0
+    attrs: Dict = field(default_factory=dict)
+    # links: fan-in/fan-out edges to spans in OTHER traces
+    links: List[Tuple[str, str]] = field(default_factory=list)
+    status: str = "ok"  # ok | error
+
+    def ctx(self) -> str:
+        return format_ctx(self.trace_id, self.span_id)
+
+    def to_otlp(self) -> Dict:
+        """One OTLP/JSON span object (trace service JSON encoding)."""
+        out = {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "name": self.name,
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns),
+            "kind": "SPAN_KIND_INTERNAL",
+            "attributes": [
+                {"key": k, "value": _otlp_value(v)}
+                for k, v in self.attrs.items()
+            ],
+            "status": {"code": "STATUS_CODE_ERROR"}
+            if self.status == "error"
+            else {"code": "STATUS_CODE_OK"},
+        }
+        if self.parent_id:
+            out["parentSpanId"] = self.parent_id
+        if self.links:
+            out["links"] = [
+                {"traceId": t, "spanId": s} for t, s in self.links
+            ]
+        return out
+
+
+def _otlp_value(v):
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+class OtlpFileExporter:
+    """OTLP-shaped JSON file sink: one `resourceSpans` envelope per line,
+    buffered (the hot path must never wait on a disk flush per span)."""
+
+    def __init__(self, path: str, service_name: str = "emqx_tpu",
+                 flush_every: int = 64):
+        self.path = path
+        self.service_name = service_name
+        self.flush_every = flush_every
+        self._buf: List[Dict] = []  # guarded-by: _lock
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def export(self, spans: Sequence[Span]) -> None:
+        with self._lock:
+            self._buf.extend(s.to_otlp() for s in spans)
+            if len(self._buf) < self.flush_every:
+                return
+            batch, self._buf = self._buf, []
+        self._write(batch)
+
+    def _write(self, batch: List[Dict]) -> None:
+        if not batch:
+            return
+        envelope = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "emqx_tpu.observe.spans"},
+                            "spans": batch,
+                        }
+                    ],
+                }
+            ]
+        }
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(envelope) + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        self._write(batch)
+
+
+class SpanRecorder:
+    """Owns sampling, the open-span registry, the finished-span ring, and
+    the exporter. One instance per broker (like `Metrics`).
+
+    Hot-path cost profile: an UNSAMPLED publish pays one crc32 + two dict
+    gets; downstream stages pay one header `.get` per message. Span
+    construction happens only on the sampled fraction.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        sample_rate: float = 0.01,
+        sample_clients: Optional[Dict[str, float]] = None,
+        sample_topics: Optional[Dict[str, float]] = None,
+        seed: int = 0,
+        ring: int = 2048,
+        exporter: Optional[OtlpFileExporter] = None,
+        always_sample: Optional[Callable[[str, str], bool]] = None,
+    ):
+        """`always_sample(client_id, topic)`: full-fidelity escape hatch —
+        wired to `TraceManager.should_sample` so clients/topics under an
+        active emqx_trace-style spec are sampled at 100%."""
+        self.metrics = metrics
+        self.sample_rate = float(sample_rate)
+        self.sample_clients = dict(sample_clients or {})
+        self.sample_topics = dict(sample_topics or {})
+        self.seed = int(seed)
+        self.exporter = exporter
+        self.always_sample = always_sample
+        self._ring: deque = deque(maxlen=ring)  # guarded-by: _lock
+        self._lock = threading.Lock()
+        # publish spans awaiting settle, keyed by span_id; bounded so a
+        # publish that never settles (crashed dispatch) cannot leak
+        self._open: Dict[str, Span] = {}  # guarded-by: _lock
+        self._open_max = 8192
+        # ids: process-random prefix + counter => unique, no per-span
+        # entropy syscall; next() is GIL-atomic
+        self._prefix = int.from_bytes(os.urandom(8), "big")
+        self._seq = itertools.count(1)
+
+    # -- ids ---------------------------------------------------------------
+    def _ids(self) -> Tuple[str, str]:
+        n = next(self._seq)
+        return f"{self._prefix:016x}{n:016x}", f"{(self._prefix ^ n) & 0xFFFFFFFF:08x}{n & 0xFFFFFFFF:08x}"
+
+    def _span_id(self) -> str:
+        n = next(self._seq)
+        return f"{(self._prefix ^ n) & 0xFFFFFFFF:08x}{n & 0xFFFFFFFF:08x}"
+
+    @staticmethod
+    def now_ns() -> int:
+        return time.time_ns()
+
+    _now = now_ns
+
+    # -- sampling ----------------------------------------------------------
+    def rate_for(self, client_id: str, topic: str) -> float:
+        """Most specific knob wins: client override, then the first
+        matching topic-filter override, then the base rate."""
+        r = self.sample_clients.get(client_id)
+        if r is not None:
+            return r
+        for filt, fr in self.sample_topics.items():
+            if T.match(topic, filt):
+                return fr
+        return self.sample_rate
+
+    def sample(self, client_id: str, topic: str) -> bool:
+        """Deterministic head decision: seeded hash of (client, topic)
+        against the effective rate — one flow samples consistently."""
+        if self.always_sample is not None and self.always_sample(
+            client_id, topic
+        ):
+            return True
+        rate = self.rate_for(client_id, topic)
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        h = zlib.crc32(f"{self.seed}:{client_id}:{topic}".encode())
+        return h < rate * 4294967296.0
+
+    # -- core span ops -----------------------------------------------------
+    def start(
+        self,
+        name: str,
+        *,
+        trace_id: Optional[str] = None,
+        parent_id: str = "",
+        links: Sequence[Tuple[str, str]] = (),
+        attrs: Optional[Dict] = None,
+        start_ns: int = 0,
+    ) -> Span:
+        if trace_id is None:
+            trace_id, span_id = self._ids()
+        else:
+            span_id = self._span_id()
+        return Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_id=parent_id,
+            start_ns=start_ns or self._now(),
+            attrs=dict(attrs or {}),
+            links=list(links),
+        )
+
+    def finish(self, span: Span, attrs: Optional[Dict] = None,
+               status: Optional[str] = None) -> None:
+        span.end_ns = self._now()
+        if attrs:
+            span.attrs.update(attrs)
+        if status is not None:
+            span.status = status
+        with self._lock:
+            self._ring.append(span)
+        if self.metrics is not None:
+            self.metrics.inc("trace.spans.sampled")
+        if self.exporter is not None:
+            self.exporter.export((span,))
+
+    # -- hot-path helpers (publish / batch / device / deliver) -------------
+    def publish_links(self, msgs) -> List[Tuple[str, str]]:
+        """Parsed span contexts of the sampled messages in a batch."""
+        out = []
+        for m in msgs:
+            parsed = parse_ctx(m.headers.get(TRACE_HEADER))
+            if parsed is not None:
+                out.append(parsed)
+        return out
+
+    def publish_begin(self, msg) -> Optional[Span]:
+        """Head of a trace: sample once, stamp the context header, open
+        the span until the batch settles. Returns None when unsampled.
+        Broker-generated `$`-rooted chatter ($SYS heartbeats, $event
+        lifecycle messages) never head-samples — flow-consistent
+        sampling would otherwise trace it forever — unless an active
+        TraceSpec explicitly targets it."""
+        if msg.topic.startswith("$"):
+            if self.always_sample is None or not self.always_sample(
+                msg.from_client, msg.topic
+            ):
+                return None
+        elif not self.sample(msg.from_client, msg.topic):
+            return None
+        span = self.start(
+            "mqtt.publish",
+            attrs={
+                "messaging.destination": msg.topic,
+                "messaging.client_id": msg.from_client,
+                "messaging.qos": msg.qos,
+            },
+        )
+        msg.headers[TRACE_HEADER] = span.ctx()
+        with self._lock:
+            if len(self._open) >= self._open_max:
+                # evict the oldest unfinished span rather than grow
+                evicted_id = next(iter(self._open))
+                evicted = self._open.pop(evicted_id)
+                if self.metrics is not None:
+                    self.metrics.inc("trace.spans.dropped")
+                evicted.status = "error"
+                evicted.attrs["dropped"] = "open_overflow"
+            self._open[span.span_id] = span
+        return span
+
+    def publish_finish(self, ctx: Optional[str], deliveries: int,
+                       status: str = "ok") -> None:
+        """Settle a publish span by its context header (the ingest path
+        holds contexts, not span objects)."""
+        parsed = parse_ctx(ctx)
+        if parsed is None:
+            return
+        _, span_id = parsed
+        with self._lock:
+            span = self._open.pop(span_id, None)
+        if span is None:
+            if self.metrics is not None:
+                self.metrics.inc("trace.spans.dropped")
+            return
+        self.finish(span, {"messaging.deliveries": deliveries},
+                    status=status)
+
+    def finish_span(self, span: Optional[Span], deliveries: int,
+                    status: str = "ok") -> None:
+        """Settle a publish span held as an object (sync publish path)."""
+        if span is None:
+            return
+        with self._lock:
+            self._open.pop(span.span_id, None)
+        self.finish(span, {"messaging.deliveries": deliveries},
+                    status=status)
+
+    def batch_begin(self, seq: int, msgs, max_batch: int) -> Optional[Span]:
+        """Fan-in: one batch span whose links are the sampled publishes'
+        contexts (keyed by the same batch seq the `ingest.launch`
+        tracepoint carries). None when nothing in the batch is sampled —
+        unsampled traffic never materializes batch spans."""
+        links = []
+        for m in msgs:
+            parsed = parse_ctx(m.headers.get(TRACE_HEADER))
+            if parsed is not None:
+                links.append(parsed)
+        if not links:
+            return None
+        return self.start(
+            "ingest.batch",
+            links=links,
+            attrs={
+                "batch.seq": seq,
+                "batch.size": len(msgs),
+                "batch.occupancy": len(msgs) / max_batch,
+            },
+        )
+
+    def device_step(self, batch_span: Optional[Span], n_rows: int, results,
+                    start_ns: int, links: Sequence = ()) -> Optional[Span]:
+        """The kernel launch+readback span, annotated from the
+        `RouteResult`: readback bytes, compact/overflow rows, fallback
+        rows. Child of the batch span (same trace); standalone with links
+        to the sampled publishes on batch-less (sync) dispatches."""
+        if batch_span is None and not links:
+            return None
+        import numpy as np
+
+        attrs = {
+            "device.rows": n_rows,
+            "device.readback_bytes": int(
+                getattr(results, "readback_bytes", 0)
+            ),
+            "device.fallback_rows": int(np.count_nonzero(results.flags)),
+        }
+        if results.slots is not None:
+            n_ovf = int(np.count_nonzero(results.overflow))
+            attrs["device.compact_rows"] = n_rows - n_ovf
+            attrs["device.overflow_rows"] = n_ovf
+        span = self.start(
+            "router.device_step",
+            trace_id=batch_span.trace_id if batch_span else None,
+            parent_id=batch_span.span_id if batch_span else "",
+            links=() if batch_span else links,
+            attrs=attrs,
+            start_ns=start_ns,
+        )
+        self.finish(span)
+        return span
+
+    def deliver(self, msg, deliveries: int, *, start_ns: int = 0,
+                device_span: Optional[Span] = None,
+                fallback: bool = False, remote: bool = False) -> None:
+        """Fan-out: a deliver span in the PUBLISH's trace (so the
+        trace_id survives end-to-end, including a cluster hop), linked to
+        the device-step span for batch attribution."""
+        parsed = parse_ctx(msg.headers.get(TRACE_HEADER))
+        if parsed is None:
+            return
+        trace_id, parent_id = parsed
+        attrs = {
+            "messaging.destination": msg.topic,
+            "messaging.deliveries": deliveries,
+        }
+        if fallback:
+            attrs["device.fallback"] = True
+        if remote:
+            attrs["cluster.forwarded"] = True
+        span = self.start(
+            "mqtt.deliver",
+            trace_id=trace_id,
+            parent_id=parent_id,
+            links=[(device_span.trace_id, device_span.span_id)]
+            if device_span is not None
+            else [],
+            attrs=attrs,
+            start_ns=start_ns,
+        )
+        self.finish(span)
+
+    def forward(self, msg, peer: str) -> None:
+        """A cross-node forward of a sampled message (publisher side):
+        records where the trace context left this node."""
+        parsed = parse_ctx(msg.headers.get(TRACE_HEADER))
+        if parsed is None:
+            return
+        trace_id, parent_id = parsed
+        span = self.start(
+            "cluster.forward",
+            trace_id=trace_id,
+            parent_id=parent_id,
+            attrs={"cluster.peer": peer,
+                   "messaging.destination": msg.topic},
+        )
+        self.finish(span)
+
+    # -- read side ---------------------------------------------------------
+    def recent(self, limit: int = 100,
+               trace_id: Optional[str] = None) -> List[Dict]:
+        """Newest-first OTLP-shaped span dicts from the ring."""
+        with self._lock:
+            spans = list(self._ring)
+        spans.reverse()
+        if trace_id:
+            spans = [s for s in spans if s.trace_id == trace_id]
+        return [s.to_otlp() for s in spans[: max(0, int(limit))]]
+
+    def spans(self) -> List[Span]:
+        """Raw Span objects (oldest first) — test/assertion surface."""
+        with self._lock:
+            return list(self._ring)
+
+    def close(self) -> None:
+        if self.exporter is not None:
+            self.exporter.flush()

@@ -161,6 +161,24 @@ class TraceManager:
             if name in self._files and spec.status(now) == "stopped":
                 self._files.pop(name).close()
 
+    def should_sample(self, client_id: str, topic: str) -> bool:
+        """Always-sample hook for the span recorder (observe/spans.py):
+        a client or topic under an ACTIVE trace spec gets 100% span
+        sampling, so emqx_trace-style debugging sees every span of the
+        flow being traced. ip_address specs don't apply (the publish head
+        has no peer address)."""
+        if not self._specs:
+            return False
+        now = time.time()
+        for spec in self._specs.values():
+            if spec.status(now) != "running":
+                continue
+            if spec.type == "clientid" and spec.value == client_id:
+                return True
+            if spec.type == "topic" and T.match(topic, spec.value):
+                return True
+        return False
+
     # -- logging -----------------------------------------------------------
     def log(self, event: str, meta: Dict) -> None:
         now = time.time()

@@ -317,6 +317,12 @@ class TpuMatcher:
         })
         matched, mcount = host["matched"], host["mcount"]
         flags = host["flags"] | too_long
+        # cumulative link-bandwidth accounting (observe/device_watch.py)
+        self.metrics.inc(
+            "device.transfer.bytes",
+            sum(v.nbytes for v in (matched, mcount, host["flags"]))
+            + sum(v.nbytes for v in host["causes"].values()),
+        )
         self._record(
             B, time.perf_counter() - t0, flags, host["causes"], too_long
         )

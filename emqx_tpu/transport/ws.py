@@ -21,11 +21,35 @@ import asyncio
 import ssl as ssl_mod
 from typing import Optional
 
-from websockets.asyncio.server import serve
-from websockets.exceptions import ConnectionClosed
+# `websockets` is imported lazily (same pattern as dtls.py's
+# `cryptography`): the module must stay importable on images without the
+# package — a ws/wss listener fails at START time with an actionable
+# error, not at import, and runtime ws tests skip cleanly.
+try:
+    from websockets.asyncio.server import serve
+    from websockets.exceptions import ConnectionClosed
+except ImportError:  # pragma: no cover - exercised on slim images
+    serve = None
 
-from emqx_tpu.transport.connection import Connection
-from emqx_tpu.transport.listener import build_ssl_context
+    class ConnectionClosed(Exception):  # placeholder: keeps the
+        """Never raised when `websockets` is absent."""  # except clauses
+        # below importable; real connections cannot exist without serve()
+
+HAVE_WEBSOCKETS = serve is not None
+
+
+def require_ws_support() -> None:
+    """Raise a clear error when the websockets backend is unavailable;
+    called when a ws/wss listener actually starts."""
+    if serve is None:
+        raise RuntimeError(
+            "WebSocket listeners require the 'websockets' package; "
+            "install it or remove the ws/wss listener from the config"
+        )
+
+
+from emqx_tpu.transport.connection import Connection  # noqa: E402
+from emqx_tpu.transport.listener import build_ssl_context  # noqa: E402
 
 
 class _WsStream:
@@ -153,6 +177,7 @@ class WsListener:
         return self.config.port
 
     async def start(self) -> None:
+        require_ws_support()
         ctx: Optional[ssl_mod.SSLContext] = None
         if self.config.type == "wss":
             ctx = build_ssl_context(self.config)

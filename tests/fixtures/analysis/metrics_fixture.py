@@ -10,6 +10,8 @@ def declare(name, kind, help=""):
 declare("messages.received", COUNTER)
 declare("messages.dropped", COUNTER)
 declare("dispatch.readback.bytes", "histogram")
+declare("trace.spans.sampled", COUNTER)
+declare("device.compile.count", COUNTER)
 
 
 class M:
@@ -27,9 +29,13 @@ def good(m: M):
     m.inc("messages.received")
     m.inc("messages.dropped", 2)
     m.observe("dispatch.readback.bytes", 4096)
+    m.inc("trace.spans.sampled")
+    m.inc("device.compile.count", 3)
 
 
 def bad(m: M):
     m.inc("messages.recieved")  # MN001: typo'd series
     m.gauge_set("sessions.active", 1)  # MN001: never declared
     m.observe("dispatch.readback.bytez", 1)  # MN001: typo'd series
+    m.inc("trace.spans.samplid")  # MN001: typo'd span series
+    m.inc("device.compile.cout")  # MN001: typo'd device series

@@ -256,7 +256,7 @@ async def test_ingest_pipeline_overlaps_and_settles_fifo():
         def __init__(self):
             self.n = 0
 
-        def adispatch_begin(self, msgs, forward=True):
+        def adispatch_begin(self, msgs, forward=True, batch_span=None):
             from emqx_tpu.broker.broker import PendingDispatch
 
             i = self.n

@@ -583,7 +583,7 @@ def test_ingest_launch_error_counted():
                 min_tpu_batch = 1
                 enable_tpu = True
 
-            def adispatch_begin(self, msgs, forward=True):
+            def adispatch_begin(self, msgs, forward=True, batch_span=None):
                 raise RuntimeError("device on fire")
 
         ing = BatchIngest(BoomBroker(), window_us=0)

@@ -1,0 +1,469 @@
+"""Causal span tracing + device runtime telemetry tests.
+
+Covers the ISSUE-5 invariants:
+- every sampled publish span reaches >= 1 deliver span through EXACTLY
+  one batch span (fan-in links), and the batch span parents the
+  device-step span the deliver spans link to;
+- fan-in link count == batch occupancy at 100% sampling;
+- head-based sampling is deterministic under a seeded hash, with
+  per-client / per-topic-filter overrides and the TraceSpec
+  always-sample escape hatch;
+- one publish's trace_id survives publish -> batch -> device-step ->
+  deliver, and a 2-node cluster forward;
+- RetraceStormWatch fires on a forced re-jit storm and stays silent in
+  steady state; DeviceWatch gauges move.
+"""
+
+import asyncio
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.ingest import BatchIngest
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.broker.router import Router
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.observe.alarm import AlarmManager, RetraceStormWatch
+from emqx_tpu.observe.device_watch import DeviceWatch
+from emqx_tpu.observe.spans import (
+    TRACE_HEADER,
+    OtlpFileExporter,
+    SpanRecorder,
+    parse_ctx,
+)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+def _bed(n_subs=8, min_tpu_batch=8, sample_rate=1.0, **rec_kw):
+    """Broker + recorder + subscriber stubs; every `t/<i>/x` publish
+    matches exactly one `t/<i>/+` subscription."""
+    b = Broker(router=Router(min_tpu_batch=min_tpu_batch), hooks=Hooks())
+    rec = SpanRecorder(
+        metrics=b.metrics, sample_rate=sample_rate, **rec_kw
+    )
+    b.spans = rec
+    sink = []
+    for i in range(n_subs):
+        b.subscribe(
+            f"s{i}", f"c{i}", f"t/{i}/+", pkt.SubOpts(),
+            lambda m, o: sink.append(m.topic),
+        )
+    return b, rec, sink
+
+
+async def _publish_through_ingest(b, n_msgs, n_subs=8):
+    ing = BatchIngest(b, max_batch=64, window_us=200)
+    b.ingest = ing
+    ing.start()
+    results = [
+        await b.apublish_enqueue(
+            Message(
+                topic=f"t/{i % n_subs}/x",
+                payload=b"p",
+                from_client=f"pub{i % 4}",
+            )
+        )
+        for i in range(n_msgs)
+    ]
+    futs = [r for r in results if not isinstance(r, int)]
+    counts = list(await asyncio.gather(*futs)) + [
+        r for r in results if isinstance(r, int)
+    ]
+    await ing.stop()
+    b.ingest = None
+    return counts
+
+
+def _by_name(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s.name, []).append(s)
+    return out
+
+
+# -- causal invariants ------------------------------------------------------
+
+@async_test
+async def test_publish_batch_device_deliver_causality():
+    """The headline invariant set, at 100% sampling: publish spans fan
+    IN to batch spans by links, batch parents device-step, deliver spans
+    keep the publish trace and link the device-step."""
+    N = 16
+    b, rec, _sink = _bed()
+    counts = await _publish_through_ingest(b, N)
+    assert sum(counts) == N  # one matching sub per publish
+    k = _by_name(rec.spans())
+    pubs, batches = k["mqtt.publish"], k["ingest.batch"]
+    devs, dels = k["router.device_step"], k["mqtt.deliver"]
+    assert len(pubs) == N and len(dels) == N
+    # fan-in: every publish links into EXACTLY one batch span
+    for p in pubs:
+        linked = [
+            bs for bs in batches
+            if (p.trace_id, p.span_id) in bs.links
+        ]
+        assert len(linked) == 1, (p.span_id, len(linked))
+        # ... and reaches >= 1 deliver span in ITS OWN trace
+        own_delivers = [
+            d for d in dels
+            if d.trace_id == p.trace_id and d.parent_id == p.span_id
+        ]
+        assert len(own_delivers) >= 1
+        # deliver -> device-step link -> batch parent closes the loop
+        # through the SAME batch span the publish linked into
+        for d in own_delivers:
+            assert len(d.links) == 1
+            dev = next(
+                v for v in devs if (v.trace_id, v.span_id) == d.links[0]
+            )
+            assert dev.parent_id == linked[0].span_id
+            assert dev.trace_id == linked[0].trace_id
+    # fan-in link count == batch occupancy (100% sampling: every row of
+    # the batch is a link, and the attr agrees)
+    for bs in batches:
+        assert len(bs.links) == bs.attrs["batch.size"]
+        assert 0 < bs.attrs["batch.occupancy"] <= 1.0
+    assert sum(len(bs.links) for bs in batches) == N
+    # device-step spans carry the readback annotations
+    for dev in devs:
+        assert dev.attrs["device.rows"] >= 1
+        assert dev.attrs["device.readback_bytes"] > 0
+        assert dev.attrs["device.fallback_rows"] == 0
+    # settle recorded delivery counts on the publish spans
+    assert all(p.attrs.get("messaging.deliveries") == 1 for p in pubs)
+    assert b.metrics.get("trace.spans.dropped") == 0
+
+
+@async_test
+async def test_partial_sampling_only_sampled_flows_materialize():
+    """rate=0.5: unsampled publishes produce NO spans anywhere in the
+    pipeline, sampled ones keep the full causal chain; the decision is
+    per-flow (client+topic), so repeated publishes agree."""
+    N = 32
+    b, rec, _sink = _bed(sample_rate=0.5)
+    flows = {
+        (f"pub{i % 4}", f"t/{i % 8}/x"): rec.sample(
+            f"pub{i % 4}", f"t/{i % 8}/x"
+        )
+        for i in range(N)
+    }
+    n_sampled_flows = sum(
+        1 for i in range(N) if flows[(f"pub{i % 4}", f"t/{i % 8}/x")]
+    )
+    assert 0 < n_sampled_flows < N  # the seed must split this workload
+    counts = await _publish_through_ingest(b, N)
+    assert sum(counts) == N  # sampling never affects delivery
+    k = _by_name(rec.spans())
+    assert len(k["mqtt.publish"]) == n_sampled_flows
+    assert len(k["mqtt.deliver"]) == n_sampled_flows
+    # batch spans only link the sampled subset
+    assert sum(
+        len(bs.links) for bs in k["ingest.batch"]
+    ) == n_sampled_flows
+
+
+def test_sampling_deterministic_under_seeded_hash():
+    r1 = SpanRecorder(sample_rate=0.5, seed=7)
+    r2 = SpanRecorder(sample_rate=0.5, seed=7)
+    r3 = SpanRecorder(sample_rate=0.5, seed=8)
+    flows = [(f"c{i}", f"top/{i}") for i in range(256)]
+    d1 = [r1.sample(c, t) for c, t in flows]
+    d2 = [r2.sample(c, t) for c, t in flows]
+    d3 = [r3.sample(c, t) for c, t in flows]
+    assert d1 == d2  # same seed -> identical decisions
+    assert d1 != d3  # a different seed re-partitions the flows
+    assert 0 < sum(d1) < len(flows)  # ~half, never all-or-nothing
+    # rate edges
+    r_all = SpanRecorder(sample_rate=1.0)
+    r_none = SpanRecorder(sample_rate=0.0)
+    assert all(r_all.sample(c, t) for c, t in flows[:16])
+    assert not any(r_none.sample(c, t) for c, t in flows[:16])
+
+
+def test_sampling_overrides_client_topic_and_tracespec():
+    rec = SpanRecorder(
+        sample_rate=0.0,
+        sample_clients={"vip": 1.0},
+        sample_topics={"hot/#": 1.0},
+    )
+    assert rec.sample("vip", "anything/at/all")
+    assert rec.sample("nobody", "hot/1/2")
+    assert not rec.sample("nobody", "cold/1")
+    # client override beats topic override (most specific wins)
+    rec2 = SpanRecorder(
+        sample_rate=1.0, sample_clients={"muted": 0.0}
+    )
+    assert not rec2.sample("muted", "hot/1")
+    # TraceSpec escape hatch: an active clientid/topic spec forces
+    # sampling even at rate 0 (emqx_trace-style full fidelity)
+    from emqx_tpu.observe.trace import TraceManager
+
+    tm = TraceManager(base_dir="/tmp/_span_traces")
+    tm.create("dbg", "clientid", "debug-me")
+    try:
+        rec3 = SpanRecorder(
+            sample_rate=0.0, always_sample=tm.should_sample
+        )
+        assert rec3.sample("debug-me", "t/1")
+        assert not rec3.sample("other", "t/1")
+    finally:
+        tm.delete("dbg")
+        tm.close()
+
+
+@async_test
+async def test_trace_id_survives_cpu_fallback_path():
+    """min_tpu_batch high => per-message CPU dispatch; the publish span
+    still parents a deliver span in the same trace (no batch/device)."""
+    b, rec, _sink = _bed(min_tpu_batch=10_000)
+    n = await b.apublish_enqueue(
+        Message(topic="t/1/x", payload=b"p", from_client="solo")
+    )
+    assert n == 1
+    k = _by_name(rec.spans())
+    (p,), (d,) = k["mqtt.publish"], k["mqtt.deliver"]
+    assert d.trace_id == p.trace_id and d.parent_id == p.span_id
+    assert "ingest.batch" not in k and "router.device_step" not in k
+
+
+def test_trace_id_survives_cluster_forward():
+    """The acceptance e2e: a publish on node1 keeps its trace_id on the
+    node2 deliver span — the context rides the forwarded message."""
+    from emqx_tpu.cluster.node import make_cluster
+
+    bus, (n1, n2) = make_cluster(2)
+    r1 = SpanRecorder(metrics=n1.broker.metrics, sample_rate=1.0)
+    r2 = SpanRecorder(metrics=n2.broker.metrics, sample_rate=1.0)
+    n1.broker.spans = r1
+    n2.broker.spans = r2
+    got = []
+    n2.subscribe(
+        "s1", "c-remote", "x/#", pkt.SubOpts(qos=1),
+        lambda m, o: got.append(m),
+    )
+    n1.publish(
+        Message(topic="x/1", payload=b"hi", qos=1, from_client="pubber")
+    )
+    n1.flush()
+    n2.flush()
+    assert len(got) == 1
+    assert TRACE_HEADER in got[0].headers  # context crossed the wire
+    (p,) = [s for s in r1.spans() if s.name == "mqtt.publish"]
+    (f,) = [s for s in r1.spans() if s.name == "cluster.forward"]
+    (d,) = [s for s in r2.spans() if s.name == "mqtt.deliver"]
+    assert d.trace_id == p.trace_id  # trace_id survives the hop
+    assert f.trace_id == p.trace_id and f.parent_id == p.span_id
+    assert f.attrs["cluster.peer"] == n2.name
+    assert d.attrs.get("cluster.forwarded") is True
+    assert parse_ctx(got[0].headers[TRACE_HEADER]) == (
+        p.trace_id, p.span_id,
+    )
+
+
+@async_test
+async def test_dropped_publish_closes_span_with_error():
+    b, rec, _sink = _bed()
+
+    def deny(msg, acc=None):
+        m = acc if acc is not None else msg
+        m.headers["allow_publish"] = False
+        return ("ok", m)
+
+    b.hooks.add("message.publish", deny, priority=1000, tag="deny")
+    n = await b.apublish_enqueue(
+        Message(topic="t/1/x", payload=b"p", from_client="denied")
+    )
+    assert n == 0
+    (p,) = [s for s in rec.spans() if s.name == "mqtt.publish"]
+    assert p.status == "error" and p.attrs["messaging.deliveries"] == 0
+
+
+def test_sys_topics_never_head_sample():
+    rec = SpanRecorder(sample_rate=1.0)
+    for topic in ("$SYS/brokers/x/uptime", "$event/client_connected"):
+        m = Message(topic=topic, payload=b"1")
+        assert rec.publish_begin(m) is None
+        assert TRACE_HEADER not in m.headers
+
+
+# -- export surfaces --------------------------------------------------------
+
+def test_otlp_file_exporter_shape(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    rec = SpanRecorder(
+        sample_rate=1.0, exporter=OtlpFileExporter(path, flush_every=4)
+    )
+    m = Message(topic="t/1", payload=b"p", from_client="c1")
+    sp = rec.publish_begin(m)
+    rec.finish_span(sp, 3)
+    rec.close()  # flush the partial buffer
+    lines = [
+        json.loads(line)
+        for line in open(path, encoding="utf-8").read().splitlines()
+    ]
+    assert lines
+    scope = lines[0]["resourceSpans"][0]["scopeSpans"][0]
+    (span,) = scope["spans"]
+    assert len(span["traceId"]) == 32 and len(span["spanId"]) == 16
+    assert span["name"] == "mqtt.publish"
+    attrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert attrs["messaging.deliveries"] == {"intValue": "3"}
+    assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+    res = lines[0]["resourceSpans"][0]["resource"]["attributes"]
+    assert {"key": "service.name", "value": {"stringValue": "emqx_tpu"}} \
+        in res
+
+
+def test_recorder_ring_and_recent_filter():
+    rec = SpanRecorder(sample_rate=1.0, ring=8)
+    ids = []
+    for i in range(12):
+        m = Message(topic=f"t/{i}", payload=b"", from_client="c")
+        sp = rec.publish_begin(m)
+        ids.append(sp.trace_id)
+        rec.finish_span(sp, 0)
+    assert len(rec.spans()) == 8  # bounded ring
+    recent = rec.recent(limit=3)
+    assert len(recent) == 3
+    assert recent[0]["traceId"] == ids[-1]  # newest first
+    only = rec.recent(limit=10, trace_id=ids[-2])
+    assert len(only) == 1 and only[0]["traceId"] == ids[-2]
+
+
+@async_test
+async def test_rest_trace_spans_endpoint():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.schema import load_config
+
+    import aiohttp
+
+    app = BrokerApp(load_config({
+        "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+        "dashboard": {"port": 0, "bind": "127.0.0.1"},
+        "router": {"enable_tpu": False},
+        "observe": {"trace_sample_rate": 1.0},
+    }))
+    await app.start()
+    try:
+        sink = []
+        app.broker.subscribe(
+            "s", "c-sub", "api/#", pkt.SubOpts(),
+            lambda m, o: sink.append(m),
+        )
+        app.broker.publish(
+            Message(topic="api/t", payload=b"x", from_client="rest-pub")
+        )
+        api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{api}/trace/spans") as r:
+                body = await r.json()
+                assert r.status == 200 and body["enabled"] is True
+                names = {sp["name"] for sp in body["data"]}
+                assert {"mqtt.publish", "mqtt.deliver"} <= names
+                pub = next(
+                    sp for sp in body["data"]
+                    if sp["name"] == "mqtt.publish"
+                )
+            async with s.get(
+                f"{api}/trace/spans",
+                params={"trace_id": pub["traceId"]},
+            ) as r:
+                body = await r.json()
+                assert {sp["traceId"] for sp in body["data"]} == {
+                    pub["traceId"]
+                }
+    finally:
+        await app.stop()
+
+
+# -- device runtime telemetry ----------------------------------------------
+
+def test_device_watch_counts_forced_rejit_and_cache_hits():
+    from emqx_tpu.ops.contract import DeviceContract
+
+    kernel = jax.jit(lambda x: x * 2)
+    reg = {"k": DeviceContract(name="k", fn=kernel, kind="jit")}
+    m = Metrics()
+    w = DeviceWatch(m, registry=reg)
+    w.poll()
+    base = m.get("device.compile.count")
+    kernel(jnp.ones(4))  # first compile
+    r1 = w.poll()
+    assert r1["kernel_compiles"] == 1
+    assert m.get("device.compile.count") > base
+    assert m.gauge("device.compile.cache_size") >= 1
+    after_first = m.get("device.compile.count")
+    kernel(jnp.ones(4))  # cache hit: steady state
+    r2 = w.poll()
+    assert r2["kernel_compiles"] == 0
+    assert m.get("device.compile.count") == after_first
+    kernel(jnp.ones((2, 2)))  # forced re-jit (new shape)
+    r3 = w.poll()
+    assert r3["kernel_compiles"] == 1
+    assert m.get("device.compile.count") > after_first
+
+
+def test_retrace_alarm_fires_on_storm_and_stays_silent_steady():
+    m = Metrics()
+    alarms = AlarmManager()
+    w = RetraceStormWatch(
+        alarms, m, threshold=1, window=1.0, warmup=5.0, sustain=2
+    )
+    t0 = 1000.0
+    w.started_at = t0
+    w.check(t0)
+    # warmup: boot compiles never alarm
+    m.inc("device.compile.count", 10)
+    w.check(t0 + 1.5)
+    assert not alarms.is_active(RetraceStormWatch.ALARM)
+    # steady state, no compiles: silent
+    for i in range(4):
+        w.check(t0 + 6.0 + i * 1.5)
+    assert not alarms.is_active(RetraceStormWatch.ALARM)
+    # storm: compile rate stays nonzero -> fires after `sustain` windows
+    m.inc("device.compile.count")
+    w.check(t0 + 12.0)
+    assert not alarms.is_active(RetraceStormWatch.ALARM)  # 1 hot window
+    m.inc("device.compile.count")
+    w.check(t0 + 13.5)
+    assert alarms.is_active(RetraceStormWatch.ALARM)
+    # one compile-free window clears it (level-triggered)
+    w.check(t0 + 15.0)
+    assert not alarms.is_active(RetraceStormWatch.ALARM)
+
+
+@async_test
+async def test_transfer_bytes_and_hbm_gauges_move():
+    b, rec, _sink = _bed()
+    await _publish_through_ingest(b, 16)
+    assert b.metrics.get("device.transfer.bytes") > 0
+    w = DeviceWatch(b.metrics, registry={})
+    w.poll()
+    # CPU fallback path sums live array nbytes — the uploaded route
+    # tables are alive, so the gauge must be nonzero after a dispatch
+    assert b.metrics.gauge("device.hbm.bytes") > 0
+
+
+def test_open_registry_bounded_eviction_counts_dropped():
+    m = Metrics()
+    rec = SpanRecorder(metrics=m, sample_rate=1.0)
+    rec._open_max = 4
+    for i in range(8):
+        msg = Message(topic=f"t/{i}", payload=b"", from_client="c")
+        rec.publish_begin(msg)
+    assert len(rec._open) == 4
+    assert m.get("trace.spans.dropped") == 4

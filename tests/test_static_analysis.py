@@ -138,6 +138,7 @@ def test_metric_checker_flags_undeclared_series():
     }
     assert bad == {
         "messages.recieved", "sessions.active", "dispatch.readback.bytez",
+        "trace.spans.samplid", "device.compile.cout",
     }
 
 

@@ -17,6 +17,12 @@ from emqx_tpu.broker.cm import ChannelManager
 from emqx_tpu.broker.hooks import Hooks
 from emqx_tpu.mqtt.client import Client
 from emqx_tpu.transport.listener import ListenerConfig, Listeners
+from emqx_tpu.transport.ws import HAVE_WEBSOCKETS
+
+# runtime ws tests need the package; the module itself imports lazily
+pytestmark = pytest.mark.skipif(
+    not HAVE_WEBSOCKETS, reason="websockets not installed"
+)
 
 
 def async_test(fn):
