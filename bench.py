@@ -43,6 +43,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -793,9 +794,8 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
 # single-shape tables absorb the squeeze
 CONFIGS = [
     "mixed_10m",
+    "serving",  # e2e_serving + serving_dispatch, ONE process (headline)
     "share_10m",
-    "e2e_serving",
-    "serving_dispatch",
     "retained_5m",
     "mixed_1m",
     "plus_100k",
@@ -812,9 +812,8 @@ EXTRAS = ["retained_spot"]
 # skipped config, never a blown gate.
 MIN_BUDGET_S = {
     "mixed_10m": 300,
+    "serving": 280,  # e2e (2 points) + serving_dispatch, one process
     "share_10m": 120,
-    "e2e_serving": 200,
-    "serving_dispatch": 150,
     "retained_5m": 110,
     "mixed_1m": 60,
     "plus_100k": 45,
@@ -1004,10 +1003,16 @@ def bench_retained_spot() -> dict:
 
 
 E2E_WORKER_COUNTS = (0, 4)  # host data-plane scaling curve (r3 item 2)
-N_PUB = 24
+# driver counts SHRUNK to fit the budget (r3/r4: e2e skipped or timed
+# out — a headline metric that never lands is worth less than a smaller
+# one that always does): 2 driver processes, 16 publishers, 24k msgs
+N_PUB = 16
 N_SUB = 8
-PER_PUB = 1250  # 30k timed messages per point
-N_DRIVERS = 4
+PER_PUB = 1500  # 24k timed messages per point
+N_DRIVERS = 2
+# BENCH_r01's tunneled e2e rate on this harness lineage — the baseline
+# the headline `e2e_msgs_per_s` is reported against (target: >= 10x)
+R01_E2E_RPS = 30458.1
 
 
 def e2e_driver(port: int, n_pub: int, n_sub: int, per_pub: int,
@@ -1074,9 +1079,11 @@ def e2e_driver(port: int, n_pub: int, n_sub: int, per_pub: int,
     asyncio.run(run())
 
 
-def _e2e_point(workers: int) -> dict:
+def _e2e_point(workers: int, deadline: Optional[float] = None) -> dict:
     """One scaling-curve point: broker with `workers` connection workers
-    (0 = classic in-process listener), load from N_DRIVERS processes."""
+    (0 = classic in-process listener), load from N_DRIVERS processes.
+    `deadline` (absolute perf_counter stamp) bounds every long wait so a
+    degraded run yields a partial capture instead of a gate kill."""
     import asyncio
     import struct as _struct
     import subprocess
@@ -1103,15 +1110,17 @@ def _e2e_point(workers: int) -> dict:
             await app.worker_pools[0].wait_ready()
         _mark(f"e2e[w={workers}]: pre-compiling ingest batch buckets")
         # each pow2 ingest bucket is a fresh XLA compile (~40-60s cold);
-        # compile them all before the timed run
+        # compile them all before the timed run — through the ACTUAL
+        # serving entry (adispatch_begin -> donated/fused jit), not the
+        # sync path, or the timed flood pays the donated program's
+        # compile inside the window (exactly how e2e died in r03/r04)
         from emqx_tpu.broker.message import Message as _Msg
 
         size = app.broker.router.min_tpu_batch
         while size <= app.config.router.ingest_max_batch:
-            app.broker.dispatch_batch_folded(
+            await app.broker.adispatch_begin(
                 [_Msg(topic="warmup/bucket") for _ in range(size)]
             )
-            await asyncio.sleep(0)
             size *= 2
         # ALSO warm the subscribe->delta-sync->route path: the scatter
         # upload program is a separate XLA compile (~40s cold on a real
@@ -1138,52 +1147,66 @@ def _e2e_point(workers: int) -> dict:
         total = N_PUB * PER_PUB
         loop = asyncio.get_running_loop()
 
+        def left() -> float:
+            if deadline is None:
+                return 1200.0
+            return max(30.0, deadline - time.perf_counter())
+
         async def one_flood():
             procs = []
-            for d in range(N_DRIVERS):
-                procs.append(subprocess.Popen(
-                    [sys.executable, __file__, "_e2e_driver", str(port),
-                     str(N_PUB // N_DRIVERS), str(N_SUB // N_DRIVERS),
-                     str(PER_PUB), str(total), f"d{d}"],
-                    stdin=subprocess.PIPE,
-                    stdout=subprocess.PIPE,
-                    text=True,
-                ))
+            try:
+                for d in range(N_DRIVERS):
+                    procs.append(subprocess.Popen(
+                        [sys.executable, __file__, "_e2e_driver",
+                         str(port),
+                         str(N_PUB // N_DRIVERS), str(N_SUB // N_DRIVERS),
+                         str(PER_PUB), str(total), f"d{d}"],
+                        stdin=subprocess.PIPE,
+                        stdout=subprocess.PIPE,
+                        text=True,
+                    ))
 
-            def _wait_ready():
+                def _wait_ready():
+                    for p in procs:
+                        line = p.stdout.readline().strip()
+                        assert line == "READY", line
+
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, _wait_ready), 120
+                )
+                await asyncio.sleep(1.0)  # fabric SUB propagation
                 for p in procs:
-                    line = p.stdout.readline().strip()
-                    assert line == "READY", line
+                    p.stdin.write("GO\n")
+                    p.stdin.flush()
 
-            await asyncio.wait_for(
-                loop.run_in_executor(None, _wait_ready), 120
-            )
-            await asyncio.sleep(1.0)  # fabric SUB propagation
-            for p in procs:
-                p.stdin.write("GO\n")
-                p.stdin.flush()
+                cap = min(1300.0, left())
 
-            def _collect(p):
-                out, _ = p.communicate(timeout=1300)
-                lines = out.strip().splitlines()
-                if not lines or p.returncode != 0:
-                    raise RuntimeError(
-                        f"e2e driver rc={p.returncode} out={out[-500:]!r}"
+                def _collect(p):
+                    out, _ = p.communicate(timeout=cap)
+                    lines = out.strip().splitlines()
+                    if not lines or p.returncode != 0:
+                        raise RuntimeError(
+                            f"e2e driver rc={p.returncode} "
+                            f"out={out[-500:]!r}"
+                        )
+                    return json.loads(lines[-1])
+
+                stats = []
+                for p in procs:
+                    stats.append(
+                        await loop.run_in_executor(None, _collect, p)
                     )
-                return json.loads(lines[-1])
+                return max(st["wall"] for st in stats)
+            finally:
+                # a timed-out flood must not leave drivers flooding the
+                # broker under the NEXT point's measurement
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
 
-            stats = []
-            for p in procs:
-                stats.append(await loop.run_in_executor(None, _collect, p))
-            return max(st["wall"] for st in stats)
-
-        # floods are single-shot samples on a 1-core host whose scheduler
-        # state varies run-to-run: take the BEST of `floods` (the
-        # sustainable-capacity question, not the unlucky-run question)
-        floods = 2 if workers else 1
-        _mark(f"e2e[w={workers}]: {floods} flood(s) x {N_DRIVERS} drivers "
+        _mark(f"e2e[w={workers}]: flood x {N_DRIVERS} drivers "
               f"({total} msgs x {N_SUB} subscribers)")
-        wall = min([await one_flood() for _ in range(floods)])
+        wall = await asyncio.wait_for(one_flood(), left())
         rate = total / wall
 
         # paced socket-to-socket latency (incl. ingest window + fabric
@@ -1232,20 +1255,43 @@ def _e2e_point(workers: int) -> dict:
     return asyncio.run(run())
 
 
-def bench_e2e() -> dict:
-    """End-to-end SERVING throughput (r2 verdict 1b / r3 verdict 2):
-    concurrent socket publishers -> MQTT codec -> (worker fabric ->)
-    ingest batch window -> device route_step -> session delivery,
-    measured at the subscriber sockets, with multi-process load drivers
-    and a worker-count scaling curve. Reference regime:
-    emqx_broker.erl:204-215 end-to-end, process-per-connection host."""
-    points = []
+def bench_e2e(deadline: Optional[float] = None) -> dict:
+    """End-to-end SERVING throughput — the HEADLINE metric (ROADMAP
+    item 1): concurrent socket publishers -> MQTT codec -> (worker
+    fabric ->) ingest batch window -> device route_step -> session
+    delivery, measured at the subscriber sockets, with multi-process
+    load drivers and a worker-count scaling curve. Reference regime:
+    emqx_broker.erl:204-215 end-to-end, process-per-connection host.
+
+    Reliability contract (r3/r4 lesson — this config skipped or timed
+    out and the trajectory lost its headline point): every long wait is
+    bounded by `deadline`, a failed/skipped point degrades to a partial
+    result carrying `"timeout": true`, and the batch-bucket programs
+    precompile through the real serving entry before the timed window.
+    """
+    points, incomplete = [], []
     for w in E2E_WORKER_COUNTS:
-        points.append(_e2e_point(w))
-        _mark(f"e2e point done: {points[-1]}")
+        if deadline is not None and time.perf_counter() > deadline - 90:
+            incomplete.append({"workers": w, "skipped": "budget"})
+            _mark(f"e2e[w={w}]: SKIPPED (budget)")
+            continue
+        try:
+            points.append(_e2e_point(w, deadline))
+            _mark(f"e2e point done: {points[-1]}")
+        except Exception as e:  # noqa: BLE001 — partial > nothing
+            incomplete.append({"workers": w, "error": repr(e)})
+            _mark(f"e2e[w={w}]: FAILED ({e!r}); continuing")
+    if not points:
+        return {
+            "timeout": True,
+            "e2e_msgs_per_s": None,
+            "incomplete_points": incomplete,
+        }
     best = max(points, key=lambda p: p["e2e_msgs_per_s"])
-    base = points[0]["e2e_msgs_per_s"]
-    return {
+    base = next(
+        (p for p in points if p["workers"] == 0), points[0]
+    )["e2e_msgs_per_s"]
+    res = {
         "publishers": N_PUB,
         "subscribers": N_SUB,
         "messages": N_PUB * PER_PUB,
@@ -1255,6 +1301,7 @@ def bench_e2e() -> dict:
         "e2e_paced_p50_ms": best["e2e_paced_p50_ms"],
         "e2e_paced_p99_ms": best["e2e_paced_p99_ms"],
         "best_workers": best["workers"],
+        "vs_r01_e2e": round(best["e2e_msgs_per_s"] / R01_E2E_RPS, 2),
         "scaling_curve": points,
         "vs_single_process": round(
             best["e2e_msgs_per_s"] / base, 2
@@ -1267,6 +1314,32 @@ def bench_e2e() -> dict:
             "window and the fabric hop"
         ),
     }
+    if incomplete:
+        res["timeout"] = True
+        res["incomplete_points"] = incomplete
+    return res
+
+
+def bench_serving_suite(deadline: Optional[float] = None) -> dict:
+    """e2e_serving + serving_dispatch in ONE process, across every
+    internal config (worker counts, dense vs compact readback, table
+    shapes) with no per-process restart between them. This is the
+    process-survival gate for the serving pipeline: bounded jit caches
+    (router.jit_cache_max), explicit device-buffer frees on table
+    growth (DeviceDeltaSync free_retired), and the bounded dispatch
+    executor must hold a long-lived process steady where the r02/r04
+    sweeps needed a fresh process per config."""
+    out = {"single_process": True}
+    try:
+        out["e2e_serving"] = bench_e2e(deadline)
+    except Exception as e:  # noqa: BLE001 — partial > nothing
+        out["e2e_serving"] = {"timeout": True, "error": repr(e)}
+    _mark(f"serving: e2e done {json.dumps(out['e2e_serving'])[:300]}")
+    try:
+        out["serving_dispatch"] = bench_serving()
+    except Exception as e:  # noqa: BLE001
+        out["serving_dispatch"] = {"timeout": True, "error": repr(e)}
+    return out
 
 
 def bench_serving() -> dict:
@@ -1561,14 +1634,26 @@ def run_one(name: str) -> None:
             int(sys.argv[5]), int(sys.argv[6]), sys.argv[7],
         )
         return
-    rng = np.random.default_rng(42 + (CONFIGS + EXTRAS).index(name))
+    known = CONFIGS + EXTRAS + ["e2e_serving", "serving_dispatch"]
+    rng = np.random.default_rng(42 + known.index(name))
+    # child-side wall budget (set by main to the remaining sweep budget):
+    # the serving suite bounds its own waits so a degraded run emits a
+    # partial JSON instead of dying to the parent's kill
+    child_budget = os.environ.get("BENCH_CHILD_BUDGET_S")
+    deadline = (
+        time.perf_counter() + float(child_budget) - 10.0
+        if child_budget
+        else None
+    )
     if name == "retained_5m":
         res = bench_retained(rng)
     elif name == "retained_spot":
         res = bench_retained_spot()
-    elif name == "e2e_serving":
-        res = bench_e2e()
-    elif name == "serving_dispatch":
+    elif name == "serving":
+        res = bench_serving_suite(deadline)
+    elif name == "e2e_serving":  # standalone debug entry
+        res = bench_e2e(deadline)
+    elif name == "serving_dispatch":  # standalone debug entry
         res = bench_serving()
     else:
         res = bench_config(
@@ -1613,8 +1698,14 @@ def main() -> None:
                 # kill at the remaining budget (+ a little grace), not a
                 # blanket floor: a late config must not overrun the gate
                 # (a too-small remainder kills the child -> ONE skipped
-                # config, by design)
+                # config, by design). The child also gets the remaining
+                # budget so deadline-aware configs (the serving suite)
+                # can emit a partial JSON BEFORE the kill would land.
                 timeout=max(10, left - 5),
+                env=dict(
+                    os.environ,
+                    BENCH_CHILD_BUDGET_S=str(max(10, left - 15)),
+                ),
             )
         except subprocess.TimeoutExpired as e:
             sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
@@ -1633,41 +1724,49 @@ def main() -> None:
                 f"(tail: {proc.stdout[-300:]!r})"
             )
             continue
-        results[name] = json.loads(proc.stdout.strip().splitlines()[-1])
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        if name == "serving":
+            # the one-process suite carries both configs; surface them
+            # under their own keys so downstream reads stay stable
+            for sub in ("e2e_serving", "serving_dispatch"):
+                if isinstance(res.get(sub), dict):
+                    results[sub] = res[sub]
+        else:
+            results[name] = res
         # partial capture: a later timeout must not erase this result
-        _mark(f"BENCH_PARTIAL {name} " + json.dumps(results[name]))
+        _mark(f"BENCH_PARTIAL {name} " + json.dumps(res))
 
-    # 10M subs across 66 shapes, NFA live; if the headline config itself
-    # was skipped/timed out, fall back to share_10m so a partial sweep
-    # still emits a parsed capture (never raise after data was gathered)
-    head = results.get("mixed_10m") or results.get("share_10m") or {
+    # HEADLINE = end-to-end serving throughput (ROADMAP item 1 / PR 6):
+    # the number that closes the socket->silicon gap, reported against
+    # BENCH_r01's ~30.5k msg/s on the same harness lineage. Kernel match
+    # throughput (the old headline) stays in detail. If e2e itself was
+    # skipped/timed out, value is null but the capture still parses.
+    e2e = results.get("e2e_serving") or {}
+    e2e_rate = e2e.get("e2e_msgs_per_s")
+    kern = results.get("mixed_10m") or results.get("share_10m") or {
         "tpu_rps": None, "speedup": None
     }
     print(
         json.dumps(
             {
-                "metric": "wildcard_route_match_throughput_10m_subs_diverse",
-                "value": head["tpu_rps"],
-                "unit": "topics/s",
-                "vs_baseline": head["speedup"],
+                "metric": "e2e_serving_msgs_per_s",
+                "value": e2e_rate,
+                "unit": "msgs/s",
+                "vs_baseline": round(e2e_rate / R01_E2E_RPS, 2)
+                if e2e_rate
+                else None,
                 "detail": {
-                    "baseline": "cpu_trie_python_in_process"
-                    " (1/10-subsampled store at 10M scale: per-lookup walk"
-                    " cost is dict-bound and ~size-independent, which"
-                    " favors the CPU side)",
+                    "baseline": (
+                        "BENCH_r01 tunneled e2e (~30.5k msg/s, same "
+                        "socket->ingest->device->deliver harness "
+                        "lineage); target >= 10x"
+                    ),
                     "device": str(jax.devices()[0]),
                     "batch": BATCH,
-                    "share_10m_tpu_rps": results.get(
-                        "share_10m", {}
-                    ).get("tpu_rps"),
-                    "update_sync_ms_10m": head.get("update_sync_ms"),
-                    "subscribe_visibility_ms_10m": head.get(
-                        "subscribe_visibility_ms"
-                    ),
-                    "insert_rps_10m": head.get("insert_rps"),
-                    "e2e_msgs_per_s": results.get("e2e_serving", {}).get(
-                        "e2e_msgs_per_s"
-                    ),
+                    "e2e_timeout": e2e.get("timeout", False),
+                    "e2e_best_workers": e2e.get("best_workers"),
+                    "e2e_paced_p50_ms": e2e.get("e2e_paced_p50_ms"),
+                    "e2e_paced_p99_ms": e2e.get("e2e_paced_p99_ms"),
                     "serving_rps": results.get(
                         "serving_dispatch", {}
                     ).get("serving_rps"),
@@ -1677,26 +1776,37 @@ def main() -> None:
                     "readback_reduction_x": results.get(
                         "serving_dispatch", {}
                     ).get("readback_reduction_x"),
+                    "kernel_tpu_rps_10m": kern["tpu_rps"],
+                    "kernel_speedup_vs_cpu_trie": kern["speedup"],
+                    "share_10m_tpu_rps": results.get(
+                        "share_10m", {}
+                    ).get("tpu_rps"),
+                    "update_sync_ms_10m": kern.get("update_sync_ms"),
+                    "subscribe_visibility_ms_10m": kern.get(
+                        "subscribe_visibility_ms"
+                    ),
+                    "insert_rps_10m": kern.get("insert_rps"),
                     "skipped_configs": skipped,
                     "wall_s": round(time.perf_counter() - _T0, 1),
                     # the note reflects the ACTUAL run (r4 shipped a
                     # hardcoded "all swept" string in a 2/8 capture)
                     "note": (
-                        f"captured {len(results)}/"
-                        f"{len(CONFIGS) + len(EXTRAS)} configs: "
+                        f"captured {len(results)} result(s): "
                         + (", ".join(results) if results else "none")
                         + (
                             f"; SKIPPED: {', '.join(skipped)}"
                             if skipped
                             else "; full sweep, zero skips"
                         )
-                        + ". headline = median of 3 timing loops on the "
-                        "shape-DIVERSE 10M config (66 wildcard shapes, "
-                        "residual NFA engaged), one fresh process per "
-                        "config (tunnel degrades after readback bursts). "
-                        "per-batch p50/p99 include dev-tunnel dispatch "
-                        "overhead; e2e_serving latencies are "
-                        "socket-to-socket incl. the ingest window."
+                        + ". headline = e2e serving msgs/s (socket-to-"
+                        "socket incl. the ingest window), best worker-"
+                        "count point; e2e_serving + serving_dispatch "
+                        "ran in ONE process across all their configs "
+                        "(bounded jit cache + explicit buffer frees + "
+                        "O(dirty) prepare keep a long-lived process "
+                        "steady). kernel numbers (per-batch p50/p99 "
+                        "include dev-tunnel dispatch overhead) remain "
+                        "in detail/configs."
                     ),
                     "configs": results,
                 },

@@ -186,6 +186,8 @@ class BrokerApp:
                 max_bytes=c.router.max_bytes,
                 fanout_compact=c.router.fanout_compact,
                 fanout_slots=c.router.fanout_slots,
+                donate_buffers=c.router.donate_buffers,
+                jit_cache_max=c.router.jit_cache_max,
             ),
             min_tpu_batch=c.router.min_tpu_batch,
             enable_tpu=c.router.enable_tpu,
@@ -602,6 +604,26 @@ class BrokerApp:
                 pipeline=c.router.ingest_pipeline,
             )
             self.broker.ingest.start()
+            if (
+                c.retainer.enable
+                and c.retainer.storm_ride
+                and self.broker.mesh is None
+            ):
+                # wildcard-subscribe replay storms ride the serving
+                # pipeline's fused launch (broker/retained_feed.py);
+                # the device retained index attaches lazily on first
+                # eligible insert, so wire the feed through a factory
+                from emqx_tpu.broker.retained_feed import RetainedStormFeed
+
+                self.retainer.ensure_device()
+                if self.retainer._device is not None:
+                    feed = RetainedStormFeed(
+                        self.retainer._device,
+                        metrics=self.broker.metrics,
+                        window_s=c.retainer.storm_window_us / 1e6,
+                    )
+                    self.retainer.storm_feed = feed
+                    self.broker.retained_feed = feed
         # restore durable state BEFORE listeners accept clients
         if self.session_persistence is not None:
             restored = self.session_persistence.restore()
@@ -860,6 +882,11 @@ class BrokerApp:
         if self.broker.ingest is not None:
             await self.broker.ingest.stop()
             self.broker.ingest = None
+        if self.broker.retained_feed is not None:
+            # unhook the storm feed: replays after stop fall back to the
+            # synchronous CPU/device match path
+            self.retainer.storm_feed = None
+            self.broker.retained_feed = None
         for t in self._tasks:
             t.cancel()
         if self._tasks:

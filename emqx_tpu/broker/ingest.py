@@ -65,6 +65,10 @@ class BatchIngest:
         self._event = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._seq = 0
+        # perf_counter stamp of the moment the LAST in-flight dispatch's
+        # device work completed (None = device busy or never launched);
+        # the gap until the next launch is the ingest.device.idle series
+        self._device_done_t: Optional[float] = None
         self.running = False
 
     def start(self) -> None:
@@ -170,6 +174,17 @@ class BatchIngest:
         # a window would tax latency for zero batching gain
         return max(2, self.broker.router.min_tpu_batch)
 
+    def _device_idle(self) -> bool:
+        """Every in-flight dispatch's DEVICE work is done (their host
+        fan-out may still be queued behind the FIFO settle)."""
+        return all(pd.ready.done() for _, _, pd, _ in self._inflight)
+
+    def _note_device_done(self, _fut=None) -> None:
+        # done-callback on each launch's `ready`: stamp the moment the
+        # pipeline's device side drained (idle-gap accounting)
+        if self._device_idle():
+            self._device_done_t = time.perf_counter()
+
     async def _run(self) -> None:
         while True:
             if not self._inflight and not self._pending:
@@ -189,17 +204,35 @@ class BatchIngest:
                 self.metrics.observe(
                     "ingest.window.wait.seconds", time.perf_counter() - t0
                 )
-            # while a dispatch is in flight, only launch another for a
-            # FULL batch: eagerly draining small batches would multiply
-            # device round-trips and shrink per-dispatch amortization
-            # (measured: e2e throughput collapsed ~3x when the pipeline
-            # launched every pending dribble); a partial batch keeps
-            # accumulating until the oldest dispatch settles
+            # Launch rules. While a dispatch's DEVICE work is in flight,
+            # only a FULL batch may launch: eagerly draining small batches
+            # would multiply device round-trips and shrink per-dispatch
+            # amortization (measured: e2e throughput collapsed ~3x when
+            # the pipeline launched every pending dribble). But the
+            # moment every in-flight dispatch's device work is DONE, a
+            # PARTIAL batch launches too — batch N's host fan-out hasn't
+            # run yet (FIFO settle below), so the partial overlaps it
+            # with device compute instead of leaving the chip dark under
+            # mid-load (the old full-batch/settle-boundary-only rule).
             batch: List = []
-            if not self._inflight or len(self._pending) >= self.max_batch:
+            if (
+                not self._inflight
+                or len(self._pending) >= self.max_batch
+                or (
+                    self._pending
+                    and len(self._inflight) < self.pipeline
+                    and self._device_idle()
+                )
+            ):
                 batch = self._pending[: self.max_batch]
                 del self._pending[: self.max_batch]
             if batch:
+                if self._device_done_t is not None:
+                    self.metrics.observe(
+                        "ingest.device.idle.seconds",
+                        time.perf_counter() - self._device_done_t,
+                    )
+                    self._device_done_t = None
                 # LAUNCH now (prepare + executor submit), settle later:
                 # a full next batch's launch overlaps this one's
                 # round-trip. Fan-out happens ONLY at settle
@@ -227,6 +260,8 @@ class BatchIngest:
                         rec.finish(bsp, {"error": str(e)}, status="error")
                 else:
                     self._inflight.append((seq, batch, pd, bsp))
+                    self._device_done_t = None
+                    pd.ready.add_done_callback(self._note_device_done)
                     self.metrics.gauge_set(
                         "ingest.pipeline.depth", len(self._inflight)
                     )
@@ -253,7 +288,24 @@ class BatchIngest:
                     )
                 finally:
                     if not ev.done():
+                        # retrieve the cancellation or the loop logs
+                        # "Task was destroyed but it is pending" for
+                        # every launch-in-flight/new-enqueue race.
+                        # gather(return_exceptions) swallows EV's
+                        # CancelledError but still re-raises OUR OWN
+                        # task's cancellation (stop() must not hang)
                         ev.cancel()
+                        await asyncio.gather(ev, return_exceptions=True)
                 if oldest_ready.done():
+                    if (
+                        self._pending
+                        and len(self._inflight) < self.pipeline
+                        and self._device_idle()
+                    ):
+                        # device idle + launchable backlog: loop back so
+                        # the partial LAUNCHES before this settle's host
+                        # fan-out runs (the launch rule above fires on
+                        # exactly this condition)
+                        continue
                     seq, b, pd, bsp = self._inflight.popleft()
                     await self._finish(seq, b, pd.complete(), bsp)

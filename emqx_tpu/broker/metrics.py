@@ -342,6 +342,10 @@ declare("ingest.settle.seconds", HISTOGRAM,
         buckets=LATENCY_BUCKETS, unit="seconds")
 declare("ingest.pipeline.depth", GAUGE,
         "device dispatches in flight after the last launch")
+declare("ingest.device.idle.seconds", HISTOGRAM,
+        "gap between the pipeline's device side draining and the next "
+        "launch (the wall the idle partial-batch launch rule closes)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
 declare("ingest.launch.errors", COUNTER,
         "batch launches that raised before reaching the device")
 declare("ingest.dispatch.errors", COUNTER,
@@ -374,6 +378,22 @@ declare("router.device.seconds", HISTOGRAM,
 declare("router.sync.seconds", HISTOGRAM,
         "serving-path table snapshot + delta upload time",
         buckets=LATENCY_BUCKETS, unit="seconds")
+declare("router.sync.skipped", COUNTER,
+        "prepares that skipped pack/delta-sync entirely (every source "
+        "table's generation counter unchanged — the steady state)")
+declare("router.prepare.dirty", COUNTER,
+        "prepares that re-snapshotted at least one table (churn since "
+        "the last batch)")
+
+# retained-replay storm feed (broker/retained_feed.py)
+declare("retained.storm.filters", COUNTER,
+        "wildcard replay filters batched through the storm feed")
+declare("retained.storm.fused", COUNTER,
+        "storm jobs fused into a serving launch "
+        "(fused_route_retained_step: zero extra launches)")
+declare("retained.storm.flushed", COUNTER,
+        "storm jobs answered by a standalone match_many flush (no "
+        "publish launch arrived inside the window)")
 
 declare("dispatch.fanout", HISTOGRAM,
         "deliveries per dispatched message", buckets=FANOUT_BUCKETS)

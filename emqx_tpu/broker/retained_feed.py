@@ -1,0 +1,195 @@
+"""Retained-replay storm feed: wildcard SUBSCRIBEs ride the serving launch.
+
+A wildcard SUBSCRIBE against a big retained store used to pay its own
+launch+readback train (one `_retained_step` launch per stored chunk,
+models/retained_index.py) — per subscriber, on the hook path. This feed
+turns a subscribe storm into ONE device pass that rides the publish
+pipeline:
+
+- concurrent replay requests aggregate here (the subscribe-side analog
+  of `BatchIngest`'s publish window);
+- when the broker launches a device batch (`Broker.adispatch_begin`),
+  it calls `take_job()` and the pending filters fuse into that launch
+  (`fused_route_retained_step`): zero extra launches, zero extra
+  readbacks for single-chunk stores;
+- when no publish launch shows up inside the window (quiet broker, pure
+  subscribe storm), the flush timer answers every pending filter with
+  one standalone `match_many` pass on the dispatch executor — still one
+  launch train for the WHOLE storm instead of one per subscriber.
+
+Waiters receive the matched retained TOPICS (already row-resolved); the
+Retainer re-fetches each message from its authoritative store, so a
+stale row (topic deleted while the storm was in flight) costs a lookup,
+never a wrong replay.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from emqx_tpu.utils.tracepoints import tp
+
+log = logging.getLogger("emqx_tpu.retained_feed")
+
+
+class RetainedStormFeed:
+    def __init__(self, retained_index, metrics=None, window_s: float = 0.002):
+        self.index = retained_index
+        self.metrics = metrics
+        self.window_s = window_s
+        # filter -> [futures]; multiple subscribers to the same filter
+        # share one lane in the storm's shape table
+        self._pending: Dict[str, List[asyncio.Future]] = {}
+        self._waiters: Dict[int, Dict] = {}  # id(job) -> waiters
+        self._timer = None
+        self._flushing = False  # a standalone match_many pass in flight
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    # -- subscribe side ----------------------------------------------------
+    def submit(self, filter_: str) -> asyncio.Future:
+        """Queue one replay; resolves with the matched retained topic
+        list (or an exception — callers fall back to the CPU walk)."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.setdefault(filter_, []).append(fut)
+        if self.metrics is not None:
+            self.metrics.inc("retained.storm.filters")
+        if self._timer is None:
+            self._timer = loop.call_later(self.window_s, self._on_window)
+        return fut
+
+    # -- serving-pipeline side --------------------------------------------
+    def take_job(self):
+        """Called by the broker on the loop thread right before a device
+        launch: pops every pending filter into a prepared StormJob the
+        launch fuses in, or returns None (nothing pending / index not
+        fusable / a standalone flush already owns the pending set)."""
+        if not self._pending or self._flushing:
+            return None
+        filters = list(self._pending)
+        job = None
+        try:
+            job = self.index.prepare_storm(filters)
+        except Exception:  # noqa: BLE001 — never poison the launch
+            log.exception("storm prepare failed; falling back to CPU")
+        if job is None:
+            # not fusable (empty index / over-budget filter): answer the
+            # waiters with a CPU-fallback signal now
+            waiters, self._pending = self._pending, {}
+            self._cancel_timer()
+            for futs in waiters.values():
+                for f in futs:
+                    if not f.done():
+                        f.set_result(None)
+            return None
+        waiters, self._pending = self._pending, {}
+        self._cancel_timer()
+        self._waiters[id(job)] = waiters
+        if self.metrics is not None:
+            self.metrics.inc("retained.storm.fused")
+        tp("retained.storm.fused", filters=len(filters))
+        return job
+
+    def attach(self, job, fut) -> None:
+        """Fail the storm's waiters if the fused launch itself dies —
+        `resolve` only runs when the batch settles successfully."""
+
+        def _done(f):
+            exc = f.exception() if not f.cancelled() else None
+            if exc is not None or f.cancelled():
+                self.fail(job, exc)
+
+        fut.add_done_callback(_done)
+
+    def resolve(self, job, matched: Optional[Dict]) -> None:
+        """Hand decoded {filter: row-index array} to the waiters (loop
+        thread, at batch settle). Rows materialize to topics here — the
+        index's row table is loop-thread state."""
+        waiters = self._waiters.pop(id(job), None)
+        if waiters is None:
+            return
+        for f, futs in waiters.items():
+            rows = matched.get(f) if matched is not None else None
+            if rows is None:
+                topics = None  # CPU-fallback signal
+            else:
+                topics = [
+                    t
+                    for t in (self.index.topic_at(int(r)) for r in rows)
+                    if t is not None
+                ]
+            for fut in futs:
+                if not fut.done():
+                    fut.set_result(topics)
+
+    def fail(self, job, exc) -> None:
+        waiters = self._waiters.pop(id(job), None)
+        if waiters is None:
+            return
+        for futs in waiters.values():
+            for fut in futs:
+                if not fut.done():
+                    # None = "fall back to the CPU walk" — a failed
+                    # device launch must not fail the SUBSCRIBE replay
+                    fut.set_result(None)
+        if exc is not None:
+            log.warning("fused retained storm failed: %r", exc)
+
+    # -- standalone flush --------------------------------------------------
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _on_window(self) -> None:
+        self._timer = None
+        if self._pending and not self._flushing:
+            asyncio.ensure_future(self._flush())
+
+    async def _flush(self) -> None:
+        """No publish launch took the storm inside the window: answer it
+        with one standalone match_many pass (still ONE launch train for
+        the whole storm). `_flushing` parks take_job so the pending set
+        and the chunk uploads have exactly one owner."""
+        from emqx_tpu.broker.broker import dispatch_pool
+
+        self._flushing = True
+        try:
+            waiters, self._pending = self._pending, {}
+            filters = list(waiters)
+            if self.metrics is not None:
+                self.metrics.inc("retained.storm.flushed")
+            tp("retained.storm.flushed", filters=len(filters))
+            loop = asyncio.get_running_loop()
+            try:
+                matched = await loop.run_in_executor(
+                    dispatch_pool(), self.index.match_many, filters
+                )
+            except Exception:  # noqa: BLE001 — replay must not hang
+                log.exception("standalone storm flush failed")
+                matched = None
+            for f, futs in waiters.items():
+                if matched is None:
+                    topics = None
+                else:
+                    topics = [
+                        t
+                        for t in (
+                            self.index.topic_at(int(r))
+                            for r in matched.get(f, ())
+                        )
+                        if t is not None
+                    ]
+                for fut in futs:
+                    if not fut.done():
+                        fut.set_result(topics)
+        finally:
+            self._flushing = False
+            if self._pending and self._timer is None:
+                self._timer = asyncio.get_running_loop().call_later(
+                    self.window_s, self._on_window
+                )

@@ -54,14 +54,26 @@ class Retainer:
         self.enable_device = enable_device
         self._device = None
         self._device_unfit = 0
+        # RetainedStormFeed (broker/retained_feed.py), attached by the
+        # app when the serving pipeline runs: wildcard-subscribe replays
+        # batch into device storms that ride the publish pipeline's
+        # fused launch instead of walking/launching per subscriber
+        self.storm_feed = None
+
+    def ensure_device(self) -> None:
+        """Instantiate the device replay index eagerly (the app wires
+        the storm feed against it before any retained insert)."""
+        if self.enable_device and self._device is None:
+            from emqx_tpu.models.retained_index import DeviceRetainedIndex
+
+            self._device = DeviceRetainedIndex()
 
     def _dev_add(self, topic: str) -> None:
         if not self.enable_device:
             return
+        self.ensure_device()
         if self._device is None:
-            from emqx_tpu.models.retained_index import DeviceRetainedIndex
-
-            self._device = DeviceRetainedIndex()
+            return
         if not self._device.add(topic):
             self._device_unfit += 1
 
@@ -312,12 +324,70 @@ class Retainer:
                 return
             if opts.retain_handling == 1 and getattr(opts, "_existing", False):
                 return
-            for m in self.match(real):
-                import copy
+            if self._storm_eligible(real):
+                # device-scale wildcard replay: batch it through the
+                # storm feed (rides the serving pipeline's fused launch)
+                # instead of blocking the SUBSCRIBE hook on an O(store)
+                # device pass per subscriber. Replay lands asynchronously
+                # — the spec allows retained delivery any time after the
+                # subscription is established.
+                import asyncio
 
-                mm = copy.copy(m)
-                mm.headers = dict(m.headers, retained=True)
-                channel.handle_deliver(mm, opts)
+                asyncio.ensure_future(
+                    self._replay_batched(real, opts, channel)
+                )
+                return
+            self._deliver_retained(self.match(real), opts, channel)
 
         hooks.add("message.publish", lambda msg: on_pub(msg), priority=100)
         hooks.add("session.subscribed", on_sub)
+
+    def _storm_eligible(self, real: str) -> bool:
+        """Wildcard filter that the device replay path would serve AND a
+        storm feed is attached (serving pipeline running)."""
+        return (
+            self.storm_feed is not None
+            and T.wildcard(real)
+            and self._device is not None
+            and self._device_unfit == 0
+            and self._count >= self.device_threshold
+            and len(T.words(real)) <= self._device.max_levels
+        )
+
+    def _deliver_retained(self, msgs, opts, channel) -> None:
+        import copy
+
+        for m in msgs:
+            mm = copy.copy(m)
+            mm.headers = dict(m.headers, retained=True)
+            channel.handle_deliver(mm, opts)
+
+    async def _replay_batched(self, real: str, opts, channel) -> None:
+        """One batched replay: await the storm feed's answer (a fused
+        serving launch or the standalone flush), fall back to the
+        authoritative CPU walk when the device pass could not serve it.
+        Topics re-fetch from the live store, so a concurrent delete
+        costs a lookup, never a stale replay."""
+        try:
+            topics = await self.storm_feed.submit(real)
+        except Exception:  # noqa: BLE001 — replay must not kill the task
+            topics = None
+        now = time.time()
+        if topics is None:
+            msgs = self.match(real, now)
+        else:
+            msgs = []
+            for t in topics:
+                m = self.get(t)
+                if m is not None and not m.is_expired(now):
+                    msgs.append(m)
+        try:
+            self._deliver_retained(msgs, opts, channel)
+        except Exception:  # noqa: BLE001 — detached task: a subscriber
+            # gone mid-replay must not surface as an unretrieved error
+            import logging
+
+            logging.getLogger("emqx_tpu.retainer").debug(
+                "retained replay delivery failed (subscriber gone?)",
+                exc_info=True,
+            )

@@ -100,6 +100,14 @@ class RouterConfig:
     # device dispatches in flight at once (batch N+1's upload/launch
     # overlaps batch N's readback); settlement stays FIFO for ordering
     ingest_pipeline: int = 2
+    # donate per-batch input buffers (token bytes/lengths) to the
+    # serving jit: steady-state batches reuse them for outputs instead
+    # of allocating fresh device buffers every launch
+    donate_buffers: bool = True
+    # bound on cached compiled programs per serving jit entry (table
+    # growth compiles fresh programs; a long-lived process must not
+    # accumulate every shape it ever served). 0 = unbounded.
+    jit_cache_max: int = 64
     # SPMD serving over a device mesh: [dp, tp] axis sizes. [0, 0] (the
     # default) = single-device serving; set e.g. [4, 2] on an 8-chip
     # host to run dist_shape_route_step on the live dispatch path.
@@ -115,6 +123,11 @@ class RetainerConfig:
     # device replay index for wildcard storms over big stores; engages at
     # device_threshold topics when the TPU path is enabled
     device_threshold: int = 10_000
+    # batch wildcard-subscribe replays through the serving pipeline:
+    # pending storms fuse into the next publish launch
+    # (fused_route_retained_step) or flush standalone after storm_window
+    storm_ride: bool = True
+    storm_window_us: int = 2000
 
 
 @dataclass
@@ -614,6 +627,12 @@ def _validate(cfg: AppConfig) -> None:
         raise ConfigError(
             "router.fanout_slots must be >= 0 (0 = auto-size)"
         )
+    if cfg.router.jit_cache_max < 0:
+        raise ConfigError(
+            "router.jit_cache_max must be >= 0 (0 = unbounded)"
+        )
+    if cfg.retainer.storm_window_us < 0:
+        raise ConfigError("retainer.storm_window_us must be >= 0")
     from emqx_tpu.broker.limiter import TYPES as _LIMITER_TYPES
 
     for lt in cfg.limiter:

@@ -19,11 +19,37 @@ hash collisions can only cost a false candidate, never a wrong replay.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
 from emqx_tpu.ops import topics as T
+
+
+class StormJob(NamedTuple):
+    """A prepared replay storm, ready to ride a serving-path launch.
+
+    Built on the event-loop thread (`DeviceRetainedIndex.prepare_storm`)
+    so the table build and chunk uploads never race host mutation; the
+    tuple is immutable device state safe to hand to an executor thread
+    (the same contract as `DeviceRouter.prepare`). `decode` turns the
+    per-chunk match matrices (host numpy) back into {filter: row-index
+    array} — device-free, so it runs wherever the readback landed.
+    """
+
+    index: "DeviceRetainedIndex"
+    filters: List[str]
+    fids: Dict[int, str]
+    shape_tables: Dict
+    nfa_tables: Optional[Dict]
+    kwargs: Dict
+    chunks: List[object]  # device chunk buffers (uploaded)
+    nrows: int  # live-row high-water at prepare time
+
+    def decode(self, matched_list) -> Dict[str, np.ndarray]:
+        return self.index._decode_storm(
+            self.fids, self.filters, matched_list, self.nrows
+        )
 
 
 def _retained_step(
@@ -97,6 +123,11 @@ class DeviceRetainedIndex:
         # host chunks; device mirrors uploaded lazily per query
         self._host_b: List[np.ndarray] = []  # [CHUNK, bucket] uint8
         self._dev: List[Optional[object]] = []  # device bytes or None=dirty
+        # mutation generation: chunk uploads capture it before the
+        # device_put and only cache the buffer if no mutation landed
+        # mid-upload (uploads may run on executor threads while the loop
+        # thread inserts — a torn upload must never be marked clean)
+        self._mut_ver = 0
 
     def _grow_bucket(self, need: int) -> None:
         from emqx_tpu.ops.nfa import _next_pow2
@@ -109,6 +140,7 @@ class DeviceRetainedIndex:
             new[:, : self.bucket] = self._host_b[c]
             self._host_b[c] = new
             self._dev[c] = None
+        self._mut_ver += 1
         self.bucket = nb
 
     def __len__(self) -> int:
@@ -141,6 +173,7 @@ class DeviceRetainedIndex:
         c, i = divmod(row, CHUNK)
         self._host_b[c][i, : len(enc)] = np.frombuffer(enc, np.uint8)
         self._host_b[c][i, len(enc):] = 0
+        self._mut_ver += 1
         self._dev[c] = None  # dirty
         return True
 
@@ -172,6 +205,7 @@ class DeviceRetainedIndex:
             if too_long.any():
                 raise ValueError("bulk_add: topic exceeds max_bytes")
             self._host_b[c][i0 : i0 + take] = mat
+            self._mut_ver += 1
             self._dev[c] = None
             for k, t in enumerate(batch):
                 self._rows[t] = row0 + k
@@ -188,6 +222,7 @@ class DeviceRetainedIndex:
         self._tombstones += 1
         c, i = divmod(row, CHUNK)
         self._host_b[c][i, :] = 0  # len derives 0 -> zero words
+        self._mut_ver += 1
         self._dev[c] = None
 
     # -- query ------------------------------------------------------------
@@ -226,20 +261,64 @@ class DeviceRetainedIndex:
         )
         return idx, fids, shape_tables, nfa_tables, kwargs
 
+    def _ensure_chunks(self) -> list:
+        """Upload dirty chunks; returns the device buffer list. Safe off
+        the mutating thread: the buffer is cached as clean only when no
+        mutation landed during the upload (`_mut_ver` check) — a torn
+        upload is still used for THIS storm (it saw a superset of the
+        pre-mutation rows; decode re-verifies against live state) but
+        never marked clean."""
+        import jax
+
+        out = []
+        for c in range(len(self._host_b)):
+            d = self._dev[c]
+            if d is None:
+                v0 = self._mut_ver
+                d = jax.device_put(self._host_b[c])
+                if self._mut_ver == v0:
+                    self._dev[c] = d
+            out.append(d)
+        return out
+
     def _launch_all(self, shape_tables, nfa_tables, kwargs) -> list:
         """Dispatch one storm launch per chunk (lengths derived
         on-device; no lengths operand), all before any readback."""
-        import jax
-
         step = _get_retained_step()
-        outs = []
-        for c in range(len(self._host_b)):
-            if self._dev[c] is None:
-                self._dev[c] = jax.device_put(self._host_b[c])
-            outs.append(
-                step(shape_tables, nfa_tables, self._dev[c], **kwargs)
-            )
-        return outs
+        return [
+            step(shape_tables, nfa_tables, d, **kwargs)
+            for d in self._ensure_chunks()
+        ]
+
+    def prepare_storm(self, filters: List[str]) -> Optional[StormJob]:
+        """Build one replay storm's filter tables + chunk buffers so the
+        serving pipeline can fuse the match into its next route launch
+        (`DeviceRouter.route_prepared(..., retained=job)`): the storm
+        then costs ZERO extra launches and zero extra readbacks for
+        single-chunk stores, instead of its own launch+readback train.
+
+        Returns None when the index is empty or any filter exceeds the
+        device budget (callers fall back to the authoritative CPU walk).
+        Must run on the thread that mutates the index (the event loop) —
+        the same contract as `DeviceRouter.prepare`.
+        """
+        if not self._host_b:
+            return None
+        if any(len(T.words(f)) > self.max_levels for f in filters):
+            return None
+        _idx, fids, shape_tables, nfa_tables, kwargs = self._build_tables(
+            filters, floor=1
+        )
+        return StormJob(
+            index=self,
+            filters=list(filters),
+            fids=fids,
+            shape_tables=shape_tables,
+            nfa_tables=nfa_tables,
+            kwargs=kwargs,
+            chunks=self._ensure_chunks(),
+            nrows=len(self._by_row),
+        )
 
     def match(self, filter_: str) -> Optional[List[str]]:  # readback-site
         """Retained topics matching `filter_`, or None when the filter
@@ -306,10 +385,20 @@ class DeviceRetainedIndex:
         # all chunks dispatched before any readback (launches pipeline);
         # read back per chunk — moderate transfer sizes behave far better
         # on the dev tunnel than one giant buffer
-        lanes = int(outs[0].shape[1])
-        flat = np.concatenate([np.asarray(m).ravel() for m in outs])
+        matched_list = [np.asarray(m) for m in outs]
         del outs
-        nrows = len(self._by_row)
+        return self._decode_storm(
+            fids, filters, matched_list, len(self._by_row)
+        )
+
+    def _decode_storm(
+        self, fids, filters: List[str], matched_list, nrows: int
+    ) -> Dict[str, np.ndarray]:
+        """Host-side storm decode: per-chunk match matrices (numpy) ->
+        {filter: row-index array}. Device-free, so the fused serving path
+        (`StormJob.decode`) runs it on whatever thread did the readback."""
+        lanes = int(matched_list[0].shape[1])
+        flat = np.concatenate([np.asarray(m).ravel() for m in matched_list])
         # flat index = (row_g * lanes + lane); group hit rows by fid with
         # one stable argsort instead of per-chunk unique passes. Dtypes
         # stay narrow: the sort is the host-side hot spot at 5M+ pairs.
@@ -327,9 +416,10 @@ class DeviceRetainedIndex:
                 hits, rows_g = hits[keep], rows_g[keep]
         if self._tombstones:
             # tombstoned rows (removed topics) can still match plen-0
-            # filters like '#' via their zeroed length
+            # filters like '#' via their zeroed length. Slice to nrows:
+            # on the fused path the store may have grown since prepare.
             live = np.zeros(nrows, dtype=bool)
-            for r, t in enumerate(self._by_row):
+            for r, t in enumerate(self._by_row[:nrows]):
                 live[r] = t is not None
             keep = live[rows_g]
             hits, rows_g = hits[keep], rows_g[keep]

@@ -59,6 +59,15 @@ class MatcherConfig:
     # per-row compact-slot cap: 0 = auto-size from the dispatch.fanout
     # histogram p99 (grow-only, pow2-padded); > 0 pins it (pow2-padded)
     fanout_slots: int = 0
+    # donate the per-batch input buffers (token bytes, lengths) to the
+    # serving-path jit so steady-state batches reuse them for outputs
+    # instead of allocating fresh device buffers every launch
+    donate_buffers: bool = True
+    # bound on cached compiled programs per serving-path jit entry: table
+    # growth / config transitions each compile a fresh program, and a
+    # long-lived process must not accumulate every shape it ever served.
+    # 0 disables trimming.
+    jit_cache_max: int = 64
 
 
 def _probe_edges(tables, node, sym, probes: int):

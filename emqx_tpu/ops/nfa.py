@@ -172,20 +172,43 @@ class DeviceDeltaSync:
     device half of the delta-overlay design (module docstring).
     """
 
-    def __init__(self, placement=None) -> None:
+    def __init__(self, placement=None, free_retired: bool = False) -> None:
         """`placement`: optional fn(name, np_array) -> device array used
         for the initial/full uploads — e.g. a NamedSharding device_put
         for SPMD serving. Delta scatters run under jit, so the placed
-        sharding propagates and churn stays O(delta) on a mesh too."""
+        sharding propagates and churn stays O(delta) on a mesh too.
+
+        `free_retired`: explicitly `.delete()` the device buffers a full
+        re-upload replaces, with ONE epoch of grace (the generation
+        retired by rebuild N is freed at rebuild N+1). Long-lived serving
+        processes grow their tables many times; without explicit frees
+        the old device mirrors linger until Python GC, and on tunneled
+        backends the accumulated garbage is what flips the link into its
+        degraded mode. The grace generation covers in-flight executor
+        batches still holding the previous snapshot (pipeline depth is
+        small and FIFO-settled, so nothing older than one generation can
+        be live by the next rebuild)."""
         self._arrays: Optional[Dict] = None
         self._epoch = -1
         self._pos = 0
         self._placement = placement
+        self._free_retired = free_retired
+        self._retired: Optional[list] = None
 
     def sync(self, src) -> Dict:
         import jax.numpy as jnp
 
         if self._arrays is None or self._epoch != src.epoch:
+            if self._free_retired:
+                old = self._retired
+                self._retired = (
+                    list(self._arrays.values()) if self._arrays else None
+                )
+                for arr in old or ():
+                    try:
+                        arr.delete()
+                    except Exception:  # noqa: BLE001 — free is advisory
+                        pass
             put = self._placement or (lambda _k, v: jnp.asarray(v))
             self._arrays = {
                 k: put(k, v.copy())

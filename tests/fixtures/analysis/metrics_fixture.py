@@ -12,6 +12,9 @@ declare("messages.dropped", COUNTER)
 declare("dispatch.readback.bytes", "histogram")
 declare("trace.spans.sampled", COUNTER)
 declare("device.compile.count", COUNTER)
+declare("router.sync.skipped", COUNTER)
+declare("ingest.device.idle.seconds", "histogram")
+declare("retained.storm.fused", COUNTER)
 
 
 class M:
@@ -31,6 +34,9 @@ def good(m: M):
     m.observe("dispatch.readback.bytes", 4096)
     m.inc("trace.spans.sampled")
     m.inc("device.compile.count", 3)
+    m.inc("router.sync.skipped")
+    m.observe("ingest.device.idle.seconds", 0.001)
+    m.inc("retained.storm.fused")
 
 
 def bad(m: M):
@@ -39,3 +45,6 @@ def bad(m: M):
     m.observe("dispatch.readback.bytez", 1)  # MN001: typo'd series
     m.inc("trace.spans.samplid")  # MN001: typo'd span series
     m.inc("device.compile.cout")  # MN001: typo'd device series
+    m.inc("router.sync.skiped")  # MN001: typo'd prepare series
+    m.observe("ingest.device.idle.secondz", 1)  # MN001: typo'd idle series
+    m.inc("retained.storm.fuzed")  # MN001: typo'd storm series

@@ -310,3 +310,153 @@ async def test_ingest_pipeline_overlaps_and_settles_fifo():
     assert settles == [0] * 4 + [1] * 4
     # overlap: batch 1 launched BEFORE batch 0's device work completed
     assert events.index(("launch", 1)) < events.index(("device_done", 0))
+
+
+class StubPipelineBroker:
+    """Scripted adispatch_begin: per-batch device delay + event log.
+
+    Batches >= `device_at` messages behave like device dispatches
+    (ready resolves after their scripted delay); smaller ones are CPU
+    batches (ready pre-resolved, dispatch deferred to complete() — the
+    PendingDispatch CPU-deferral contract in broker.adispatch_begin).
+    """
+
+    class router:
+        min_tpu_batch = 1
+        enable_tpu = True
+
+    def __init__(self, events, delays=(), device_at=4):
+        self.events = events
+        self.delays = list(delays)
+        self.device_at = device_at
+        self.n = 0
+
+    def adispatch_begin(self, msgs, forward=True, batch_span=None):
+        from emqx_tpu.broker.broker import PendingDispatch
+
+        i = self.n
+        self.n += 1
+        loop = asyncio.get_running_loop()
+        is_dev = len(msgs) >= self.device_at
+        self.events.append(("launch", i, len(msgs), is_dev))
+        ready = loop.create_future()
+        if is_dev:
+            delay = self.delays[i] if i < len(self.delays) else 0.0
+            loop.call_later(
+                delay,
+                lambda: (
+                    self.events.append(("device_done", i)),
+                    ready.done() or ready.set_result(None),
+                ),
+            )
+        else:
+            ready.set_result(None)
+
+        async def complete():
+            await ready
+            self.events.append(("fanout", i))
+            return [1] * len(msgs)
+
+        return PendingDispatch(ready, complete)
+
+
+@async_test
+async def test_cross_batch_fifo_with_mixed_cpu_and_device_batches():
+    """Satellite: per-publisher FIFO holds when a small CPU batch is
+    launched while a SLOW device batch is in flight — the CPU batch's
+    dispatch must defer to settle time (launch order), not run at
+    launch, or publisher P's message #2 would deliver before #1."""
+    events = []
+    b = StubPipelineBroker(events, delays=[0.2], device_at=4)
+    ing = BatchIngest(b, max_batch=4, window_us=0, pipeline=2)
+    ing.start()
+    futs = [ing.enqueue(Message(topic=f"p/{k}")) for k in range(4)]
+    await asyncio.sleep(0.05)  # device batch 0 (slow) is in flight
+    # publisher P's second message lands in a 1-message CPU batch that
+    # launches while batch 0's device work is still pending
+    futs.append(ing.enqueue(Message(topic="p/0")))
+    await asyncio.gather(*futs)
+    await ing.stop()
+    launches = [e[1:] for e in events if e[0] == "launch"]
+    fanouts = [e[1] for e in events if e[0] == "fanout"]
+    assert launches[0] == (0, 4, True)
+    assert launches[1][2] is False  # the small batch took the CPU path
+    # the CPU batch was ready instantly but fanned out strictly AFTER
+    # the slow device batch (FIFO settle = cross-batch ordering)
+    assert fanouts == [0, 1]
+    assert events.index(("fanout", 0)) > events.index(
+        ("launch", 1, 1, False)
+    )
+
+
+@async_test
+async def test_idle_device_launches_partial_batch():
+    """Tentpole (c): once every in-flight dispatch's DEVICE work is
+    done, a PARTIAL backlog launches immediately — before the settled
+    batch's host fan-out — instead of waiting for a full batch or the
+    settle boundary (the old rule left the device dark under mid-load).
+    """
+    events = []
+    b = StubPipelineBroker(events, delays=[0.1, 0.0], device_at=2)
+    ing = BatchIngest(b, max_batch=8, window_us=0, pipeline=2)
+    ing.start()
+    futs = [ing.enqueue(Message(topic=f"p/{k}")) for k in range(8)]
+    await asyncio.sleep(0.02)  # batch 0 (full, slow device) in flight
+    # partial backlog arrives while batch 0 is still ON DEVICE: must
+    # NOT launch yet (dribble rule) ...
+    futs += [ing.enqueue(Message(topic=f"q/{k}")) for k in range(3)]
+    await asyncio.sleep(0.02)
+    assert [e for e in events if e[0] == "launch"] == [
+        ("launch", 0, 8, True)
+    ]
+    await asyncio.gather(*futs)
+    await ing.stop()
+    # ... but the moment batch 0's device work completed, the partial
+    # launched BEFORE batch 0's host fan-out ran (overlap, not idle)
+    i_done0 = events.index(("device_done", 0))
+    i_launch1 = events.index(("launch", 1, 3, True))
+    i_fanout0 = events.index(("fanout", 0))
+    assert i_done0 < i_launch1 < i_fanout0
+    h = ing.metrics.histogram("ingest.device.idle.seconds")
+    assert h is not None and h.count >= 1
+
+
+@async_test
+async def test_launch_in_flight_enqueue_race_leaves_no_pending_waiter():
+    """Satellite regression: the flusher's cancelled `_event.wait()`
+    future must be retrieved (awaited) — before the fix every
+    launch-in-flight/new-enqueue race left a cancelled-but-unawaited
+    task that the loop reports as "Task was destroyed but it is
+    pending" under load. Drives the race repeatedly (park on the
+    (oldest_ready, event.wait) pair, then wake via BOTH arms) and
+    asserts no stray Event.wait task survives in any state — and that
+    stop() still completes promptly (the retrieval must not swallow
+    the flusher's own cancellation)."""
+    events = []
+    b = StubPipelineBroker(events, delays=[0.05] * 64, device_at=2)
+    ing = BatchIngest(b, max_batch=4, window_us=0, pipeline=2)
+    ing.start()
+    futs = []
+    for round_ in range(4):
+        # a non-full device batch goes in flight; the flusher parks in
+        # the (oldest_ready, event.wait) race...
+        futs += [ing.enqueue(Message(topic=f"r{round_}/{k}"))
+                 for k in range(3)]
+        await asyncio.sleep(0.01)
+        # ...and a NEW enqueue wakes it (the race's other arm)
+        futs.append(ing.enqueue(Message(topic=f"r{round_}/wake")))
+        await asyncio.sleep(0.08)
+    await asyncio.gather(*futs)
+    # park the flusher in the race one final time and cancel it THERE:
+    # the finally must retrieve its ev waiter without swallowing the
+    # flusher's own cancellation (stop() would hang otherwise)
+    futs2 = [ing.enqueue(Message(topic="final/a")),
+             ing.enqueue(Message(topic="final/b"))]
+    await asyncio.sleep(0.01)
+    await asyncio.wait_for(ing.stop(), 5)
+    await asyncio.gather(*futs2)
+    stray = [
+        t for t in asyncio.all_tasks()
+        if "Event.wait" in repr(t.get_coro())
+    ]
+    assert stray == []

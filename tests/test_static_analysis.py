@@ -139,6 +139,8 @@ def test_metric_checker_flags_undeclared_series():
     assert bad == {
         "messages.recieved", "sessions.active", "dispatch.readback.bytez",
         "trace.spans.samplid", "device.compile.cout",
+        "router.sync.skiped", "ingest.device.idle.secondz",
+        "retained.storm.fuzed",
     }
 
 

@@ -239,7 +239,9 @@ def _harness(name: str):
             {"B": 16, "kslot": 8},
             {"B": 8, "kslot": 32},
         ]
-    elif name in ("route_step", "shape_route_step"):
+    elif name in (
+        "route_step", "shape_route_step", "fused_route_retained_step"
+    ):
         configs = _configs_single()
     elif name in ("dist_step", "dist_shape_step"):
         configs = _configs_mesh()
@@ -293,6 +295,41 @@ def _harness(name: str):
             return fn, (
                 index.shapes.device_snapshot(), nfa, bits,
                 bytes_mat, lengths,
+            )
+        if name == "fused_route_retained_step":
+            from emqx_tpu.models.router_model import (
+                fused_route_retained_step,
+            )
+            from emqx_tpu.ops.route_index import RouteIndex
+
+            with_nfa = index.residual_count > 0
+            nfa = index.nfa.device_snapshot() if with_nfa else None
+            # retained half: a small deterministic storm-filter table +
+            # one (scaled-down) topic chunk — abstract tracing only, so
+            # the real 1M-row CHUNK is unnecessary
+            ridx = RouteIndex()
+            for f in ("site/+/a", "site/#"):
+                ridx.add(f)
+            rst = ridx.shapes.device_snapshot()
+            r_with_nfa = ridx.residual_count > 0
+            rnt = ridx.nfa.device_snapshot() if r_with_nfa else None
+            ret_bytes = np.zeros((64, 16), np.uint8)
+            fn = partial(
+                fused_route_retained_step,
+                m_active=m_active,
+                with_nfa=with_nfa,
+                salt=salt,
+                ret_m_active=ridx.shapes.m_active(floor=1),
+                ret_with_nfa=r_with_nfa,
+                ret_salt=ridx.salt,
+                ret_max_levels=8,
+                ret_narrow=True,
+                kslot=cfg["kslot"],
+                **kw,
+            )
+            return fn, (
+                index.shapes.device_snapshot(), nfa, bits,
+                bytes_mat, lengths, rst, rnt, ret_bytes,
             )
         # mesh builders
         import jax
