@@ -802,7 +802,7 @@ CONFIGS = [
     "exact_1k",
 ]
 # run only if budget remains after the required sweep
-EXTRAS = ["retained_spot"]
+EXTRAS = ["retained_spot", "chaos_soak"]
 
 # per-config minimum-remaining-budget to attempt it (measured warm-cache
 # costs + margin; the old blanket 120/170s threshold skipped the ~20s
@@ -819,6 +819,7 @@ MIN_BUDGET_S = {
     "plus_100k": 45,
     "exact_1k": 30,
     "retained_spot": 20,
+    "chaos_soak": 45,
 }
 
 
@@ -1473,6 +1474,190 @@ def bench_serving() -> dict:
     }
 
 
+def bench_chaos_soak() -> dict:
+    """`chaos_soak` config (docs/robustness.md): steady QoS1 publish
+    load through the REAL ingest -> device-route -> dispatch pipeline
+    while faults fire on a schedule — device launch failures, torn
+    delta-syncs, admission drops — asserting the degradation ladder's
+    contract as a regression gate, not a bench footnote:
+
+    - ZERO message loss for accepted QoS>=1 publishes (degraded batches
+      serve the identical recipient sets from the CPU trie; sheds are
+      backpressure the publisher SEES, never silence);
+    - bounded p99 settle latency during degradation;
+    - recovery back toward baseline RPS after the faults clear (the
+      half-open probe re-warms the device path; the ratio is recorded
+      in the BENCH json).
+    """
+    import asyncio
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.degrade import DegradeController, IngestShed
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.observe.faults import default_faults
+    from emqx_tpu.ops.matcher import MatcherConfig
+
+    N_DEV, N_MID = 50, 8  # 400 '+/#' filters, one sub each
+    N_MSGS = 4096  # per phase
+    MAX_BATCH = 512
+    OPEN_SECS = 0.3
+
+    rng = np.random.default_rng(2207)
+    ids = _zipf_ids(rng, N_MSGS, N_DEV)
+    nums = rng.integers(0, N_MID, size=N_MSGS)
+    topics = [f"device/{i}/mid/{j}/leaf" for i, j in zip(ids, nums)]
+
+    b = Broker(
+        router=Router(MatcherConfig(), min_tpu_batch=64), hooks=Hooks()
+    )
+    deg = DegradeController(
+        metrics=b.metrics,
+        max_retries=2,
+        backoff_base_s=0.002,
+        backoff_max_s=0.05,
+        open_secs=OPEN_SECS,
+    )
+    b.degrade = deg
+    default_faults.metrics = b.metrics
+    delivered = [0]
+
+    def deliver(m, o):
+        delivered[0] += 1
+
+    sid = 0
+    for i in range(N_DEV):
+        for j in range(N_MID):
+            b.subscribe(
+                f"s{sid}", f"c{sid}", f"device/{i}/+/{j}/#",
+                pkt.SubOpts(), deliver,
+            )
+            sid += 1
+
+    async def phase(ing, tag: str) -> dict:
+        lats = []
+        loss = 0
+        shed = 0
+        t0 = time.perf_counter()
+        futs = []
+        for t in topics:
+            te = time.perf_counter()
+            f = ing.enqueue(Message(topic=t, payload=b"p", qos=1))
+            f.add_done_callback(
+                lambda _f, te=te: lats.append(time.perf_counter() - te)
+            )
+            futs.append(f)
+        res = await asyncio.gather(*futs, return_exceptions=True)
+        wall = time.perf_counter() - t0
+        for r in res:
+            if isinstance(r, IngestShed):
+                shed += 1  # backpressure the publisher SAW — not loss
+            elif isinstance(r, BaseException) or r < 1:
+                loss += 1  # accepted but not delivered = real loss
+        lats.sort()
+        out = {
+            "rps": round((N_MSGS - shed) / wall, 1),
+            "p99_ms": round(lats[int(0.99 * (len(lats) - 1))] * 1e3, 2)
+            if lats
+            else None,
+            "loss": loss,
+            "shed": shed,
+        }
+        _mark(f"chaos_soak: {tag} {json.dumps(out)}")
+        return out
+
+    async def run() -> dict:
+        ing = BatchIngest(b, max_batch=MAX_BATCH, window_us=500)
+        b.ingest = ing
+        ing.start()
+        await ing.submit(  # compile outside the timed phases
+            Message(topic="device/0/mid/0/warm", payload=b"w", qos=1)
+        )
+        baseline = await phase(ing, "baseline")
+
+        # wave 1: every device launch fails -> retries -> breaker opens
+        # -> CPU-trie serving for the rest of the wave
+        default_faults.arm("device.launch", mode="raise")
+        wave_launch = await phase(ing, "fault:device.launch")
+        default_faults.disarm("device.launch")
+
+        # wave 2: torn delta-syncs (subscribe churn dirties the tables;
+        # every dirty sync is declared corrupt -> epoch rollback) plus
+        # probabilistic admission drops (sheds, visible backpressure)
+        await asyncio.sleep(OPEN_SECS + 0.1)  # let the probe recover
+        b.subscribe("churn", "cchurn", "device/0/#", pkt.SubOpts(), deliver)
+        default_faults.arm("router.delta_sync", mode="corrupt")
+        default_faults.arm(
+            "ingest.enqueue", mode="drop", probability=0.02
+        )
+        wave_sync = await phase(ing, "fault:delta_sync+shed")
+        default_faults.disarm()
+
+        # recovery: dwell out the breaker, then measure a clean wave
+        await asyncio.sleep(OPEN_SECS + 0.1)
+        recovered = await phase(ing, "recovered")
+        await ing.stop()
+        m = b.metrics
+        ratio = (
+            round(recovered["rps"] / baseline["rps"], 3)
+            if baseline["rps"]
+            else None
+        )
+        total_loss = (
+            baseline["loss"] + wave_launch["loss"] + wave_sync["loss"]
+            + recovered["loss"]
+        )
+        # the regression gate: accepted QoS1 publishes never vanish,
+        # degradation keeps p99 bounded (no wedged-pipeline stall), and
+        # the process comes back without a restart
+        assert total_loss == 0, f"lost {total_loss} accepted messages"
+        assert deg.device.state == "closed", deg.device.state
+        bound_ms = max(5000.0, 10.0 * (baseline["p99_ms"] or 0.0))
+        for wave in (wave_launch, wave_sync):
+            assert wave["p99_ms"] is not None and wave["p99_ms"] <= bound_ms, (
+                wave,
+                bound_ms,
+            )
+        assert ratio is not None and ratio >= 0.3, (
+            f"recovery rps ratio {ratio} below floor"
+        )
+        return {
+            "messages_per_phase": N_MSGS,
+            "subscriptions": sid,
+            "qos1_loss": total_loss,
+            "baseline": baseline,
+            "fault_device_launch": wave_launch,
+            "fault_delta_sync": wave_sync,
+            "recovered": recovered,
+            "recovery_rps_ratio": ratio,
+            "degrade": {
+                "trips": m.get("degrade.trips.device"),
+                "retries": m.get("degrade.retries"),
+                "fallback_batches": m.get("degrade.fallback.batches"),
+                "probe_ok": m.get("degrade.probe.ok"),
+                "sync_rollbacks": m.get("router.sync.rollback"),
+                "sheds": m.get("ingest.shed"),
+                "faults_injected": m.get("faults.injected"),
+            },
+            "note": (
+                "steady QoS1 load with scheduled faults: launch raise"
+                " wave trips the breaker into CPU-trie serving (zero"
+                " loss), corrupt delta-syncs roll back to the last good"
+                " epoch, probabilistic admission drops surface as sheds"
+                " (publisher-visible backpressure), and the half-open"
+                " probe recovers the device path; recovery_rps_ratio is"
+                " recovered/baseline in ONE process — the 'degrades"
+                " until restart' pathology is the regression this gate"
+                " exists to catch"
+            ),
+        }
+
+    return asyncio.run(run())
+
+
 def hotpath_stats() -> None:
     """`--hotpath-stats`: drive a small in-process publish workload through
     the real ingest -> device-route -> dispatch pipeline, then print ONE
@@ -1649,6 +1834,8 @@ def run_one(name: str) -> None:
         res = bench_retained(rng)
     elif name == "retained_spot":
         res = bench_retained_spot()
+    elif name == "chaos_soak":
+        res = bench_chaos_soak()
     elif name == "serving":
         res = bench_serving_suite(deadline)
     elif name == "e2e_serving":  # standalone debug entry
@@ -1676,6 +1863,12 @@ def main() -> None:
     if len(sys.argv) > 1:
         if sys.argv[1] == "--hotpath-stats":
             hotpath_stats()
+            return
+        if sys.argv[1] == "--configs":
+            # explicit subset run: `bench.py --configs chaos_soak[,..]`
+            # — one JSON line per named config, in this process's child
+            for n in sys.argv[2].split(","):
+                run_one(n.strip())
             return
         run_one(sys.argv[1])
         return

@@ -219,7 +219,26 @@ class BrokerApp:
             enable=c.olp.enable,
             lag_watermark_ms=c.olp.lag_watermark_ms,
             cooldown=c.olp.cooldown,
+            metrics=self.broker.metrics,
         )
+        # fault injection (observe/faults.py): the process-wide injector
+        # gets this broker's metrics for faults.injected accounting;
+        # config-armed rules (default off) load here, runtime arming
+        # goes through GET/POST /api/v5/faults
+        from emqx_tpu.observe.faults import default_faults
+
+        self.faults = default_faults
+        self.faults.metrics = self.broker.metrics
+        if c.faults.enable:
+            for fr in c.faults.rules:
+                self.faults.arm(
+                    fr.site,
+                    mode=fr.mode,
+                    probability=fr.probability,
+                    nth=fr.nth,
+                    max_fires=fr.max_fires,
+                    delay_ms=fr.delay_ms,
+                )
         if c.force_gc.enable:
             from emqx_tpu.transport.congestion import ForcedGC
 
@@ -433,6 +452,26 @@ class BrokerApp:
             self.broker.spans = self.spans
         else:
             self.spans = None
+        # graceful-degradation ladder (broker/degrade.py): device-path
+        # breaker + retry policy; transitions emit degrade.* series and
+        # span events so traces show WHY a message took the slow path
+        if c.degrade.enable:
+            from emqx_tpu.broker.degrade import DegradeController
+
+            self.degrade = DegradeController(
+                metrics=self.broker.metrics,
+                spans=self.spans,
+                max_retries=c.degrade.max_retries,
+                backoff_base_s=c.degrade.backoff_base_ms / 1e3,
+                backoff_max_s=c.degrade.backoff_max_ms / 1e3,
+                failure_threshold=c.degrade.failure_threshold,
+                open_secs=c.degrade.open_secs,
+                probe_successes=c.degrade.probe_successes,
+                shed_queue_batches=c.degrade.shed_queue_batches,
+            )
+            self.broker.degrade = self.degrade
+        else:
+            self.degrade = None
         # device runtime telemetry (observe/device_watch.py): compile /
         # retrace watch + HBM & transfer gauges, polled from housekeeping
         if c.router.enable_tpu:
@@ -495,6 +534,7 @@ class BrokerApp:
                 retainer=self.retainer if c.retainer.enable else None,
                 delayed=self.delayed if c.delayed.enable else None,
                 banned=self.banned,
+                degrade=self.degrade,
             )
         else:
             self.session_persistence = None
@@ -550,7 +590,14 @@ class BrokerApp:
             from emqx_tpu.cluster.tcp_transport import TcpBus
 
             self.cluster_bus = TcpBus(
-                node_name(), host=c.cluster.bind, port=c.cluster.listen_port
+                node_name(),
+                host=c.cluster.bind,
+                port=c.cluster.listen_port,
+                send_retries=c.cluster.send_retries,
+                send_backoff_s=c.cluster.send_backoff_ms / 1e3,
+                send_deadline_s=c.cluster.send_deadline_s,
+                metrics=self.broker.metrics,
+                degrade=self.degrade,
             )
             self.cluster_node = ClusterNode(
                 node_name(),
@@ -602,6 +649,7 @@ class BrokerApp:
                 max_batch=c.router.ingest_max_batch,
                 window_us=c.router.ingest_window_us,
                 pipeline=c.router.ingest_pipeline,
+                olp=self.olp,
             )
             self.broker.ingest.start()
             if (

@@ -132,6 +132,10 @@ class Broker:
         # replicate on first/last subscriber, publishes forward to remote
         # route owners (emqx_broker.erl:278-293 forward regime)
         self.cluster = None
+        # DegradeController (broker/degrade.py), attached by the app:
+        # device-path circuit breaker + bounded retry policy. None =
+        # legacy behavior (a failed launch fails its batch's publishes)
+        self.degrade = None
 
     # -- subscribe side ---------------------------------------------------
     def subscribe(
@@ -341,37 +345,32 @@ class Broker:
         """
         r = self.router
         if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
-            if forward and self.cluster is not None and len(msgs) > 1:
-                # keep remote fan-out batched per destination node even
-                # on the CPU branch (one forward_batch per node, not one
-                # per message per node)
-                fwd = self.cluster.forward_batch_remote(msgs)
-                rec = self.spans
-                out = []
-                for i, m in enumerate(msgs):
-                    t_ns = (
-                        rec.now_ns()
-                        if rec is not None and TRACE_HEADER in m.headers
-                        else 0
-                    )
-                    n = self._route_dispatch(
-                        m, self.router.match(m.topic)
-                    )
-                    if t_ns:
-                        rec.deliver(m, n, start_ns=t_ns)
-                    n += fwd[i]
-                    if n == 0:
-                        self.hooks.run("message.dropped", m, "no_subscribers")
-                        self.metrics.inc("messages.dropped.no_subscribers")
-                    out.append(n)
-                return out
-            return [self._dispatch_routed(m, forward) for m in msgs]
+            return self._dispatch_cpu_batch(msgs, forward)
+        deg = self.degrade
+        if deg is not None and not deg.device.allow():
+            # breaker open: degraded serving from the authoritative CPU
+            # trie at batch granularity (docs/robustness.md)
+            self.metrics.inc("degrade.fallback.batches")
+            tp("dispatch.degraded", n=len(msgs))
+            return self._dispatch_cpu_batch(msgs, forward)
         dev = self._device_router()
         rec = self.spans
         t_launch = rec.now_ns() if rec is not None else 0
-        results = dev.route(
-            [m.topic for m in msgs], self._client_hashes(msgs)
-        )
+        try:
+            results = dev.route(
+                [m.topic for m in msgs], self._client_hashes(msgs)
+            )
+        except Exception:  # noqa: BLE001 — degrade, don't fail the batch
+            if deg is None:
+                raise
+            # sync callers get no backoff train (they may hold the event
+            # loop); the async serving path owns the retry ladder
+            deg.device.record_failure("route")
+            self.metrics.inc("degrade.fallback.batches")
+            tp("dispatch.degraded", n=len(msgs))
+            return self._dispatch_cpu_batch(msgs, forward)
+        if deg is not None:
+            deg.device.record_success()
         dsp = None
         if rec is not None:
             # sync path has no ingest batch span: the device-step span
@@ -383,6 +382,40 @@ class Broker:
         return self._dispatch_device_results(
             msgs, results, forward, device_span=dsp
         )
+
+    def _dispatch_cpu_batch(
+        self, msgs: Sequence[Message], forward: bool = True
+    ) -> List[int]:
+        """The authoritative CPU slow path for a whole batch: per-message
+        trie match + host fan-out, remote fan-out still batched per
+        destination node. This is both the small-batch branch and the
+        degradation target when the device path is broken or its breaker
+        is open — it must never itself touch the device."""
+        if forward and self.cluster is not None and len(msgs) > 1:
+            # keep remote fan-out batched per destination node even
+            # on the CPU branch (one forward_batch per node, not one
+            # per message per node)
+            fwd = self.cluster.forward_batch_remote(msgs)
+            rec = self.spans
+            out = []
+            for i, m in enumerate(msgs):
+                t_ns = (
+                    rec.now_ns()
+                    if rec is not None and TRACE_HEADER in m.headers
+                    else 0
+                )
+                n = self._route_dispatch(
+                    m, self.router.match(m.topic)
+                )
+                if t_ns:
+                    rec.deliver(m, n, start_ns=t_ns)
+                n += fwd[i]
+                if n == 0:
+                    self.hooks.run("message.dropped", m, "no_subscribers")
+                    self.metrics.inc("messages.dropped.no_subscribers")
+                out.append(n)
+            return out
+        return [self._dispatch_routed(m, forward) for m in msgs]
 
     async def adispatch_batch_folded(
         self, msgs: Sequence[Message], forward: bool = True
@@ -413,19 +446,43 @@ class Broker:
         round-trip finished (pipeline pacing only)."""
         loop = asyncio.get_running_loop()
         r = self.router
-        if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
+        deg = self.degrade
+
+        def _cpu_pending(degraded: bool = False):
             ready = loop.create_future()
             ready.set_result(None)
+            if degraded:
+                self.metrics.inc("degrade.fallback.batches")
+                tp("dispatch.degraded", n=len(msgs))
 
             async def _cpu():
                 # CPU batches defer dispatch to settle time too: a small
                 # batch settling before an in-flight device batch would
-                # invert cross-batch delivery order
+                # invert cross-batch delivery order. A DEGRADED batch
+                # must bypass the device re-entry inside
+                # dispatch_batch_folded, not just prefer CPU.
+                if degraded:
+                    if batch_span is not None:
+                        batch_span.attrs["degraded"] = True
+                    return self._dispatch_cpu_batch(msgs, forward)
                 return self.dispatch_batch_folded(msgs, forward)
 
             return PendingDispatch(ready, _cpu)
+
+        if not (r.enable_tpu and len(msgs) >= r.min_tpu_batch):
+            return _cpu_pending()
+        if deg is not None and not deg.device.allow():
+            # breaker open: the whole batch serves from the CPU trie
+            # (half-open probes re-enter here one batch at a time)
+            return _cpu_pending(degraded=True)
         dev = self._device_router()
-        args = dev.prepare()
+        try:
+            args = dev.prepare()
+        except Exception:  # noqa: BLE001 — no good epoch: degrade
+            if deg is None:
+                raise
+            deg.device.record_failure("delta_sync")
+            return _cpu_pending(degraded=True)
         feed = self.retained_feed
         storm = None
         if feed is not None and self.mesh is None:
@@ -434,20 +491,61 @@ class Broker:
             storm = feed.take_job()
         rec = self.spans
         t_launch = rec.now_ns() if rec is not None else 0
+        topics = [m.topic for m in msgs]
+        hashes = self._client_hashes(msgs)
         fut = loop.run_in_executor(
             dispatch_pool(),
             dev.route_prepared,
             args,
-            [m.topic for m in msgs],
-            self._client_hashes(msgs),
+            topics,
+            hashes,
             storm,
         )
         if storm is not None:
             feed.attach(storm, fut)
 
         async def _complete():
-            results = await fut
+            try:
+                results = await fut
+            except Exception:  # noqa: BLE001 — the retry ladder owns it
+                if deg is None:
+                    raise
+                results = None
+            if results is None:
+                # bounded exponential backoff + jitter, then degrade:
+                # each retry re-prepares (the failure may have been a
+                # torn sync; rollback serves the last good epoch) and
+                # relaunches WITHOUT the storm (its waiters already fell
+                # back to the CPU walk via feed.attach's done-callback)
+                for delay in deg.retry_delays():
+                    await asyncio.sleep(delay)
+                    try:
+                        args2 = dev.prepare()
+                        results = await loop.run_in_executor(
+                            dispatch_pool(),
+                            dev.route_prepared,
+                            args2,
+                            topics,
+                            hashes,
+                            None,
+                        )
+                        break
+                    except Exception:  # noqa: BLE001 — keep retrying
+                        results = None
+            if results is None:
+                # retries exhausted: trip the breaker, serve this batch
+                # from the CPU trie — the publishes SUCCEED (identical
+                # recipient sets, slower path), they don't fail
+                deg.device.record_failure("launch")
+                self.metrics.inc("degrade.fallback.batches")
+                tp("dispatch.degraded", n=len(msgs))
+                if batch_span is not None:
+                    batch_span.attrs["degraded"] = True
+                return self._dispatch_cpu_batch(msgs, forward)
+            if deg is not None:
+                deg.device.record_success()
             if storm is not None:
+                # no-op when the storm already failed over (retry path)
                 feed.resolve(storm, results.retained)
             dsp = None
             if rec is not None:

@@ -32,8 +32,10 @@ import time
 from collections import deque
 from typing import List, Optional, Tuple
 
+from emqx_tpu.broker.degrade import OPEN, IngestShed
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.observe import faults as _faults
 from emqx_tpu.observe.spans import TRACE_HEADER
 from emqx_tpu.utils.tracepoints import tp
 
@@ -47,10 +49,17 @@ class BatchIngest:
         max_batch: int = 4096,
         window_us: int = 1000,
         pipeline: int = 2,
+        olp=None,
     ):
         self.broker = broker
         self.max_batch = max_batch
         self.window_s = window_us / 1e6
+        # overload-protection signal (broker/olp.py): with the broker's
+        # DegradeController attached, enqueues shed once the pending
+        # backlog passes the shed bound while olp.is_overloaded() holds
+        # or the device breaker is open — backpressure instead of
+        # unbounded queue growth behind a broken fast path
+        self.olp = olp
         # device dispatches in flight at once: batch N+1's table upload +
         # kernel launch overlaps batch N's readback round-trip (the
         # dominant per-batch wall when the chip sits behind a network
@@ -97,8 +106,35 @@ class BatchIngest:
 
     def enqueue(self, msg: Message) -> asyncio.Future:
         """Enqueue one folded message; the future resolves with its
-        delivery count when the batch flushes."""
+        delivery count when the batch flushes.
+
+        Shed gate (docs/robustness.md): while the broker is overloaded
+        (olp) or the device breaker is open, a backlog past the shed
+        bound refuses new enqueues with `IngestShed` on the returned
+        future — the publisher's PUBACK fails (QoS>=1 clients retry)
+        instead of the pending list growing without bound behind a
+        degraded pipeline."""
+        act = _faults.hit("ingest.enqueue")  # raise -> publisher's task
         fut = asyncio.get_running_loop().create_future()
+        shed = act == "drop"
+        deg = getattr(self.broker, "degrade", None)
+        if (
+            not shed
+            and deg is not None
+            and len(self._pending)
+            >= deg.shed_queue_batches * self.max_batch
+            and (
+                (self.olp is not None and self.olp.is_overloaded())
+                or deg.device.state == OPEN
+            )
+        ):
+            shed = True
+        if shed:
+            self.metrics.inc("ingest.shed")
+            fut.set_exception(
+                IngestShed("ingest backlog shed (overload/degraded)")
+            )
+            return fut
         self._pending.append((msg, fut, time.perf_counter()))
         self._event.set()
         return fut

@@ -295,7 +295,46 @@ declare("delivery.errors", COUNTER)
 declare("limiter.refused.connection", COUNTER)
 declare("limiter.dropped.message_routing", COUNTER)
 declare("olp.refused", COUNTER)
+declare("olp.lag_ms", GAUGE,
+        "last sampled event-loop lag (the Olp overload signal)")
+declare("olp.trips", COUNTER,
+        "overload trips: lag crossed the watermark from a calm state")
 declare("node.drained", COUNTER)
+
+# -- fault injection + graceful degradation (observe/faults.py,
+# broker/degrade.py; docs/robustness.md) ----------------------------------
+declare("faults.injected", COUNTER,
+        "fault-site fires across every armed rule (soak audit trail)")
+declare("degrade.state.device", GAUGE,
+        "device-path breaker state: 0 closed, 1 half-open, 2 open "
+        "(open = batches served by the CPU trie)")
+declare("degrade.state.cluster_send", GAUGE,
+        "cluster-send breaker state (most recent transition across "
+        "destinations): 0 closed, 1 half-open, 2 open")
+declare("degrade.trips.device", COUNTER,
+        "device-path breaker closed -> open transitions")
+declare("degrade.trips.cluster_send", COUNTER,
+        "cluster-send breaker closed -> open transitions (any dest)")
+declare("degrade.probe.ok", COUNTER,
+        "half-open probes that succeeded (recovery evidence)")
+declare("degrade.probe.fail", COUNTER,
+        "half-open probes that failed (dwell restarted)")
+declare("degrade.retries", COUNTER,
+        "bounded backoff retry attempts before degrading a batch")
+declare("degrade.fallback.batches", COUNTER,
+        "whole batches served by the CPU trie because the device path "
+        "failed or its breaker was open")
+declare("ingest.shed", COUNTER,
+        "enqueues refused at the ingest gate (olp overloaded or device "
+        "breaker open past the queue bound) — backpressure, not loss")
+declare("router.sync.rollback", COUNTER,
+        "dirty prepares that failed or tore and rolled back to the "
+        "last good epoch snapshot")
+declare("cluster.send.retries", COUNTER,
+        "cluster send attempts retried after a transport failure")
+declare("cluster.send.dead_letter", COUNTER,
+        "cluster sends given up after deadline/retry budget (the "
+        "bounded dead-letter count)")
 
 # worker fabric (transport/workers.py)
 declare("fabric.sess.crash_parked", COUNTER)

@@ -38,6 +38,7 @@ NS_SESSIONS = "persistent_sessions"
 NS_RETAINED = "retained"
 NS_DELAYED = "delayed"
 NS_BANNED = "banned"
+NS_DEGRADE = "degrade"
 
 
 def make_detached_deliverer(session, wal=None, client_id: str = ""):
@@ -166,13 +167,20 @@ class SessionPersistence:
 class DurableState:
     """Retained / delayed / banned snapshot+restore (disc_copies analog)."""
 
-    def __init__(self, kv: FileKv, retainer=None, delayed=None, banned=None):
+    def __init__(self, kv: FileKv, retainer=None, delayed=None, banned=None,
+                 degrade=None):
         self.kv = kv
         self.retainer = retainer
         self.delayed = delayed
         self.banned = banned
+        # DegradeController (broker/degrade.py): breaker states ride the
+        # durable snapshot so a node restarting mid-degradation resumes
+        # open/probing instead of hammering a still-broken fast path
+        self.degrade = degrade
 
     def flush(self) -> None:
+        if self.degrade is not None:
+            self.kv.write(NS_DEGRADE, {"paths": self.degrade.snapshot()})
         if self.retainer is not None:
             msgs = []
             for t in self.retainer.topics():
@@ -209,6 +217,9 @@ class DurableState:
 
     def restore(self) -> Dict[str, int]:
         out = {"retained": 0, "delayed": 0, "banned": 0}
+        if self.degrade is not None:
+            data = self.kv.read(NS_DEGRADE)
+            self.degrade.restore((data or {}).get("paths"))
         if self.retainer is not None:
             data = self.kv.read(NS_RETAINED)
             for d in (data or {}).get("messages", []):

@@ -29,6 +29,7 @@ import asyncio
 import logging
 from typing import Dict, List, Optional
 
+from emqx_tpu.observe import faults as _faults
 from emqx_tpu.utils.tracepoints import tp
 
 log = logging.getLogger("emqx_tpu.retained_feed")
@@ -73,6 +74,9 @@ class RetainedStormFeed:
         filters = list(self._pending)
         job = None
         try:
+            # fault site: a failed storm prepare exercises exactly this
+            # except-arm (every waiter falls back to the CPU walk)
+            _faults.hit("retained.storm")
             job = self.index.prepare_storm(filters)
         except Exception:  # noqa: BLE001 — never poison the launch
             log.exception("storm prepare failed; falling back to CPU")

@@ -27,12 +27,16 @@ Design:
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 from emqx_tpu.cluster.transport import NodeUnreachable
+from emqx_tpu.observe import faults as _faults
+from emqx_tpu.observe.faults import FaultError
 
 Handler = Callable[[str, object], Optional[object]]
 
@@ -152,10 +156,33 @@ class TcpBus:
         port: int = 0,
         channels: int = 4,
         timeout: float = 5.0,
+        send_retries: int = 2,
+        send_backoff_s: float = 0.05,
+        send_deadline_s: float = 0.0,
+        metrics=None,
+        degrade=None,
     ):
+        """`send_retries`/`send_backoff_s`/`send_deadline_s`: each `send`
+        retries transient transport failures with bounded exponential
+        backoff + jitter under an overall deadline (0 = timeout *
+        (retries + 1)) before NodeUnreachable — replacing the old
+        single-reconnect-per-send. Gives-up count into
+        `cluster.send.dead_letter`. `degrade`: an optional
+        DegradeController — sends to a tripped destination fail FAST
+        (no deadline burn) until the half-open probe recovers it."""
         self.node = node
         self.timeout = timeout
         self.channels = channels
+        self.send_retries = max(0, int(send_retries))
+        self.send_backoff_s = float(send_backoff_s)
+        self.send_deadline_s = float(send_deadline_s)
+        self.degrade = degrade
+        if metrics is None:
+            from emqx_tpu.broker.metrics import default_metrics
+
+            metrics = default_metrics
+        self.metrics = metrics
+        self._send_rng = random.Random(0xC1)
         self._handler: Optional[Handler] = None
         self._peers: Dict[str, Tuple[str, int]] = {}
         self._conns: Dict[Tuple[str, int], _PeerConn] = {}
@@ -198,15 +225,76 @@ class TcpBus:
     def send(
         self, src: str, dst: str, payload: object, channel_key: str = ""
     ) -> object:
-        return self._conn_for(dst, channel_key).call(payload, self.timeout)
+        """Confirmed send with deadline + bounded retry/backoff.
+
+        Runs on forward/replication worker threads (never the event
+        loop), so the backoff sleeps are plain `time.sleep`. A breaker
+        (when a DegradeController is attached) makes a partitioned
+        destination fail fast instead of paying the full deadline per
+        message; give-up counts into `cluster.send.dead_letter` — the
+        bounded dead-letter record for the caller's at-least-once layer.
+        """
+        br = (
+            self.degrade.cluster_breaker(dst)
+            if self.degrade is not None
+            else None
+        )
+        if br is not None and not br.allow():
+            self.metrics.inc("cluster.send.dead_letter")
+            raise NodeUnreachable(f"{self.node} -> {dst}: circuit open")
+        deadline = time.monotonic() + (
+            self.send_deadline_s
+            or self.timeout * (self.send_retries + 1)
+        )
+        delay = self.send_backoff_s
+        attempt = 0
+        while True:
+            try:
+                # fault site: an injected partition/drop exercises the
+                # same retry + dead-letter ladder as a real one
+                act = _faults.hit("cluster.forward")
+                if act == "drop":
+                    raise FaultError("cluster.forward")
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    raise NodeUnreachable(
+                        f"{self.node} -> {dst}: send deadline exceeded"
+                    )
+                result = self._conn_for(dst, channel_key).call(
+                    payload, min(self.timeout, budget)
+                )
+                if br is not None:
+                    br.record_success()
+                return result
+            except (NodeUnreachable, FaultError, OSError) as e:
+                attempt += 1
+                if (
+                    attempt > self.send_retries
+                    or time.monotonic() + delay >= deadline
+                ):
+                    if br is not None:
+                        br.record_failure("send")
+                    self.metrics.inc("cluster.send.dead_letter")
+                    if isinstance(e, NodeUnreachable):
+                        raise
+                    raise NodeUnreachable(
+                        f"{self.node} -> {dst}: {e}"
+                    ) from e
+                self.metrics.inc("cluster.send.retries")
+                time.sleep(
+                    delay * (1.0 + 0.5 * self._send_rng.random())
+                )
+                delay = min(delay * 2.0, self.timeout)
 
     def cast(
         self, src: str, dst: str, payload: object, channel_key: str = ""
     ) -> bool:
         try:
+            if _faults.hit("cluster.forward") == "drop":
+                return False  # casts are lossy by contract
             self._conn_for(dst, channel_key).cast(payload)
             return True
-        except (NodeUnreachable, OSError):
+        except (NodeUnreachable, FaultError, OSError):
             return False
 
     # -- internals ----------------------------------------------------------

@@ -52,6 +52,13 @@ class ClusterConfig:
     bind: str = "127.0.0.1"
     listen_port: int = 0  # 0 = ephemeral (printed at boot)
     seeds: List[ClusterSeed] = field(default_factory=list)
+    # cluster send robustness (tcp_transport.py): each send retries up
+    # to send_retries times with bounded exponential backoff before the
+    # dead-letter counter takes it; send_deadline_s bounds the WHOLE
+    # attempt train (0 = timeout * (retries + 1))
+    send_retries: int = 2
+    send_backoff_ms: float = 50.0
+    send_deadline_s: float = 0.0
 
 
 @dataclass
@@ -265,6 +272,61 @@ class OlpConfig:
     cooldown: float = 5.0
 
 
+# Every injectable fault site (observe/faults.py). These literals MUST
+# stay in lockstep with faults.SITES — the FT checker in tools/analysis
+# statically cross-checks the two, so a site added to the injector
+# without config awareness fails the lint, not a midnight soak.
+FAULT_SITES = frozenset({
+    "ingest.enqueue",
+    "device.launch",
+    "device.readback",
+    "router.delta_sync",
+    "retained.storm",
+    "cluster.forward",
+    "exhook.call",
+})
+
+FAULT_MODES = ("raise", "delay", "drop", "corrupt")
+
+
+@dataclass
+class FaultRuleSpec:
+    """One armed fault behavior (observe/faults.py FaultRule). Default
+    off at the root (`faults.enable`); rules also arm at runtime via
+    GET/POST /api/v5/faults for soak testing."""
+
+    site: str = ""
+    mode: str = "raise"  # raise | delay | drop | corrupt
+    probability: float = 1.0
+    nth: int = 0  # fire on every nth eligible call (0 = every)
+    max_fires: int = 0  # stop after this many fires (0 = unlimited, 1 = one-shot)
+    delay_ms: float = 0.0
+
+
+@dataclass
+class FaultsConfig:
+    enable: bool = False
+    rules: List[FaultRuleSpec] = field(default_factory=list)
+
+
+@dataclass
+class DegradeConfig:
+    """Graceful-degradation ladder knobs (broker/degrade.py): bounded
+    retry/backoff before a batch degrades, breaker trip threshold, open
+    dwell before the half-open probe, and the ingest shed bound."""
+
+    enable: bool = True
+    max_retries: int = 2
+    backoff_base_ms: float = 20.0
+    backoff_max_ms: float = 2000.0
+    failure_threshold: int = 1  # exhausted-retry batches to trip open
+    open_secs: float = 5.0  # open dwell before a half-open probe
+    probe_successes: int = 1  # probes needed to close from half-open
+    # ingest sheds enqueues past shed_queue_batches * ingest_max_batch
+    # pending messages while overloaded or the device breaker is open
+    shed_queue_batches: int = 8
+
+
 @dataclass
 class ForceGcConfig:
     enable: bool = True
@@ -468,6 +530,8 @@ class AppConfig:
     # message_in, connection, message_routing (emqx_limiter schema analog)
     limiter: Dict[str, Any] = field(default_factory=dict)
     olp: OlpConfig = field(default_factory=OlpConfig)
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
+    degrade: DegradeConfig = field(default_factory=DegradeConfig)
     force_gc: ForceGcConfig = field(default_factory=ForceGcConfig)
     durability: DurabilityConfig = field(default_factory=DurabilityConfig)
     exhook: List[ExhookServerSpec] = field(default_factory=list)
@@ -633,6 +697,31 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.retainer.storm_window_us < 0:
         raise ConfigError("retainer.storm_window_us must be >= 0")
+    for i, fr in enumerate(cfg.faults.rules):
+        if fr.site not in FAULT_SITES:
+            raise ConfigError(
+                f"faults.rules[{i}].site {fr.site!r} is not a registered "
+                f"fault site (one of {sorted(FAULT_SITES)})"
+            )
+        if fr.mode not in FAULT_MODES:
+            raise ConfigError(
+                f"faults.rules[{i}].mode {fr.mode!r} must be one of "
+                f"{FAULT_MODES}"
+            )
+        if not 0.0 <= fr.probability <= 1.0:
+            raise ConfigError(
+                f"faults.rules[{i}].probability must be in [0, 1]"
+            )
+    if cfg.degrade.max_retries < 0:
+        raise ConfigError("degrade.max_retries must be >= 0")
+    if cfg.degrade.failure_threshold < 1:
+        raise ConfigError("degrade.failure_threshold must be >= 1")
+    if cfg.degrade.open_secs < 0:
+        raise ConfigError("degrade.open_secs must be >= 0")
+    if cfg.degrade.shed_queue_batches < 1:
+        raise ConfigError("degrade.shed_queue_batches must be >= 1")
+    if cfg.cluster.send_retries < 0:
+        raise ConfigError("cluster.send_retries must be >= 0")
     from emqx_tpu.broker.limiter import TYPES as _LIMITER_TYPES
 
     for lt in cfg.limiter:

@@ -31,6 +31,8 @@ from emqx_tpu.broker.hooks import Hooks
 from emqx_tpu.broker.message import Message
 from emqx_tpu.exhook import hookprovider_pb2 as pb
 from emqx_tpu.exhook.rpc import HookProviderStub
+from emqx_tpu.observe import faults as _faults
+from emqx_tpu.observe.faults import FaultError
 from emqx_tpu.ops import topics as T
 from emqx_tpu.utils.node import node_name
 
@@ -237,13 +239,16 @@ class ExhookServer:
             self.metrics[hook]["failed"] += 1
             return False, None
         try:
+            # fault site: an injected sidecar failure rides the same
+            # failed_action + breaker ladder as a real gRPC error
+            _faults.hit("exhook.call")
             resp = getattr(self.stub, method)(
                 request, timeout=self.timeout, metadata=metadata
             )
             self.metrics[hook]["succeed"] += 1
             self._consec_failures = 0
             return True, resp
-        except grpc.RpcError as e:
+        except (grpc.RpcError, FaultError) as e:
             self.metrics[hook]["failed"] += 1
             self._consec_failures += 1
             if self._consec_failures >= self._breaker_threshold:

@@ -75,6 +75,13 @@ ROUTES = [
     ("post", "/api/v5/mqtt/topic_metrics", "topic_metrics_add", "Track a topic", "topic_metrics"),
     ("delete", "/api/v5/mqtt/topic_metrics/{topic:.+}", "topic_metrics_del", "Untrack a topic", "topic_metrics"),
     ("get", "/api/v5/prometheus/stats", "prometheus_stats", "Prometheus exposition", "metrics"),
+    ("get", "/api/v5/faults", "faults_list",
+     "Armed fault-injection rules + degradation breaker states "
+     "(docs/robustness.md)", "faults"),
+    ("post", "/api/v5/faults", "faults_arm",
+     "Arm a fault rule at a registered site (soak testing)", "faults"),
+    ("delete", "/api/v5/faults", "faults_disarm",
+     "Disarm fault rules (?site= for one, all otherwise)", "faults"),
     ("get", "/api/v5/trace/spans", "trace_spans",
      "Recent causal trace spans (publish -> batch -> device -> deliver "
      "ring buffer, OTLP-shaped)", "trace"),
@@ -654,6 +661,44 @@ class MgmtApi:
     async def alarms_clear(self, request):
         n = self.app.alarms.delete_all_deactivated()
         return web.json_response({"cleared": n}, status=200)
+
+    # -- fault injection + degradation (observe/faults.py,
+    #    broker/degrade.py; docs/robustness.md) ----------------------------
+    async def faults_list(self, request):
+        out = self.app.faults.snapshot()
+        deg = getattr(self.app, "degrade", None)
+        out["degrade"] = deg.to_json() if deg is not None else None
+        return web.json_response(out)
+
+    async def faults_arm(self, request):
+        """Arm one rule: {site, mode?, probability?, nth?, max_fires?,
+        delay_ms?}. The injector validates site/mode/probability against
+        the same registry the config loader enforces."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        try:
+            rule = self.app.faults.arm(
+                str(body.get("site", "")),
+                mode=str(body.get("mode", "raise")),
+                probability=float(body.get("probability", 1.0)),
+                nth=int(body.get("nth", 0)),
+                max_fires=int(body.get("max_fires", 0)),
+                delay_ms=float(body.get("delay_ms", 0.0)),
+            )
+        except (ValueError, TypeError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(rule.to_json(), status=201)
+
+    async def faults_disarm(self, request):
+        site = request.query.get("site")
+        self.app.faults.disarm(site)
+        return web.Response(status=204)
 
     async def slow_subs_list(self, request):
         return web.json_response({"data": self.app.slow_subs.topk()})

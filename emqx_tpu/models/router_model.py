@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from emqx_tpu.observe import faults as _faults
 from emqx_tpu.ops.contract import device_contract
 from emqx_tpu.ops.matcher import batch_match_bytes_impl
 from emqx_tpu.ops.nfa import _next_pow2
@@ -1027,7 +1028,28 @@ class DeviceRouter:
                 self.metrics.inc("router.sync.skipped")
             return self._prep_args
         self._clean_streak = 0
-        args = self._device_args_dirty()
+        # Epoch discipline around the dirty sync (docs/robustness.md): a
+        # pack/upload that raises — or tears (fault mode "corrupt": the
+        # snapshot interleaves epochs) — must NEVER become the serving
+        # snapshot. Roll back to the last good epoch (the generation
+        # counters from the O(dirty) cache make "good" checkable) and
+        # leave _prep_key stale so the next prepare retries the sync;
+        # serving a slightly-stale-but-consistent table beats serving a
+        # torn one, and beats taking the whole batch path down.
+        try:
+            action = _faults.hit("router.delta_sync")
+            args = self._device_args_dirty()
+            if action == "corrupt" or self._version_key() != key:
+                raise RuntimeError(
+                    "torn delta-sync: table generations moved during the "
+                    "snapshot"
+                )
+        except Exception:
+            if self._prep_args is None:
+                raise  # no good epoch yet: the caller degrades to CPU
+            if self.metrics is not None:
+                self.metrics.inc("router.sync.rollback")
+            return self._prep_args
         self._prep_key = key
         self._prep_args = args
         if self.metrics is not None:
@@ -1172,6 +1194,9 @@ class DeviceRouter:
         from emqx_tpu.broker.shared_sub import stable_hash
         from emqx_tpu.ops import tokenizer as tok
 
+        # fault site: a failed tpu-dispatch launch (raise) or a slow one
+        # (delay) — the broker's degradation ladder handles both
+        _faults.hit("device.launch")
         cfg = self.config
         (
             shape_tables,
@@ -1302,6 +1327,9 @@ class DeviceRouter:
         kernel's overflow is per-shard (any tp shard over its local cap)
         and must be read back.
         """
+        # fault site: a wedged/failed device->host transfer (the other
+        # half of the launch's round trip; same recovery ladder)
+        _faults.hit("device.readback")
         pulls = {
             "matched": out["matched"][:B],
             "mcount": out["mcount"][:B],
@@ -1454,11 +1482,13 @@ class DeviceRouter:
         for i, t in enumerate(topics):
             if flags[i]:
                 if fallback is None:
-                    raise RuntimeError(
-                        f"device match overflow for topic {t!r}; "
-                        "no fallback provided"
-                    )
-                out.append(fallback(t))
+                    # per-row error contract (ops/matcher.MatchError):
+                    # one flagged row must not poison its batchmates
+                    from emqx_tpu.ops.matcher import MatchError
+
+                    out.append(MatchError(t))
+                else:
+                    out.append(fallback(t))
                 continue
             row = matched[i]
             names = []

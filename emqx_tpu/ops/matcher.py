@@ -244,6 +244,25 @@ def _pad_pow2(n: int, lo: int = 256) -> int:
     return max(lo, _next_pow2(n))
 
 
+class MatchError(RuntimeError):
+    """Per-row match failure marker (returned, never raised mid-batch).
+
+    With ``fallback=None`` a device-flagged row (too deep / overflow /
+    too long) used to raise AFTER the whole batch's device work was
+    done — one oversized topic poisoned every other row's result. Now
+    each flagged row yields a `MatchError` in its slot and the rest of
+    the batch returns normally; callers either pass a fallback (the CPU
+    trie) or filter/inspect the error rows themselves."""
+
+    def __init__(self, topic: str, cause: str = "overflow"):
+        super().__init__(
+            f"device match overflow for topic {topic!r}; no fallback "
+            "provided"
+        )
+        self.topic = topic
+        self.cause = cause
+
+
 class TpuMatcher:
     """Host-facing wrapper: owns packed tables on device, pads batches,
     decodes matches back to filter names, and falls back to a caller-provided
@@ -291,7 +310,10 @@ class TpuMatcher:
         """Match a batch of topic strings -> list of matched filter names.
 
         `fallback(topic) -> list[str]` handles rows the device flags
-        (too deep / overflow); defaults to raising if flagged.
+        (too deep / overflow). With no fallback a flagged row yields a
+        `MatchError` IN ITS SLOT (per-row error contract) — the rest of
+        the batch still returns; one pathological topic cannot poison
+        the device work already done for its batchmates.
         """
         import jax
         import time
@@ -339,11 +361,9 @@ class TpuMatcher:
         for i in range(B):
             if flags[i]:
                 if fallback is None:
-                    raise RuntimeError(
-                        f"device match overflow for topic {topics[i]!r}; "
-                        "no fallback provided"
-                    )
-                out.append(fallback(topics[i]))
+                    out.append(MatchError(topics[i]))
+                else:
+                    out.append(fallback(topics[i]))
             else:
                 names = []
                 for fid in matched[i, : mcount[i]]:

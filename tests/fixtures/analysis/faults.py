@@ -1,0 +1,8 @@
+"""FT fixture: the injector half of the site registry (FT001 pairs with
+the FAULT_SITES literal in the sibling schema.py fixture)."""
+
+SITES = (
+    "device.launch",  # in lockstep with schema -> silent
+    "ingest.enqueue",  # in lockstep with schema -> silent
+    "matcher.mystery",  # FT001: injector-only, config can never arm it
+)

@@ -1,0 +1,22 @@
+"""FT fixture: degradation series drifting from the declared registry."""
+
+
+class M:
+    def inc(self, name, n=1):
+        pass
+
+    def gauge_set(self, name, v):
+        pass
+
+
+class Breaker:
+    def __init__(self, name, state_series="", trips_series=""):
+        self.state_series = state_series
+        self.trips_series = trips_series
+
+
+def bad(m: M):
+    m.inc("degrade.trips.devize")  # FT002: typo'd trips series
+    m.inc("faults.injektd")  # FT002: typo'd injection counter
+    # FT002: breaker series names are checked through the *_series kwargs
+    return Breaker("device", state_series="degrade.state.devize")

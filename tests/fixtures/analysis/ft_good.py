@@ -1,0 +1,32 @@
+"""FT fixture: declared degradation series stay silent."""
+
+
+def declare(name, kind, help=""):
+    pass
+
+
+declare("degrade.state.device", "gauge")
+declare("degrade.trips.device", "counter")
+declare("degrade.probe.ok", "counter")
+declare("faults.injected", "counter")
+
+
+class M:
+    def inc(self, name, n=1):
+        pass
+
+
+class Breaker:
+    def __init__(self, name, state_series="", trips_series=""):
+        self.state_series = state_series
+        self.trips_series = trips_series
+
+
+def good(m: M):
+    m.inc("degrade.probe.ok")
+    m.inc("faults.injected")
+    return Breaker(
+        "device",
+        state_series="degrade.state.device",
+        trips_series="degrade.trips.device",
+    )

@@ -15,6 +15,8 @@ declare("device.compile.count", COUNTER)
 declare("router.sync.skipped", COUNTER)
 declare("ingest.device.idle.seconds", "histogram")
 declare("retained.storm.fused", COUNTER)
+declare("olp.lag_ms", "gauge")
+declare("olp.trips", COUNTER)
 
 
 class M:
@@ -37,6 +39,8 @@ def good(m: M):
     m.inc("router.sync.skipped")
     m.observe("ingest.device.idle.seconds", 0.001)
     m.inc("retained.storm.fused")
+    m.gauge_set("olp.lag_ms", 12.5)
+    m.inc("olp.trips")
 
 
 def bad(m: M):
@@ -48,3 +52,5 @@ def bad(m: M):
     m.inc("router.sync.skiped")  # MN001: typo'd prepare series
     m.observe("ingest.device.idle.secondz", 1)  # MN001: typo'd idle series
     m.inc("retained.storm.fuzed")  # MN001: typo'd storm series
+    m.gauge_set("olp.lag_mz", 1)  # MN001: typo'd olp gauge
+    m.inc("olp.tripz")  # MN001: typo'd olp trip counter

@@ -1,0 +1,7 @@
+"""FT fixture: the config-schema half of the site registry."""
+
+FAULT_SITES = frozenset({
+    "device.launch",  # in lockstep with faults.py -> silent
+    "ingest.enqueue",  # in lockstep with faults.py -> silent
+    "cluster.ghost",  # FT001: schema ghost, no injector site fires it
+})

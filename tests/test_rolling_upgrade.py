@@ -105,6 +105,133 @@ def _cfg(data_dir, port=0):
     )
 
 
+def _cfg_tpu(data_dir, open_secs=60.0):
+    """Device-serving config for the prepare-cache / breaker-state
+    restart tests: small batches so the CPU-jax compile stays cheap."""
+    return load_config(
+        {
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"enable": False},
+            "router": {
+                "enable_tpu": True,
+                "min_tpu_batch": 4,
+                "ingest_max_batch": 64,
+            },
+            "degrade": {"open_secs": open_secs},
+            "durability": {
+                "enable": True,
+                "data_dir": str(data_dir),
+                "flush_interval": 0.5,
+            },
+            "session": {"expiry_interval": 3600},
+        }
+    )
+
+
+def test_prepare_cache_counters_rebuild_across_snapshot_restore():
+    """PR 6's O(dirty) prepare caches the device snapshot on host-table
+    generation counters. Those counters are process state: a restored
+    node must NOT serve from a phantom warm cache — its first prepares
+    are dirty against the restored tables — and restored subscriptions
+    must be routable through the device path immediately (the boot
+    warmup snapshots AFTER restore)."""
+
+    async def run():
+        with tempfile.TemporaryDirectory() as d:
+            app1 = BrokerApp(_cfg_tpu(d))
+            await app1.start()
+            port = list(app1.listeners.list().values())[0].port
+            cl = Client("devroll", version=pkt.MQTT_V5, clean_start=False,
+                        properties={"Session-Expiry-Interval": 3600})
+            await cl.connect("127.0.0.1", port)
+            await cl.subscribe("dev/+/t", qos=1)
+            await cl.disconnect()
+            await asyncio.sleep(0.05)
+            m1 = app1.broker.metrics
+            msgs = [
+                Message(topic=f"dev/{i}/t", payload=b"a%d" % i, qos=1)
+                for i in range(8)
+            ]
+            n = app1.broker.publish_batch(list(msgs))
+            assert n == 8
+            dirty1 = m1.get("router.prepare.dirty")
+            assert dirty1 >= 1
+            # second batch against clean tables: the O(dirty) cache hits
+            app1.broker.publish_batch(list(msgs))
+            assert m1.get("router.sync.skipped") >= 1
+            await app1.drain()
+            await app1.stop()
+
+            app2 = BrokerApp(_cfg_tpu(d))
+            await app2.start()
+            try:
+                m2 = app2.broker.metrics
+                # fresh process: the cache was rebuilt (dirty prepare at
+                # warmup), never carried over
+                assert m2.get("router.prepare.dirty") >= 1
+                # restored subscription is routable via the device path
+                # in the FIRST post-restore batch (banked for the
+                # detached session)
+                n = app2.broker.publish_batch(
+                    [Message(topic=f"dev/{i}/t", payload=b"b%d" % i,
+                             qos=1) for i in range(8)]
+                )
+                assert n == 8
+                assert m2.get("messages.routed.device") >= 8
+                dev = app2.broker._device_router()
+                # and the generation-counter cache works in the new
+                # process: a clean re-prepare returns the cached tuple
+                args = dev.prepare()
+                assert dev.prepare() is args
+            finally:
+                await app2.stop()
+
+    asyncio.run(run())
+
+
+def test_breaker_state_survives_drain_restart():
+    """A node restarting mid-degradation re-enters the OPEN breaker
+    state from the durable snapshot instead of hammering a fast path
+    the previous process already proved broken."""
+
+    async def run():
+        with tempfile.TemporaryDirectory() as d:
+            app1 = BrokerApp(_cfg_tpu(d, open_secs=120.0))
+            await app1.start()
+            assert app1.degrade is not None
+            # the previous process tripped the device path open
+            app1.degrade.device.record_failure("launch")
+            assert app1.degrade.device.state == "open"
+            await app1.drain()
+            await app1.stop()  # final durable flush ships breaker state
+
+            app2 = BrokerApp(_cfg_tpu(d, open_secs=120.0))
+            await app2.start()
+            try:
+                assert app2.degrade.device.state == "open"
+                assert not app2.degrade.device.allow()
+                # degraded serving still works end to end: batches take
+                # the CPU trie, not the (distrusted) device path
+                app2.broker.subscribe(
+                    "s1", "c1", "deg/#", pkt.SubOpts(), lambda m, o: None,
+                )
+                n = app2.broker.publish_batch(
+                    [Message(topic=f"deg/{i}", payload=b"x")
+                     for i in range(8)]
+                )
+                assert n == 8
+                assert app2.broker.metrics.get(
+                    "degrade.fallback.batches"
+                ) >= 1
+                assert app2.broker.metrics.get(
+                    "messages.routed.device"
+                ) == 0
+            finally:
+                await app2.stop()
+
+    asyncio.run(run())
+
+
 def test_app_drain_then_replacement_process_zero_loss():
     """Single-node rolling restart through BrokerApp.drain(): the old
     process drains (listeners closed, sessions parked + WAL checkpoint),

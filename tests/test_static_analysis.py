@@ -140,8 +140,38 @@ def test_metric_checker_flags_undeclared_series():
         "messages.recieved", "sessions.active", "dispatch.readback.bytez",
         "trace.spans.samplid", "device.compile.cout",
         "router.sync.skiped", "ingest.device.idle.secondz",
-        "retained.storm.fuzed",
+        "retained.storm.fuzed", "olp.lag_mz", "olp.tripz",
     }
+
+
+# -- fault contracts --------------------------------------------------------
+
+def test_fault_checker_flags_site_drift_and_undeclared_series():
+    report = run_fixtures(["fault"])
+    bad = {(f.code, f.detail) for f in report.findings}
+    # injector-only site: config validation can never arm it
+    assert ("FT001", "matcher.mystery") in bad
+    # schema ghost: a rule naming it never fires
+    assert ("FT001", "cluster.ghost") in bad
+    # undeclared series at a metric call site and via a *_series kwarg
+    assert ("FT002", "degrade.trips.devize") in bad
+    assert ("FT002", "faults.injektd") in bad
+    assert ("FT002", "degrade.state.devize") in bad
+    # lockstep sites + declared series stay silent
+    details = {d for _, d in bad}
+    assert "device.launch" not in details
+    assert "ingest.enqueue" not in details
+    assert "degrade.state.device" not in details
+    assert "degrade.probe.ok" not in details
+    assert "faults.injected" not in details
+
+
+def test_fault_checker_repo_registries_in_lockstep():
+    # the live cross-check the checker exists for: emqx_tpu's injector
+    # SITES and config FAULT_SITES agree, and every degrade.*/faults.*
+    # series the degradation ladder emits is declared
+    report = run_analysis(ROOT / "emqx_tpu", checks=["fault"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
 
 
 # -- sharding discipline ----------------------------------------------------

@@ -36,7 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--checks", default=None,
         help="comma-separated subset of checks to run "
-             "(lock,async,jit,config,metrics,shard,transfer,retrace)",
+             "(lock,async,jit,config,metrics,shard,transfer,retrace,"
+             "fault)",
     )
     p.add_argument(
         "--changed-only", action="store_true",

@@ -1,0 +1,173 @@
+"""FT: fault-injection + degradation contract lint.
+
+Two registries must not drift:
+
+- **FT001 — site/schema lockstep.** Every fault site registered in the
+  injector (`SITES = (...)` in a module named ``faults.py``) must appear
+  in the config schema's literal site list (`FAULT_SITES = frozenset({...})`
+  in a module named ``schema.py``) and vice versa — a site the injector
+  knows but config validation rejects (or a schema ghost the injector
+  never fires) surfaces at lint time, not in a midnight soak.
+
+- **FT002 — degrade/faults series declaration.** Every ``degrade.*`` /
+  ``faults.*`` metric series referenced statically — as the first arg of
+  a metric call (`inc`/`observe`/`observe_many`/`gauge_set`) or as any
+  ``*_series=`` keyword (the breaker constructors take their series
+  names this way precisely so this checker can see them) — must be
+  `declare()`d in the metric-kind registry. The MN checker already
+  guards plain call sites; FT002 additionally covers the series handed
+  to breakers, which MN's call-site scan cannot reach.
+
+Both checks are cross-module (`begin` collects, `finalize` reports) and
+no-op gracefully when the tree has no faults/schema modules (fixture
+subsets, third-party scans).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from tools.analysis.checkers.metric_names import declared_names
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+# a plausible series/site literal: dotted lowercase words. Anchored on
+# the WHOLE string so prose in docstrings never matches.
+_SERIES_RE = re.compile(r"^(degrade|faults)\.[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+_METRIC_METHODS = ("inc", "observe", "observe_many", "gauge_set")
+
+
+def _const_str_elts(node: ast.AST) -> List[str]:
+    """String constants inside a tuple/list/set/frozenset(...) literal."""
+    if isinstance(node, ast.Call) and node.args:
+        # frozenset({...}) / tuple([...]) wrappers
+        return _const_str_elts(node.args[0])
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _toplevel_assign(mod: ParsedModule, name: str):
+    """(lineno, value-node) of a module-level `NAME = ...`, else None."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.lineno, node.value
+    return None
+
+
+class FaultContractChecker(Checker):
+    name = "fault"
+    codes = {
+        "FT001": "fault site registry and config schema site list drift",
+        "FT002": "degrade.*/faults.* series referenced but not declared "
+                 "in the metric-kind registry",
+    }
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._declared: Set[str] = declared_names(modules)
+        # (site, mod, lineno) from SITES in any faults.py
+        self._sites: List[Tuple[str, ParsedModule, int]] = []
+        # (site, mod, lineno) from FAULT_SITES in any schema.py
+        self._schema_sites: List[Tuple[str, ParsedModule, int]] = []
+        # series -> first (mod, lineno, context) reference
+        self._series: Dict[str, Tuple[ParsedModule, int, str]] = {}
+        for mod in modules:
+            base = mod.rel.rsplit("/", 1)[-1]
+            if base == "faults.py":
+                got = _toplevel_assign(mod, "SITES")
+                if got is not None:
+                    line, val = got
+                    for s in _const_str_elts(val):
+                        self._sites.append((s, mod, line))
+            if base == "schema.py":
+                got = _toplevel_assign(mod, "FAULT_SITES")
+                if got is not None:
+                    line, val = got
+                    for s in _const_str_elts(val):
+                        self._schema_sites.append((s, mod, line))
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and _SERIES_RE.match(node.args[0].value)
+                ):
+                    self._series.setdefault(
+                        node.args[0].value,
+                        (mod, node.lineno, node.func.attr),
+                    )
+                for kw in node.keywords:
+                    if (
+                        kw.arg
+                        and kw.arg.endswith("_series")
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                        and _SERIES_RE.match(kw.value.value)
+                    ):
+                        self._series.setdefault(
+                            kw.value.value, (mod, node.lineno, kw.arg)
+                        )
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # FT001 only when BOTH registries exist in the scanned tree — a
+        # fixture subset or foreign tree has nothing to keep in lockstep
+        if self._sites and self._schema_sites:
+            schema_set = {s for s, _, _ in self._schema_sites}
+            site_set = {s for s, _, _ in self._sites}
+            for s, mod, line in self._sites:
+                if s not in schema_set:
+                    findings.append(Finding(
+                        code="FT001",
+                        path=mod.rel,
+                        line=line,
+                        symbol="SITES",
+                        detail=s,
+                        message=(
+                            f"fault site {s!r} registered in the injector "
+                            "but missing from config schema FAULT_SITES — "
+                            "config can never arm it"
+                        ),
+                    ))
+            for s, mod, line in self._schema_sites:
+                if s not in site_set:
+                    findings.append(Finding(
+                        code="FT001",
+                        path=mod.rel,
+                        line=line,
+                        symbol="FAULT_SITES",
+                        detail=s,
+                        message=(
+                            f"schema fault site {s!r} has no registered "
+                            "injector site — a rule naming it never fires"
+                        ),
+                    ))
+        for series, (mod, line, ctx) in sorted(self._series.items()):
+            if series not in self._declared:
+                findings.append(Finding(
+                    code="FT002",
+                    path=mod.rel,
+                    line=line,
+                    symbol=ctx,
+                    detail=series,
+                    message=(
+                        f"undeclared degradation series {series!r}; "
+                        "declare() it in emqx_tpu/broker/metrics.py"
+                    ),
+                ))
+        return findings
