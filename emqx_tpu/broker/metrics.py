@@ -465,6 +465,14 @@ declare("device.transfer.bytes", COUNTER,
         "cumulative device->host readback bytes across all readback "
         "sites (rate = sustained link bandwidth consumed)")
 
+# -- runtime race harness (observe/racetrack.py) ---------------------------
+declare("racetrack.events", COUNTER,
+        "accesses probed while the race harness is armed (the race test "
+        "suite and chaos_soak; disarmed production cost is zero)")
+declare("race.reports", COUNTER,
+        "candidate data races reported by the armed lockset/HB detector "
+        "(field + both stacks + locksets; zero unwaived is the gate)")
+
 # -- causal span tracing (observe/spans.py) --------------------------------
 declare("trace.spans.sampled", COUNTER,
         "spans recorded into the ring (head-based sampling accepted)")

@@ -374,15 +374,28 @@ class ClusterNode:
         return fut.result(timeout=timeout)
 
     def leave(self) -> None:
+        # the pool REFERENCES are construction-only (CX discipline):
+        # shutdown() flips state inside the executors themselves, so a
+        # loop-side submit racing this drain (leave runs on the default
+        # executor during a rolling-upgrade handoff) gets a RuntimeError
+        # that `_pool_submit` drops — never a torn None dereference
         if self._repl_pool is not None:
             self._repl_pool.shutdown(wait=True)  # flush pending replication
-            self._repl_pool = None
         if self._fwd_pool is not None:
             self._fwd_pool.shutdown(wait=True)  # flush in-flight forwards
-            self._fwd_pool = None
         self.membership.leave()
         self.rpc.stop()
         self.bus.detach(self.name)
+
+    @staticmethod
+    def _pool_submit(pool, fn, *args) -> None:
+        """Submit replication/forward work to an app-mode pool. A pool
+        already shut down by a racing leave() swallows the task — the
+        bus is detaching, the work has nowhere to go."""
+        try:
+            pool.submit(fn, *args)
+        except RuntimeError:
+            pass
 
     # -- subscribe side ----------------------------------------------------
     def subscribe(
@@ -428,7 +441,7 @@ class ClusterNode:
 
         if self._repl_pool is not None:
             for p in peers:
-                self._repl_pool.submit(one, p)
+                self._pool_submit(self._repl_pool, one, p)
         else:
             for p in peers:
                 one(p)
@@ -509,7 +522,7 @@ class ClusterNode:
 
         for p in self.membership.peers():
             if self._repl_pool is not None:
-                self._repl_pool.submit(one, p)
+                self._pool_submit(self._repl_pool, one, p)
             else:
                 one(p)
 
@@ -577,7 +590,7 @@ class ClusterNode:
 
         for p in self.membership.peers():
             if self._repl_pool is not None:
-                self._repl_pool.submit(one, p)
+                self._pool_submit(self._repl_pool, one, p)
             else:
                 one(p)
 
@@ -684,7 +697,7 @@ class ClusterNode:
 
         for node, batch in per_node.items():
             if self._fwd_pool is not None:
-                self._fwd_pool.submit(send, node, batch)
+                self._pool_submit(self._fwd_pool, send, node, batch)
             else:
                 send(node, batch)
         return out

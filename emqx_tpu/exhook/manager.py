@@ -169,10 +169,16 @@ class ExhookServer:
         self._notify_backlog = 0  # guarded-by: _notify_lock (worker thread
         self._notify_lock = threading.Lock()  # decrements, loop increments)
         self._notify_backlog_max = 1000
-        self._consec_failures = 0
+        # breaker state + per-hook counters mutate from BOTH worker lanes
+        # (up to pool_size valued workers run `call` concurrently) and
+        # are read on the loop: unlocked `+=` here loses increments, so
+        # a flapping sidecar could stay under the trip threshold forever
+        # (found by the CX checker / racetrack, PR 8)
+        self._state_lock = threading.Lock()
+        self._consec_failures = 0  # guarded-by: _state_lock
         self._breaker_threshold = breaker_threshold
         self._breaker_cooldown = breaker_cooldown
-        self._broken_until = 0.0
+        self._broken_until = 0.0  # guarded-by: _state_lock
 
     def load(self, version: str) -> bool:
         """OnProviderLoaded handshake: learn which hooks to bridge."""
@@ -222,7 +228,32 @@ class ExhookServer:
         return any(T.match(topic, f) for f in filters)
 
     def _breaker_open(self) -> bool:
-        return time.monotonic() < self._broken_until
+        with self._state_lock:
+            return time.monotonic() < self._broken_until
+
+    def _mark(self, hook: str, ok: bool, trip: bool = True) -> None:
+        """Result accounting + breaker ladder, callable from any lane.
+
+        One lock covers the per-hook counter dicts (defaultdict creation
+        and `+=` are read-modify-write) and the consecutive-failure
+        counter the breaker trips on. `trip=False` counts a failure
+        without advancing the ladder: local rejections (breaker already
+        open, backlog drop, pool shut down) say nothing about sidecar
+        health — letting them extend `_broken_until` would hold the
+        breaker open forever under steady traffic."""
+        with self._state_lock:
+            m = self.metrics[hook]
+            if ok:
+                m["succeed"] += 1
+                self._consec_failures = 0
+            else:
+                m["failed"] += 1
+                if trip:
+                    self._consec_failures += 1
+                    if self._consec_failures >= self._breaker_threshold:
+                        self._broken_until = (
+                            time.monotonic() + self._breaker_cooldown
+                        )
 
     def call(self, method: str, request, hook: str, metadata=None):
         """Blocking gRPC call -> (ok, response|None); metrics + breaker.
@@ -236,7 +267,7 @@ class ExhookServer:
         call from the event loop — use `acall`/`notify` there.
         """
         if self._breaker_open():
-            self.metrics[hook]["failed"] += 1
+            self._mark(hook, ok=False, trip=False)
             return False, None
         try:
             # fault site: an injected sidecar failure rides the same
@@ -245,16 +276,10 @@ class ExhookServer:
             resp = getattr(self.stub, method)(
                 request, timeout=self.timeout, metadata=metadata
             )
-            self.metrics[hook]["succeed"] += 1
-            self._consec_failures = 0
+            self._mark(hook, ok=True)
             return True, resp
         except (grpc.RpcError, FaultError) as e:
-            self.metrics[hook]["failed"] += 1
-            self._consec_failures += 1
-            if self._consec_failures >= self._breaker_threshold:
-                self._broken_until = (
-                    time.monotonic() + self._breaker_cooldown
-                )
+            self._mark(hook, ok=False)
             log.debug("exhook %s %s failed: %s", self.name, method, e)
             return False, None
 
@@ -263,7 +288,7 @@ class ExhookServer:
         waits. A shut-down pool (unload raced with an in-flight packet)
         counts as a failure so failed_action applies."""
         if self._breaker_open():
-            self.metrics[hook]["failed"] += 1
+            self._mark(hook, ok=False, trip=False)
             return False, None
         loop = asyncio.get_running_loop()
         try:
@@ -272,7 +297,7 @@ class ExhookServer:
                 metadata,
             )
         except RuntimeError:
-            self.metrics[hook]["failed"] += 1
+            self._mark(hook, ok=False, trip=False)
             return False, None
 
     def _notify_done(self, _fut) -> None:
@@ -290,7 +315,7 @@ class ExhookServer:
                 drop = False
                 self._notify_backlog += 1
         if drop:
-            self.metrics[hook]["failed"] += 1
+            self._mark(hook, ok=False, trip=False)
             return
         try:
             fut = self._pool.submit(self.call, method, request, hook)
@@ -300,13 +325,15 @@ class ExhookServer:
         fut.add_done_callback(self._notify_done)
 
     def info(self) -> Dict:
+        with self._state_lock:
+            mstats = {k: dict(v) for k, v in self.metrics.items()}
         return {
             "name": self.name,
             "url": self.url,
             "loaded": self.loaded,
             "failed_action": self.failed_action,
             "hooks": dict(self.hooks),
-            "metrics": {k: dict(v) for k, v in self.metrics.items()},
+            "metrics": mstats,
         }
 
 

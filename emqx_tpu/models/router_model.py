@@ -950,16 +950,20 @@ class DeviceRouter:
         self._rand_seq = itertools.count(0xEC0)
         # auto-sized compact-slot cap (grow-only so the jit program is
         # stable; only _device_args — loop thread — mutates it)
-        self._kslot = 0
+        self._kslot = 0  # single-writer: loop
         # O(dirty) prepare: cached (version key, args) of the last
         # snapshot. While every source table's generation counter is
         # unchanged, prepare() returns this tuple without touching
         # pack/delta-sync at all — a clean-table batch costs a few dict
         # reads, not a re-walk of live structures. Only the loop thread
-        # (prepare/_device_args callers) mutates it.
-        self._prep_key = None
-        self._prep_args = None
-        self._clean_streak = 0
+        # (prepare/_device_args callers) mutates it; `tpu-dispatch`
+        # workers only ever see the immutable args tuple passed to
+        # route_prepared (the publication pattern the CX checker's
+        # single-writer declaration encodes — a pool-rooted writer
+        # appearing later is a CX002)
+        self._prep_key = None  # single-writer: loop
+        self._prep_args = None  # single-writer: loop
+        self._clean_streak = 0  # single-writer: loop
 
     # clean-table prepares re-check the auto-sized Kslot only every this
     # many batches: the fanout histogram drifts slowly and the p99 scan

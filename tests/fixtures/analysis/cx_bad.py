@@ -1,0 +1,57 @@
+"""CX fixture: cross-context escapes the checker must flag."""
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+cx_pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="cx-worker")
+
+
+class SharedState:
+    """One field per violation class."""
+
+    def __init__(self):
+        self.counter = 0  # CX001: written from loop AND cx-worker
+        self.flights = 0  # CX001: written on loop, read from cx-worker
+        self.stamp = 0.0  # single-writer: loop
+        self.mode = "a"  # single-writer: warp-core
+
+    def cx_bump(self):
+        # runs on the cx-worker pool (submitted below)
+        self.counter += 1
+        # CX002: `stamp` declares single-writer loop, but this method
+        # writes it from cx-worker — the declaration rotted
+        self.stamp = 2.0
+        return self.flights
+
+    async def tick(self):
+        self.counter += 1  # second writer context: the event loop
+        self.flights += 1
+        self.stamp = 1.0  # the declared writer (legal on its own)
+        # `mode` declares a context no root in this tree creates: CX002
+        self.mode = "b"
+        await asyncio.sleep(0)
+
+
+def cx_spin(state: SharedState):
+    cx_pool.submit(state.cx_bump)
+
+
+class ThreadShared:
+    """Raw-thread root: loop writes, a named thread also writes."""
+
+    def __init__(self):
+        self.tally = 0  # CX001 (loop + cx-reader)
+        self._t = None
+
+    def cx_reader_loop(self):
+        self.tally += 1
+
+    def start(self):
+        self._t = threading.Thread(
+            target=self.cx_reader_loop, name="cx-reader", daemon=True
+        )
+        self._t.start()
+
+    async def observe(self):
+        self.tally += 1

@@ -1,0 +1,67 @@
+"""CX fixture: compliant cross-context disciplines that must stay silent."""
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+good_pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="cx-good")
+
+
+class LockedShared:
+    """Cross-context, but lock-guarded: the LK checker owns it."""
+
+    GUARDED_BY = {"count": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def cx_good_bump(self):
+        with self._lock:
+            self.count += 1
+
+    async def poll(self):
+        good_pool.submit(self.cx_good_bump)
+        with self._lock:
+            return self.count
+
+
+class PublishedShared:
+    """The publication pattern: one declared writer context, GIL-atomic
+    snapshot reads everywhere else."""
+
+    def __init__(self):
+        self.snapshot = ()  # single-writer: loop
+
+    async def refresh(self):
+        self.snapshot = (1, 2, 3)
+        await asyncio.sleep(0)
+
+    def cx_good_read(self):
+        return len(self.snapshot)
+
+
+async def launch(p: PublishedShared):
+    return await asyncio.get_running_loop().run_in_executor(
+        good_pool, p.cx_good_read
+    )
+
+
+class WaivedShared:
+    """A deliberate racy flag, waived inline with a justification."""
+
+    def __init__(self):
+        self.alive = True
+
+    def cx_good_kill(self):
+        # monotonic GIL-atomic tombstone: readers may observe it late,
+        # never torn
+        self.alive = False  # lint: disable=CX001
+
+    async def reap(self):
+        self.alive = False
+        await asyncio.sleep(0)
+
+
+def kill_later(w: WaivedShared):
+    good_pool.submit(w.cx_good_kill)

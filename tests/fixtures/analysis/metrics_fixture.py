@@ -17,6 +17,8 @@ declare("ingest.device.idle.seconds", "histogram")
 declare("retained.storm.fused", COUNTER)
 declare("olp.lag_ms", "gauge")
 declare("olp.trips", COUNTER)
+declare("racetrack.events", COUNTER)
+declare("race.reports", COUNTER)
 
 
 class M:
@@ -41,6 +43,8 @@ def good(m: M):
     m.inc("retained.storm.fused")
     m.gauge_set("olp.lag_ms", 12.5)
     m.inc("olp.trips")
+    m.inc("racetrack.events")
+    m.inc("race.reports")
 
 
 def bad(m: M):
@@ -54,3 +58,5 @@ def bad(m: M):
     m.inc("retained.storm.fuzed")  # MN001: typo'd storm series
     m.gauge_set("olp.lag_mz", 1)  # MN001: typo'd olp gauge
     m.inc("olp.tripz")  # MN001: typo'd olp trip counter
+    m.inc("racetrack.eventz")  # MN001: typo'd race-harness counter
+    m.inc("race.reportz")  # MN001: typo'd race-report counter

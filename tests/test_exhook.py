@@ -332,3 +332,30 @@ def test_valued_response_continue_and_stop_semantics():
     finally:
         for srv in (sA, sB, sC):
             srv.stop(None)
+
+
+def test_breaker_rejections_do_not_extend_cooldown():
+    """PR 8 regression: calls rejected while the breaker is open count a
+    failure but must NOT advance the ladder — re-tripping on every
+    rejection would push _broken_until forward forever under steady
+    traffic, and the breaker could never half-open."""
+    s = ExhookServer("brk", "127.0.0.1:1", timeout=0.05,
+                     breaker_threshold=2, breaker_cooldown=5.0)
+    try:
+        # two real failures (unreachable sidecar) trip the breaker
+        for _ in range(2):
+            ok, _resp = s.call("OnProviderLoaded", None, "client.connect")
+            assert not ok
+        with s._state_lock:
+            deadline = s._broken_until
+        assert deadline > time.monotonic()
+        # a burst of rejected calls while open: failures counted,
+        # deadline untouched
+        for _ in range(5):
+            ok, _resp = s.call("OnProviderLoaded", None, "client.connect")
+            assert not ok
+        with s._state_lock:
+            assert s._broken_until == deadline
+        assert s.metrics["client.connect"]["failed"] == 7
+    finally:
+        s.unload()

@@ -1,0 +1,363 @@
+"""racetrack (observe/racetrack.py): the runtime half of the PR 8
+concurrency rig.
+
+Every test drives a *deterministic seeded interleaving*: thread bodies
+are sequenced with explicit Events (which racetrack deliberately does
+NOT model as happens-before), so a seeded race is detected on every run
+and a properly-disciplined pattern is silent on every run — no
+schedule-luck flakiness in either direction.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.observe.racetrack import RaceTracker
+
+pytestmark = pytest.mark.race
+
+
+class Shared:
+    def __init__(self):
+        self.x = 0
+        self._lock = threading.Lock()
+        self._lock_b = threading.Lock()
+
+
+@pytest.fixture
+def tracker():
+    t = RaceTracker()
+    yield t
+    t.disarm()
+
+
+def _run_seeded(tracker, first, second):
+    """Two raw threads, `first`'s body strictly before `second`'s via an
+    Event — deterministic, and invisible to the HB model on purpose."""
+    handoff = threading.Event()
+
+    def a():
+        first()
+        handoff.set()
+
+    def b():
+        assert handoff.wait(5)
+        second()
+
+    t1 = threading.Thread(target=a, name="seeded-a")
+    t2 = threading.Thread(target=b, name="seeded-b")
+    t1.start()
+    t2.start()
+    t1.join(5)
+    t2.join(5)
+
+
+# -- seeded races must be detected ------------------------------------------
+
+def test_unguarded_write_write_race_detected(tracker):
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+
+    def w1():
+        s.x = 1
+
+    def w2():
+        s.x = 2
+
+    _run_seeded(tracker, w1, w2)
+    tracker.disarm()
+    reports = tracker.unwaived_reports()
+    assert reports, "seeded write/write race missed"
+    r = reports[0]
+    assert r.field == "Shared.x"
+    # the report carries BOTH stacks and both locksets
+    assert r.prior.stack and r.current.stack
+    assert r.prior.locks == () and r.current.locks == ()
+    assert r.prior.thread != r.current.thread
+    assert "race on Shared.x" in r.render()
+
+
+def test_read_write_race_detected(tracker):
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+    got = []
+    _run_seeded(tracker, lambda: got.append(s.x), lambda: setattr(s, "x", 7))
+    tracker.disarm()
+    reports = tracker.unwaived_reports()
+    assert reports
+    assert {reports[0].prior.write, reports[0].current.write} == {
+        False, True,
+    }
+
+
+def test_disjoint_locksets_still_race(tracker):
+    # each side holds A lock — just not the SAME lock: still a race,
+    # and the report shows both locksets for the postmortem
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+
+    def w1():
+        with s._lock:
+            s.x = 1
+
+    def w2():
+        with s._lock_b:
+            s.x = 2
+
+    _run_seeded(tracker, w1, w2)
+    tracker.disarm()
+    reports = tracker.unwaived_reports()
+    assert reports
+    assert reports[0].prior.locks == ("Shared._lock",)
+    assert reports[0].current.locks == ("Shared._lock_b",)
+
+
+def test_probe_covers_container_state(tracker):
+    # dict-entry mutations are invisible to attribute probes; the
+    # explicit probe() hook (faults.hit analog) covers them
+    table = {"n": 0}
+    holder = object()
+    tracker.arm()
+
+    def w1():
+        tracker.probe(holder, "n", write=True, name="Table")
+        table["n"] += 1
+
+    def w2():
+        tracker.probe(holder, "n", write=True, name="Table")
+        table["n"] += 1
+
+    _run_seeded(tracker, w1, w2)
+    tracker.disarm()
+    assert any(r.field == "Table.n" for r in tracker.unwaived_reports())
+
+
+# -- disciplined patterns must stay silent ----------------------------------
+
+def test_common_lock_serializes(tracker):
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+
+    def w1():
+        with s._lock:
+            s.x = 1
+
+    def w2():
+        with s._lock:
+            s.x = 2
+
+    _run_seeded(tracker, w1, w2)
+    tracker.disarm()
+    assert not tracker.unwaived_reports(), [
+        r.render() for r in tracker.unwaived_reports()
+    ]
+
+
+def test_executor_handoff_has_happens_before(tracker):
+    # loop-style handoff: owner writes, submits work that writes, takes
+    # the result, writes again — submit->run and done->result edges
+    # order every pair, so zero reports
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+    s.x = 1
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(lambda: setattr(s, "x", 2))
+        fut.result(5)
+        s.x = 3
+        fut = pool.submit(lambda: setattr(s, "x", 4))
+        fut.result(5)
+    tracker.disarm()
+    assert not tracker.unwaived_reports(), [
+        r.render() for r in tracker.unwaived_reports()
+    ]
+
+
+def test_sibling_executor_tasks_do_race(tracker):
+    # ...but two tasks forked from the SAME parent state are unordered
+    # with each other: the fork edge covers parent->child only
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+    started = threading.Event()
+    gate = threading.Event()
+
+    def w1():
+        # wait until w2 occupies the other worker, so the two writes
+        # deterministically land on DISTINCT pool threads
+        assert started.wait(5)
+        s.x = 1
+        gate.set()
+
+    def w2():
+        started.set()
+        assert gate.wait(5)
+        s.x = 2
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        f1 = pool.submit(w1)
+        f2 = pool.submit(w2)
+        f1.result(5)
+        f2.result(5)
+    tracker.disarm()
+    assert tracker.unwaived_reports()
+
+
+def test_lock_release_acquire_edge(tracker):
+    # release->acquire publishes the releaser's clock: a field written
+    # under the lock ONCE and then read outside it later by the other
+    # thread is still ordered through the critical-section handoff
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.arm()
+    ready = threading.Event()
+
+    def writer():
+        with s._lock:
+            s.x = 1
+        ready.set()
+
+    def reader():
+        assert ready.wait(5)
+        with s._lock:
+            pass  # sync point: merges the writer's published clock
+        _ = s.x  # unlocked read, but ordered through the lock edge
+
+    _run_seeded(tracker, writer, reader)
+    tracker.disarm()
+    assert not tracker.unwaived_reports(), [
+        r.render() for r in tracker.unwaived_reports()
+    ]
+
+
+def test_waiver_suppresses_known_benign(tracker):
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    tracker.waive("Shared.x")
+    tracker.arm()
+    _run_seeded(tracker, lambda: setattr(s, "x", 1),
+                lambda: setattr(s, "x", 2))
+    tracker.disarm()
+    assert not tracker.unwaived_reports()
+    assert tracker.reports  # recorded, just waived
+
+
+def test_disarmed_is_inert_and_metrics_flow(tracker):
+    m = Metrics()
+    s = Shared()
+    tracker.watch(s, fields=["x"])
+    # disarmed: the class is untouched and probe() is a no-op
+    assert type(s) is Shared
+    tracker.probe(s, "x")
+    assert not tracker.reports
+    tracker.arm(metrics=m)
+    assert type(s) is not Shared
+    _run_seeded(tracker, lambda: setattr(s, "x", 1),
+                lambda: setattr(s, "x", 2))
+    tracker.disarm()
+    assert type(s) is Shared  # restored
+    assert m.get("racetrack.events") >= 2
+    assert m.get("race.reports") >= 1
+
+
+# -- the regression the tentpole exists for ---------------------------------
+
+class OldExhookBreaker:
+    """Replica of ExhookServer's PRE-PR-8 breaker accounting: unlocked
+    `+=` on the consecutive-failure counter from concurrent worker
+    lanes. Kept as a fixture so the harness provably catches the exact
+    bug class the real class was fixed for."""
+
+    def __init__(self, threshold=3):
+        self._consec_failures = 0
+        self._broken_until = 0.0
+        self._threshold = threshold
+
+    def fail(self):
+        self._consec_failures += 1
+        if self._consec_failures >= self._threshold:
+            self._broken_until = time.monotonic() + 5.0
+
+
+def test_old_exhook_breaker_pattern_is_detected(tracker):
+    br = OldExhookBreaker()
+    tracker.watch(br, fields=["_consec_failures", "_broken_until"])
+    tracker.arm()
+    _run_seeded(tracker, br.fail, br.fail)
+    tracker.disarm()
+    assert any(
+        r.field == "OldExhookBreaker._consec_failures"
+        for r in tracker.unwaived_reports()
+    ), "the unguarded breaker increment must be reported"
+
+
+def test_cluster_pool_leave_handoff_is_clean(tracker):
+    """PR 8 fix: leave() used to None out the repl/fwd pool references
+    from the rolling-upgrade drain (default executor) while loop-side
+    replication raced its `is not None` check into `.submit` — a torn
+    None dereference. The references are construction-only now (this
+    test fails its `is not None` assert on the old code), shutdown state
+    lives inside the executors, and a post-shutdown submit is swallowed
+    by `_pool_submit`."""
+    from emqx_tpu.cluster.node import ClusterNode
+    from emqx_tpu.cluster.transport import LocalBus
+
+    class _Loop:  # app-mode marker; never actually run
+        def is_closed(self):
+            return False
+
+    node = ClusterNode("rt@x", LocalBus(), loop=_Loop())
+    tracker.watch(
+        node, fields=["_repl_pool", "_fwd_pool"], name="ClusterNode"
+    )
+    tracker.arm()
+    with ThreadPoolExecutor(max_workers=1) as drain:
+        drain.submit(node.leave).result(5)
+    # replication racing (or trailing) the drain: dropped, never a crash
+    node._pool_submit(node._repl_pool, lambda: None)
+    tracker.disarm()
+    assert node._repl_pool is not None and node._fwd_pool is not None
+    assert not tracker.unwaived_reports(), [
+        r.render() for r in tracker.unwaived_reports()
+    ]
+
+
+def test_fixed_exhook_breaker_is_clean(tracker):
+    # the real (fixed) ExhookServer: breaker mutations under _state_lock
+    # from concurrent valued-lane workers -> zero reports
+    grpc = pytest.importorskip("grpc")  # noqa: F841 — channel ctor only
+    from emqx_tpu.exhook.manager import ExhookServer
+
+    s = ExhookServer("rt", "127.0.0.1:1", timeout=0.05,
+                     breaker_threshold=100)
+    tracker.watch(
+        s, fields=["_consec_failures", "_broken_until"], name="ExhookServer"
+    )
+    tracker.arm()
+    gate = threading.Event()
+
+    def call_once():
+        gate.wait(5)
+        # unreachable sidecar: every call takes the failure arm, which
+        # is exactly the breaker-mutating path
+        s.call("OnProviderLoaded", None, "client.connect")
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(call_once) for _ in range(8)]
+        gate.set()
+        for f in futs:
+            f.result(10)
+    tracker.disarm()
+    assert not tracker.unwaived_reports(), [
+        r.render() for r in tracker.unwaived_reports()
+    ]
+    with s._state_lock:
+        assert s._consec_failures == 8  # no lost increments either
+    s.unload()

@@ -141,6 +141,7 @@ def test_metric_checker_flags_undeclared_series():
         "trace.spans.samplid", "device.compile.cout",
         "router.sync.skiped", "ingest.device.idle.secondz",
         "retained.storm.fuzed", "olp.lag_mz", "olp.tripz",
+        "racetrack.eventz", "race.reportz",
     }
 
 
@@ -270,6 +271,45 @@ def test_metric_checker_sees_the_hot_path_call_sites():
         "dispatch.readback.bytes",
     ):
         assert expected in names, expected
+
+
+# -- cross-context escapes --------------------------------------------------
+
+def test_cx_checker_flags_cross_context_mutations():
+    report = run_fixtures(["cx"])
+    bad = {
+        (f.code, f.symbol, f.detail)
+        for f in report.findings
+        if f.path.endswith("cx_bad.py")
+    }
+    # two writer contexts (loop + pool)
+    assert ("CX001", "SharedState.cx_bump", "counter") in bad
+    # written on the loop, read from the pool
+    assert ("CX001", "SharedState.tick", "flights") in bad
+    # raw threading.Thread(target=...) root
+    assert ("CX001", "ThreadShared.cx_reader_loop", "tally") in bad
+    # stale single-writer: a pool method writes the loop-declared field
+    assert ("CX002", "SharedState.cx_bump", "stamp->loop") in bad
+    # single-writer naming a context no root creates
+    assert ("CX002", "SharedState", "mode->warp-core") in bad
+    assert len(bad) == 5, sorted(bad)
+
+
+def test_cx_checker_accepts_guarded_single_writer_and_waived():
+    report = run_fixtures(["cx"])
+    good = [f for f in report.findings if f.path.endswith("cx_good.py")]
+    # GUARDED_BY attr, a correct `# single-writer: loop`, and the
+    # inline-waived tombstone flag all stay silent
+    assert not good, [f.render() for f in good]
+    assert report.suppressed >= 1  # the WaivedShared waiver was counted
+
+
+def test_cx_repo_runs_clean():
+    # the rig the segmented-table refactor will be developed under:
+    # every cross-context mutable field in emqx_tpu/ is locked, declared
+    # single-writer, or explicitly waived — non-baseline zero
+    report = run_analysis(ROOT / "emqx_tpu", checks=["cx"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
 
 
 # -- scoped runs + parse parallelism ----------------------------------------

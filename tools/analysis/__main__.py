@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--checks", default=None,
         help="comma-separated subset of checks to run "
              "(lock,async,jit,config,metrics,shard,transfer,retrace,"
-             "fault)",
+             "fault,cx)",
     )
     p.add_argument(
         "--changed-only", action="store_true",

@@ -1,0 +1,247 @@
+"""CX: concurrency discipline — cross-context escape analysis.
+
+PRs 6–7 made the hot path genuinely concurrent: the `tpu-dispatch`
+executor overlaps device launches with the event loop, cluster sender
+threads and exhook pools mutate breaker state, the bus reader threads
+feed reply events. Every one of those threads shares objects with the
+loop, and the lock checker (LK) only sees attributes someone *already*
+annotated. This checker closes the gap from the other side: it computes
+which execution contexts each method can run under (tools/analysis/
+contexts.py — loop, named pools, raw threads) and flags object fields
+that are **mutated** while **reachable from more than one context**
+without a declared discipline.
+
+A flagged field has three legal states:
+
+- lock-guarded — add it to `GUARDED_BY` / a trailing `# guarded-by:`
+  comment (the LK checker then enforces every access);
+- single-writer — a trailing `# single-writer: <context>` on an
+  assignment line (or a class-level `SINGLE_WRITER = {"attr": "ctx"}`)
+  declares that exactly one context ever writes it and every other
+  context only reads GIL-atomic snapshots (the publication pattern:
+  DeviceRouter's prepare cache, TcpBus._handler);
+- waived — `# lint: disable=CX001` with a justification, or a baseline
+  entry (deliberate racy flags like a monotonic `alive` tombstone).
+
+  CX001  field mutated while reachable from >= 2 execution contexts,
+         with no guard, single-writer declaration, or waiver
+  CX002  stale `# single-writer:` declaration — a *known* context other
+         than the declared one writes the field, or the declared
+         context name matches no context root discovered in the tree
+         (the way HT002 catches a `# readback-site` that rotted)
+
+The analysis is deliberately conservative where the context map is
+blind: a method no context root reaches contributes nothing, so a
+library class never used from two contexts stays silent even if it
+*could* race in some other program.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from tools.analysis.callgraph import ProjectGraph, module_dotted
+from tools.analysis.checkers.lock_discipline import guarded_attrs
+from tools.analysis.contexts import ContextMap
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+_SINGLE_RE = re.compile(r"#\s*single-writer:\s*([\w.\-*:]+)")
+
+
+def _self_attr(node: ast.AST) -> str:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def single_writer_attrs(mod: ParsedModule,
+                        cls: ast.ClassDef) -> Dict[str, Tuple[str, int]]:
+    """attr -> (declared context, lineno), from trailing comments on
+    self.X assignments and the class-level SINGLE_WRITER dict."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "SINGLE_WRITER"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            for k, v in zip(node.value.keys, node.value.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    out[k.value] = (v.value, node.lineno)
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            m = _SINGLE_RE.search(mod.line_text(node.lineno))
+            if m:
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        out[attr] = (m.group(1), node.lineno)
+    return out
+
+
+def _ctx_matches(ctx: str, declared: str) -> bool:
+    """`repl-*` style pool families match by prefix, both ways."""
+    if ctx == declared:
+        return True
+    if declared.endswith("*") and ctx.startswith(declared[:-1]):
+        return True
+    if ctx.endswith("*") and declared.startswith(ctx[:-1]):
+        return True
+    return False
+
+
+class _Access:
+    __slots__ = ("line", "symbol", "ctxs", "write")
+
+    def __init__(self, line: int, symbol: str, ctxs: Set[str], write: bool):
+        self.line = line
+        self.symbol = symbol
+        self.ctxs = ctxs
+        self.write = write
+
+
+class CrossContextChecker(Checker):
+    name = "cx"
+    codes = {
+        "CX001": "field mutated while reachable from >=2 execution "
+                 "contexts without guard/single-writer/waiver",
+        "CX002": "stale or unknown `# single-writer:` declaration",
+    }
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._graph = ProjectGraph(modules)
+        self._cmap = ContextMap(self._graph)
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        dn = module_dotted(mod.rel)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(mod, dn, node))
+        return findings
+
+    # -- per class ---------------------------------------------------------
+    def _method_accesses(self, dn: str,
+                         cls: ast.ClassDef) -> Dict[str, List[_Access]]:
+        """attr -> accesses with the contexts of the enclosing method."""
+        cmap = self._cmap
+        out: Dict[str, List[_Access]] = {}
+        for item in cls.body:
+            if not isinstance(
+                item, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if item.name == "__init__":
+                continue  # the object is not shared mid-construction
+            ctxs = set(cmap.contexts((dn, item.name)))
+            if not ctxs:
+                continue  # no root reaches it: nothing to judge
+            symbol = f"{cls.name}.{item.name}"
+
+            def visit(n: ast.AST) -> None:
+                for child in ast.iter_child_nodes(n):
+                    attr = _self_attr(child)
+                    if attr:
+                        write = isinstance(
+                            child.ctx, (ast.Store, ast.Del)
+                        ) if hasattr(child, "ctx") else False
+                        out.setdefault(attr, []).append(
+                            _Access(child.lineno, symbol, ctxs, write)
+                        )
+                    visit(child)
+
+            visit(item)
+            # an AugAssign store is also a read-modify-write; ast marks
+            # the target Store, which we already record as a write
+        return out
+
+    def _check_class(self, mod: ParsedModule, dn: str,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        accesses = self._method_accesses(dn, cls)
+        if not accesses:
+            return ()
+        guarded = guarded_attrs(mod, cls)
+        declared_sw = single_writer_attrs(mod, cls)
+        findings: List[Finding] = []
+        for attr, accs in sorted(accesses.items()):
+            writes = [a for a in accs if a.write]
+            write_ctxs: Set[str] = set()
+            for a in writes:
+                write_ctxs |= a.ctxs
+            all_ctxs: Set[str] = set()
+            for a in accs:
+                all_ctxs |= a.ctxs
+            if attr in declared_sw:
+                decl, line = declared_sw[attr]
+                if not self._cmap.known_context(decl):
+                    findings.append(Finding(
+                        code="CX002",
+                        path=mod.rel,
+                        line=line,
+                        symbol=cls.name,
+                        detail=f"{attr}->{decl}",
+                        message=(
+                            f"`# single-writer: {decl}` on {attr!r} names "
+                            "a context no root in this tree creates "
+                            "(typo, or the pool was renamed)"
+                        ),
+                    ))
+                    continue
+                stray = sorted(
+                    c for c in write_ctxs if not _ctx_matches(c, decl)
+                )
+                if stray:
+                    w = next(
+                        a for a in writes
+                        if any(not _ctx_matches(c, decl) for c in a.ctxs)
+                    )
+                    findings.append(Finding(
+                        code="CX002",
+                        path=mod.rel,
+                        line=w.line,
+                        symbol=w.symbol,
+                        detail=f"{attr}->{decl}",
+                        message=(
+                            f"stale `# single-writer: {decl}`: {attr!r} "
+                            f"is also written from context(s) "
+                            f"{', '.join(stray)}"
+                        ),
+                    ))
+                continue
+            if attr in guarded:
+                continue  # the LK checker owns its discipline
+            if not writes or len(all_ctxs) < 2:
+                continue
+            w = writes[0]
+            findings.append(Finding(
+                code="CX001",
+                path=mod.rel,
+                line=w.line,
+                symbol=w.symbol,
+                detail=attr,
+                message=(
+                    f"self.{attr} is mutated while reachable from "
+                    f"contexts [{', '.join(sorted(all_ctxs))}] with no "
+                    "`# guarded-by:`/GUARDED_BY, `# single-writer:` "
+                    "declaration, or waiver"
+                ),
+            ))
+        return findings

@@ -39,6 +39,47 @@ def _self_attr(node: ast.AST) -> str:
     return ""
 
 
+def guarded_attrs(mod: ParsedModule, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr -> lock name, from comments and GUARDED_BY.
+
+    Shared with the CX checker: a lock-guarded attribute is exempt from
+    cross-context escape findings because THIS checker enforces its
+    discipline."""
+    guarded: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        # GUARDED_BY = {"attr": "lock"} at class level
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "GUARDED_BY"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            for k, v in zip(node.value.keys, node.value.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    guarded[k.value] = v.value
+        # trailing `# guarded-by: <lock>` on a self.X assignment
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            m = _GUARDED_RE.search(mod.line_text(node.lineno))
+            if m:
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        guarded[attr] = m.group(1)
+    return guarded
+
+
 class LockDisciplineChecker(Checker):
     name = "lock"
     codes = {
@@ -57,40 +98,7 @@ class LockDisciplineChecker(Checker):
     # -- per class ---------------------------------------------------------
     def _guarded_attrs(self, mod: ParsedModule,
                        cls: ast.ClassDef) -> Dict[str, str]:
-        """attr -> lock name, from comments and GUARDED_BY."""
-        guarded: Dict[str, str] = {}
-        for node in ast.walk(cls):
-            # GUARDED_BY = {"attr": "lock"} at class level
-            if (
-                isinstance(node, ast.Assign)
-                and any(
-                    isinstance(t, ast.Name) and t.id == "GUARDED_BY"
-                    for t in node.targets
-                )
-                and isinstance(node.value, ast.Dict)
-            ):
-                for k, v in zip(node.value.keys, node.value.values):
-                    if (
-                        isinstance(k, ast.Constant)
-                        and isinstance(k.value, str)
-                        and isinstance(v, ast.Constant)
-                        and isinstance(v.value, str)
-                    ):
-                        guarded[k.value] = v.value
-            # trailing `# guarded-by: <lock>` on a self.X assignment
-            if isinstance(node, (ast.Assign, ast.AnnAssign)):
-                m = _GUARDED_RE.search(mod.line_text(node.lineno))
-                if m:
-                    targets = (
-                        node.targets
-                        if isinstance(node, ast.Assign)
-                        else [node.target]
-                    )
-                    for t in targets:
-                        attr = _self_attr(t)
-                        if attr:
-                            guarded[attr] = m.group(1)
-        return guarded
+        return guarded_attrs(mod, cls)
 
     def _check_class(self, mod: ParsedModule,
                      cls: ast.ClassDef) -> Iterable[Finding]:

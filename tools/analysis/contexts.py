@@ -1,0 +1,347 @@
+"""Execution-context map: which thread does each function run on?
+
+The serving pipeline is a mixed asyncio/thread system — one event loop
+plus a zoo of named pools (`tpu-dispatch`, the exhook notify/valued
+lanes, the `repl-*`/`fwd-*` cluster executors) and raw
+`threading.Thread` workers (cluster bus reader/acceptor, transport
+fabric). The CX checker needs to know, for every function, the set of
+execution contexts it can run under, so it can flag object fields
+mutated from more than one.
+
+The map is built from a registry of *context roots* discovered
+syntactically:
+
+- every ``async def`` runs on the event loop -> context ``"loop"``
+  (module-level code and the sync call tree under coroutines rides the
+  same thread);
+- ``loop.run_in_executor(EXEC, fn, ...)`` and ``EXEC.submit(fn, ...)``
+  make ``fn`` (and its call tree) run in EXEC's context. EXEC resolves
+  to a *named* context through the pool table: every
+  ``ThreadPoolExecutor(..., thread_name_prefix=...)`` assignment in the
+  tree names the pool held by that variable/attribute, and a call like
+  ``dispatch_pool()`` resolves through the function's body to the pool
+  it creates. ``None`` is the asyncio default executor;
+- ``threading.Thread(target=fn, ...)`` roots ``fn`` in a context named
+  by the ``name=`` kwarg or the target function;
+- ``fut.add_done_callback(cb)`` roots ``cb`` in the pool context when
+  ``fut`` came from ``pool.submit(...)`` in the same function
+  (concurrent.futures runs callbacks on the worker), and on the loop
+  otherwise (asyncio futures run callbacks via call_soon).
+
+Reachability follows the shared project call graph. Two deliberate
+over/under-approximations, both inherited from callgraph.py's bias:
+``self.method`` resolves by bare name within the module (methods of
+sibling classes may merge), and a method reference through an arbitrary
+variable (``dev.route_prepared``) falls back to a project-wide
+bare-name lookup only when the name is rare (<= 3 definitions) — common
+names (`get`, `close`) would otherwise wire the whole tree together.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.callgraph import FuncKey, ProjectGraph
+
+LOOP = "loop"
+DEFAULT_EXECUTOR = "default-executor"
+
+def _const_prefix(node: ast.AST) -> Optional[str]:
+    """Literal (or leading-literal, for f-strings) text of a name expr."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value.rstrip("-_") + "-*"
+    return None
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """'x' for `x = ...`, '_pool' for `self._pool = ...`."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class ContextMap:
+    """contexts(key) -> the set of execution-context names a function
+    (keyed like the project graph: (module, bare name)) may run under."""
+
+    def __init__(self, graph: ProjectGraph):
+        self.graph = graph
+        # pool variable/attribute name -> context name
+        self.pools: Dict[str, str] = {}
+        # functions whose body creates-and-returns/assigns a named pool
+        self._pool_factories: Dict[FuncKey, str] = {}
+        self.context_names: Set[str] = {LOOP, DEFAULT_EXECUTOR}
+        self._collect_pools()
+        # context -> root function keys
+        self.roots: Dict[str, Set[FuncKey]] = {}
+        self._collect_roots()
+        self._ctx: Dict[FuncKey, Set[str]] = {}
+        self._propagate()
+
+    # -- pool discovery -----------------------------------------------------
+    def _pool_ctor_name(self, dn: str, call: ast.Call) -> Optional[str]:
+        """Context name when `call` is ThreadPoolExecutor(...)."""
+        name = self.graph.call_name(dn, call.func)
+        if name.rpartition(".")[2] != "ThreadPoolExecutor":
+            return None
+        for kw in call.keywords:
+            if kw.arg == "thread_name_prefix":
+                got = _const_prefix(kw.value)
+                if got:
+                    return got
+        return "executor"
+
+    def _collect_pools(self) -> None:
+        g = self.graph
+        for dn, mod in g.mods.items():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                ctor = None
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Call):
+                        ctor = self._pool_ctor_name(dn, sub)
+                        if ctor:
+                            break
+                if not ctor:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    tn = _target_name(t)
+                    if tn:
+                        self.pools[tn] = ctor
+                        self.context_names.add(ctor)
+        # functions that build a named pool anywhere in their body are
+        # pool factories: `dispatch_pool()` resolves to "tpu-dispatch"
+        for info in g.infos:
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    ctor = self._pool_ctor_name(info.dn, node)
+                    if ctor:
+                        self._pool_factories[info.key] = ctor
+                        self.context_names.add(ctor)
+                        break
+
+    def _executor_context(self, dn: str, node: ast.AST) -> str:
+        """Context name of an executor expression at a submit site."""
+        if isinstance(node, ast.Constant) and node.value is None:
+            return DEFAULT_EXECUTOR
+        tn = _target_name(node)
+        if tn and tn in self.pools:
+            return self.pools[tn]
+        if isinstance(node, ast.Call):
+            for key in self.graph.ref_targets(dn, node.func):
+                if key in self._pool_factories:
+                    return self._pool_factories[key]
+        if tn:
+            return f"executor:{tn}"
+        return "executor"
+
+    # -- root discovery -----------------------------------------------------
+    def _fn_keys(self, dn: str, node: ast.AST) -> List[FuncKey]:
+        """Function-reference -> keys; unique-name fallback for
+        `obj.meth` references the alias table cannot see. Ambiguous
+        names (a stdlib `t.join` shadowing three project `join`s) stay
+        unresolved — a wrong root poisons every context downstream."""
+        keys = [
+            k for k in self.graph.ref_targets(dn, node)
+            if k in self.graph.funcs
+        ]
+        if keys:
+            return keys
+        if isinstance(node, ast.Attribute):
+            hits = [
+                k for k in self.graph.funcs if k[1] == node.attr
+            ]
+            if len(hits) == 1 and len(self.graph.funcs[hits[0]]) == 1:
+                return hits
+        return []
+
+    def _add_root(self, ctx: str, keys: Sequence[FuncKey]) -> None:
+        if not keys:
+            return
+        self.context_names.add(ctx)
+        self.roots.setdefault(ctx, set()).update(keys)
+
+    def _collect_roots(self) -> None:
+        g = self.graph
+        for info in g.infos:
+            if isinstance(info.node, ast.AsyncFunctionDef):
+                self._add_root(LOOP, [info.key])
+        for dn, mod in g.mods.items():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr == "run_in_executor" and len(node.args) >= 2:
+                    ctx = self._executor_context(dn, node.args[0])
+                    self._add_root(ctx, self._fn_keys(dn, node.args[1]))
+                elif func.attr == "submit" and node.args:
+                    tn = _target_name(func.value)
+                    if tn in self.pools:
+                        self._add_root(
+                            self.pools[tn],
+                            self._fn_keys(dn, node.args[0]),
+                        )
+                    elif isinstance(func.value, ast.Call):
+                        ctx = self._executor_context(dn, func.value)
+                        if ctx not in ("executor",):
+                            self._add_root(
+                                ctx, self._fn_keys(dn, node.args[0])
+                            )
+                elif func.attr in (
+                    "call_soon", "call_later", "call_soon_threadsafe",
+                    "call_at",
+                ):
+                    # scheduled callbacks run on the event loop
+                    for arg in node.args:
+                        if isinstance(arg, (ast.Name, ast.Attribute)):
+                            got = self._fn_keys(dn, arg)
+                            if got:
+                                self._add_root(LOOP, got)
+                                break
+                else:
+                    name = g.call_name(dn, func)
+                    if name.rpartition(".")[2] == "Thread" or (
+                        isinstance(func, ast.Attribute)
+                        and func.attr == "Thread"
+                    ):
+                        self._thread_root(dn, node)
+            # add_done_callback: pool future -> worker context,
+            # asyncio future -> loop. Decided per enclosing function.
+        for info in g.infos:
+            self._done_callback_roots(info.dn, info.node)
+
+    def _thread_root(self, dn: str, call: ast.Call) -> None:
+        target = None
+        ctx = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "name":
+                got = _const_prefix(kw.value)
+                if got:
+                    ctx = got
+        if target is None:
+            return
+        keys = self._fn_keys(dn, target)
+        if not keys:
+            return
+        if ctx is None:
+            ctx = f"thread:{keys[0][1]}"
+        self._add_root(ctx, keys)
+
+    def _done_callback_roots(self, dn: str, fn: ast.AST) -> None:
+        """`fut.add_done_callback(cb)`: cb's context depends on where
+        `fut` came from, tracked locally within this one function."""
+        pool_futs: Set[str] = set()  # names assigned from pool.submit
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                vf = node.value.func
+                if (
+                    isinstance(vf, ast.Attribute)
+                    and vf.attr == "submit"
+                    and _target_name(vf.value) in self.pools
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            pool_futs.add((t.id, self.pools[
+                                _target_name(vf.value)]))
+        pool_by_name = dict(pool_futs)
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_done_callback"
+                and node.args
+            ):
+                cb_keys = self._fn_keys(dn, node.args[0])
+                if not cb_keys:
+                    continue
+                holder = _target_name(node.func.value)
+                ctx = pool_by_name.get(holder, LOOP)
+                self._add_root(ctx, cb_keys)
+
+    # -- propagation --------------------------------------------------------
+    def _call_edges(self, dn: str, fn: ast.AST) -> List[FuncKey]:
+        """graph.call_edges plus a unique-name fallback: a method call
+        through an arbitrary receiver (`self.bus.send(...)`,
+        `dev.route_prepared(...)`) resolves by bare name when exactly
+        one function in the whole tree has that name — any ambiguity
+        (`.inc()`, `.close()`) stays unresolved rather than wiring
+        unrelated classes into every context."""
+        g = self.graph
+        out: List[FuncKey] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            for ref in [node.func] + [
+                a for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+                if isinstance(a, (ast.Name, ast.Attribute))
+            ]:
+                keys = [
+                    k for k in g.ref_targets(dn, ref) if k in g.funcs
+                ]
+                if not keys and isinstance(ref, ast.Attribute):
+                    hits = [k for k in g.funcs if k[1] == ref.attr]
+                    if len(hits) == 1 and len(g.funcs[hits[0]]) == 1:
+                        keys = hits
+                out.extend(keys)
+        return out
+
+    def _propagate(self) -> None:
+        g = self.graph
+        edges_cache: Dict[FuncKey, List[FuncKey]] = {}
+
+        def edges(key: FuncKey) -> List[FuncKey]:
+            got = edges_cache.get(key)
+            if got is None:
+                got = []
+                for info in g.funcs.get(key, []):
+                    got.extend(self._call_edges(info.dn, info.node))
+                edges_cache[key] = got
+            return got
+
+        for ctx, roots in self.roots.items():
+            seen: Set[FuncKey] = set()
+            work = list(roots)
+            while work:
+                key = work.pop()
+                if key in seen:
+                    continue
+                seen.add(key)
+                self._ctx.setdefault(key, set()).add(ctx)
+                work.extend(edges(key))
+
+    # -- queries ------------------------------------------------------------
+    def contexts(self, key: FuncKey) -> Set[str]:
+        return self._ctx.get(key, set())
+
+    def known_context(self, name: str) -> bool:
+        """Is `name` a context this tree could discover? Glob-suffixed
+        pool families (`repl-*`) match their prefix."""
+        if name in self.context_names:
+            return True
+        for ctx in self.context_names:
+            if ctx.endswith("*") and name.startswith(ctx[:-1]):
+                return True
+        return False

@@ -269,6 +269,7 @@ def parse_modules(root: Path, jobs: int = 0) -> List[ParsedModule]:
 def default_checkers() -> List[Checker]:
     from tools.analysis.checkers.async_blocking import AsyncBlockingChecker
     from tools.analysis.checkers.config_keys import ConfigKeyChecker
+    from tools.analysis.checkers.cross_context import CrossContextChecker
     from tools.analysis.checkers.fault_contracts import FaultContractChecker
     from tools.analysis.checkers.host_transfer import HostTransferChecker
     from tools.analysis.checkers.jit_purity import JitPurityChecker
@@ -287,6 +288,7 @@ def default_checkers() -> List[Checker]:
         HostTransferChecker(),
         RetraceChecker(),
         FaultContractChecker(),
+        CrossContextChecker(),
     ]
 
 

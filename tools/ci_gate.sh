@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# ci_gate.sh — THE single pre-merge command (docs/concurrency.md,
+# docs/static_analysis.md). Four gates, in the order that fails fastest:
+#
+#   1. tpu_lint, all checkers            (pure AST, ~8 s)
+#   2. the device-contract audit          (jaxpr tracing on CPU)
+#   3. tier-1 pytest                      (`-m "not slow"`; the race-marked
+#      racetrack suite is part of tier-1 and runs with the detector armed)
+#   4. the race suite alone, verbose      (`-m race`) — redundant with (3)
+#      but isolates the concurrency rig's verdict in its own section of
+#      the log, so a race report is never buried in a 500-test dot wall
+#
+# Fast mode for the inner loop (pre-push, not pre-merge):
+#
+#   tools/ci_gate.sh --fast     # lint scoped to git-touched files
+#                               # (--changed-only --jobs 8) + race suite
+#
+# Exit non-zero on the first failing gate.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+FAST=0
+for arg in "$@"; do
+    case "$arg" in
+        --fast) FAST=1 ;;
+        *) echo "usage: tools/ci_gate.sh [--fast]" >&2; exit 2 ;;
+    esac
+done
+
+banner() { printf '\n== %s ==\n' "$*"; }
+
+if [ "$FAST" = 1 ]; then
+    banner "tpu_lint (changed files)"
+    python -m tools.analysis --changed-only --jobs 8
+    banner "race suite (racetrack armed)"
+    python -m pytest tests/ -q -m race -p no:cacheprovider
+    exit 0
+fi
+
+banner "tpu_lint (all checkers)"
+python -m tools.analysis --jobs 8
+
+banner "device-contract audit"
+python -m tools.analysis --contracts
+
+banner "tier-1 tests"
+python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider
+
+banner "race suite (racetrack armed)"
+python -m pytest tests/ -m race -p no:cacheprovider
+
+banner "ci_gate: all gates green"
