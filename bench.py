@@ -794,7 +794,8 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
 # single-shape tables absorb the squeeze
 CONFIGS = [
     "mixed_10m",
-    "serving",  # e2e_serving + serving_dispatch, ONE process (headline)
+    "serving",  # e2e_serving + serving_dispatch (headline)
+    "churn_storm",  # O(delta) update path at 10M subs (ROADMAP item 2)
     "share_10m",
     "retained_5m",
     "mixed_1m",
@@ -813,6 +814,7 @@ EXTRAS = ["retained_spot", "chaos_soak"]
 MIN_BUDGET_S = {
     "mixed_10m": 300,
     "serving": 280,  # e2e (2 points) + serving_dispatch, one process
+    "churn_storm": 240,  # 10M cold build + churn/visibility phases
     "share_10m": 120,
     "retained_5m": 110,
     "mixed_1m": 60,
@@ -1692,6 +1694,201 @@ def bench_chaos_soak() -> dict:
     return asyncio.run(run())
 
 
+def bench_churn_storm(rng, deadline: Optional[float] = None) -> dict:
+    """`churn_storm` config (ROADMAP item 2): million-user churn against
+    a 10M-subscription table on the SEGMENTED update path.
+
+    Three phases, all against one live index + one DeviceSegmentManager:
+
+    1. mass reconnect — waves of fresh subscribes absorbed by the shape
+       hot segment (warm `bulk_add`: vectorized placement, no packed
+       rebuild) and synced to the device per wave; reports
+       `churn_inserts_per_s` (target > 1M/s);
+    2. subscribe visibility — single subscribe -> delta sync -> a routed
+       batch that provably matches it; reports the median + p99 wall
+       (`subscribe_visibility_ms`, target < 10ms). This is the window a
+       reconnecting client cannot receive messages;
+    3. churn correctness under compaction — unsubscribe/resubscribe a
+       slab, run a background-style compaction cycle mid-churn, and
+       assert the device agrees with `T.match` on probe topics.
+
+    CPU-backend numbers are a proxy for tunnel-attached dev chips (the
+    scatter is one launch either way; on a tunnel the old path paid one
+    RTT per touched array plus periodic O(table) rebuild+reupload).
+    """
+    import time as _t
+
+    from emqx_tpu.models.router_model import shape_route_step
+    from emqx_tpu.ops import topics as T
+    from emqx_tpu.ops.route_index import RouteIndex
+    from emqx_tpu.ops.segments import (
+        DeviceSegmentManager,
+        SegmentCompactor,
+        ShapeSegmentOwner,
+    )
+    from emqx_tpu.ops.tokenizer import encode_topics
+
+    N = int(os.environ.get("BENCH_CHURN_N", 10_000_000))
+    WAVES = 12
+    # a network-blip reconnect storm is ~all EXISTING subscriptions
+    # re-attaching; genuinely new filters are the small tail
+    RESUB = 131072  # reconnecting clients re-subscribing EXISTING filters
+    FRESH = 2048  # genuinely new filters per wave (the hot-segment path)
+
+    _mark(f"churn_storm: cold-building {N} subscriptions")
+    filters = [
+        f"dev/{i}/+/t{i % 7}/#" if i % 3 else f"dev/{i}/s{i % 11}"
+        for i in range(N)
+    ]
+    index = RouteIndex()
+    t0 = _t.perf_counter()
+    index.bulk_add(filters)
+    build_s = _t.perf_counter() - t0
+    del filters
+    man = DeviceSegmentManager(free_retired=True)
+    t0 = _t.perf_counter()
+    tabs = man.sync(index.shapes)
+    upload_s = _t.perf_counter() - t0
+    _mark(
+        f"churn_storm: built in {build_s:.1f}s, uploaded in "
+        f"{upload_s:.1f}s; warming the probe program"
+    )
+
+    CFGS = dict(max_levels=8, frontier=16, max_matches=16, probes=8)
+    vb, vl, _ = encode_topics(["dev/churn0/q/t0/tail"] * 256, MAX_BYTES)
+
+    def vis_step(tabs_):
+        return shape_route_step(
+            tabs_, None, None, vb, vl,
+            m_active=index.shapes.m_active(),
+            with_nfa=False, salt=index.salt, **CFGS,
+        )
+
+    import jax
+
+    jax.block_until_ready(vis_step(tabs)["mcount"])
+
+    # -- phase 1: mass reconnect. A network-blip storm is mostly clients
+    # RE-subscribing filters the table already holds (refcount hits +
+    # bitmap writes) plus a tail of genuinely new filters (the hot-
+    # segment path). Waves are pre-built so the measured wall is the
+    # update path, not f-string workload generation.
+    _mark(
+        f"churn_storm: {WAVES} reconnect waves x "
+        f"({RESUB} resub + {FRESH} fresh)"
+    )
+    rng2 = np.random.default_rng(0xC4)
+    waves = []
+    for w in range(WAVES):
+        ids = rng2.integers(0, N, size=RESUB)
+        batch = [
+            f"dev/{i}/+/t{i % 7}/#" if i % 3 else f"dev/{i}/s{i % 11}"
+            for i in ids
+        ]
+        batch += [f"churn/{w}/{k}/+/x/#" for k in range(FRESH)]
+        waves.append(batch)
+    epoch0 = index.shapes.epoch
+    t0 = _t.perf_counter()
+    for batch in waves:
+        index.bulk_add(batch)
+        tabs = man.sync(index.shapes)
+    jax.block_until_ready(tabs["shape_hot"])
+    churn_s = _t.perf_counter() - t0
+    churn_rps = WAVES * (RESUB + FRESH) / churn_s
+    assert index.shapes.epoch == epoch0, (
+        "mass reconnect forced a packed rebuild — the hot segment "
+        "failed to absorb the storm"
+    )
+    # fresh-only component rate (the pure hot-segment insert path)
+    fresh_batch = [f"churnf/{k}/+/x/#" for k in range(FRESH)]
+    t0 = _t.perf_counter()
+    index.bulk_add(fresh_batch)
+    tabs = man.sync(index.shapes)
+    jax.block_until_ready(tabs["shape_hot"])
+    fresh_rps = FRESH / (_t.perf_counter() - t0)
+
+    # -- phase 2: subscribe -> routable visibility ----------------------
+    vis = []
+    for k in range(11):
+        f = f"dev/churn{k}/+/t0/#"
+        t1 = _t.perf_counter()
+        index.add(f)
+        out = vis_step(man.sync(index.shapes))
+        mc = int(np.asarray(out["mcount"])[0])
+        vis.append((_t.perf_counter() - t1) * 1e3)
+        if k == 0:
+            assert mc >= 1, "fresh subscription not visible to the kernel"
+    vis = np.array(vis[1:])  # wave 0 may pay one-off jit/bucket warmup
+    vis_ms = float(np.median(vis))
+
+    # -- phase 3: unsubscribe/resubscribe + compaction under churn ------
+    _mark("churn_storm: tombstone/resubscribe + background compaction")
+    for k in range(512):
+        index.remove(f"churn/0/{k}/+/x/#")
+    for k in range(0, 512, 2):
+        index.add(f"churn/0/{k}/+/x/#")
+    tombs = index.shapes.packed_tombstones
+    hot_before = index.shapes.hot_live
+    owner = ShapeSegmentOwner(index.shapes, man, hot_entries=1)
+    t0 = _t.perf_counter()
+    assert SegmentCompactor().compact_now(owner)
+    compact_s = _t.perf_counter() - t0
+    tabs = man.sync(index.shapes)  # adopts the offered packed buffer
+    probe = (
+        ["churn/0/1/q/x/deep", "churn/0/2/q/x/deep", "dev/5/q/t5/deep"]
+        * 86
+    )[:256]
+    pb, pl, _ = encode_topics(probe, MAX_BYTES)
+    out = shape_route_step(
+        tabs, None, None, pb, pl,
+        m_active=index.shapes.m_active(),
+        with_nfa=False, salt=index.salt, **CFGS,
+    )
+    mc = np.asarray(out["mcount"])[: len(probe)]
+    cands = [f"churn/0/{j}/+/x/#" for j in (1, 2)] + ["dev/5/+/t5/#"]
+    for i, t in enumerate(probe[:3]):
+        # rebuild-equivalence spot check: count LIVE filters matching
+        # (churn/0/1 was tombstoned and must stay dead; churn/0/2 was
+        # tombstoned then resubscribed and must match again)
+        want = sum(
+            1 for f in cands
+            if index.filter_id(f) is not None and T.match(t, f)
+        )
+        assert int(mc[i]) == want, (t, int(mc[i]), want)
+
+    return {
+        "subscriptions": len(index),
+        "table_build_s": round(build_s, 1),
+        "initial_upload_s": round(upload_s, 1),
+        "churn_inserts": WAVES * (RESUB + FRESH),
+        "churn_inserts_per_s": round(churn_rps, 1),
+        "fresh_inserts_per_s": round(fresh_rps, 1),
+        "churn_waves": WAVES,
+        "resub_per_wave": RESUB,
+        "fresh_per_wave": FRESH,
+        "subscribe_visibility_ms": round(vis_ms, 3),
+        "subscribe_visibility_p99_ms": round(
+            float(np.percentile(vis, 99)), 3
+        ),
+        "compact_s": round(compact_s, 2),
+        "compact_merged": hot_before,
+        "tombstones_purged": tombs,
+        "hot_fill_after_compact": index.shapes.hot_live,
+        "delta_launches": man.delta_launches,
+        "full_resyncs": man.full_resyncs,
+        "note": (
+            "mass reconnect + resubscribe against a 10M-sub table on the"
+            " segmented update path: subscribes land in the hot segment"
+            " (vectorized bulk placement, one small re-upload per wave),"
+            " unsubscribes tombstone in place, and compaction merges"
+            " hot->packed off the critical path (offered device buffer"
+            " adopted by the next sync). targets: >1M inserts/s, <10ms"
+            " subscribe->routable visibility, rebuild-equivalent"
+            " recipient sets (asserted)"
+        ),
+    }
+
+
 def hotpath_stats() -> None:
     """`--hotpath-stats`: drive a small in-process publish workload through
     the real ingest -> device-route -> dispatch pipeline, then print ONE
@@ -1843,6 +2040,31 @@ def hotpath_stats() -> None:
     asyncio.run(run())
 
 
+def _run_config(name: str, deadline: Optional[float] = None) -> dict:
+    """Run one named config in THIS process and return its result dict."""
+    known = CONFIGS + EXTRAS + ["e2e_serving", "serving_dispatch"]
+    rng = np.random.default_rng(42 + known.index(name))
+    if name == "retained_5m":
+        return bench_retained(rng)
+    if name == "retained_spot":
+        return bench_retained_spot()
+    if name == "chaos_soak":
+        return bench_chaos_soak()
+    if name == "churn_storm":
+        return bench_churn_storm(rng, deadline)
+    if name == "serving":
+        return bench_serving_suite(deadline)
+    if name == "e2e_serving":  # standalone debug entry
+        return bench_e2e(deadline)
+    if name == "serving_dispatch":  # standalone debug entry
+        return bench_serving()
+    return bench_config(
+        name,
+        rng,
+        measure_updates=name in ("mixed_1m", "mixed_10m"),
+    )
+
+
 def run_one(name: str) -> None:
     """Child-process entry: one config, one JSON line on stdout."""
     if name != "_e2e_driver":
@@ -1853,45 +2075,77 @@ def run_one(name: str) -> None:
             int(sys.argv[5]), int(sys.argv[6]), sys.argv[7],
         )
         return
-    known = CONFIGS + EXTRAS + ["e2e_serving", "serving_dispatch"]
-    rng = np.random.default_rng(42 + known.index(name))
-    # child-side wall budget (set by main to the remaining sweep budget):
-    # the serving suite bounds its own waits so a degraded run emits a
-    # partial JSON instead of dying to the parent's kill
+    # standalone wall budget: the serving suite bounds its own waits so a
+    # degraded run emits a partial JSON instead of dying to a kill
     child_budget = os.environ.get("BENCH_CHILD_BUDGET_S")
     deadline = (
         time.perf_counter() + float(child_budget) - 10.0
         if child_budget
         else None
     )
-    if name == "retained_5m":
-        res = bench_retained(rng)
-    elif name == "retained_spot":
-        res = bench_retained_spot()
-    elif name == "chaos_soak":
-        res = bench_chaos_soak()
-    elif name == "serving":
-        res = bench_serving_suite(deadline)
-    elif name == "e2e_serving":  # standalone debug entry
-        res = bench_e2e(deadline)
-    elif name == "serving_dispatch":  # standalone debug entry
-        res = bench_serving()
+    print(json.dumps(_run_config(name, deadline)))
+
+
+def _store_result(results: dict, name: str, res: dict) -> None:
+    if name == "serving":
+        # the serving suite carries both configs; surface them under
+        # their own keys so downstream reads stay stable
+        for sub in ("e2e_serving", "serving_dispatch"):
+            if isinstance(res.get(sub), dict):
+                results[sub] = res[sub]
     else:
-        res = bench_config(
-            name,
-            rng,
-            measure_updates=name in ("mixed_1m", "mixed_10m"),
-        )
-    print(json.dumps(res))
+        results[name] = res
+
+
+def run_sweep() -> None:
+    """Child-process entry: the WHOLE config sweep in ONE process.
+
+    Pre-segment-tables, every config needed a fresh process: the axon dev
+    tunnel degraded permanently (~300x slower dispatch) after bursts of
+    readbacks/frees, because retired device mirrors piled up until GC and
+    epoch churn re-uploaded whole tables. With the segment manager's
+    free_retired grace + O(delta) scatters + bounded jit caches (PR 6),
+    one long-lived process stays in the fast path — which is exactly the
+    production serving shape, so the bench now exercises it.
+
+    Emits one `BENCH_PARTIAL <name> <json>` stderr line per completed
+    config (the parent recovers these if this process dies mid-sweep)
+    and a final combined JSON line on stdout.
+    """
+    _enable_xla_cache()
+    results: dict = {}
+    skipped: list = []
+    for name in CONFIGS + EXTRAS:
+        left = BUDGET_S - (time.perf_counter() - _T0)
+        if left < MIN_BUDGET_S.get(name, 120):
+            skipped.append(name)
+            _mark(f"{name}: SKIPPED (budget: {left:.0f}s left)")
+            continue
+        deadline = time.perf_counter() + left - 15.0
+        # deadline-aware configs (the serving suite) also read this env
+        os.environ["BENCH_CHILD_BUDGET_S"] = str(max(10, left - 15))
+        try:
+            res = _run_config(name, deadline)
+        except Exception as e:  # noqa: BLE001 — keep sweeping (r3 1d)
+            skipped.append(name)
+            _mark(f"{name}: FAILED ({e!r}); continuing")
+            continue
+        _store_result(results, name, res)
+        # partial capture: a later crash must not erase this result
+        _mark(f"BENCH_PARTIAL {name} " + json.dumps(res))
+    print(json.dumps({"results": results, "skipped": skipped}))
 
 
 def main() -> None:
-    # Each config runs in its OWN process. The axon dev tunnel degrades
-    # permanently (~300x slower dispatch) in a process after bursts of
-    # result readbacks/frees — measured: same kernel 40us/batch in a fresh
-    # process vs 12ms/batch after a prior config's readback phase. Process
-    # isolation keeps every config's timing loop in the tunnel's fast
-    # path. (Irrelevant on a directly-attached TPU host.)
+    # ONE child process runs the WHOLE sweep (run_sweep). Historically
+    # every config needed its own process because the axon dev tunnel
+    # degraded permanently after readback/free bursts; the segmented
+    # update path removed the causes (retired mirrors freed with grace,
+    # O(delta) scatters instead of epoch re-uploads, bounded jit
+    # caches), so the sweep now runs in the long-lived-process shape
+    # production serves in. The parent stays thin: it enforces the gate
+    # budget and recovers BENCH_PARTIAL lines if the child dies.
+    import re
     import subprocess
 
     if len(sys.argv) > 1:
@@ -1900,9 +2154,12 @@ def main() -> None:
             return
         if sys.argv[1] == "--configs":
             # explicit subset run: `bench.py --configs chaos_soak[,..]`
-            # — one JSON line per named config, in this process's child
+            # — one JSON line per named config, in this process
             for n in sys.argv[2].split(","):
                 run_one(n.strip())
+            return
+        if sys.argv[1] == "_sweep":
+            run_sweep()
             return
         run_one(sys.argv[1])
         return
@@ -1911,57 +2168,44 @@ def main() -> None:
 
     results = {}
     skipped = []
-    for name in CONFIGS + EXTRAS:
-        left = BUDGET_S - (time.perf_counter() - _T0)
-        if left < MIN_BUDGET_S.get(name, 120):
-            skipped.append(name)
-            _mark(f"{name}: SKIPPED (budget: {left:.0f}s left)")
-            continue
-        try:
-            proc = subprocess.run(
-                [sys.executable, __file__, name],
-                capture_output=True,
-                text=True,
-                # kill at the remaining budget (+ a little grace), not a
-                # blanket floor: a late config must not overrun the gate
-                # (a too-small remainder kills the child -> ONE skipped
-                # config, by design). The child also gets the remaining
-                # budget so deadline-aware configs (the serving suite)
-                # can emit a partial JSON BEFORE the kill would land.
-                timeout=max(10, left - 5),
-                env=dict(
-                    os.environ,
-                    BENCH_CHILD_BUDGET_S=str(max(10, left - 15)),
-                ),
-            )
-        except subprocess.TimeoutExpired as e:
-            sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
-                             if isinstance(e.stderr, bytes)
-                             else (e.stderr or ""))
-            skipped.append(name)
-            _mark(f"{name}: TIMED OUT inside budget; continuing")
-            continue
+    stderr_text = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "_sweep"],
+            capture_output=True,
+            text=True,
+            timeout=BUDGET_S + 60,
+        )
+        stderr_text = proc.stderr
         sys.stderr.write(proc.stderr)
-        if proc.returncode != 0:
-            # one failing config must not erase the configs already
-            # captured — record and keep sweeping (r3 verdict item 1d)
-            skipped.append(name)
-            _mark(
-                f"{name}: FAILED rc={proc.returncode}; continuing "
-                f"(tail: {proc.stdout[-300:]!r})"
-            )
-            continue
-        res = json.loads(proc.stdout.strip().splitlines()[-1])
-        if name == "serving":
-            # the one-process suite carries both configs; surface them
-            # under their own keys so downstream reads stay stable
-            for sub in ("e2e_serving", "serving_dispatch"):
-                if isinstance(res.get(sub), dict):
-                    results[sub] = res[sub]
+        if proc.returncode == 0:
+            doc = json.loads(proc.stdout.strip().splitlines()[-1])
+            results = doc["results"]
+            skipped = doc["skipped"]
         else:
-            results[name] = res
-        # partial capture: a later timeout must not erase this result
-        _mark(f"BENCH_PARTIAL {name} " + json.dumps(res))
+            _mark(f"sweep child FAILED rc={proc.returncode}; recovering "
+                  f"partials (tail: {proc.stdout[-300:]!r})")
+    except subprocess.TimeoutExpired as e:
+        stderr_text = (
+            (e.stderr or b"").decode("utf-8", "replace")
+            if isinstance(e.stderr, bytes)
+            else (e.stderr or "")
+        )
+        sys.stderr.write(stderr_text)
+        _mark("sweep child TIMED OUT; recovering partials")
+    if not results and stderr_text:
+        # the child died mid-sweep: every completed config left a
+        # BENCH_PARTIAL line — the capture survives the crash
+        done = set()
+        for m in re.finditer(
+            r"BENCH_PARTIAL (\S+) (\{.*)$", stderr_text, re.M
+        ):
+            try:
+                _store_result(results, m.group(1), json.loads(m.group(2)))
+                done.add(m.group(1))
+            except ValueError:
+                continue
+        skipped = [n for n in CONFIGS + EXTRAS if n not in done]
 
     # HEADLINE = end-to-end serving throughput (ROADMAP item 1 / PR 6):
     # the number that closes the socket->silicon gap, reported against
@@ -1973,6 +2217,7 @@ def main() -> None:
     kern = results.get("mixed_10m") or results.get("share_10m") or {
         "tpu_rps": None, "speedup": None
     }
+    churn = results.get("churn_storm") or {}
     print(
         json.dumps(
             {
@@ -2013,6 +2258,13 @@ def main() -> None:
                         "subscribe_visibility_ms"
                     ),
                     "insert_rps_10m": kern.get("insert_rps"),
+                    # segmented update path (churn_storm, ROADMAP item 2)
+                    "churn_inserts_per_s": churn.get(
+                        "churn_inserts_per_s"
+                    ),
+                    "subscribe_visibility_ms": churn.get(
+                        "subscribe_visibility_ms"
+                    ),
                     "skipped_configs": skipped,
                     "wall_s": round(time.perf_counter() - _T0, 1),
                     # the note reflects the ACTUAL run (r4 shipped a
@@ -2027,13 +2279,15 @@ def main() -> None:
                         )
                         + ". headline = e2e serving msgs/s (socket-to-"
                         "socket incl. the ingest window), best worker-"
-                        "count point; e2e_serving + serving_dispatch "
-                        "ran in ONE process across all their configs "
-                        "(bounded jit cache + explicit buffer frees + "
-                        "O(dirty) prepare keep a long-lived process "
-                        "steady). kernel numbers (per-batch p50/p99 "
-                        "include dev-tunnel dispatch overhead) remain "
-                        "in detail/configs."
+                        "count point; the FULL sweep ran in ONE child "
+                        "process (segment tables: O(delta) scatters + "
+                        "free_retired grace + bounded jit caches keep a "
+                        "long-lived process steady — the per-config "
+                        "respawn is gone). churn_storm reports the "
+                        "segmented update path (churn_inserts_per_s / "
+                        "subscribe_visibility_ms at 10M subs). kernel "
+                        "numbers (per-batch p50/p99 include dev-tunnel "
+                        "dispatch overhead) remain in detail/configs."
                     ),
                     "configs": results,
                 },

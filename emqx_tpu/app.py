@@ -494,6 +494,19 @@ class BrokerApp:
         else:
             self.device_watch = None
             self.retrace_watch = None
+        # background segment compaction (ops/segments.py): housekeeping
+        # merges the shape-index hot segment into the packed table and
+        # proactively grows the subscriber bitmaps on the compaction
+        # executor — the subscribe path never pays an O(table) rebuild
+        if c.router.enable_tpu:
+            from emqx_tpu.ops.segments import SegmentCompactor
+
+            self.segment_compactor = SegmentCompactor(
+                metrics=self.broker.metrics,
+                interval_s=c.router.compact_interval_s,
+            )
+        else:
+            self.segment_compactor = None
         self.statsd = (
             StatsdExporter(
                 self.broker.metrics,
@@ -529,12 +542,39 @@ class BrokerApp:
                 ),
             )
             self.session_persistence.attach(self.hooks)
+            segments = None
+            if c.durability.segment_snapshot:
+                # rolling-upgrade fast path: the device-table host state
+                # (route index + bitmaps) checkpoints as a sidecar pickle
+                # so a replacement process restores million-entry tables
+                # instead of replaying every subscribe
+                from emqx_tpu.ops.segments import SegmentStateSnapshot
+
+                def _cap_segments():
+                    return {
+                        "router": self.broker.router,
+                        "subtab": self.broker.subtab,
+                        "grouptab": self.broker.grouptab,
+                    }
+
+                def _install_segments(state):
+                    self.broker.router = state["router"]
+                    self.broker.subtab = state["subtab"]
+                    self.broker.grouptab = state["grouptab"]
+                    self.broker._device = None  # rebuilt on next batch
+
+                segments = SegmentStateSnapshot(
+                    _os.path.join(c.durability.data_dir, "segments.pkl"),
+                    capture=_cap_segments,
+                    install=_install_segments,
+                )
             self.durable_state = DurableState(
                 kv,
                 retainer=self.retainer if c.retainer.enable else None,
                 delayed=self.delayed if c.delayed.enable else None,
                 banned=self.banned,
                 degrade=self.degrade,
+                segments=segments,
             )
         else:
             self.session_persistence = None
@@ -1022,6 +1062,24 @@ class BrokerApp:
                     self.device_watch.poll(now)
                 if self.retrace_watch is not None:
                     self.retrace_watch.check(now)
+                dev = self.broker._device
+                if self.segment_compactor is not None and dev is not None:
+                    st = dev.segment_status()
+                    m = self.broker.metrics
+                    m.gauge_set("router.segment.hot.fill", st["hot_fill"])
+                    m.gauge_set(
+                        "router.segment.hot.capacity", st["hot_capacity"]
+                    )
+                    m.gauge_set(
+                        "router.segment.tombstones", st["tombstones"]
+                    )
+                    rc = self.config.router
+                    self.segment_compactor.tick(
+                        dev.compaction_owners(
+                            hot_entries=rc.compact_hot_entries,
+                            tombstone_frac=rc.compact_tombstone_frac,
+                        )
+                    )
                 self.trace.sweep(now)
                 self.license.tick(now)
                 self.topic_metrics.tick_rates(now)

@@ -424,6 +424,33 @@ declare("router.prepare.dirty", COUNTER,
         "prepares that re-snapshotted at least one table (churn since "
         "the last batch)")
 
+# segmented update path (ops/segments.py, docs/update_path.md)
+declare("router.segment.hot.fill", GAUGE,
+        "live entries in the shape-index hot segment (subscribes since "
+        "the last compaction)")
+declare("router.segment.hot.capacity", GAUGE,
+        "hot-segment slot capacity (pow2; grows by doubling, re-uploads "
+        "alone via the per-array resync marker)")
+declare("router.segment.tombstones", GAUGE,
+        "tombstoned packed-table slots awaiting compaction (unsubscribed "
+        "entries masked out of the match)")
+declare("router.compact.runs", COUNTER,
+        "background segment-compaction cycles applied (hot segment "
+        "merged into a rebuilt packed table off the critical path)")
+declare("router.compact.aborted", COUNTER,
+        "compaction cycles discarded (a structural rebuild raced the "
+        "background build; retried next interval)")
+declare("router.compact.merged", COUNTER,
+        "hot-segment entries merged into the packed table by compaction")
+declare("router.compact.seconds", HISTOGRAM,
+        "wall seconds per compaction cycle (capture + executor build + "
+        "pre-upload + journal-replay apply)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("router.compact.lag.seconds", GAUGE,
+        "seconds the compaction trigger has been pending (0 when the "
+        "hot segment is under threshold; sustained growth means "
+        "compaction cannot keep up with churn)")
+
 # retained-replay storm feed (broker/retained_feed.py)
 declare("retained.storm.filters", COUNTER,
         "wildcard replay filters batched through the storm feed")

@@ -39,6 +39,7 @@ NS_RETAINED = "retained"
 NS_DELAYED = "delayed"
 NS_BANNED = "banned"
 NS_DEGRADE = "degrade"
+NS_SEGMENTS = "segments"
 
 
 def make_detached_deliverer(session, wal=None, client_id: str = ""):
@@ -168,7 +169,7 @@ class DurableState:
     """Retained / delayed / banned snapshot+restore (disc_copies analog)."""
 
     def __init__(self, kv: FileKv, retainer=None, delayed=None, banned=None,
-                 degrade=None):
+                 degrade=None, segments=None):
         self.kv = kv
         self.retainer = retainer
         self.delayed = delayed
@@ -177,10 +178,17 @@ class DurableState:
         # durable snapshot so a node restarting mid-degradation resumes
         # open/probing instead of hammering a still-broken fast path
         self.degrade = degrade
+        # SegmentStateSnapshot (ops/segments.py): device-table host state
+        # (route index, hot segments, subscriber bitmaps) checkpoints to
+        # a sidecar file; the kv carries the pointer + generation so a
+        # rolling upgrade restores tables instead of replaying subscribes
+        self.segments = segments
 
     def flush(self) -> None:
         if self.degrade is not None:
             self.kv.write(NS_DEGRADE, {"paths": self.degrade.snapshot()})
+        if self.segments is not None:
+            self.kv.write(NS_SEGMENTS, self.segments.save())
         if self.retainer is not None:
             msgs = []
             for t in self.retainer.topics():
@@ -220,6 +228,11 @@ class DurableState:
         if self.degrade is not None:
             data = self.kv.read(NS_DEGRADE)
             self.degrade.restore((data or {}).get("paths"))
+        if self.segments is not None:
+            # BEFORE session restore: re-subscribes then land as
+            # refcount hits on the restored tables, not fresh builds
+            restored = self.segments.load(self.kv.read(NS_SEGMENTS))
+            out["segments"] = len(restored) if restored else 0
         if self.retainer is not None:
             data = self.kv.read(NS_RETAINED)
             for d in (data or {}).get("messages", []):

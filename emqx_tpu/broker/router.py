@@ -42,6 +42,14 @@ class Router:
         self.min_tpu_batch = min_tpu_batch
         self.enable_tpu = enable_tpu
 
+    def __getstate__(self):
+        # segment-state snapshots (ops/segments.SegmentStateSnapshot)
+        # pickle the router; the lazy DeviceRouter holds device buffers
+        # and is rebuilt on first use after restore
+        d = self.__dict__.copy()
+        d["_matcher"] = None
+        return d
+
     def __len__(self) -> int:
         return len(self._exact) + len(self._trie)
 

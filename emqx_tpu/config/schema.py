@@ -119,6 +119,16 @@ class RouterConfig:
     # default) = single-device serving; set e.g. [4, 2] on an 8-chip
     # host to run dist_shape_route_step on the live dispatch path.
     mesh_shape: List[int] = field(default_factory=lambda: [0, 0])
+    # segmented update path (docs/update_path.md): background compaction
+    # merges the shape-index hot segment into the packed table once it
+    # holds this many live entries (housekeeping-driven, built + pre-
+    # uploaded on the segment-compact executor)
+    compact_hot_entries: int = 1024
+    # minimum seconds between background compaction cycles per table
+    compact_interval_s: float = 5.0
+    # also compact when this fraction of the packed table is tombstoned
+    # (mass unsubscribe reclaim)
+    compact_tombstone_frac: float = 0.25
 
 
 @dataclass
@@ -263,6 +273,11 @@ class DurabilityConfig:
     data_dir: str = "data"
     flush_interval: float = 5.0
     fsync: bool = False
+    # checkpoint the device-table host state (route index + hot
+    # segments + subscriber bitmaps) as a sidecar pickle so a rolling
+    # upgrade restores million-entry tables instead of replaying every
+    # subscribe (ops/segments.SegmentStateSnapshot)
+    segment_snapshot: bool = False
 
 
 @dataclass
@@ -694,6 +709,14 @@ def _validate(cfg: AppConfig) -> None:
     if cfg.router.jit_cache_max < 0:
         raise ConfigError(
             "router.jit_cache_max must be >= 0 (0 = unbounded)"
+        )
+    if cfg.router.compact_hot_entries < 1:
+        raise ConfigError("router.compact_hot_entries must be >= 1")
+    if cfg.router.compact_interval_s < 0:
+        raise ConfigError("router.compact_interval_s must be >= 0")
+    if not (0.0 < cfg.router.compact_tombstone_frac <= 1.0):
+        raise ConfigError(
+            "router.compact_tombstone_frac must be in (0, 1]"
         )
     if cfg.retainer.storm_window_us < 0:
         raise ConfigError("retainer.storm_window_us must be >= 0")

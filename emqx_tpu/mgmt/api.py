@@ -363,6 +363,18 @@ class MgmtApi:
                 "device_ms": hist("router.device.seconds", 1e3),
                 "sync_ms": hist("router.sync.seconds", 1e3),
                 "batch_size": hist("router.batch.size"),
+                "prepare_dirty": m.get("router.prepare.dirty"),
+                "sync_skipped": m.get("router.sync.skipped"),
+            },
+            "segment": {
+                "hot_fill": m.gauge("router.segment.hot.fill"),
+                "hot_capacity": m.gauge("router.segment.hot.capacity"),
+                "tombstones": m.gauge("router.segment.tombstones"),
+                "compact_runs": m.get("router.compact.runs"),
+                "compact_aborted": m.get("router.compact.aborted"),
+                "compact_merged": m.get("router.compact.merged"),
+                "compact_ms": hist("router.compact.seconds", 1e3),
+                "compact_lag_s": m.gauge("router.compact.lag.seconds"),
             },
             "dispatch": {
                 "fanout": hist("dispatch.fanout"),

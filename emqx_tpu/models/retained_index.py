@@ -108,6 +108,11 @@ CHUNK = 1 << 20
 
 
 class DeviceRetainedIndex:
+    # retained churn is row-granular (up to `bucket` logged bytes per
+    # insert/delete), so the op-log cap sits higher than the index
+    # sources' — a full chunk re-upload is 64MB on the link
+    OPLOG_MAX = 1 << 18
+
     def __init__(self, max_bytes: int = 64, max_levels: int = 8):
         self.max_bytes = max_bytes  # hard cap (device-budget gate)
         self.max_levels = max_levels
@@ -120,14 +125,52 @@ class DeviceRetainedIndex:
         self._by_row: List[Optional[str]] = []
         self._free: List[int] = []
         self._tombstones = 0  # live rows removed (match_many fast path)
-        # host chunks; device mirrors uploaded lazily per query
+        # host chunks, mirrored on device by the ONE segment-table
+        # manager (ops/segments.py): retained add/remove reaches the
+        # device as row scatters (delta-overlay protocol), a fresh chunk
+        # re-uploads alone (resync marker), and only a bucket-width
+        # change pays a full re-upload (epoch bump). The manager's lock +
+        # torn-version guard covers storm uploads running on executor
+        # threads while the loop thread inserts.
         self._host_b: List[np.ndarray] = []  # [CHUNK, bucket] uint8
-        self._dev: List[Optional[object]] = []  # device bytes or None=dirty
-        # mutation generation: chunk uploads capture it before the
-        # device_put and only cache the buffer if no mutation landed
-        # mid-upload (uploads may run on executor threads while the loop
-        # thread inserts — a torn upload must never be marked clean)
-        self._mut_ver = 0
+        from emqx_tpu.ops.segments import DeviceSegmentManager
+
+        self._seg = DeviceSegmentManager(name="retained")
+        self.epoch = 0
+        self.oplog: list = []
+        self.version = 0
+
+    # -- delta protocol -----------------------------------------------------
+    def device_snapshot(self) -> Dict[str, np.ndarray]:
+        return {f"chunk_{c}": b for c, b in enumerate(self._host_b)}
+
+    def _bump_epoch(self) -> None:
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+
+    def _log_resync(self, name: str) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump_epoch()
+            return
+        from emqx_tpu.ops.segments import RESYNC
+
+        self.oplog.append((RESYNC, name, 0))
+
+    def _log_row(self, c: int, i: int) -> None:
+        """Op-log one row's bytes (post-write): the delta scatter replays
+        the whole `bucket`-wide row, trailing zeros included, so the
+        on-device length derivation stays exact."""
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump_epoch()
+            return
+        row = self._host_b[c][i]
+        base = i * self.bucket
+        name = f"chunk_{c}"
+        for b in range(self.bucket):
+            self.oplog.append((name, base + b, int(row[b])))
 
     def _grow_bucket(self, need: int) -> None:
         from emqx_tpu.ops.nfa import _next_pow2
@@ -139,9 +182,8 @@ class DeviceRetainedIndex:
             new = np.zeros((CHUNK, nb), np.uint8)
             new[:, : self.bucket] = self._host_b[c]
             self._host_b[c] = new
-            self._dev[c] = None
-        self._mut_ver += 1
         self.bucket = nb
+        self._bump_epoch()  # every chunk changed geometry: full upload
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -168,13 +210,14 @@ class DeviceRetainedIndex:
                 self._host_b.append(
                     np.zeros((CHUNK, self.bucket), np.uint8)
                 )
-                self._dev.append(None)
+                # a fresh chunk re-uploads ALONE; existing chunks'
+                # mirrors are untouched
+                self._log_resync(f"chunk_{len(self._host_b) - 1}")
         self._rows[topic] = row
         c, i = divmod(row, CHUNK)
         self._host_b[c][i, : len(enc)] = np.frombuffer(enc, np.uint8)
         self._host_b[c][i, len(enc):] = 0
-        self._mut_ver += 1
-        self._dev[c] = None  # dirty
+        self._log_row(c, i)
         return True
 
     def bulk_add(self, topics: List[str]) -> int:
@@ -198,15 +241,15 @@ class DeviceRetainedIndex:
             c, i0 = divmod(row0, CHUNK)
             if c >= len(self._host_b):
                 self._host_b.append(np.zeros((CHUNK, self.bucket), np.uint8))
-                self._dev.append(None)
             take = min(CHUNK - i0, len(fresh) - pos)
             batch = fresh[pos : pos + take]
             mat, _lens, too_long = encode_topics(batch, self.bucket)
             if too_long.any():
                 raise ValueError("bulk_add: topic exceeds max_bytes")
             self._host_b[c][i0 : i0 + take] = mat
-            self._mut_ver += 1
-            self._dev[c] = None
+            # slab write: re-upload the touched chunk wholesale instead
+            # of logging CHUNK x bucket scalar deltas
+            self._log_resync(f"chunk_{c}")
             for k, t in enumerate(batch):
                 self._rows[t] = row0 + k
             self._by_row.extend(batch)
@@ -222,8 +265,7 @@ class DeviceRetainedIndex:
         self._tombstones += 1
         c, i = divmod(row, CHUNK)
         self._host_b[c][i, :] = 0  # len derives 0 -> zero words
-        self._mut_ver += 1
-        self._dev[c] = None
+        self._log_row(c, i)
 
     # -- query ------------------------------------------------------------
     def _build_tables(self, filters: List[str], floor: int = 0):
@@ -262,24 +304,14 @@ class DeviceRetainedIndex:
         return idx, fids, shape_tables, nfa_tables, kwargs
 
     def _ensure_chunks(self) -> list:
-        """Upload dirty chunks; returns the device buffer list. Safe off
-        the mutating thread: the buffer is cached as clean only when no
-        mutation landed during the upload (`_mut_ver` check) — a torn
-        upload is still used for THIS storm (it saw a superset of the
-        pre-mutation rows; decode re-verifies against live state) but
-        never marked clean."""
-        import jax
-
-        out = []
-        for c in range(len(self._host_b)):
-            d = self._dev[c]
-            if d is None:
-                v0 = self._mut_ver
-                d = jax.device_put(self._host_b[c])
-                if self._mut_ver == v0:
-                    self._dev[c] = d
-            out.append(d)
-        return out
+        """Sync the chunk mirrors through the segment manager; returns
+        the device buffer list in chunk order. Safe off the mutating
+        thread: the manager serializes concurrent syncs and never caches
+        a torn upload as clean (version guard) — a torn snapshot is
+        still used for THIS storm (a superset of the pre-mutation rows;
+        decode re-verifies against live state)."""
+        segs = self._seg.sync(self)
+        return [segs[f"chunk_{c}"] for c in range(len(self._host_b))]
 
     def _launch_all(self, shape_tables, nfa_tables, kwargs) -> list:
         """Dispatch one storm launch per chunk (lengths derived

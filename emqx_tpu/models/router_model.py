@@ -900,7 +900,8 @@ class DeviceRouter:
         import dataclasses
 
         from emqx_tpu.ops.matcher import MatcherConfig
-        from emqx_tpu.ops.nfa import MAX_PROBES, DeviceDeltaSync
+        from emqx_tpu.ops.nfa import MAX_PROBES
+        from emqx_tpu.ops.segments import DeviceSegmentManager
 
         self.index = index
         self.subtab = subtab  # None => match-only (no fan-out bitmaps)
@@ -924,24 +925,38 @@ class DeviceRouter:
             )
 
             tplace = table_placement(mesh)
-            self._shape_sync = DeviceDeltaSync(
-                placement=tplace, free_retired=True
+            self._table_placement = tplace
+            self._bitmap_placement = bitmap_placement(mesh)
+            self._shape_sync = DeviceSegmentManager(
+                placement=tplace, free_retired=True, name="shapes"
             )
-            self._nfa_sync = DeviceDeltaSync(
-                placement=tplace, free_retired=True
+            self._nfa_sync = DeviceSegmentManager(
+                placement=tplace, free_retired=True, name="nfa"
             )
-            self._bits_sync = DeviceDeltaSync(
-                placement=bitmap_placement(mesh), free_retired=True
+            self._bits_sync = DeviceSegmentManager(
+                placement=self._bitmap_placement,
+                free_retired=True,
+                name="bitmaps",
             )
             # group tables are replicated on the mesh like match tables
-            self._group_sync = DeviceDeltaSync(
-                placement=tplace, free_retired=True
+            self._group_sync = DeviceSegmentManager(
+                placement=tplace, free_retired=True, name="groups"
             )
         else:
-            self._shape_sync = DeviceDeltaSync(free_retired=True)
-            self._nfa_sync = DeviceDeltaSync(free_retired=True)
-            self._bits_sync = DeviceDeltaSync(free_retired=True)
-            self._group_sync = DeviceDeltaSync(free_retired=True)
+            self._table_placement = None
+            self._bitmap_placement = None
+            self._shape_sync = DeviceSegmentManager(
+                free_retired=True, name="shapes"
+            )
+            self._nfa_sync = DeviceSegmentManager(
+                free_retired=True, name="nfa"
+            )
+            self._bits_sync = DeviceSegmentManager(
+                free_retired=True, name="bitmaps"
+            )
+            self._group_sync = DeviceSegmentManager(
+                free_retired=True, name="groups"
+            )
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
         # state (it runs on executor threads)
@@ -1125,6 +1140,53 @@ class DeviceRouter:
             group_tables,
             kslot,
         )
+
+    # -- segment maintenance (ops/segments.SegmentCompactor) --------------
+    def segment_status(self) -> Dict:
+        """Hot-segment occupancy + tombstone load of the serving tables —
+        feeds the `router.segment.*` gauges and the compaction trigger."""
+        sh = self.index.shapes
+        return {
+            "hot_fill": sh.hot_live,
+            "hot_capacity": sh.hot_capacity,
+            "tombstones": sh.packed_tombstones,
+            "packed_capacity": sh._Tcap,
+            "full_resyncs": self._shape_sync.full_resyncs,
+            "delta_launches": self._shape_sync.delta_launches,
+            "array_resyncs": self._shape_sync.array_resyncs,
+        }
+
+    def compaction_owners(self, hot_entries: int = 1024,
+                          tombstone_frac: float = 0.25) -> list:
+        """Adapters the background `SegmentCompactor` drives: merge the
+        shape hot segment into the packed table, and proactively grow
+        the subscriber bitmap matrix — both built + pre-uploaded on the
+        compaction executor, applied on the loop, so the subscribe path
+        never pays an O(table) rebuild or a full upload."""
+        from emqx_tpu.ops.segments import (
+            BitmapGrowthOwner,
+            ShapeSegmentOwner,
+        )
+
+        owners = [
+            ShapeSegmentOwner(
+                self.index.shapes,
+                self._shape_sync,
+                placement=self._table_placement,
+                hot_entries=hot_entries,
+                tombstone_frac=tombstone_frac,
+            )
+        ]
+        if self.subtab is not None:
+            owners.append(
+                BitmapGrowthOwner(
+                    self.subtab,
+                    self.index,
+                    self._bits_sync,
+                    placement=self._bitmap_placement,
+                )
+            )
+        return owners
 
     def prepare(self):
         """Snapshot + upload current tables/bitmaps. MUST run on the thread

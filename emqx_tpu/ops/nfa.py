@@ -161,105 +161,15 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-class DeviceDeltaSync:
-    """Device-resident mirror of incrementally-mutated host tables.
-
-    `sync(src)` returns a dict of device arrays matching
-    `src.device_snapshot()`. On the source's `epoch` changing (array growth,
-    rehash, salt bump) the mirror is rebuilt with a full upload; otherwise
-    the op-log suffix since the last sync is replayed as ONE donated scatter
-    per touched array — churn costs O(delta), not O(table). This is the
-    device half of the delta-overlay design (module docstring).
-    """
-
-    def __init__(self, placement=None, free_retired: bool = False) -> None:
-        """`placement`: optional fn(name, np_array) -> device array used
-        for the initial/full uploads — e.g. a NamedSharding device_put
-        for SPMD serving. Delta scatters run under jit, so the placed
-        sharding propagates and churn stays O(delta) on a mesh too.
-
-        `free_retired`: explicitly `.delete()` the device buffers a full
-        re-upload replaces, with ONE epoch of grace (the generation
-        retired by rebuild N is freed at rebuild N+1). Long-lived serving
-        processes grow their tables many times; without explicit frees
-        the old device mirrors linger until Python GC, and on tunneled
-        backends the accumulated garbage is what flips the link into its
-        degraded mode. The grace generation covers in-flight executor
-        batches still holding the previous snapshot (pipeline depth is
-        small and FIFO-settled, so nothing older than one generation can
-        be live by the next rebuild)."""
-        self._arrays: Optional[Dict] = None
-        self._epoch = -1
-        self._pos = 0
-        self._placement = placement
-        self._free_retired = free_retired
-        self._retired: Optional[list] = None
-
-    def sync(self, src) -> Dict:
-        import jax.numpy as jnp
-
-        if self._arrays is None or self._epoch != src.epoch:
-            if self._free_retired:
-                old = self._retired
-                self._retired = (
-                    list(self._arrays.values()) if self._arrays else None
-                )
-                for arr in old or ():
-                    try:
-                        arr.delete()
-                    except Exception:  # noqa: BLE001 — free is advisory
-                        pass
-            put = self._placement or (lambda _k, v: jnp.asarray(v))
-            self._arrays = {
-                k: put(k, v.copy())
-                for k, v in src.device_snapshot().items()
-            }
-            self._epoch = src.epoch
-            self._pos = len(src.oplog)
-            return dict(self._arrays)
-        ops = src.oplog[self._pos :]
-        if not ops:
-            return dict(self._arrays)
-        per: Dict[str, Dict[int, int]] = {}
-        for name, idx, val in ops:
-            per.setdefault(name, {})[idx] = val  # last write per slot wins
-        for name, writes in per.items():
-            arr = self._arrays[name]
-            flat = arr.reshape(-1)
-            idxs = np.fromiter(writes.keys(), dtype=np.int32, count=len(writes))
-            vals = np.array(list(writes.values()), dtype=arr.dtype)
-            # pad to a pow2 bucket (repeating one write is a no-op) so jit
-            # recompiles per size bucket, not per delta length
-            n = len(idxs)
-            npad = max(16, _next_pow2(n))
-            if npad != n:
-                idxs = np.pad(idxs, (0, npad - n), mode="edge")
-                vals = np.pad(vals, (0, npad - n), mode="edge")
-            out = _scatter_set(flat, jnp.asarray(idxs), jnp.asarray(vals))
-            out = out.reshape(arr.shape)
-            if self._placement is not None:
-                # the scatter's jit may drop the placed sharding; re-pin
-                # it (device-side reshard — no host re-upload)
-                out = self._placement(name, out)
-            self._arrays[name] = out
-        self._pos = len(src.oplog)
-        # shallow copy: callers may hold the snapshot across a later sync
-        # (executor batches); mutating the returned dict under them would
-        # hand a worker a torn table set
-        return dict(self._arrays)
-
-
-_scatter_fn = None
-
-
-def _scatter_set(flat, idxs, vals):
-    """jitted flat[idxs] = vals (jax imported lazily, cached)."""
-    global _scatter_fn
-    if _scatter_fn is None:
-        import jax
-
-        _scatter_fn = jax.jit(lambda f, i, v: f.at[i].set(v))
-    return _scatter_fn(flat, idxs, vals)
+# The device consumer of the delta-overlay protocol now lives in
+# emqx_tpu/ops/segments.py as the ONE segment-table manager under every
+# index (router/shape/retained — ROADMAP item 3). The historical name is
+# kept importable here: the manager is a strict superset (coalesced
+# one-launch scatter replay, per-array resync markers, offered buffers
+# from background compaction).
+from emqx_tpu.ops.segments import (  # noqa: E402  (re-export)
+    DeviceSegmentManager as DeviceDeltaSync,
+)
 
 
 class NfaBuilder:

@@ -29,11 +29,24 @@ correctness never depends on the shape heuristic.
 Host-side updates follow the same delta-overlay protocol as NfaBuilder
 (epoch / oplog / device_snapshot; see ops/nfa.py) so subscribe/unsubscribe
 churn reaches the device as scatters.
+
+Update-path segmentation (docs/update_path.md): the PACKED table
+(`arr_table`) is written only by rebuilds — cold bulk loads and
+compaction. Incremental subscribes land in a small append-only **hot
+segment** (`arr_hot`, an open-addressing table probed with the same
+slot_hash/probe_step sequence), so a subscribe is O(1) host writes plus
+one device scatter, never an O(table) rehash; unsubscribes of packed
+entries set a bit in a **tombstone mask** (`arr_tomb`) instead of
+touching the row. The device kernel matches against
+``packed ∪ hot − tombstones`` in the same single launch, and a
+background compaction (`ops/segments.SegmentCompactor`) periodically
+merges the hot segment into a rebuilt packed table off the critical
+path, replaying the mutations that raced the build from a journal.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -125,6 +138,11 @@ class ShapeIndex:
     """
 
     OPLOG_MAX = 65536
+    HOT_MIN = 256  # initial/minimum hot-segment capacity (pow2)
+    # largest hot-segment population a warm bulk_add may leave behind;
+    # bigger loads take the classic packed rebuild (they are restore-
+    # scale, already epoch-bump territory)
+    HOT_ABSORB_MAX = 1 << 17
 
     def __init__(self, salt: int = 0, max_shapes: int = MAX_SHAPES):
         self.salt = salt
@@ -137,47 +155,148 @@ class ShapeIndex:
         self.arr_shape_mask = np.zeros(max_shapes, np.int32)
         self.arr_shape_len = np.full(max_shapes, -1, np.int32)  # -1 = dead
         self.arr_shape_flags = np.zeros(max_shapes, np.int32)  # 1=#, 2=rootwild
-        # filter table: fused [T, 4] int32 (c1, c2, fid, shape_id)
+        # PACKED filter table: fused [T, 4] int32 (c1, c2, fid, shape_id);
+        # written only by rebuilds (cold bulk load / compaction)
         self._Tcap = 1024
         self.arr_table = np.zeros((self._Tcap, 4), np.int32)
         self.arr_table[:, 2] = -1  # fid lane: -1 empty
         self._fill = 0  # non-empty slots (live + tombstones)
-        # filter -> (shape_id, c1, c2, fid); key -> filter for collisions.
-        # After a cold bulk load these dicts are materialized LAZILY from
-        # the stashed arrays (`_cold`) on first incremental access — dict
-        # construction for 10M filters costs ~1min the serving path may
-        # never need.
-        self._entries_d: Dict[str, Tuple[int, int, int, int]] = {}
-        self._by_key_d: Dict[Tuple[int, int], str] = {}
-        self._cold = None  # (names, sid_arr, c1_arr, c2_arr, fid_arr)
+        # packed-row tombstone mask: bit i set => packed slot i is dead.
+        # Unsubscribe flips ONE bit (one device scatter word) instead of
+        # rewriting the row; compaction purges the mask.
+        self.arr_tomb = np.zeros(self._Tcap // 32, np.uint32)
+        self._tombs = 0  # tombstoned packed slots
+        # HOT segment: same fused [H, 4] layout + probe sequence as the
+        # packed table, but small and append-only between compactions.
+        # Every incremental add lands here — the packed table never
+        # rehashes on the subscribe path.
+        self._Hcap = self.HOT_MIN
+        self.arr_hot = np.zeros((self._Hcap, 4), np.int32)
+        self.arr_hot[:, 2] = -1
+        self._hot_fill = 0  # non-empty hot slots (live + tombstones)
+        self._hot_tombs = 0
+        self._in_hot: set = set()  # filters currently living in hot
+        # compaction bookkeeping: a capture is valid while no structural
+        # rebuild (_rehash / cold load) happened; mutations racing an
+        # outstanding build are journaled and replayed at apply
+        self._structure_gen = 0
+        self._journal: Optional[list] = None  # single-writer: loop
+        # The packed/hot arrays ARE the host mirror: an entry's
+        # (c1, c2) recomputes from its filter string (shape registry +
+        # salt) and its row is found by the same probe walk the device
+        # runs — no 10M-entry shadow dicts, so nothing materializes on
+        # the first post-restore subscribe/unsubscribe (the dict version
+        # cost a ~30s one-shot stall there). Name recovery for the rare
+        # salt rebuild goes through `resolve_name` (fid -> filter; set
+        # by RouteIndex to its registry lookup).
+        self.resolve_name: Optional[Callable[[int], Optional[str]]] = None
         self.epoch = 0
         self.oplog: list = []
         self.version = 0
 
-    # -- lazy host mirror --------------------------------------------------
-    def _materialize(self) -> None:
-        if self._cold is None:
-            return
-        names, sid, c1, c2, fid = self._cold
-        self._cold = None
-        sid_l = sid.tolist()
-        c1_l = c1.tolist()
-        c2_l = c2.tolist()
-        fid_l = fid.tolist()
-        self._entries_d = dict(zip(names, zip(sid_l, c1_l, c2_l, fid_l)))
-        self._by_key_d = dict(zip(zip(c1_l, c2_l), names))
-        if len(self._entries_d) != len(names):
-            raise RuntimeError("cold bulk load lost entries (dup names?)")
+    # -- host probe mirror -------------------------------------------------
+    def _find_live(self, c1: int, c2: int):
+        """Locate the LIVE row holding (c1, c2): -> (in_hot, idx, fid,
+        sid) or None. Walks the same (home, stride) probe sequence as
+        the device kernel — hot segment first, then the packed table
+        with its tombstone mask."""
+        cc1 = np.int32(np.uint32(c1))
+        cc2 = np.int32(np.uint32(c2))
+        slot = slot_hash(c1)
+        step = probe_step(c2)
+        hot = self.arr_hot
+        for p in range(MAX_PROBES):
+            idx = (slot + p * step) & (self._Hcap - 1)
+            if (
+                hot[idx, 2] >= 0
+                and hot[idx, 0] == cc1
+                and hot[idx, 1] == cc2
+            ):
+                return True, idx, int(hot[idx, 2]), int(hot[idx, 3])
+        tab = self.arr_table
+        for p in range(MAX_PROBES):
+            idx = (slot + p * step) & (self._Tcap - 1)
+            if (
+                tab[idx, 2] >= 0
+                and tab[idx, 0] == cc1
+                and tab[idx, 1] == cc2
+                and not (self.arr_tomb[idx >> 5] >> (idx & 31)) & 1
+            ):
+                return False, idx, int(tab[idx, 2]), int(tab[idx, 3])
+        return None
 
-    @property
-    def _entries(self) -> Dict[str, Tuple[int, int, int, int]]:
-        self._materialize()
-        return self._entries_d
+    def _find_live_batch(self, c1s: np.ndarray, c2s: np.ndarray):
+        """Vectorized `_find_live` existence test for a batch of
+        (c1, c2) pairs (uint32 arrays) -> bool [n]. One probe-round
+        sweep over the hot segment and the packed table."""
+        n = len(c1s)
+        with np.errstate(over="ignore"):
+            home = c1s * np.uint32(SLOT_MUL)
+            home = home ^ (home >> np.uint32(SLOT_SHIFT))
+            step = c2s | np.uint32(1)
+        cc1 = c1s.view(np.int32)
+        cc2 = c2s.view(np.int32)
+        found = np.zeros(n, bool)
+        hot, Hm = self.arr_hot, np.uint32(self._Hcap - 1)
+        tab, Tm = self.arr_table, np.uint32(self._Tcap - 1)
+        with np.errstate(over="ignore"):
+            for p in range(MAX_PROBES):
+                idx = ((home + np.uint32(p) * step) & Hm).astype(np.int64)
+                row = hot[idx]
+                found |= (
+                    (row[:, 2] >= 0)
+                    & (row[:, 0] == cc1)
+                    & (row[:, 1] == cc2)
+                )
+            for p in range(MAX_PROBES):
+                idx = ((home + np.uint32(p) * step) & Tm).astype(np.int64)
+                row = tab[idx]
+                alive = (row[:, 2] >= 0) & (
+                    (
+                        (self.arr_tomb[idx >> 5] >> (idx & 31).astype(
+                            np.uint32
+                        ))
+                        & np.uint32(1)
+                    )
+                    == 0
+                )
+                found |= alive & (row[:, 0] == cc1) & (row[:, 1] == cc2)
+        return found
 
-    @property
-    def _by_key(self) -> Dict[Tuple[int, int], str]:
-        self._materialize()
-        return self._by_key_d
+    def _ent_of(self, filter_: str):
+        """Recompute `filter_`'s entry from live state: -> (sid, c1, c2,
+        fid) or None when absent. The shape registry lookup is read-only
+        (no ref bump)."""
+        parsed = self.parse_shape(filter_)
+        if parsed is None:
+            return None
+        mask, plen, has_hash, prefix = parsed
+        sid = self._shape_ids.get((mask, plen, has_hash))
+        if sid is None:
+            return None
+        c1, c2 = combined_pair(prefix, mask, sid, self.salt)
+        found = self._find_live(c1, c2)
+        if found is None:
+            return None
+        _in_hot, _idx, fid, row_sid = found
+        if row_sid != sid:
+            return None  # foreign row (collision shadow): not ours
+        if self.resolve_name is not None:
+            owner = self.resolve_name(fid)
+            if owner is not None and owner != filter_:
+                return None  # 64-bit collision: the live row is another's
+        return sid, c1, c2, fid
+
+    def _live_rows(self, with_hot: bool = True) -> np.ndarray:
+        """All live rows [(c1, c2, fid, sid)] as an int32 [n, 4] matrix:
+        packed minus tombstones, plus (optionally) the hot segment."""
+        idx = np.nonzero(self.arr_table[:, 2] >= 0)[0]
+        tword = self.arr_tomb[idx >> 5]
+        dead = (tword >> (idx & 31).astype(np.uint32)) & np.uint32(1)
+        rows = [self.arr_table[idx[dead == 0]]]
+        if with_hot:
+            rows.append(self.arr_hot[self.arr_hot[:, 2] >= 0])
+        return np.concatenate(rows, axis=0)
 
     # -- delta protocol ----------------------------------------------------
     def _log(self, name: str, idx: int, val: int) -> None:
@@ -192,15 +311,42 @@ class ShapeIndex:
         self.oplog.clear()
         self.version += 1
 
+    def _log_resync(self, name: str) -> None:
+        """Per-array resync marker: consumers re-upload ONLY `name`
+        (DeviceSegmentManager) — the big packed table never rides along
+        with a hot-segment rebuild."""
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump_epoch()
+            return
+        from emqx_tpu.ops.segments import RESYNC
+
+        self.oplog.append((RESYNC, name, 0))
+
     def device_snapshot(self) -> Dict[str, np.ndarray]:
         return {
             # flat view: row-major [T,4] -> [T*4], matching the oplog's
             # flat indices AND avoiding the TPU [_,4] tile-padding blowup
             "shape_tab": self.arr_table.reshape(-1),
+            "shape_hot": self.arr_hot.reshape(-1),
+            "shape_tomb": self.arr_tomb,
             "shape_mask": self.arr_shape_mask,
             "shape_len": self.arr_shape_len,
             "shape_flags": self.arr_shape_flags,
         }
+
+    # -- segment status (metrics / compaction triggers) --------------------
+    @property
+    def hot_live(self) -> int:
+        return self._hot_fill - self._hot_tombs
+
+    @property
+    def hot_capacity(self) -> int:
+        return self._Hcap
+
+    @property
+    def packed_tombstones(self) -> int:
+        return self._tombs
 
     # -- shape parsing -----------------------------------------------------
     @staticmethod
@@ -270,26 +416,188 @@ class ShapeIndex:
             self.max_shapes,
         )
 
-    def _place(self, c1: int, c2: int, fid: int, sid: int) -> None:
-        # NOTE: the caller has already put the entry in self._entries, so a
-        # rehash (which rebuilds from _entries) places it — just return.
-        if (self._fill + 1) * 2 > self._Tcap:
-            self._rehash(self._Tcap * 2)
+    def _place_hot(self, filter_: str, c1: int, c2: int, fid: int,
+                   sid: int) -> None:
+        """O(1) insert into the hot segment (probe placement + 4 logged
+        writes = one device scatter). The caller has already registered
+        key uniqueness against the live tables. Growth rebuilds ONLY the hot
+        segment (small) and re-uploads only it (resync marker)."""
+        if (self._hot_fill + 1) * 2 > self._Hcap:
+            self._rebuild_hot(extra=[(filter_, c1, c2, fid, sid)])
             return
-        res = self._cuckoo_walk(self.arr_table, self._Tcap, (c1, c2, fid, sid))
-        if res is None:
-            self._rehash(self._Tcap * 2)
+        slot = slot_hash(c1)
+        step = probe_step(c2)
+        for p in range(MAX_PROBES):
+            idx = (slot + p * step) & (self._Hcap - 1)
+            f = self.arr_hot[idx, 2]
+            if f == -1 or f == TOMB_FID:
+                if f == -1:
+                    self._hot_fill += 1
+                else:
+                    self._hot_tombs -= 1
+                row = (
+                    int(np.int32(np.uint32(c1))),
+                    int(np.int32(np.uint32(c2))),
+                    fid,
+                    sid,
+                )
+                self.arr_hot[idx] = row
+                base = idx * 4
+                for lane in range(4):
+                    self._log("shape_hot", base + lane, row[lane])
+                self._in_hot.add(filter_)
+                return
+        # probe window full (pathological cluster): grow + rebuild hot
+        self._rebuild_hot(extra=[(filter_, c1, c2, fid, sid)])
+
+    def _rebuild_hot(self, extra=(), min_cap: int = 0) -> None:
+        """Rebuild the hot segment (vectorized placement, drops hot
+        tombstones) sized for its live population plus `extra` fresh
+        entries [(filter, c1, c2, fid, sid)]. O(hot) — the hot segment is
+        small by construction; one `!resync` marker re-uploads it."""
+        live = self.arr_hot[self.arr_hot[:, 2] >= 0]  # drops tombs
+        n = len(live) + len(extra)
+        if n > self.HOT_ABSORB_MAX:
+            # no compactor drained the hot segment (standalone index):
+            # fold everything into the packed table inline, `extra`
+            # rides along explicitly (it is not in any array yet)
+            self._rehash(
+                self._Tcap,
+                extra=[(a, b, f, s) for _name, a, b, f, s in extra],
+            )
             return
-        writes, was_empty = res
-        if was_empty:
-            # _fill counts non-empty slots; a walk converts exactly ONE
-            # slot from empty/tombstone to live (displacements only move
-            # live entries between live slots)
-            self._fill += 1
-        for idx, row in writes:
-            base = idx * 4
-            for lane in range(4):
-                self._log("shape_tab", base + lane, int(row[lane]))
+        newH = max(
+            self.HOT_MIN, min_cap, _next_pow2(2 * (n + 1))
+        )
+        sid = np.empty(n, np.int64)
+        c1 = np.empty(n, np.uint32)
+        c2 = np.empty(n, np.uint32)
+        fid = np.empty(n, np.int64)
+        k = len(live)
+        sid[:k] = live[:, 3].astype(np.int64)
+        c1[:k] = np.ascontiguousarray(live[:, 0]).view(np.uint32)
+        c2[:k] = np.ascontiguousarray(live[:, 1]).view(np.uint32)
+        fid[:k] = live[:, 2].astype(np.int64)
+        for j, (name, a, b, f, s) in enumerate(extra):
+            i = k + j
+            sid[i], c1[i], c2[i], fid[i] = s, a & _M32, b & _M32, f
+            self._in_hot.add(name)
+        tab, newH = self._build_table(sid, c1, c2, fid, newH)
+        self._Hcap = newH
+        self.arr_hot = tab
+        self._hot_fill = n
+        self._hot_tombs = 0
+        self._log_resync("shape_hot")
+
+    def _bulk_place_hot(self, accepted) -> None:
+        """Vectorized placement of a fresh batch [(filter, c1, c2, fid,
+        sid)] into the LIVE hot table — probe-round bidding in the
+        `_build_table` style, O(batch) not O(hot), with ONE `!resync`
+        marker (re-uploading the small hot array beats logging 4 scalar
+        writes per entry, and keeps the op-log flat under churn storms).
+        This is what lets a mass-reconnect wave land at millions of
+        subscribes/sec without ever touching the packed table."""
+        n = len(accepted)
+        if n == 0:
+            return
+        if self.hot_live + n > self.HOT_ABSORB_MAX:
+            # restore-scale batch: classic full rebuild, one epoch bump
+            # (the batch rows ride as extras — they are in no array yet)
+            self._rehash(
+                self._Tcap,
+                extra=[(a, b, f, s) for _name, a, b, f, s in accepted],
+            )
+            return
+        if (self._hot_fill + n + 1) * 2 > self._Hcap:
+            self._rebuild_hot(extra=accepted)  # grows + places, 1 marker
+            return
+        c1 = np.fromiter((a[1] & _M32 for a in accepted), np.uint32, n)
+        c2 = np.fromiter((a[2] & _M32 for a in accepted), np.uint32, n)
+        fidv = np.fromiter((a[3] for a in accepted), np.int64, n)
+        sidv = np.fromiter((a[4] for a in accepted), np.int64, n)
+        with np.errstate(over="ignore"):
+            home = c1 * np.uint32(SLOT_MUL)
+            home = home ^ (home >> np.uint32(SLOT_SHIFT))
+            step = c2 | np.uint32(1)
+        H = self._Hcap
+        tab = self.arr_hot
+        unplaced = np.arange(n)
+        placed_empty = 0
+        for p in range(MAX_PROBES):
+            if not len(unplaced):
+                break
+            with np.errstate(over="ignore"):
+                idx = (
+                    home[unplaced] + np.uint32(p) * step[unplaced]
+                ) & np.uint32(H - 1)
+            idx = idx.astype(np.int64)
+            free = tab[idx, 2] == -1  # tombs stay occupied here; the
+            # next rebuild drops them
+            cand = unplaced[free]
+            cidx = idx[free]
+            _, first = np.unique(cidx, return_index=True)
+            win, widx = cand[first], cidx[first]
+            tab[widx, 0] = c1[win].view(np.int32)
+            tab[widx, 1] = c2[win].view(np.int32)
+            tab[widx, 2] = fidv[win]
+            tab[widx, 3] = sidv[win]
+            placed_empty += len(win)
+            pm = np.zeros(n, bool)
+            pm[win] = True
+            unplaced = unplaced[~pm[unplaced]]
+        self._hot_fill += placed_empty
+        self._in_hot.update(a[0] for a in accepted)
+        self._log_resync("shape_hot")
+        for i in unplaced.tolist():
+            # pathological-cluster tail (~load^8): per-entry placement,
+            # which may grow/rebuild the hot segment
+            f = accepted[i][0]
+            self._in_hot.discard(f)  # _place_hot re-registers it
+            self._place_hot(
+                f, int(c1[i]), int(c2[i]), int(fidv[i]), int(sidv[i])
+            )
+
+    def _tomb_hot(self, c1: int, c2: int) -> None:
+        """Tombstone a live hot entry (fid lane -> TOMB_FID: one logged
+        write; the slot stays occupied so probe chains hold)."""
+        slot = slot_hash(c1)
+        step = probe_step(c2)
+        cc1, cc2 = np.int32(np.uint32(c1)), np.int32(np.uint32(c2))
+        for p in range(MAX_PROBES):
+            idx = (slot + p * step) & (self._Hcap - 1)
+            if (
+                self.arr_hot[idx, 2] >= 0
+                and self.arr_hot[idx, 0] == cc1
+                and self.arr_hot[idx, 1] == cc2
+            ):
+                self.arr_hot[idx, 2] = TOMB_FID
+                self._log("shape_hot", idx * 4 + 2, TOMB_FID)
+                self._hot_tombs += 1
+                break
+        if self._hot_tombs * 4 > self._Hcap:
+            self._rebuild_hot()  # cheap: hot is small
+
+    def _tomb_packed(self, c1: int, c2: int) -> None:
+        """Tombstone a packed entry by setting its mask bit — the row is
+        untouched (probe chains hold), the device sees one scattered
+        word, and compaction purges the bit later."""
+        slot = slot_hash(c1)
+        step = probe_step(c2)
+        cc1, cc2 = np.int32(np.uint32(c1)), np.int32(np.uint32(c2))
+        for p in range(MAX_PROBES):
+            idx = (slot + p * step) & (self._Tcap - 1)
+            if (
+                self.arr_table[idx, 2] >= 0
+                and self.arr_table[idx, 0] == cc1
+                and self.arr_table[idx, 1] == cc2
+                and not (self.arr_tomb[idx >> 5] >> (idx & 31)) & 1
+            ):
+                self.arr_tomb[idx >> 5] |= np.uint32(1 << (idx & 31))
+                self._log(
+                    "shape_tomb", idx >> 5, int(self.arr_tomb[idx >> 5])
+                )
+                self._tombs += 1
+                break
 
     @staticmethod
     def _probe_positions(c1: int, c2: int, Tcap: int):
@@ -395,26 +703,57 @@ class ShapeIndex:
                 return tab, newT
             newT *= 2
 
-    def _rehash(self, newT: int) -> None:
-        """Rebuild the table from `_entries` (vectorized placement)."""
-        ents = list(self._entries.values())
-        n = len(ents)
+    def _reset_segments(self) -> None:  # every caller bumps the epoch
+        """Fresh tombstone mask (sized to the packed table) + empty hot
+        segment: the packed rebuild just absorbed everything live."""
+        self.arr_tomb = np.zeros(max(1, self._Tcap // 32), np.uint32)
+        self._tombs = 0
+        self.arr_hot = np.zeros((self._Hcap, 4), np.int32)
+        self.arr_hot[:, 2] = -1
+        self._hot_fill = 0
+        self._hot_tombs = 0
+        self._in_hot = set()
+
+    def _rehash(self, newT: int, extra=()) -> None:
+        """Full rebuild from the LIVE rows (vectorized array scan — no
+        dict walk) — the inline path for restore-scale bulk loads, salt
+        rebuilds and the tombstone safety valve. `extra` rows
+        [(c1, c2, fid, sid)] are not in any array yet (overflowing
+        insert) and ride the same placement. Invalidates any outstanding
+        compaction capture (`_structure_gen`) and absorbs the hot
+        segment."""
+        self._structure_gen += 1
+        self._journal = None
+        live = self._live_rows()
+        n = len(live) + len(extra)
+        while (n + 1) * 2 > newT:
+            newT *= 2
         if n == 0:
             tab = np.zeros((newT, 4), np.int32)
             tab[:, 2] = -1
             self._Tcap = newT
             self.arr_table = tab
             self._fill = 0
+            self._reset_segments()
             self._bump_epoch()
             return
-        sid = np.array([e[0] for e in ents], np.int64)
-        c1 = np.array([e[1] & 0xFFFFFFFF for e in ents], np.uint32)
-        c2 = np.array([e[2] & 0xFFFFFFFF for e in ents], np.uint32)
-        fid = np.array([e[3] for e in ents], np.int64)
+        sid = np.empty(n, np.int64)
+        c1 = np.empty(n, np.uint32)
+        c2 = np.empty(n, np.uint32)
+        fid = np.empty(n, np.int64)
+        k = len(live)
+        sid[:k] = live[:, 3].astype(np.int64)
+        c1[:k] = np.ascontiguousarray(live[:, 0]).view(np.uint32)
+        c2[:k] = np.ascontiguousarray(live[:, 1]).view(np.uint32)
+        fid[:k] = live[:, 2].astype(np.int64)
+        for j, (a, b, f, s) in enumerate(extra):
+            i = k + j
+            sid[i], c1[i], c2[i], fid[i] = s, a & _M32, b & _M32, f
         tab, newT = self._build_table(sid, c1, c2, fid, newT)
         self._Tcap = newT
         self.arr_table = tab
         self._fill = n
+        self._reset_segments()
         self._bump_epoch()
 
     def add(self, filter_: str, fid: int) -> bool:
@@ -428,14 +767,15 @@ class ShapeIndex:
         if sid is None:
             return False
         c1, c2 = combined_pair(prefix, mask, sid, self.salt)
-        other = self._by_key.get((c1, c2))
-        if other is not None and other != filter_:
-            # true 64-bit collision between distinct filters: residual
+        if self._find_live(c1, c2) is not None:
+            # (c1, c2) already live: a true 64-bit collision between
+            # distinct filters (the caller only adds absent filters) —
+            # first-probe-wins lookup cannot hold both, so residual
             self._shape_release(sid, (mask, plen, has_hash))
             return False
-        self._by_key[(c1, c2)] = filter_
-        self._entries[filter_] = (sid, c1, c2, fid)
-        self._place(c1, c2, fid, sid)
+        if self._journal is not None:
+            self._journal.append(("add", filter_, (sid, c1, c2, fid)))
+        self._place_hot(filter_, c1, c2, fid, sid)
         return True
 
     def bulk_add_cold(
@@ -459,7 +799,7 @@ class ShapeIndex:
         reject. Returns the rejected (filter, fid) pairs, in input order,
         for the residual engine. Bit-identical to repeated `add`.
         """
-        assert not self._entries, "bulk_add_cold requires an empty index"
+        assert len(self) == 0, "bulk_add_cold requires an empty index"
         n = len(names)
         rej = np.zeros(n, dtype=bool)
         rej |= unfit
@@ -515,19 +855,18 @@ class ShapeIndex:
         tab, newT = self._build_table(
             sids[keep], c1[keep], c2[keep], fids[keep], newT
         )
+        self._structure_gen += 1
+        self._journal = None
         self._Tcap = newT
         self.arr_table = tab
         self._fill = len(keep)
-        # -- host mirror (lazy: arrays stashed, dicts on first access) ----
+        self._reset_segments()
+        # -- no shadow mirror to build: the packed table IS the host
+        # state (probe lookups + array scans serve every later need) ----
         if rej.any():
-            keep_names = [names[i] for i in keep.tolist()]
-            self._cold = (
-                keep_names, sids[keep], c1[keep], c2[keep], fids[keep]
-            )
             rej_idx = np.nonzero(rej)[0].tolist()
             out = [(names[i], int(fids[i])) for i in rej_idx]
         else:
-            self._cold = (names, sids, c1, c2, fids)
             out = []
         self._bump_epoch()
         return out
@@ -589,48 +928,49 @@ class ShapeIndex:
                 s2 = np.sum(h2 * k2[None, :] * lb, axis=1, dtype=np.uint32)
                 c1s[lo:hi] = _mix32_np(s1 ^ (sids[lo:hi] * np.uint32(FOLD1)))
                 c2s[lo:hi] = _mix32_np(s2 ^ (sids[lo:hi] * np.uint32(FOLD2)))
-        # grow once to the final load factor
-        need = len(self._entries) + len(metas)
-        newT = self._Tcap
-        while (need + 1) * 2 > newT:
-            newT *= 2
+        accepted = []  # (filter, c1, c2, fid, sid)
+        journal = self._journal
+        live_clash = self._find_live_batch(c1s, c2s)  # ONE vector sweep
+        batch_keys: Dict[Tuple[int, int], bool] = {}  # in-batch dups
         for i, (f, fid, sid, key) in enumerate(metas):
             c1, c2 = int(c1s[i]), int(c2s[i])
-            other = self._by_key.get((c1, c2))
-            if other is not None and other != f:
+            if live_clash[i] or (c1, c2) in batch_keys:
+                # live (c1, c2) => a different filter (caller only adds
+                # absent ones): 64-bit collision, route to residual
                 self._shape_release(sid, key)
                 rejected.append((f, fid))
                 continue
-            self._by_key[(c1, c2)] = f
-            self._entries[f] = (sid, c1, c2, fid)
-        self._rehash(newT)  # places everything; bumps epoch once
+            batch_keys[(c1, c2)] = True
+            if journal is not None:
+                journal.append(("add", f, (sid, c1, c2, fid)))
+            accepted.append((f, c1, c2, fid, sid))
+        # churn-scale batches land in the hot segment (one vectorized
+        # placement + one small re-upload; the packed table is never
+        # touched); restore-scale batches fall through to a full rebuild
+        # inside _bulk_place_hot
+        self._bulk_place_hot(accepted)
         return rejected
 
     def remove(self, filter_: str) -> bool:
-        ent = self._entries.pop(filter_, None)
+        ent = self._ent_of(filter_)
         if ent is None:
             return False
-        sid, c1, c2, _fid = ent
-        self._by_key.pop((c1, c2), None)
-        slot = slot_hash(c1)
-        step = probe_step(c2)
-        cc1, cc2 = np.int32(np.uint32(c1)), np.int32(np.uint32(c2))
-        for p in range(MAX_PROBES):
-            idx = (slot + p * step) & (self._Tcap - 1)
-            if (
-                self.arr_table[idx, 2] >= 0
-                and self.arr_table[idx, 0] == cc1
-                and self.arr_table[idx, 1] == cc2
-            ):
-                self.arr_table[idx, 2] = TOMB_FID
-                self._log("shape_tab", idx * 4 + 2, TOMB_FID)
-                break
+        sid, c1, c2, fid = ent
+        if self._journal is not None:
+            self._journal.append(("remove", filter_, ent))
+        if filter_ in self._in_hot:
+            self._in_hot.discard(filter_)
+            self._tomb_hot(c1, c2)
+        else:
+            self._tomb_packed(c1, c2)
         parsed = self.parse_shape(filter_)
         if parsed is not None:
             mask, plen, has_hash, _ = parsed
             self._shape_release(sid, (mask, plen, has_hash))
-        if (self._fill - len(self._entries)) * 4 > self._Tcap:
-            self._rehash(self._Tcap)  # compact tombstones in place
+        if self._tombs * 2 > self._Tcap:
+            # safety valve only: background compaction (SegmentCompactor)
+            # normally purges tombstones long before half the table dies
+            self._rehash(self._Tcap)
         return True
 
     def rebuild(self, salt: int) -> List[Tuple[str, int]]:
@@ -644,27 +984,121 @@ class ShapeIndex:
         evictees in the residual NFA engine.
         """
         self.salt = salt
-        entries = list(self._entries.items())
-        self._by_key.clear()
+        if self.resolve_name is None:
+            raise RuntimeError(
+                "ShapeIndex.rebuild needs resolve_name (fid -> filter) "
+                "to re-hash entries under the new salt"
+            )
+        live = self._live_rows()
+        seen: Dict[Tuple[int, int], bool] = {}
+        rows: List[Tuple[int, int, int, int]] = []
         evicted: List[Tuple[str, int]] = []
-        for f, (sid, _c1, _c2, fid) in entries:
+        for fid, sid in zip(
+            live[:, 2].astype(np.int64).tolist(),
+            live[:, 3].astype(np.int64).tolist(),
+        ):
+            f = self.resolve_name(int(fid))
             parsed = self.parse_shape(f)
             mask, plen, has_hash, prefix = parsed
             c1, c2 = combined_pair(prefix, mask, sid, salt)
-            if (c1, c2) in self._by_key:
-                del self._entries[f]
+            if (c1, c2) in seen:
                 self._shape_release(sid, (mask, plen, has_hash))
-                evicted.append((f, fid))
+                evicted.append((f, int(fid)))
                 continue
-            self._entries[f] = (sid, c1, c2, fid)
-            self._by_key[(c1, c2)] = f
-        self._rehash(self._Tcap)
+            seen[(c1, c2)] = True
+            rows.append((c1, c2, int(fid), int(sid)))
+        # drop EVERYTHING live (the old-salt rows are all stale) and
+        # rebuild from the re-hashed rows only
+        self.arr_table[:, 2] = -1
+        self._fill = 0
+        self._reset_segments()
+        self._rehash(self._Tcap, extra=rows)
         return evicted
 
     def __len__(self) -> int:
-        if self._cold is not None:
-            return len(self._entries_d) + len(self._cold[0])
-        return len(self._entries_d)
+        return (
+            self._fill
+            - self._tombs
+            + self._hot_fill
+            - self._hot_tombs
+        )
+
+    # -- background compaction (ops/segments.SegmentCompactor) -------------
+    # One cycle: begin() on the mutating thread (array memcpys + journal
+    # on), build_compact() anywhere (pure numpy over the capture),
+    # apply_compact() back on the mutating thread (swap + journal
+    # replay). A structural rebuild racing the build (_rehash/cold load)
+    # bumps `_structure_gen` and the apply aborts cleanly.
+
+    def begin_compact(self) -> Dict:
+        """Capture a consistent array snapshot (fast memcpys — never the
+        10M-entry host dicts) and start journaling mutations."""
+        cap = {
+            "tab": self.arr_table.copy(),
+            "tomb": self.arr_tomb.copy(),
+            "hot": self.arr_hot.copy(),
+            "Tcap": self._Tcap,
+            "gen": self._structure_gen,
+        }
+        self._journal = []
+        return cap
+
+    @staticmethod
+    def build_compact(cap: Dict) -> Dict:
+        """Merge `packed − tombstones + hot` into a fresh packed table.
+        Pure numpy over the capture — safe on any thread, off the
+        subscribe path entirely."""
+        tab, Tcap = cap["tab"], cap["Tcap"]
+        idx = np.nonzero(tab[:, 2] >= 0)[0]
+        tword = cap["tomb"][idx >> 5]
+        dead = (tword >> (idx & 31).astype(np.uint32)) & np.uint32(1)
+        rows = [tab[idx[dead == 0]]]
+        hot = cap["hot"]
+        rows.append(hot[hot[:, 2] >= 0])
+        live = np.concatenate(rows, axis=0)
+        n = len(live)
+        newT = 1024
+        while (n + 1) * 2 > newT:
+            newT *= 2
+        if n:
+            tab2, newT = ShapeIndex._build_table(
+                live[:, 3].astype(np.int64),
+                np.ascontiguousarray(live[:, 0]).view(np.uint32),
+                np.ascontiguousarray(live[:, 1]).view(np.uint32),
+                live[:, 2].astype(np.int64),
+                newT,
+            )
+        else:
+            tab2 = np.zeros((newT, 4), np.int32)
+            tab2[:, 2] = -1
+        return {"tab": tab2, "Tcap": newT, "gen": cap["gen"], "n": n}
+
+    def apply_compact(self, built: Dict) -> Optional[int]:
+        """Install a built packed table (mutating thread). The journal of
+        mutations that raced the build replays on top — adds re-place
+        into the (fresh) hot segment, removes re-tombstone — so the
+        result is bit-equivalent to having paused the world. Returns the
+        new epoch (for `DeviceSegmentManager.offer`), or None when a
+        structural rebuild invalidated the capture."""
+        if self._journal is None or built["gen"] != self._structure_gen:
+            self._journal = None
+            return None
+        journal, self._journal = self._journal, None
+        self._structure_gen += 1
+        self._Tcap = built["Tcap"]
+        self.arr_table = built["tab"]
+        self._fill = built["n"]
+        self._reset_segments()
+        self._bump_epoch()
+        for op, f, (sid, c1, c2, fid) in journal:
+            if op == "add":
+                self._place_hot(f, c1, c2, fid, sid)
+            elif f in self._in_hot:  # remove of a journal-replayed add
+                self._in_hot.discard(f)
+                self._tomb_hot(c1, c2)
+            else:  # remove of an entry the build merged into packed
+                self._tomb_packed(c1, c2)
+        return self.epoch
 
 
 # -- device kernel ---------------------------------------------------------
@@ -678,9 +1112,17 @@ def shape_match_device(
     tables: device dict (shape_tab FLAT [T*4] i32 — kept one-dimensional
     because a [T, 4] s32 operand pads its minor dim 4 -> 128 under TPU
     tiling, a 32x HBM expansion that OOMs at 10M-filter scale;
-    shape_mask/len/flags [Mcap])
+    shape_hot FLAT [H*4] i32 hot segment; shape_tomb u32 [T/32]
+    packed-row tombstone mask; shape_mask/len/flags [Mcap])
     h1, h2: uint32 [B, L] per-level word hashes; nwords [B]; dollar [B]
     -> matched fid int32 [B, M] (-1 = no match; SPARSE, not compacted)
+
+    The match is ``packed ∪ hot − tombstones`` in ONE program: the
+    packed probe loop masks hits through the tombstone bitmask, then the
+    same (c1, c2) pair probes the small hot segment — a subscribe is
+    routable the moment its hot-segment scatter lands, with no repack
+    and no program change (the hot table is always probed, so the
+    compiled program is stable across empty/full hot states).
     """
     import jax
     import jax.numpy as jnp
@@ -692,6 +1134,9 @@ def shape_match_device(
     flags = tables["shape_flags"][:M]
     tab = tables["shape_tab"]  # [T*4] flat row-major
     Tcap = tab.shape[0] // 4
+    hot = tables["shape_hot"]  # [H*4] flat row-major
+    Hcap = hot.shape[0] // 4
+    tomb = tables["shape_tomb"]  # uint32 [Tcap/32] packed tombstone bits
 
     lvl = jnp.arange(L, dtype=jnp.int32)
     lvl_bit = (mask[None, :] >> lvl[:, None]) & 1  # [L, M]
@@ -725,6 +1170,7 @@ def shape_match_device(
     fid = jnp.full((B, M), -1, dtype=jnp.int32)
     found = jnp.zeros((B, M), dtype=bool)
     tmask = jnp.uint32(Tcap - 1)
+    sid_lane = jnp.arange(M, dtype=jnp.int32)[None, :]
     for p in range(probes):
         idx = ((slot + jnp.uint32(p) * step) & tmask).astype(jnp.int32)
         base4 = idx * 4  # flat row offset (4 x 1D gathers: the 2D form
@@ -733,10 +1179,39 @@ def shape_match_device(
         r_c2 = tab[base4 + 1]
         r_fid = tab[base4 + 2]
         r_sid = tab[base4 + 3]
+        # tombstone mask: an unsubscribed packed row stays in place (its
+        # probe chain holds) but may not match
+        tword = tomb[idx >> 5]
+        t_dead = (
+            (tword >> (idx & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        ) != 0
         hit = (
             (r_c1 == c1i)
             & (r_c2 == c2i)
-            & (r_sid == jnp.arange(M, dtype=jnp.int32)[None, :])
+            & (r_sid == sid_lane)
+            & (r_fid >= 0)
+            & ~t_dead
+            & valid
+            & ~found
+        )
+        fid = jnp.where(hit, r_fid, fid)
+        found |= hit
+    # hot segment: same probe sequence over the small overlay table
+    # (entries subscribed since the last compaction). Host add keeps
+    # (c1, c2) unique across packed-live and hot, so chaining on `found`
+    # is dedup enough.
+    hmask = jnp.uint32(Hcap - 1)
+    for p in range(probes):
+        idx = ((slot + jnp.uint32(p) * step) & hmask).astype(jnp.int32)
+        base4 = idx * 4
+        r_c1 = hot[base4]
+        r_c2 = hot[base4 + 1]
+        r_fid = hot[base4 + 2]
+        r_sid = hot[base4 + 3]
+        hit = (
+            (r_c1 == c1i)
+            & (r_c2 == c2i)
+            & (r_sid == sid_lane)
             & (r_fid >= 0)
             & valid
             & ~found

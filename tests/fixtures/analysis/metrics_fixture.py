@@ -19,6 +19,8 @@ declare("olp.lag_ms", "gauge")
 declare("olp.trips", COUNTER)
 declare("racetrack.events", COUNTER)
 declare("race.reports", COUNTER)
+declare("router.segment.hot.fill", "gauge")
+declare("router.compact.runs", COUNTER)
 
 
 class M:
@@ -45,6 +47,8 @@ def good(m: M):
     m.inc("olp.trips")
     m.inc("racetrack.events")
     m.inc("race.reports")
+    m.gauge_set("router.segment.hot.fill", 3)
+    m.inc("router.compact.runs")
 
 
 def bad(m: M):
@@ -58,5 +62,7 @@ def bad(m: M):
     m.inc("retained.storm.fuzed")  # MN001: typo'd storm series
     m.gauge_set("olp.lag_mz", 1)  # MN001: typo'd olp gauge
     m.inc("olp.tripz")  # MN001: typo'd olp trip counter
+    m.gauge_set("router.segment.hot.fil", 1)  # MN001: typo'd segment gauge
+    m.inc("router.compact.runz")  # MN001: typo'd compaction counter
     m.inc("racetrack.eventz")  # MN001: typo'd race-harness counter
     m.inc("race.reportz")  # MN001: typo'd race-report counter

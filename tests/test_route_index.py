@@ -9,6 +9,7 @@ Reference correctness analogs: emqx_trie_SUITE / emqx_router_SUITE.
 
 import random
 
+import numpy as np
 import pytest
 
 from emqx_tpu.broker.trie import TopicTrie
@@ -164,18 +165,33 @@ def test_place_within_device_probe_bound():
     si = ShapeIndex()
     for i in range(5000):
         si.add(f"org/{i % 30}/dev/{i % 997}/x{i}", i)
-    for f, (sid, c1, c2, fid) in si._entries.items():
+
+    def within_bound(tab, cap, c1, c2, fid, sid):
         base = slot_hash(c1)
         step = probe_step(c2)
         for p in range(SHAPE_PROBES):
-            idx = (base + p * step) & (si._Tcap - 1)
-            if (
-                si.arr_table[idx, 2] == fid
-                and si.arr_table[idx, 3] == sid
-            ):
-                break
-        else:
-            raise AssertionError(f"{f} placed beyond probe bound")
+            idx = (base + p * step) & (cap - 1)
+            if tab[idx, 2] == fid and tab[idx, 3] == sid:
+                return True
+        return False
+
+    # incremental adds live in the hot segment (or the packed table after
+    # an inline fold) — either way, within the shared device probe bound
+    for row in si._live_rows():
+        c1, c2 = int(np.uint32(row[0])), int(np.uint32(row[1]))
+        fid, sid = int(row[2]), int(row[3])
+        assert within_bound(
+            si.arr_hot, si._Hcap, c1, c2, fid, sid
+        ) or within_bound(si.arr_table, si._Tcap, c1, c2, fid, sid), fid
+    # compaction merges hot into packed; every entry must then sit in the
+    # PACKED table within the same bound
+    built = ShapeIndex.build_compact(si.begin_compact())
+    assert si.apply_compact(built) is not None
+    assert si.hot_live == 0
+    for row in si._live_rows():
+        c1, c2 = int(np.uint32(row[0])), int(np.uint32(row[1]))
+        fid, sid = int(row[2]), int(row[3])
+        assert within_bound(si.arr_table, si._Tcap, c1, c2, fid, sid), fid
 
 
 def test_parse_shape():
@@ -249,11 +265,12 @@ def test_bulk_add_equivalent_to_incremental():
     fids_blk = blk.bulk_add(filters)
     assert fids_inc == fids_blk
     assert blk.residual_count == inc.residual_count
-    # identical hash entries per filter
+    # identical hash entries per filter (recomputed probe lookups)
     for f in filters:
         if f in blk._residual:
             continue
-        assert blk.shapes._entries[f] == inc.shapes._entries[f], f
+        assert blk.shapes._ent_of(f) == inc.shapes._ent_of(f), f
+        assert blk.shapes._ent_of(f) is not None, f
     # refcount semantics: bulk over existing refs
     again = blk.bulk_add(filters[:10])
     assert again == fids_blk[:10]
@@ -275,5 +292,5 @@ def test_bulk_add_rejects_invalid_atomically():
     assert len(idx) == 0
     assert idx.filter_id("ok/t") is None
     fid = idx.add("ok/t")  # still fully indexable afterwards
-    assert idx.shapes._entries.get("ok/t") is not None
+    assert idx.shapes._ent_of("ok/t") is not None
     assert fid == 0

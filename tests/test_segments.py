@@ -1,0 +1,420 @@
+"""Segmented device tables (ops/segments.py + the shape-index hot
+segment): O(delta) subscribe/unsubscribe on ONE unified manager.
+
+Pins the PR's contracts:
+- the op-log suffix replays as ONE fused device launch, whatever mix of
+  arrays it touched;
+- per-array resync markers re-upload ONLY the rebuilt array (hot-segment
+  growth never re-ships the packed table);
+- compaction's offered buffers are adopted when fresh, ignored when a
+  later structural event superseded them;
+- ANY interleaving of subscribe/unsubscribe/compact yields recipient
+  sets identical to a from-scratch rebuild — including tombstoned
+  resubscribe and compaction racing an in-flight launch;
+- the background-compaction thread discipline is racetrack-clean, and a
+  seeded UNdisciplined compactor is detected.
+"""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.trie import TopicTrie
+from emqx_tpu.models.router_model import DeviceRouter, SubscriberTable
+from emqx_tpu.ops import segments as seg
+from emqx_tpu.ops.matcher import MatcherConfig
+from emqx_tpu.ops.route_index import RouteIndex
+from emqx_tpu.ops.segments import (
+    DeviceSegmentManager,
+    SegmentCompactor,
+    ShapeSegmentOwner,
+)
+from emqx_tpu.ops.shape_index import ShapeIndex
+
+
+@pytest.fixture
+def scatter_calls(monkeypatch):
+    """Count fused delta launches (module-global seam)."""
+    calls = []
+    real = seg._segment_scatter
+
+    def spy(flats, idxs, vals):
+        calls.append(sorted(flats))
+        return real(flats, idxs, vals)
+
+    monkeypatch.setattr(seg, "_segment_scatter", spy)
+    return calls
+
+
+# -- manager units -----------------------------------------------------------
+
+
+class TestManagerDelta:
+    def test_multi_array_suffix_replays_as_one_launch(self, scatter_calls):
+        si = ShapeIndex()
+        man = DeviceSegmentManager(name="t")
+        si.add("a/+/c", 0)
+        man.sync(si)  # full upload
+        assert scatter_calls == []
+        # churn touching several arrays: hot rows + shape meta
+        si.add("x/y/#", 1)
+        si.add("q/+", 2)
+        si.remove("a/+/c")
+        out = man.sync(si)
+        assert len(scatter_calls) == 1  # ONE launch for the whole suffix
+        assert len(scatter_calls[0]) >= 2  # multiple arrays rode it
+        # and the mirror matches the host state bit-for-bit
+        for k, v in si.device_snapshot().items():
+            assert np.array_equal(np.asarray(out[k]), v.reshape(-1) if
+                                  v.ndim > 1 else v), k
+
+    def test_clean_sync_is_free(self, scatter_calls):
+        si = ShapeIndex()
+        si.add("a/b", 0)
+        man = DeviceSegmentManager()
+        first = man.sync(si)
+        again = man.sync(si)
+        assert scatter_calls == []
+        assert all(again[k] is first[k] for k in first)
+
+    def test_resync_marker_reuploads_only_that_array(self):
+        si = ShapeIndex()
+        man = DeviceSegmentManager(name="t")
+        for i in range(4):
+            si.add(f"s/{i}/+", i)
+        out0 = man.sync(si)
+        packed0 = out0["shape_tab"]
+        # force hot-segment growth: rebuild + "!resync shape_hot" marker
+        si._rebuild_hot(min_cap=si._Hcap * 2)
+        assert si.epoch == 0  # NOT a structural epoch bump
+        out1 = man.sync(si)
+        assert man.array_resyncs >= 1
+        assert out1["shape_tab"] is packed0  # packed mirror untouched
+        assert out1["shape_hot"].shape[0] == si._Hcap * 4
+
+    def test_offer_adopted_when_fresh_and_ignored_when_stale(self):
+        import jax
+
+        si = ShapeIndex()
+        for i in range(8):
+            si.add(f"o/{i}/+", i)
+        man = DeviceSegmentManager()
+        man.sync(si)
+        built = ShapeIndex.build_compact(si.begin_compact())
+        dev = jax.device_put(built["tab"].reshape(-1))
+        epoch = si.apply_compact(built)
+        assert epoch is not None
+        man.offer(epoch, {"shape_tab": dev}, pos=0)
+        out = man.sync(si)
+        assert out["shape_tab"] is dev  # adopted, not re-uploaded
+        # a later structural event makes a pending offer stale
+        man.offer(epoch, {"shape_tab": dev}, pos=0)
+        si._rehash(si._Tcap)  # epoch bump
+        out2 = man.sync(si)
+        assert out2["shape_tab"] is not dev
+        assert np.array_equal(
+            np.asarray(out2["shape_tab"]), si.arr_table.reshape(-1)
+        )
+
+    def test_torn_offthread_sync_is_never_cached_clean(self):
+        si = ShapeIndex()
+        si.add("a/+", 0)
+        man = DeviceSegmentManager()
+
+        real = si.device_snapshot
+
+        def torn_snapshot():
+            out = real()
+            si.add("raced/+", 99)  # a mutation lands mid-upload
+            return out
+
+        si.device_snapshot = torn_snapshot
+        man.sync(si)
+        si.device_snapshot = real
+        full0 = man.full_resyncs
+        man.sync(si)  # torn: must re-upload, not serve the cached mirror
+        assert man.full_resyncs == full0 + 1
+        out = man.sync(si)
+        assert np.array_equal(
+            np.asarray(out["shape_hot"]), si.arr_hot.reshape(-1)
+        )
+
+
+# -- churn equivalence (the property the whole PR hangs on) ------------------
+
+
+def _fresh_pair(live):
+    """From-scratch rebuild of the live set: reference semantics."""
+    idx = RouteIndex()
+    trie = TopicTrie()
+    for f in sorted(live):
+        idx.add(f)
+        trie.insert(f)
+    return idx, trie
+
+
+def _assert_matches_rebuild(idx, live, topics):
+    """Device match over the segmented index == from-scratch rebuild."""
+    _idx2, trie = _fresh_pair(live)
+    dev = DeviceRouter(idx, None, MatcherConfig(max_levels=8))
+    got = dev.match_batch(list(topics), fallback=trie.match)
+    for t, names in zip(topics, got):
+        assert sorted(names) == sorted(trie.match(t)), t
+
+
+class TestChurnEquivalence:
+    PROBES = [
+        "dev/3/x/t1", "dev/17/s", "dev/900/x/t5", "a/b/c", "dev/42/x/t0",
+        "dev/7/y/t0", "other/x",
+    ]
+
+    def test_interleaved_subscribe_unsubscribe_compact(self):
+        """Random interleaving of add/remove/compact — every probe point
+        must match a from-scratch rebuild exactly."""
+        random.seed(190)
+        idx = RouteIndex()
+        live = set()
+        compactor = SegmentCompactor()
+        owner = ShapeSegmentOwner(
+            idx.shapes, DeviceSegmentManager(), hot_entries=1
+        )
+        for step in range(900):
+            r = random.random()
+            if live and r < 0.35:
+                f = random.choice(sorted(live))
+                live.discard(f)
+                idx.remove(f)
+            elif r < 0.38 and step > 50:
+                assert compactor.compact_now(owner)
+            else:
+                i = random.randrange(400)
+                f = f"dev/{i}/+/t{i % 7}" if i % 3 else f"dev/{i}/s"
+                if f not in live:
+                    live.add(f)
+                    idx.add(f)
+            if step % 150 == 149:
+                _assert_matches_rebuild(idx, live, self.PROBES)
+        _assert_matches_rebuild(idx, live, self.PROBES)
+
+    def test_tombstoned_resubscribe(self):
+        """remove (packed tombstone) then re-add: the hot entry must win
+        over the masked packed row, and compaction must converge."""
+        idx = RouteIndex()
+        live = set()
+        for i in range(40):
+            f = f"site/{i}/+"
+            idx.add(f)
+            live.add(f)
+        # force everything into packed
+        owner = ShapeSegmentOwner(
+            idx.shapes, DeviceSegmentManager(), hot_entries=1
+        )
+        SegmentCompactor().compact_now(owner)
+        assert idx.shapes.hot_live == 0
+        idx.remove("site/7/+")
+        assert idx.shapes.packed_tombstones == 1
+        idx.add("site/7/+")  # resubscribe: lands in hot; packed row dead
+        assert idx.shapes.hot_live == 1
+        _assert_matches_rebuild(idx, live, ["site/7/x", "site/8/x"])
+        SegmentCompactor().compact_now(owner)
+        assert idx.shapes.packed_tombstones == 0
+        _assert_matches_rebuild(idx, live, ["site/7/x", "site/8/x"])
+
+    def test_compaction_racing_a_launch(self):
+        """A batch prepared BEFORE compaction must still serve correct
+        results from its (retired-with-grace) snapshot, and the next
+        prepare adopts the compacted tables."""
+        idx = RouteIndex()
+        subs = SubscriberTable(max_subscribers=64)
+        for i in range(32):
+            fid = idx.add(f"r/{i}/+")
+            subs.add(fid, i)
+        dev = DeviceRouter(
+            idx, subs, MatcherConfig(max_levels=8, fanout_compact=False)
+        )
+        args_old = dev.prepare()  # in-flight batch holds this snapshot
+        owner = ShapeSegmentOwner(
+            idx.shapes, dev._shape_sync, hot_entries=1
+        )
+        assert SegmentCompactor().compact_now(owner)
+        topics = [f"r/{i}/x" for i in range(32)]
+        res_old = dev.route_prepared(args_old, topics)
+        res_new = dev.route(topics)
+        assert np.array_equal(res_old.mcount, res_new.mcount)
+        assert np.array_equal(
+            np.sort(res_old.matched, axis=1),
+            np.sort(res_new.matched, axis=1),
+        )
+        assert np.array_equal(res_old.bitmaps, res_new.bitmaps)
+
+    def test_mutations_racing_a_background_build_replay_from_journal(self):
+        """begin -> (mutations land) -> build -> apply: the journal
+        replays the racing mutations, bit-equivalent to a world-stop."""
+        idx = RouteIndex()
+        live = set()
+        for i in range(60):
+            f = f"j/{i}/+"
+            idx.add(f)
+            live.add(f)
+        cap = idx.shapes.begin_compact()
+        # mutations race the (conceptual) background build
+        idx.remove("j/3/+")
+        live.discard("j/3/+")
+        idx.add("j/new/+")
+        live.add("j/new/+")
+        idx.remove("j/new/+")  # add-then-remove inside the window
+        live.discard("j/new/+")
+        idx.add("j/also/+")
+        live.add("j/also/+")
+        built = ShapeIndex.build_compact(cap)
+        assert idx.shapes.apply_compact(built) is not None
+        _assert_matches_rebuild(
+            idx, live, ["j/3/x", "j/new/x", "j/also/x", "j/5/x"]
+        )
+
+    def test_structural_rebuild_aborts_the_capture(self):
+        idx = RouteIndex()
+        for i in range(10):
+            idx.add(f"s/{i}/+")
+        cap = idx.shapes.begin_compact()
+        idx.shapes._rehash(idx.shapes._Tcap)  # structural event
+        built = ShapeIndex.build_compact(cap)
+        assert idx.shapes.apply_compact(built) is None  # clean abort
+
+    def test_bulk_churn_absorbs_into_hot_without_rebuild(self):
+        """Warm bulk_add (mass reconnect) must land in the hot segment:
+        no epoch bump, no packed-table rebuild, one resync marker."""
+        idx = RouteIndex()
+        fids = idx.bulk_add([f"cold/{i}/+" for i in range(500)])
+        assert len(set(fids)) == 500
+        epoch0 = idx.shapes.epoch
+        packed0 = idx.shapes.arr_table
+        idx.bulk_add([f"storm/{i}/+/x" for i in range(2000)])
+        assert idx.shapes.epoch == epoch0  # no full re-upload
+        assert idx.shapes.arr_table is packed0  # packed untouched
+        assert idx.shapes.hot_live == 2000
+        live = {f"cold/{i}/+" for i in range(500)} | {
+            f"storm/{i}/+/x" for i in range(2000)
+        }
+        _assert_matches_rebuild(
+            idx, live, ["cold/3/q", "storm/7/q/x", "storm/1999/z/x"]
+        )
+
+
+# -- retained chunks on the manager ------------------------------------------
+
+
+class TestRetainedSegments:
+    def test_retained_churn_is_row_deltas_not_chunk_reuploads(self):
+        from emqx_tpu.models.retained_index import DeviceRetainedIndex
+
+        dev = DeviceRetainedIndex(max_bytes=32)
+        dev.bulk_add([f"s/{i}/t" for i in range(64)])
+        assert dev.match("s/+/t") is not None
+        full0 = dev._seg.full_resyncs
+        dev.add("s/extra/t")
+        dev.remove("s/3/t")
+        got = dev.match("s/+/t")
+        assert dev._seg.full_resyncs == full0  # deltas, no full upload
+        assert dev._seg.delta_launches >= 1
+        want = sorted(
+            [f"s/{i}/t" for i in range(64) if i != 3] + ["s/extra/t"]
+        )
+        assert sorted(got) == want
+
+    def test_bucket_growth_is_the_only_full_reupload(self):
+        from emqx_tpu.models.retained_index import DeviceRetainedIndex
+
+        dev = DeviceRetainedIndex(max_bytes=64)
+        dev.bulk_add(["a/b"])
+        dev.match("a/+")
+        full0 = dev._seg.full_resyncs
+        dev.add("a/" + "x" * 30)  # exceeds the 16-byte bucket
+        dev.match("a/+")
+        assert dev._seg.full_resyncs == full0 + 1
+
+
+# -- racetrack: the background-compaction discipline -------------------------
+
+
+@pytest.mark.race
+def test_disciplined_compaction_cycle_is_race_clean():
+    """The PR 8 shape: segment-compact thread vs loop-side inserts. The
+    capture/journal discipline means the build thread only touches its
+    immutable capture — racetrack armed over the index and manager must
+    stay silent through a full seeded cycle."""
+    from emqx_tpu.observe.racetrack import RaceTracker
+
+    idx = RouteIndex()
+    for i in range(64):
+        idx.add(f"rc/{i}/+")
+    man = DeviceSegmentManager()
+    man.sync(idx.shapes)
+    tracker = RaceTracker()
+    tracker.watch(idx.shapes, name="ShapeIndex")
+    tracker.watch(man, name="SegmentManager")
+    tracker.arm()
+    try:
+        cap = idx.shapes.begin_compact()
+        done = threading.Event()
+        built_box = {}
+
+        def build():
+            built_box["b"] = ShapeIndex.build_compact(cap)
+            done.set()
+
+        t = threading.Thread(target=build, name="segment-compact-t")
+        t.start()
+        # loop-side churn racing the build
+        idx.add("rc/racing/+")
+        idx.remove("rc/5/+")
+        assert done.wait(10)
+        t.join(5)
+        assert idx.shapes.apply_compact(built_box["b"]) is not None
+        man.sync(idx.shapes)
+    finally:
+        tracker.disarm()
+    races = tracker.unwaived_reports()
+    assert not races, "\n".join(r.render() for r in races)
+
+
+@pytest.mark.race
+def test_undisciplined_compactor_is_detected():
+    """Negative control: a compactor that rebuilds the LIVE arrays from
+    its thread (instead of a capture) races loop-side inserts — the
+    harness must report it."""
+    from emqx_tpu.observe.racetrack import RaceTracker
+
+    idx = RouteIndex()
+    for i in range(16):
+        idx.add(f"bad/{i}/+")
+    tracker = RaceTracker()
+    tracker.watch(idx.shapes, name="ShapeIndex",
+                  fields=["_fill", "_tombs"])
+    tracker.arm()
+    try:
+        handoff = threading.Event()
+
+        def bad_compactor():
+            # mutates live index state off-thread: the bug the
+            # begin/build/apply split exists to prevent
+            idx.shapes._fill = idx.shapes._fill
+            idx.shapes._tombs = 0
+            handoff.set()
+
+        def loop_side():
+            assert handoff.wait(5)
+            idx.shapes._fill = idx.shapes._fill + 0
+            idx.shapes._tombs = 1
+
+        t1 = threading.Thread(target=bad_compactor, name="bad-compact")
+        t2 = threading.Thread(target=loop_side, name="loop-side")
+        t1.start()
+        t2.start()
+        t1.join(5)
+        t2.join(5)
+    finally:
+        tracker.disarm()
+    assert tracker.unwaived_reports(), "seeded undisciplined write missed"

@@ -141,6 +141,7 @@ def test_metric_checker_flags_undeclared_series():
         "trace.spans.samplid", "device.compile.cout",
         "router.sync.skiped", "ingest.device.idle.secondz",
         "retained.storm.fuzed", "olp.lag_mz", "olp.tripz",
+        "router.segment.hot.fil", "router.compact.runz",
         "racetrack.eventz", "race.reportz",
     }
 

@@ -232,7 +232,13 @@ def _harness(name: str):
     None for registered kernels the audit has no recipe for."""
     import numpy as np
 
-    if name == "compact_fanout_slots":
+    if name == "segment_scatter_insert":
+        # B = the pow2 delta bucket; two buckets pin the recompile story
+        configs = [
+            {"B": 16, "kslot": 0},
+            {"B": 64, "kslot": 0},
+        ]
+    elif name == "compact_fanout_slots":
         # kslot=0 means "compaction off" — the stage never traces
         configs = [
             {"B": 8, "kslot": 8},
@@ -258,6 +264,21 @@ def _harness(name: str):
         bits = subs.pack(index.num_filters_capacity)
         salt = index.salt
         kw = dict(max_levels=8, frontier=8, max_matches=8, probes=8)
+        if name == "segment_scatter_insert":
+            from emqx_tpu.ops.segments import segment_scatter_impl
+
+            nb = cfg["B"]
+            flats = {
+                "shape_tab": np.full(4096, -1, np.int32),
+                "sub_bitmaps": np.zeros(2048, np.uint32),
+            }
+            idxs = {
+                k: np.arange(nb, dtype=np.int32) for k in flats
+            }
+            vals = {
+                k: np.ones(nb, v.dtype) for k, v in flats.items()
+            }
+            return segment_scatter_impl, (flats, idxs, vals)
         if name == "compact_fanout_slots":
             from emqx_tpu.models.router_model import compact_fanout_slots
 

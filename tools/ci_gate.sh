@@ -15,6 +15,17 @@
 #   tools/ci_gate.sh --fast     # lint scoped to git-touched files
 #                               # (--changed-only --jobs 8) + race suite
 #
+# Bench recipes (slow — NOT part of tier-1 or this gate; run when a PR
+# touches the paths they measure):
+#
+#   python bench.py --configs chaos_soak    # degradation ladder gate
+#   python bench.py churn_storm             # segmented update path at
+#                                           # 10M subs (~3-4 min): gates
+#                                           # >1M inserts/s and <10ms
+#                                           # subscribe visibility
+#                                           # (docs/update_path.md)
+#   python bench.py                         # full sweep (BENCH json)
+#
 # Exit non-zero on the first failing gate.
 set -euo pipefail
 
