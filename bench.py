@@ -784,6 +784,356 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
     return out
 
 
+# -- mesh_serving: scale-out sharded serving (ROADMAP item 4) ----------------
+# The broker scenario matrix from "Benchmarking Message Brokers for IoT
+# Edge Computing" (PAPERS.md), served through the REAL mesh entry:
+# subscription table sharded over 'tp', ingest batches over 'dp', the
+# MeshServingRouter engine (dist_shape_step / dist_fused_step). Three
+# scales: "full" (8 real devices, 100M-subscription table), "proxy"
+# (2-shard CPU stand-in so tier-1-adjacent runs exercise the config),
+# and "dryrun" (tiny; rides the driver's dryrun_multichip gate so the
+# per-scenario RPS land in the MULTICHIP json).
+
+MESH_SCALES = {
+    "dryrun": dict(
+        devices=8, tp=2, mass_filters=256, mass_slots=1 << 12,
+        mass_bits=50_000, hot=128, wide=64, share=4, retained=2_000,
+        msgs=1024, storm_filters=8, max_batch=256,
+    ),
+    "proxy": dict(
+        devices=2, tp=2, mass_filters=1024, mass_slots=1 << 14,
+        mass_bits=1_000_000, hot=256, wide=128, share=8,
+        retained=20_000, msgs=4096, storm_filters=16, max_batch=1024,
+    ),
+    "full": dict(
+        devices=8, tp=2, mass_filters=32_768, mass_slots=1 << 20,
+        mass_bits=100_000_000, hot=1024, wide=2048, share=16,
+        retained=1_000_000, msgs=65_536, storm_filters=64,
+        max_batch=4096,
+    ),
+}
+
+_POP8 = None
+
+
+def _popcount_words(arr) -> int:
+    """Chunked uint32-word popcount (the 100M-bit table never fits an
+    unpackbits materialization)."""
+    global _POP8
+    if _POP8 is None:
+        _POP8 = np.array(
+            [bin(i).count("1") for i in range(256)], np.uint64
+        )
+    flat = arr.reshape(-1).view(np.uint8)
+    total = 0
+    step = 1 << 26  # 64MB slabs
+    for i in range(0, flat.size, step):
+        total += int(_POP8[flat[i : i + step]].sum())
+    return total
+
+
+def _build_mesh_workload(b, scale, rng):
+    """Hot serving filters with REAL subscriber objects (what the host
+    fan-out delivers to) + the mass table loaded through the segment
+    path (bulk bitmap bits on filters the publish topics never match —
+    passive weight the device gathers over every batch, exactly the
+    100M-subscription condition the scenario matrix serves under)."""
+    from emqx_tpu.mqtt import packet as pkt
+
+    counters = {"fan_in": [0], "fan_out": [0], "share": [0]}
+
+    def deliver_for(key):
+        c = counters[key]
+
+        def deliver(m, o):
+            c[0] += 1
+
+        return deliver
+
+    sid = 0
+    for i in range(scale["hot"]):
+        b.subscribe(f"s{sid}", f"c{sid}", f"fin/{i}/+",
+                    pkt.SubOpts(), deliver_for("fan_in"))
+        sid += 1
+    for i in range(scale["wide"]):
+        b.subscribe(f"s{sid}", f"c{sid}", "fout/#",
+                    pkt.SubOpts(), deliver_for("fan_out"))
+        sid += 1
+    for i in range(scale["share"]):
+        b.subscribe(f"s{sid}", f"c{sid}", "$share/g/q/#",
+                    pkt.SubOpts(), deliver_for("share"))
+        sid += 1
+    # mass: filters the traffic never matches, loaded via the segment
+    # path (router.add_route -> RouteIndex hot segment; subscriber bits
+    # via ONE vectorized bulk_add -> sharded full upload on first sync)
+    idx = b.router
+    base_slot = sid + 64
+    fid_list = []
+    for i in range(scale["mass_filters"]):
+        f = f"mass/{i}/+/t"
+        idx.add_route(f)
+        fid_list.append(idx.filter_id(f))
+    fid_np = np.asarray(fid_list, np.int64)
+    draws = rng.integers(0, len(fid_np), size=scale["mass_bits"])
+    slots = rng.integers(
+        base_slot, scale["mass_slots"], size=scale["mass_bits"]
+    )
+    b.subtab.bulk_add(fid_np[draws], slots)
+    subs = _popcount_words(b.subtab.arr) + b.subscription_count()
+    return counters, subs
+
+
+async def _mesh_scenario_pass(b, topics, max_batch):
+    """One scenario through the REAL serving entry: BatchIngest window
+    -> MeshServingRouter dist step -> host fan-out."""
+    import asyncio
+
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.message import Message
+
+    ing = BatchIngest(b, max_batch=max_batch, window_us=500)
+    b.ingest = ing
+    ing.start()
+    try:
+        # compile + sharded upload outside the timed window
+        await ing.submit(Message(topic="warm/x"))
+        t0 = time.perf_counter()
+        futs = [
+            ing.enqueue(Message(topic=t, payload=b"p")) for t in topics
+        ]
+        counts = await asyncio.gather(*futs)
+        wall = time.perf_counter() - t0
+    finally:
+        await ing.stop()
+        b.ingest = None
+    return {
+        "msgs": len(topics),
+        "deliveries": int(sum(counts)),
+        "rps": round(len(topics) / wall, 1),
+        "deliveries_per_s": round(sum(counts) / wall, 1),
+        "wall_s": round(wall, 3),
+    }
+
+
+async def _mesh_retained_pass(b, mesh, scale, rng):
+    """retained-storm scenario: R stored topics, K wildcard replay
+    storms fused into the serving launch (dist_fused_step)."""
+    import asyncio
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.retained_feed import RetainedStormFeed
+    from emqx_tpu.models.retained_index import DeviceRetainedIndex
+
+    ridx = DeviceRetainedIndex(mesh=mesh)
+    R = scale["retained"]
+    ridx.bulk_add([f"rs/{i % 97}/t{i}" for i in range(R)])
+    feed = RetainedStormFeed(ridx, metrics=b.metrics, window_s=30.0)
+    b.retained_feed = feed
+    try:
+        t0 = time.perf_counter()
+        futs = [
+            feed.submit(f"rs/{i}/#")
+            for i in range(scale["storm_filters"])
+        ]
+        # a publish batch takes the storm into its fused launch
+        n = await b.adispatch_batch_folded(
+            [Message(topic=f"fin/{i % scale['hot']}/r")
+             for i in range(scale["max_batch"])]
+        )
+        replies = await asyncio.gather(*futs)
+        wall = time.perf_counter() - t0
+    finally:
+        b.retained_feed = None
+    replayed = sum(len(r or ()) for r in replies)
+    return {
+        "stored": R,
+        "storm_filters": scale["storm_filters"],
+        "replayed": replayed,
+        "replayed_per_s": round(replayed / wall, 1),
+        "fused": b.metrics.get("retained.storm.fused"),
+        "publish_riders": int(sum(n)),
+        "wall_s": round(wall, 3),
+    }
+
+
+def _engine_kernel_rps(dev, scale, rng, batches: int = 12) -> float:
+    """Device-level topics/s through route_prepared (prepared snapshot,
+    steady state) — the apples-to-apples half of the single-vs-mesh
+    speedup figure."""
+    B = scale["max_batch"]
+    topics = [f"fin/{i % scale['hot']}/k" for i in range(B)]
+    args = dev.prepare()
+    dev.route_prepared(args, topics)  # compile + upload, untimed
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        dev.route_prepared(args, topics)
+    wall = time.perf_counter() - t0
+    return round(batches * B / wall, 1)
+
+
+def mesh_serving_matrix(mode: str, deadline: Optional[float] = None) -> dict:
+    """Build the sharded table at `mode` scale and run the four-scenario
+    broker matrix end-to-end through the real serving entry. Returns the
+    result dict (also the payload dryrun_multichip prints into the
+    MULTICHIP json)."""
+    import asyncio
+
+    import jax
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.models.router_model import DeviceRouter
+    from emqx_tpu.ops.matcher import MatcherConfig
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    scale = MESH_SCALES[mode]
+    ndev = min(len(jax.devices()), scale["devices"])
+    tp = scale["tp"] if ndev % scale["tp"] == 0 and ndev >= scale["tp"] else 1
+    mesh = make_mesh(ndev, tp=tp)
+    rng = np.random.default_rng(0x4E5)
+    cfg = MatcherConfig(
+        # pin the compact cap above the fan-out scenario's width so the
+        # sweep never recompiles mid-measurement
+        fanout_slots=max(KSLOT_MIN_FOR_BENCH, 2 * scale["wide"]),
+    )
+    b = Broker(router=Router(cfg, min_tpu_batch=64), hooks=Hooks())
+    b.mesh = mesh
+    t_build = time.perf_counter()
+    counters, subs = _build_mesh_workload(b, scale, rng)
+    build_s = time.perf_counter() - t_build
+    _mark(
+        f"mesh_serving[{mode}]: {subs} subscriptions built in "
+        f"{build_s:.1f}s on mesh {mesh.shape['dp']}x{mesh.shape['tp']}"
+    )
+
+    # single-device engine first (its mirrors free when it drops)
+    single_rps = None
+    if deadline is None or time.perf_counter() < deadline - 60:
+        try:
+            sdev = DeviceRouter(b.router.index, b.subtab, cfg)
+            single_rps = _engine_kernel_rps(sdev, scale, rng)
+            del sdev
+        except Exception as e:  # noqa: BLE001 — speedup is optional
+            _mark(f"mesh_serving: single-device pass failed: {e!r}")
+
+    M = scale["msgs"]
+    H, W = scale["hot"], scale["wide"]
+    scen: dict = {}
+
+    async def run_all():
+        scen["fan_in"] = await _mesh_scenario_pass(
+            b, [f"fin/{i % H}/x" for i in range(M)], scale["max_batch"]
+        )
+        scen["fan_out"] = await _mesh_scenario_pass(
+            b, [f"fout/{i}" for i in range(max(256, M // W))],
+            scale["max_batch"],
+        )
+        scen["shared_group"] = await _mesh_scenario_pass(
+            b, [f"q/{i}" for i in range(M // 4)], scale["max_batch"]
+        )
+        scen["retained_storm"] = await _mesh_retained_pass(
+            b, mesh, scale, rng
+        )
+
+    asyncio.run(run_all())
+    # scenario sanity: the matrix really delivered
+    assert scen["fan_in"]["deliveries"] == M, scen["fan_in"]
+    assert scen["fan_out"]["deliveries"] == scen["fan_out"]["msgs"] * W
+    assert (
+        scen["shared_group"]["deliveries"] == scen["shared_group"]["msgs"]
+    ), "shared group must deliver exactly once per message"
+    mesh_rps = _engine_kernel_rps(b._device_router(), scale, rng)
+    res = {
+        "mode": mode,
+        "proxy": mode != "full",
+        "mesh": f"{mesh.shape['dp']}x{mesh.shape['tp']}",
+        "devices": ndev,
+        "subscriptions": subs,
+        "build_s": round(build_s, 1),
+        "mesh_serving_rps": scen["fan_in"]["rps"],
+        "scenarios": scen,
+        "mesh_kernel_rps": mesh_rps,
+        "single_device_kernel_rps": single_rps,
+        "single_vs_mesh_speedup": (
+            round(mesh_rps / single_rps, 2) if single_rps else None
+        ),
+        "note": (
+            "four-scenario broker matrix (fan-in / fan-out / "
+            "shared-group / retained-storm) through the REAL serving "
+            "entry: BatchIngest -> MeshServingRouter dist step (table "
+            "sharded over tp, batch over dp) -> host fan-out; "
+            "subscriptions = popcount of the sharded bitmap + live "
+            "subscriber objects; speedup is device-level route_prepared "
+            "topics/s, mesh vs one device over the SAME tables — <1 on "
+            "a host-local backend is the honest sharding overhead, the "
+            "figure exists so the TPU run shows the real scaling"
+        ),
+    }
+    return res
+
+
+KSLOT_MIN_FOR_BENCH = 256
+
+
+def _mesh_serving_child() -> dict:
+    mode = os.environ.get("BENCH_MESH_MODE", "proxy")
+    deadline = None
+    budget = os.environ.get("BENCH_CHILD_BUDGET_S")
+    if budget:
+        deadline = time.perf_counter() + float(budget) - 10.0
+    return mesh_serving_matrix(mode, deadline)
+
+
+def bench_mesh_serving(deadline: Optional[float] = None) -> dict:
+    """`mesh_serving` sweep config: ONE child process (its own device
+    topology: 8 real devices at full scale, a forced 2-device CPU host
+    platform for the shard proxy), BENCH_PARTIAL-aware via the normal
+    sweep capture. On 1-device CPU images the config degrades to the
+    2-shard proxy with `"proxy": true` instead of skipping — the mesh
+    path is exercised in tier-1-adjacent runs, not only on TPU."""
+    import subprocess
+
+    import jax
+
+    ndev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    env = dict(os.environ)
+    if platform != "cpu" and ndev >= 8:
+        mode = "full"
+    else:
+        mode = "proxy"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+    env["BENCH_MESH_MODE"] = mode
+    budget = 600.0
+    if deadline is not None:
+        budget = max(60.0, deadline - time.perf_counter())
+    env["BENCH_CHILD_BUDGET_S"] = str(int(budget))
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "_mesh_serving_child"],
+            capture_output=True,
+            text=True,
+            timeout=budget + 30,
+            env=env,
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            return {
+                "timeout": True,
+                "mode": mode,
+                "error": f"rc={proc.returncode}: {proc.stdout[-300:]!r}",
+            }
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"timeout": True, "mode": mode}
+
+
 # mixed_10m (the HEADLINE: shape-diverse 10M table, residual NFA forced,
 # update-sync measured — r3 verdict item 3) runs FIRST in its own fresh
 # process; every config emits a BENCH_PARTIAL stderr line on completion
@@ -795,6 +1145,7 @@ def _bench_config_tail(name, index, filters, topics, spf, insert_s, stage,
 CONFIGS = [
     "mixed_10m",
     "serving",  # e2e_serving + serving_dispatch (headline)
+    "mesh_serving",  # scale-out sharded serving matrix (ROADMAP item 4)
     "churn_storm",  # O(delta) update path at 10M subs (ROADMAP item 2)
     "share_10m",
     "retained_5m",
@@ -814,6 +1165,7 @@ EXTRAS = ["retained_spot", "chaos_soak"]
 MIN_BUDGET_S = {
     "mixed_10m": 300,
     "serving": 280,  # e2e (2 points) + serving_dispatch, one process
+    "mesh_serving": 150,  # sharded matrix child (proxy ~60s; full more)
     "churn_storm": 240,  # 10M cold build + churn/visibility phases
     "share_10m": 120,
     "retained_5m": 110,
@@ -2052,6 +2404,8 @@ def _run_config(name: str, deadline: Optional[float] = None) -> dict:
         return bench_chaos_soak()
     if name == "churn_storm":
         return bench_churn_storm(rng, deadline)
+    if name == "mesh_serving":
+        return bench_mesh_serving(deadline)
     if name == "serving":
         return bench_serving_suite(deadline)
     if name == "e2e_serving":  # standalone debug entry
@@ -2074,6 +2428,11 @@ def run_one(name: str) -> None:
             int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
             int(sys.argv[5]), int(sys.argv[6]), sys.argv[7],
         )
+        return
+    if name == "_mesh_serving_child":
+        # grandchild entry for the mesh_serving config: its OWN device
+        # topology (env-selected), one JSON line on stdout
+        print(json.dumps(_mesh_serving_child()))
         return
     # standalone wall budget: the serving suite bounds its own waits so a
     # degraded run emits a partial JSON instead of dying to a kill
@@ -2258,6 +2617,16 @@ def main() -> None:
                         "subscribe_visibility_ms"
                     ),
                     "insert_rps_10m": kern.get("insert_rps"),
+                    # scale-out sharded serving (mesh_serving, item 4)
+                    "mesh_serving_rps": results.get(
+                        "mesh_serving", {}
+                    ).get("mesh_serving_rps"),
+                    "mesh_serving_proxy": results.get(
+                        "mesh_serving", {}
+                    ).get("proxy"),
+                    "single_vs_mesh_speedup": results.get(
+                        "mesh_serving", {}
+                    ).get("single_vs_mesh_speedup"),
                     # segmented update path (churn_storm, ROADMAP item 2)
                     "churn_inserts_per_s": churn.get(
                         "churn_inserts_per_s"

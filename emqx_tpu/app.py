@@ -205,6 +205,10 @@ class BrokerApp:
 
             dp, tp = c.router.mesh_shape
             self.broker.mesh = make_mesh(dp * tp, tp=tp)
+            # every table owner shards through the same mesh: the lazy
+            # match-only engine (Router.matcher) and the retained replay
+            # index pick it up from here (segment-manager placements)
+            self.router.mesh = self.broker.mesh
         self.cm = ChannelManager(self.broker)
         self.channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
         # populated below once authn config is read (SCRAM enhanced auth)
@@ -280,6 +284,7 @@ class BrokerApp:
             enable_device=c.router.enable_tpu,
         )
         self.retainer.enabled = c.retainer.enable
+        self.retainer.mesh = self.broker.mesh
         self.retainer.attach(self.hooks)
 
         self.delayed = DelayedPublish(
@@ -646,6 +651,14 @@ class BrokerApp:
                 loop=asyncio.get_running_loop(),
             )
             self.broker.cluster = self.cluster_node
+            if self.broker.mesh is not None:
+                # scale-out serving: advertise this node's slice of the
+                # global subscriber-lane space; shard ownership + the
+                # node-loss re-own ladder live in cluster/route_sync.py
+                idx, total = c.cluster.shard_slice
+                self.cluster_node.attach_mesh_slice(
+                    c.router.mesh_shape, idx, total
+                )
             if c.retainer.enable:
                 # retained set/clear replicate cluster-wide + join-time
                 # bootstrap (emqx_retainer_mnesia parity)
@@ -692,13 +705,11 @@ class BrokerApp:
                 olp=self.olp,
             )
             self.broker.ingest.start()
-            if (
-                c.retainer.enable
-                and c.retainer.storm_ride
-                and self.broker.mesh is None
-            ):
+            if c.retainer.enable and c.retainer.storm_ride:
                 # wildcard-subscribe replay storms ride the serving
-                # pipeline's fused launch (broker/retained_feed.py);
+                # pipeline's fused launch (broker/retained_feed.py) —
+                # single-device AND mesh mode (the mesh engine fuses
+                # them into dist_fused_step, chunk rows over 'dp');
                 # the device retained index attaches lazily on first
                 # eligible insert, so wire the feed through a factory
                 from emqx_tpu.broker.retained_feed import RetainedStormFeed
@@ -1036,6 +1047,11 @@ class BrokerApp:
         c = self.config
         last_retainer_sweep = 0.0
         last_durability_flush = time.time()
+        # mesh.shard.* accounting (scale-out serving): scatter launches
+        # diff the segment managers' counters; the lane-fill scan walks
+        # the subscriber matrix, so it runs every 30th tick only
+        last_shard_launches = 0
+        mesh_fill_tick = 0
         while True:
             await asyncio.sleep(1.0)
             try:
@@ -1080,6 +1096,31 @@ class BrokerApp:
                             tombstone_frac=rc.compact_tombstone_frac,
                         )
                     )
+                if (
+                    dev is not None
+                    and self.broker.mesh is not None
+                    and hasattr(dev, "shard_status")
+                ):
+                    m = self.broker.metrics
+                    launches = (
+                        dev._shape_sync.delta_launches
+                        + dev._bits_sync.delta_launches
+                        + dev._nfa_sync.delta_launches
+                    )
+                    if launches > last_shard_launches:
+                        m.inc(
+                            "mesh.shard.scatter.launches",
+                            launches - last_shard_launches,
+                        )
+                        last_shard_launches = launches
+                    if mesh_fill_tick % 30 == 0:
+                        st = dev.shard_status()
+                        m.gauge_set("mesh.shard.count", st["shards"])
+                        m.gauge_set(
+                            "mesh.shard.fill",
+                            st.get("lane_fill_max", 0.0),
+                        )
+                    mesh_fill_tick += 1
                 self.trace.sweep(now)
                 self.license.tick(now)
                 self.topic_metrics.tick_rates(now)

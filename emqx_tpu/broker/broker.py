@@ -119,6 +119,9 @@ class Broker:
         self._free_slots: List[int] = []
         self._device = None  # lazy DeviceRouter
         self.mesh = None  # jax Mesh => SPMD serving (set by app/tests)
+        # cluster mesh-slice label (ClusterNode.attach_mesh_slice):
+        # stamped onto router.device_step spans by the mesh engine
+        self.shard_label = None
         self.ingest = None  # BatchIngest, attached by the app
         # RetainedStormFeed (broker/retained_feed.py), attached by the
         # app: pending wildcard-subscribe replay storms ride the next
@@ -378,6 +381,7 @@ class Broker:
             dsp = rec.device_step(
                 None, len(msgs), results, t_launch,
                 links=rec.publish_links(msgs),
+                extra=dev.span_attrs(),
             )
         return self._dispatch_device_results(
             msgs, results, forward, device_span=dsp
@@ -485,9 +489,11 @@ class Broker:
             return _cpu_pending(degraded=True)
         feed = self.retained_feed
         storm = None
-        if feed is not None and self.mesh is None:
+        if feed is not None and dev.supports_retained_fusion:
             # pending wildcard-subscribe replays ride THIS launch: the
             # fused kernel answers them in the same program + readback
+            # (fused_route_retained_step single-device; dist_fused_step
+            # on the mesh engine, chunk rows scanning sharded over 'dp')
             storm = feed.take_job()
         rec = self.spans
         t_launch = rec.now_ns() if rec is not None else 0
@@ -557,6 +563,7 @@ class Broker:
                     links=rec.publish_links(msgs)
                     if batch_span is None
                     else (),
+                    extra=dev.span_attrs(),
                 )
             return self._dispatch_device_results(
                 msgs, results, forward, device_span=dsp
@@ -566,9 +573,15 @@ class Broker:
 
     def _device_router(self):
         if self._device is None:
-            from emqx_tpu.models.router_model import DeviceRouter
+            from emqx_tpu.models.router_model import (
+                DeviceRouter,
+                MeshServingRouter,
+            )
 
-            self._device = DeviceRouter(
+            # mesh set => the scale-out engine: sharded table mirrors,
+            # SPMD dist step, fused retained storms over the mesh
+            cls = DeviceRouter if self.mesh is None else MeshServingRouter
+            self._device = cls(
                 self.router.index,
                 self.subtab,
                 self.router.matcher_config,
@@ -577,6 +590,8 @@ class Broker:
                 mesh=self.mesh,
                 metrics=self.metrics,
             )
+            if self.mesh is not None and self.shard_label:
+                self._device.shard_label = self.shard_label
         return self._device
 
     def _client_hashes(self, msgs):

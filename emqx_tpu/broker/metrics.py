@@ -451,6 +451,28 @@ declare("router.compact.lag.seconds", GAUGE,
         "hot segment is under threshold; sustained growth means "
         "compaction cannot keep up with churn)")
 
+# scale-out sharded serving (parallel/mesh.py dist_fused_step,
+# cluster/route_sync.ShardOwnership, docs/scale_out.md)
+declare("mesh.shard.count", GAUGE,
+        "device shards in the local serving mesh (dp x tp product; 0 "
+        "when SPMD serving is off)")
+declare("mesh.shard.fill", GAUGE,
+        "max per-tp-shard subscriber-lane occupancy (nonzero words / "
+        "words in the fullest lane slice; sustained skew vs the min "
+        "means one chip carries the fan-out wall)")
+declare("mesh.shard.scatter.launches", COUNTER,
+        "O(delta) scatter launches that landed on SHARDED mirrors "
+        "(churn reaching the mesh without a full table re-upload)")
+declare("mesh.shard.compact.runs", COUNTER,
+        "background compaction cycles whose rebuilt tables pre-uploaded "
+        "straight into the sharded layout (placement hook present)")
+declare("mesh.shard.rebalance", COUNTER,
+        "shard ownership moves after a node loss (rendezvous re-own; "
+        "each move is one slice adopting a survivor)")
+declare("mesh.shard.reroutes", COUNTER,
+        "publish forwards rerouted from a dead shard owner to its "
+        "rendezvous successor (the stall the re-own ladder removes)")
+
 # retained-replay storm feed (broker/retained_feed.py)
 declare("retained.storm.filters", COUNTER,
         "wildcard replay filters batched through the storm feed")

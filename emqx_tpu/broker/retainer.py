@@ -54,6 +54,10 @@ class Retainer:
         self.enable_device = enable_device
         self._device = None
         self._device_unfit = 0
+        # ('dp','tp') jax Mesh, set by the app BEFORE the first insert
+        # when SPMD serving is on: the replay index then shards its
+        # chunk mirrors over 'dp' (models/retained_index.py)
+        self.mesh = None
         # RetainedStormFeed (broker/retained_feed.py), attached by the
         # app when the serving pipeline runs: wildcard-subscribe replays
         # batch into device storms that ride the publish pipeline's
@@ -66,7 +70,7 @@ class Retainer:
         if self.enable_device and self._device is None:
             from emqx_tpu.models.retained_index import DeviceRetainedIndex
 
-            self._device = DeviceRetainedIndex()
+            self._device = DeviceRetainedIndex(mesh=self.mesh)
 
     def _dev_add(self, topic: str) -> None:
         if not self.enable_device:

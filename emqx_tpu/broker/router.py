@@ -41,13 +41,20 @@ class Router:
         self._matcher_config = matcher_config or MatcherConfig()
         self.min_tpu_batch = min_tpu_batch
         self.enable_tpu = enable_tpu
+        # ('dp','tp') jax Mesh, set by the app alongside broker.mesh:
+        # the lazy match-only engine then uploads its table mirrors
+        # pre-sharded (replicated NamedSharding) like the serving engine
+        self.mesh = None
 
     def __getstate__(self):
         # segment-state snapshots (ops/segments.SegmentStateSnapshot)
         # pickle the router; the lazy DeviceRouter holds device buffers
-        # and is rebuilt on first use after restore
+        # and is rebuilt on first use after restore. The mesh holds
+        # live device objects (unpicklable by design) — the restoring
+        # process re-attaches its OWN mesh (app boot wiring).
         d = self.__dict__.copy()
         d["_matcher"] = None
+        d["mesh"] = None
         return d
 
     def __len__(self) -> int:
@@ -110,7 +117,7 @@ class Router:
             from emqx_tpu.models.router_model import DeviceRouter
 
             self._matcher = DeviceRouter(
-                self._index, None, self._matcher_config
+                self._index, None, self._matcher_config, mesh=self.mesh
             )
         return self._matcher
 

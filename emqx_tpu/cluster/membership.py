@@ -91,13 +91,20 @@ class Membership:
                             self.node, p, ("membership", "join", joiner)
                         )
             return view
-        if kind == "heartbeat":
+        if kind in ("heartbeat", "heartbeat_ack"):
             with self._lock:
                 came_back = not self._alive.get(from_node)
                 self._alive[from_node] = True
                 self._last_seen[from_node] = now
             if came_back:
                 self._emit("node_up", from_node)
+            if kind == "heartbeat":
+                # receipt-confirmed liveness: the sender learns we are
+                # alive from this ack ARRIVING, never from its own send
+                # buffer accepting bytes (see heartbeat() below)
+                self._bus.cast(
+                    self.node, from_node, ("membership", "heartbeat_ack")
+                )
             return True
         if kind == "leave":
             with self._lock:
@@ -114,12 +121,18 @@ class Membership:
             self._bus.cast(self.node, p, ("membership", "leave"))
 
     def heartbeat(self) -> None:
-        """Send one heartbeat round + expire dead peers. Called on a timer."""
+        """Send one heartbeat round + expire dead peers. Called on a timer.
+
+        `_last_seen` refreshes ONLY when the peer's ack (or any inbound
+        membership traffic) arrives — never on the outbound cast
+        "succeeding". Over TCP a `sendall` to a freshly-killed peer
+        happily buffers in the kernel (the RST comes later), so
+        send-side success is evidence about OUR socket, not the peer;
+        trusting it kept kill -9'd nodes alive past FAILURE_TIMEOUT
+        whenever the connection reader hadn't yet noticed the close
+        (the cluster-proc flake this line exists to pin)."""
         for p in self.peers():
-            ok = self._bus.cast(self.node, p, ("membership", "heartbeat"))
-            if ok:
-                with self._lock:
-                    self._last_seen[p] = self._clock()
+            self._bus.cast(self.node, p, ("membership", "heartbeat"))
         self.expire()
 
     def expire(self) -> None:

@@ -29,7 +29,7 @@ from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.shared_sub import stable_hash
 from emqx_tpu.cluster.cluster_rpc import ClusterRpcLog
 from emqx_tpu.cluster.membership import Membership
-from emqx_tpu.cluster.route_sync import ClusterRouteTable
+from emqx_tpu.cluster.route_sync import ClusterRouteTable, ShardOwnership
 from emqx_tpu.cluster.rpc import Rpc, RpcError
 from emqx_tpu.cluster.transport import LocalBus
 from emqx_tpu.mqtt import packet as pkt
@@ -72,6 +72,10 @@ class ClusterNode:
         )
         self.broker = broker or Broker()
         self.routes = ClusterRouteTable(name)
+        # mesh-slice ownership (scale-out serving): which node serves
+        # which slice of the global subscriber-lane space, and where
+        # publishes bound for a dead owner reroute (docs/scale_out.md)
+        self.shards = ShardOwnership(name, metrics=self.broker.metrics)
         self.membership = Membership(name, bus, clock=clock)
         self.rpc = Rpc(name, bus)
         self.conf_log = ClusterRpcLog(name)
@@ -204,6 +208,14 @@ class ClusterNode:
             },
         )
         self.rpc.registry.register(
+            "shard",
+            1,
+            {
+                "advertise": self._proto_shard_advertise,
+                "dump": self.shards.dump,
+            },
+        )
+        self.rpc.registry.register(
             "retain",
             1,
             {
@@ -273,6 +285,20 @@ class ClusterNode:
                 if not nodes:
                     self._shared_nodes.pop(key, None)
             self._shared_cands.clear()
+            # shard re-own rides the same degrade ladder that declared
+            # the node dead (heartbeat expiry / open breakers): the dead
+            # owner's mesh slices move to rendezvous-chosen survivors,
+            # so forwards reroute to a live slice instead of stalling
+            # behind the dead peer's send deadline (docs/scale_out.md)
+            moves = self.shards.reown(
+                node, self.membership.running_nodes()
+            )
+            if moves:
+                import logging
+
+                logging.getLogger("emqx_tpu.cluster").warning(
+                    "node %s down: re-owned shards %s", node, moves
+                )
             self.broker.metrics.inc("cluster.nodedown.routes_purged", purged)
         elif event == "node_up":
             self.rpc.forget_peer(node)  # re-negotiate BPAPI versions
@@ -297,6 +323,17 @@ class ClusterNode:
         self._parked_owner.update(
             self.rpc.call(seed, "sess", "dump_parked")
         )
+        # mesh-shard ownership bootstrap + (re-)announce our own slice:
+        # a returning owner reclaims its home shards here (the
+        # advertisement IS the reclaim — see ShardOwnership.advertise)
+        try:
+            if self.rpc.supported_version(seed, "shard") >= 1:
+                self.shards.load(self.rpc.call(seed, "shard", "dump"))
+                mine = self.shards.local_shards()
+                if mine:
+                    self._shard_cast()
+        except RpcError:
+            pass  # pre-shard-proto seed: ownership stays local-only
         # shared-group membership bootstrap + announce our own groups
         for r, g, nodes in self.rpc.call(seed, "shared", "dump"):
             self._shared_nodes.setdefault((r, g), set()).update(nodes)
@@ -446,6 +483,62 @@ class ClusterNode:
             for p in peers:
                 one(p)
 
+    # -- mesh-shard ownership (scale-out serving) --------------------------
+    def attach_mesh_slice(
+        self, mesh_shape, index: int = 0, total: int = 1
+    ) -> List[str]:
+        """Declare this node's slice of the global subscriber-lane
+        space: slice `index` of `total`, served by a local mesh of
+        `mesh_shape` = (dp, tp). Advertised to every current peer (late
+        joiners pull the dump). The serving engine's span label
+        (`router.device_step` shard attr) follows the advertisement."""
+        shards = self.shards.advertise_local(
+            tuple(mesh_shape), index, total
+        )
+        self.broker.shard_label = self.shards.label()
+        dev = self.broker._device
+        if dev is not None and hasattr(dev, "shard_label"):
+            dev.shard_label = self.broker.shard_label
+        self._shard_cast()
+        return shards
+
+    def _shard_cast(self) -> None:
+        mine = self.shards.local_shards()
+        if not mine:
+            return
+        shape = list(
+            self.shards._home.get(self.name, ((), (0, 0)))[1]
+        )
+
+        def one(p):
+            self.rpc.cast(
+                p, "shard", "advertise", self.name, mine, shape,
+                key="shard",
+            )
+
+        for p in self.membership.peers():
+            if self._repl_pool is not None:
+                self._pool_submit(self._repl_pool, one, p)
+            else:
+                one(p)
+
+    def _proto_shard_advertise(self, node: str, shards, shape) -> None:
+        self.shards.advertise(node, list(shards), tuple(shape))
+
+    def _live_dest(self, node: str) -> str:
+        """Remap a publish destination whose owner is DOWN to the node
+        that re-owned its shard (rendezvous successor). While membership
+        still believes the owner is alive — or no successor exists —
+        the original destination stands and the send path's breaker/
+        retry ladder handles it."""
+        if node == self.name or self.membership.is_alive(node):
+            return node
+        alt = self.shards.successor_node(node)
+        if alt is not None and alt != node:
+            self.broker.metrics.inc("mesh.shard.reroutes")
+            return alt
+        return node
+
     # -- publish side ------------------------------------------------------
     def publish(self, msg: Message) -> int:
         """Cluster publish: match once, dispatch local, forward per node."""
@@ -480,6 +573,7 @@ class ClusterNode:
         per_node: Dict[str, List[Tuple[Message, List[str]]]] = {}
         for m, dests in zip(kept, all_dests):
             for node, filters in dests.items():
+                node = self._live_dest(node)
                 if node == self.name:
                     total += self.broker.dispatch(filters, m)
                 else:
@@ -666,6 +760,10 @@ class ClusterNode:
         confirm: Dict[str, bool] = {}
         for i, (m, dests) in enumerate(zip(msgs, all_dests)):
             for node, filters in dests.items():
+                # a dest whose owner died reroutes to the shard's
+                # rendezvous successor; a successor that is US needs no
+                # forward (local dispatch already ran on this batch)
+                node = self._live_dest(node)
                 if node == self.name:
                     continue
                 per_node.setdefault(node, []).append((m, filters))
@@ -709,6 +807,7 @@ class ClusterNode:
             return 0
         rec = getattr(self.broker, "spans", None)
         for node, filters in dests.items():  # aggre: one entry per node
+            node = self._live_dest(node)
             if node == self.name:
                 n += self.broker.dispatch(filters, msg)
             else:

@@ -20,9 +20,148 @@ publishes; bitmaps of *local* subscribers are applied on each owner node.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Set
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
 
 from emqx_tpu.broker.router import Router
+
+
+class ShardOwnership:
+    """Cluster-wide mesh-slice ownership (scale-out serving,
+    docs/scale_out.md).
+
+    Each serving node runs its own ('dp','tp') device mesh and owns a
+    SLICE of the global subscriber-lane space — shard ids are
+    ``s{index}/{total}`` plus the node's local mesh shape, advertised
+    over the ``shard`` BPAPI proto on join (the mria-replicated
+    ownership-table analog). The map answers two questions on the
+    publish path:
+
+    - which node currently serves a shard (``owner``), and
+    - where publishes bound for a DEAD owner should go instead
+      (``successor_node``): on node_down the dead node's home shards
+      re-own onto survivors by rendezvous hash — every replica computes
+      the same assignment with zero coordination RPCs — so the forward
+      path reroutes to the adopting slice instead of stalling behind the
+      dead peer's send deadline (the degrade ladder's cluster breakers
+      already fail those sends fast; this gives them a live target).
+      A returning owner re-advertises and reclaims its home shards.
+    """
+
+    def __init__(self, node: str, metrics=None) -> None:
+        self.node = node
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # shard id -> current owner node          guarded-by: _lock
+        self._owner: Dict[str, str] = {}
+        # node -> (home shard ids, mesh shape)    guarded-by: _lock
+        self._home: Dict[str, Tuple[List[str], Tuple[int, int]]] = {}
+        self._local: List[str] = []  # guarded-by: _lock
+
+    @staticmethod
+    def slice_ids(index: int, total: int) -> List[str]:
+        """Shard ids of cluster slice `index` of `total` (one global
+        slice per node today; the id scheme leaves room for splitting a
+        slice finer than a node later)."""
+        if not (0 <= index < total):
+            raise ValueError(f"shard slice {index}/{total} out of range")
+        return [f"s{index}/{total}"]
+
+    # -- advertisement (BPAPI `shard` proto) -------------------------------
+    def advertise_local(
+        self, mesh_shape: Tuple[int, int], index: int, total: int
+    ) -> List[str]:
+        shards = self.slice_ids(index, total)
+        self.advertise(self.node, shards, tuple(mesh_shape))
+        with self._lock:
+            self._local = list(shards)
+        return shards
+
+    def advertise(
+        self, node: str, shards: List[str],
+        mesh_shape: Tuple[int, int] = (0, 0),
+    ) -> None:
+        """A node announcing its home slice (join or node_up return):
+        home shards return to their advertiser — reclaim is part of the
+        rebalance ladder, not a special case."""
+        with self._lock:
+            self._home[node] = (list(shards), tuple(mesh_shape))
+            for s in shards:
+                self._owner[s] = node
+
+    def local_shards(self) -> List[str]:
+        with self._lock:
+            return list(self._local)
+
+    def label(self) -> str:
+        """Span/metric label for this node's slice ("local" when no
+        slice is advertised — a standalone mesh broker)."""
+        with self._lock:
+            if not self._local:
+                return "local"
+            shape = self._home.get(self.node, ((), (0, 0)))[1]
+            lbl = "+".join(self._local)
+            if shape != (0, 0):
+                lbl += f"@dp{shape[0]}tp{shape[1]}"
+            return lbl
+
+    # -- reads -------------------------------------------------------------
+    def owner(self, shard: str) -> Optional[str]:
+        with self._lock:
+            return self._owner.get(shard)
+
+    def shard_count(self) -> int:
+        with self._lock:
+            return len(self._owner)
+
+    def successor_node(self, dead: str) -> Optional[str]:
+        """The node serving `dead`'s FIRST home shard now (None while
+        the map has no better answer than the dead node itself)."""
+        with self._lock:
+            home = self._home.get(dead, ((), None))[0]
+            for s in home:
+                cur = self._owner.get(s)
+                if cur is not None and cur != dead:
+                    return cur
+        return None
+
+    # -- rebalance ladder --------------------------------------------------
+    def reown(self, dead: str, survivors: List[str]) -> List[Tuple[str, str]]:
+        """Reassign every shard `dead` owned onto `survivors` by
+        rendezvous hash (deterministic: all replicas agree without a
+        coordination round). Returns [(shard, new_owner)] moves; counts
+        each into `mesh.shard.rebalance`."""
+        cands = sorted(n for n in survivors if n != dead)
+        moves: List[Tuple[str, str]] = []
+        with self._lock:
+            for s, cur in list(self._owner.items()):
+                if cur != dead:
+                    continue
+                if not cands:
+                    del self._owner[s]  # no survivor: orphan, not a lie
+                    continue
+                new = max(
+                    cands,
+                    key=lambda n: zlib.crc32(f"{s}|{n}".encode()),
+                )
+                self._owner[s] = new
+                moves.append((s, new))
+        if self.metrics is not None:
+            for _ in moves:
+                self.metrics.inc("mesh.shard.rebalance")
+        return moves
+
+    # -- bootstrap ---------------------------------------------------------
+    def dump(self) -> List[tuple]:
+        with self._lock:
+            return [
+                (n, list(shards), list(shape))
+                for n, (shards, shape) in self._home.items()
+            ]
+
+    def load(self, dump: List[tuple]) -> None:
+        for n, shards, shape in dump:
+            self.advertise(n, list(shards), tuple(shape))
 
 
 class ClusterRouteTable:

@@ -59,6 +59,12 @@ class ClusterConfig:
     send_retries: int = 2
     send_backoff_ms: float = 50.0
     send_deadline_s: float = 0.0
+    # scale-out sharded serving (docs/scale_out.md): this node's slice
+    # of the global subscriber-lane space, [index, total]. With
+    # router.mesh_shape set, the node advertises the slice on join
+    # (ShardOwnership) and publishes reroute to the rendezvous
+    # successor when an owner dies. [0, 1] = the whole space (default).
+    shard_slice: List[int] = field(default_factory=lambda: [0, 1])
 
 
 @dataclass
@@ -745,6 +751,17 @@ def _validate(cfg: AppConfig) -> None:
         raise ConfigError("degrade.shed_queue_batches must be >= 1")
     if cfg.cluster.send_retries < 0:
         raise ConfigError("cluster.send_retries must be >= 0")
+    ss = cfg.cluster.shard_slice
+    if (
+        len(ss) != 2
+        or not all(isinstance(v, int) for v in ss)
+        or ss[1] < 1
+        or not 0 <= ss[0] < ss[1]
+    ):
+        raise ConfigError(
+            "cluster.shard_slice must be [index, total] with "
+            "0 <= index < total"
+        )
     from emqx_tpu.broker.limiter import TYPES as _LIMITER_TYPES
 
     for lt in cfg.limiter:

@@ -376,6 +376,21 @@ class MgmtApi:
                 "compact_ms": hist("router.compact.seconds", 1e3),
                 "compact_lag_s": m.gauge("router.compact.lag.seconds"),
             },
+            "mesh": {
+                "shape": (
+                    f"{self.broker.mesh.shape['dp']}x"
+                    f"{self.broker.mesh.shape['tp']}"
+                    if self.broker.mesh is not None
+                    else None
+                ),
+                "shard_label": self.broker.shard_label,
+                "shard_count": m.gauge("mesh.shard.count"),
+                "shard_fill_max": m.gauge("mesh.shard.fill"),
+                "scatter_launches": m.get("mesh.shard.scatter.launches"),
+                "compact_runs": m.get("mesh.shard.compact.runs"),
+                "rebalance_events": m.get("mesh.shard.rebalance"),
+                "reroutes": m.get("mesh.shard.reroutes"),
+            },
             "dispatch": {
                 "fanout": hist("dispatch.fanout"),
                 "routed_device": routed_dev,

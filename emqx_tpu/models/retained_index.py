@@ -113,9 +113,16 @@ class DeviceRetainedIndex:
     # sources' — a full chunk re-upload is 64MB on the link
     OPLOG_MAX = 1 << 18
 
-    def __init__(self, max_bytes: int = 64, max_levels: int = 8):
+    def __init__(self, max_bytes: int = 64, max_levels: int = 8,
+                 mesh=None):
+        """`mesh`: a ('dp','tp') jax Mesh — chunk mirrors then upload
+        through the segment manager pre-sharded (rows over 'dp', the
+        layout `dist_fused_step` scans), and storm filter tables place
+        replicated like every other match table. None = single-device
+        placement, unchanged."""
         self.max_bytes = max_bytes  # hard cap (device-budget gate)
         self.max_levels = max_levels
+        self.mesh = mesh
         # actual storage width: a pow2 bucket grown to the longest stored
         # topic. Every storm moves chunk bytes across the host<->device
         # link at least once, so padding to the cap when topics are short
@@ -135,7 +142,19 @@ class DeviceRetainedIndex:
         self._host_b: List[np.ndarray] = []  # [CHUNK, bucket] uint8
         from emqx_tpu.ops.segments import DeviceSegmentManager
 
-        self._seg = DeviceSegmentManager(name="retained")
+        if mesh is not None:
+            from emqx_tpu.parallel.mesh import (
+                retained_placement,
+                table_placement,
+            )
+
+            self._seg = DeviceSegmentManager(
+                placement=retained_placement(mesh), name="retained"
+            )
+            self._table_place = table_placement(mesh)
+        else:
+            self._seg = DeviceSegmentManager(name="retained")
+            self._table_place = None
         self.epoch = 0
         self.oplog: list = []
         self.version = 0
@@ -280,14 +299,19 @@ class DeviceRetainedIndex:
             if len(T.words(f)) > self.max_levels:
                 raise ValueError(f"filter too deep for device budget: {f}")
             fids[idx.add(f)] = f
+        # storm tables are one-shot (a fresh table per storm, never
+        # delta-synced); in mesh mode they place through the canonical
+        # replicated layout so the fused sharded program reads them
+        # without a per-launch reshard
+        put = self._table_place or (lambda _n, a: jax.device_put(a))
         shape_tables = {
-            k: jax.device_put(v.copy())
+            k: put(k, v.copy())
             for k, v in idx.shapes.device_snapshot().items()
         }
         with_nfa = idx.residual_count > 0
         nfa_tables = (
             {
-                k: jax.device_put(v.copy())
+                k: put(k, v.copy())
                 for k, v in idx.nfa.device_snapshot().items()
             }
             if with_nfa

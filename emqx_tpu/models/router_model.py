@@ -1028,6 +1028,16 @@ class DeviceRouter:
         )
 
     def _device_args(self):
+        # loop-side growth packs BEFORE the version key: the dirty sync
+        # itself grows the bitmap/group tables to cover every live
+        # filter id, and that (legitimate, same-thread) version bump
+        # must not trip the torn-snapshot check below — filter-only
+        # growth (e.g. a bulk route load) would fail its first prepare
+        # spuriously. No-ops when capacities already cover the index.
+        if self.subtab is not None:
+            self.subtab.pack(self.index.num_filters_capacity)
+        if self.grouptab is not None and len(self.grouptab):
+            self.grouptab.pack_fcap(self.index.num_filters_capacity)
         key = self._version_key()
         if self._prep_key == key:
             # clean tables: skip pack/delta-sync entirely. The auto-sized
@@ -1222,11 +1232,13 @@ class DeviceRouter:
 
         `retained`: an optional prepared replay storm
         (DeviceRetainedIndex.prepare_storm) to fuse into this launch —
-        chunk 0 rides the SAME program (fused_route_retained_step) and
-        the same readback; additional chunks (stores past 1M topics)
-        launch alongside before any readback. Single-device only; the
-        decoded {filter: rows} lands in `RouteResult.retained`.
-        Returns a `RouteResult`.
+        chunk 0 rides the SAME program (fused_route_retained_step — or
+        dist_fused_step on a `MeshServingRouter`) and the same readback;
+        additional chunks (stores past 1M topics) launch alongside
+        before any readback. Engines that cannot fuse advertise
+        `supports_retained_fusion = False` and must not be handed a
+        storm. The decoded {filter: rows} lands in
+        `RouteResult.retained`. Returns a `RouteResult`.
         """
         import time
 
@@ -1306,6 +1318,7 @@ class DeviceRouter:
             return self._route_mesh(
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
+                retained=retained,
             )
         step_kw = dict(
             m_active=m_active,
@@ -1472,10 +1485,22 @@ class DeviceRouter:
             readback_bytes=readback, retained=retained_res,
         )
 
+    # engine capability flag the broker gates storm fusion on: the
+    # single-device engine fuses via fused_route_retained_step; a plain
+    # DeviceRouter pointed at a mesh has no fused mesh program (that is
+    # MeshServingRouter's job), so a storm must not be handed to it
+    @property
+    def supports_retained_fusion(self) -> bool:
+        return self.mesh is None
+
+    def span_attrs(self) -> Dict:
+        """Engine attributes stamped onto `router.device_step` spans."""
+        return {}
+
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
-        rand=None, kslot=0,
+        rand=None, kslot=0, retained=None,
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
@@ -1486,24 +1511,17 @@ class DeviceRouter:
         placed here."""
         from emqx_tpu.parallel.mesh import dist_shape_route_step, place_batch
 
+        if retained is not None:
+            # engine contract: callers gate on supports_retained_fusion.
+            # Silently dropping the storm here would hang its waiters.
+            raise RuntimeError(
+                "retained storm handed to a non-fusing mesh engine; "
+                "use MeshServingRouter for mesh serving"
+            )
         cfg = self.config
-        dp = self.mesh.shape["dp"]
-        # (bitmap-width/tp divisibility is checked in _device_args,
-        # before the sharded upload)
-        # batch rows must split evenly over dp (shard_map constraint);
-        # mat was padded to a pow2 >= 64 — round up to a dp multiple for
-        # non-pow2 dp sizes
-        rows = mat.shape[0]
-        if rows % dp:
-            extra = dp - rows % dp
-            mat = np.pad(mat, ((0, extra), (0, 0)))
-            lens = np.pad(lens, (0, extra))
-        with_groups = group_tables is not None
-        if with_groups and mat.shape[0] != (0 if ch is None else len(ch)):
-            pad = mat.shape[0] - len(ch)
-            ch = np.pad(ch, (0, pad))
-            th = np.pad(th, (0, pad))
-            rand = np.pad(rand, (0, pad))
+        mat, lens, ch, th, rand, with_groups = self._mesh_pad(
+            mat, lens, ch, th, rand, group_tables is not None
+        )
         st, nt, sb = shape_tables, nfa_tables, bits
         bm, ln = place_batch(self.mesh, mat, lens)
         out = dist_shape_route_step(
@@ -1525,8 +1543,28 @@ class DeviceRouter:
             probes=cfg.probes,
             share_strategy=self.share_strategy,
             kslot=kslot,
+            donate=getattr(cfg, "donate_buffers", False),
         )
         return self._readback(out, B, too_long, with_groups, kslot, mesh=True)
+
+    def _mesh_pad(self, mat, lens, ch, th, rand, with_groups):
+        """Round the batch up to a dp multiple (shard_map constraint) and
+        keep the per-topic $share entropy vectors the same length.
+        (Bitmap-width/tp divisibility is checked in _device_args, before
+        the sharded upload; mat was already padded to a pow2 >= 64 — the
+        extra rows here cover non-pow2 dp sizes.)"""
+        dp = self.mesh.shape["dp"]
+        rows = mat.shape[0]
+        if rows % dp:
+            extra = dp - rows % dp
+            mat = np.pad(mat, ((0, extra), (0, 0)))
+            lens = np.pad(lens, (0, extra))
+        if with_groups and mat.shape[0] != (0 if ch is None else len(ch)):
+            pad = mat.shape[0] - len(ch)
+            ch = np.pad(ch, (0, pad))
+            th = np.pad(th, (0, pad))
+            rand = np.pad(rand, (0, pad))
+        return mat, lens, ch, th, rand, with_groups
 
     def match_batch(
         self, topics: Sequence[str], fallback=None
@@ -1564,3 +1602,139 @@ class DeviceRouter:
                     names.append(name)
             out.append(names)
         return out
+
+
+class MeshServingRouter(DeviceRouter):
+    """The scale-out serving engine: `route_prepared` runs the SPMD dist
+    step over a ('dp','tp') mesh as the broker's REAL dispatch engine —
+    subscription table sharded over 'tp' (subscriber-lane slices), the
+    ingest batch over 'dp', with retained-replay storms fused into the
+    same sharded program (`dist_fused_step`). Everything the
+    single-device engine earned is preserved by inheritance: the
+    O(dirty) prepare cache, buffer donation, Kslot auto-sizing (against
+    the per-shard lane width), the breaker/degrade ladder hooks, and the
+    segment-manager upload path (all mirrors land pre-sharded via the
+    placement hooks — nothing is re-placed per batch).
+
+    `shard_label` names the mesh slice this process owns for span/
+    metric attribution; a clustered node sets it to its advertised
+    ('dp','tp') slice (cluster/route_sync.ShardOwnership), a standalone
+    mesh broker keeps the default.
+    """
+
+    supports_retained_fusion = True
+
+    def __init__(
+        self,
+        index,
+        subtab: Optional[SubscriberTable],
+        config=None,
+        grouptab: Optional[GroupTable] = None,
+        share_strategy: str = "round_robin",
+        mesh=None,
+        metrics=None,
+    ):
+        if mesh is None:
+            raise ValueError("MeshServingRouter requires a ('dp','tp') mesh")
+        super().__init__(
+            index, subtab, config, grouptab=grouptab,
+            share_strategy=share_strategy, mesh=mesh, metrics=metrics,
+        )
+        self.shard_label = "local"  # single-writer: loop
+
+    def span_attrs(self) -> Dict:
+        sh = self.mesh.shape
+        return {
+            "device.mesh_shape": f"{sh['dp']}x{sh['tp']}",
+            "device.shard": self.shard_label,
+        }
+
+    def shard_status(self) -> Dict:
+        """Per-tp-shard lane occupancy of the subscriber matrix — feeds
+        the `mesh.shard.*` gauges. Nonzero WORDS (not bits): one pass of
+        numpy counting, cheap enough for a housekeeping tick."""
+        sh = dict(self.mesh.shape)
+        out = {"dp": sh["dp"], "tp": sh["tp"], "shards": sh["dp"] * sh["tp"]}
+        if self.subtab is not None:
+            arr = self.subtab.arr
+            tp = sh["tp"]
+            w = arr.shape[1]
+            per = w // tp if tp and w % tp == 0 else w
+            fills = []
+            for s in range(w // per if per else 0):
+                sl = arr[:, s * per : (s + 1) * per]
+                fills.append(
+                    float(np.count_nonzero(sl)) / max(1, sl.size)
+                )
+            out["lane_fill_max"] = max(fills) if fills else 0.0
+            out["lane_fill_min"] = min(fills) if fills else 0.0
+        return out
+
+    def _route_mesh(
+        self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
+        mat, lens, B, too_long, group_tables=None, ch=None, th=None,
+        rand=None, kslot=0, retained=None,
+    ):
+        """SPMD serving with optional fused retained storm: chunk 0 of a
+        prepared `StormJob` rides the SAME sharded program + readback
+        (its rows scan sharded over 'dp'); extra chunks launch alongside
+        before any readback — exactly the single-device fusion contract,
+        spread over the mesh."""
+        if retained is None or not retained.chunks:
+            return super()._route_mesh(
+                shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
+                mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
+            )
+        from emqx_tpu.parallel.mesh import (
+            dist_fused_route_step,
+            place_batch,
+        )
+
+        cfg = self.config
+        mat, lens, ch, th, rand, with_groups = self._mesh_pad(
+            mat, lens, ch, th, rand, group_tables is not None
+        )
+        bm, ln = place_batch(self.mesh, mat, lens)
+        out = dist_fused_route_step(
+            self.mesh,
+            shape_tables,
+            nfa_tables,
+            bits,
+            bm,
+            ln,
+            retained.shape_tables,
+            retained.nfa_tables,
+            retained.chunks[0],
+            group_tables,
+            ch,
+            th,
+            rand,
+            m_active=m_active,
+            salt=salt,
+            ret_m_active=retained.kwargs["m_active"],
+            ret_with_nfa=retained.kwargs["with_nfa"],
+            ret_salt=retained.kwargs["salt"],
+            ret_max_levels=retained.kwargs["max_levels"],
+            ret_narrow=retained.kwargs["narrow"],
+            max_levels=cfg.max_levels,
+            frontier=cfg.frontier,
+            max_matches=cfg.max_matches,
+            probes=cfg.probes,
+            share_strategy=self.share_strategy,
+            kslot=kslot,
+            donate=getattr(cfg, "donate_buffers", False),
+        )
+        from emqx_tpu.models.retained_index import _get_retained_step
+
+        rstep = _get_retained_step()
+        extra = [
+            rstep(
+                retained.shape_tables, retained.nfa_tables, c,
+                **retained.kwargs,
+            )
+            for c in retained.chunks[1:]
+        ]
+        return self._readback(
+            out, B, too_long, with_groups, kslot, mesh=True,
+            retained=retained, extra_retained=extra,
+        )

@@ -394,11 +394,15 @@ class SpanRecorder:
         )
 
     def device_step(self, batch_span: Optional[Span], n_rows: int, results,
-                    start_ns: int, links: Sequence = ()) -> Optional[Span]:
+                    start_ns: int, links: Sequence = (),
+                    extra: Optional[Dict] = None) -> Optional[Span]:
         """The kernel launch+readback span, annotated from the
         `RouteResult`: readback bytes, compact/overflow rows, fallback
         rows. Child of the batch span (same trace); standalone with links
-        to the sampled publishes on batch-less (sync) dispatches."""
+        to the sampled publishes on batch-less (sync) dispatches.
+        `extra`: engine attributes (DeviceRouter.span_attrs) — the mesh
+        engine stamps `device.mesh_shape`/`device.shard` here so a trace
+        records WHICH slice of the sharded table served the batch."""
         if batch_span is None and not links:
             return None
         import numpy as np
@@ -410,6 +414,8 @@ class SpanRecorder:
             ),
             "device.fallback_rows": int(np.count_nonzero(results.flags)),
         }
+        if extra:
+            attrs.update(extra)
         if results.slots is not None:
             n_ovf = int(np.count_nonzero(results.overflow))
             attrs["device.compact_rows"] = n_rows - n_ovf

@@ -277,7 +277,13 @@ class TpuMatcher:
         builder: NfaBuilder,
         config: MatcherConfig = MatcherConfig(),
         metrics=None,
+        mesh=None,
     ):
+        """`mesh`: a ('dp','tp') jax Mesh — the NFA table mirror then
+        uploads through the segment manager with the canonical
+        replicated NamedSharding (parallel/mesh.table_placement), the
+        same placement-hook path every other table owner uses, so churn
+        stays O(delta) scatters on the mesh too."""
         from emqx_tpu.broker.metrics import default_metrics
         from emqx_tpu.ops.nfa import DeviceDeltaSync
 
@@ -288,7 +294,14 @@ class TpuMatcher:
             config = dataclasses.replace(config, probes=MAX_PROBES)
         self.config = config
         self.metrics = metrics if metrics is not None else default_metrics
-        self._sync = DeviceDeltaSync()
+        if mesh is not None:
+            from emqx_tpu.parallel.mesh import table_placement
+
+            self._sync = DeviceDeltaSync(
+                placement=table_placement(mesh), name="nfa"
+            )
+        else:
+            self._sync = DeviceDeltaSync()
         self._salt = 0
 
     def _tables(self):

@@ -374,6 +374,11 @@ class SegmentCompactor:
                 if self.metrics is not None:
                     self.metrics.inc("router.compact.runs")
                     self.metrics.inc("router.compact.merged", merged)
+                    if getattr(owner, "_placement", None) is not None:
+                        # the rebuilt table pre-uploaded straight into
+                        # the sharded mesh layout — no host gather, no
+                        # serving-path re-placement (docs/scale_out.md)
+                        self.metrics.inc("mesh.shard.compact.runs")
         except Exception:  # noqa: BLE001 — one bad cycle must not stop
             self.aborted += 1
             if self.metrics is not None:
@@ -407,6 +412,8 @@ class SegmentCompactor:
         if self.metrics is not None:
             self.metrics.inc("router.compact.runs")
             self.metrics.inc("router.compact.merged", merged)
+            if getattr(owner, "_placement", None) is not None:
+                self.metrics.inc("mesh.shard.compact.runs")
         return True
 
 

@@ -251,6 +251,7 @@ def _dist_shape_step_fn(
     max_matches: int,
     probes: int,
     kslot: int = 0,
+    donate: bool = False,
 ):
     """The SERVING engine (shape index + residual NFA + fan-out + $share
     pick) sharded over the mesh — same layout as `_dist_step_fn`, all
@@ -323,7 +324,217 @@ def _dist_shape_step_fn(
         ),
         out_specs=_out_specs(with_groups, with_slots=kslot > 0),
     )
-    return _register_built(jax.jit(fn))
+    # ``donate``: recycle the per-batch lengths buffer (aliases the
+    # [B]-shaped int32 outputs under the same 'dp' sharding) — the mesh
+    # twin of shape_route_step_donated; tables/bitmaps never donate.
+    jit_kw = {"donate_argnums": (8,)} if donate else {}
+    return _register_built(jax.jit(fn, **jit_kw))
+
+
+@device_contract(
+    "dist_fused_step",
+    kind="builder",
+    # the fused serving builder inherits dist_shape_step's ICI budget:
+    # stats psums + the per-shard compaction's lane-offset rebase. The
+    # retained half is shard-local by construction (chunk rows ride
+    # 'dp'; its tables are replicated) — a collective appearing there
+    # is a contract violation, not a tuning knob.
+    collectives=("psum", "axis_index"),
+    out_bounds={
+        "slots": lambda cfg: (
+            cfg["B"] * cfg["kslot"] * cfg.get("tp", 1) * 4
+        ),
+        "slot_count": lambda cfg: cfg["B"] * 4,
+    },
+)
+@lru_cache(maxsize=32)
+def _dist_fused_step_fn(
+    mesh: Mesh,
+    shape_keys: tuple,
+    nfa_keys: Optional[tuple],
+    group_keys: Optional[tuple],
+    ret_shape_keys: tuple,
+    ret_nfa_keys: Optional[tuple],
+    share_strategy: int,
+    m_active: int,
+    salt: int,
+    max_levels: int,
+    frontier: int,
+    max_matches: int,
+    probes: int,
+    kslot: int,
+    ret_m_active: int,
+    ret_with_nfa: bool,
+    ret_salt: int,
+    ret_max_levels: int,
+    ret_narrow: bool,
+    donate: bool = False,
+):
+    """`_dist_shape_step_fn` + the retained-replay half fused into the
+    SAME sharded program (the mesh analog of
+    `fused_route_retained_step`): a wildcard-subscribe storm's filter
+    tables ride replicated like the match tables, and the retained-topic
+    chunk shards its ROWS over 'dp' — each dp slice matches its share of
+    the stored topics, so the replay scan scales with the mesh instead
+    of serializing on one chip. The [chunk, lanes] match matrix
+    concatenates over 'dp' in the output and rides the same coalesced
+    readback as the route outputs.
+
+    ``donate``: donate the per-batch `lengths` buffer (aliases the
+    [B]-shaped int32 outputs, same 'dp' sharding) — the mesh-path twin
+    of `shape_route_step_donated`."""
+    from emqx_tpu.models.router_model import shape_route_step_impl
+
+    with_nfa = nfa_keys is not None
+    with_groups = group_keys is not None
+
+    def local_step(
+        shape_tables, nfa_tables, group_tables, ch, th, rand,
+        sub_bitmaps, bytes_mat, lengths,
+        ret_shape_tables, ret_nfa_tables, ret_bytes,
+    ):
+        out = shape_route_step_impl(
+            shape_tables,
+            nfa_tables,
+            sub_bitmaps,
+            bytes_mat,
+            lengths,
+            group_tables,
+            ch,
+            th,
+            rand,
+            m_active=m_active,
+            with_nfa=with_nfa,
+            salt=salt,
+            max_levels=max_levels,
+            frontier=frontier,
+            max_matches=max_matches,
+            probes=probes,
+            with_groups=with_groups,
+            share_strategy=share_strategy,
+            dp_axis="dp" if with_groups else None,
+        )
+        if kslot:
+            slots, count, over = compact_fanout_slots(
+                out["bitmaps"], kslot
+            )
+            w_local = out["bitmaps"].shape[1]
+            off = jax.lax.axis_index("tp").astype(jnp.int32) * (
+                w_local * 32
+            )
+            out["slots"] = jnp.where(slots >= 0, slots + off, -1)
+            out["slot_count"] = jax.lax.psum(count, "tp")
+            out["overflow"] = (
+                jax.lax.psum(over.astype(jnp.int32), "tp") > 0
+            )
+        # retained half: bit-identical to fused_route_retained_step's,
+        # on this shard's slice of the chunk rows (lengths derive
+        # on-device — retained topics cannot contain NUL)
+        rl = jnp.sum((ret_bytes != 0).astype(jnp.int32), axis=1)
+        rout = shape_route_step_impl(
+            ret_shape_tables,
+            ret_nfa_tables,
+            None,
+            ret_bytes,
+            rl,
+            m_active=ret_m_active,
+            with_nfa=ret_with_nfa,
+            salt=ret_salt,
+            max_levels=ret_max_levels,
+        )
+        rm = rout["matched"]
+        out["retained"] = rm.astype(jnp.int16) if ret_narrow else rm
+        return _reduce_stats(out, with_groups)
+
+    shape_specs = {k: P() for k in shape_keys}
+    nfa_specs = {k: P() for k in nfa_keys} if with_nfa else None
+    group_specs = {k: P() for k in group_keys} if with_groups else None
+    ret_shape_specs = {k: P() for k in ret_shape_keys}
+    ret_nfa_specs = (
+        {k: P() for k in ret_nfa_keys} if ret_nfa_keys is not None else None
+    )
+    per_topic = P("dp") if with_groups else P()
+    out_specs = _out_specs(with_groups, with_slots=kslot > 0)
+    out_specs["retained"] = P("dp", None)
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(
+            shape_specs, nfa_specs, group_specs,
+            per_topic, per_topic, per_topic,
+            P(None, "tp"), P("dp", None), P("dp"),
+            ret_shape_specs, ret_nfa_specs, P("dp", None),
+        ),
+        out_specs=out_specs,
+    )
+    jit_kw = {"donate_argnums": (8,)} if donate else {}
+    return _register_built(jax.jit(fn, **jit_kw))
+
+
+def dist_fused_route_step(
+    mesh: Mesh,
+    shape_tables: Dict,
+    nfa_tables: Optional[Dict],
+    sub_bitmaps,
+    bytes_mat,
+    lengths,
+    ret_shape_tables: Dict,
+    ret_nfa_tables: Optional[Dict],
+    ret_bytes,
+    group_tables: Optional[Dict] = None,
+    client_hash=None,
+    topic_hash=None,
+    rand=None,
+    *,
+    m_active: int,
+    salt: int,
+    ret_m_active: int,
+    ret_with_nfa: bool,
+    ret_salt: int,
+    ret_max_levels: int,
+    ret_narrow: bool,
+    max_levels: int = 16,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+    share_strategy: int = 0,
+    kslot: int = 0,
+    donate: bool = False,
+):
+    """Distributed serving step WITH a fused retained-replay storm —
+    the mesh engine `MeshServingRouter.route_prepared` launches when a
+    prepared `StormJob` rides the batch. Sharding as in
+    `dist_shape_route_step`, plus: storm filter tables replicated,
+    retained chunk rows on 'dp', the match matrix back on ('dp', None)."""
+    fn = _dist_fused_step_fn(
+        mesh,
+        tuple(sorted(shape_tables)),
+        tuple(sorted(nfa_tables)) if nfa_tables is not None else None,
+        tuple(sorted(group_tables)) if group_tables is not None else None,
+        tuple(sorted(ret_shape_tables)),
+        tuple(sorted(ret_nfa_tables))
+        if ret_nfa_tables is not None
+        else None,
+        share_strategy,
+        m_active,
+        salt,
+        max_levels,
+        frontier,
+        max_matches,
+        probes,
+        kslot,
+        ret_m_active,
+        ret_with_nfa,
+        ret_salt,
+        ret_max_levels,
+        ret_narrow,
+        donate,
+    )
+    return fn(
+        shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
+        rand, sub_bitmaps, bytes_mat, lengths,
+        ret_shape_tables, ret_nfa_tables, ret_bytes,
+    )
 
 
 def dist_shape_route_step(
@@ -346,6 +557,7 @@ def dist_shape_route_step(
     probes: int = 8,
     share_strategy: int = 0,
     kslot: int = 0,
+    donate: bool = False,
 ):
     """Distributed serving step (shape engine). Sharding as in
     `dist_route_step`: tables replicated, subscriber lanes on 'tp',
@@ -367,6 +579,7 @@ def dist_shape_route_step(
         max_matches,
         probes,
         kslot,
+        donate,
     )
     return fn(
         shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
@@ -397,6 +610,15 @@ def table_placement(mesh: Mesh):
 def bitmap_placement(mesh: Mesh):
     """Canonical placement for subscriber bitmaps: lanes sharded on 'tp'."""
     sh = NamedSharding(mesh, P(None, "tp"))
+    return lambda _name, arr: jax.device_put(arr, sh)
+
+
+def retained_placement(mesh: Mesh):
+    """Canonical placement for retained-topic chunks: ROWS sharded on
+    'dp' (each dp slice scans its share of the stored topics; CHUNK is a
+    pow2, so any pow2 dp divides it). Storm filter tables ride
+    `table_placement` (replicated) like every other match table."""
+    sh = NamedSharding(mesh, P("dp", None))
     return lambda _name, arr: jax.device_put(arr, sh)
 
 

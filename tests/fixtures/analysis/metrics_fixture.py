@@ -21,6 +21,9 @@ declare("racetrack.events", COUNTER)
 declare("race.reports", COUNTER)
 declare("router.segment.hot.fill", "gauge")
 declare("router.compact.runs", COUNTER)
+declare("mesh.shard.fill", "gauge")
+declare("mesh.shard.rebalance", COUNTER)
+declare("mesh.shard.scatter.launches", COUNTER)
 
 
 class M:
@@ -49,6 +52,9 @@ def good(m: M):
     m.inc("race.reports")
     m.gauge_set("router.segment.hot.fill", 3)
     m.inc("router.compact.runs")
+    m.gauge_set("mesh.shard.fill", 0.5)
+    m.inc("mesh.shard.rebalance")
+    m.inc("mesh.shard.scatter.launches", 2)
 
 
 def bad(m: M):
@@ -66,3 +72,6 @@ def bad(m: M):
     m.inc("router.compact.runz")  # MN001: typo'd compaction counter
     m.inc("racetrack.eventz")  # MN001: typo'd race-harness counter
     m.inc("race.reportz")  # MN001: typo'd race-report counter
+    m.gauge_set("mesh.shard.fil", 1)  # MN001: typo'd shard gauge
+    m.inc("mesh.shard.rebalanse")  # MN001: typo'd rebalance counter
+    m.inc("mesh.shard.scatter.launchez")  # MN001: typo'd scatter counter

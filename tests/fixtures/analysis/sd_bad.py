@@ -22,6 +22,16 @@ def bad_spec():
     return P("lanes", None)
 
 
+def bad_mesh_serving_placement():
+    # SD003: the scale-out serving path's placements (retained chunks
+    # over 'dp', lanes over 'tp') must name mesh-bound axes — a
+    # placement spec naming an axis no Mesh literal binds ('dq' here)
+    # would reshard every launch against a phantom axis
+    from jax.sharding import NamedSharding  # noqa: F401
+
+    return P("dq", None)
+
+
 def build(mesh):
     spec = P("dp", None)
     return shard_map(

@@ -331,3 +331,109 @@ def test_retained_bootstrap_paged_100k(cluster3):
     assert len(rb) == N  # full store converged
     assert max(pages) <= a.RETAIN_PAGE_MAX  # bounded chunks
     assert len(pages) >= N // a.RETAIN_PAGE_MAX  # genuinely paged
+
+
+# -- mesh-shard ownership (scale-out serving, docs/scale_out.md) ------------
+
+
+def test_shard_slices_advertise_and_converge(cluster3):
+    """Each node advertises its slice of the global subscriber-lane
+    space; every replica agrees on the ownership map (advertise casts +
+    join-time dump), and the serving span label follows."""
+    _, (a, b, c), _ = cluster3
+    for i, n in enumerate((a, b, c)):
+        shards = n.attach_mesh_slice((4, 2), i, 3)
+        assert shards == [f"s{i}/3"]
+    for n in (a, b, c):
+        n.flush()  # advertise casts ride the async sender
+    for n in (a, b, c):
+        assert n.shards.owner("s0/3") == a.name
+        assert n.shards.owner("s1/3") == b.name
+        assert n.shards.owner("s2/3") == c.name
+    assert a.broker.shard_label.startswith("s0/3")
+    assert "dp4tp2" in a.broker.shard_label
+
+
+def test_shard_slice_survives_join_bootstrap():
+    """A LATE joiner pulls the ownership map from its seed (it never saw
+    the earlier advertise casts)."""
+    from emqx_tpu.cluster import make_cluster
+
+    bus, (a, b) = make_cluster(2)
+    try:
+        a.attach_mesh_slice((2, 2), 0, 3)
+        b.attach_mesh_slice((2, 2), 1, 3)
+        from emqx_tpu.cluster.node import ClusterNode
+
+        c = ClusterNode("late@cluster", bus)
+        c.attach_mesh_slice((2, 2), 2, 3)
+        assert c.join(a.name)
+        assert c.shards.owner("s0/3") == a.name
+        assert c.shards.owner("s1/3") == b.name
+        # and the earlier nodes learned the late slice
+        assert a.shards.owner("s2/3") == "late@cluster"
+        c.rpc.stop()
+    finally:
+        for n in (a, b):
+            n.rpc.stop()
+
+
+def test_node_loss_reowns_shard_and_reroutes_publishes(cluster3):
+    """Node loss: the dead owner's slice re-owns onto a rendezvous
+    survivor (same answer on every replica, zero coordination), the
+    rebalance counter moves, and a publish that still names the dead
+    owner (stale replica entry) forwards to the successor instead of
+    stalling behind the dead peer."""
+    bus, (a, b, c), clock = cluster3
+    for i, n in enumerate((a, b, c)):
+        n.attach_mesh_slice((4, 2), i, 3)
+    for n in (a, b, c):
+        n.flush()  # drain advertise casts
+    # c dies silently (no goodbye)
+    bus.detach(c.name)
+    clock.advance(FAILURE_TIMEOUT + 1)
+    a.membership.heartbeat()
+    b.membership.heartbeat()
+    assert not a.membership.is_alive(c.name)
+    new_owner = a.shards.owner("s2/3")
+    assert new_owner in (a.name, b.name)  # adopted by a survivor
+    assert b.shards.owner("s2/3") == new_owner  # deterministic everywhere
+    assert a.broker.metrics.get("mesh.shard.rebalance") >= 1
+    assert a.shards.successor_node(c.name) == new_owner
+
+    # stale replica entry still naming the dead owner: the forward
+    # reroutes to the successor's slice instead of dead-lettering
+    a.routes.add_route("own/#", c.name)
+    before = {
+        n.name: n.broker.metrics.get("messages.received")
+        for n in (a, b)
+    }
+    n_del = a.publish(Message(topic="own/x"))
+    a.flush()
+    succ = [n for n in (a, b) if n.name == new_owner][0]
+    assert (
+        succ.broker.metrics.get("messages.received")
+        == before[new_owner] + 1
+    )
+    assert a.broker.metrics.get("mesh.shard.reroutes") >= 1
+
+
+def test_returning_owner_reclaims_its_home_shards(cluster3):
+    """The re-own is a lease, not a transfer: when the original owner
+    rejoins and re-advertises, its home shards come back."""
+    bus, (a, b, c), clock = cluster3
+    for i, n in enumerate((a, b, c)):
+        n.attach_mesh_slice((4, 2), i, 3)
+    for n in (a, b, c):
+        n.flush()  # drain advertise casts
+    bus.detach(c.name)
+    clock.advance(FAILURE_TIMEOUT + 1)
+    a.membership.heartbeat()
+    b.membership.heartbeat()
+    assert a.shards.owner("s2/3") != c.name
+    # c returns: re-attach its bus + rejoin + re-advertise (join does it)
+    bus.attach(c.name, c._handle)
+    assert c.join(a.name)
+    c.flush()  # drain the re-advertise casts
+    assert a.shards.owner("s2/3") == c.name
+    assert b.shards.owner("s2/3") == c.name

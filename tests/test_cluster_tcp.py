@@ -173,6 +173,64 @@ def test_nodedown_gc_over_tcp(tcp_pair):
     assert a.publish(Message(topic="gone/x")) == 0
 
 
+def test_heartbeat_liveness_is_receipt_confirmed_not_send_confirmed():
+    """Root-cause regression for the two-OS-process flake: a cast to a
+    freshly-killed TCP peer can 'succeed' (sendall buffers in the
+    kernel; the RST arrives after the reader thread notices, which under
+    full-suite load can be arbitrarily late). Send-side success must
+    therefore NEVER refresh `_last_seen` — only the peer's ack arriving
+    may. A bus that accepts every cast but delivers nothing (the
+    kernel-buffer race, made deterministic) must still expire the peer."""
+    from emqx_tpu.cluster.membership import Membership
+
+    clock = FakeClock()
+
+    class BlackHoleBus:
+        """Every send/cast 'succeeds'; nothing is ever delivered."""
+
+        def send(self, src, dst, payload):
+            return ["m@bh", "dead@bh"]  # join view
+
+        def cast(self, src, dst, payload):
+            return True  # bytes buffered != peer alive
+
+    m = Membership("m@bh", BlackHoleBus(), clock=clock)
+    downs = []
+    m.monitor(lambda ev, n: downs.append((ev, n)) if ev == "node_down" else None)
+    assert m.join("dead@bh")
+    assert m.is_alive("dead@bh")
+    clock.advance(FAILURE_TIMEOUT + 1)
+    m.heartbeat()  # casts "succeed" but no ack ever arrives
+    assert not m.is_alive("dead@bh")
+    assert ("node_down", "dead@bh") in downs
+
+
+def test_heartbeat_ack_keeps_live_tcp_peer_alive():
+    """The other half of the contract: over a real TcpBus, a live peer's
+    ack refreshes `_last_seen`, so advancing the clock past the failure
+    timeout does NOT expire a peer that is still answering."""
+    clock = FakeClock()
+    bus_a = TcpBus("a@hb")
+    bus_b = TcpBus("b@hb")
+    a = ClusterNode("a@hb", bus_a, clock=clock)
+    b = ClusterNode("b@hb", bus_b, clock=clock)
+    bus_a.add_peer("b@hb", "127.0.0.1", bus_b.port)
+    bus_b.add_peer("a@hb", "127.0.0.1", bus_a.port)
+    try:
+        assert b.join("a@hb")
+        clock.advance(FAILURE_TIMEOUT + 1)
+        a.membership.heartbeat()  # ack is async over TCP
+        assert poll(lambda: a.membership.is_alive("b@hb"), timeout=5)
+        # the refreshed last_seen survives the next expiry sweep
+        a.membership.expire()
+        assert a.membership.is_alive("b@hb")
+    finally:
+        for n in (a, b):
+            n.rpc.stop()
+        bus_a.stop()
+        bus_b.stop()
+
+
 # -- a genuine second OS process -------------------------------------------
 
 CHILD_SCRIPT = r"""

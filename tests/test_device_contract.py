@@ -47,12 +47,17 @@ def test_mesh_builders_are_audited_on_the_virtual_mesh():
     report = run_audit()
     assert "dist_step" in report.kernels
     assert "dist_shape_step" in report.kernels
+    # the scale-out serving engine's fused builder is under audit too
+    assert "dist_fused_step" in report.kernels
     # the declared collective contract was exercised, not vacuous
-    k8 = [
-        s for key, s in report.kernels["dist_shape_step"].items()
-        if "k8" in key
-    ]
-    assert k8 and any("axis_index" in s["collectives"] for s in k8)
+    for builder in ("dist_shape_step", "dist_fused_step"):
+        k8 = [
+            s for key, s in report.kernels[builder].items()
+            if "k8" in key
+        ]
+        assert k8 and any(
+            "axis_index" in s["collectives"] for s in k8
+        ), builder
     assert all(
         "psum" in s["collectives"]
         for s in report.kernels["dist_step"].values()

@@ -246,6 +246,79 @@ def test_mesh_share_pick_through_dist_step():
     assert len(got1) == 16 and len(got2) == 16, (len(got1), len(got2))
 
 
+def test_retained_storm_rides_mesh_fused_launch():
+    """Wildcard-subscribe replay storms fuse into the MESH launch
+    (dist_fused_step): the storm's chunk rows scan sharded over 'dp',
+    the match matrix rides the same coalesced readback, and the waiters
+    get exactly the retained topics the CPU walk would have found."""
+    import asyncio
+
+    from emqx_tpu.broker.retained_feed import RetainedStormFeed
+    from emqx_tpu.models.retained_index import DeviceRetainedIndex
+    from emqx_tpu.ops import topics as T
+
+    async def run():
+        b = mesh_broker()
+        ridx = DeviceRetainedIndex(mesh=b.mesh)
+        stored = [f"ret/{i % 5}/t{i}" for i in range(50)]
+        for t in stored:
+            assert ridx.add(t)
+        # a LONG window: the replay must ride the publish launch, not
+        # the standalone flush timer
+        feed = RetainedStormFeed(ridx, metrics=b.metrics, window_s=30.0)
+        b.retained_feed = feed
+        fut_all = feed.submit("ret/#")
+        fut_three = feed.submit("ret/3/+")
+        got, deliver = collector()
+        b.subscribe("s1", "c1", "pub/#", pkt.SubOpts(), deliver)
+        msgs = [Message(topic=f"pub/{i}") for i in range(16)]
+        n = await b.adispatch_batch_folded(msgs)
+        assert sum(n) == 16 and len(got) == 16
+        replay_all = await asyncio.wait_for(fut_all, 30)
+        replay_three = await asyncio.wait_for(fut_three, 30)
+        assert sorted(replay_all) == sorted(stored)
+        assert sorted(replay_three) == sorted(
+            t for t in stored if T.match(t, "ret/3/+")
+        )
+        # fused into the serving launch, not flushed standalone
+        assert b.metrics.get("retained.storm.fused") == 1
+        assert b.metrics.get("retained.storm.flushed") == 0
+        # and it really was the mesh engine
+        from emqx_tpu.models.router_model import MeshServingRouter
+
+        assert isinstance(b._device, MeshServingRouter)
+        assert b._device.supports_retained_fusion
+        # chunk mirrors uploaded pre-sharded over 'dp'
+        chunks = ridx._seg._arrays
+        assert chunks and all(
+            "dp" in str(a.sharding.spec) for a in chunks.values()
+        )
+
+    asyncio.run(asyncio.wait_for(run(), 120))
+
+
+def test_mesh_device_step_span_grows_shard_attrs():
+    """`router.device_step` spans on the mesh engine carry mesh_shape +
+    shard attrs, so a causal trace records WHICH slice served it."""
+    from emqx_tpu.observe.spans import SpanRecorder
+
+    b = mesh_broker()
+    b.shard_label = "s0/2@dp4tp2"
+    rec = SpanRecorder(sample_rate=1.0)
+    b.spans = rec
+    got, deliver = collector()
+    b.subscribe("s1", "c1", "sp/#", pkt.SubOpts(), deliver)
+    msgs = [Message(topic=f"sp/{i}") for i in range(16)]
+    for m in msgs:  # span heads: the device-step span links to these
+        rec.publish_begin(m)
+    b.dispatch_batch_folded(msgs)
+    steps = [s for s in rec.spans() if s.name == "router.device_step"]
+    assert steps, "no device-step span recorded"
+    attrs = steps[-1].attrs
+    assert attrs.get("device.mesh_shape") == "4x2"
+    assert attrs.get("device.shard") == "s0/2@dp4tp2"
+
+
 def test_mesh_share_pick_matches_host_path():
     """Mesh-mode group delivery counts must equal the host path's for the
     same workload (per-member assignment may differ across strategies

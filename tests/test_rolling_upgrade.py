@@ -363,5 +363,91 @@ def test_segment_state_snapshots_and_restores_through_durable_state():
             np.sort(r1.matched, axis=1), np.sort(r2.matched, axis=1)
         )
         assert np.array_equal(r1.bitmaps, r2.bitmaps)
+
+
+def test_sharded_segment_state_snapshots_and_restores():
+    """Rolling upgrade of a SCALE-OUT node: the host tables behind a
+    MESH-sharded serving engine snapshot/restore through DurableState,
+    and the replacement process re-uploads them PRE-SHARDED through the
+    same placement hooks — identical recipient sets, no subscribe
+    replay, no single-device detour. (The snapshot pickles HOST numpy —
+    device buffers and their shardings are rebuilt, never serialized.)"""
+    import os
+
+    import numpy as np
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.persistent_session import NS_SEGMENTS, DurableState
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.models.router_model import MeshServingRouter
+    from emqx_tpu.ops.matcher import MatcherConfig
+    from emqx_tpu.ops.segments import (
+        SegmentCompactor,
+        SegmentStateSnapshot,
+        ShapeSegmentOwner,
+    )
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.storage.kv import FileKv
+
+    mesh = make_mesh(8)
+    with tempfile.TemporaryDirectory() as td:
+        b = Broker(router=Router(min_tpu_batch=1), hooks=Hooks())
+        b.mesh = mesh
+        # the app wires the match-only engine to the same mesh; the
+        # snapshot must still pickle (Mesh holds live device objects —
+        # __getstate__ drops it, the restorer re-attaches its own)
+        b.router.mesh = mesh
+        for i in range(64):
+            b.subscribe(f"s{i}", f"c{i}", f"sh/{i}/+", pkt.SubOpts(),
+                        lambda m, o: None)
+        dev = b._device_router()
+        assert isinstance(dev, MeshServingRouter)
+        dev.prepare()
+        # mixed state: compact through the SHARDED owner, more hot
+        # churn, one tombstone — the states a live upgrade drains with
+        comp_owner = ShapeSegmentOwner(
+            b.router.index.shapes, dev._shape_sync,
+            placement=dev._table_placement, hot_entries=1,
+        )
+        SegmentCompactor().compact_now(comp_owner)
+        for i in range(64, 80):
+            b.subscribe(f"s{i}", f"c{i}", f"sh/{i}/+", pkt.SubOpts(),
+                        lambda m, o: None)
+        b.unsubscribe("s5", "sh/5/+")
+        kv = FileKv(td)
+        snap = SegmentStateSnapshot(
+            os.path.join(td, "sharded.pkl"),
+            capture=lambda: {
+                "router": b.router,
+                "subtab": b.subtab,
+            },
+        )
+        DurableState(kv, segments=snap).flush()
+        assert kv.read(NS_SEGMENTS)["path"].endswith("sharded.pkl")
+
+        holder = {}
+        snap2 = SegmentStateSnapshot(
+            os.path.join(td, "sharded.pkl"),
+            capture=dict,
+            install=holder.update,
+        )
+        DurableState(FileKv(td), segments=snap2).restore()
+        router2 = holder["router"]
+        cfg = MatcherConfig(fanout_compact=False)
+        d1 = MeshServingRouter(
+            b.router.index, b.subtab, cfg, mesh=mesh
+        )
+        d2 = MeshServingRouter(
+            router2.index, holder["subtab"], cfg, mesh=mesh
+        )
+        topics = [f"sh/{i}/x" for i in range(0, 80, 3)] + ["sh/5/x"]
+        r1 = d1.route(topics)
+        r2 = d2.route(topics)
+        assert np.array_equal(r1.mcount, r2.mcount)
+        assert np.array_equal(r1.bitmaps, r2.bitmaps)
+        # the restored mirrors really uploaded sharded (lanes on 'tp')
+        bits = d2._bits_sync._arrays["sub_bitmaps"]
+        assert "tp" in str(bits.sharding.spec)
         # the unsubscribed filter stayed dead through the upgrade
         assert int(r1.mcount[-1]) == 0 and int(r2.mcount[-1]) == 0

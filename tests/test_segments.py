@@ -336,6 +336,208 @@ class TestRetainedSegments:
         assert dev._seg.full_resyncs == full0 + 1
 
 
+# -- sharded segment lifecycle (scale-out serving, docs/scale_out.md) --------
+
+
+def _mesh():
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+def _spec_str(arr) -> str:
+    return str(getattr(arr.sharding, "spec", ""))
+
+
+class TestShardedSegments:
+    def test_placement_hook_upload_parity_sharded_vs_replicated(self):
+        """The SAME churn stream through a sharded manager (mesh
+        placement) and a plain one must serve identical recipient sets
+        — full upload, hot-segment scatter inserts, tombstones, and the
+        offered-compaction path all land per-shard with no behavioral
+        drift. This is the acceptance gate for 'no new upload path'."""
+        from emqx_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        cfg = MatcherConfig(max_levels=8)
+
+        def build(mesh_arg):
+            idx = RouteIndex()
+            subs = SubscriberTable()
+            cls = DeviceRouter
+            if mesh_arg is not None:
+                from emqx_tpu.models.router_model import MeshServingRouter
+
+                cls = MeshServingRouter
+            dev = cls(idx, subs, cfg, mesh=mesh_arg)
+            for i in range(48):
+                fid = idx.add(f"par/{i}/+")
+                subs.add(fid, i)
+            return idx, subs, dev
+
+        idx_m, subs_m, dev_m = build(mesh)
+        idx_r, subs_r, dev_r = build(None)
+        topics = [f"par/{i % 48}/x" for i in range(64)]
+
+        def serve(dev):
+            res = dev.route(topics)
+            out = []
+            for i in range(len(topics)):
+                if res.slots is not None and not res.overflow[i]:
+                    row = res.slots[i]
+                    out.append(sorted(int(s) for s in row[row >= 0]))
+                else:
+                    bits = (
+                        res.bitmaps[i]
+                        if res.bitmaps is not None
+                        else res.dense_rows[res.dense_index[i]]
+                    )
+                    out.append(sorted(
+                        np.nonzero(np.unpackbits(
+                            bits.view(np.uint8), bitorder="little"
+                        ))[0].tolist()
+                    ))
+            return out
+
+        assert serve(dev_m) == serve(dev_r)
+        # churn: hot-segment inserts + a tombstone, then re-serve
+        for src in (idx_m, idx_r):
+            src.add("par/hot/+")
+            src.remove("par/3/+")
+        for subs, idx in ((subs_m, idx_m), (subs_r, idx_r)):
+            subs.add(idx.filter_id("par/hot/+"), 77)
+        topics2 = topics + ["par/hot/y", "par/3/z"]
+
+        def serve2(dev):
+            res = dev.route(topics2)
+            return serve(dev)
+
+        assert serve2(dev_m) == serve2(dev_r)
+        # the mesh mirrors really are sharded (lanes on tp, tables
+        # replicated) — uploaded that way by the manager, not re-placed
+        assert "tp" in _spec_str(dev_m._bits_sync._arrays["sub_bitmaps"])
+        for arr in dev_m._shape_sync._arrays.values():
+            assert "dp" not in _spec_str(arr)  # replicated
+
+    def test_per_shard_compaction_equals_from_scratch_sharded_rebuild(self):
+        """Background compaction on a sharded owner: merged packed table
+        pre-uploads in the sharded layout (no global gather to host on
+        the serving path), and the post-compaction recipient sets equal
+        a from-scratch sharded rebuild's."""
+        from emqx_tpu.broker.metrics import Metrics
+        from emqx_tpu.models.router_model import MeshServingRouter
+
+        mesh = _mesh()
+        cfg = MatcherConfig(max_levels=8)
+        idx = RouteIndex()
+        subs = SubscriberTable()
+        dev = MeshServingRouter(idx, subs, cfg, mesh=mesh)
+        for i in range(32):
+            fid = idx.add(f"cmp/{i}/+")
+            subs.add(fid, i)
+        dev.prepare()
+        # hot churn past the packed build
+        for i in range(32, 56):
+            fid = idx.add(f"cmp/{i}/+")
+            subs.add(fid, i)
+        idx.remove("cmp/2/+")
+        assert idx.shapes.hot_live > 0
+        m = Metrics()
+        comp = SegmentCompactor(metrics=m)
+        owner = ShapeSegmentOwner(
+            idx.shapes, dev._shape_sync,
+            placement=dev._table_placement, hot_entries=1,
+        )
+        assert comp.compact_now(owner)
+        assert idx.shapes.hot_live == 0
+        assert m.get("mesh.shard.compact.runs") == 1
+        # next prepare adopts the offered (pre-sharded) buffer
+        args = dev.prepare()
+        topics = [f"cmp/{i % 56}/x" for i in range(64)]
+        res = dev.route_prepared(args, topics)
+        # from-scratch sharded rebuild of the same end state
+        idx2 = RouteIndex()
+        subs2 = SubscriberTable()
+        dev2 = MeshServingRouter(idx2, subs2, cfg, mesh=mesh)
+        for i in range(56):
+            if i == 2:
+                continue
+            fid = idx2.add(f"cmp/{i}/+")
+            subs2.add(fid, i)
+        res2 = dev2.route(topics)
+
+        def rows(res_, i):
+            if res_.slots is not None and not res_.overflow[i]:
+                r = res_.slots[i]
+                return sorted(int(s) for s in r[r >= 0])
+            bits = (
+                res_.bitmaps[i]
+                if res_.bitmaps is not None
+                else res_.dense_rows[res_.dense_index[i]]
+            )
+            return sorted(np.nonzero(np.unpackbits(
+                bits.view(np.uint8), bitorder="little"
+            ))[0].tolist())
+
+        for i in range(len(topics)):
+            assert rows(res, i) == rows(res2, i), topics[i]
+
+
+@pytest.mark.race
+def test_sharded_compaction_racing_loop_inserts_is_silent():
+    """Per-shard compaction under churn, racetrack-armed: the mesh
+    placement changes WHERE the built table uploads (executor thread,
+    pre-sharded), not the thread discipline — a full cycle racing
+    loop-side inserts must stay silent exactly like the single-device
+    cycle."""
+    from emqx_tpu.observe.racetrack import RaceTracker
+
+    mesh = _mesh()
+    from emqx_tpu.parallel.mesh import table_placement
+
+    place = table_placement(mesh)
+    idx = RouteIndex()
+    for i in range(64):
+        idx.add(f"shrc/{i}/+")
+    man = DeviceSegmentManager(placement=place, name="shapes")
+    man.sync(idx.shapes)
+    tracker = RaceTracker()
+    tracker.watch(idx.shapes, name="ShapeIndex")
+    tracker.watch(man, name="SegmentManager")
+    tracker.arm()
+    try:
+        owner = ShapeSegmentOwner(
+            idx.shapes, man, placement=place, hot_entries=1
+        )
+        cap = owner.begin()
+        done = threading.Event()
+        built_box = {}
+
+        def build():
+            # executor half: numpy merge + the SHARDED device upload
+            built_box["b"] = owner.build(cap)
+            done.set()
+
+        t = threading.Thread(target=build, name="segment-compact-t")
+        t.start()
+        # loop-side churn racing the sharded build+upload
+        idx.add("shrc/racing/+")
+        idx.remove("shrc/5/+")
+        assert done.wait(15)
+        t.join(5)
+        applied = owner.apply(built_box["b"])
+        assert applied is not None
+        epoch, bufs, pos, _merged = applied
+        man.offer(epoch, bufs, pos)
+        man.sync(idx.shapes)
+    finally:
+        tracker.disarm()
+    races = tracker.unwaived_reports()
+    assert not races, "\n".join(r.render() for r in races)
+    # and the adopted buffer kept its mesh placement
+    assert hasattr(man._arrays["shape_tab"], "sharding")
+
+
 # -- racetrack: the background-compaction discipline -------------------------
 
 

@@ -143,6 +143,8 @@ def test_metric_checker_flags_undeclared_series():
         "retained.storm.fuzed", "olp.lag_mz", "olp.tripz",
         "router.segment.hot.fil", "router.compact.runz",
         "racetrack.eventz", "race.reportz",
+        "mesh.shard.fil", "mesh.shard.rebalanse",
+        "mesh.shard.scatter.launchez",
     }
 
 
@@ -188,6 +190,9 @@ def test_shard_checker_flags_unbound_axes_and_stray_collectives():
     assert ("SD001", "bad_axis_body") in bad  # psum over 'rows'
     assert ("SD002", "stray_collective") in bad  # never shard_map-ped
     assert ("SD003", "bad_spec") in bad  # P('lanes')
+    # the scale-out serving placements: a spec naming an unbound axis
+    # in a mesh-serving-shaped helper is a pinned finding
+    assert ("SD003", "bad_mesh_serving_placement") in bad  # P('dq')
 
 
 def test_shard_checker_accepts_mesh_bound_and_reached_code():

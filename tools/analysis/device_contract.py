@@ -249,7 +249,7 @@ def _harness(name: str):
         "route_step", "shape_route_step", "fused_route_retained_step"
     ):
         configs = _configs_single()
-    elif name in ("dist_step", "dist_shape_step"):
+    elif name in ("dist_step", "dist_shape_step", "dist_fused_step"):
         configs = _configs_mesh()
     else:
         return None
@@ -376,6 +376,45 @@ def _harness(name: str):
                 kw["frontier"], kw["max_matches"], kw["probes"],
             )
             return fn, (tables, bits, bytes_mat, lengths)
+        if name == "dist_fused_step":
+            from emqx_tpu.ops.route_index import RouteIndex
+            from emqx_tpu.parallel.mesh import _dist_fused_step_fn
+
+            with_nfa = index.residual_count > 0
+            st = index.shapes.device_snapshot()
+            nt = index.nfa.device_snapshot() if with_nfa else None
+            # retained half: small storm-filter table + a dp-divisible
+            # topic-chunk slab (abstract tracing — no 1M-row CHUNK)
+            ridx = RouteIndex()
+            for f in ("site/+/a", "site/#"):
+                ridx.add(f)
+            rst = ridx.shapes.device_snapshot()
+            r_with_nfa = ridx.residual_count > 0
+            rnt = ridx.nfa.device_snapshot() if r_with_nfa else None
+            ret_bytes = np.zeros((64, 16), np.uint8)
+            fn = _dist_fused_step_fn(
+                mesh,
+                tuple(sorted(st)),
+                tuple(sorted(nt)) if nt is not None else None,
+                None,  # group_keys
+                tuple(sorted(rst)),
+                tuple(sorted(rnt)) if rnt is not None else None,
+                0,  # share_strategy
+                m_active,
+                salt,
+                kw["max_levels"],
+                kw["frontier"],
+                kw["max_matches"],
+                kw["probes"],
+                cfg["kslot"],
+                ridx.shapes.m_active(floor=1),
+                r_with_nfa,
+                ridx.salt,
+                8,  # ret_max_levels
+                True,  # ret_narrow
+            )
+            return fn, (st, nt, None, None, None, None, bits, bytes_mat,
+                        lengths, rst, rnt, ret_bytes)
         from emqx_tpu.parallel.mesh import _dist_shape_step_fn
 
         with_nfa = index.residual_count > 0

@@ -24,6 +24,12 @@
 #                                           # >1M inserts/s and <10ms
 #                                           # subscribe visibility
 #                                           # (docs/update_path.md)
+#   python bench.py --configs mesh_serving  # scale-out sharded serving:
+#                                           # the four-scenario broker
+#                                           # matrix through the mesh
+#                                           # entry (100M subs on TPU;
+#                                           # 2-shard CPU proxy, ~90s —
+#                                           # docs/scale_out.md)
 #   python bench.py                         # full sweep (BENCH json)
 #
 # Exit non-zero on the first failing gate.
