@@ -1147,6 +1147,7 @@ CONFIGS = [
     "serving",  # e2e_serving + serving_dispatch (headline)
     "mesh_serving",  # scale-out sharded serving matrix (ROADMAP item 4)
     "churn_storm",  # O(delta) update path at 10M subs (ROADMAP item 2)
+    "session_storm",  # device-resident session/QoS state (item 2 half 2)
     "share_10m",
     "retained_5m",
     "mixed_1m",
@@ -1167,6 +1168,7 @@ MIN_BUDGET_S = {
     "serving": 280,  # e2e (2 points) + serving_dispatch, one process
     "mesh_serving": 150,  # sharded matrix child (proxy ~60s; full more)
     "churn_storm": 240,  # 10M cold build + churn/visibility phases
+    "session_storm": 110,  # 1M-session resume + redelivery flood
     "share_10m": 120,
     "retained_5m": 110,
     "mixed_1m": 60,
@@ -1970,6 +1972,66 @@ def bench_chaos_soak() -> dict:
         # recovery: dwell out the breaker, then measure a clean wave
         await asyncio.sleep(OPEN_SECS + 0.1)
         recovered = await phase(ing, "recovered")
+
+        # wave 3 (docs/sessions.md): device loss MID-INFLIGHT-WINDOW.
+        # QoS1 deliveries land in store-backed session windows (acks
+        # withheld), then device.launch faults fire BETWEEN delivery
+        # and ack. The zero-loss gate extends to the windows: every
+        # accepted message redelivers EXACTLY once through the
+        # fallback sweep while the device path is down.
+        from emqx_tpu.broker.session import Session, SessionConfig
+        from emqx_tpu.broker.session_store import SessionStore
+
+        mono = [0.0]
+        store = SessionStore(
+            capacity=8192, sweep_slots=4096, retry_interval=1.0,
+            metrics=b.metrics, clock=lambda: mono[0],
+        )
+        b.session_store = store
+        sess = Session(
+            "soak-inflight", SessionConfig(max_inflight=4096),
+            store=store,
+        )
+        resent: list = []
+        store.bind(
+            sess.store_slot,
+            lambda pid, st, msg: resent.append(pid) or True,
+        )
+        b.subscribe(
+            "soak-inflight", "soak-inflight", "inflight/#",
+            pkt.SubOpts(qos=1),
+            lambda msg, o: sess.deliver(msg, o),
+        )
+        await asyncio.gather(*[
+            ing.enqueue(
+                Message(topic=f"inflight/a/{i}", payload=b"p", qos=1)
+            )
+            for i in range(256)
+        ])
+        # the windows are OPEN (unacked) when the device dies; the
+        # in-flight session rider aborts, batches degrade to the trie
+        default_faults.arm("device.launch", mode="raise")
+        await asyncio.gather(*[
+            ing.enqueue(
+                Message(topic=f"inflight/b/{i}", payload=b"p", qos=1)
+            )
+            for i in range(256)
+        ])
+        default_faults.disarm()
+        inflight_rows = store.table.live
+        assert inflight_rows == 512, inflight_rows
+        mono[0] += 5.0  # everything past the retry interval
+        n_re = store.host_sweep()  # degraded: the host fallback scan
+        assert n_re == 512, f"redelivered {n_re}/512 inflight windows"
+        assert store.host_sweep() == 0, "redelivery must be exactly-once"
+        mid_inflight = {
+            "inflight_rows": inflight_rows,
+            "redelivered_exactly_once": n_re,
+        }
+        _mark(f"chaos_soak: mid_inflight {json.dumps(mid_inflight)}")
+        # dwell out the wave-3 trip; the post wave's probe re-closes
+        await asyncio.sleep(OPEN_SECS + 0.1)
+        post_inflight = await phase(ing, "post-inflight-recovery")
         await ing.stop()
         rt.disarm()
         races = rt.unwaived_reports()
@@ -1984,7 +2046,7 @@ def bench_chaos_soak() -> dict:
         )
         total_loss = (
             baseline["loss"] + wave_launch["loss"] + wave_sync["loss"]
-            + recovered["loss"]
+            + recovered["loss"] + post_inflight["loss"]
         )
         # the regression gate: accepted QoS1 publishes never vanish,
         # degradation keeps p99 bounded (no wedged-pipeline stall), and
@@ -2008,6 +2070,8 @@ def bench_chaos_soak() -> dict:
             "fault_device_launch": wave_launch,
             "fault_delta_sync": wave_sync,
             "recovered": recovered,
+            "fault_mid_inflight": mid_inflight,
+            "post_inflight_recovery": post_inflight,
             "recovery_rps_ratio": ratio,
             "degrade": {
                 "trips": m.get("degrade.trips.device"),
@@ -2044,6 +2108,159 @@ def bench_chaos_soak() -> dict:
         }
 
     return asyncio.run(run())
+
+
+def bench_session_storm(deadline: Optional[float] = None) -> dict:
+    """`session_storm` config (ROADMAP item 2, docs/sessions.md): a
+    reconnect storm WITH per-client delivery guarantees intact.
+
+    Phases, all against the device-resident `SessionStore`:
+
+    1. build — N sessions each holding one unacked QoS1 inflight row,
+       bulk-placed into the open-addressing (slot, pid) table (one
+       epoch bump), then mass-disconnected (state lives ONLY in the
+       table — zero per-session Python objects);
+    2. resume — capture/install the store (the crashed-broker shape)
+       and re-arm EVERY window with ONE full upload (segment replay);
+       `resume_visibility_ms` is install -> first device-swept
+       redelivery landing through the REAL pipeline (the window a
+       reconnected client cannot be retried in);
+    3. redelivery flood — device sweeps ride serving launches
+       (`session_ack_step` fused into `session_route_step`: no extra
+       launch, no extra readback), each sweep returning up to
+       `sweep_slots` due rows; the flood drains when every session has
+       been retransmitted EXACTLY once (asserted), reporting
+       `redelivery_rps`.
+
+    The host-dict equivalence property (device store == dict store
+    ack/redelivery behavior) is pinned in tier-1
+    (tests/test_session_store.py), not re-measured here.
+    """
+    import asyncio
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.broker.session_store import SessionStore
+    from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.ops.nfa import _next_pow2
+
+    N = int(os.environ.get("BENCH_SESSION_N", 1_000_000))
+    SWEEP_K = 16384
+
+    mono = [0.0]
+    _mark(f"session_storm: building {N} sessions (1 QoS1 inflight each)")
+    t0 = time.perf_counter()
+    store = SessionStore(
+        capacity=_next_pow2(2 * N), sweep_slots=SWEEP_K,
+        retry_interval=1.0, clock=lambda: mono[0],
+    )
+    cids = [f"c{i}" for i in range(N)]
+    # payload bytes are shared (the slab stores refs); pids cycle the
+    # 16-bit space so (slot, pid) keys stay unique per session
+    shared = Message(topic="dev/offline", payload=b"m", qos=1)
+    rows = store.bulk_load(
+        cids, [shared] * N, pids=(np.arange(N) % 65535) + 1
+    )
+    lost = int((rows < 0).sum())
+    build_s = time.perf_counter() - t0
+    _mark(
+        f"session_storm: built in {build_s:.1f}s (table cap "
+        f"{store.table._cap}, lost {lost}); mass-disconnecting"
+    )
+    assert lost == 0, f"{lost} rows lost in bulk placement"
+    # mass disconnect: nothing to tear down — no channel or Session
+    # object exists; the inflight state IS the table
+    state = store.capture()
+
+    # -- resume: a fresh broker restores the store as a segment replay --
+    b = Broker(router=Router(min_tpu_batch=32), hooks=Hooks())
+    store2 = SessionStore(
+        capacity=64, sweep_slots=SWEEP_K, retry_interval=1.0,
+        metrics=b.metrics, clock=lambda: mono[0],
+    )
+    b.session_store = store2
+    b.subscribe("drv", "drv", "drive/#", pkt.SubOpts(), lambda m, o: None)
+    redelivered = [0]
+    first_hit = [None]
+
+    def resend(pid, st, msg):
+        redelivered[0] += 1
+        if first_hit[0] is None:
+            first_hit[0] = time.perf_counter()
+        return True
+
+    t1 = time.perf_counter()
+    resumed = store2.install(state)
+    for slot in range(len(store2._slot_cid)):
+        store2._bind[slot] = resend
+    install_s = time.perf_counter() - t1
+    assert resumed == N, (resumed, N)
+    mono[0] += 60.0  # every window is long past its retry interval
+
+    async def flood() -> dict:
+        ing = BatchIngest(b, max_batch=256, window_us=200)
+        b.ingest = ing
+        ing.start()
+        # warm: first launch pays the full table upload (THE replay)
+        await ing.submit(Message(topic="drive/warm", payload=b"w", qos=0))
+        t2 = time.perf_counter()
+        sweeps = 0
+        while redelivered[0] < N:
+            if deadline is not None and time.perf_counter() > deadline:
+                break
+            store2.request_sweep()
+            futs = [
+                ing.enqueue(Message(topic=f"drive/{i}", payload=b"p"))
+                for i in range(64)
+            ]
+            await asyncio.gather(*futs)
+            sweeps += 1
+        wall = time.perf_counter() - t2
+        await ing.stop()
+        return {"wall": wall, "sweeps": sweeps}
+
+    fl = asyncio.run(flood())
+    m = b.metrics
+    complete = redelivered[0] >= N
+    out = {
+        "sessions": N,
+        "build_s": round(build_s, 2),
+        "sessions_resumed": resumed,
+        "resume_install_ms": round(install_s * 1e3, 2),
+        "resume_visibility_ms": round(
+            (first_hit[0] - t1) * 1e3, 2
+        ) if first_hit[0] else None,
+        "resumed_per_s": round(N / max(install_s, 1e-9), 1),
+        "redelivered": redelivered[0],
+        "redelivery_rps": round(redelivered[0] / max(fl["wall"], 1e-9), 1),
+        "sweep_launches": fl["sweeps"],
+        "sweep_slots": SWEEP_K,
+        "ack_rides": m.get("session.ack.rides"),
+        "device_sweeps": m.get("session.sweep.device"),
+        "extra_scatter_launches": store2.manager.delta_launches,
+        "full_uploads": store2.manager.full_resyncs,
+        "timeout": not complete,
+        "note": (
+            "mass disconnect -> reconnect-with-session -> QoS1"
+            " redelivery flood. Resume is ONE full table upload (the"
+            " segment replay; zero per-session Python objects"
+            " rebuilt); the flood's retry scans are device sweeps"
+            " fused into serving launches (session_ack_step riding"
+            " session_route_step: extra_scatter_launches stays 0)."
+            " Each session redelivers exactly once — the sweep"
+            " refreshes the retransmit stamp on device AND host."
+        ),
+    }
+    if complete:
+        assert redelivered[0] == N, (redelivered[0], N)
+        assert store2.manager.delta_launches == 0, (
+            "ack/sweep path paid its own scatter launch"
+        )
+    _mark(f"session_storm: {json.dumps(out)}")
+    return out
 
 
 def bench_churn_storm(rng, deadline: Optional[float] = None) -> dict:
@@ -2404,6 +2621,8 @@ def _run_config(name: str, deadline: Optional[float] = None) -> dict:
         return bench_chaos_soak()
     if name == "churn_storm":
         return bench_churn_storm(rng, deadline)
+    if name == "session_storm":
+        return bench_session_storm(deadline)
     if name == "mesh_serving":
         return bench_mesh_serving(deadline)
     if name == "serving":
@@ -2634,6 +2853,16 @@ def main() -> None:
                     "subscribe_visibility_ms": churn.get(
                         "subscribe_visibility_ms"
                     ),
+                    # device-resident session state (session_storm)
+                    "sessions_resumed": results.get(
+                        "session_storm", {}
+                    ).get("sessions_resumed"),
+                    "session_resume_visibility_ms": results.get(
+                        "session_storm", {}
+                    ).get("resume_visibility_ms"),
+                    "session_redelivery_rps": results.get(
+                        "session_storm", {}
+                    ).get("redelivery_rps"),
                     "skipped_configs": skipped,
                     "wall_s": round(time.perf_counter() - _T0, 1),
                     # the note reflects the ACTUAL run (r4 shipped a

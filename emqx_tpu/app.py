@@ -210,6 +210,24 @@ class BrokerApp:
             # index pick it up from here (segment-manager placements)
             self.router.mesh = self.broker.mesh
         self.cm = ChannelManager(self.broker)
+        # device-resident session store (broker/session_store.py): the
+        # inflight/QoS state tables ride the same segment machinery as
+        # subscriptions; ack clears fuse into serving launches. The
+        # host-dict path stays the fallback (knob off = unchanged)
+        if c.session.device_store and c.router.enable_tpu:
+            from emqx_tpu.broker.session_store import SessionStore
+
+            self.session_store = SessionStore(
+                capacity=c.session.store_capacity,
+                sweep_slots=c.session.store_sweep_slots,
+                retry_interval=c.session.retry_interval,
+                metrics=self.broker.metrics,
+                mesh=self.broker.mesh,
+            )
+            self.broker.session_store = self.session_store
+            self.cm.session_store = self.session_store
+        else:
+            self.session_store = None
         self.channel_config = ChannelConfig(caps=c.mqtt, session=c.session)
         # populated below once authn config is read (SCRAM enhanced auth)
         # rate limiting + overload protection (reference: emqx_limiter,
@@ -556,16 +574,32 @@ class BrokerApp:
                 from emqx_tpu.ops.segments import SegmentStateSnapshot
 
                 def _cap_segments():
-                    return {
+                    state = {
                         "router": self.broker.router,
                         "subtab": self.broker.subtab,
                         "grouptab": self.broker.grouptab,
                     }
+                    if self.session_store is not None:
+                        # mass session resume = segment replay: the
+                        # whole inflight/QoS table checkpoints as
+                        # arrays; restore re-arms every window with one
+                        # upload, zero per-session objects rebuilt
+                        state["session_store"] = (
+                            self.session_store.capture()
+                        )
+                    return state
 
                 def _install_segments(state):
                     self.broker.router = state["router"]
                     self.broker.subtab = state["subtab"]
                     self.broker.grouptab = state["grouptab"]
+                    if (
+                        self.session_store is not None
+                        and state.get("session_store") is not None
+                    ):
+                        self.session_store.install(
+                            state["session_store"]
+                        )
                     self.broker._device = None  # rebuilt on next batch
 
                 segments = SegmentStateSnapshot(
@@ -1046,6 +1080,7 @@ class BrokerApp:
 
         c = self.config
         last_retainer_sweep = 0.0
+        last_session_sweep = 0.0
         last_durability_flush = time.time()
         # mesh.shard.* accounting (scale-out serving): scatter launches
         # diff the segment managers' counters; the lane-fill scan walks
@@ -1090,12 +1125,19 @@ class BrokerApp:
                         "router.segment.tombstones", st["tombstones"]
                     )
                     rc = self.config.router
-                    self.segment_compactor.tick(
-                        dev.compaction_owners(
-                            hot_entries=rc.compact_hot_entries,
-                            tombstone_frac=rc.compact_tombstone_frac,
-                        )
+                    owners = dev.compaction_owners(
+                        hot_entries=rc.compact_hot_entries,
+                        tombstone_frac=rc.compact_tombstone_frac,
                     )
+                    if self.session_store is not None:
+                        # fourth owner on the one compactor: purge acked
+                        # (tombstoned) session rows off the critical path
+                        owners.append(
+                            self.session_store.compaction_owner(
+                                tombstone_frac=rc.compact_tombstone_frac
+                            )
+                        )
+                    self.segment_compactor.tick(owners)
                 if (
                     dev is not None
                     and self.broker.mesh is not None
@@ -1121,6 +1163,21 @@ class BrokerApp:
                             st.get("lane_fill_max", 0.0),
                         )
                     mesh_fill_tick += 1
+                if (
+                    self.session_store is not None
+                    and now - last_session_sweep
+                    >= c.session.store_sweep_interval
+                ):
+                    # arm a retry/expiry sweep to ride the next serving
+                    # launch (host fallback scan when idle / non-fusing)
+                    dev2 = self.broker._device
+                    self.session_store.tick(
+                        fused_path=dev2 is not None
+                        and getattr(
+                            dev2, "supports_session_fusion", False
+                        )
+                    )
+                    last_session_sweep = now
                 self.trace.sweep(now)
                 self.license.tick(now)
                 self.topic_metrics.tick_rates(now)

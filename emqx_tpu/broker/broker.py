@@ -139,6 +139,11 @@ class Broker:
         # device-path circuit breaker + bounded retry policy. None =
         # legacy behavior (a failed launch fails its batch's publishes)
         self.degrade = None
+        # SessionStore (broker/session_store.py), attached by the app
+        # when session.device_store: pending inflight writes + QoS
+        # retry/expiry sweeps ride serving launches as the fused
+        # session-ack stage (no extra launch or readback per batch)
+        self.session_store = None
 
     # -- subscribe side ---------------------------------------------------
     def subscribe(
@@ -495,6 +500,20 @@ class Broker:
             # (fused_route_retained_step single-device; dist_fused_step
             # on the mesh engine, chunk rows scanning sharded over 'dp')
             storm = feed.take_job()
+        store = self.session_store
+        rider = None
+        if store is not None and storm is None and getattr(
+            dev, "supports_session_fusion", False
+        ):
+            # pending session-table writes (+ a requested retry/expiry
+            # sweep) fuse into THIS launch as the session-ack stage —
+            # ack batches never pay their own device launch, and the
+            # sweep lists ride the same coalesced readback
+            rider = store.take_rider()
+            if rider is not None and batch_span is not None:
+                batch_span.attrs["session.rider.rows"] = rider.rows
+                if rider.sweep_k:
+                    batch_span.attrs["session.sweep"] = True
         rec = self.spans
         t_launch = rec.now_ns() if rec is not None else 0
         topics = [m.topic for m in msgs]
@@ -506,17 +525,28 @@ class Broker:
             topics,
             hashes,
             storm,
+            rider,
         )
         if storm is not None:
             feed.attach(storm, fut)
 
         async def _complete():
+            srd = rider
             try:
                 results = await fut
             except Exception:  # noqa: BLE001 — the retry ladder owns it
                 if deg is None:
+                    if srd is not None:
+                        store.abort(srd)
                     raise
                 results = None
+            if results is None and srd is not None:
+                # the failed launch carried the session rider: nothing
+                # is lost (host arrays are authoritative) — its writes
+                # stay queued and ride a later launch or the segment
+                # scatter path; retries relaunch bare
+                store.abort(srd)
+                srd = None
             if results is None:
                 # bounded exponential backoff + jitter, then degrade:
                 # each retry re-prepares (the failure may have been a
@@ -550,6 +580,10 @@ class Broker:
                 return self._dispatch_cpu_batch(msgs, forward)
             if deg is not None:
                 deg.device.record_success()
+            if srd is not None and results.session is not None:
+                # adopt the updated device mirror + act on the sweep
+                # (back on the loop — the single-writer discipline)
+                store.commit(srd, results.session)
             if storm is not None:
                 # no-op when the storm already failed over (retry path)
                 feed.resolve(storm, results.retained)

@@ -855,12 +855,18 @@ class Channel:
 
     # -- timers ------------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
-        """Periodic work: QoS retry + awaiting_rel expiry."""
+        """Periodic work: QoS retry + awaiting_rel expiry. `now` is a
+        monotonic-clock reading (elapsed-time questions only — wall
+        steps must not mass-expire windows)."""
         if self.session is None:
             return
-        for q in self.session.retry():
-            self._send(q)
-        now = now or time.time()
+        if not self.session.inflight.store_managed:
+            # store-managed windows retransmit from the session store's
+            # sweep (device scan riding a launch, or the host fallback)
+            # through _store_resend — never from a per-channel walk
+            for q in self.session.retry():
+                self._send(q)
+        now = now or time.monotonic()
         timeout = self.session.config.await_rel_timeout
         expired = [
             pid
@@ -868,7 +874,27 @@ class Channel:
             if now - ts > timeout
         ]
         for pid in expired:
-            del self.session.awaiting_rel[pid]
+            self.session.release_rel(pid)
+
+    def _store_resend(self, pid: int, state: int, msg) -> bool:
+        """Redelivery sink for the session store's retry sweeps: dup
+        PUBLISH for the publish phase, PUBREL for the rel phase. Returns
+        False (no stamp refresh) when this channel can't transmit."""
+        if self.state != "connected" or self.session is None:
+            return False
+        from emqx_tpu.ops.session_table import ST_PUBREL
+
+        if state == ST_PUBREL:
+            rel = pkt.PubAck(packet_id=pid)
+            rel.type = pkt.PUBREL
+            self._send(rel)
+            return True
+        if msg is None:
+            return False
+        self._send(
+            self.session._publish_packet(msg, msg.qos, pid, dup=True)
+        )
+        return True
 
     # -- takeover / kick ---------------------------------------------------
     def kick(self, reason: str) -> Optional[Session]:

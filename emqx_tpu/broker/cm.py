@@ -21,8 +21,12 @@ from emqx_tpu.utils.tracepoints import tp
 
 
 class ChannelManager:
-    def __init__(self, broker: Broker):
+    def __init__(self, broker: Broker, session_store=None):
         self.broker = broker
+        # SessionStore (broker/session_store.py): when set, sessions are
+        # created store-backed — inflight windows write through to the
+        # device-resident table, sweeps retransmit via channel bindings
+        self.session_store = session_store
         self._channels: Dict[str, object] = {}  # client_id -> Channel
         self._detached: Dict[str, Tuple[Session, float]] = {}
         # worker fabrics (transport/workers.WorkerFabric) register here:
@@ -66,7 +70,9 @@ class ChannelManager:
             from emqx_tpu.storage.codec import session_from_json
 
             try:
-                remote = session_from_json(sj, channel.config.session)
+                remote = session_from_json(
+                    sj, channel.config.session, store=self.session_store
+                )
             except Exception:
                 remote = None
         return self._open_local(channel, remote=remote)
@@ -101,7 +107,9 @@ class ChannelManager:
                 present = True
                 tp("cm.takenover", cid=cid)
         if session is None:
-            session = Session(cid, channel.config.session)
+            session = Session(
+                cid, channel.config.session, store=self.session_store
+            )
             self.broker.hooks.run("session.created", cid)
             tp("cm.created", cid=cid)
         else:
@@ -110,6 +118,13 @@ class ChannelManager:
                 self.broker.subscribe(
                     cid, cid, f, opts, channel._make_deliverer(opts)
                 )
+        if self.session_store is not None and session.store_slot is not None:
+            # live again: the sweep retransmits through THIS channel,
+            # and the expiry lane disarms until the next detach
+            self.session_store.bind(
+                session.store_slot, channel._store_resend
+            )
+            self.session_store.set_expiry(cid, 0)
         self._channels[cid] = channel
         self.broker.metrics.gauge_set("connections.count", len(self._channels))
         return session, present
@@ -120,6 +135,8 @@ class ChannelManager:
             self.broker.drop_session_subs(
                 sess.client_id, list(sess.subscriptions)
             )
+        if self.session_store is not None:
+            self.session_store.drop_session(old.client_id)
         self.broker.hooks.run("session.discarded", old.client_id)
 
     def _drop_detached(self, cid: str) -> None:
@@ -127,6 +144,8 @@ class ChannelManager:
         if ent is not None:
             sess, _ = ent
             self.broker.drop_session_subs(cid, list(sess.subscriptions))
+            if self.session_store is not None:
+                self.session_store.drop_session(cid)
             self.broker.hooks.run("session.discarded", cid)
 
     def on_channel_closed(self, channel, reason: str) -> None:
@@ -138,13 +157,22 @@ class ChannelManager:
         sess = channel.session
         if sess is None:
             return
+        store = self.session_store
+        if store is not None and sess.store_slot is not None:
+            store.unbind(sess.store_slot)
         expiry = sess.config.expiry_interval
         if expiry > 0:
             self._detached[cid] = (sess, time.time() + expiry)
+            if store is not None and sess.store_slot is not None:
+                # arm the device expiry lane; the table rows stay put —
+                # resume is a rebind, never a rebuild
+                store.set_expiry(cid, expiry)
             # persistence swaps in its durable banker on this hookpoint
             self.broker.hooks.run("session.detached", cid)
         else:
             self.broker.drop_session_subs(cid, list(sess.subscriptions))
+            if store is not None:
+                store.drop_session(cid)
             self.broker.hooks.run("session.terminated", cid, reason)
 
     def kick_client(self, client_id: str) -> bool:
@@ -155,6 +183,8 @@ class ChannelManager:
         sess = ch.kick("kicked")
         if sess is not None:
             self.broker.drop_session_subs(client_id, list(sess.subscriptions))
+        if self.session_store is not None:
+            self.session_store.drop_session(client_id)
         return True
 
     def sweep_expired(self, now: Optional[float] = None) -> int:

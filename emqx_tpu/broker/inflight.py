@@ -1,7 +1,14 @@
 """Inflight window for QoS1/2 deliveries (reference: emqx_inflight.erl).
 
 Insertion-ordered dict keyed by packet id; entries carry the message, send
-timestamp, and the QoS2 state ('publish' sent vs 'pubrel' phase)."""
+timestamp, and the QoS2 state ('publish' sent vs 'pubrel' phase).
+
+Timestamps are `time.monotonic()`, NOT wall clock: retry/expiry decisions
+are elapsed-time questions, and a wall-clock step (NTP correction, manual
+set) would otherwise mass-expire every window at once — or freeze retries
+entirely when the clock jumps backward. Serialization (storage/codec)
+converts to/from ages, never raw stamps.
+"""
 
 from __future__ import annotations
 
@@ -18,10 +25,12 @@ class InflightEntry:
     # metadata survive so completion hooks can report on the message
     msg: Optional[Message]
     phase: str  # 'publish' | 'pubrel'
-    ts: float
+    ts: float  # monotonic-clock stamp of the last (re)transmit
 
 
 class Inflight:
+    store_managed = False  # True on the session-store write-through view
+
     def __init__(self, max_size: int = 32):
         self.max_size = max_size
         self._d: Dict[int, InflightEntry] = {}
@@ -35,15 +44,18 @@ class Inflight:
     def contains(self, packet_id: int) -> bool:
         return packet_id in self._d
 
+    def get(self, packet_id: int) -> Optional[InflightEntry]:
+        return self._d.get(packet_id)
+
     def insert(self, packet_id: int, msg: Message, phase: str = "publish"):
-        self._d[packet_id] = InflightEntry(msg, phase, time.time())
+        self._d[packet_id] = InflightEntry(msg, phase, time.monotonic())
 
     def update(self, packet_id: int, phase: str) -> bool:
         e = self._d.get(packet_id)
         if e is None:
             return False
         e.phase = phase
-        e.ts = time.time()
+        e.ts = time.monotonic()
         if phase == "pubrel" and e.msg is not None and e.msg.payload:
             # payload no longer needed after PUBREC; keep the metadata
             import copy
@@ -60,8 +72,9 @@ class Inflight:
         return iter(list(self._d.items()))
 
     def retry_due(self, interval: float, now: Optional[float] = None):
-        """Entries older than `interval` seconds, for retransmission."""
-        now = now or time.time()
+        """Entries older than `interval` seconds, for retransmission.
+        `now` must be a monotonic-clock reading when provided."""
+        now = now or time.monotonic()
         return [
             (pid, e) for pid, e in self._d.items() if now - e.ts >= interval
         ]

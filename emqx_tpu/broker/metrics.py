@@ -473,6 +473,39 @@ declare("mesh.shard.reroutes", COUNTER,
         "publish forwards rerouted from a dead shard owner to its "
         "rendezvous successor (the stall the re-own ladder removes)")
 
+# -- device-resident session store (broker/session_store.py,
+# ops/session_table.py; docs/sessions.md) ----------------------------------
+declare("session.store.sessions", GAUGE,
+        "live session slots registered in the store")
+declare("session.store.inflight", GAUGE,
+        "live inflight/awaiting-rel rows in the session table")
+declare("session.store.tombstones", GAUGE,
+        "acked (cleared) session rows awaiting compaction")
+declare("session.ack.rides", COUNTER,
+        "session write batches fused onto a serving launch "
+        "(session_ack_step riding session_route_step: zero extra "
+        "launches, zero extra readbacks)")
+declare("session.ack.rows", COUNTER,
+        "row writes (delivery inserts + PUBACK/PUBREC/PUBCOMP/PUBREL "
+        "clears) applied via fused rides")
+declare("session.ack.scatters", COUNTER,
+        "session deltas applied via the segment scatter path instead "
+        "(mesh engine, idle broker, or degraded device path)")
+declare("session.sweep.device", COUNTER,
+        "QoS retry/expiry sweeps that rode a serving launch")
+declare("session.sweep.host", COUNTER,
+        "host-array fallback sweeps (idle broker, non-fusing engine, "
+        "or device path degraded)")
+declare("session.sweep.due", COUNTER,
+        "rows a sweep found due for retransmit (uncapped count)")
+declare("session.redeliveries", COUNTER,
+        "QoS1/2 retransmits sent from sweep hits (host re-verified)")
+declare("session.expired.swept", COUNTER,
+        "sessions the expiry sweep flagged past their deadline")
+declare("session.resume.replayed", COUNTER,
+        "sessions resumed via segment replay (store install: one full "
+        "upload re-arms every inflight window)")
+
 # retained-replay storm feed (broker/retained_feed.py)
 declare("retained.storm.filters", COUNTER,
         "wildcard replay filters batched through the storm feed")

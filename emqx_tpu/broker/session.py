@@ -32,17 +32,47 @@ class SessionConfig:
     # defaults to 2h); v5 clients override via Session-Expiry-Interval, and
     # clean-start v4 sessions are forced to 0 by the channel manager
     expiry_interval: float = 7200.0
+    # device-resident session store (broker/session_store.py): inflight
+    # windows + QoS state land on segment tables, ack clears fuse into
+    # serving launches, retry scans become device sweeps. Off = the
+    # host-dict path alone (also the degrade-ladder fallback when on)
+    device_store: bool = False
+    # initial (slot, packet-id) row capacity; grows by doubling
+    store_capacity: int = 4096
+    # compact width of the device retry/expiry sweep (pow2-rounded);
+    # uncapped counts tell the store when a flood needs a second sweep
+    store_sweep_slots: int = 1024
+    # how often housekeeping arms a sweep / runs the host fallback scan
+    store_sweep_interval: float = 5.0
 
 
 class Session:
-    def __init__(self, client_id: str, config: SessionConfig = SessionConfig()):
+    def __init__(
+        self,
+        client_id: str,
+        config: SessionConfig = SessionConfig(),
+        store=None,
+    ):
+        """`store`: an optional `broker.session_store.SessionStore` —
+        when given, inflight/awaiting-rel state writes through to the
+        device-resident session table (the dict view stays authoritative
+        for this live session; the table carries the aggregate state for
+        fused ack clears, device sweeps, and mass resume)."""
         import dataclasses
 
         self.client_id = client_id
         self.config = dataclasses.replace(config)  # per-session copy
         self.created_at = time.time()
         self.subscriptions: Dict[str, pkt.SubOpts] = {}
-        self.inflight = Inflight(config.max_inflight)
+        self.store = store
+        if store is not None:
+            self.store_slot = store.attach(client_id)
+            self.inflight = store.make_inflight(
+                self.store_slot, config.max_inflight
+            )
+        else:
+            self.store_slot = None
+            self.inflight = Inflight(config.max_inflight)
         self.mqueue = MQueue(config.max_mqueue)
         self.awaiting_rel: Dict[int, float] = {}  # incoming QoS2 packet ids
         self._next_pid = 1
@@ -110,7 +140,7 @@ class Session:
 
     def pubrec(self, packet_id: int) -> bool:
         """QoS2 phase 1 ack'd by receiver -> move to rel phase."""
-        e = self.inflight._d.get(packet_id)
+        e = self.inflight.get(packet_id)
         if e is None or e.phase != "publish":
             return False
         self.inflight.update(packet_id, "pubrel")
@@ -136,16 +166,22 @@ class Session:
 
     # -- incoming QoS2 (client -> broker) ---------------------------------
     def await_rel(self, packet_id: int) -> bool:
-        """Track an incoming QoS2 publish until PUBREL; False if duplicate."""
+        """Track an incoming QoS2 publish until PUBREL; False if duplicate.
+        Stamps are monotonic (expiry is an elapsed-time question)."""
         if packet_id in self.awaiting_rel:
             return False
         if len(self.awaiting_rel) >= self.config.max_awaiting_rel:
             raise OverflowError("max_awaiting_rel")
-        self.awaiting_rel[packet_id] = time.time()
+        self.awaiting_rel[packet_id] = time.monotonic()
+        if self.store is not None:
+            self.store.await_rel(self.store_slot, packet_id)
         return True
 
     def release_rel(self, packet_id: int) -> bool:
-        return self.awaiting_rel.pop(packet_id, None) is not None
+        ok = self.awaiting_rel.pop(packet_id, None) is not None
+        if ok and self.store is not None:
+            self.store.release_rel(self.store_slot, packet_id)
+        return ok
 
     # -- retry ------------------------------------------------------------
     def retry(self) -> List[pkt.Packet]:
@@ -158,7 +194,9 @@ class Session:
                 rel = pkt.PubAck(packet_id=pid)
                 rel.type = pkt.PUBREL
                 out.append(rel)
-            e.ts = time.time()
+            e.ts = time.monotonic()
+            if self.store is not None:
+                self.store.touch_inflight(self.store_slot, pid)
         return out
 
     # -- takeover ---------------------------------------------------------

@@ -726,6 +726,12 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.retainer.storm_window_us < 0:
         raise ConfigError("retainer.storm_window_us must be >= 0")
+    if cfg.session.store_capacity < 64:
+        raise ConfigError("session.store_capacity must be >= 64")
+    if cfg.session.store_sweep_slots < 16:
+        raise ConfigError("session.store_sweep_slots must be >= 16")
+    if cfg.session.store_sweep_interval <= 0:
+        raise ConfigError("session.store_sweep_interval must be > 0")
     for i, fr in enumerate(cfg.faults.rules):
         if fr.site not in FAULT_SITES:
             raise ConfigError(

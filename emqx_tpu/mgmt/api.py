@@ -376,6 +376,20 @@ class MgmtApi:
                 "compact_ms": hist("router.compact.seconds", 1e3),
                 "compact_lag_s": m.gauge("router.compact.lag.seconds"),
             },
+            "session": (
+                {
+                    **self.broker.session_store.status(),
+                    "ack_rides": m.get("session.ack.rides"),
+                    "ack_rows": m.get("session.ack.rows"),
+                    "ack_scatters": m.get("session.ack.scatters"),
+                    "sweeps_device": m.get("session.sweep.device"),
+                    "sweeps_host": m.get("session.sweep.host"),
+                    "redeliveries": m.get("session.redeliveries"),
+                    "resumed": m.get("session.resume.replayed"),
+                }
+                if self.broker.session_store is not None
+                else None
+            ),
             "mesh": {
                 "shape": (
                     f"{self.broker.mesh.shape['dp']}x"

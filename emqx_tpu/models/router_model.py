@@ -351,6 +351,99 @@ shape_route_step_donated = partial(
 )(shape_route_step_impl)
 
 
+def session_route_step_impl(
+    shape_tables,
+    nfa_tables,
+    sub_bitmaps,
+    bytes_mat,
+    lengths,
+    sess_tables,
+    sess_idxs,
+    sess_vals,
+    sess_clock,
+    group_tables=None,
+    client_hash=None,
+    topic_hash=None,
+    rand=None,
+    *,
+    m_active: int,
+    with_nfa: bool,
+    salt: int,
+    max_levels: int = 16,
+    frontier: int = 32,
+    max_matches: int = 64,
+    probes: int = 8,
+    shape_probes: Optional[int] = None,
+    with_groups: bool = False,
+    share_strategy: int = 0,
+    kslot: int = 0,
+    sweep_k: int = 0,
+):
+    """Publish routing + the session-ack stage as ONE device program.
+
+    The composition of two audited kernels (`shape_route_step` +
+    `session_ack_step`, docs/sessions.md): a batch's pending inflight
+    writes — delivery inserts, PUBACK/PUBREC/PUBCOMP/PUBREL clears —
+    scatter onto the device session table inside the SAME launch the
+    batch pays for routing, and (``sweep_k > 0``) the QoS retransmit /
+    session-expiry sweep's compact row lists ride the same coalesced
+    readback. The updated session arrays stay on device (the store
+    adopts them as the new mirror); only the O(sweep_k) sweep outputs
+    ever cross the link — no extra launch, no extra transfer.
+    """
+    from emqx_tpu.ops.session_table import session_ack_impl
+
+    out = shape_route_step_impl(
+        shape_tables,
+        nfa_tables,
+        sub_bitmaps,
+        bytes_mat,
+        lengths,
+        group_tables,
+        client_hash,
+        topic_hash,
+        rand,
+        m_active=m_active,
+        with_nfa=with_nfa,
+        salt=salt,
+        max_levels=max_levels,
+        frontier=frontier,
+        max_matches=max_matches,
+        probes=probes,
+        shape_probes=shape_probes,
+        with_groups=with_groups,
+        share_strategy=share_strategy,
+        kslot=kslot,
+    )
+    out["session"] = session_ack_impl(
+        sess_tables, sess_idxs, sess_vals, sess_clock, sweep_k=sweep_k
+    )
+    return out
+
+
+# jit entry for the session-fused program. Not a separate device
+# contract: it composes two registered kernels (`shape_route_step` +
+# `session_ack_step`), each audited with its own golden jaxpr — the
+# same rationale as shape_route_step_donated's shared contract.
+session_route_step = partial(
+    jax.jit,
+    static_argnames=(
+        "m_active",
+        "with_nfa",
+        "salt",
+        "max_levels",
+        "frontier",
+        "max_matches",
+        "probes",
+        "shape_probes",
+        "with_groups",
+        "share_strategy",
+        "kslot",
+        "sweep_k",
+    ),
+)(session_route_step_impl)
+
+
 def fused_route_retained_step_impl(
     shape_tables,
     nfa_tables,
@@ -859,6 +952,10 @@ class RouteResult(NamedTuple):
     # fused retained-replay storm that rode this batch's launch
     # (fused_route_retained_step): {filter: matched row-index array}
     retained: Optional[Dict[str, np.ndarray]] = None
+    # fused session-ack stage outputs (session_route_step): a
+    # `broker.session_store.SessionStepOut` — updated device mirror
+    # (stays on device) + the O(sweep_k) sweep lists
+    session: Optional[tuple] = None
 
 
 # floor for the auto-sized compact-slot cap: below this the slot list is
@@ -1220,7 +1317,7 @@ class DeviceRouter:
         )
 
     def route_prepared(self, args, topics, client_hashes=None,
-                       retained=None):
+                       retained=None, session=None):
         """Kernel launch + readback against a `prepare()` snapshot; touches
         no mutable host state, so it may run in an executor thread while
         the event loop keeps serving connections (the jit compile on a new
@@ -1243,7 +1340,9 @@ class DeviceRouter:
         import time
 
         t0 = time.perf_counter()
-        out = self._route_prepared(args, topics, client_hashes, retained)
+        out = self._route_prepared(
+            args, topics, client_hashes, retained, session
+        )
         if self.metrics is not None:
             # Histogram.observe is lock-safe: this runs on executor threads
             self.metrics.observe(
@@ -1268,7 +1367,7 @@ class DeviceRouter:
         return out
 
     def _route_prepared(self, args, topics, client_hashes=None,
-                        retained=None):
+                        retained=None, session=None):
         from emqx_tpu.broker.shared_sub import stable_hash
         from emqx_tpu.ops import tokenizer as tok
 
@@ -1315,6 +1414,13 @@ class DeviceRouter:
         else:
             ch = th = rand = None
         if self.mesh is not None and bits is not None:
+            if session is not None:
+                # engine contract: callers gate on
+                # supports_session_fusion — the mesh engine's session
+                # mirror updates ride the segment scatter path instead
+                raise RuntimeError(
+                    "session rider handed to a non-fusing mesh engine"
+                )
             return self._route_mesh(
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
@@ -1332,6 +1438,20 @@ class DeviceRouter:
             share_strategy=self.share_strategy,
             kslot=kslot,
         )
+        if session is not None:
+            # the fused session-ack stage: the rider's inflight writes +
+            # retry/expiry sweep ride THIS launch and THIS readback (the
+            # broker never pairs a rider with a retained storm)
+            out = session_route_step(
+                shape_tables, nfa_tables, bits, mat, lens,
+                session.arrays, session.idxs, session.vals,
+                session.clock,
+                group_tables, ch, th, rand,
+                sweep_k=session.sweep_k, **step_kw,
+            )
+            return self._readback(
+                out, B, too_long, with_groups, kslot, session=session
+            )
         if retained is not None and retained.chunks:
             # one launch, one readback: the storm's chunk-0 match rides
             # the route program; extra chunks launch before any readback
@@ -1382,7 +1502,7 @@ class DeviceRouter:
 
     def _readback(  # readback-site
         self, out, B, too_long, with_groups, kslot, mesh=False,
-        retained=None, extra_retained=None,
+        retained=None, extra_retained=None, session=None,
     ):
         """Pull one batch's outputs to host -> `RouteResult`.
 
@@ -1432,6 +1552,14 @@ class DeviceRouter:
             pulls["retained"] = out["retained"]
             for j, m in enumerate(extra_retained or ()):
                 pulls[f"retained_{j + 1}"] = m
+        if session is not None and session.sweep_k:
+            # the session sweep's compact lists join the one device_get;
+            # the updated table arrays themselves NEVER cross the link
+            sess = out["session"]
+            pulls["session_due"] = sess["due"]
+            pulls["session_due_count"] = sess["due_count"]
+            pulls["session_expired"] = sess["expired"]
+            pulls["session_expired_count"] = sess["expired_count"]
         host = jax.device_get(pulls)
         matched = host["matched"]
         mcount = host["mcount"]
@@ -1449,10 +1577,26 @@ class DeviceRouter:
                 for j in range(len(extra_retained or ()))
             ]
             retained_res = retained.decode(chunks_m)
+        sess_res = None
+        if session is not None:
+            from emqx_tpu.broker.session_store import SessionStepOut
+
+            sess = out["session"]
+            if session.sweep_k:
+                sess_res = SessionStepOut(
+                    sess["tables"],
+                    host["session_due"],
+                    int(host["session_due_count"]),
+                    host["session_expired"],
+                    int(host["session_expired_count"]),
+                )
+            else:
+                sess_res = SessionStepOut(sess["tables"], None, 0, None, 0)
         if out["bitmaps"] is None:
             return RouteResult(
                 matched, mcount, flags, None, picks,
                 readback_bytes=readback, retained=retained_res,
+                session=sess_res,
             )
         if kslot:
             slots = host["slots"]
@@ -1476,6 +1620,7 @@ class DeviceRouter:
                 slots=slots, slot_count=slot_count, overflow=overflow,
                 dense_rows=dense_rows, dense_index=dense_index,
                 readback_bytes=readback, retained=retained_res,
+                session=sess_res,
             )
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
@@ -1483,6 +1628,7 @@ class DeviceRouter:
         return RouteResult(
             matched, mcount, flags, bitmaps, picks,
             readback_bytes=readback, retained=retained_res,
+            session=sess_res,
         )
 
     # engine capability flag the broker gates storm fusion on: the
@@ -1491,6 +1637,13 @@ class DeviceRouter:
     # MeshServingRouter's job), so a storm must not be handed to it
     @property
     def supports_retained_fusion(self) -> bool:
+        return self.mesh is None
+
+    # session-ack fusion (session_route_step) is a single-device program;
+    # the mesh engine's session mirrors update via the segment scatter
+    # path on the 'dp'-sharded placement instead (docs/sessions.md)
+    @property
+    def supports_session_fusion(self) -> bool:
         return self.mesh is None
 
     def span_attrs(self) -> Dict:

@@ -145,6 +145,53 @@ class DeviceSegmentManager:
         with self._lock:
             self._offer = (epoch, dict(arrays), pos)
 
+    def has_mirror(self) -> bool:
+        with self._lock:
+            return self._arrays is not None
+
+    # -- fused-launch rider handoff ---------------------------------------
+    def peek_delta(self, src):
+        """Rider support (broker/session_store.py): the current mirror +
+        the op-log suffix as per-array last-write-wins vectors, WITHOUT
+        applying anything — the caller fuses the scatter into a serving
+        launch (`session_ack_step` riding `session_route_step`) and
+        hands the produced device arrays back via `adopt`. Returns
+        ``(arrays, per_name_writes, pos, epoch)``, or None when the
+        mirror needs a full resync / the suffix carries resync markers —
+        those (rare, structural) paths go through `sync()` instead."""
+        with self._lock:
+            if (
+                self._arrays is None
+                or self._epoch != src.epoch
+                or self._torn
+            ):
+                return None
+            ops = src.oplog[self._pos :]
+            per: Dict[str, Dict[int, int]] = {}
+            for name, idx, val in ops:
+                if name == RESYNC or name not in self._arrays:
+                    return None
+                per.setdefault(name, {})[idx] = val
+            return dict(self._arrays), per, len(src.oplog), self._epoch
+
+    def adopt(self, arrays: Dict, pos: int, epoch: int) -> bool:
+        """Install rider-produced device arrays as the mirror at op-log
+        position ``pos``. Refused (False) when a structural event moved
+        the mirror past the rider's epoch/position — the host arrays are
+        authoritative, so the refused rider's writes are already covered
+        by the full re-upload that superseded it."""
+        with self._lock:
+            if (
+                self._arrays is None
+                or self._epoch != epoch
+                or self._torn
+                or pos < self._pos
+            ):
+                return False
+            self._arrays = dict(arrays)
+            self._pos = pos
+            return True
+
     # -- sync --------------------------------------------------------------
     def sync(self, src) -> Dict:
         with self._lock:

@@ -1,0 +1,569 @@
+"""Device-resident session & QoS state: the (session, packet-id) table.
+
+Sessions, QoS1/2 inflight windows, and offline-queue bookkeeping used to
+live as per-client Python objects (`broker/session.py` dicts) — the next
+10M-entry shadow-dict problem after PR 9 cured subscriptions (ROADMAP
+item 2). This module is the table those objects collapse into:
+
+- **host side**: `SessionTable`, a vectorized open-addressing
+  (slot, packet-id) -> row table in the PR 9 fid-table style (EMOMA's
+  one-memory-access exact match, PAPERS.md): every probe round is one
+  numpy gather over the whole batch, inserts bid for empty/tombstone
+  slots in bulk, and there is NO per-entry Python object anywhere. The
+  host arrays are AUTHORITATIVE — acks and inserts mutate them first,
+  so the dict-era session semantics are always answerable locally.
+- **device side**: the same arrays mirror onto the accelerator through
+  `DeviceSegmentManager` (epoch/oplog/device_snapshot protocol — the
+  fourth table owner after shapes/bitmaps/retained). The hot mutation
+  stream (delivery inserts + PUBACK/PUBREC/PUBCOMP clears) does NOT pay
+  its own scatter launch: `broker/session_store.py` packages the op-log
+  suffix as a *rider* that fuses into the next serving launch via
+  `session_ack_step` below, and QoS1 retry / session-expiry scans come
+  back as a device-side sweep riding the same coalesced readback.
+
+Row lanes (all int32 — the device contract forbids 64-bit widening):
+  ``sess_slot``  owning session slot (-1 empty, -2 tombstone)
+  ``sess_pid``   packet id (1..65535)
+  ``sess_state`` 0 free | 1 publish phase (awaiting PUBACK/PUBREC)
+                 | 2 rel phase (awaiting PUBCOMP) | 3 incoming QoS2
+                 (awaiting PUBREL)
+  ``sess_ts``    last (re)transmit stamp, deciseconds on the store's
+                 monotonic clock (int32 covers ~6.8 years)
+  ``sess_mid``   message-slab id for redelivery (-1 when the payload is
+                 gone, e.g. the rel phase)
+Session lanes (indexed by slot; grown alone via the `!resync` marker):
+  ``slot_expiry`` session-expiry deadline in deciseconds (0 = none)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from emqx_tpu.ops.contract import device_contract
+from emqx_tpu.ops.nfa import _next_pow2
+
+# states
+FREE = 0
+ST_PUBLISH = 1  # QoS1/2 publish sent, awaiting PUBACK / PUBREC
+ST_PUBREL = 2  # QoS2 rel phase, awaiting PUBCOMP
+ST_AWAIT_REL = 3  # incoming QoS2 publish, awaiting PUBREL
+
+# sess_slot occupancy markers
+EMPTY = -1
+TOMB = -2
+
+SESSION_PROBES = 16
+ROW_LANES = ("sess_slot", "sess_pid", "sess_state", "sess_ts", "sess_mid")
+SLOT_LANES = ("slot_expiry",)
+RESYNC = "!resync"
+
+
+@device_contract(
+    "session_ack_step",
+    # host->device ack/insert replay is device-local (placed shardings
+    # propagate through the scatter); the sweep outputs are O(sweep_k),
+    # never O(cap) — reusing the compact_fanout_slots discipline
+    collectives=(),
+    out_bounds={
+        "due": lambda cfg: max(cfg["kslot"], 1) * 4,
+        "expired": lambda cfg: max(cfg["kslot"], 1) * 4,
+        "due_count": lambda cfg: 4,
+        "expired_count": lambda cfg: 4,
+    },
+)
+def session_ack_impl(tables: Dict, idxs: Dict, vals: Dict, clock,
+                     *, sweep_k: int = 0) -> Dict:
+    """The fused session stage: apply one rider's row/slot writes as
+    scatters — `tables[k][idxs[k]] = vals[k]` — and (``sweep_k > 0``)
+    sweep the WHOLE table for QoS1 retransmits and expired sessions in
+    the same program.
+
+    This is what rides the serving launch (`session_route_step` in
+    models/router_model.py): ack batches become scatter clears in the
+    same program as routing, and the retry scan is a device bitmap sweep
+    instead of a per-client dict walk. Padded index vectors repeat one
+    write (identical values — idempotent), so programs key on pow2 delta
+    buckets. ``clock`` is an int32 ``[2]`` array ``(now_ds, retry_ds)``
+    — an array, not a static, so the tick never recompiles.
+
+    Sweep outputs (compact, -1 padded; counts are UNCAPPED so the host
+    knows when a flood overflowed ``sweep_k`` and sweeps again):
+      ``due [sweep_k]``      row ids in publish phase older than retry
+      ``expired [sweep_k]``  session slots past their expiry deadline
+    """
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops.matcher import _compact
+
+    out = {}
+    for k, arr in tables.items():
+        if k in idxs:
+            out[k] = arr.at[idxs[k]].set(vals[k])
+        else:
+            out[k] = arr
+    res = {"tables": out}
+    if sweep_k > 0:
+        now = clock[0]
+        retry = clock[1]
+        st = out["sess_state"]
+        ts = out["sess_ts"]
+        occ = out["sess_slot"] >= 0
+        due_mask = (
+            occ
+            & ((st == ST_PUBLISH) | (st == ST_PUBREL))
+            & ((now - ts) >= retry)
+        )
+        rows = jnp.arange(st.shape[0], dtype=jnp.int32)
+        due, _ = _compact(
+            jnp.where(due_mask, rows, -1)[None, :], sweep_k
+        )
+        res["due"] = due[0]
+        res["due_count"] = jnp.sum(due_mask.astype(jnp.int32))
+        ex = out["slot_expiry"]
+        ex_mask = (ex > 0) & (now >= ex)
+        slots = jnp.arange(ex.shape[0], dtype=jnp.int32)
+        exp, _ = _compact(
+            jnp.where(ex_mask, slots, -1)[None, :], sweep_k
+        )
+        res["expired"] = exp[0]
+        res["expired_count"] = jnp.sum(ex_mask.astype(jnp.int32))
+    return res
+
+
+def _mix(slot, pid):
+    """Row hash of (slot, pid) — vectorized 32-bit mixing in uint64
+    lanes (masked, so numpy never warns on scalar overflow), the same
+    independent-multiplier shape as the PR 9 fid table."""
+    m32 = np.uint64(0xFFFFFFFF)
+    a = (
+        (np.asarray(slot, np.uint64) * np.uint64(0x9E3779B1))
+        ^ (np.asarray(pid, np.uint64) * np.uint64(0x85EBCA77))
+    ) & m32
+    a ^= a >> np.uint64(15)
+    return (a * np.uint64(0xC2B2AE35)) & m32
+
+
+def _step(slot, pid):
+    """Odd probe stride (full cycle over any pow2 capacity): decouples
+    probe paths that share a starting row, so clustering never walls a
+    bulk load the way a linear stride does."""
+    return (
+        (np.asarray(pid, np.uint64) << np.uint64(1))
+        ^ np.asarray(slot, np.uint64)
+    ) | np.uint64(1)
+
+
+class SessionTable:
+    """Host-authoritative open-addressing (slot, pid) -> row store.
+
+    Implements the segment-manager source protocol (`epoch`, `version`,
+    `oplog`, `device_snapshot`) so `DeviceSegmentManager` mirrors it like
+    every other table owner; the hot mutation stream additionally rides
+    serving launches via `SessionStore.take_rider`. Growth of the row
+    table doubles capacity and bumps the epoch (full re-upload); growth
+    of the per-slot lanes re-uploads those arrays ALONE via the
+    per-array `!resync` marker.
+    """
+
+    def __init__(self, capacity: int = 1024, slots: int = 256):
+        cap = _next_pow2(max(64, capacity))
+        scap = _next_pow2(max(64, slots))
+        self._cap = cap
+        self._scap = scap
+        self.sess_slot = np.full(cap, EMPTY, np.int32)
+        self.sess_pid = np.zeros(cap, np.int32)
+        self.sess_state = np.zeros(cap, np.int32)
+        self.sess_ts = np.zeros(cap, np.int32)
+        self.sess_mid = np.full(cap, -1, np.int32)
+        self.slot_expiry = np.zeros(scap, np.int32)
+        self.live = 0
+        self.tombstones = 0
+        self.epoch = 0
+        self.version = 0
+        self.oplog: list = []
+        self.OPLOG_MAX = 262144
+        # compaction journal (loop-thread): semantic (slot,pid) upserts/
+        # clears that raced a background rebuild — row ids relocate, so
+        # raw lane writes cannot replay
+        self._journal: Optional[list] = None
+        self._structure_gen = 0
+
+    # -- op-log plumbing ---------------------------------------------------
+    def _bump(self) -> None:
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+        self._structure_gen += 1
+
+    def _log(self, name: str, idx: int, val: int) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump()
+            return
+        self.oplog.append((name, int(idx), int(val)))
+
+    def device_snapshot(self) -> Dict[str, np.ndarray]:
+        return {
+            "sess_slot": self.sess_slot,
+            "sess_pid": self.sess_pid,
+            "sess_state": self.sess_state,
+            "sess_ts": self.sess_ts,
+            "sess_mid": self.sess_mid,
+            "slot_expiry": self.slot_expiry,
+        }
+
+    # -- probing -----------------------------------------------------------
+    def _find(self, slot: int, pid: int) -> int:
+        """Row of a live (slot, pid) entry, or -1."""
+        mask = self._cap - 1
+        h = int(_mix(slot, pid))
+        st = int(_step(slot, pid))
+        for r in range(SESSION_PROBES):
+            row = (h + r * st) & mask
+            if self.sess_slot[row] == EMPTY:
+                return -1
+            if (
+                self.sess_slot[row] == slot
+                and self.sess_pid[row] == pid
+            ):
+                return row
+        return -1
+
+    def _find_free(self, slot: int, pid: int) -> int:
+        """First empty/tombstone row on the probe path, or -1 (full)."""
+        mask = self._cap - 1
+        h = int(_mix(slot, pid))
+        st = int(_step(slot, pid))
+        for r in range(SESSION_PROBES):
+            row = (h + r * st) & mask
+            if self.sess_slot[row] < 0:
+                return row
+        return -1
+
+    def lookup_batch(self, slots, pids) -> np.ndarray:
+        """Vectorized (slot, pid) -> row (-1 miss): one gather per probe
+        round over the whole batch — the EMOMA exact-match idiom."""
+        slots = np.asarray(slots, np.int64)
+        pids = np.asarray(pids, np.int64)
+        n = len(slots)
+        mask = self._cap - 1
+        h = _mix(slots, pids).astype(np.int64)
+        st = _step(slots, pids).astype(np.int64)
+        found = np.full(n, -1, np.int64)
+        dead = np.zeros(n, bool)  # hit a hard EMPTY: stop probing
+        for r in range(SESSION_PROBES):
+            rows = (h + r * st) & mask
+            open_ = (found < 0) & ~dead
+            ent_slot = self.sess_slot[rows]
+            hit = open_ & (ent_slot == slots) & (self.sess_pid[rows] == pids)
+            found[hit] = rows[hit]
+            dead |= open_ & (ent_slot == EMPTY)
+            if not open_.any():
+                break
+        return found.astype(np.int64)
+
+    # -- mutation ----------------------------------------------------------
+    def _write_row(self, row: int, slot: int, pid: int, state: int,
+                   ts: int, mid: int) -> None:
+        self.sess_slot[row] = slot
+        self.sess_pid[row] = pid
+        self.sess_state[row] = state
+        self.sess_ts[row] = ts
+        self.sess_mid[row] = mid
+        self._log("sess_slot", row, slot)
+        self._log("sess_pid", row, pid)
+        self._log("sess_state", row, state)
+        self._log("sess_ts", row, ts)
+        self._log("sess_mid", row, mid)
+
+    def insert(self, slot: int, pid: int, state: int, ts: int,
+               mid: int = -1) -> int:
+        """Upsert one (slot, pid) row; returns its row id. Grows (epoch
+        bump) when the probe path is saturated or load passes 3/4."""
+        if self._journal is not None:
+            self._journal.append(("set", slot, pid, state, ts, mid))
+        row = self._find(slot, pid)
+        if row < 0:
+            if self.live + self.tombstones >= (self._cap * 3) // 4:
+                self._grow(self._cap * 2)
+            row = self._find_free(slot, pid)
+            while row < 0:
+                self._grow(self._cap * 2)
+                row = self._find_free(slot, pid)
+            if self.sess_slot[row] == TOMB:
+                self.tombstones -= 1
+            self.live += 1
+        self._write_row(row, slot, pid, state, ts, mid)
+        return row
+
+    def set_state(self, row: int, state: int, ts: int,
+                  mid: Optional[int] = None) -> None:
+        if self._journal is not None:
+            self._journal.append(
+                ("set", int(self.sess_slot[row]), int(self.sess_pid[row]),
+                 state, ts, self.sess_mid[row] if mid is None else mid)
+            )
+        self.sess_state[row] = state
+        self.sess_ts[row] = ts
+        self._log("sess_state", row, state)
+        self._log("sess_ts", row, ts)
+        if mid is not None:
+            self.sess_mid[row] = mid
+            self._log("sess_mid", row, mid)
+
+    def touch(self, row: int, ts: int) -> None:
+        """Refresh the retransmit stamp after a resend."""
+        self.sess_ts[row] = ts
+        self._log("sess_ts", row, ts)
+
+    def clear(self, row: int) -> int:
+        """Tombstone one row; returns the message id it carried."""
+        if self._journal is not None:
+            self._journal.append(
+                ("clear", int(self.sess_slot[row]),
+                 int(self.sess_pid[row]), 0, 0, -1)
+            )
+        mid = int(self.sess_mid[row])
+        self.sess_slot[row] = TOMB
+        self.sess_state[row] = FREE
+        self.sess_mid[row] = -1
+        self._log("sess_slot", row, TOMB)
+        self._log("sess_state", row, FREE)
+        self._log("sess_mid", row, -1)
+        self.live -= 1
+        self.tombstones += 1
+        return mid
+
+    def set_expiry(self, slot: int, deadline_ds: int) -> None:
+        if slot >= self._scap:
+            self._grow_slots(_next_pow2(slot + 1))
+        if self._journal is not None:
+            self._journal.append(("expiry", slot, 0, 0, deadline_ds, -1))
+        self.slot_expiry[slot] = deadline_ds
+        self._log("slot_expiry", slot, deadline_ds)
+
+    def bulk_insert(self, slots, pids, states, tss, mids) -> np.ndarray:
+        """Vectorized cold/storm load of UNIQUE (slot, pid) keys: place
+        everything with round-robin probe bidding (the `_bulk_place_hot`
+        idiom) and ONE epoch bump. Returns the placed row ids (-1 = lost
+        after growth retries — callers treat that as table-full)."""
+        slots = np.asarray(slots, np.int64)
+        pids = np.asarray(pids, np.int64)
+        states = np.asarray(states, np.int64)
+        tss = np.asarray(tss, np.int64)
+        mids = np.asarray(mids, np.int64)
+        n = len(slots)
+        while self.live + self.tombstones + n > (self._cap * 3) // 4:
+            self._grow(self._cap * 2)
+        rows = self._bulk_place(slots, pids, states, tss, mids)
+        for _ in range(4):
+            lost = rows < 0
+            if not lost.any():
+                break
+            # saturated probe paths: double (relocating every placed
+            # entry), place ONLY the losers, then re-resolve all row ids
+            # against the grown table — never re-place a placed key
+            self._grow(self._cap * 2)
+            self._bulk_place(
+                slots[lost], pids[lost], states[lost], tss[lost],
+                mids[lost],
+            )
+            rows = self.lookup_batch(slots, pids)
+        self._bump()
+        return rows
+
+    def _bulk_place(self, slots, pids, states, tss, mids) -> np.ndarray:
+        mask = self._cap - 1
+        n = len(slots)
+        h = _mix(slots, pids).astype(np.int64)
+        stp = _step(slots, pids).astype(np.int64)
+        rows = np.full(n, -1, np.int64)
+        pending = np.arange(n)
+        for r in range(SESSION_PROBES):
+            if not len(pending):
+                break
+            cand = (h[pending] + r * stp[pending]) & mask
+            free = self.sess_slot[cand] < 0
+            bid = pending[free]
+            brow = cand[free]
+            # first bidder per row wins this round; losers re-probe
+            uniq, first = np.unique(brow, return_index=True)
+            win = bid[first]
+            wrow = brow[first]
+            tomb = self.sess_slot[wrow] == TOMB
+            self.tombstones -= int(np.count_nonzero(tomb))
+            self.sess_slot[wrow] = slots[win]
+            self.sess_pid[wrow] = pids[win]
+            self.sess_state[wrow] = states[win]
+            self.sess_ts[wrow] = tss[win]
+            self.sess_mid[wrow] = mids[win]
+            rows[win] = wrow
+            self.live += len(win)
+            pending = pending[rows[pending] < 0]
+        return rows
+
+    # -- growth ------------------------------------------------------------
+    def _grow(self, new_cap: int) -> None:
+        """Double the row table and re-place every live entry (epoch
+        bump: full re-upload, one recompile of the table-shaped jits)."""
+        old = (
+            self.sess_slot, self.sess_pid, self.sess_state,
+            self.sess_ts, self.sess_mid,
+        )
+        live = np.nonzero(old[0] >= 0)[0]
+        self._cap = new_cap
+        self.sess_slot = np.full(new_cap, EMPTY, np.int32)
+        self.sess_pid = np.zeros(new_cap, np.int32)
+        self.sess_state = np.zeros(new_cap, np.int32)
+        self.sess_ts = np.zeros(new_cap, np.int32)
+        self.sess_mid = np.full(new_cap, -1, np.int32)
+        self.live = 0
+        self.tombstones = 0
+        if len(live):
+            self._bulk_place(
+                old[0][live].astype(np.int64),
+                old[1][live].astype(np.int64),
+                old[2][live].astype(np.int64),
+                old[3][live].astype(np.int64),
+                old[4][live].astype(np.int64),
+            )
+        self._bump()
+
+    def _grow_slots(self, new_scap: int) -> None:
+        new = np.zeros(new_scap, np.int32)
+        new[: self._scap] = self.slot_expiry
+        self.slot_expiry = new
+        self._scap = new_scap
+        # small lane: re-upload ALONE (never the row table) — the
+        # per-array resync marker exists for exactly this
+        self._log(RESYNC, 0, 0)
+        self.oplog[-1] = (RESYNC, "slot_expiry", 0)
+
+    # -- host sweeps (authoritative; the device sweep mirrors these) -------
+    def due_rows(self, now_ds: int, retry_ds: int) -> np.ndarray:
+        """QoS retransmit scan (publish phase -> dup PUBLISH, rel phase
+        -> PUBREL) — one vectorized pass, no dict walk."""
+        return np.nonzero(
+            (self.sess_slot >= 0)
+            & (
+                (self.sess_state == ST_PUBLISH)
+                | (self.sess_state == ST_PUBREL)
+            )
+            & ((now_ds - self.sess_ts) >= retry_ds)
+        )[0]
+
+    def expired_slots(self, now_ds: int) -> np.ndarray:
+        return np.nonzero(
+            (self.slot_expiry > 0) & (self.slot_expiry <= now_ds)
+        )[0]
+
+    def rows_of_slot(self, slot: int) -> np.ndarray:
+        """Every live row owned by one session (resume/drop path)."""
+        return np.nonzero(self.sess_slot == slot)[0]
+
+    # -- compaction (SegmentCompactor owner protocol) ----------------------
+    def begin_compact(self) -> Dict:
+        self._journal = []
+        return {
+            "arrays": {k: v.copy() for k, v in self.device_snapshot().items()},
+            "cap": self._cap,
+            "gen": self._structure_gen,
+        }
+
+    @staticmethod
+    def build_compact(cap: Dict) -> Dict:
+        """Re-place every live row into a fresh table (tombstones
+        purged). Pure numpy over the capture — any thread."""
+        arrs = cap["arrays"]
+        live = np.nonzero(arrs["sess_slot"] >= 0)[0]
+        built = SessionTable(capacity=cap["cap"], slots=1)
+        built.slot_expiry = arrs["slot_expiry"].copy()
+        built._scap = len(built.slot_expiry)
+        if len(live):
+            built._bulk_place(
+                arrs["sess_slot"][live].astype(np.int64),
+                arrs["sess_pid"][live].astype(np.int64),
+                arrs["sess_state"][live].astype(np.int64),
+                arrs["sess_ts"][live].astype(np.int64),
+                arrs["sess_mid"][live].astype(np.int64),
+            )
+        return {"table": built, "gen": cap["gen"]}
+
+    def apply_compact(self, built: Dict) -> Optional[int]:
+        """Swap in the rebuilt table + replay the journal of racing
+        mutations (semantic (slot, pid) upserts — row ids relocated).
+        Returns the new epoch, or None when a structural event
+        invalidated the capture."""
+        journal = self._journal
+        self._journal = None
+        if journal is None or built["gen"] != self._structure_gen:
+            return None
+        t = built["table"]
+        self._cap = t._cap
+        self._scap = t._scap
+        self.sess_slot = t.sess_slot
+        self.sess_pid = t.sess_pid
+        self.sess_state = t.sess_state
+        self.sess_ts = t.sess_ts
+        self.sess_mid = t.sess_mid
+        self.slot_expiry = t.slot_expiry
+        self.live = t.live
+        self.tombstones = t.tombstones
+        self._bump()
+        for op, slot, pid, state, ts, mid in journal:
+            if op == "set":
+                self.insert(slot, pid, state, ts, mid)
+            elif op == "clear":
+                row = self._find(slot, pid)
+                if row >= 0:
+                    self.clear(row)
+            elif op == "expiry":
+                self.set_expiry(slot, ts)
+        return self.epoch
+
+
+class SessionSegmentOwner:
+    """Compaction adapter for a `SessionTable` + its manager: purge
+    tombstoned (acked) rows off the critical path, pre-uploading the
+    rebuilt table on the compaction executor — the `ShapeSegmentOwner`
+    contract, fourth owner on the one `SegmentCompactor`."""
+
+    key = "sessions"
+
+    def __init__(self, table: SessionTable, manager, placement=None,
+                 tombstone_frac: float = 0.25):
+        self.table = table
+        self.manager = manager
+        self._placement = placement
+        self.tombstone_frac = tombstone_frac
+
+    def needs_compact(self) -> bool:
+        t = self.table
+        return t.tombstones > 0 and (
+            t.tombstones >= self.tombstone_frac * t._cap
+        )
+
+    def begin(self):
+        return self.table.begin_compact()
+
+    def build(self, cap):
+        built = SessionTable.build_compact(cap)
+        devs = {}
+        for k, v in built["table"].device_snapshot().items():
+            if self._placement is not None:
+                devs[k] = self._placement(k, v.copy())
+            else:
+                import jax
+
+                devs[k] = jax.device_put(v.copy())
+        built["devs"] = devs
+        return built
+
+    def apply(self, built):
+        merged = self.table.tombstones
+        epoch = self.table.apply_compact(built)
+        if epoch is None:
+            return None
+        return epoch, built["devs"], 0, merged

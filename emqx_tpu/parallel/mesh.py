@@ -622,6 +622,17 @@ def retained_placement(mesh: Mesh):
     return lambda _name, arr: jax.device_put(arr, sh)
 
 
+def session_placement(mesh: Mesh):
+    """Canonical placement for the session table (ops/session_table.py):
+    1-D row/slot lanes sharded over 'dp' (pow2 capacities, so any pow2
+    dp divides them) — each dp slice owns its share of the inflight
+    rows, consistent with PR 10's shard-ownership regime. Delta scatters
+    and compaction-offered buffers land pre-sharded through this hook;
+    nothing is re-placed per batch."""
+    sh = NamedSharding(mesh, P("dp"))
+    return lambda _name, arr: jax.device_put(arr, sh)
+
+
 def place_batch(mesh: Mesh, bytes_mat, lengths):
     """Canonical placement for a topic batch: rows sharded on 'dp'."""
     bm = jax.device_put(bytes_mat, NamedSharding(mesh, P("dp", None)))

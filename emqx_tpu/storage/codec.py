@@ -102,13 +102,19 @@ def subopts_from_json(d: Dict) -> pkt.SubOpts:
 
 def session_to_json(sess) -> Dict:
     """Snapshot: metadata + subscriptions + pending (mqueue/inflight)."""
+    import time as _time
+
+    _mono = _time.monotonic()
     inflight = []
     for pid, e in sess.inflight.items():
         inflight.append(
             {
                 "pid": pid,
                 "phase": e.phase,
-                "ts": e.ts,
+                # inflight stamps are monotonic-clock readings, which are
+                # meaningless in another process: persist the AGE and
+                # rebase at restore (broker/inflight.py clock discipline)
+                "age": round(max(0.0, _mono - e.ts), 3),
                 "msg": msg_to_json(e.msg) if e.msg is not None else None,
             }
         )
@@ -126,10 +132,12 @@ def session_to_json(sess) -> Dict:
     }
 
 
-def session_from_json(d: Dict, config) -> "object":
+def session_from_json(d: Dict, config, store=None) -> "object":
+    import time as _time
+
     from emqx_tpu.broker.session import Session
 
-    sess = Session(d["client_id"], config)
+    sess = Session(d["client_id"], config, store=store)
     sess.created_at = d.get("created_at", sess.created_at)
     sess.config.expiry_interval = d.get(
         "expiry_interval", sess.config.expiry_interval
@@ -141,15 +149,16 @@ def session_from_json(d: Dict, config) -> "object":
     }
     for m in d.get("mqueue", []):
         sess.mqueue.in_(msg_from_json(m))
+    _mono = _time.monotonic()
     for e in d.get("inflight", []):
         msg = msg_from_json(e["msg"]) if e.get("msg") else None
         sess.inflight.insert(e["pid"], msg, phase=e.get("phase", "publish"))
-        sess.inflight._d[e["pid"]].ts = e.get("ts", 0.0)
-    import time as _time
-
+        # rebase the persisted AGE onto this process's monotonic clock;
+        # legacy snapshots carried raw stamps ("ts") from another clock —
+        # treat those as age 0 (fresh) rather than mass-expiring them
+        sess.inflight.get(e["pid"]).ts = _mono - e.get("age", 0.0)
     # fresh timestamp: the receiver-side QoS2 dedup window restarts at
     # resume instead of being instantly expired by the first tick
-    _now = _time.time()
     for pid in d.get("awaiting_rel", []):
-        sess.awaiting_rel[int(pid)] = _now
+        sess.awaiting_rel[int(pid)] = _mono
     return sess

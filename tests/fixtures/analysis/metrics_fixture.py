@@ -24,6 +24,10 @@ declare("router.compact.runs", COUNTER)
 declare("mesh.shard.fill", "gauge")
 declare("mesh.shard.rebalance", COUNTER)
 declare("mesh.shard.scatter.launches", COUNTER)
+declare("session.store.inflight", "gauge")
+declare("session.ack.rides", COUNTER)
+declare("session.sweep.due", COUNTER)
+declare("session.redeliveries", COUNTER)
 
 
 class M:
@@ -55,6 +59,10 @@ def good(m: M):
     m.gauge_set("mesh.shard.fill", 0.5)
     m.inc("mesh.shard.rebalance")
     m.inc("mesh.shard.scatter.launches", 2)
+    m.gauge_set("session.store.inflight", 7)
+    m.inc("session.ack.rides")
+    m.inc("session.sweep.due", 3)
+    m.inc("session.redeliveries")
 
 
 def bad(m: M):
@@ -75,3 +83,7 @@ def bad(m: M):
     m.gauge_set("mesh.shard.fil", 1)  # MN001: typo'd shard gauge
     m.inc("mesh.shard.rebalanse")  # MN001: typo'd rebalance counter
     m.inc("mesh.shard.scatter.launchez")  # MN001: typo'd scatter counter
+    m.gauge_set("session.store.inflite", 1)  # MN001: typo'd store gauge
+    m.inc("session.ack.ridez")  # MN001: typo'd fused-ride counter
+    m.inc("session.sweep.dew")  # MN001: typo'd sweep counter
+    m.inc("session.redeliveriez")  # MN001: typo'd redelivery counter

@@ -145,6 +145,8 @@ def test_metric_checker_flags_undeclared_series():
         "racetrack.eventz", "race.reportz",
         "mesh.shard.fil", "mesh.shard.rebalanse",
         "mesh.shard.scatter.launchez",
+        "session.store.inflite", "session.ack.ridez",
+        "session.sweep.dew", "session.redeliveriez",
     }
 
 

@@ -238,6 +238,14 @@ def _harness(name: str):
             {"B": 16, "kslot": 0},
             {"B": 64, "kslot": 0},
         ]
+    elif name == "session_ack_step":
+        # B = the pow2 rider-write bucket; kslot doubles as sweep_k
+        # (kslot=0: pure scatter ride, no sweep stage traces)
+        configs = [
+            {"B": 16, "kslot": 0},
+            {"B": 16, "kslot": 8},
+            {"B": 64, "kslot": 8},
+        ]
     elif name == "compact_fanout_slots":
         # kslot=0 means "compaction off" — the stage never traces
         configs = [
@@ -279,6 +287,23 @@ def _harness(name: str):
                 k: np.ones(nb, v.dtype) for k, v in flats.items()
             }
             return segment_scatter_impl, (flats, idxs, vals)
+        if name == "session_ack_step":
+            from emqx_tpu.ops.session_table import (
+                ROW_LANES,
+                SessionTable,
+                session_ack_impl,
+            )
+
+            t = SessionTable(capacity=1024, slots=256)
+            tables = {
+                k: v.copy() for k, v in t.device_snapshot().items()
+            }
+            nb = cfg["B"]
+            idxs = {k: np.arange(nb, dtype=np.int32) for k in ROW_LANES}
+            vals = {k: np.ones(nb, np.int32) for k in ROW_LANES}
+            clock = np.asarray([100, 300], np.int32)
+            fn = partial(session_ack_impl, sweep_k=cfg["kslot"])
+            return fn, (tables, idxs, vals, clock)
         if name == "compact_fanout_slots":
             from emqx_tpu.models.router_model import compact_fanout_slots
 
@@ -466,6 +491,7 @@ def run_audit(
     if registry is None:
         # importing the kernel modules populates the registry
         import emqx_tpu.models.router_model  # noqa: F401
+        import emqx_tpu.ops.session_table  # noqa: F401
         from emqx_tpu.ops.contract import REGISTRY
 
         try:

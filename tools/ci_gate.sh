@@ -24,6 +24,11 @@
 #                                           # >1M inserts/s and <10ms
 #                                           # subscribe visibility
 #                                           # (docs/update_path.md)
+#   python bench.py --configs session_storm # device-resident session
+#                                           # state: 1M-session resume
+#                                           # via segment replay + QoS1
+#                                           # redelivery flood (~30s —
+#                                           # docs/sessions.md)
 #   python bench.py --configs mesh_serving  # scale-out sharded serving:
 #                                           # the four-scenario broker
 #                                           # matrix through the mesh
