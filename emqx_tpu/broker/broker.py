@@ -112,6 +112,10 @@ class Broker:
         from emqx_tpu.models.router_model import GroupTable, SubscriberTable
 
         self.subtab = SubscriberTable()
+        # running plain-subscription count: subscription_count() used to
+        # RECOMPUTE sum(len(entry)) per subscribe/unsubscribe, turning a
+        # million-connection subscribe storm into O(N^2) gauge upkeep
+        self._plain_subs = 0
         # $share groups mirrored as device lane segments so the kernel
         # resolves the member pick too (emqx_shared_sub.erl:234-285)
         self.grouptab = GroupTable()
@@ -175,17 +179,24 @@ class Broker:
             prev = entry.get(sid)
             first = not entry
             entry[sid] = sub
-            if first:
+            if prev is None:
+                self._plain_subs += 1
+            fid = (
                 self.router.add_route(real)
-                if self.cluster is not None:
-                    self.cluster._replicate_add(real)
+                if first
+                else None
+            )
+            if first and self.cluster is not None:
+                self.cluster._replicate_add(real)
             if prev is not None:
                 # re-subscribe with fresh opts: keep the slot, swap the sub
                 sub.slot = prev.slot
                 self._slot_subs[sub.slot] = sub
             else:
                 sub.slot = self._alloc_slot(sub)
-                fid = self.router.filter_id(real)
+                if fid is None:
+                    # route already existed: resolve its id (one probe)
+                    fid = self.router.filter_id(real)
                 if fid is not None:
                     self.subtab.add(fid, sub.slot)
         self.metrics.gauge_set("subscriptions.count", self.subscription_count())
@@ -216,6 +227,7 @@ class Broker:
         if not entry or sid not in entry:
             return False
         sub = entry.pop(sid)
+        self._plain_subs -= 1
         if sub.slot >= 0:
             fid = self.router.filter_id(real)
             if fid is not None:
@@ -242,7 +254,7 @@ class Broker:
         self._free_slots.append(slot)
 
     def subscription_count(self) -> int:
-        return sum(len(v) for v in self._subs.values()) + self.shared.count()
+        return self._plain_subs + self.shared.count()
 
     def subscriptions(self) -> List[Tuple[str, str, pkt.SubOpts]]:
         out = []
@@ -366,7 +378,10 @@ class Broker:
         t_launch = rec.now_ns() if rec is not None else 0
         try:
             results = dev.route(
-                [m.topic for m in msgs], self._client_hashes(msgs)
+                # topic_key(): zero-copy ingest — slab-backed messages
+                # hand the tokenizer a TopicRef into the fabric read
+                # buffer instead of paying a str decode per row
+                [m.topic_key() for m in msgs], self._client_hashes(msgs)
             )
         except Exception:  # noqa: BLE001 — degrade, don't fail the batch
             if deg is None:
@@ -516,7 +531,9 @@ class Broker:
                     batch_span.attrs["session.sweep"] = True
         rec = self.spans
         t_launch = rec.now_ns() if rec is not None else 0
-        topics = [m.topic for m in msgs]
+        # topic_key(): slab-backed messages defer str decode — the
+        # tokenizer gathers their bytes straight from the fabric slab
+        topics = [m.topic_key() for m in msgs]
         hashes = self._client_hashes(msgs)
         fut = loop.run_in_executor(
             dispatch_pool(),
@@ -665,38 +682,56 @@ class Broker:
         fid_memo: Dict[int, Tuple[Optional[str], bool]] = {}
         compact = results.slots is not None
         rec = self.spans
+        # batch-level fan-out prep (docs/protocol_plane.md): ONE
+        # .tolist() per device output matrix up front — the per-message
+        # loop below then runs on plain ints, with per-row metric
+        # observes batched into `fanouts` at the end. The old per-row
+        # numpy mask/filter chains were a top per-message dispatch cost.
+        flags_l = np.asarray(flags).tolist()
+        slots_ll = results.slots.tolist() if compact else None
+        ovf_l = results.overflow.tolist() if compact else None
+        # matched filter-id rows only matter when shared groups exist
+        # AND the device didn't already resolve the picks
+        need_fids = picks is None and bool(self.shared._table)
+        matched_l = matched.tolist() if need_fids else None
+        fanouts: List[int] = []
         for i, m in enumerate(msgs):
             t_ns = (
                 rec.now_ns()
                 if rec is not None and TRACE_HEADER in m.headers
                 else 0
             )
-            if flags[i]:
+            if flags_l[i]:
                 fell_back += 1
                 tp("dispatch.fallback", topic=m.topic)
                 n = self._route_dispatch(m, r.match(m.topic))
             else:
-                # matched rows are SPARSE (-1 holes between engines)
-                row = matched[i]
                 msg_picks = (
                     (picks[0][i], picks[1][i]) if picks is not None else None
                 )
-                if compact and not results.overflow[i]:
-                    srow = results.slots[i]
-                    bits, slots = None, srow[srow >= 0]
+                if compact and not ovf_l[i]:
+                    # -1 pads skip inside the dispatch loop
+                    bits, slots = None, slots_ll[i]
                 elif compact:
                     bits = results.dense_rows[results.dense_index[i]]
                     slots = None
                 else:
                     bits, slots = results.bitmaps[i], None
+                # matched rows are SPARSE (-1 holes between engines)
+                fids = (
+                    [f for f in matched_l[i] if f >= 0]
+                    if matched_l is not None
+                    else ()
+                )
                 n = self._dispatch_row(
-                    m, bits, row[row >= 0], msg_picks, touched_gids,
+                    m, bits, fids, msg_picks, touched_gids,
                     slots=slots, match_memo=match_memo, fid_memo=fid_memo,
+                    stats=fanouts,
                 )
             if t_ns:
                 rec.deliver(
                     m, n, start_ns=t_ns, device_span=device_span,
-                    fallback=bool(flags[i]),
+                    fallback=bool(flags_l[i]),
                 )
             if fwd is not None:
                 n += fwd[i]
@@ -704,6 +739,13 @@ class Broker:
                 self.hooks.run("message.dropped", m, "no_subscribers")
                 self.metrics.inc("messages.dropped.no_subscribers")
             out.append(n)
+        if fanouts:
+            # batched flight-recorder upkeep: same series, one lock
+            self.metrics.inc("messages.received", len(fanouts))
+            self.metrics.observe_many("dispatch.fanout", fanouts)
+            delivered = sum(fanouts)
+            if delivered:
+                self.metrics.inc("messages.delivered", delivered)
         if touched_gids:
             self._sync_group_counters(touched_gids)
         if fell_back:
@@ -716,15 +758,20 @@ class Broker:
         self, msg: Message, bits: Optional[np.ndarray], fids, picks=None,
         touched_gids: Optional[set] = None, *, slots=None,
         match_memo: Optional[Dict] = None,
-        fid_memo: Optional[Dict] = None,
+        fid_memo: Optional[Dict] = None, stats: Optional[List] = None,
     ) -> int:
         """Deliver one routed message from its device outputs: subscriber
         slot list (compact path) or bitmap (dense path) -> plain subs;
         matched filter ids -> shared groups.
         When `picks` is given ((gids, idxs) from the device $share pick),
         group delivery goes straight to the picked member with host-side
-        failover only; otherwise the host runs the full pick."""
-        self.metrics.inc("messages.received")
+        failover only; otherwise the host runs the full pick.
+        `slots` may be a plain int list (batch callers pre-.tolist() the
+        whole slot matrix; -1 pads are skipped here) — with `stats`
+        given, the fan-out lands in it and the per-row metric calls are
+        batched by the caller instead."""
+        if stats is None:
+            self.metrics.inc("messages.received")
         if match_memo is None:
             match_memo = {}
         if fid_memo is None:
@@ -739,15 +786,18 @@ class Broker:
                 bits = np.ascontiguousarray(bits)
             slots = np.nonzero(
                 np.unpackbits(bits.view(np.uint8), bitorder="little")
-            )[0]
-        else:
-            slots = np.asarray(slots)
-        # batched bounds filter before the Python delivery loop (slots
-        # past the local table can only be another node's lanes)
-        if len(slots):
-            slots = slots[slots < len(self._slot_subs)]
+            )[0].tolist()
+        elif not isinstance(slots, list):
+            slots = np.asarray(slots).tolist()
+        slot_subs = self._slot_subs
+        nsubs = len(slot_subs)
         for slot in slots:
-            sub = self._slot_subs[slot]
+            # -1 pads (compact rows) and slots past the local table
+            # (another node's lanes) skip here — plain int compares,
+            # no per-row numpy filter pass
+            if slot < 0 or slot >= nsubs:
+                continue
+            sub = slot_subs[slot]
             if sub is None:
                 continue
             if sub.opts.no_local and sub.client_id == msg.from_client:
@@ -756,14 +806,17 @@ class Broker:
             # filter ids freed during an in-flight batch can be reused by
             # unrelated subscriptions — verify the sub's filter really
             # matches before delivering (misdelivery is worse than a
-            # topic-match check per delivery). Memoized per batch: the
-            # match is a pure string function of (topic, filter)
-            ok = match_memo.get((topic, sub.filter))
-            if ok is None:
-                ok = T.match(topic, sub.filter)
-                match_memo[(topic, sub.filter)] = ok
-            if not ok:
-                continue
+            # topic-match check per delivery). Exact filters (the serving
+            # common case) short-circuit on string equality; the full
+            # matcher is memoized per batch (pure fn of (topic, filter))
+            f = sub.filter
+            if topic != f:
+                ok = match_memo.get((topic, f))
+                if ok is None:
+                    ok = T.match(topic, f)
+                    match_memo[(topic, f)] = ok
+                if not ok:
+                    continue
             n += self._deliver_one(sub, msg)
         if picks is not None:
             # device-resolved $share picks: host does delivery + failover
@@ -805,6 +858,9 @@ class Broker:
                     match_memo[(topic, name)] = ok
                 if ok:
                     n += self.shared.dispatch_groups(name, msg)
+        if stats is not None:
+            stats.append(n)  # caller batches the metric upkeep
+            return n
         self.metrics.observe("dispatch.fanout", n)
         if n:
             self.metrics.inc("messages.delivered", n)

@@ -839,11 +839,52 @@ class Channel:
         out = self.session.deliver(msg, opts)
         for q in out:
             self.hooks.run("message.delivered", self._ci_snapshot(), msg)
-            self._send(q)
+            if not (
+                q.type == pkt.PUBLISH
+                and q.qos
+                and q.packet_id
+                and not q.dup
+                and self._send_pub_split(msg, q)
+            ):
+                self._send(q)
             if q.type == pkt.PUBLISH and q.qos == 0:
                 # QoS0 completes at send; QoS1/2 complete at PUBACK/PUBCOMP
                 # ('delivery.completed' hook, emqx_slow_subs.erl:25 parity)
                 self._delivery_completed(msg)
+
+    def _send_pub_split(self, msg: Message, q) -> bool:
+        """QoS1/2 fan-out fast path: serialize the PUBLISH ONCE per
+        (version, qos, retain, topic) as a head/tail pair around the
+        packet-id slot (mqtt/slab_serializer.split_publish — bytes
+        identical to frame.serialize) and emit each subscriber's frame
+        as writelines([head, pid, tail]) — the payload is never copied
+        per target. The cache rides the Message like the QoS0 `_fb`
+        cache; retained-store replays are excluded for the same
+        lifetime reason. Returns False to fall back to `_send`."""
+        ws = getattr(self.sink, "send_segments", None)
+        if ws is None or msg.headers.get("retained"):
+            return False
+        from emqx_tpu.mqtt import slab_serializer as SS
+
+        fbq = getattr(msg, "_fbq", None)
+        if fbq is None:
+            fbq = {}
+            msg._fbq = fbq
+        key = (self.version, q.qos, q.retain, q.topic)
+        ent = fbq.get(key)
+        if ent is None:
+            tb = q.topic.encode("utf-8")
+            if len(tb) > 0xFFFF:
+                return False  # _send raises the codec's exact error
+            ent = fbq[key] = SS.split_publish(
+                tb, q.payload, q.qos, q.retain, False, self.version,
+                q.properties,
+            )
+        head, tail = ent
+        ws([head, SS.pid_bytes(q.packet_id), tail])
+        self.broker.metrics.inc("packets.sent")
+        self.broker.metrics.inc("dispatch.serialize.frames")
+        return True
 
     def _delivery_completed(self, msg: Message) -> None:
         self.hooks.run(
@@ -895,6 +936,62 @@ class Channel:
             self.session._publish_packet(msg, msg.qos, pid, dup=True)
         )
         return True
+
+    def _store_resend_batch(self, items) -> List[bool]:
+        """Batched twin of `_store_resend` for the session store's sweep
+        floods: ALL of this channel's due rows serialize in ONE slab
+        pass (mqtt/slab_serializer — vectorized headers/varints, frames
+        byte-identical to the per-packet path) and land on the socket as
+        a `writelines` of memoryviews. Returns per-item sent flags (all
+        False when the channel can't transmit)."""
+        if self.state != "connected" or self.session is None:
+            return [False] * len(items)
+        from emqx_tpu.mqtt import slab_serializer as SS
+        from emqx_tpu.ops.session_table import ST_PUBREL
+
+        sent = [True] * len(items)
+        pubs = []  # (item index, serializer tuple)
+        segs: List = []  # per-frame segments in item order
+        seg_slot: List[int] = []  # index into segs for each publish
+        v5 = self.version == pkt.MQTT_V5
+        for i, (pid, state, msg) in enumerate(items):
+            if state == ST_PUBREL:
+                segs.append(SS.pubrel_frame(pid))
+                continue
+            if msg is None:
+                sent[i] = False
+                continue
+            pb = None
+            if v5:
+                props = getattr(msg, "properties", None)
+                pb = SS.encode_properties(props) if props else None
+            pubs.append(
+                (msg.topic_bytes(), msg.payload_view(), msg.qos,
+                 msg.retain, True, pid, pb)  # dup=True: retransmit
+            )
+            seg_slot.append(len(segs))
+            segs.append(None)  # patched with the slab view below
+        if pubs:
+            slab, offs = SS.serialize_pub_slab(pubs, self.version)
+            for k, mv in enumerate(SS.frames_of(slab, offs)):
+                segs[seg_slot[k]] = mv
+        segs = [s for s in segs if s is not None]
+        if not segs:
+            return sent
+        ws = getattr(self.sink, "send_segments", None)
+        try:
+            if ws is not None:
+                ws(segs)
+            else:
+                self.sink.send_bytes(b"".join(segs))
+        except Exception:
+            return [False] * len(items)
+        m = self.broker.metrics
+        m.inc("packets.sent", len(segs))
+        m.inc("dispatch.serialize.batches")
+        m.inc("dispatch.serialize.frames", len(segs))
+        m.inc("dispatch.serialize.bytes", sum(len(s) for s in segs))
+        return sent
 
     # -- takeover / kick ---------------------------------------------------
     def kick(self, reason: str) -> Optional[Session]:

@@ -48,6 +48,10 @@ class Inflight:
         return self._d.get(packet_id)
 
     def insert(self, packet_id: int, msg: Message, phase: str = "publish"):
+        if msg is not None:
+            # slab-escape site: the window outlives the dispatch tick —
+            # a SlabMessage must own its bytes, not pin the read buffer
+            msg.own_buffers()
         self._d[packet_id] = InflightEntry(msg, phase, time.monotonic())
 
     def update(self, packet_id: int, phase: str) -> bool:

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional  # noqa: F401 — Dict used by SlabMessage
 
 from emqx_tpu.utils.guid import next_guid
 
@@ -35,3 +35,117 @@ class Message:
 
     def is_sys(self) -> bool:
         return self.topic.startswith("$SYS/")
+
+    # -- zero-copy surface (overridden by SlabMessage) --------------------
+    def topic_bytes(self):
+        """Topic as bytes-like, without forcing a fresh decode cycle."""
+        return self.topic.encode("utf-8", "surrogatepass")
+
+    def topic_key(self):
+        """Tokenizer input: str here; a `TopicRef` into the fabric read
+        slab for un-materialized SlabMessages (ops/tokenizer slab path)."""
+        return self.topic
+
+    def payload_view(self):
+        """Payload as a bytes-like view (no copy for slab messages)."""
+        return self.payload or b""
+
+    def own_buffers(self) -> "Message":
+        """Ownership discipline (docs/protocol_plane.md): a message about
+        to outlive its dispatch (retained store, queued/banked, session
+        slab, parked fabric delivery) must own its bytes — no memoryview
+        into a fabric read buffer may escape past buffer recycle. No-op
+        here; SlabMessage materializes and drops the slab reference."""
+        return self
+
+
+class SlabMessage(Message):
+    """A Message whose topic/payload still live inside a fabric read
+    slab (`transport/fabric.PubSlab`/`DlvSlab`): str decode and payload
+    copies are deferred until a consumer actually needs them — the
+    zero-copy ingest seam (the router feeds `topic_key()` straight into
+    the tokenizer's topic matrix with one vectorized gather per slab).
+
+    Lifetime: the slab reference pins the WHOLE frame body, so every
+    long-lived store must call `own_buffers()` first (annotated escape
+    sites: retainer insert, mqueue banking, session-store slab, fabric
+    parking). Pickle/copy materialize automatically."""
+
+    def __init__(self, slab, i: int, qos: int = 0, retain: bool = False,
+                 dup: bool = False, from_client: str = "",
+                 properties: Optional[Dict] = None):
+        # deliberate bypass of the dataclass __init__: topic/payload are
+        # lazy properties backed by (slab, i)
+        self._slab = slab
+        self._i = i
+        self._topic: Optional[str] = None
+        self._payload: Optional[bytes] = None
+        self.qos = qos
+        self.retain = retain
+        self.dup = dup
+        self.from_client = from_client
+        self.from_username = None
+        self.mid = next_guid()
+        self.headers = {}
+        self.properties = properties if properties is not None else {}
+        self.timestamp = time.time()
+
+    @property
+    def topic(self) -> str:  # type: ignore[override]
+        t = self._topic
+        if t is None:
+            t = self._topic = str(
+                self._slab.topic_bytes(self._i), "utf-8"
+            )
+        return t
+
+    @topic.setter
+    def topic(self, v: str) -> None:
+        self._topic = v
+
+    @property
+    def payload(self) -> bytes:  # type: ignore[override]
+        p = self._payload
+        if p is None:
+            p = self._payload = bytes(self._slab.payload_view(self._i))
+        return p
+
+    @payload.setter
+    def payload(self, v: bytes) -> None:
+        self._payload = v
+
+    def topic_bytes(self):
+        if self._slab is not None and self._topic is None:
+            return self._slab.topic_bytes(self._i)
+        return self.topic.encode("utf-8", "surrogatepass")
+
+    def topic_key(self):
+        if self._slab is not None and self._topic is None:
+            from emqx_tpu.ops.tokenizer import TopicRef
+
+            s = self._slab
+            return TopicRef(
+                s.flat, int(s.t_off[self._i]), int(s.t_len[self._i])
+            )
+        return self.topic
+
+    def payload_view(self):
+        if self._slab is not None and self._payload is None:
+            return self._slab.payload_view(self._i)
+        return self._payload or b""
+
+    def own_buffers(self) -> "Message":
+        if self._slab is not None:
+            if self._topic is None:
+                self._topic = str(self._slab.topic_bytes(self._i), "utf-8")
+            if self._payload is None:
+                self._payload = bytes(self._slab.payload_view(self._i))
+            self._slab = None
+            self._i = -1
+        return self
+
+    def __getstate__(self):
+        # pickle (cluster forward) and copy.copy both route here: the
+        # clone owns its bytes, never a view into the shared read slab
+        self.own_buffers()
+        return dict(self.__dict__)

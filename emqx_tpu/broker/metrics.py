@@ -351,6 +351,33 @@ declare("fabric.link.reconnected", COUNTER)
 declare("fabric.worker.crash_loop", COUNTER)
 declare("fabric.worker.respawns", COUNTER)
 
+# -- slab protocol plane (transport/fabric.py slab codec, zero-copy
+# ingest, batched delivery/resend serialization; docs/protocol_plane.md)
+declare("fabric.slab.pub.frames", COUNTER,
+        "T_PUBB_S frames unpacked via the vectorized slab codec")
+declare("fabric.slab.pub.records", COUNTER,
+        "publish records recovered by slab header scans (no per-record "
+        "struct.unpack, no tuple materialization)")
+declare("fabric.slab.dlv.frames", COUNTER,
+        "T_DLV_S delivery frames packed from once-serialized regions")
+declare("fabric.slab.dlv.records", COUNTER,
+        "delivery records packed via the slab codec (one per "
+        "(message, worker) — fan-out stays worker-side)")
+declare("ingest.zerocopy.records", COUNTER,
+        "messages entering ingest as slab-backed views: topic bytes "
+        "feed the tokenizer straight from the fabric read buffer")
+declare("ingest.zerocopy.deferred.bytes", COUNTER,
+        "topic+payload bytes whose str-decode/copy was deferred at "
+        "ingest (paid later only if a consumer materializes)")
+declare("dispatch.serialize.batches", COUNTER,
+        "batched PUBLISH serialization passes (one slab build for a "
+        "whole resend/delivery batch)")
+declare("dispatch.serialize.frames", COUNTER,
+        "outbound PUBLISH frames emitted by the slab serializer / "
+        "split-frame fan-out (serialize once, patch the packet id)")
+declare("dispatch.serialize.bytes", COUNTER,
+        "bytes serialized by the batched slab passes")
+
 # cluster
 declare("cluster.nodedown.routes_purged", COUNTER)
 declare("cluster.retain.bootstrap_failed", COUNTER)

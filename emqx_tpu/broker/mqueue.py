@@ -41,6 +41,9 @@ class MQueue:
         if msg.qos == 0 and not self.store_qos0:
             self.dropped += 1
             return msg
+        # slab-escape site: banked messages outlive their fabric frame —
+        # materialize before queueing (no-op for ordinary messages)
+        msg.own_buffers()
         p = self._prio(msg)
         q = self._qs.setdefault(p, deque())
         dropped = None

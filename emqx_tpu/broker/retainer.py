@@ -105,6 +105,9 @@ class Retainer:
         self._insert(msg)
 
     def _insert(self, msg: Message) -> None:
+        # slab-escape site: the store holds messages indefinitely — a
+        # retained SlabMessage must never pin its fabric read buffer
+        msg.own_buffers()
         words = T.words(msg.topic)
         if self._count >= self.max_retained:
             # at capacity only an overwrite of an existing topic is allowed;

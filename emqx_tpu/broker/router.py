@@ -66,13 +66,15 @@ class Router:
     def has_route(self, filter_: str) -> bool:
         return filter_ in self._exact or self._trie.has(filter_)
 
-    def add_route(self, filter_: str) -> None:
-        """Refcounted insert (one ref per subscriber entry)."""
-        self._index.add(filter_)
+    def add_route(self, filter_: str) -> int:
+        """Refcounted insert (one ref per subscriber entry). Returns the
+        filter id so subscribe-storm callers skip a registry re-probe."""
+        fid = self._index.add(filter_)
         if T.wildcard(filter_):
             self._trie.insert(filter_)
         else:
             self._exact[filter_] = self._exact.get(filter_, 0) + 1
+        return fid
 
     def delete_route(self, filter_: str) -> None:
         self._index.remove(filter_)

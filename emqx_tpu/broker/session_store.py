@@ -262,6 +262,9 @@ class SessionStore:
     def _put_msg(self, msg) -> int:
         if msg is None:
             return -1
+        # slab-escape site: the message slab holds entries until ack —
+        # a SlabMessage must own its bytes before landing here
+        msg.own_buffers()
         if self._free_mids:
             mid = self._free_mids.pop()
             self._slab[mid] = msg
@@ -449,36 +452,88 @@ class SessionStore:
         return n
 
     def _redeliver(self, rows) -> int:
-        """Retransmit due rows through the bound channels. Device rows
-        are re-verified against the host table (rows can clear while a
-        sweep is in flight — same staleness net as subscriber slots)."""
+        """Retransmit due rows through the bound channels.
+
+        The re-verify against the authoritative host table (rows can
+        clear while a sweep is in flight — same staleness net as
+        subscriber slots) is ONE vectorized mask over the row arrays,
+        not a per-row field walk. Surviving rows then group per bound
+        channel: a channel exposing `_store_resend_batch` (the real
+        Channel; docs/protocol_plane.md) gets ALL its due rows in one
+        call — one slab-serializer pass, one writelines — and stamps
+        refresh via `touch_many`. Plain per-row callbacks keep the
+        legacy contract (the degrade/compat path)."""
         t = self.table
+        rows = np.asarray(rows, np.int64)
+        if not rows.size:
+            return 0
         now = self.now_ds()
+        slot_a = t.sess_slot[rows]
+        state_a = t.sess_state[rows]
+        ok = (
+            (slot_a >= 0)
+            & ((state_a == ST_PUBLISH) | (state_a == ST_PUBREL))
+            & ((now - t.sess_ts[rows]) >= self.retry_ds)
+            & (t.sess_pid[rows] < PID_SPACE)  # incoming QoS2 never
+        )
+        if not ok.any():
+            return 0
+        rows = rows[ok]
+        slots_l = slot_a[ok].tolist()
+        states_l = state_a[ok].tolist()
+        pids_l = t.sess_pid[rows].tolist()
+        mids_l = t.sess_mid[rows].tolist()
+        rows_l = rows.tolist()
+        bind = self._bind
+        slab = self._slab
+        n_slab = len(slab)
         n = 0
-        for row in np.asarray(rows).tolist():
-            row = int(row)
-            slot = int(t.sess_slot[row])
-            if slot < 0:
-                continue  # cleared in flight
-            state = int(t.sess_state[row])
-            if state not in (ST_PUBLISH, ST_PUBREL):
-                continue
-            if (now - int(t.sess_ts[row])) < self.retry_ds:
-                continue  # re-verify: stamped since the sweep launched
-            cb = self._bind.get(slot)
+        touched: List[int] = []
+        # per-channel batches: OWNER id -> [batch_fn, items, row ids]
+        # (grouped by the owning channel — bound methods are distinct
+        # objects per bind, so keying on the callback would shatter one
+        # sink's rows into single-item batches). cb_ent memoizes the
+        # owner/batch resolution per callback object: the flood loop
+        # then pays one dict get per row, not two getattrs.
+        batches: Dict[int, list] = {}
+        cb_ent: Dict[int, object] = {}
+        for i, slot in enumerate(slots_l):
+            cb = bind.get(slot)
             if cb is None:
                 continue  # offline session: nothing to transmit to
-            pid = int(t.sess_pid[row])
-            if pid >= PID_SPACE:
-                continue  # incoming-QoS2 rows never retransmit
-            msg = self._get_msg(int(t.sess_mid[row]))
+            ent = cb_ent.get(id(cb))
+            if ent is None:
+                owner = getattr(cb, "__self__", cb)
+                batch_fn = getattr(owner, "_store_resend_batch", None)
+                if batch_fn is None:
+                    ent = cb_ent[id(cb)] = 0  # legacy per-row marker
+                else:
+                    ent = batches.get(id(owner))
+                    if ent is None:
+                        ent = batches[id(owner)] = [batch_fn, [], []]
+                    cb_ent[id(cb)] = ent
+            mid = mids_l[i]
+            msg = slab[mid] if 0 <= mid < n_slab else None
+            if ent != 0:
+                ent[1].append((pids_l[i], states_l[i], msg))
+                ent[2].append(rows_l[i])
+                continue
             try:
-                if not cb(pid, state, msg):
+                if not cb(pids_l[i], states_l[i], msg):
                     continue
             except Exception:  # noqa: BLE001 — one dead sink, not the sweep
                 continue
-            t.touch(row, now)
+            t.touch(rows_l[i], now)
             n += 1
+        for batch_fn, items, rws in batches.values():
+            try:
+                sent = batch_fn(items)
+            except Exception:  # noqa: BLE001 — one dead sink, not the sweep
+                continue
+            touched.extend(r for r, s in zip(rws, sent) if s)
+            n += sum(map(bool, sent))
+        if touched:
+            t.touch_many(touched, now)
         if n and self.metrics is not None:
             self.metrics.inc("session.redeliveries", n)
         return n

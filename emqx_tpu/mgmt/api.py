@@ -405,6 +405,22 @@ class MgmtApi:
                 "rebalance_events": m.get("mesh.shard.rebalance"),
                 "reroutes": m.get("mesh.shard.reroutes"),
             },
+            "fabric": {
+                "slab_pub_frames": m.get("fabric.slab.pub.frames"),
+                "slab_pub_records": m.get("fabric.slab.pub.records"),
+                "slab_dlv_frames": m.get("fabric.slab.dlv.frames"),
+                "slab_dlv_records": m.get("fabric.slab.dlv.records"),
+                "zerocopy_records": m.get("ingest.zerocopy.records"),
+                "zerocopy_deferred_bytes": m.get(
+                    "ingest.zerocopy.deferred.bytes"
+                ),
+                "serialize_batches": m.get("dispatch.serialize.batches"),
+                "serialize_frames": m.get("dispatch.serialize.frames"),
+                "serialize_bytes": m.get("dispatch.serialize.bytes"),
+                "raw_records": m.get("fabric.raw.records"),
+                "parked_dropped": m.get("fabric.parked.dropped"),
+                "flush_errors": m.get("fabric.flush.errors"),
+            },
             "dispatch": {
                 "fanout": hist("dispatch.fanout"),
                 "routed_device": routed_dev,

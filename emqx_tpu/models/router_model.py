@@ -1399,8 +1399,15 @@ class DeviceRouter:
             if client_hashes is not None:
                 ch[:B] = np.asarray(client_hashes, np.uint32)
             if self.share_strategy == 4:  # hash_topic
+                # TopicRef entries (zero-copy slab rows) decode here:
+                # the pick hash is defined over the str form
                 th = np.fromiter(
-                    (stable_hash(t) for t in topics), np.uint32, count=B
+                    (
+                        stable_hash(t if isinstance(t, str) else str(t))
+                        for t in topics
+                    ),
+                    np.uint32,
+                    count=B,
                 )
                 th = np.pad(th, (0, Bp - B))
             else:

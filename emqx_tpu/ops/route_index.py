@@ -255,11 +255,12 @@ class RouteIndex:
             self._refs = new
 
     # -- filter->fid registry (open-addressing, two-key confirmed) --------
-    def _hash_get(self, filter_: str) -> Optional[int]:
+    def _hash_get(self, filter_: str, _keys=None) -> Optional[int]:
         """Probe for `filter_`; every key hit is confirmed by exact
         string compare, so a key collision degrades to one extra probe,
-        never a wrong fid."""
-        key, key2 = _row_key_str(filter_)
+        never a wrong fid. `_keys` lets add() reuse one key computation
+        across its get+set pair (subscribe-storm hot path)."""
+        key, key2 = _keys if _keys is not None else _row_key_str(filter_)
         cap = len(self._hkey)
         mask = cap - 1
         slot = int(key) & mask
@@ -281,12 +282,12 @@ class RouteIndex:
             slot = (slot + step) & mask
         return None
 
-    def _hash_set(self, filter_: str, fid: int) -> None:
+    def _hash_set(self, filter_: str, fid: int, _keys=None) -> None:
         """Insert (caller has established absence). Reuses the first
         tombstone on the probe path; grows at 2/3 occupancy."""
         if (self._hfill + 1) * 3 > 2 * len(self._hkey):
             self._hash_rehash(self._live + 1)
-        key, key2 = _row_key_str(filter_)
+        key, key2 = _keys if _keys is not None else _row_key_str(filter_)
         cap = len(self._hkey)
         mask = cap - 1
         slot = int(key) & mask
@@ -481,7 +482,8 @@ class RouteIndex:
     # -- mutation ----------------------------------------------------------
     def add(self, filter_: str) -> int:
         T.validate(filter_)
-        fid = self._hash_get(filter_)
+        keys = _row_key_str(filter_)
+        fid = self._hash_get(filter_, keys)
         if fid is not None:
             self._refs[fid] += 1
             return fid
@@ -494,7 +496,7 @@ class RouteIndex:
             self._ids.append(filter_)
             self._refs_ensure(fid + 1)
             self._refs[fid] = 1
-        self._hash_set(filter_, fid)
+        self._hash_set(filter_, fid, keys)
         self._live += 1
         if not self.shapes.add(filter_, fid):
             self._residual.add(filter_)

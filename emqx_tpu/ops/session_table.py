@@ -318,6 +318,21 @@ class SessionTable:
         self.sess_ts[row] = ts
         self._log("sess_ts", row, ts)
 
+    def touch_many(self, rows, ts: int) -> None:
+        """Vectorized stamp refresh for a whole sweep's retransmits:
+        one scatter store + one op-log extend (the redelivery flood
+        used to pay `touch`'s per-row `_log` a million times)."""
+        rows = np.asarray(rows, np.int64)
+        if not rows.size:
+            return
+        self.sess_ts[rows] = ts
+        if len(self.oplog) + rows.size > self.OPLOG_MAX:
+            self._bump()  # overflow: next sync is a full re-upload
+            return
+        self.version += int(rows.size)
+        t = int(ts)
+        self.oplog.extend(("sess_ts", int(r), t) for r in rows)
+
     def clear(self, row: int) -> int:
         """Tombstone one row; returns the message id it carried."""
         if self._journal is not None:

@@ -22,7 +22,7 @@ collision detection live there.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import List, Tuple
+from typing import List, NamedTuple, Tuple
 
 import numpy as np
 
@@ -66,6 +66,43 @@ def _pow_tables(max_bytes: int) -> Tuple[np.ndarray, ...]:
     return tuple(out)
 
 
+class TopicRef(NamedTuple):
+    """A topic's bytes IN PLACE inside a shared read slab (the fabric
+    frame body): `buf` is the flat uint8 view of the whole slab, the
+    topic is buf[off:off+ln]. `encode_topics` gathers every ref sharing
+    a slab into the topic matrix with ONE vectorized pass — the
+    zero-copy seam between transport/fabric.py and the device tokenizer
+    (no str decode, no per-row copy)."""
+
+    buf: np.ndarray
+    off: int
+    ln: int
+
+    def tobytes(self) -> bytes:
+        return self.buf[self.off : self.off + self.ln].tobytes()
+
+    def __str__(self) -> str:
+        return self.tobytes().decode("utf-8", "surrogatepass")
+
+
+def _fill_from_slab(mat, lens, too_long, buf, rows, offs, lns, max_bytes):
+    """One gather fills every row backed by the same slab buffer."""
+    rows = np.asarray(rows, np.int64)
+    offs = np.asarray(offs, np.int64)
+    lns = np.asarray(lns, np.int64)
+    if buf.size == 0:
+        return  # degenerate slab: rows keep their zero fill
+    tl = lns > max_bytes
+    eff = np.minimum(lns, max_bytes)
+    cols = np.arange(max_bytes, dtype=np.int64)
+    idx = offs[:, None] + cols[None, :]
+    valid = cols[None, :] < eff[:, None]
+    np.clip(idx, 0, max(buf.size - 1, 0), out=idx)
+    mat[rows] = buf[idx] * valid
+    lens[rows] = eff
+    too_long[rows] = tl
+
+
 def encode_topics(
     topics: List[bytes | str], max_bytes: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -74,12 +111,26 @@ def encode_topics(
     -> (bytes_mat uint8 [B, max_bytes], lengths int32 [B], too_long bool [B]).
     Too-long topics are truncated and flagged (host falls back to the CPU
     trie for those rows; cf. 64KB cap at emqx_topic.erl ?MAX_TOPIC_LEN).
+
+    `TopicRef` entries (zero-copy ingest: topic bytes still sitting in a
+    fabric read slab) are grouped per backing buffer and gathered into
+    the matrix with one vectorized indexed read per slab — the common
+    serving batch (one PUBB frame) fills in a single pass.
     """
     B = len(topics)
     mat = np.zeros((B, max_bytes), dtype=np.uint8)
     lens = np.zeros(B, dtype=np.int32)
     too_long = np.zeros(B, dtype=bool)
+    slabs: dict = {}
     for i, t in enumerate(topics):
+        if isinstance(t, TopicRef):
+            g = slabs.get(id(t.buf))
+            if g is None:
+                g = slabs[id(t.buf)] = (t.buf, [], [], [])
+            g[1].append(i)
+            g[2].append(t.off)
+            g[3].append(t.ln)
+            continue
         b = t.encode("utf-8", "surrogatepass") if isinstance(t, str) else t
         n = len(b)
         if n > max_bytes:
@@ -87,6 +138,9 @@ def encode_topics(
             n = max_bytes
         mat[i, :n] = np.frombuffer(b[:n], dtype=np.uint8)
         lens[i] = n
+    for buf, rows, offs, lns in slabs.values():
+        _fill_from_slab(mat, lens, too_long, buf, rows, offs, lns,
+                        max_bytes)
     return mat, lens, too_long
 
 

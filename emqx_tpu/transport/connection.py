@@ -72,6 +72,17 @@ class Connection:
         except Exception:
             self.close("send_error")
 
+    def send_segments(self, segs) -> None:
+        """Pre-serialized frame segments (the batched slab serializer:
+        writelines of memoryviews — shared heads/tails and slab frame
+        views land on the socket without an intermediate join)."""
+        if self._closing:
+            return
+        try:
+            self.writer.writelines(segs)
+        except Exception:
+            self.close("send_error")
+
     def close(self, reason: str) -> None:
         if self._closing:
             return

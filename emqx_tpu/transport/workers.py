@@ -159,11 +159,14 @@ class WorkerFabric:
                         writer.write(F.pack_json(F.T_SUB_ACK, {"h": h}))
                 elif ftype == F.T_UNSUB:
                     self._on_unsub(wid, body)
-                elif ftype == F.T_PUBB:
+                elif ftype in (F.T_PUBB, F.T_PUBB_S):
                     if self._pub_gate_open:
-                        await self._on_pub_batch(writer, body)
+                        if ftype == F.T_PUBB_S:
+                            await self._on_pub_slab(writer, body)
+                        else:
+                            await self._on_pub_batch(writer, body)
                     else:
-                        self._held_pubs.append((writer, body))
+                        self._held_pubs.append((writer, ftype, body))
                 elif ftype == F.T_SESS:
                     import json
 
@@ -354,9 +357,12 @@ class WorkerFabric:
         # behind the held ones so per-link order is preserved
         try:
             while self._held_pubs:
-                writer, body = self._held_pubs.pop(0)
+                writer, ftype, body = self._held_pubs.pop(0)
                 if not writer.is_closing():
-                    await self._on_pub_batch(writer, body)
+                    if ftype == F.T_PUBB_S:
+                        await self._on_pub_slab(writer, body)
+                    else:
+                        await self._on_pub_batch(writer, body)
         finally:
             self._pub_gate_open = True
 
@@ -641,6 +647,47 @@ class WorkerFabric:
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
 
+    async def _on_pub_slab(self, writer, body: bytes) -> None:
+        """Slab PUBB (T_PUBB_S): ONE vectorized header scan recovers
+        every record; messages enter the ingest window as SlabMessages —
+        topic bytes feed the tokenizer straight from this frame body
+        (ops/tokenizer TopicRef gather) and payload copies defer until a
+        subscriber needs them (zero-copy ingest, docs/protocol_plane.md)."""
+        from emqx_tpu.broker.message import SlabMessage
+
+        slab = F.unpack_pub_slab(body)
+        met = self.broker.metrics
+        met.inc("fabric.slab.pub.frames")
+        if slab.n:
+            met.inc("fabric.slab.pub.records", slab.n)
+            met.inc("ingest.zerocopy.records", slab.n)
+            met.inc(
+                "ingest.zerocopy.deferred.bytes",
+                int(slab.t_len.sum() + slab.p_len.sum()),
+            )
+        flags = slab.flags
+        qos_l = (flags & 3).tolist()
+        retain_l = (flags & 4).astype(bool).tolist()
+        dup_l = (flags & 8).astype(bool).tolist()
+        props_l = (flags & 0x10).astype(bool).tolist()
+        results = []
+        # enqueue INLINE (per-publisher ordering), confirm-wait as a task
+        # — same contract as the per-record path
+        for i in range(slab.n):
+            msg = SlabMessage(
+                slab, i, qos=qos_l[i], retain=retain_l[i], dup=dup_l[i],
+                from_client=slab.client(i),
+                properties=slab.props(i) if props_l[i] else None,
+            )
+            results.append(await self.broker.apublish_enqueue(msg))
+        if not any(qos_l):
+            return  # pure-QoS0 batch: the worker holds no PUBACKs on it
+        t = asyncio.get_running_loop().create_task(
+            self._ack_pub_batch(writer, slab.seq, results)
+        )
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
     async def _ack_pub_batch(self, writer, seq: int, results) -> None:
         """Confirm AFTER every message dispatched/banked (ingest futures
         resolve at the batch-window flush) with per-message delivery
@@ -770,8 +817,20 @@ class WorkerFabric:
                         self._park(wid, raw_records)
                     continue
                 if records:
-                    for frame in F.pack_dlv_batches(records):
-                        w.write(frame)
+                    if F.SLAB_WIRE:
+                        nf = 0
+                        for frame in F.pack_dlv_slabs(records):
+                            w.write(frame)
+                            nf += 1
+                        self.broker.metrics.inc(
+                            "fabric.slab.dlv.frames", nf
+                        )
+                        self.broker.metrics.inc(
+                            "fabric.slab.dlv.records", len(records)
+                        )
+                    else:
+                        for frame in F.pack_dlv_batches(records):
+                            w.write(frame)
                 if raw_records:
                     for frame in F.pack_raw_batches(raw_records):
                         w.write(frame)
@@ -788,6 +847,11 @@ class WorkerFabric:
 
         queues = self._parked.setdefault(wid, {})
         for msg, handles in records:
+            # slab-escape site: parked deliveries outlive their fabric
+            # read buffer (raw-lane bufs park as plain bytes)
+            ob = getattr(msg, "own_buffers", None)
+            if ob is not None:
+                ob()
             for h in handles:
                 q = queues.get(h)
                 if q is None:
@@ -857,7 +921,8 @@ class WorkerFabric:
                         seg = [(x, [h]) for x in run[i:j]]
                         packer = (
                             F.pack_raw_batches if is_raw
-                            else F.pack_dlv_batches
+                            else (F.pack_dlv_slabs if F.SLAB_WIRE
+                                  else F.pack_dlv_batches)
                         )
                         for frame in packer(seg):
                             w.write(frame)
@@ -957,7 +1022,7 @@ class WorkerBroker:
         for seq in sorted(self._inflight):
             futs, _timer, msgs = self._inflight[seq]
             if any(f is not None and not f.done() for f in futs):
-                self._send(F.pack_pub_batch(msgs, seq))
+                self._send(self._pack_pub(msgs, seq))
         # re-announce live channels: the router's drop-path cleared
         # their session-owner entries when the link fell
         if self.cm is not None:
@@ -969,6 +1034,14 @@ class WorkerBroker:
     def _send(self, data: bytes) -> None:
         if self._link_w is not None and not self._link_w.is_closing():
             self._link_w.write(data)
+
+    @staticmethod
+    def _pack_pub(msgs, seq: int) -> bytes:
+        """Publish batches ride the slab wire (one header table + joined
+        regions; T_PUBB_S) unless the env kill-switch forces legacy."""
+        if F.SLAB_WIRE:
+            return F.pack_pub_slab(msgs, seq)
+        return F.pack_pub_batch(msgs, seq)
 
     # session RPC ---------------------------------------------------------
     SESS_TIMEOUT_S = 30.0
@@ -1199,7 +1272,7 @@ class WorkerBroker:
                     self.ACK_TIMEOUT_S, self._expire_batch, seq
                 )
                 self._inflight[seq] = (futs, timer, msgs)
-            self._send(F.pack_pub_batch(msgs, seq))
+            self._send(self._pack_pub(msgs, seq))
 
     def _expire_batch(self, seq: int) -> None:
         ent = self._inflight.pop(seq, None)
@@ -1266,6 +1339,36 @@ class WorkerBroker:
             self.metrics.inc("packets.sent", sent)
         if errs:
             self.metrics.inc("delivery.errors", errs)
+
+    def on_dlv_slab(self, slab) -> None:
+        """Slab DLV (T_DLV_S): handles resolve FIRST, so a record whose
+        targets all unsubscribed mid-flight skips decode entirely; one
+        lazy SlabMessage per record is shared across its targets (str
+        decode / payload copy happen at most once, on first need)."""
+        from emqx_tpu.broker.message import SlabMessage
+
+        subs = self._subs
+        flags = slab.flags
+        for i in range(slab.n):
+            ents = [
+                ent
+                for h in slab.handles(i).tolist()
+                if (ent := subs.get(h)) is not None
+            ]
+            if not ents:
+                continue
+            f = int(flags[i])
+            msg = SlabMessage(
+                slab, i, qos=f & 3, retain=bool(f & 4),
+                from_client=slab.client(i), properties=slab.props(i),
+            )
+            if f & 8:
+                msg.headers["retained"] = True
+            for deliver, opts in ents:
+                try:
+                    deliver(msg, opts)
+                except Exception:
+                    self.metrics.inc("delivery.errors")
 
     def on_delivery(self, topic, payload, qos, retain, retained, client,
                     props, handles) -> None:
@@ -1477,6 +1580,8 @@ async def _worker_async(wid, bind, port, uds_path, config) -> None:
                     if ftype == F.T_DLV:
                         for rec in F.unpack_dlv_batch(body):
                             broker.on_delivery(*rec)
+                    elif ftype == F.T_DLV_S:
+                        broker.on_dlv_slab(F.unpack_dlv_slab(body))
                     elif ftype == F.T_RAW:
                         broker.on_raw(F.unpack_raw_batch(body))
                     elif ftype == F.T_PUBB_ACK:

@@ -28,6 +28,9 @@ declare("session.store.inflight", "gauge")
 declare("session.ack.rides", COUNTER)
 declare("session.sweep.due", COUNTER)
 declare("session.redeliveries", COUNTER)
+declare("fabric.slab.pub.records", COUNTER)
+declare("ingest.zerocopy.records", COUNTER)
+declare("dispatch.serialize.frames", COUNTER)
 
 
 class M:
@@ -63,6 +66,9 @@ def good(m: M):
     m.inc("session.ack.rides")
     m.inc("session.sweep.due", 3)
     m.inc("session.redeliveries")
+    m.inc("fabric.slab.pub.records", 64)
+    m.inc("ingest.zerocopy.records", 64)
+    m.inc("dispatch.serialize.frames", 8)
 
 
 def bad(m: M):
@@ -87,3 +93,6 @@ def bad(m: M):
     m.inc("session.ack.ridez")  # MN001: typo'd fused-ride counter
     m.inc("session.sweep.dew")  # MN001: typo'd sweep counter
     m.inc("session.redeliveriez")  # MN001: typo'd redelivery counter
+    m.inc("fabric.slab.pub.recordz")  # MN001: typo'd slab counter
+    m.inc("ingest.zerocopy.recordz")  # MN001: typo'd zerocopy counter
+    m.inc("dispatch.serialize.framez")  # MN001: typo'd serializer counter

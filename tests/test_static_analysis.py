@@ -147,6 +147,8 @@ def test_metric_checker_flags_undeclared_series():
         "mesh.shard.scatter.launchez",
         "session.store.inflite", "session.ack.ridez",
         "session.sweep.dew", "session.redeliveriez",
+        "fabric.slab.pub.recordz", "ingest.zerocopy.recordz",
+        "dispatch.serialize.framez",
     }
 
 

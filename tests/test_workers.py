@@ -118,9 +118,10 @@ def test_flush_pubs_chunks_below_frame_cap():
         seqs = set()
         n_records = 0
         for frame in w.chunks:
-            assert frame[4] == F.T_PUBB
-            assert len(frame) - 5 <= 1_000_000 + 400_100
-            seq, recs = F.unpack_pub_batch(frame[5:])
+            # the live wire is the slab format (T_PUBB_S) by default
+            assert frame[4] == (F.T_PUBB_S if F.SLAB_WIRE else F.T_PUBB)
+            assert len(frame) - 5 <= 1_000_000 + 400_200
+            seq, recs = F.unpack_pub_frame(frame)
             seqs.add(seq)
             n_records += len(recs)
             # ack each chunk: its futures must resolve independently
@@ -485,8 +486,8 @@ def test_fabric_seam_parks_per_subscriber_no_batch_drop():
         got = [
             (t, handles)
             for f in w.frames
-            for t, _p, _q, _r, _rt, _c, _pr, handles in F.unpack_dlv_batch(
-                f[5:]
+            for t, _p, _q, _r, _rt, _c, _pr, handles in F.unpack_dlv_frame(
+                f
             )
         ]
         seq7 = [t for t, hs in got if hs == [7]]

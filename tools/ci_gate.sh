@@ -29,6 +29,12 @@
 #                                           # via segment replay + QoS1
 #                                           # redelivery flood (~30s —
 #                                           # docs/sessions.md)
+#   python bench.py --configs conn_scaling  # slab protocol plane:
+#                                           # 10k->1M simulated-client
+#                                           # scaling curve + codec
+#                                           # microbench + >=5x
+#                                           # redelivery-flood gate
+#                                           # (docs/protocol_plane.md)
 #   python bench.py --configs mesh_serving  # scale-out sharded serving:
 #                                           # the four-scenario broker
 #                                           # matrix through the mesh
