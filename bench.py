@@ -1174,7 +1174,8 @@ MIN_BUDGET_S = {
     "mesh_serving": 150,  # sharded matrix child (proxy ~60s; full more)
     "churn_storm": 240,  # 10M cold build + churn/visibility phases
     "session_storm": 110,  # 1M-session resume + redelivery flood
-    "conn_scaling": 230,  # 3-point curve + codec micro (measured ~200s)
+    "conn_scaling": 400,  # 4-point curve (2 distinct-topic points incl.
+    # 1M-topic CSR) + drain-to-quiescence + codec micro
     "share_10m": 120,
     "retained_5m": 110,
     "mixed_1m": 60,
@@ -1738,10 +1739,13 @@ def bench_serving() -> dict:
     nums = rng.integers(0, N_MID, size=N_MSGS)
     topics = [f"device/{i}/mid/{j}/leaf" for i, j in zip(ids, nums)]
 
-    def build(compact: bool):
+    def build(compact: bool, sub_table: str = "dense"):
         b = Broker(
             router=Router(
-                MatcherConfig(fanout_compact=compact), min_tpu_batch=64
+                MatcherConfig(
+                    fanout_compact=compact, sub_table=sub_table
+                ),
+                min_tpu_batch=64,
             ),
             hooks=Hooks(),
         )
@@ -1766,8 +1770,8 @@ def bench_serving() -> dict:
             sid += 1
         return b, delivered
 
-    async def run_pass(compact: bool) -> dict:
-        b, delivered = build(compact)
+    async def run_pass(compact: bool, sub_table: str = "dense") -> dict:
+        b, delivered = build(compact, sub_table)
         ing = BatchIngest(b, max_batch=MAX_BATCH, window_us=500)
         b.ingest = ing
         ing.start()
@@ -1786,7 +1790,10 @@ def bench_serving() -> dict:
             h.sum / h.count / 1e6 if h is not None and h.count else None
         )
         return {
-            "mode": "compact" if compact else "dense",
+            "mode": (
+                "sparse" if sub_table == "sparse"
+                else "compact" if compact else "dense"
+            ),
             "serving_rps": round(sum(counts) / wall, 1),
             "msgs_per_s": round(N_MSGS / wall, 1),
             "deliveries": int(sum(counts)),
@@ -1799,6 +1806,7 @@ def bench_serving() -> dict:
                 "dispatch.compact.overflow.rows"
             ),
             "width_words": b.subtab.width_words,
+            "sub_table_bytes": b.subtab.table_bytes(),
         }
 
     _mark("serving_dispatch: dense pass")
@@ -1806,8 +1814,11 @@ def bench_serving() -> dict:
     _mark(f"serving_dispatch: dense done {dense}")
     compact = asyncio.run(run_pass(True))
     _mark(f"serving_dispatch: compact done {compact}")
+    sparse = asyncio.run(run_pass(True, sub_table="sparse"))
+    _mark(f"serving_dispatch: sparse done {sparse}")
     # identical delivery work is the correctness floor for the comparison
     assert dense["deliveries"] == compact["deliveries"], (dense, compact)
+    assert dense["deliveries"] == sparse["deliveries"], (dense, sparse)
     red = (
         round(dense["readback_mb_per_batch"]
               / compact["readback_mb_per_batch"], 1)
@@ -1823,6 +1834,17 @@ def bench_serving() -> dict:
         "readback_reduction_x": red,
         "dense": dense,
         "compact": compact,
+        # the CSR subscriber table serving the SAME workload: identical
+        # deliveries, O(subscriptions) memory (docs/serving_pipeline.md
+        # "subscriber-table memory budget")
+        "sparse": sparse,
+        "sparse_vs_dense_rps_x": (
+            round(sparse["serving_rps"] / dense["serving_rps"], 2)
+            if dense["serving_rps"]
+            else None
+        ),
+        "sub_table_bytes_sparse": sparse["sub_table_bytes"],
+        "sub_table_bytes_dense": dense["sub_table_bytes"],
         "note": (
             "deliveries/sec through the real BatchIngest -> device route"
             " -> host fan-out pipeline with stub deliverers; readback"
@@ -2429,17 +2451,21 @@ def _codec_micro() -> dict:
     return out
 
 
-CONN_SCALING_POINTS = (10_000, 100_000, 1_000_000)
+# (connections, distinct topics) points: the topic-space axis is the
+# CSR unlock (ops/csr_table.py) — 1M DISTINCT single-subscriber topics
+# needed a ~128GB dense [fids, slot_words] matrix before the sparse
+# subscriber table (router.sub_table), which stores O(subscriptions).
+# The (1M, 4096) point keeps the r05-era shared-topic fleet shape
+# (fan-out ~244) for curve continuity; each point now also reports the
+# MEASURED sub_table_bytes next to the dense-equivalent formula bytes.
+CONN_SCALING_POINTS = (
+    (10_000, 4096),
+    (100_000, 100_000),
+    (1_000_000, 4096),
+    (1_000_000, 1_000_000),
+)
 CONN_SCALING_MSGS = 16_384
 CONN_SCALING_WORKERS = 4
-# fixed topic space across every point (the IoT fleet shape: many
-# clients over a shared topic universe). Fixed because the device
-# subscriber table is a dense [fids, slot_words] matrix: 1M DISTINCT
-# single-subscriber topics would need a 128GB host mirror — a real
-# architectural ceiling this bench documents (the mesh path shards the
-# slot axis over 'tp'; a sparse fid row representation is the open
-# item). 4096 topics x 1M slots = 537MB, feasible single-node.
-CONN_SCALING_TOPICS = 4096
 
 
 def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
@@ -2450,16 +2476,19 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
     Each point builds a fresh router process in miniature: a Broker +
     BatchIngest + WorkerFabric whose N clients are real fabric
     subscriptions (the SUB json path, one client per subscription,
-    spread over a FIXED 4096-topic space) on W simulated worker links
+    spread over that point's K-topic space) on W simulated worker links
     (socketpairs with draining readers — the worker processes are
     simulated, the WIRE is real). The measured flood then drives the
     REAL router-side slab path end-to-end: packed T_PUBB_S frames ->
     vectorized unpack -> SlabMessage ingest -> device route_step ->
     dispatch -> outbox fan-out -> slab DLV frames on the socketpairs.
     `msgs_per_s` is publish-settle throughput at that connection count
-    (per-message fan-out grows as N/4096: 2.4 -> 244 deliveries); the
-    curve is the BENCH headline's scaling detail. The codec microbench
-    (slab vs per-record vs native-C) rides along.
+    (fan-out = N/K); `deliveries_per_s` spans the full drain-to-
+    quiescence window. The DISTINCT-topic points (100k and 1M topics,
+    one subscriber each) exist because of the CSR subscriber table
+    (router.sub_table auto-flips): they record the MEASURED
+    sub_table_bytes next to the ~128GB dense-equivalent formula bytes.
+    The codec microbench (slab vs per-record vs native-C) rides along.
     """
     import asyncio
     import json as _json
@@ -2476,7 +2505,7 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
     rng = np.random.default_rng(7)
     points = []
 
-    async def one_point(n_conns: int) -> dict:
+    async def one_point(n_conns: int, K: int) -> dict:
         b = Broker(router=Router(min_tpu_batch=32), hooks=Hooks())
 
         class _App:
@@ -2507,17 +2536,22 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
         t0 = time.perf_counter()
         # N clients = N fabric subscriptions over the real SUB path
         # (each worker proxies its share; retained replay off), spread
-        # over the fixed topic space
-        K = CONN_SCALING_TOPICS
+        # over the K-topic space. Worker id mixes in i >> 12 so one
+        # topic's subscribers spread over workers (i % W alone aliases
+        # whenever W divides K, collapsing every fan-out onto one
+        # worker's DLV stream)
+        W = CONN_SCALING_WORKERS
         for i in range(n_conns):
             fab._on_sub(
-                i % CONN_SCALING_WORKERS,
+                (i + (i >> 12)) % W,
                 _json.dumps({
                     "h": i, "sid": f"s{i}", "cid": f"s{i}",
                     "f": f"c/{i % K}", "qos": 0, "nr": True,
                 }).encode(),
             )
         build_s = time.perf_counter() - t0
+        sub_mode = b.subtab.status()["mode"]
+        sub_bytes = b.subtab.table_bytes()
         ing = BatchIngest(b, max_batch=512, window_us=200)
         b.ingest = ing
         ing.start()
@@ -2553,7 +2587,31 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
         if fab._tasks:
             await asyncio.gather(*list(fab._tasks))
         wall = time.perf_counter() - t1
-        await asyncio.sleep(0.05)  # let the last outbox flush tick run
+        # drain the delivery plane to QUIESCENCE (r05 regression: one
+        # 50ms sleep let roughly one outbox flush tick run, so the DLV
+        # ring / deliveries_per_s saturated at whatever one tick could
+        # pack instead of measuring the plane): keep ticking until the
+        # outboxes + parked queues are empty AND the drained byte count
+        # stops moving, under an explicit budget, and SAY when the
+        # budget was hit instead of publishing a capped number.
+        drain_budget = 20.0
+        t_dr = time.perf_counter()
+        last_bytes = -1
+        while time.perf_counter() - t_dr < drain_budget:
+            quiet = (
+                not fab._outbox
+                and not fab._raw_outbox
+                and not fab._parked
+                and drained[0] == last_bytes
+            )
+            if quiet:
+                break
+            last_bytes = drained[0]
+            await asyncio.sleep(0.05)
+        drain_s = time.perf_counter() - t_dr
+        drain_complete = (
+            not fab._outbox and not fab._raw_outbox and not fab._parked
+        )
         await ing.stop()
         for d in drainers:
             d.cancel()
@@ -2561,30 +2619,47 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
             w.close()
             w2.close()
         dlv = b.metrics.get("fabric.slab.dlv.records") - m0_dlv
+        raw = b.metrics.get("fabric.raw.records")
         delivered = b.metrics.get("messages.delivered") - m0_del
+        # dense-equivalent bytes: what the pre-CSR [Fcap, W] matrix
+        # would allocate for this point (pow2 axes, 4B words)
+        from emqx_tpu.ops.nfa import _next_pow2
+
+        nf = _next_pow2(max(64, K))
+        nw = max(2, _next_pow2((n_conns + 31) // 32))
         return {
             "connections": n_conns,
+            "topics": K,
             "build_s": round(build_s, 2),
             "subscribe_rps": round(n_conns / max(build_s, 1e-9), 1),
             "msgs_per_s": round(CONN_SCALING_MSGS / wall, 1),
-            "deliveries_per_s": round(delivered / wall, 1),
+            "deliveries_per_s": round(delivered / (wall + drain_s), 1),
             "fanout_mean": round(delivered / CONN_SCALING_MSGS, 1),
             "dlv_records": int(dlv),
+            "raw_records": int(raw),
+            "drain_s": round(drain_s, 2),
+            "drain_complete": drain_complete,
             "drained_bytes": drained[0],
+            "sub_table_mode": sub_mode,
+            "sub_table_bytes": sub_bytes,
+            "sub_table_bytes_per_sub": round(sub_bytes / n_conns, 1),
+            "dense_equiv_bytes": nf * nw * 4,
             "zerocopy_records": b.metrics.get("ingest.zerocopy.records"),
         }
 
-    for n in CONN_SCALING_POINTS:
+    for n, k in CONN_SCALING_POINTS:
         if deadline is not None and time.perf_counter() > deadline - 30:
-            points.append({"connections": n, "skipped": "budget"})
-            _mark(f"conn_scaling[{n}]: SKIPPED (budget)")
+            points.append({"connections": n, "topics": k,
+                           "skipped": "budget"})
+            _mark(f"conn_scaling[{n}/{k}t]: SKIPPED (budget)")
             continue
         try:
-            points.append(asyncio.run(one_point(n)))
+            points.append(asyncio.run(one_point(n, k)))
             _mark(f"conn_scaling point done: {points[-1]}")
         except Exception as e:  # noqa: BLE001 — partial > nothing
-            points.append({"connections": n, "error": repr(e)})
-            _mark(f"conn_scaling[{n}]: FAILED ({e!r}); continuing")
+            points.append({"connections": n, "topics": k,
+                           "error": repr(e)})
+            _mark(f"conn_scaling[{n}/{k}t]: FAILED ({e!r}); continuing")
     good = [p for p in points if "msgs_per_s" in p]
     out = {
         "curve": points,
@@ -2597,7 +2672,11 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
             (p["msgs_per_s"] for p in good
              if p["connections"] == 1_000_000), None
         ),
-        "topics": CONN_SCALING_TOPICS,
+        "sub_table_bytes_at_1m_distinct": next(
+            (p["sub_table_bytes"] for p in good
+             if p["connections"] == 1_000_000
+             and p["topics"] >= 100_000), None
+        ),
         "codec_micro": _codec_micro(),
         "note": (
             "simulated clients over the worker plane: real fabric"
@@ -2605,12 +2684,11 @@ def bench_conn_scaling(deadline: Optional[float] = None) -> dict:
             " links; worker PROCESSES simulated (their sockets are the"
             " drain side). msgs_per_s = publish->settle through slab"
             " unpack -> zero-copy ingest -> device route -> slab DLV"
-            " pack at each connection count; per-message fan-out grows"
-            " as N/topics. Topic space fixed at 4096: 1M DISTINCT"
-            " single-subscriber topics would need a 128GB dense"
-            " [fid, slot] subscriber matrix on one host — the measured"
-            " ceiling that makes a sparse fid-row representation the"
-            " next protocol-plane item."
+            " pack; deliveries_per_s over the full drain-to-quiescence"
+            " window. The topics axis is the CSR unlock: distinct-"
+            "topic points carry measured sub_table_bytes next to the"
+            " dense-equivalent formula bytes (1M distinct topics ="
+            " ~128GB dense, O(subscriptions) sparse)."
         ),
     }
     _mark(f"conn_scaling: {json.dumps(out)[:400]}")
@@ -3226,6 +3304,18 @@ def main() -> None:
                     "conn_msgs_per_s_at_1m": conn.get(
                         "msgs_per_s_at_1m"
                     ),
+                    # CSR subscriber table (docs/serving_pipeline.md
+                    # "subscriber-table memory budget"): the measured
+                    # O(S) footprint at the 1M-distinct-topic point +
+                    # the dense-vs-sparse serving comparison
+                    "sub_table_bytes_at_1m_distinct": conn.get(
+                        "sub_table_bytes_at_1m_distinct"
+                    ),
+                    # NB: the sweep flattens "serving" into e2e_serving
+                    # + serving_dispatch result keys before this point
+                    "serving_sparse_vs_dense_rps_x": results.get(
+                        "serving_dispatch", {}
+                    ).get("sparse_vs_dense_rps_x"),
                     "codec_micro": conn.get("codec_micro"),
                     "skipped_configs": skipped,
                     "wall_s": round(time.perf_counter() - _T0, 1),

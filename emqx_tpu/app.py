@@ -186,6 +186,8 @@ class BrokerApp:
                 max_bytes=c.router.max_bytes,
                 fanout_compact=c.router.fanout_compact,
                 fanout_slots=c.router.fanout_slots,
+                sub_table=c.router.sub_table,
+                sparse_gather=c.router.sparse_gather,
                 donate_buffers=c.router.donate_buffers,
                 jit_cache_max=c.router.jit_cache_max,
             ),
@@ -209,6 +211,10 @@ class BrokerApp:
             # match-only engine (Router.matcher) and the retained replay
             # index pick it up from here (segment-manager placements)
             self.router.mesh = self.broker.mesh
+            # a sparse subscriber table partitions its slot column over
+            # the 'tp' axis; setting the shard count up front avoids a
+            # re-shard rebuild on the first prepare
+            self.broker.subtab.set_shards(tp)
         self.cm = ChannelManager(self.broker)
         # device-resident session store (broker/session_store.py): the
         # inflight/QoS state tables ride the same segment machinery as
@@ -1124,6 +1130,19 @@ class BrokerApp:
                     m.gauge_set(
                         "router.segment.tombstones", st["tombstones"]
                     )
+                    st_sub = self.broker.subtab.status()
+                    if st_sub["mode"] == "sparse":
+                        m.gauge_set("router.sparse.bytes", st_sub["bytes"])
+                        m.gauge_set(
+                            "router.sparse.fill", st_sub["csr_fill"]
+                        )
+                        m.gauge_set(
+                            "router.sparse.tombstones",
+                            st_sub["csr_tombstones"],
+                        )
+                        m.gauge_set(
+                            "router.sparse.hot.fill", st_sub["hot_fill"]
+                        )
                     rc = self.config.router
                     owners = dev.compaction_owners(
                         hot_entries=rc.compact_hot_entries,

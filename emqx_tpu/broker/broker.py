@@ -111,7 +111,17 @@ class Broker:
         # (emqx_broker.erl:505-530 do_dispatch, as one gather+OR)
         from emqx_tpu.models.router_model import GroupTable, SubscriberTable
 
-        self.subtab = SubscriberTable()
+        # router.sub_table policy (docs/serving_pipeline.md): the CSR
+        # representation serves through the compact readback contract,
+        # so fanout_compact=False pins the dense matrix (the fallback)
+        mc = self.router.matcher_config
+        self.subtab = SubscriberTable(
+            mode=(
+                getattr(mc, "sub_table", "auto")
+                if getattr(mc, "fanout_compact", True)
+                else "dense"
+            ),
+        )
         # running plain-subscription count: subscription_count() used to
         # RECOMPUTE sum(len(entry)) per subscribe/unsubscribe, turning a
         # million-connection subscribe storm into O(N^2) gauge upkeep

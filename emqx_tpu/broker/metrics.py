@@ -478,6 +478,26 @@ declare("router.compact.lag.seconds", GAUGE,
         "hot segment is under threshold; sustained growth means "
         "compaction cannot keep up with churn)")
 
+# sparse (CSR) subscriber table (ops/csr_table.py, router.sub_table
+# policy; docs/serving_pipeline.md "subscriber-table memory budget")
+declare("router.sparse.flips", COUNTER,
+        "subscriber-table representation flips served (dense bitmap "
+        "matrix <-> CSR slot lists; auto mode flips at most once)")
+declare("router.sparse.overflow.rows", COUNTER,
+        "sparse-path rows whose fan-out exceeded the Kslot/gather "
+        "window and rebuilt their recipient set from the host table")
+declare("router.sparse.bytes", GAUGE,
+        "device footprint of the CSR subscriber table (slot column + "
+        "region lanes + hot segment) — the sub_table_bytes number")
+declare("router.sparse.fill", GAUGE,
+        "live subscriptions in the CSR table")
+declare("router.sparse.tombstones", GAUGE,
+        "tombstoned CSR entries (packed column + hot) awaiting "
+        "compaction")
+declare("router.sparse.hot.fill", GAUGE,
+        "live entries in the CSR hot segment (subscribes since the "
+        "last compaction)")
+
 # scale-out sharded serving (parallel/mesh.py dist_fused_step,
 # cluster/route_sync.ShardOwnership, docs/scale_out.md)
 declare("mesh.shard.count", GAUGE,

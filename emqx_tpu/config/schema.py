@@ -105,6 +105,16 @@ class RouterConfig:
     # per-row compact-slot cap Kslot: 0 = auto-size from the
     # dispatch.fanout histogram p99 (grow-only, pow2); > 0 pins it
     fanout_slots: int = 0
+    # subscriber-table representation (docs/serving_pipeline.md
+    # "subscriber-table memory budget"): dense = the [Fcap, W] bitmap
+    # matrix (O(filters x slots) memory; the degrade fallback),
+    # sparse = CSR slot lists (O(total subscriptions) — what makes 1M
+    # distinct single-subscriber topics possible), auto = start dense,
+    # flip once when occupancy x width says the matrix is mostly zeros
+    sub_table: str = "auto"
+    # sparse-mode gather-window bound per routed row (0 = 2 x Kslot);
+    # rows past it rebuild their fan-out on host like Kslot overflow
+    sparse_gather: int = 0
     # ingest-side adaptive batch window (broker/ingest.py): collect
     # concurrent publishes into one device route_step
     ingest_enable: bool = True
@@ -711,6 +721,19 @@ def _validate(cfg: AppConfig) -> None:
     if cfg.router.fanout_slots < 0:
         raise ConfigError(
             "router.fanout_slots must be >= 0 (0 = auto-size)"
+        )
+    if cfg.router.sub_table not in ("auto", "dense", "sparse"):
+        raise ConfigError(
+            "router.sub_table must be one of auto|dense|sparse"
+        )
+    if cfg.router.sub_table == "sparse" and not cfg.router.fanout_compact:
+        raise ConfigError(
+            "router.sub_table=sparse requires router.fanout_compact "
+            "(the CSR table serves through the compact readback)"
+        )
+    if cfg.router.sparse_gather < 0:
+        raise ConfigError(
+            "router.sparse_gather must be >= 0 (0 = 2 x Kslot)"
         )
     if cfg.router.jit_cache_max < 0:
         raise ConfigError(

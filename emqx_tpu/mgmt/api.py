@@ -366,6 +366,15 @@ class MgmtApi:
                 "prepare_dirty": m.get("router.prepare.dirty"),
                 "sync_skipped": m.get("router.sync.skipped"),
             },
+            "sub_table": {
+                # subscriber-table representation (docs/serving_pipeline
+                # "subscriber-table memory budget"): mode + live device
+                # footprint straight from the table, overflow/flip
+                # counters from the flight recorder
+                **self.broker.subtab.status(),
+                "overflow_rows": m.get("router.sparse.overflow.rows"),
+                "rep_flips": m.get("router.sparse.flips"),
+            },
             "segment": {
                 "hot_fill": m.gauge("router.segment.hot.fill"),
                 "hot_capacity": m.gauge("router.segment.hot.capacity"),

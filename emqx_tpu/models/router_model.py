@@ -30,6 +30,7 @@ import numpy as np
 
 from emqx_tpu.observe import faults as _faults
 from emqx_tpu.ops.contract import device_contract
+from emqx_tpu.ops.csr_table import CsrSegmentOwner, CsrTable, sparse_fanout_slots
 from emqx_tpu.ops.matcher import batch_match_bytes_impl
 from emqx_tpu.ops.nfa import _next_pow2
 
@@ -132,6 +133,7 @@ def route_step_impl(
     max_matches: int = 64,
     probes: int = 8,
     kslot: int = 0,
+    kg: int = 0,
 ):
     """Full forward step: tokenize + match + fanout + stats. Jittable.
 
@@ -140,6 +142,12 @@ def route_step_impl(
     additionally carries the sparse fan-out compaction
     (`compact_fanout_slots`): slots [B, kslot], slot_count [B],
     overflow [B].
+
+    ``sub_bitmaps`` may instead be a CSR table dict (ops/csr_table.py
+    array set): the fan-out half then runs `sparse_fanout_slots` —
+    memory O(total subscriptions) instead of O(Fcap * W) — emitting the
+    same compact contract directly (``kg`` bounds the gather window;
+    0 = 2 * kslot). The dense trace is unchanged either way.
     """
     # cause breakdown is unused on this path (XLA dead-code-eliminates it);
     # the serving path folds all causes into one fallback flag per row
@@ -153,6 +161,25 @@ def route_step_impl(
         max_matches=max_matches,
         probes=probes,
     )
+    if isinstance(sub_bitmaps, dict):  # CSR representation
+        slots, scount, sovf, live = sparse_fanout_slots(
+            sub_bitmaps, matched, kslot=kslot, kg=kg
+        )
+        stats = {
+            "routed": jnp.sum((mcount > 0).astype(jnp.int32)),
+            "matches": jnp.sum(mcount),
+            "fanout_bits": jnp.sum(live),
+        }
+        return {
+            "matched": matched,
+            "mcount": mcount,
+            "flags": flags,
+            "bitmaps": None,
+            "stats": stats,
+            "slots": slots,
+            "slot_count": scount,
+            "overflow": sovf,
+        }
     bitmaps = fanout_bitmaps(sub_bitmaps, matched)
     stats = {
         "routed": jnp.sum((mcount > 0).astype(jnp.int32)),
@@ -184,7 +211,8 @@ route_step = device_contract(
         "slot_count": lambda cfg: cfg["B"] * 4,
     },
 )(partial(jax.jit, static_argnames=(
-    "salt", "max_levels", "frontier", "max_matches", "probes", "kslot"
+    "salt", "max_levels", "frontier", "max_matches", "probes", "kslot",
+    "kg",
 ))(route_step_impl))
 
 
@@ -211,6 +239,7 @@ def shape_route_step_impl(
     share_strategy: int = 0,
     dp_axis: Optional[str] = None,
     kslot: int = 0,
+    kg: int = 0,
 ):
     """The serving-path kernel: shape index + (residual NFA) + fanout.
 
@@ -225,6 +254,12 @@ def shape_route_step_impl(
     slot_count [B] / overflow [B], so the host can read back O(matches)
     compact slot lists and fetch dense bitmap rows only for the
     (rare, overflow-flagged) rows whose fan-out exceeds the cap.
+
+    ``sub_bitmaps`` may instead be a CSR table dict (ops/csr_table.py):
+    the fan-out stage then runs `sparse_fanout_slots` over the
+    O(subscriptions) slot lists and emits the same compact contract
+    directly (no dense bitmaps exist; overflow rows rebuild on host).
+    ``kg`` is the CSR gather-window bound (0 = 2 * kslot).
     """
     import jax.numpy as jnp
 
@@ -257,7 +292,15 @@ def shape_route_step_impl(
         matched = jnp.concatenate([matched, m2], axis=1)
         flags = flags | f2
     mcount = jnp.sum((matched >= 0).astype(jnp.int32), axis=1)
-    if sub_bitmaps is not None:
+    sparse_out = None
+    if isinstance(sub_bitmaps, dict):  # CSR representation
+        bitmaps = None
+        s_slots, s_count, s_ovf, s_live = sparse_fanout_slots(
+            sub_bitmaps, matched, kslot=kslot, kg=kg
+        )
+        sparse_out = (s_slots, s_count, s_ovf)
+        fanout_bits = jnp.sum(s_live)
+    elif sub_bitmaps is not None:
         bitmaps = fanout_bitmaps(sub_bitmaps, matched)
         fanout_bits = jnp.sum(popcount32(bitmaps).astype(jnp.int32))
     else:  # match-only callers (Router.match_batch) skip the fan-out half
@@ -289,7 +332,9 @@ def shape_route_step_impl(
         "pick_idx": pick_idx,
         "stats": stats,
     }
-    if kslot > 0 and bitmaps is not None:
+    if sparse_out is not None:
+        out["slots"], out["slot_count"], out["overflow"] = sparse_out
+    elif kslot > 0 and bitmaps is not None:
         slots, scount, sovf = compact_fanout_slots(bitmaps, kslot)
         out["slots"] = slots
         out["slot_count"] = scount
@@ -319,6 +364,7 @@ shape_route_step = device_contract(
         "share_strategy",
         "dp_axis",
         "kslot",
+        "kg",
     ),
 )(shape_route_step_impl))
 
@@ -346,9 +392,24 @@ shape_route_step_donated = partial(
         "share_strategy",
         "dp_axis",
         "kslot",
+        "kg",
     ),
     donate_argnames=("lengths",),
 )(shape_route_step_impl)
+
+# Second registry entry for the SAME serving jit traced with a CSR
+# subscriber table instead of the dense bitmap matrix: the sparse mode
+# compiles a different program (gather-union fan-out, no [B, W]
+# bitmaps), so it gets its own golden jaxpr + byte bounds. The audit
+# harness (tools/analysis/device_contract.py) builds the CSR workload.
+sparse_shape_route_step = device_contract(
+    "sparse_shape_route_step",
+    collectives=(),
+    out_bounds={
+        "slots": lambda cfg: cfg["B"] * cfg["kslot"] * 4,
+        "slot_count": lambda cfg: cfg["B"] * 4,
+    },
+)(shape_route_step)
 
 
 def session_route_step_impl(
@@ -377,6 +438,7 @@ def session_route_step_impl(
     with_groups: bool = False,
     share_strategy: int = 0,
     kslot: int = 0,
+    kg: int = 0,
     sweep_k: int = 0,
 ):
     """Publish routing + the session-ack stage as ONE device program.
@@ -414,6 +476,7 @@ def session_route_step_impl(
         with_groups=with_groups,
         share_strategy=share_strategy,
         kslot=kslot,
+        kg=kg,
     )
     out["session"] = session_ack_impl(
         sess_tables, sess_idxs, sess_vals, sess_clock, sweep_k=sweep_k
@@ -439,6 +502,7 @@ session_route_step = partial(
         "with_groups",
         "share_strategy",
         "kslot",
+        "kg",
         "sweep_k",
     ),
 )(session_route_step_impl)
@@ -474,6 +538,7 @@ def fused_route_retained_step_impl(
     with_groups: bool = False,
     share_strategy: int = 0,
     kslot: int = 0,
+    kg: int = 0,
 ):
     """Publish routing + retained-replay match as ONE device program.
 
@@ -509,6 +574,7 @@ def fused_route_retained_step_impl(
         with_groups=with_groups,
         share_strategy=share_strategy,
         kslot=kslot,
+        kg=kg,
     )
     rl = jnp.sum((ret_bytes != 0).astype(jnp.int32), axis=1)
     rout = shape_route_step_impl(
@@ -550,6 +616,7 @@ fused_route_retained_step = device_contract(
         "with_groups",
         "share_strategy",
         "kslot",
+        "kg",
         "ret_m_active",
         "ret_with_nfa",
         "ret_salt",
@@ -839,18 +906,52 @@ def share_pick_device(
     return jnp.where(ok, gids, -1), jnp.where(ok, idx, -1)
 
 
+def _popcount_u32(arr: np.ndarray) -> int:
+    """Total set bits of a uint32 array (chunked: no 8x byte blowup)."""
+    bc = getattr(np, "bitwise_count", None)
+    if bc is not None:
+        return int(bc(arr).sum())
+    total = 0
+    flat = arr.reshape(-1).view(np.uint8)
+    step = 1 << 22
+    for lo in range(0, len(flat), step):
+        total += int(np.unpackbits(flat[lo : lo + step]).sum())
+    return total
+
+
 class SubscriberTable:
-    """Host-side registry: (filter id, subscriber slot) -> bitmap matrix.
+    """Host-side registry: (filter id, subscriber slot) -> fan-out state,
+    in one of TWO device representations behind one mutation interface:
+
+    - **dense** (the original): a ``sub_bitmaps [Fcap, W]`` uint32
+      matrix — O(Fcap * W) memory, one gather+OR per batch row. Right
+      for small tables and shared-heavy/high-occupancy workloads;
+    - **sparse** (ops/csr_table.py): per-fid CSR slot lists —
+      O(total subscriptions) memory, the representation that makes a
+      million DISTINCT single-subscriber topics (and the 100M-sub mesh
+      run) physically possible.
+
+    ``mode`` is the `router.sub_table` policy: ``dense`` pins the
+    matrix (the degrade fallback), ``sparse`` converts immediately, and
+    ``auto`` starts dense and flips ONCE (grow-only, checked at growth
+    events so the per-subscribe cost is zero) when the matrix passes
+    `AUTO_MIN_DENSE_BYTES` and exceeds `AUTO_RATIO` x the estimated CSR
+    footprint — i.e. when occupancy x width says the bitmap is mostly
+    zeros. A flip is an ordinary epoch bump on the SAME object: every
+    holder (Broker, DeviceRouter, segment manager) just sees a full
+    resync with the other representation's arrays.
 
     The reference keeps subscribers in per-node ETS bag tables
-    (emqx_broker.erl:98-110). Here each local subscriber gets a dense slot;
-    the [Fcap, W] uint32 matrix is the PRIMARY storage, mutated in place
-    with every write op-logged (flat index) so `DeviceDeltaSync` can replay
-    churn as O(delta) scatters. Either axis auto-grows by doubling; growth
-    bumps `epoch` (full re-upload + one route_step recompile).
+    (emqx_broker.erl:98-110); both representations op-log their scalar
+    writes (flat index) so `DeviceSegmentManager` replays churn as
+    O(delta) scatters, and growth/flips bump `epoch` (full re-upload).
     """
 
-    def __init__(self, max_subscribers: int = 1024):
+    AUTO_MIN_DENSE_BYTES = 8 << 20  # don't bother below 8MB dense
+    AUTO_RATIO = 2.0  # flip when dense > ratio x estimated CSR bytes
+
+    def __init__(self, max_subscribers: int = 1024, mode: str = "dense",
+                 shards: int = 1):
         self.width_words = max(2, _next_pow2((max_subscribers + 31) // 32))
         self._fcap = 64
         self.arr = np.zeros((self._fcap, self.width_words), dtype=np.uint32)
@@ -858,15 +959,143 @@ class SubscriberTable:
         self.oplog: list = []  # (name, flat_idx, value)
         self.version = 0
         self.OPLOG_MAX = 65536
+        self.mode = "dense"
+        self.shards = max(1, int(shards))
+        self._sp: Optional[CsrTable] = None  # the sparse rep when active
+        self.live = 0  # live subscriptions (both reps; drives the policy)
+        self.flips = 0
+        if mode != "dense":
+            self.set_mode(mode)
 
-    def _log(self, fid: int, w: int, val: int) -> None:
+    # -- op-log plumbing (shared by both representations) ------------------
+    def _bump_epoch(self) -> None:
+        self.epoch += 1
+        self.oplog.clear()
+        self.version += 1
+
+    def _log_any(self, name: str, flat_idx: int, val: int) -> None:
         self.version += 1
         if len(self.oplog) >= self.OPLOG_MAX:
-            self.epoch += 1
-            self.oplog.clear()
+            self._bump_epoch()
             return
-        self.oplog.append(("sub_bitmaps", fid * self.width_words + w, val))
+        self.oplog.append((name, int(flat_idx), int(val)))
 
+    def _log_resync(self, name: str) -> None:
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump_epoch()
+            return
+        from emqx_tpu.ops.segments import RESYNC
+
+        self.oplog.append((RESYNC, name, 0))
+
+    def _log(self, fid: int, w: int, val: int) -> None:
+        self._log_any("sub_bitmaps", fid * self.width_words + w, val)
+
+    # -- representation policy ---------------------------------------------
+    @property
+    def sparse(self) -> bool:
+        return self._sp is not None
+
+    @property
+    def csr(self) -> Optional[CsrTable]:
+        return self._sp
+
+    def set_mode(self, mode: str) -> None:
+        """Pin the representation policy; converts immediately when the
+        pinned representation differs from the live one."""
+        if mode not in ("auto", "dense", "sparse"):
+            raise ValueError(f"sub_table mode {mode!r}")
+        self.mode = mode
+        if mode == "sparse" and self._sp is None:
+            self._flip_sparse()
+        elif mode == "dense" and self._sp is not None:
+            self._flip_dense()
+
+    def set_shards(self, shards: int) -> None:
+        """Partition count for the mesh placement ('tp' slices of the
+        slot column). Re-shards a live sparse table (epoch bump)."""
+        shards = max(1, int(shards))
+        if shards == self.shards:
+            return
+        self.shards = shards
+        if self._sp is not None:
+            self._sp.reshard(shards)
+
+    def _csr_estimate(self) -> int:
+        """Estimated CSR footprint: 4B slot column + 2 x 4B region lanes
+        per fid + the hot segment floor."""
+        return 16 * max(self.live, 1) + 8 * self._fcap + 8192
+
+    def _maybe_flip(self) -> None:
+        """Auto policy, checked only at dense growth events (the only
+        times the answer can change): flip when occupancy x width says
+        the matrix is mostly zeros AND it is big enough to matter."""
+        if self.mode != "auto" or self._sp is not None:
+            return
+        dense_bytes = self.arr.nbytes
+        if dense_bytes < self.AUTO_MIN_DENSE_BYTES:
+            return
+        if dense_bytes > self.AUTO_RATIO * self._csr_estimate():
+            self._flip_sparse()
+
+    def _mk_csr(self) -> CsrTable:
+        return CsrTable(
+            shards=self.shards,
+            log=self._log_any,
+            log_resync=self._log_resync,
+            bump=self._bump_epoch,
+        )
+
+    def _flip_sparse(self) -> None:
+        """dense -> CSR: expand the live bits (vectorized), build the
+        exact-size CSR + registry, drop the matrix. One epoch bump."""
+        rows, words = np.nonzero(self.arr)
+        if len(rows):
+            vals = self.arr[rows, words]
+            bits = (
+                (vals[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+            ).astype(bool)
+            e_idx, e_bit = np.nonzero(bits)
+            fids = rows[e_idx].astype(np.int64)
+            slots = words[e_idx].astype(np.int64) * 32 + e_bit
+        else:
+            fids = slots = np.empty(0, np.int64)
+        sp = self._mk_csr()
+        built = CsrTable._build(fids, slots, sp.shards, self._fcap)
+        sp._install(built)
+        sp.max_slot = max(
+            sp.max_slot, self.width_words * 32 - 1 if len(rows) else -1
+        )
+        self._sp = sp
+        self.arr = None  # the matrix is gone — that is the point
+        self.live = built["n"]
+        self.flips += 1
+        self._bump_epoch()
+
+    def _flip_dense(self) -> None:
+        """CSR -> dense (the degrade fallback / explicit pin)."""
+        sp = self._sp
+        fids, slots = sp.live_pairs()
+        self._sp = None
+        nf = max(64, _next_pow2(int(fids.max()) + 1 if len(fids) else 1))
+        nw = max(
+            self.width_words,
+            _next_pow2((int(slots.max()) // 32 + 1) if len(slots) else 2),
+        )
+        self._fcap, self.width_words = nf, nw
+        self.arr = np.zeros((nf, nw), np.uint32)
+        if len(fids):
+            w = slots // 32
+            bits = (np.uint32(1) << (slots % 32).astype(np.uint32)).astype(
+                np.uint32
+            )
+            np.bitwise_or.at(self.arr, (fids, w), bits)
+        self.live = len(fids)
+        self.flips += 1
+        self._bump_epoch()
+
+    # -- mutation (mode-dispatched) ----------------------------------------
     def _ensure(self, fid: int, slot: int) -> None:
         need_w = _next_pow2(slot // 32 + 1)
         need_f = _next_pow2(fid + 1)
@@ -878,14 +1107,31 @@ class SubscriberTable:
             self.arr = new
             self.width_words = nw
             self._fcap = nf
-            self.epoch += 1
-            self.oplog.clear()
-            self.version += 1
+            self._bump_epoch()
+            self._maybe_flip()
+
+    def _track_width(self, slot: int) -> None:
+        # external readers size dense fallback rows from width_words;
+        # keep it covering the slot universe in sparse mode too
+        need_w = _next_pow2(slot // 32 + 1)
+        if need_w > self.width_words:
+            self.width_words = need_w
 
     def add(self, filter_id: int, slot: int) -> None:
+        if self._sp is not None:
+            if self._sp.add(filter_id, slot):
+                self.live += 1
+            self._fcap = max(self._fcap, self._sp._fcap)
+            self._track_width(slot)
+            return
         self._ensure(filter_id, slot)
+        if self._sp is not None:  # _ensure's growth flipped the rep
+            return self.add(filter_id, slot)
         w = slot // 32
-        self.arr[filter_id, w] |= np.uint32(1 << (slot % 32))
+        bit = np.uint32(1 << (slot % 32))
+        if not self.arr[filter_id, w] & bit:
+            self.live += 1
+        self.arr[filter_id, w] |= bit
         self._log(filter_id, w, int(self.arr[filter_id, w]))
 
     def bulk_add(self, fids, slots) -> None:
@@ -894,32 +1140,109 @@ class SubscriberTable:
         slots = np.asarray(slots, dtype=np.int64)
         if not len(fids):
             return
+        if self._sp is not None:
+            self._sp.bulk_add(fids, slots)
+            self.live = self._sp.live
+            self._fcap = max(self._fcap, self._sp._fcap)
+            self._track_width(int(slots.max()))
+            return
         self._ensure(int(fids.max()), int(slots.max()))
+        if self._sp is not None:
+            return self.bulk_add(fids, slots)
         w = slots // 32
         bits = (np.uint32(1) << (slots % 32).astype(np.uint32)).astype(
             np.uint32
         )
         np.bitwise_or.at(self.arr, (fids, w), bits)
-        self.epoch += 1
-        self.oplog.clear()
-        self.version += 1
+        self.live = _popcount_u32(self.arr)
+        self._bump_epoch()
+        self._maybe_flip()
 
     def remove(self, filter_id: int, slot: int) -> None:
+        if self._sp is not None:
+            if self._sp.remove(filter_id, slot):
+                self.live -= 1
+            return
         if filter_id >= self._fcap or slot // 32 >= self.width_words:
             return
         w = slot // 32
-        self.arr[filter_id, w] &= np.uint32(~(1 << (slot % 32)) & 0xFFFFFFFF)
+        bit = np.uint32(1 << (slot % 32))
+        if self.arr[filter_id, w] & bit:
+            self.live -= 1
+        self.arr[filter_id, w] &= np.uint32(~bit & 0xFFFFFFFF)
         self._log(filter_id, w, int(self.arr[filter_id, w]))
 
-    def pack(self, filter_capacity: int) -> np.ndarray:
-        """Grow to cover `filter_capacity` rows and return the live matrix
-        (a view — valid until the next mutation)."""
+    def pack(self, filter_capacity: int):
+        """Grow to cover `filter_capacity` filter rows. Dense mode
+        returns the live matrix (a view — valid until the next
+        mutation); sparse mode returns None (there is no matrix)."""
+        if self._sp is not None:
+            # serve-time hot bound: a storm of adds with no background
+            # compactor must not hand the kernel a giant hot scan
+            self._sp.maybe_absorb()
+            self._sp.pack(filter_capacity)
+            self._fcap = max(self._fcap, self._sp._fcap)
+            return None
         if filter_capacity > self._fcap:
             self._ensure(filter_capacity - 1, 0)
+            if self._sp is not None:
+                self._sp.pack(filter_capacity)
+                return None
         return self.arr
 
     def device_snapshot(self):
+        if self._sp is not None:
+            return self._sp.device_snapshot()
         return {"sub_bitmaps": self.arr}
+
+    # -- introspection (REST / gauges / benches) ---------------------------
+    def fill_row_bits(self, fid: int, row: np.ndarray) -> None:
+        """OR one fid's subscriber bits into a uint32 bitmap row — the
+        host-built dense fallback for sparse overflow rows. Runs against
+        the LIVE table (loop thread; the per-delivery filter re-verify
+        is the staleness net, as everywhere on the dispatch path)."""
+        if self._sp is not None:
+            slots = self._sp.slots_of(fid)
+            slots = slots[slots < len(row) * 32]
+            if len(slots):
+                np.bitwise_or.at(
+                    row,
+                    slots // 32,
+                    (np.uint32(1) << (slots % 32).astype(np.uint32)).astype(
+                        np.uint32
+                    ),
+                )
+            return
+        if fid < self._fcap:
+            n = min(len(row), self.width_words)
+            row[:n] |= self.arr[fid, :n]
+
+    def table_bytes(self) -> int:
+        """Device-table footprint of the ACTIVE representation — the
+        `sub_table_bytes` number the memory-budget docs talk about."""
+        if self._sp is not None:
+            return self._sp.nbytes
+        return int(self.arr.nbytes)
+
+    def status(self) -> Dict:
+        """Hotpath-REST / gauge block: mode, bytes, fill, tombstones."""
+        out = {
+            "mode": "sparse" if self._sp is not None else "dense",
+            "policy": self.mode,
+            "bytes": self.table_bytes(),
+            "subscriptions": self.live,
+            "width_words": self.width_words,
+            "fcap": self._fcap,
+            "flips": self.flips,
+            "shards": self.shards,
+        }
+        if self._sp is not None:
+            sp = self._sp
+            out["csr_fill"] = sp.live
+            out["csr_tombstones"] = sp.packed_tombs + sp.hot_tombs
+            out["hot_fill"] = sp.hot_fill
+            out["max_region"] = sp.max_region
+        return out
 
 
 class RouteResult(NamedTuple):
@@ -956,6 +1279,37 @@ class RouteResult(NamedTuple):
     # `broker.session_store.SessionStepOut` — updated device mirror
     # (stays on device) + the O(sweep_k) sweep lists
     session: Optional[tuple] = None
+
+
+class _LazyDenseRows:
+    """Dense fallback rows for SPARSE overflow rows, built on demand.
+
+    The CSR path has no device bitmap matrix to gather overflow rows
+    from, so the fallback unions the row's matched fids' slot lists
+    from the HOST table instead. Construction here stores only the fid
+    lists (cheap, runs on the dispatch executor); the actual union runs
+    at `__getitem__` time — which is `Broker._dispatch_device_results`,
+    on the event loop, the thread that owns the table — so no cross-
+    thread reads of live arrays ever happen. Duck-types the
+    `dense_rows[j]` indexing of the device-gathered overflow contract;
+    nothing crossed the link for these rows (readback_bytes excludes
+    them honestly).
+    """
+
+    __slots__ = ("subtab", "fid_lists")
+
+    def __init__(self, subtab, fid_lists):
+        self.subtab = subtab
+        self.fid_lists = fid_lists
+
+    def __len__(self) -> int:
+        return len(self.fid_lists)
+
+    def __getitem__(self, j: int) -> np.ndarray:
+        row = np.zeros(self.subtab.width_words, np.uint32)
+        for fid in self.fid_lists[j]:
+            self.subtab.fill_row_bits(int(fid), row)
+        return row
 
 
 # floor for the auto-sized compact-slot cap: below this the slot list is
@@ -1030,11 +1384,6 @@ class DeviceRouter:
             self._nfa_sync = DeviceSegmentManager(
                 placement=tplace, free_retired=True, name="nfa"
             )
-            self._bits_sync = DeviceSegmentManager(
-                placement=self._bitmap_placement,
-                free_retired=True,
-                name="bitmaps",
-            )
             # group tables are replicated on the mesh like match tables
             self._group_sync = DeviceSegmentManager(
                 placement=tplace, free_retired=True, name="groups"
@@ -1048,12 +1397,18 @@ class DeviceRouter:
             self._nfa_sync = DeviceSegmentManager(
                 free_retired=True, name="nfa"
             )
-            self._bits_sync = DeviceSegmentManager(
-                free_retired=True, name="bitmaps"
-            )
             self._group_sync = DeviceSegmentManager(
                 free_retired=True, name="groups"
             )
+        # the subscriber-table mirror follows the table's ACTIVE
+        # representation: dense lanes shard over 'tp', a CSR table's
+        # arrays shard their leading (slot-owner) axis over 'tp'. A
+        # representation flip (router.sub_table=auto) swaps the manager
+        # — an ordinary full resync under the new placement.
+        self._bits_sparse = (
+            subtab is not None and getattr(subtab, "sparse", False)
+        )
+        self._bits_sync = self._mk_bits_sync(self._bits_sparse)
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
         # state (it runs on executor threads)
@@ -1077,12 +1432,27 @@ class DeviceRouter:
         self._prep_args = None  # single-writer: loop
         self._clean_streak = 0  # single-writer: loop
 
+    def _mk_bits_sync(self, sparse: bool):
+        from emqx_tpu.ops.segments import DeviceSegmentManager
+
+        placement = None
+        if self.mesh is not None:
+            if sparse:
+                from emqx_tpu.parallel.mesh import csr_placement
+
+                placement = csr_placement(self.mesh)
+            else:
+                placement = self._bitmap_placement
+        return DeviceSegmentManager(
+            placement=placement, free_retired=True, name="bitmaps"
+        )
+
     # clean-table prepares re-check the auto-sized Kslot only every this
     # many batches: the fanout histogram drifts slowly and the p99 scan
     # would otherwise be the only per-batch work left on the clean path
     KSLOT_RECHECK = 64
 
-    def _fanout_kslot(self, width_words: int) -> int:
+    def _fanout_kslot(self, width_words: int, sparse: bool = False) -> int:
         """Static Kslot for the next batch; 0 = compaction off.
 
         An explicit ``config.fanout_slots`` pins the cap (pow2-padded to
@@ -1092,9 +1462,13 @@ class DeviceRouter:
         serving program twice for zero readback win — and turns
         compaction off entirely while the slot universe (W*32) is no
         wider than the compact output would be.
+
+        ``sparse``: a CSR table HAS no dense readback to fall back to —
+        compaction is mandatory there, so the cap never returns 0 (and
+        the fanout_compact knob / width win-condition don't apply).
         """
         cfg = self.config
-        if not cfg.fanout_compact or self.subtab is None:
+        if self.subtab is None or (not sparse and not cfg.fanout_compact):
             return 0
         if cfg.fanout_slots > 0:
             return _next_pow2(cfg.fanout_slots)
@@ -1107,6 +1481,8 @@ class DeviceRouter:
                 want = max(want, 2 * max(1, int(h.p99)))
         k = max(self._kslot, _next_pow2(want))
         self._kslot = k
+        if sparse:
+            return k
         if self.mesh is not None:
             # per-shard compaction: each tp shard emits its own kslot-wide
             # list, so the win condition is against the LOCAL lane width
@@ -1132,6 +1508,15 @@ class DeviceRouter:
         # growth (e.g. a bulk route load) would fail its first prepare
         # spuriously. No-ops when capacities already cover the index.
         if self.subtab is not None:
+            if (
+                self.mesh is not None
+                and self.subtab.sparse
+                and self.subtab.shards != self.mesh.shape["tp"]
+            ):
+                # mesh attached after the representation flip (or the
+                # app wiring was skipped): re-partition the slot column
+                # over 'tp' BEFORE the version key, like any growth
+                self.subtab.set_shards(self.mesh.shape["tp"])
             self.subtab.pack(self.index.num_filters_capacity)
         if self.grouptab is not None and len(self.grouptab):
             self.grouptab.pack_fcap(self.index.num_filters_capacity)
@@ -1145,11 +1530,17 @@ class DeviceRouter:
             if (
                 self._clean_streak % self.KSLOT_RECHECK == 0
                 and self.subtab is not None
-                and self.config.fanout_compact
+                and (self.config.fanout_compact or self.subtab.sparse)
             ):
-                kslot = self._fanout_kslot(self.subtab.width_words)
-                if kslot != self._prep_args[-1]:
-                    self._prep_args = self._prep_args[:-1] + (kslot,)
+                kslot = self._fanout_kslot(
+                    self.subtab.width_words, sparse=self.subtab.sparse
+                )
+                if kslot != self._prep_args[-2]:
+                    self._prep_args = (
+                        self._prep_args[:-2]
+                        + (kslot,)
+                        + self._prep_args[-1:]
+                    )
             if self.metrics is not None:
                 self.metrics.inc("router.sync.skipped")
             return self._prep_args
@@ -1208,11 +1599,22 @@ class DeviceRouter:
 
     def _device_args_dirty(self):
         idx = self.index
+        kg = 0
         if self.subtab is not None:
-            # grow the bitmap matrix to cover every live filter id BEFORE
-            # the snapshot — a matched fid must always gather a real row
+            sparse = self.subtab.sparse
+            if sparse != self._bits_sparse:
+                # representation flip (router.sub_table policy): swap
+                # the mirror manager so the full resync lands under the
+                # new placement; the retired mirror frees with it
+                self._bits_sync = self._mk_bits_sync(sparse)
+                self._bits_sparse = sparse
+                if self.metrics is not None:
+                    self.metrics.inc("router.sparse.flips")
+            # grow the fan-out table to cover every live filter id
+            # BEFORE the snapshot — a matched fid must always gather a
+            # real bitmap row / CSR region
             self.subtab.pack(idx.num_filters_capacity)
-            if self.mesh is not None:
+            if self.mesh is not None and not sparse:
                 tp = self.mesh.shape["tp"]
                 if self.subtab.width_words % tp:
                     # fail HERE with the config fix, before the sharded
@@ -1223,8 +1625,13 @@ class DeviceRouter:
                         f"{self.subtab.width_words} not divisible by "
                         f"mesh tp={tp}; use a power-of-two tp"
                     )
-            bits = self._bits_sync.sync(self.subtab)["sub_bitmaps"]
-            kslot = self._fanout_kslot(self.subtab.width_words)
+            snap = self._bits_sync.sync(self.subtab)
+            bits = snap if sparse else snap["sub_bitmaps"]
+            kslot = self._fanout_kslot(
+                self.subtab.width_words, sparse=sparse
+            )
+            if sparse:
+                kg = getattr(self.config, "sparse_gather", 0)
         else:
             bits = None
             kslot = 0
@@ -1246,6 +1653,7 @@ class DeviceRouter:
             with_nfa,
             group_tables,
             kslot,
+            kg,
         )
 
     # -- segment maintenance (ops/segments.SegmentCompactor) --------------
@@ -1284,7 +1692,25 @@ class DeviceRouter:
                 tombstone_frac=tombstone_frac,
             )
         ]
-        if self.subtab is not None:
+        if self.subtab is not None and self.subtab.sparse:
+            # CSR representation: merge the hot segment into the packed
+            # slot column + purge tombstones (the ShapeIndex cycle);
+            # built + pre-uploaded off the subscribe path
+            placement = None
+            if self.mesh is not None:
+                from emqx_tpu.parallel.mesh import csr_placement
+
+                placement = csr_placement(self.mesh)
+            owners.append(
+                CsrSegmentOwner(
+                    self.subtab,
+                    self._bits_sync,
+                    placement=placement,
+                    hot_entries=hot_entries,
+                    tombstone_frac=tombstone_frac,
+                )
+            )
+        elif self.subtab is not None:
             owners.append(
                 BitmapGrowthOwner(
                     self.subtab,
@@ -1384,6 +1810,7 @@ class DeviceRouter:
             with_nfa,
             group_tables,
             kslot,
+            kg,
         ) = args
         B = len(topics)
         Bp = max(64, _next_pow2(B))
@@ -1431,7 +1858,7 @@ class DeviceRouter:
             return self._route_mesh(
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
-                retained=retained,
+                retained=retained, kg=kg,
             )
         step_kw = dict(
             m_active=m_active,
@@ -1444,6 +1871,7 @@ class DeviceRouter:
             with_groups=with_groups,
             share_strategy=self.share_strategy,
             kslot=kslot,
+            kg=kg,
         )
         if session is not None:
             # the fused session-ack stage: the rider's inflight writes +
@@ -1544,7 +1972,10 @@ class DeviceRouter:
         if with_groups:
             pulls["pick_gid"] = out["pick_gid"][:B]
             pulls["pick_idx"] = out["pick_idx"][:B]
-        if out["bitmaps"] is not None:
+        # sparse (CSR) fan-out: compact outputs exist with NO dense
+        # bitmap matrix behind them — overflow rows rebuild on host
+        sparse_fan = out["bitmaps"] is None and out.get("slots") is not None
+        if out["bitmaps"] is not None or sparse_fan:
             if kslot:
                 pulls["slots"] = out["slots"][:B]
                 pulls["slot_count"] = out["slot_count"][:B]
@@ -1599,7 +2030,7 @@ class DeviceRouter:
                 )
             else:
                 sess_res = SessionStepOut(sess["tables"], None, 0, None, 0)
-        if out["bitmaps"] is None:
+        if out["bitmaps"] is None and not sparse_fan:
             return RouteResult(
                 matched, mcount, flags, None, picks,
                 readback_bytes=readback, retained=retained_res,
@@ -1611,17 +2042,37 @@ class DeviceRouter:
             if mesh:
                 overflow = host["overflow"]
             else:
+                # holds on the sparse path too: the kernel forces
+                # count past kslot for gather-window overflow rows
                 overflow = slot_count > kslot
             dense_rows = dense_index = None
             ovf_idx = np.nonzero(overflow)[0]
             if ovf_idx.size:
-                # masked second transfer: ONLY the rows whose fan-out
-                # exceeded the cap come back dense (device-side gather)
-                dense_rows = np.ascontiguousarray(
-                    jax.device_get(out["bitmaps"][ovf_idx])
-                )
                 dense_index = {int(r): j for j, r in enumerate(ovf_idx)}
-                readback += dense_rows.nbytes
+                if sparse_fan:
+                    # no dense matrix exists: the fallback rows build
+                    # lazily from the HOST table at dispatch time (on
+                    # the loop thread — see _LazyDenseRows); nothing
+                    # extra crosses the link
+                    dense_rows = _LazyDenseRows(
+                        self.subtab,
+                        [
+                            matched[r][matched[r] >= 0].tolist()
+                            for r in ovf_idx
+                        ],
+                    )
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "router.sparse.overflow.rows",
+                            int(ovf_idx.size),
+                        )
+                else:
+                    # masked second transfer: ONLY the rows whose fan-
+                    # out exceeded the cap come back dense
+                    dense_rows = np.ascontiguousarray(
+                        jax.device_get(out["bitmaps"][ovf_idx])
+                    )
+                    readback += dense_rows.nbytes
             return RouteResult(
                 matched, mcount, flags, None, picks,
                 slots=slots, slot_count=slot_count, overflow=overflow,
@@ -1660,7 +2111,7 @@ class DeviceRouter:
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
-        rand=None, kslot=0, retained=None,
+        rand=None, kslot=0, retained=None, kg=0,
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
@@ -1703,6 +2154,7 @@ class DeviceRouter:
             probes=cfg.probes,
             share_strategy=self.share_strategy,
             kslot=kslot,
+            kg=kg,
             donate=getattr(cfg, "donate_buffers", False),
         )
         return self._readback(out, B, too_long, with_groups, kslot, mesh=True)
@@ -1815,6 +2267,18 @@ class MeshServingRouter(DeviceRouter):
         numpy counting, cheap enough for a housekeeping tick."""
         sh = dict(self.mesh.shape)
         out = {"dp": sh["dp"], "tp": sh["tp"], "shards": sh["dp"] * sh["tp"]}
+        if self.subtab is not None and self.subtab.sparse:
+            # CSR shards: per-'tp'-slice live-subscription counts (the
+            # sparse lane-fill analog — exact, one pass over [S, F])
+            sp = self.subtab.csr
+            per = sp.csr_len.sum(axis=1)
+            hot_live = (sp.hot_fid >= 0).sum(axis=1)
+            fills = (per + hot_live).astype(np.float64)
+            denom = max(1.0, float(fills.sum()))
+            out["lane_fill_max"] = float(fills.max()) / denom
+            out["lane_fill_min"] = float(fills.min()) / denom
+            out["sub_table"] = "sparse"
+            return out
         if self.subtab is not None:
             arr = self.subtab.arr
             tp = sh["tp"]
@@ -1833,7 +2297,7 @@ class MeshServingRouter(DeviceRouter):
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
-        rand=None, kslot=0, retained=None,
+        rand=None, kslot=0, retained=None, kg=0,
     ):
         """SPMD serving with optional fused retained storm: chunk 0 of a
         prepared `StormJob` rides the SAME sharded program + readback
@@ -1844,6 +2308,7 @@ class MeshServingRouter(DeviceRouter):
             return super()._route_mesh(
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
+                kg=kg,
             )
         from emqx_tpu.parallel.mesh import (
             dist_fused_route_step,
@@ -1882,6 +2347,7 @@ class MeshServingRouter(DeviceRouter):
             probes=cfg.probes,
             share_strategy=self.share_strategy,
             kslot=kslot,
+            kg=kg,
             donate=getattr(cfg, "donate_buffers", False),
         )
         from emqx_tpu.models.retained_index import _get_retained_step

@@ -59,6 +59,17 @@ class MatcherConfig:
     # per-row compact-slot cap: 0 = auto-size from the dispatch.fanout
     # histogram p99 (grow-only, pow2-padded); > 0 pins it (pow2-padded)
     fanout_slots: int = 0
+    # subscriber-table representation policy (router.sub_table,
+    # docs/serving_pipeline.md "subscriber-table memory budget"):
+    # "dense" pins the [Fcap, W] bitmap matrix (the degrade fallback),
+    # "sparse" pins the CSR slot lists (O(total subscriptions) memory),
+    # "auto" starts dense and flips ONCE when occupancy x width says
+    # the matrix is mostly zeros
+    sub_table: str = "auto"
+    # CSR gather-window bound per row (sparse mode): rows whose matched
+    # regions exceed it rebuild on host like Kslot overflow. 0 = auto
+    # (2 x Kslot, tracking the fanout p99)
+    sparse_gather: int = 0
     # donate the per-batch input buffers (token bytes, lengths) to the
     # serving-path jit so steady-state batches reuse them for outputs
     # instead of allocating fresh device buffers every launch

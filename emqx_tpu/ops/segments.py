@@ -528,6 +528,8 @@ class BitmapGrowthOwner:
         self.headroom = headroom
 
     def needs_compact(self) -> bool:
+        if getattr(self.subtab, "sparse", False):
+            return False  # the CSR representation has its own owner
         return (
             self.index.num_filters_capacity
             > self.headroom * self.subtab._fcap

@@ -104,12 +104,15 @@ def make_mesh(
 
 
 # canonical output shardings + stats reduction, shared by both engines
-def _out_specs(with_groups: bool = False, with_slots: bool = False):
+def _out_specs(with_groups: bool = False, with_slots: bool = False,
+               dense_bitmaps: bool = True):
     specs = {
         "matched": P("dp", None),
         "mcount": P("dp"),
         "flags": P("dp"),
-        "bitmaps": P("dp", "tp"),
+        # the CSR engine emits NO bitmap matrix: None here mirrors the
+        # output dict's None leaf (empty pytree node on both sides)
+        "bitmaps": P("dp", "tp") if dense_bitmaps else None,
         "stats": {"routed": P(), "matches": P(), "fanout_bits": P()},
     }
     if with_groups:
@@ -252,6 +255,8 @@ def _dist_shape_step_fn(
     probes: int,
     kslot: int = 0,
     donate: bool = False,
+    sub_keys: Optional[tuple] = None,
+    kg: int = 0,
 ):
     """The SERVING engine (shape index + residual NFA + fan-out + $share
     pick) sharded over the mesh — same layout as `_dist_step_fn`, all
@@ -266,9 +271,16 @@ def _dist_shape_step_fn(
     uses), the per-shard slot lists concatenate over 'tp' in the output
     (-1 holes between segments), and count/overflow psum/OR over 'tp'.
     A row overflows when ANY shard's local fan-out exceeds kslot —
-    conservative, and the host's dense fallback keeps it correct."""
+    conservative, and the host's dense fallback keeps it correct.
+
+    ``sub_keys`` set = the CSR subscriber table (ops/csr_table.py):
+    its arrays shard their leading slot-owner axis over 'tp'
+    (`csr_placement`), each shard's `sparse_fanout_slots` emits GLOBAL
+    slot ids directly (no lane rebase), and only the count psum /
+    overflow OR run here. Same output contract either way."""
     with_nfa = nfa_keys is not None
     with_groups = group_keys is not None
+    sparse = sub_keys is not None
 
     def local_step(
         shape_tables, nfa_tables, group_tables, ch, th, rand,
@@ -294,35 +306,56 @@ def _dist_shape_step_fn(
             with_groups=with_groups,
             share_strategy=share_strategy,
             dp_axis="dp" if with_groups else None,
+            kslot=kslot if sparse else 0,
+            kg=kg,
         )
         if kslot:
-            slots, count, over = compact_fanout_slots(
-                out["bitmaps"], kslot
-            )
-            w_local = out["bitmaps"].shape[1]
-            off = jax.lax.axis_index("tp").astype(jnp.int32) * (
-                w_local * 32
-            )
-            out["slots"] = jnp.where(slots >= 0, slots + off, -1)
-            out["slot_count"] = jax.lax.psum(count, "tp")
-            out["overflow"] = (
-                jax.lax.psum(over.astype(jnp.int32), "tp") > 0
-            )
+            if sparse:
+                # per-shard CSR compaction already ran inside the impl;
+                # reduce the per-shard counts/overflow over 'tp'
+                out["slot_count"] = jax.lax.psum(out["slot_count"], "tp")
+                out["overflow"] = (
+                    jax.lax.psum(
+                        out["overflow"].astype(jnp.int32), "tp"
+                    )
+                    > 0
+                )
+            else:
+                slots, count, over = compact_fanout_slots(
+                    out["bitmaps"], kslot
+                )
+                w_local = out["bitmaps"].shape[1]
+                off = jax.lax.axis_index("tp").astype(jnp.int32) * (
+                    w_local * 32
+                )
+                out["slots"] = jnp.where(slots >= 0, slots + off, -1)
+                out["slot_count"] = jax.lax.psum(count, "tp")
+                out["overflow"] = (
+                    jax.lax.psum(over.astype(jnp.int32), "tp") > 0
+                )
         return _reduce_stats(out, with_groups)
 
     shape_specs = {k: P() for k in shape_keys}
     nfa_specs = {k: P() for k in nfa_keys} if with_nfa else None
     group_specs = {k: P() for k in group_keys} if with_groups else None
     per_topic = P("dp") if with_groups else P()
+    sub_spec = (
+        {k: P("tp", None) for k in sub_keys}
+        if sparse
+        else P(None, "tp")
+    )
     fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(
             shape_specs, nfa_specs, group_specs,
             per_topic, per_topic, per_topic,
-            P(None, "tp"), P("dp", None), P("dp"),
+            sub_spec, P("dp", None), P("dp"),
         ),
-        out_specs=_out_specs(with_groups, with_slots=kslot > 0),
+        out_specs=_out_specs(
+            with_groups, with_slots=kslot > 0,
+            dense_bitmaps=not sparse,
+        ),
     )
     # ``donate``: recycle the per-batch lengths buffer (aliases the
     # [B]-shaped int32 outputs under the same 'dp' sharding) — the mesh
@@ -369,6 +402,8 @@ def _dist_fused_step_fn(
     ret_max_levels: int,
     ret_narrow: bool,
     donate: bool = False,
+    sub_keys: Optional[tuple] = None,
+    kg: int = 0,
 ):
     """`_dist_shape_step_fn` + the retained-replay half fused into the
     SAME sharded program (the mesh analog of
@@ -387,6 +422,7 @@ def _dist_fused_step_fn(
 
     with_nfa = nfa_keys is not None
     with_groups = group_keys is not None
+    sparse = sub_keys is not None
 
     def local_step(
         shape_tables, nfa_tables, group_tables, ch, th, rand,
@@ -413,20 +449,31 @@ def _dist_fused_step_fn(
             with_groups=with_groups,
             share_strategy=share_strategy,
             dp_axis="dp" if with_groups else None,
+            kslot=kslot if sparse else 0,
+            kg=kg,
         )
         if kslot:
-            slots, count, over = compact_fanout_slots(
-                out["bitmaps"], kslot
-            )
-            w_local = out["bitmaps"].shape[1]
-            off = jax.lax.axis_index("tp").astype(jnp.int32) * (
-                w_local * 32
-            )
-            out["slots"] = jnp.where(slots >= 0, slots + off, -1)
-            out["slot_count"] = jax.lax.psum(count, "tp")
-            out["overflow"] = (
-                jax.lax.psum(over.astype(jnp.int32), "tp") > 0
-            )
+            if sparse:
+                out["slot_count"] = jax.lax.psum(out["slot_count"], "tp")
+                out["overflow"] = (
+                    jax.lax.psum(
+                        out["overflow"].astype(jnp.int32), "tp"
+                    )
+                    > 0
+                )
+            else:
+                slots, count, over = compact_fanout_slots(
+                    out["bitmaps"], kslot
+                )
+                w_local = out["bitmaps"].shape[1]
+                off = jax.lax.axis_index("tp").astype(jnp.int32) * (
+                    w_local * 32
+                )
+                out["slots"] = jnp.where(slots >= 0, slots + off, -1)
+                out["slot_count"] = jax.lax.psum(count, "tp")
+                out["overflow"] = (
+                    jax.lax.psum(over.astype(jnp.int32), "tp") > 0
+                )
         # retained half: bit-identical to fused_route_retained_step's,
         # on this shard's slice of the chunk rows (lengths derive
         # on-device — retained topics cannot contain NUL)
@@ -454,21 +501,46 @@ def _dist_fused_step_fn(
         {k: P() for k in ret_nfa_keys} if ret_nfa_keys is not None else None
     )
     per_topic = P("dp") if with_groups else P()
-    out_specs = _out_specs(with_groups, with_slots=kslot > 0)
+    out_specs = _out_specs(
+        with_groups, with_slots=kslot > 0, dense_bitmaps=not sparse
+    )
     out_specs["retained"] = P("dp", None)
+    sub_spec = (
+        {k: P("tp", None) for k in sub_keys}
+        if sparse
+        else P(None, "tp")
+    )
     fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(
             shape_specs, nfa_specs, group_specs,
             per_topic, per_topic, per_topic,
-            P(None, "tp"), P("dp", None), P("dp"),
+            sub_spec, P("dp", None), P("dp"),
             ret_shape_specs, ret_nfa_specs, P("dp", None),
         ),
         out_specs=out_specs,
     )
     jit_kw = {"donate_argnums": (8,)} if donate else {}
     return _register_built(jax.jit(fn, **jit_kw))
+
+
+# Second registry entry for the serving builder traced with the CSR
+# subscriber table: the sparse mesh program replaces the dense per-shard
+# compaction (which needs the axis_index lane rebase) with the in-impl
+# CSR gather — its ICI budget is the stats/count psums ONLY. A lane
+# rebase appearing in the sparse trace is a contract violation.
+device_contract(
+    "sparse_dist_shape_step",
+    kind="builder",
+    collectives=("psum",),
+    out_bounds={
+        "slots": lambda cfg: (
+            cfg["B"] * cfg["kslot"] * cfg.get("tp", 1) * 4
+        ),
+        "slot_count": lambda cfg: cfg["B"] * 4,
+    },
+)(_dist_shape_step_fn)
 
 
 def dist_fused_route_step(
@@ -500,6 +572,7 @@ def dist_fused_route_step(
     share_strategy: int = 0,
     kslot: int = 0,
     donate: bool = False,
+    kg: int = 0,
 ):
     """Distributed serving step WITH a fused retained-replay storm —
     the mesh engine `MeshServingRouter.route_prepared` launches when a
@@ -529,6 +602,10 @@ def dist_fused_route_step(
         ret_max_levels,
         ret_narrow,
         donate,
+        tuple(sorted(sub_bitmaps))
+        if isinstance(sub_bitmaps, dict)
+        else None,
+        kg,
     )
     return fn(
         shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
@@ -558,6 +635,7 @@ def dist_shape_route_step(
     share_strategy: int = 0,
     kslot: int = 0,
     donate: bool = False,
+    kg: int = 0,
 ):
     """Distributed serving step (shape engine). Sharding as in
     `dist_route_step`: tables replicated, subscriber lanes on 'tp',
@@ -565,7 +643,8 @@ def dist_shape_route_step(
     $share picks resolve on-device per dp shard (r3 verdict item 4 —
     the host pick wall stays down on the multi-chip path too).
     ``kslot`` engages per-shard sparse fan-out compaction (see
-    `_dist_shape_step_fn`)."""
+    `_dist_shape_step_fn`). A dict `sub_bitmaps` = the CSR subscriber
+    table, arrays sharded over 'tp' by their leading slot-owner axis."""
     fn = _dist_shape_step_fn(
         mesh,
         tuple(sorted(shape_tables)),
@@ -580,6 +659,10 @@ def dist_shape_route_step(
         probes,
         kslot,
         donate,
+        tuple(sorted(sub_bitmaps))
+        if isinstance(sub_bitmaps, dict)
+        else None,
+        kg,
     )
     return fn(
         shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
@@ -610,6 +693,17 @@ def table_placement(mesh: Mesh):
 def bitmap_placement(mesh: Mesh):
     """Canonical placement for subscriber bitmaps: lanes sharded on 'tp'."""
     sh = NamedSharding(mesh, P(None, "tp"))
+    return lambda _name, arr: jax.device_put(arr, sh)
+
+
+def csr_placement(mesh: Mesh):
+    """Canonical placement for the SPARSE subscriber table
+    (ops/csr_table.py): every array's leading axis is the shard-owner
+    axis (subscription owned by ``slot % shards``), sharded over 'tp' —
+    the CSR twin of the dense lane sharding, O(subscriptions / tp)
+    per device. Slot ids are stored globally, so per-shard compact
+    lists concatenate over 'tp' with no lane rebase."""
+    sh = NamedSharding(mesh, P("tp", None))
     return lambda _name, arr: jax.device_put(arr, sh)
 
 

@@ -21,6 +21,8 @@ declare("racetrack.events", COUNTER)
 declare("race.reports", COUNTER)
 declare("router.segment.hot.fill", "gauge")
 declare("router.compact.runs", COUNTER)
+declare("router.sparse.overflow.rows", COUNTER)
+declare("router.sparse.bytes", "gauge")
 declare("mesh.shard.fill", "gauge")
 declare("mesh.shard.rebalance", COUNTER)
 declare("mesh.shard.scatter.launches", COUNTER)
@@ -59,6 +61,8 @@ def good(m: M):
     m.inc("race.reports")
     m.gauge_set("router.segment.hot.fill", 3)
     m.inc("router.compact.runs")
+    m.inc("router.sparse.overflow.rows", 2)
+    m.gauge_set("router.sparse.bytes", 4096)
     m.gauge_set("mesh.shard.fill", 0.5)
     m.inc("mesh.shard.rebalance")
     m.inc("mesh.shard.scatter.launches", 2)
@@ -84,6 +88,8 @@ def bad(m: M):
     m.inc("olp.tripz")  # MN001: typo'd olp trip counter
     m.gauge_set("router.segment.hot.fil", 1)  # MN001: typo'd segment gauge
     m.inc("router.compact.runz")  # MN001: typo'd compaction counter
+    m.inc("router.sparse.overflow.rowz")  # MN001: typo'd sparse counter
+    m.gauge_set("router.sparse.bytez", 1)  # MN001: typo'd sparse gauge
     m.inc("racetrack.eventz")  # MN001: typo'd race-harness counter
     m.inc("race.reportz")  # MN001: typo'd race-report counter
     m.gauge_set("mesh.shard.fil", 1)  # MN001: typo'd shard gauge

@@ -142,6 +142,7 @@ def test_metric_checker_flags_undeclared_series():
         "router.sync.skiped", "ingest.device.idle.secondz",
         "retained.storm.fuzed", "olp.lag_mz", "olp.tripz",
         "router.segment.hot.fil", "router.compact.runz",
+        "router.sparse.overflow.rowz", "router.sparse.bytez",
         "racetrack.eventz", "race.reportz",
         "mesh.shard.fil", "mesh.shard.rebalanse",
         "mesh.shard.scatter.launchez",

@@ -253,12 +253,35 @@ def _harness(name: str):
             {"B": 16, "kslot": 8},
             {"B": 8, "kslot": 32},
         ]
+    elif name == "sparse_fanout_slots":
+        # the CSR gather-union stage exists only with a positive cap
+        configs = [
+            {"B": 8, "kslot": 8},
+            {"B": 16, "kslot": 8},
+            {"B": 8, "kslot": 32},
+        ]
+    elif name == "sparse_shape_route_step":
+        # the serving jit traced against a CSR subscriber table
+        configs = [
+            {"B": 8, "kslot": 8},
+            {"B": 16, "kslot": 8},
+        ]
     elif name in (
         "route_step", "shape_route_step", "fused_route_retained_step"
     ):
         configs = _configs_single()
-    elif name in ("dist_step", "dist_shape_step", "dist_fused_step"):
-        configs = _configs_mesh()
+    elif name in (
+        "dist_step", "dist_shape_step", "dist_fused_step",
+        "sparse_dist_shape_step",
+    ):
+        configs = (
+            [
+                {"B": 8, "kslot": 8, "dp": 2, "tp": 2},
+                {"B": 8, "kslot": 16, "dp": 2, "tp": 2},
+            ]
+            if name == "sparse_dist_shape_step"
+            else _configs_mesh()
+        )
     else:
         return None
 
@@ -317,6 +340,53 @@ def _harness(name: str):
                 return {"slots": slots, "count": count, "overflow": over}
 
             return fn, (bm,)
+        if name == "sparse_fanout_slots":
+            from emqx_tpu.models.router_model import SubscriberTable
+            from emqx_tpu.ops.csr_table import sparse_fanout_slots
+
+            st = SubscriberTable(mode="sparse")
+            for i in range(64):
+                st.add(i % 16, i)
+            csr = {
+                k: v.copy() for k, v in st.device_snapshot().items()
+            }
+            matched = np.full((B, 8), -1, np.int32)
+            matched[:, 0] = np.arange(B, dtype=np.int32) % 16
+
+            def sfn(csr, matched):
+                slots, count, over, live = sparse_fanout_slots(
+                    csr, matched, kslot=cfg["kslot"]
+                )
+                return {
+                    "slots": slots,
+                    "count": count,
+                    "overflow": over,
+                    "live": live,
+                }
+
+            return sfn, (csr, matched)
+        if name == "sparse_shape_route_step":
+            from emqx_tpu.models.router_model import shape_route_step
+
+            subs.set_mode("sparse")
+            subs.pack(index.num_filters_capacity)
+            csr = {
+                k: v.copy() for k, v in subs.device_snapshot().items()
+            }
+            with_nfa = index.residual_count > 0
+            fn = partial(
+                shape_route_step,
+                m_active=m_active,
+                with_nfa=with_nfa,
+                salt=salt,
+                kslot=cfg["kslot"],
+                **kw,
+            )
+            nfa = index.nfa.device_snapshot() if with_nfa else None
+            return fn, (
+                index.shapes.device_snapshot(), nfa, csr,
+                bytes_mat, lengths,
+            )
         if name == "route_step":
             from emqx_tpu.models.router_model import route_step
 
@@ -445,6 +515,32 @@ def _harness(name: str):
         with_nfa = index.residual_count > 0
         st = index.shapes.device_snapshot()
         nt = index.nfa.device_snapshot() if with_nfa else None
+        if name == "sparse_dist_shape_step":
+            subs.set_mode("sparse")
+            subs.set_shards(cfg["tp"])
+            subs.pack(index.num_filters_capacity)
+            csr = {
+                k: v.copy() for k, v in subs.device_snapshot().items()
+            }
+            fn = _dist_shape_step_fn(
+                mesh,
+                tuple(sorted(st)),
+                tuple(sorted(nt)) if nt is not None else None,
+                None,  # group_keys
+                0,  # share_strategy
+                m_active,
+                salt,
+                kw["max_levels"],
+                kw["frontier"],
+                kw["max_matches"],
+                kw["probes"],
+                cfg["kslot"],
+                False,  # donate
+                tuple(sorted(csr)),
+                0,  # kg (auto: 2 x kslot)
+            )
+            return fn, (st, nt, None, None, None, None, csr, bytes_mat,
+                        lengths)
         fn = _dist_shape_step_fn(
             mesh,
             tuple(sorted(st)),

@@ -31,10 +31,16 @@
 #                                           # docs/sessions.md)
 #   python bench.py --configs conn_scaling  # slab protocol plane:
 #                                           # 10k->1M simulated-client
-#                                           # scaling curve + codec
-#                                           # microbench + >=5x
-#                                           # redelivery-flood gate
-#                                           # (docs/protocol_plane.md)
+#                                           # scaling curve with the
+#                                           # distinct-topic axis
+#                                           # (4096->100k->1M topics;
+#                                           # CSR sub_table_bytes
+#                                           # measured per point,
+#                                           # deliveries drained to
+#                                           # quiescence) + codec
+#                                           # microbench
+#                                           # (docs/protocol_plane.md,
+#                                           # serving_pipeline.md)
 #   python bench.py --configs mesh_serving  # scale-out sharded serving:
 #                                           # the four-scenario broker
 #                                           # matrix through the mesh
