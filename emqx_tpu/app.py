@@ -215,6 +215,25 @@ class BrokerApp:
             # the 'tp' axis; setting the shard count up front avoids a
             # re-shard rebuild on the first prepare
             self.broker.subtab.set_shards(tp)
+        if c.semantic.enable:
+            # semantic routing plane (docs/semantic_routing.md):
+            # embedding-filter subscriptions fused into the serving
+            # launch; attached BEFORE the first dispatch builds the
+            # device engine so the engine binds the semantic table
+            from emqx_tpu.broker.semantic import SemanticRouting
+
+            self.broker.semantic = SemanticRouting(
+                dim=c.semantic.dim,
+                topk=c.semantic.topk,
+                threshold=c.semantic.threshold,
+                dtype=c.semantic.dtype,
+                shards=(
+                    c.router.mesh_shape[1]
+                    if self.broker.mesh is not None
+                    else 1
+                ),
+                metrics=self.broker.metrics,
+            )
         self.cm = ChannelManager(self.broker)
         # device-resident session store (broker/session_store.py): the
         # inflight/QoS state tables ride the same segment machinery as
@@ -354,6 +373,11 @@ class BrokerApp:
 
         self.rule_engine = RuleEngine(self.broker)
         self.rule_engine.attach(self.hooks)
+        if c.semantic.enable and c.semantic.rule_predicates:
+            # device-compiled WHERE predicates (rules/compile.py):
+            # eligible rules filter at match rate inside the serving
+            # launch instead of post-dispatch Python rate
+            self.rule_engine.attach_device()
         for spec in c.rules:
             outputs = []
             for o in spec.outputs or [None]:

@@ -57,7 +57,10 @@ def dispatch_pool():
 
 
 class Subscriber:
-    __slots__ = ("sid", "deliver", "opts", "client_id", "slot", "filter")
+    __slots__ = (
+        "sid", "deliver", "opts", "client_id", "slot", "filter",
+        "semantic",
+    )
 
     def __init__(self, sid: str, client_id: str, deliver: Deliverer, opts: pkt.SubOpts):
         self.sid = sid
@@ -66,6 +69,10 @@ class Subscriber:
         self.opts = opts
         self.slot = -1  # device bitmap slot (non-shared subs only)
         self.filter = ""  # the real (share-stripped) subscription filter
+        # embedding-filtered subscription (docs/semantic_routing.md):
+        # the slot lives in the SemanticTable, NOT the subscriber
+        # table — delivery requires topic AND similarity
+        self.semantic = False
 
 
 class PendingDispatch:
@@ -158,6 +165,15 @@ class Broker:
         # retry/expiry sweeps ride serving launches as the fused
         # session-ack stage (no extra launch or readback per batch)
         self.session_store = None
+        # SemanticRouting (broker/semantic.py), attached by the app
+        # when semantic.enable: embedding-filter subscriptions ride the
+        # serving launch as a fused similarity matmul; None = the
+        # semantic stage never traces (docs/semantic_routing.md)
+        self.semantic = None
+        # RuleEngine's device-predicate seam (rules/engine.py
+        # attach_device): compiled WHERE masks evaluate inside the
+        # serving launch and fire at settle; None = hook-path rules
+        self.rule_hook = None
 
     # -- subscribe side ---------------------------------------------------
     def subscribe(
@@ -167,10 +183,24 @@ class Broker:
         filter_: str,
         opts: pkt.SubOpts,
         deliver: Deliverer,
+        embedding=None,
+        sem_threshold=None,
     ) -> None:
+        """`embedding`/`sem_threshold`: an optional embedding filter
+        (docs/semantic_routing.md) — the subscription then delivers on
+        topic match AND similarity (its slot lives in the semantic
+        table, not the fan-out table). Ignored (plain subscribe) when
+        no SemanticRouting is attached or the filter is $shared."""
         group, real = T.parse_share(filter_)
         sub = Subscriber(sid, client_id, deliver, opts)
         sub.filter = real
+        if embedding is not None and (
+            self.semantic is None or group is not None
+        ):
+            # no semantic plane (or a $share filter, which resolves by
+            # group pick, not slots): degrade to a plain subscription
+            self.metrics.inc("semantic.subscribe.rejected")
+            embedding = None
         if group is not None:
             # one route ref per group (matched by delete on group-empty)
             if self.shared.subscribe(group, real, sub):
@@ -204,11 +234,34 @@ class Broker:
                 self._slot_subs[sub.slot] = sub
             else:
                 sub.slot = self._alloc_slot(sub)
-                if fid is None:
-                    # route already existed: resolve its id (one probe)
-                    fid = self.router.filter_id(real)
-                if fid is not None:
-                    self.subtab.add(fid, sub.slot)
+            if fid is None:
+                # route already existed: resolve its id (one probe)
+                fid = self.router.filter_id(real)
+            if embedding is not None:
+                # embedding-filtered subscription: the slot binds into
+                # the semantic table (topic scope = this filter's fid;
+                # '#' scopes degenerate to unscoped similarity-only)
+                sub.semantic = True
+                if prev is not None and not prev.semantic:
+                    if fid is not None:
+                        self.subtab.remove(fid, sub.slot)
+                th = (
+                    self.semantic.default_threshold
+                    if sem_threshold is None
+                    else float(sem_threshold)
+                )
+                self.semantic.attach(
+                    sid, sub.slot, embedding, th,
+                    fid=-1 if fid is None else fid, scope=real,
+                )
+            else:
+                if prev is not None and prev.semantic:
+                    # the re-subscribe dropped the embedding filter:
+                    # back to plain fan-out
+                    self.semantic.detach(sub.slot)
+                if prev is None or prev.semantic:
+                    if fid is not None:
+                        self.subtab.add(fid, sub.slot)
         self.metrics.gauge_set("subscriptions.count", self.subscription_count())
 
     def unsubscribe(self, sid: str, filter_: str) -> bool:
@@ -239,9 +292,12 @@ class Broker:
         sub = entry.pop(sid)
         self._plain_subs -= 1
         if sub.slot >= 0:
-            fid = self.router.filter_id(real)
-            if fid is not None:
-                self.subtab.remove(fid, sub.slot)
+            if sub.semantic and self.semantic is not None:
+                self.semantic.detach(sub.slot)
+            else:
+                fid = self.router.filter_id(real)
+                if fid is not None:
+                    self.subtab.remove(fid, sub.slot)
             self._free_slot(sub.slot)
         if not entry:
             del self._subs[real]
@@ -309,6 +365,14 @@ class Broker:
         # span head BEFORE the fold: the publish span covers hook time,
         # and the stamped context header rides into exhook sidecar calls
         sp = rec.publish_begin(msg) if rec is not None else None
+        rh = self.rule_hook
+        if rh is not None and rh.device_active():
+            ing0 = self.ingest
+            if ing0 is not None and ing0.running:
+                # device-compiled rule WHEREs defer to settle time: the
+                # batch evaluates them inside the serving launch (the
+                # hook-path evaluator skips marked messages)
+                msg.headers["_batch_rules"] = True
         msg = await self.hooks.arun_fold("message.publish", (), msg)
         if msg is None or msg.headers.get("allow_publish") is False:
             self.metrics.inc("messages.dropped")
@@ -354,8 +418,12 @@ class Broker:
 
     def publish_batch(self, msgs: Sequence[Message]) -> int:
         """Batch publish: one TPU kernel for all topics, then fan out."""
+        rh = self.rule_hook
+        defer = rh is not None and rh.device_active()
         msgs2: List[Message] = []
         for m in msgs:
+            if defer:
+                m.headers["_batch_rules"] = True
             m = self.hooks.run_fold("message.publish", (), m)
             if m is not None and m.headers.get("allow_publish") is not False:
                 msgs2.append(m)
@@ -391,7 +459,8 @@ class Broker:
                 # topic_key(): zero-copy ingest — slab-backed messages
                 # hand the tokenizer a TopicRef into the fabric read
                 # buffer instead of paying a str decode per row
-                [m.topic_key() for m in msgs], self._client_hashes(msgs)
+                [m.topic_key() for m in msgs], self._client_hashes(msgs),
+                embeds=self._embeds(msgs), rules=self._rule_batch(msgs),
             )
         except Exception:  # noqa: BLE001 — degrade, don't fail the batch
             if deg is None:
@@ -424,7 +493,13 @@ class Broker:
         trie match + host fan-out, remote fan-out still batched per
         destination node. This is both the small-batch branch and the
         degradation target when the device path is broken or its breaker
-        is open — it must never itself touch the device."""
+        is open — it must never itself touch the device. Deferred
+        device-compiled rules fire here through the vectorized HOST
+        evaluator (the degrade ladder's middle rung); semantic
+        recipients resolve per message inside `_route_dispatch` via the
+        host twin."""
+        if self.rule_hook is not None:
+            self.rule_hook.fire_settled(msgs)
         if forward and self.cluster is not None and len(msgs) > 1:
             # keep remote fan-out batched per destination node even
             # on the CPU branch (one forward_batch per node, not one
@@ -545,6 +620,8 @@ class Broker:
         # tokenizer gathers their bytes straight from the fabric slab
         topics = [m.topic_key() for m in msgs]
         hashes = self._client_hashes(msgs)
+        embeds = self._embeds(msgs)
+        rules = self._rule_batch(msgs)
         fut = loop.run_in_executor(
             dispatch_pool(),
             dev.route_prepared,
@@ -553,6 +630,8 @@ class Broker:
             hashes,
             storm,
             rider,
+            embeds,
+            rules,
         )
         if storm is not None:
             feed.attach(storm, fut)
@@ -591,6 +670,9 @@ class Broker:
                             topics,
                             hashes,
                             None,
+                            None,
+                            embeds,
+                            rules,
                         )
                         break
                     except Exception:  # noqa: BLE001 — keep retrying
@@ -650,10 +732,31 @@ class Broker:
                 share_strategy=self.shared.strategy,
                 mesh=self.mesh,
                 metrics=self.metrics,
+                semtab=(
+                    self.semantic.table
+                    if self.semantic is not None
+                    else None
+                ),
             )
             if self.mesh is not None and self.shard_label:
                 self._device.shard_label = self.shard_label
         return self._device
+
+    def _embeds(self, msgs):
+        """Per-message query embeddings for the fused semantic stage —
+        None (and zero per-row cost) when no semantic plane is live."""
+        sem = self.semantic
+        if sem is None or not len(sem.table):
+            return None
+        return sem.embed_batch(msgs)
+
+    def _rule_batch(self, msgs):
+        """Compiled rule programs + the batch's feature matrix for the
+        in-launch WHERE masks — None when no rule compiled."""
+        rh = self.rule_hook
+        if rh is None:
+            return None
+        return rh.device_progs(msgs)
 
     def _client_hashes(self, msgs):
         """Publisher-id hashes for the device $share pick — skipped
@@ -680,6 +783,34 @@ class Broker:
         matched, flags = results.matched, results.flags
         picks = results.picks
         r = self.router
+        # deferred device-compiled rules fire FIRST (reference order:
+        # rules run in the publish fold, before dispatch) — with the
+        # in-launch masks when the batch carried them, else the host
+        # evaluator ladder (rules/engine.fire_settled)
+        if self.rule_hook is not None:
+            self.rule_hook.fire_settled(msgs, masks=results.rule_masks)
+        # semantic plane live for this batch: winner slots are already
+        # unioned into the compact rows; rows only need the host-side
+        # dedup net (mesh shards can union the same slot twice) and the
+        # flight-recorder series
+        sem = results.sem_count is not None
+        if sem:
+            hits = int(np.asarray(results.sem_count).sum())
+            if hits:
+                self.metrics.inc("semantic.hits", hits)
+            topk = (
+                self.semantic.table.topk
+                if self.semantic is not None
+                else 0
+            )
+            if topk:
+                trunc = int(
+                    np.count_nonzero(
+                        np.asarray(results.sem_count) > topk
+                    )
+                )
+                if trunc:
+                    self.metrics.inc("semantic.topk.truncated", trunc)
         fwd = (
             self.cluster.forward_batch_remote(msgs)
             if forward and self.cluster is not None
@@ -724,7 +855,10 @@ class Broker:
                     bits, slots = None, slots_ll[i]
                 elif compact:
                     bits = results.dense_rows[results.dense_index[i]]
-                    slots = None
+                    # semantic winners live in the device slot row (the
+                    # dense fallback covers only the TOPIC fan-out):
+                    # union them back in — dup topic slots dedup below
+                    slots = slots_ll[i] if sem else None
                 else:
                     bits, slots = results.bitmaps[i], None
                 # matched rows are SPARSE (-1 holes between engines)
@@ -736,7 +870,7 @@ class Broker:
                 n = self._dispatch_row(
                     m, bits, fids, msg_picks, touched_gids,
                     slots=slots, match_memo=match_memo, fid_memo=fid_memo,
-                    stats=fanouts,
+                    stats=fanouts, dedup=sem,
                 )
             if t_ns:
                 rec.deliver(
@@ -769,6 +903,7 @@ class Broker:
         touched_gids: Optional[set] = None, *, slots=None,
         match_memo: Optional[Dict] = None,
         fid_memo: Optional[Dict] = None, stats: Optional[List] = None,
+        dedup: bool = False,
     ) -> int:
         """Deliver one routed message from its device outputs: subscriber
         slot list (compact path) or bitmap (dense path) -> plain subs;
@@ -779,7 +914,11 @@ class Broker:
         `slots` may be a plain int list (batch callers pre-.tolist() the
         whole slot matrix; -1 pads are skipped here) — with `stats`
         given, the fan-out lands in it and the per-row metric calls are
-        batched by the caller instead."""
+        batched by the caller instead. `bits` AND `slots` together =
+        the semantic overflow contract: the dense row carries the topic
+        fan-out, the slot list carries the device row's semantic
+        winners, and `dedup` guards double delivery (also set for mesh
+        batches, where two 'tp' shards can emit the same slot)."""
         if stats is None:
             self.metrics.inc("messages.received")
         if match_memo is None:
@@ -788,25 +927,38 @@ class Broker:
             fid_memo = {}
         n = 0
         topic = msg.topic
-        if slots is None:
+        if bits is not None:
             # dense decode. ascontiguousarray: readback rows can be
             # strided (axon backend / fancy-indexed fallback rows) and
             # ndarray.view raises on non-contiguous buffers
             if not bits.flags.c_contiguous:
                 bits = np.ascontiguousarray(bits)
-            slots = np.nonzero(
+            dense = np.nonzero(
                 np.unpackbits(bits.view(np.uint8), bitorder="little")
             )[0].tolist()
+            if slots is None:
+                slots = dense
+            else:
+                # dense topic fan-out + the device row's semantic
+                # winners (overflow rows on the semantic plane)
+                if not isinstance(slots, list):
+                    slots = np.asarray(slots).tolist()
+                slots = dense + slots
         elif not isinstance(slots, list):
             slots = np.asarray(slots).tolist()
         slot_subs = self._slot_subs
         nsubs = len(slot_subs)
+        seen = set() if dedup else None
         for slot in slots:
             # -1 pads (compact rows) and slots past the local table
             # (another node's lanes) skip here — plain int compares,
             # no per-row numpy filter pass
             if slot < 0 or slot >= nsubs:
                 continue
+            if seen is not None:
+                if slot in seen:
+                    continue
+                seen.add(slot)
             sub = slot_subs[slot]
             if sub is None:
                 continue
@@ -920,6 +1072,11 @@ class Broker:
 
     def _route_dispatch(self, msg: Message, filters: List[str]) -> int:
         self.metrics.inc("messages.received")
+        if msg.headers.get("_batch_rules") and self.rule_hook is not None:
+            # a deferred-rule message settling OUTSIDE the batch paths
+            # (sync publish, device-flagged fallback rows whose batch
+            # carried no masks): fire through the host ladder
+            self.rule_hook.fire_settled([msg])
         n = 0
         for f in filters:
             # one matched filter may carry plain subscribers AND shared groups
@@ -928,8 +1085,27 @@ class Broker:
                 for sub in list(entry.values()):
                     if sub.opts.no_local and sub.client_id == msg.from_client:
                         continue
+                    if sub.semantic:
+                        # embedding-filtered: delivery needs similarity
+                        # too — resolved by the host twin below
+                        continue
                     n += self._deliver_one(sub, msg)
             n += self.shared.dispatch_groups(f, msg)
+        sem = self.semantic
+        if sem is not None and len(sem.table):
+            # the authoritative host twin (CPU fallback / per-message
+            # path): topic-scope AND similarity, global top-k
+            for slot in sem.host_route([msg])[0]:
+                sub = (
+                    self._slot_subs[slot]
+                    if 0 <= slot < len(self._slot_subs)
+                    else None
+                )
+                if sub is None:
+                    continue
+                if sub.opts.no_local and sub.client_id == msg.from_client:
+                    continue
+                n += self._deliver_one(sub, msg)
         self.metrics.observe("dispatch.fanout", n)
         if n:
             self.metrics.inc("messages.delivered", n)

@@ -612,6 +612,17 @@ class Channel:
         filters = await self.hooks.arun_fold(
             "client.subscribe", (self.client_info(),), p.filters
         )
+        # embedding filter riding the SUBSCRIBE user properties
+        # (docs/semantic_routing.md): packet-level, applies to every
+        # filter in the packet; malformed embeddings degrade to a plain
+        # subscribe (counted) rather than failing the packet
+        sem_parsed = None
+        sem = getattr(self.broker, "semantic", None)
+        if sem is not None and p.properties:
+            try:
+                sem_parsed = sem.parse_subscribe(p.properties)
+            except (ValueError, TypeError):
+                self.broker.metrics.inc("semantic.subscribe.rejected")
         rcs: List[int] = []
         pending: List[tuple] = []  # (rcs index, router-confirm future)
         for f, opts in filters:
@@ -661,6 +672,9 @@ class Channel:
                     "raw_sink": self.sink,
                     "raw_version": self.version,
                 }
+            if sem_parsed is not None:
+                sub_kw["embedding"] = sem_parsed[0]
+                sub_kw["sem_threshold"] = sem_parsed[1]
             r = self.broker.subscribe(
                 self.client_id, self.client_id, mf, opts,
                 self._make_deliverer(opts), **sub_kw,

@@ -608,3 +608,41 @@ declare("trace.spans.sampled", COUNTER,
 declare("trace.spans.dropped", COUNTER,
         "spans lost unfinished (open-registry overflow or a settle that "
         "found no open span)")
+
+# -- semantic routing plane (docs/semantic_routing.md) ---------------------
+declare("semantic.filters", GAUGE,
+        "live embedding-filter subscriptions in the semantic table")
+declare("semantic.hits", COUNTER,
+        "qualifying semantic matches on the fused device path "
+        "(pre-top-k; the uncapped sem_count sum per batch)")
+declare("semantic.topk.truncated", COUNTER,
+        "routed rows whose qualifying set exceeded topk (winners "
+        "delivered, the tail dropped BY DESIGN)")
+declare("semantic.host.batches", COUNTER,
+        "batches/messages routed through the host twin (CPU fallback, "
+        "per-message paths) instead of the fused kernel")
+declare("semantic.host.matches", COUNTER,
+        "semantic recipients resolved by the host twin")
+declare("semantic.subscribe.rejected", COUNTER,
+        "embedding filters ignored at subscribe (no semantic plane "
+        "attached, or a $share filter)")
+declare("semantic.embed.rejected", COUNTER,
+        "per-message embeddings dropped as malformed (bad base64/JSON "
+        "or a dimension mismatch)")
+
+# -- rule engine (rules/engine.py; device predicates rules/compile.py) -----
+declare("rules.matched", COUNTER,
+        "rule evaluations whose FROM clause selected the event")
+declare("rules.passed", COUNTER,
+        "rule evaluations that passed WHERE and produced output rows")
+declare("rules.failed", COUNTER,
+        "rule evaluations that raised during SQL evaluation")
+declare("rules.dropped", COUNTER,
+        "rule evaluations dropped by WHERE (or an empty FOREACH) — on "
+        "the device path these rows never built a host context")
+declare("rules.device.batches", COUNTER,
+        "settled batches whose compiled WHERE masks came from the "
+        "serving launch (device rate)")
+declare("rules.host.batches", COUNTER,
+        "settled batches that fell back to the vectorized numpy WHERE "
+        "evaluator (degraded/CPU batches, rule-set churn in flight)")

@@ -148,6 +148,33 @@ class RouterConfig:
 
 
 @dataclass
+class SemanticConfig:
+    """Semantic routing plane (docs/semantic_routing.md): embedding-
+    filter subscriptions answered by a similarity matmul fused into the
+    serving launch, plus device-compiled rule WHERE predicates. The
+    whole plane is one opt-in; `rule_predicates` can switch the rule
+    half off independently."""
+
+    enable: bool = False
+    # embedding dimensionality; every filter and message embedding
+    # must match it exactly
+    dim: int = 64
+    # per-message semantic fan-out bound: route to the topk most
+    # similar qualifying subscribers (per 'tp' shard on a mesh)
+    topk: int = 16
+    # default cosine-similarity threshold for filters that don't pin
+    # their own via the semantic-threshold user property
+    threshold: float = 0.75
+    # device storage dtype for the embedding matrix: float32, or
+    # bfloat16 to halve HBM + double MXU throughput (quantized at
+    # upload; host keeps f32)
+    dtype: str = "float32"
+    # compile eligible rule-engine WHERE clauses to in-launch masks
+    # (rules/compile.py); off = rules stay on the host hook path
+    rule_predicates: bool = True
+
+
+@dataclass
 class RetainerConfig:
     enable: bool = True
     max_retained_messages: int = 1_000_000
@@ -548,6 +575,7 @@ class AppConfig:
     mqtt: MqttCaps = field(default_factory=MqttCaps)
     session: SessionConfig = field(default_factory=SessionConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    semantic: SemanticConfig = field(default_factory=SemanticConfig)
     retainer: RetainerConfig = field(default_factory=RetainerConfig)
     delayed: DelayedConfig = field(default_factory=DelayedConfig)
     rewrite: List[RewriteRuleSpec] = field(default_factory=list)
@@ -749,6 +777,21 @@ def _validate(cfg: AppConfig) -> None:
         )
     if cfg.retainer.storm_window_us < 0:
         raise ConfigError("retainer.storm_window_us must be >= 0")
+    if not 1 <= cfg.semantic.dim <= 4096:
+        raise ConfigError("semantic.dim must be in 1..4096")
+    if not 1 <= cfg.semantic.topk <= 1024:
+        raise ConfigError("semantic.topk must be in 1..1024")
+    if not -1.0 <= cfg.semantic.threshold <= 1.0:
+        raise ConfigError(
+            "semantic.threshold must be in [-1, 1] (cosine similarity)"
+        )
+    if cfg.semantic.dtype not in ("float32", "bfloat16"):
+        raise ConfigError("semantic.dtype must be float32|bfloat16")
+    if cfg.semantic.enable and not cfg.router.fanout_compact:
+        raise ConfigError(
+            "semantic.enable requires router.fanout_compact (semantic "
+            "winners union into the compact slot readback)"
+        )
     if cfg.session.store_capacity < 64:
         raise ConfigError("session.store_capacity must be >= 64")
     if cfg.session.store_sweep_slots < 16:

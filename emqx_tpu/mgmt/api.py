@@ -75,6 +75,14 @@ ROUTES = [
     ("post", "/api/v5/mqtt/topic_metrics", "topic_metrics_add", "Track a topic", "topic_metrics"),
     ("delete", "/api/v5/mqtt/topic_metrics/{topic:.+}", "topic_metrics_del", "Untrack a topic", "topic_metrics"),
     ("get", "/api/v5/prometheus/stats", "prometheus_stats", "Prometheus exposition", "metrics"),
+    ("get", "/api/v5/semantic/filters", "semantic_list",
+     "List embedding-filter subscriptions (docs/semantic_routing.md)",
+     "semantic"),
+    ("post", "/api/v5/semantic/filters", "semantic_attach",
+     "Attach an embedding filter to an existing subscription",
+     "semantic"),
+    ("delete", "/api/v5/semantic/filters", "semantic_detach",
+     "Detach embedding filters (?clientid=&topic_filter=)", "semantic"),
     ("get", "/api/v5/faults", "faults_list",
      "Armed fault-injection rules + degradation breaker states "
      "(docs/robustness.md)", "faults"),
@@ -414,6 +422,26 @@ class MgmtApi:
                 "rebalance_events": m.get("mesh.shard.rebalance"),
                 "reroutes": m.get("mesh.shard.reroutes"),
             },
+            "semantic": (
+                {
+                    **self.broker.semantic.status(),
+                    "hits": m.get("semantic.hits"),
+                    "topk_truncated": m.get("semantic.topk.truncated"),
+                    "host_batches": m.get("semantic.host.batches"),
+                    "host_matches": m.get("semantic.host.matches"),
+                    "embed_rejected": m.get("semantic.embed.rejected"),
+                }
+                if self.broker.semantic is not None
+                else None
+            ),
+            "rules": {
+                "matched": m.get("rules.matched"),
+                "passed": m.get("rules.passed"),
+                "failed": m.get("rules.failed"),
+                "dropped": m.get("rules.dropped"),
+                "device_batches": m.get("rules.device.batches"),
+                "host_batches": m.get("rules.host.batches"),
+            },
             "fabric": {
                 "slab_pub_frames": m.get("fabric.slab.pub.frames"),
                 "slab_pub_records": m.get("fabric.slab.pub.records"),
@@ -610,6 +638,7 @@ class MgmtApi:
                 rule_id, sql, outputs, str(body.get("description", ""))
             )
             rule.enabled = bool(body.get("enable", True))
+            eng.refresh_device()
         except (json.JSONDecodeError, KeyError, ValueError, TypeError, SqlParseError) as e:
             # ValueError also covers duplicate rule ids (create_rule)
             return web.json_response(
@@ -727,6 +756,99 @@ class MgmtApi:
     async def alarms_clear(self, request):
         n = self.app.alarms.delete_all_deactivated()
         return web.json_response({"cleared": n}, status=200)
+
+    # -- semantic routing plane (broker/semantic.py,
+    #    docs/semantic_routing.md) -----------------------------------------
+    async def semantic_list(self, request):
+        sem = self.broker.semantic
+        if sem is None:
+            return web.json_response(
+                {"code": "NOT_ENABLED",
+                 "message": "semantic.enable is off"}, status=404,
+            )
+        return web.json_response(
+            {"status": sem.status(), "data": sem.entries()}
+        )
+
+    async def semantic_attach(self, request):
+        """Attach an embedding filter to an EXISTING subscription:
+        {clientid, topic_filter, embedding (JSON list | base64 f32le),
+        threshold?}. The subscription then delivers on topic match AND
+        similarity; re-POST replaces the embedding in place."""
+        sem = self.broker.semantic
+        if sem is None:
+            return web.json_response(
+                {"code": "NOT_ENABLED",
+                 "message": "semantic.enable is off"}, status=404,
+            )
+        try:
+            body = await request.json()
+            cid = str(body["clientid"])
+            tf = str(body["topic_filter"])
+            from emqx_tpu.broker.semantic import decode_embedding
+
+            vec = decode_embedding(body["embedding"], sem.table.dim)
+            th = float(body.get("threshold", sem.default_threshold))
+        except (json.JSONDecodeError, KeyError, ValueError,
+                TypeError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        b = self.broker
+        entry = b._subs.get(tf) or {}
+        sub = entry.get(cid)
+        if sub is None or sub.slot < 0:
+            return web.json_response(
+                {"code": "NOT_FOUND",
+                 "message": f"no subscription {tf!r} for {cid!r}"},
+                status=404,
+            )
+        fid = b.router.filter_id(tf)
+        if not sub.semantic and fid is not None:
+            # the slot migrates from the fan-out table to the semantic
+            # table — same transition the SUBSCRIBE path performs
+            b.subtab.remove(fid, sub.slot)
+        sub.semantic = True
+        sem.attach(
+            cid, sub.slot, vec, th,
+            fid=-1 if fid is None else fid, scope=tf,
+        )
+        return web.json_response(
+            {"slot": sub.slot, "threshold": th}, status=201
+        )
+
+    async def semantic_detach(self, request):
+        """Detach filters; ?clientid= narrows to one client,
+        &topic_filter= to one subscription (which reverts to plain
+        fan-out delivery)."""
+        sem = self.broker.semantic
+        if sem is None:
+            return web.json_response(
+                {"code": "NOT_ENABLED"}, status=404
+            )
+        cid = request.query.get("clientid")
+        tf = request.query.get("topic_filter")
+        b = self.broker
+        n = 0
+        for item in list(sem.entries()):
+            if cid is not None and item["clientid"] != cid:
+                continue
+            if tf is not None and item["topic_filter"] != tf:
+                continue
+            slot = item["slot"]
+            sem.detach(slot)
+            sub = (
+                b._slot_subs[slot]
+                if 0 <= slot < len(b._slot_subs)
+                else None
+            )
+            if sub is not None and sub.semantic:
+                sub.semantic = False
+                fid = b.router.filter_id(sub.filter)
+                if fid is not None:
+                    b.subtab.add(fid, slot)
+            n += 1
+        return web.json_response({"detached": n})
 
     # -- fault injection + degradation (observe/faults.py,
     #    broker/degrade.py; docs/robustness.md) ----------------------------

@@ -33,6 +33,11 @@ from emqx_tpu.ops.contract import device_contract
 from emqx_tpu.ops.csr_table import CsrSegmentOwner, CsrTable, sparse_fanout_slots
 from emqx_tpu.ops.matcher import batch_match_bytes_impl
 from emqx_tpu.ops.nfa import _next_pow2
+from emqx_tpu.ops.semantic_table import (
+    SemanticSegmentOwner,
+    semantic_match_step,
+    union_semantic_slots,
+)
 
 
 def popcount32(x):
@@ -226,6 +231,10 @@ def shape_route_step_impl(
     client_hash=None,
     topic_hash=None,
     rand=None,
+    sem_tables=None,
+    q_vecs=None,
+    rule_feats=None,
+    rule_valid=None,
     *,
     m_active: int,
     with_nfa: bool,
@@ -240,6 +249,8 @@ def shape_route_step_impl(
     dp_axis: Optional[str] = None,
     kslot: int = 0,
     kg: int = 0,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
 ):
     """The serving-path kernel: shape index + (residual NFA) + fanout.
 
@@ -260,6 +271,21 @@ def shape_route_step_impl(
     O(subscriptions) slot lists and emits the same compact contract
     directly (no dense bitmaps exist; overflow rows rebuild on host).
     ``kg`` is the CSR gather-window bound (0 = 2 * kslot).
+
+    ``sem_tables`` set (ops/semantic_table.py array dict) engages the
+    SEMANTIC routing plane: `semantic_match_step` runs one batched
+    similarity matmul over ``q_vecs`` [B, D] in the SAME program, and
+    its top-``sem_topk`` winner slots union into the compact slot rows
+    before readback (`union_semantic_slots` — the topic part stays
+    byte-identical, so slot_count/overflow keep topic-only semantics).
+    Requires the compact stage (kslot > 0). The qualifying count rides
+    the readback as ``sem_count`` [B].
+
+    ``rule_progs`` (a static tuple of compiled WHERE programs,
+    rules/compile.py) evaluates every compiled rule over the
+    ``rule_feats``/``rule_valid`` [B, F] feature batch inside this
+    launch; the bool masks ride readback as ``rule_masks`` [R, B].
+    Defaults leave the trace bit-identical (golden jaxprs unchanged).
     """
     import jax.numpy as jnp
 
@@ -339,6 +365,23 @@ def shape_route_step_impl(
         out["slots"] = slots
         out["slot_count"] = scount
         out["overflow"] = sovf
+    if sem_tables is not None:
+        if "slots" not in out:
+            raise ValueError(
+                "semantic routing requires the compact fan-out stage "
+                "(kslot > 0 and a subscriber table)"
+            )
+        sem_slots, sem_count = semantic_match_step(
+            sem_tables, q_vecs, matched, sem_topk
+        )
+        out["slots"] = union_semantic_slots(out["slots"], sem_slots)
+        out["sem_count"] = sem_count
+    if rule_progs:
+        from emqx_tpu.rules.compile import eval_rule_masks
+
+        out["rule_masks"] = eval_rule_masks(
+            rule_progs, rule_feats, rule_valid
+        )
     return out
 
 
@@ -365,6 +408,8 @@ shape_route_step = device_contract(
         "dp_axis",
         "kslot",
         "kg",
+        "sem_topk",
+        "rule_progs",
     ),
 )(shape_route_step_impl))
 
@@ -393,6 +438,8 @@ shape_route_step_donated = partial(
         "dp_axis",
         "kslot",
         "kg",
+        "sem_topk",
+        "rule_progs",
     ),
     donate_argnames=("lengths",),
 )(shape_route_step_impl)
@@ -426,6 +473,10 @@ def session_route_step_impl(
     client_hash=None,
     topic_hash=None,
     rand=None,
+    sem_tables=None,
+    q_vecs=None,
+    rule_feats=None,
+    rule_valid=None,
     *,
     m_active: int,
     with_nfa: bool,
@@ -439,6 +490,8 @@ def session_route_step_impl(
     share_strategy: int = 0,
     kslot: int = 0,
     kg: int = 0,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
     sweep_k: int = 0,
 ):
     """Publish routing + the session-ack stage as ONE device program.
@@ -465,6 +518,10 @@ def session_route_step_impl(
         client_hash,
         topic_hash,
         rand,
+        sem_tables,
+        q_vecs,
+        rule_feats,
+        rule_valid,
         m_active=m_active,
         with_nfa=with_nfa,
         salt=salt,
@@ -477,6 +534,8 @@ def session_route_step_impl(
         share_strategy=share_strategy,
         kslot=kslot,
         kg=kg,
+        sem_topk=sem_topk,
+        rule_progs=rule_progs,
     )
     out["session"] = session_ack_impl(
         sess_tables, sess_idxs, sess_vals, sess_clock, sweep_k=sweep_k
@@ -503,6 +562,8 @@ session_route_step = partial(
         "share_strategy",
         "kslot",
         "kg",
+        "sem_topk",
+        "rule_progs",
         "sweep_k",
     ),
 )(session_route_step_impl)
@@ -521,6 +582,10 @@ def fused_route_retained_step_impl(
     client_hash=None,
     topic_hash=None,
     rand=None,
+    sem_tables=None,
+    q_vecs=None,
+    rule_feats=None,
+    rule_valid=None,
     *,
     m_active: int,
     with_nfa: bool,
@@ -539,6 +604,8 @@ def fused_route_retained_step_impl(
     share_strategy: int = 0,
     kslot: int = 0,
     kg: int = 0,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
 ):
     """Publish routing + retained-replay match as ONE device program.
 
@@ -563,6 +630,10 @@ def fused_route_retained_step_impl(
         client_hash,
         topic_hash,
         rand,
+        sem_tables,
+        q_vecs,
+        rule_feats,
+        rule_valid,
         m_active=m_active,
         with_nfa=with_nfa,
         salt=salt,
@@ -575,6 +646,8 @@ def fused_route_retained_step_impl(
         share_strategy=share_strategy,
         kslot=kslot,
         kg=kg,
+        sem_topk=sem_topk,
+        rule_progs=rule_progs,
     )
     rl = jnp.sum((ret_bytes != 0).astype(jnp.int32), axis=1)
     rout = shape_route_step_impl(
@@ -617,6 +690,8 @@ fused_route_retained_step = device_contract(
         "share_strategy",
         "kslot",
         "kg",
+        "sem_topk",
+        "rule_progs",
         "ret_m_active",
         "ret_with_nfa",
         "ret_salt",
@@ -1279,6 +1354,13 @@ class RouteResult(NamedTuple):
     # `broker.session_store.SessionStepOut` — updated device mirror
     # (stays on device) + the O(sweep_k) sweep lists
     session: Optional[tuple] = None
+    # semantic routing plane (docs/semantic_routing.md): qualifying
+    # embedding-filter hits per row (UNCAPPED; winners are already
+    # unioned into `slots`, so dispatch needs no extra decode)
+    sem_count: Optional[np.ndarray] = None
+    # compiled rule-predicate masks [R, B] bool, in DeviceRuleFilter
+    # order (rules/compile.py) — consumed by the settle-time rule fire
+    rule_masks: Optional[np.ndarray] = None
 
 
 class _LazyDenseRows:
@@ -1312,6 +1394,11 @@ class _LazyDenseRows:
         return row
 
 
+# prepared-args tuple layout (DeviceRouter._device_args_dirty): the
+# clean-path Kslot recheck swaps one element in place, so the position
+# is a named constant instead of a fragile negative index
+_ARGS_KSLOT = 7
+
 # floor for the auto-sized compact-slot cap: below this the slot list is
 # cheaper than the program bookkeeping either way, and a tiny cap would
 # overflow constantly while the fanout histogram warms up
@@ -1339,6 +1426,7 @@ class DeviceRouter:
         share_strategy: str = "round_robin",
         mesh=None,
         metrics=None,
+        semtab=None,
     ):
         """`mesh`: a jax.sharding.Mesh with ("dp", "tp") axes — when set,
         batches execute the SPMD dist_shape_route_step (tables replicated,
@@ -1357,6 +1445,10 @@ class DeviceRouter:
         self.index = index
         self.subtab = subtab  # None => match-only (no fan-out bitmaps)
         self.grouptab = grouptab  # None => host-side $share pick
+        # SemanticTable (ops/semantic_table.py): embedding-filter
+        # subscriptions riding the same launch; None / empty = the
+        # semantic stage never traces (docs/semantic_routing.md)
+        self.semtab = semtab
         self.mesh = mesh
         # hot-path flight recorder (router.* series); None = don't record
         self.metrics = metrics
@@ -1409,6 +1501,17 @@ class DeviceRouter:
             subtab is not None and getattr(subtab, "sparse", False)
         )
         self._bits_sync = self._mk_bits_sync(self._bits_sparse)
+        # semantic-table mirror: entries shard their leading slot-owner
+        # axis over 'tp' (slot % shards — the CSR regime, so per-shard
+        # semantic hits are global slot ids; parallel/mesh.py)
+        sem_place = None
+        if mesh is not None and semtab is not None:
+            from emqx_tpu.parallel.mesh import semantic_placement
+
+            sem_place = semantic_placement(mesh)
+        self._sem_sync = DeviceSegmentManager(
+            placement=sem_place, free_retired=True, name="semantic"
+        )
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
         # state (it runs on executor threads)
@@ -1452,7 +1555,8 @@ class DeviceRouter:
     # would otherwise be the only per-batch work left on the clean path
     KSLOT_RECHECK = 64
 
-    def _fanout_kslot(self, width_words: int, sparse: bool = False) -> int:
+    def _fanout_kslot(self, width_words: int, sparse: bool = False,
+                      semantic: bool = False) -> int:
         """Static Kslot for the next batch; 0 = compaction off.
 
         An explicit ``config.fanout_slots`` pins the cap (pow2-padded to
@@ -1466,9 +1570,14 @@ class DeviceRouter:
         ``sparse``: a CSR table HAS no dense readback to fall back to —
         compaction is mandatory there, so the cap never returns 0 (and
         the fanout_compact knob / width win-condition don't apply).
+        ``semantic``: the semantic union rides the compact slot rows
+        (docs/semantic_routing.md), so an active semantic table makes
+        the cap mandatory the same way.
         """
         cfg = self.config
-        if self.subtab is None or (not sparse and not cfg.fanout_compact):
+        if self.subtab is None or (
+            not sparse and not semantic and not cfg.fanout_compact
+        ):
             return 0
         if cfg.fanout_slots > 0:
             return _next_pow2(cfg.fanout_slots)
@@ -1481,7 +1590,7 @@ class DeviceRouter:
                 want = max(want, 2 * max(1, int(h.p99)))
         k = max(self._kslot, _next_pow2(want))
         self._kslot = k
-        if sparse:
+        if sparse or semantic:
             return k
         if self.mesh is not None:
             # per-shard compaction: each tp shard emits its own kslot-wide
@@ -1498,6 +1607,7 @@ class DeviceRouter:
             self.index.version,
             self.subtab.version if self.subtab is not None else -1,
             self.grouptab.version if self.grouptab is not None else -1,
+            self.semtab.version if self.semtab is not None else -1,
         )
 
     def _device_args(self):
@@ -1527,19 +1637,26 @@ class DeviceRouter:
             # fanout p99 without any table churn); growth only swaps the
             # cached tuple's kslot element — everything else is current.
             self._clean_streak += 1
+            sem_on = self.semtab is not None and len(self.semtab) > 0
             if (
                 self._clean_streak % self.KSLOT_RECHECK == 0
                 and self.subtab is not None
-                and (self.config.fanout_compact or self.subtab.sparse)
+                and (
+                    self.config.fanout_compact
+                    or self.subtab.sparse
+                    or sem_on
+                )
             ):
                 kslot = self._fanout_kslot(
-                    self.subtab.width_words, sparse=self.subtab.sparse
+                    self.subtab.width_words,
+                    sparse=self.subtab.sparse,
+                    semantic=sem_on,
                 )
-                if kslot != self._prep_args[-2]:
+                if kslot != self._prep_args[_ARGS_KSLOT]:
                     self._prep_args = (
-                        self._prep_args[:-2]
+                        self._prep_args[:_ARGS_KSLOT]
                         + (kslot,)
-                        + self._prep_args[-1:]
+                        + self._prep_args[_ARGS_KSLOT + 1 :]
                     )
             if self.metrics is not None:
                 self.metrics.inc("router.sync.skipped")
@@ -1600,6 +1717,7 @@ class DeviceRouter:
     def _device_args_dirty(self):
         idx = self.index
         kg = 0
+        sem_on = self.semtab is not None and len(self.semtab) > 0
         if self.subtab is not None:
             sparse = self.subtab.sparse
             if sparse != self._bits_sparse:
@@ -1628,7 +1746,7 @@ class DeviceRouter:
             snap = self._bits_sync.sync(self.subtab)
             bits = snap if sparse else snap["sub_bitmaps"]
             kslot = self._fanout_kslot(
-                self.subtab.width_words, sparse=sparse
+                self.subtab.width_words, sparse=sparse, semantic=sem_on
             )
             if sparse:
                 kg = getattr(self.config, "sparse_gather", 0)
@@ -1644,6 +1762,14 @@ class DeviceRouter:
             group_tables = self._group_sync.sync(self.grouptab)
         else:
             group_tables = None
+        if sem_on:
+            # the semantic mirror rides the same sync machinery: full
+            # upload on epoch bumps, op-logged scatter deltas otherwise
+            sem_tables = self._sem_sync.sync(self.semtab)
+            sem_topk = self.semtab.topk
+        else:
+            sem_tables = None
+            sem_topk = 0
         return (
             shape_tables,
             nfa_tables,
@@ -1654,6 +1780,8 @@ class DeviceRouter:
             group_tables,
             kslot,
             kg,
+            sem_tables,
+            sem_topk,
         )
 
     # -- segment maintenance (ops/segments.SegmentCompactor) --------------
@@ -1719,6 +1847,21 @@ class DeviceRouter:
                     placement=self._bitmap_placement,
                 )
             )
+        if self.semtab is not None:
+            sem_place = None
+            if self.mesh is not None:
+                from emqx_tpu.parallel.mesh import semantic_placement
+
+                sem_place = semantic_placement(self.mesh)
+            owners.append(
+                SemanticSegmentOwner(
+                    self.semtab,
+                    self._sem_sync,
+                    placement=sem_place,
+                    hot_entries=hot_entries,
+                    tombstone_frac=tombstone_frac,
+                )
+            )
         return owners
 
     def prepare(self):
@@ -1736,14 +1879,16 @@ class DeviceRouter:
             )
         return args
 
-    def route(self, topics, client_hashes=None):
+    def route(self, topics, client_hashes=None, embeds=None, rules=None):
         """Batch route: returns a host-side `RouteResult` (all numpy)."""
         return self.route_prepared(
-            self._device_args(), topics, client_hashes
+            self._device_args(), topics, client_hashes,
+            embeds=embeds, rules=rules,
         )
 
     def route_prepared(self, args, topics, client_hashes=None,
-                       retained=None, session=None):
+                       retained=None, session=None, embeds=None,
+                       rules=None):
         """Kernel launch + readback against a `prepare()` snapshot; touches
         no mutable host state, so it may run in an executor thread while
         the event loop keeps serving connections (the jit compile on a new
@@ -1752,6 +1897,14 @@ class DeviceRouter:
         `client_hashes` ([B] uint32, stable_hash of each publisher id)
         feeds the device $share pick; required only when a group table is
         loaded and the strategy is hash_clientid.
+
+        `embeds` ([B, D] f32 per-message embeddings) feeds the fused
+        semantic-match stage when the prepared args carry a semantic
+        table (rows without an embedding ride a zero vector — matching
+        nothing at any positive threshold). `rules` is an optional
+        ``(progs, feats, valid)`` triple from rules/compile.
+        DeviceRuleFilter: the compiled WHERE masks evaluate inside this
+        same launch and land in `RouteResult.rule_masks`.
 
         `retained`: an optional prepared replay storm
         (DeviceRetainedIndex.prepare_storm) to fuse into this launch —
@@ -1767,7 +1920,8 @@ class DeviceRouter:
 
         t0 = time.perf_counter()
         out = self._route_prepared(
-            args, topics, client_hashes, retained, session
+            args, topics, client_hashes, retained, session, embeds,
+            rules,
         )
         if self.metrics is not None:
             # Histogram.observe is lock-safe: this runs on executor threads
@@ -1793,7 +1947,8 @@ class DeviceRouter:
         return out
 
     def _route_prepared(self, args, topics, client_hashes=None,
-                        retained=None, session=None):
+                        retained=None, session=None, embeds=None,
+                        rules=None):
         from emqx_tpu.broker.shared_sub import stable_hash
         from emqx_tpu.ops import tokenizer as tok
 
@@ -1811,6 +1966,8 @@ class DeviceRouter:
             group_tables,
             kslot,
             kg,
+            sem_tables,
+            sem_topk,
         ) = args
         B = len(topics)
         Bp = max(64, _next_pow2(B))
@@ -1847,6 +2004,25 @@ class DeviceRouter:
                 rand = np.zeros(Bp, np.uint32)
         else:
             ch = th = rand = None
+        if sem_tables is not None:
+            # per-message query embeddings, padded like the batch; rows
+            # without one ride a zero vector (matches nothing at any
+            # positive threshold)
+            D = sem_tables["sem_vec"].shape[2]
+            qv = np.zeros((Bp, D), np.float32)
+            if embeds is not None:
+                qv[:B] = np.asarray(embeds, np.float32)
+        else:
+            qv = None
+        if rules is not None and rules[0]:
+            rprogs, rf, rv = rules
+            F = rf.shape[1]
+            rfeats = np.zeros((Bp, F), np.float32)
+            rfeats[:B] = rf
+            rvalid = np.zeros((Bp, F), bool)
+            rvalid[:B] = rv
+        else:
+            rprogs, rfeats, rvalid = (), None, None
         if self.mesh is not None and bits is not None:
             if session is not None:
                 # engine contract: callers gate on
@@ -1859,6 +2035,8 @@ class DeviceRouter:
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
                 retained=retained, kg=kg,
+                sem_tables=sem_tables, sem_topk=sem_topk, qv=qv,
+                rprogs=rprogs, rfeats=rfeats, rvalid=rvalid,
             )
         step_kw = dict(
             m_active=m_active,
@@ -1872,6 +2050,8 @@ class DeviceRouter:
             share_strategy=self.share_strategy,
             kslot=kslot,
             kg=kg,
+            sem_topk=sem_topk,
+            rule_progs=rprogs,
         )
         if session is not None:
             # the fused session-ack stage: the rider's inflight writes +
@@ -1882,6 +2062,7 @@ class DeviceRouter:
                 session.arrays, session.idxs, session.vals,
                 session.clock,
                 group_tables, ch, th, rand,
+                sem_tables, qv, rfeats, rvalid,
                 sweep_k=session.sweep_k, **step_kw,
             )
             return self._readback(
@@ -1895,6 +2076,7 @@ class DeviceRouter:
                 retained.shape_tables, retained.nfa_tables,
                 retained.chunks[0],
                 group_tables, ch, th, rand,
+                sem_tables, qv, rfeats, rvalid,
                 ret_m_active=retained.kwargs["m_active"],
                 ret_with_nfa=retained.kwargs["with_nfa"],
                 ret_salt=retained.kwargs["salt"],
@@ -1931,6 +2113,10 @@ class DeviceRouter:
             ch,
             th,
             rand,
+            sem_tables,
+            qv,
+            rfeats,
+            rvalid,
             **step_kw,
         )
         return self._readback(out, B, too_long, with_groups, kslot)
@@ -1990,6 +2176,12 @@ class DeviceRouter:
             pulls["retained"] = out["retained"]
             for j, m in enumerate(extra_retained or ()):
                 pulls[f"retained_{j + 1}"] = m
+        if out.get("sem_count") is not None:
+            # the semantic winners are already unioned into `slots`;
+            # only the O(B) qualifying count crosses separately
+            pulls["sem_count"] = out["sem_count"][:B]
+        if out.get("rule_masks") is not None:
+            pulls["rule_masks"] = out["rule_masks"][:, :B]
         if session is not None and session.sweep_k:
             # the session sweep's compact lists join the one device_get;
             # the updated table arrays themselves NEVER cross the link
@@ -2000,6 +2192,8 @@ class DeviceRouter:
             pulls["session_expired_count"] = sess["expired_count"]
         host = jax.device_get(pulls)
         matched = host["matched"]
+        sem_count = host.get("sem_count")
+        rule_masks = host.get("rule_masks")
         mcount = host["mcount"]
         flags = host["flags"] | too_long
         picks = (
@@ -2034,7 +2228,8 @@ class DeviceRouter:
             return RouteResult(
                 matched, mcount, flags, None, picks,
                 readback_bytes=readback, retained=retained_res,
-                session=sess_res,
+                session=sess_res, sem_count=sem_count,
+                rule_masks=rule_masks,
             )
         if kslot:
             slots = host["slots"]
@@ -2078,7 +2273,8 @@ class DeviceRouter:
                 slots=slots, slot_count=slot_count, overflow=overflow,
                 dense_rows=dense_rows, dense_index=dense_index,
                 readback_bytes=readback, retained=retained_res,
-                session=sess_res,
+                session=sess_res, sem_count=sem_count,
+                rule_masks=rule_masks,
             )
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
@@ -2086,7 +2282,8 @@ class DeviceRouter:
         return RouteResult(
             matched, mcount, flags, bitmaps, picks,
             readback_bytes=readback, retained=retained_res,
-            session=sess_res,
+            session=sess_res, sem_count=sem_count,
+            rule_masks=rule_masks,
         )
 
     # engine capability flag the broker gates storm fusion on: the
@@ -2111,7 +2308,8 @@ class DeviceRouter:
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
-        rand=None, kslot=0, retained=None, kg=0,
+        rand=None, kslot=0, retained=None, kg=0, sem_tables=None,
+        sem_topk=0, qv=None, rprogs=(), rfeats=None, rvalid=None,
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
@@ -2133,6 +2331,7 @@ class DeviceRouter:
         mat, lens, ch, th, rand, with_groups = self._mesh_pad(
             mat, lens, ch, th, rand, group_tables is not None
         )
+        qv, rfeats, rvalid = self._mesh_pad_rows(mat, qv, rfeats, rvalid)
         st, nt, sb = shape_tables, nfa_tables, bits
         bm, ln = place_batch(self.mesh, mat, lens)
         out = dist_shape_route_step(
@@ -2146,6 +2345,10 @@ class DeviceRouter:
             ch,
             th,
             rand,
+            sem_tables,
+            qv,
+            rfeats,
+            rvalid,
             m_active=m_active,
             salt=salt,
             max_levels=cfg.max_levels,
@@ -2155,9 +2358,23 @@ class DeviceRouter:
             share_strategy=self.share_strategy,
             kslot=kslot,
             kg=kg,
+            sem_topk=sem_topk,
+            rule_progs=rprogs,
             donate=getattr(cfg, "donate_buffers", False),
         )
         return self._readback(out, B, too_long, with_groups, kslot, mesh=True)
+
+    @staticmethod
+    def _mesh_pad_rows(mat, qv, rfeats, rvalid):
+        """Per-row semantic/rule operands pad to the dp-padded batch
+        length the same way the $share entropy vectors do."""
+        rows = mat.shape[0]
+        if qv is not None and len(qv) != rows:
+            qv = np.pad(qv, ((0, rows - len(qv)), (0, 0)))
+        if rfeats is not None and len(rfeats) != rows:
+            rfeats = np.pad(rfeats, ((0, rows - len(rfeats)), (0, 0)))
+            rvalid = np.pad(rvalid, ((0, rows - len(rvalid)), (0, 0)))
+        return qv, rfeats, rvalid
 
     def _mesh_pad(self, mat, lens, ch, th, rand, with_groups):
         """Round the batch up to a dp multiple (shard_map constraint) and
@@ -2245,12 +2462,14 @@ class MeshServingRouter(DeviceRouter):
         share_strategy: str = "round_robin",
         mesh=None,
         metrics=None,
+        semtab=None,
     ):
         if mesh is None:
             raise ValueError("MeshServingRouter requires a ('dp','tp') mesh")
         super().__init__(
             index, subtab, config, grouptab=grouptab,
             share_strategy=share_strategy, mesh=mesh, metrics=metrics,
+            semtab=semtab,
         )
         self.shard_label = "local"  # single-writer: loop
 
@@ -2297,7 +2516,8 @@ class MeshServingRouter(DeviceRouter):
     def _route_mesh(
         self, shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
-        rand=None, kslot=0, retained=None, kg=0,
+        rand=None, kslot=0, retained=None, kg=0, sem_tables=None,
+        sem_topk=0, qv=None, rprogs=(), rfeats=None, rvalid=None,
     ):
         """SPMD serving with optional fused retained storm: chunk 0 of a
         prepared `StormJob` rides the SAME sharded program + readback
@@ -2308,7 +2528,8 @@ class MeshServingRouter(DeviceRouter):
             return super()._route_mesh(
                 shape_tables, nfa_tables, bits, salt, m_active, with_nfa,
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
-                kg=kg,
+                kg=kg, sem_tables=sem_tables, sem_topk=sem_topk, qv=qv,
+                rprogs=rprogs, rfeats=rfeats, rvalid=rvalid,
             )
         from emqx_tpu.parallel.mesh import (
             dist_fused_route_step,
@@ -2319,6 +2540,7 @@ class MeshServingRouter(DeviceRouter):
         mat, lens, ch, th, rand, with_groups = self._mesh_pad(
             mat, lens, ch, th, rand, group_tables is not None
         )
+        qv, rfeats, rvalid = self._mesh_pad_rows(mat, qv, rfeats, rvalid)
         bm, ln = place_batch(self.mesh, mat, lens)
         out = dist_fused_route_step(
             self.mesh,
@@ -2334,6 +2556,10 @@ class MeshServingRouter(DeviceRouter):
             ch,
             th,
             rand,
+            sem_tables,
+            qv,
+            rfeats,
+            rvalid,
             m_active=m_active,
             salt=salt,
             ret_m_active=retained.kwargs["m_active"],
@@ -2348,6 +2574,8 @@ class MeshServingRouter(DeviceRouter):
             share_strategy=self.share_strategy,
             kslot=kslot,
             kg=kg,
+            sem_topk=sem_topk,
+            rule_progs=rprogs,
             donate=getattr(cfg, "donate_buffers", False),
         )
         from emqx_tpu.models.retained_index import _get_retained_step

@@ -128,6 +128,31 @@ def _out_specs(with_groups: bool = False, with_slots: bool = False,
     return specs
 
 
+def _sem_rules_local(out, sem_tables, qv, rfeats, rvalid, sem_topk,
+                     rule_progs):
+    """Per-shard semantic union + compiled-rule masks, shared by both
+    serving builders. Runs INSIDE shard_map: `sem_tables` is this tp
+    shard's slice of the entry axis (slot-owner sharding — winner slots
+    are global ids, so the union lands before the 'tp' concat with no
+    rebase); the qualifying counts psum over 'tp'. Rule feature rows
+    ride the 'dp' batch shards and are tp-replicated, like `matched`."""
+    if sem_tables is not None:
+        from emqx_tpu.ops.semantic_table import (
+            semantic_match_step,
+            union_semantic_slots,
+        )
+
+        sem_slots, sem_count = semantic_match_step(
+            sem_tables, qv, out["matched"], sem_topk
+        )
+        out["slots"] = union_semantic_slots(out["slots"], sem_slots)
+        out["sem_count"] = jax.lax.psum(sem_count, "tp")
+    if rule_progs:
+        from emqx_tpu.rules.compile import eval_rule_masks
+
+        out["rule_masks"] = eval_rule_masks(rule_progs, rfeats, rvalid)
+
+
 def _reduce_stats(out, with_groups: bool = False):
     """routed/matches are identical across tp replicas: reduce over dp
     only. fanout_bits is partial per lane slice: reduce over both axes."""
@@ -257,6 +282,9 @@ def _dist_shape_step_fn(
     donate: bool = False,
     sub_keys: Optional[tuple] = None,
     kg: int = 0,
+    sem_keys: Optional[tuple] = None,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
 ):
     """The SERVING engine (shape index + residual NFA + fan-out + $share
     pick) sharded over the mesh — same layout as `_dist_step_fn`, all
@@ -277,14 +305,25 @@ def _dist_shape_step_fn(
     its arrays shard their leading slot-owner axis over 'tp'
     (`csr_placement`), each shard's `sparse_fanout_slots` emits GLOBAL
     slot ids directly (no lane rebase), and only the count psum /
-    overflow OR run here. Same output contract either way."""
+    overflow OR run here. Same output contract either way.
+
+    ``sem_keys`` set = the semantic table (ops/semantic_table.py):
+    entries shard their leading slot-owner axis over 'tp'
+    (`semantic_placement`, the CSR regime), each shard's
+    `semantic_match_step` matmul answers its slice of the embedding
+    filters against the dp-sharded query batch, and the winner slots
+    (GLOBAL ids) union into the shard's compact rows before the 'tp'
+    concat; the qualifying counts psum over 'tp'. ``rule_progs``
+    evaluates the compiled WHERE masks over the dp-sharded feature
+    batch (tp-replicated, like `matched`)."""
     with_nfa = nfa_keys is not None
     with_groups = group_keys is not None
     sparse = sub_keys is not None
+    with_sem = sem_keys is not None
 
     def local_step(
         shape_tables, nfa_tables, group_tables, ch, th, rand,
-        sub_bitmaps, bytes_mat, lengths,
+        sub_bitmaps, bytes_mat, lengths, sem_tables, qv, rfeats, rvalid,
     ):
         out = shape_route_step_impl(
             shape_tables,
@@ -333,6 +372,9 @@ def _dist_shape_step_fn(
                 out["overflow"] = (
                     jax.lax.psum(over.astype(jnp.int32), "tp") > 0
                 )
+        _sem_rules_local(
+            out, sem_tables, qv, rfeats, rvalid, sem_topk, rule_progs
+        )
         return _reduce_stats(out, with_groups)
 
     shape_specs = {k: P() for k in shape_keys}
@@ -344,6 +386,15 @@ def _dist_shape_step_fn(
         if sparse
         else P(None, "tp")
     )
+    sem_specs = {k: P("tp") for k in sem_keys} if with_sem else None
+    out_specs = _out_specs(
+        with_groups, with_slots=kslot > 0,
+        dense_bitmaps=not sparse,
+    )
+    if with_sem:
+        out_specs["sem_count"] = P("dp")
+    if rule_progs:
+        out_specs["rule_masks"] = P(None, "dp")
     fn = shard_map(
         local_step,
         mesh=mesh,
@@ -351,11 +402,9 @@ def _dist_shape_step_fn(
             shape_specs, nfa_specs, group_specs,
             per_topic, per_topic, per_topic,
             sub_spec, P("dp", None), P("dp"),
+            sem_specs, P("dp", None), P("dp", None), P("dp", None),
         ),
-        out_specs=_out_specs(
-            with_groups, with_slots=kslot > 0,
-            dense_bitmaps=not sparse,
-        ),
+        out_specs=out_specs,
     )
     # ``donate``: recycle the per-batch lengths buffer (aliases the
     # [B]-shaped int32 outputs under the same 'dp' sharding) — the mesh
@@ -404,6 +453,9 @@ def _dist_fused_step_fn(
     donate: bool = False,
     sub_keys: Optional[tuple] = None,
     kg: int = 0,
+    sem_keys: Optional[tuple] = None,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
 ):
     """`_dist_shape_step_fn` + the retained-replay half fused into the
     SAME sharded program (the mesh analog of
@@ -423,11 +475,13 @@ def _dist_fused_step_fn(
     with_nfa = nfa_keys is not None
     with_groups = group_keys is not None
     sparse = sub_keys is not None
+    with_sem = sem_keys is not None
 
     def local_step(
         shape_tables, nfa_tables, group_tables, ch, th, rand,
         sub_bitmaps, bytes_mat, lengths,
         ret_shape_tables, ret_nfa_tables, ret_bytes,
+        sem_tables, qv, rfeats, rvalid,
     ):
         out = shape_route_step_impl(
             shape_tables,
@@ -474,6 +528,9 @@ def _dist_fused_step_fn(
                 out["overflow"] = (
                     jax.lax.psum(over.astype(jnp.int32), "tp") > 0
                 )
+        _sem_rules_local(
+            out, sem_tables, qv, rfeats, rvalid, sem_topk, rule_progs
+        )
         # retained half: bit-identical to fused_route_retained_step's,
         # on this shard's slice of the chunk rows (lengths derive
         # on-device — retained topics cannot contain NUL)
@@ -505,11 +562,16 @@ def _dist_fused_step_fn(
         with_groups, with_slots=kslot > 0, dense_bitmaps=not sparse
     )
     out_specs["retained"] = P("dp", None)
+    if with_sem:
+        out_specs["sem_count"] = P("dp")
+    if rule_progs:
+        out_specs["rule_masks"] = P(None, "dp")
     sub_spec = (
         {k: P("tp", None) for k in sub_keys}
         if sparse
         else P(None, "tp")
     )
+    sem_specs = {k: P("tp") for k in sem_keys} if with_sem else None
     fn = shard_map(
         local_step,
         mesh=mesh,
@@ -518,6 +580,7 @@ def _dist_fused_step_fn(
             per_topic, per_topic, per_topic,
             sub_spec, P("dp", None), P("dp"),
             ret_shape_specs, ret_nfa_specs, P("dp", None),
+            sem_specs, P("dp", None), P("dp", None), P("dp", None),
         ),
         out_specs=out_specs,
     )
@@ -530,6 +593,23 @@ def _dist_fused_step_fn(
 # compaction (which needs the axis_index lane rebase) with the in-impl
 # CSR gather — its ICI budget is the stats/count psums ONLY. A lane
 # rebase appearing in the sparse trace is a contract violation.
+# Registry entry for the serving builder traced WITH a semantic table:
+# the semantic union adds the per-shard similarity matmul + top-k and
+# one more count psum to the program; the dense per-shard compaction's
+# lane rebase (axis_index) stays. Its ICI budget is pinned here.
+device_contract(
+    "sem_dist_shape_step",
+    kind="builder",
+    collectives=("psum", "axis_index"),
+    out_bounds={
+        "slots": lambda cfg: (
+            cfg["B"] * cfg["kslot"] * 2 * cfg.get("tp", 1) * 4
+        ),
+        "slot_count": lambda cfg: cfg["B"] * 4,
+        "sem_count": lambda cfg: cfg["B"] * 4,
+    },
+)(_dist_shape_step_fn)
+
 device_contract(
     "sparse_dist_shape_step",
     kind="builder",
@@ -557,6 +637,10 @@ def dist_fused_route_step(
     client_hash=None,
     topic_hash=None,
     rand=None,
+    sem_tables: Optional[Dict] = None,
+    q_vecs=None,
+    rule_feats=None,
+    rule_valid=None,
     *,
     m_active: int,
     salt: int,
@@ -573,6 +657,8 @@ def dist_fused_route_step(
     kslot: int = 0,
     donate: bool = False,
     kg: int = 0,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
 ):
     """Distributed serving step WITH a fused retained-replay storm —
     the mesh engine `MeshServingRouter.route_prepared` launches when a
@@ -606,11 +692,15 @@ def dist_fused_route_step(
         if isinstance(sub_bitmaps, dict)
         else None,
         kg,
+        tuple(sorted(sem_tables)) if sem_tables is not None else None,
+        sem_topk,
+        rule_progs,
     )
     return fn(
         shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
         rand, sub_bitmaps, bytes_mat, lengths,
         ret_shape_tables, ret_nfa_tables, ret_bytes,
+        sem_tables, q_vecs, rule_feats, rule_valid,
     )
 
 
@@ -625,6 +715,10 @@ def dist_shape_route_step(
     client_hash=None,
     topic_hash=None,
     rand=None,
+    sem_tables: Optional[Dict] = None,
+    q_vecs=None,
+    rule_feats=None,
+    rule_valid=None,
     *,
     m_active: int,
     salt: int,
@@ -636,6 +730,8 @@ def dist_shape_route_step(
     kslot: int = 0,
     donate: bool = False,
     kg: int = 0,
+    sem_topk: int = 0,
+    rule_progs: tuple = (),
 ):
     """Distributed serving step (shape engine). Sharding as in
     `dist_route_step`: tables replicated, subscriber lanes on 'tp',
@@ -663,10 +759,14 @@ def dist_shape_route_step(
         if isinstance(sub_bitmaps, dict)
         else None,
         kg,
+        tuple(sorted(sem_tables)) if sem_tables is not None else None,
+        sem_topk,
+        rule_progs,
     )
     return fn(
         shape_tables, nfa_tables, group_tables, client_hash, topic_hash,
         rand, sub_bitmaps, bytes_mat, lengths,
+        sem_tables, q_vecs, rule_feats, rule_valid,
     )
 
 
@@ -704,6 +804,17 @@ def csr_placement(mesh: Mesh):
     per device. Slot ids are stored globally, so per-shard compact
     lists concatenate over 'tp' with no lane rebase."""
     sh = NamedSharding(mesh, P("tp", None))
+    return lambda _name, arr: jax.device_put(arr, sh)
+
+
+def semantic_placement(mesh: Mesh):
+    """Canonical placement for the semantic table
+    (ops/semantic_table.py): every array's leading axis is the
+    shard-owner axis (entry owned by ``slot % shards``), sharded over
+    'tp' — the CSR slot-ownership regime, so per-shard semantic winners
+    are GLOBAL slot ids and the compact rows concatenate over 'tp'
+    with no lane rebase. O(filters / tp) embedding rows per device."""
+    sh = NamedSharding(mesh, P("tp"))
     return lambda _name, arr: jax.device_put(arr, sh)
 
 

@@ -1,0 +1,485 @@
+"""Rule-predicate compiler: WHERE clauses -> device masks in the
+serving launch.
+
+The rule engine evaluates WHERE per message on the host (rules/
+runtime.py) — post-dispatch Python rate. This module compiles the
+supported AST subset (comparisons, AND/OR/NOT, IN-lists, numeric
+arithmetic over a per-message feature schema) into a tiny stack
+PROGRAM — a hashable tuple of RPN ops — that a trace-time interpreter
+(`eval_prog`) unrolls into the serving jit: every enabled compiled
+rule's WHERE evaluates over the whole batch INSIDE the same launch the
+batch already pays for routing, and only the [R, B] boolean masks ride
+the coalesced readback. Non-matching rows therefore drop at device
+match rate; the host only ever touches rows that passed.
+
+Degrade ladder (the robustness idiom):
+
+  device mask  ->  vectorized numpy twin  ->  per-row scalar evaluator
+
+The SAME program evaluates under numpy (`xp=np`) for CPU-degraded
+batches — that is the vectorized host fallback `rules/runtime.
+eval_where_rows` exposes — and anything the compiler cannot express
+returns None and stays on the scalar `eval_expr` path unchanged.
+
+Feature schema (host-extracted per batch into one f32 [B, F] matrix +
+a validity mask): ``qos``, numeric ``payload.<key>`` lanes (the JSON
+payload decodes ONCE per message, only when a payload lane exists),
+and hashed string-identity lanes for ``topic(N)`` / ``payload.<key>``
+string equality. String lanes hash to 24 bits (f32-exact): equal
+strings always collide (no false negatives), unequal strings may — so
+rules carrying a string lane are flagged ``exact=False`` and the
+engine RE-VERIFIES device-passed rows with the scalar evaluator before
+firing (passing rows are the rare case; non-matching rows still drop
+at device rate, which is the whole win).
+
+Null semantics mirror `rules/runtime.eval_expr` exactly (the fuzz
+suite pins this): every numeric node carries a validity lane; invalid
+(undefined/non-numeric) operands poison arithmetic, lose every
+ordering comparison, and compare equal only to each other.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from emqx_tpu.rules.sql import BinOp, Call, InList, Lit, Query, UnOp, Var
+
+# f32 holds 24-bit integers exactly; string identity lanes live there
+_HASH_BITS = 0xFFFFFF
+
+
+def _shash(s) -> float:
+    if isinstance(s, bytes):
+        s = s.decode("utf-8", "replace")
+    return float(zlib.crc32(str(s).encode("utf-8")) & _HASH_BITS)
+
+
+class _Uncompilable(Exception):
+    pass
+
+
+class _Compiler:
+    """AST -> RPN ops. Lane keys: ("num", "qos"), ("num",
+    "payload.<k>"), ("str", "payload.<k>"), ("str", "topic.<n>")."""
+
+    def __init__(self, lanes: Dict[Tuple[str, str], int]):
+        self.lanes = lanes
+        self.ops: List[tuple] = []
+        self.exact = True
+
+    def _lane(self, kind: str, name: str) -> int:
+        key = (kind, name)
+        if key not in self.lanes:
+            self.lanes[key] = len(self.lanes)
+        if kind == "str":
+            self.exact = False
+        return self.lanes[key]
+
+    # numeric-producing nodes push ("feat"|"lit"|arith...) ops
+    def num(self, node) -> None:
+        if isinstance(node, Lit):
+            v = node.value
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise _Uncompilable(f"non-numeric literal {v!r}")
+            self.ops.append(("lit", float(v)))
+            return
+        if isinstance(node, Var):
+            p = node.path
+            if p == ["qos"]:
+                self.ops.append(("feat", self._lane("num", "qos")))
+                return
+            if (
+                len(p) == 2 and p[0] == "payload"
+                and isinstance(p[1], str)
+            ):
+                self.ops.append(
+                    ("feat", self._lane("num", f"payload.{p[1]}"))
+                )
+                return
+            raise _Uncompilable(f"variable {p!r}")
+        if isinstance(node, UnOp) and node.op == "neg":
+            self.num(node.operand)
+            self.ops.append(("neg",))
+            return
+        if isinstance(node, BinOp) and node.op in (
+            "+", "-", "*", "/", "div", "mod"
+        ):
+            self.num(node.left)
+            self.num(node.right)
+            self.ops.append((
+                {"+": "add", "-": "sub", "*": "mul", "/": "truediv",
+                 "div": "idiv", "mod": "mod"}[node.op],
+            ))
+            return
+        raise _Uncompilable(f"numeric node {type(node).__name__}")
+
+    def _str_operand(self, node) -> None:
+        """Push a string-identity feature (hashed lane)."""
+        if isinstance(node, Var):
+            p = node.path
+            if (
+                len(p) == 2 and p[0] == "payload"
+                and isinstance(p[1], str)
+            ):
+                self.ops.append(
+                    ("feat", self._lane("str", f"payload.{p[1]}"))
+                )
+                return
+        if (
+            isinstance(node, Call) and node.name == "topic"
+            and len(node.args) == 1 and isinstance(node.args[0], Lit)
+            and isinstance(node.args[0].value, int)
+        ):
+            n = node.args[0].value
+            self.ops.append(("feat", self._lane("str", f"topic.{n}")))
+            return
+        raise _Uncompilable(f"string operand {type(node).__name__}")
+
+    def _eq_pair(self, left, right, neq: bool) -> None:
+        """Equality: numeric x numeric, or string-feature x string-lit
+        (hashed identity)."""
+        lit_str = isinstance(right, Lit) and isinstance(right.value, str)
+        lit_str_l = isinstance(left, Lit) and isinstance(left.value, str)
+        if lit_str or lit_str_l:
+            feat, lit = (left, right) if lit_str else (right, left)
+            self._str_operand(feat)
+            self.ops.append(("lit", _shash(lit.value)))
+        else:
+            self.num(left)
+            self.num(right)
+        self.ops.append(("ne",) if neq else ("eq",))
+
+    # boolean-producing nodes push mask ops
+    def boolean(self, node) -> None:
+        if isinstance(node, Lit) and isinstance(node.value, bool):
+            self.ops.append(("blit", bool(node.value)))
+            return
+        if isinstance(node, BinOp):
+            op = node.op
+            if op in ("and", "or"):
+                self.boolean(node.left)
+                self.boolean(node.right)
+                self.ops.append((op,))
+                return
+            if op in ("=", "!="):
+                self._eq_pair(node.left, node.right, op == "!=")
+                return
+            if op in (">", "<", ">=", "<="):
+                self.num(node.left)
+                self.num(node.right)
+                self.ops.append((
+                    {">": "gt", "<": "lt", ">=": "ge", "<=": "le"}[op],
+                ))
+                return
+            raise _Uncompilable(f"operator {op!r}")
+        if isinstance(node, UnOp) and node.op == "not":
+            self.boolean(node.operand)
+            self.ops.append(("not",))
+            return
+        if isinstance(node, InList):
+            # expand to OR of equalities (device has no set primitive);
+            # items may be any compilable operand (-3 parses as a neg)
+            for i, item in enumerate(node.items):
+                self._eq_pair(node.needle, item, neq=False)
+                if i:
+                    self.ops.append(("or",))
+            if node.negated:
+                self.ops.append(("not",))
+            return
+        # numeric node in boolean position: truthiness (non-zero)
+        self.num(node)
+        self.ops.append(("truthy",))
+
+
+def compile_where(expr, lanes: Dict[Tuple[str, str], int]):
+    """Compile one WHERE AST against a SHARED lane table (lanes grow in
+    place so every rule in a set extracts from one feature matrix).
+
+    Returns ``(prog, exact)`` or None when the expression uses anything
+    outside the compilable subset. ``prog`` is a hashable tuple of ops —
+    the serving jit's static argument, so a rule-set change recompiles
+    the program exactly once.
+    """
+    c = _Compiler(lanes)
+    snapshot = dict(lanes)
+    try:
+        c.boolean(expr)
+    except _Uncompilable:
+        # roll back lanes this expression introduced before failing
+        lanes.clear()
+        lanes.update(snapshot)
+        return None
+    return tuple(c.ops), c.exact
+
+
+# -- evaluation (ONE interpreter, two array modules) -------------------------
+
+
+def eval_prog(prog: Sequence[tuple], feats, valid, xp):
+    """Evaluate a compiled program over a feature batch.
+
+    feats: f32 [B, F]; valid: bool [B, F]; xp: jax.numpy at trace time
+    (the mask unrolls INTO the serving program) or numpy for the
+    vectorized host fallback — same semantics by construction, which is
+    what makes the numpy twin a trustworthy degrade target.
+
+    Stack values are ("n", value, valid) numeric pairs or ("b", mask)
+    booleans; null semantics follow rules/runtime.eval_expr (module
+    docstring).
+    """
+    B = feats.shape[0]
+    tt = xp.ones(B, bool)
+    stack: list = []
+    for op in prog:
+        tag = op[0]
+        if tag == "feat":
+            lane = op[1]
+            stack.append(("n", feats[:, lane], valid[:, lane]))
+        elif tag == "lit":
+            stack.append((
+                "n", xp.full(B, op[1], np.float32), tt,
+            ))
+        elif tag == "blit":
+            stack.append(("b", tt if op[1] else ~tt))
+        elif tag in ("add", "sub", "mul", "truediv", "idiv", "mod"):
+            _, b, vb = stack.pop()
+            _, a, va = stack.pop()
+            ok = va & vb
+            if tag == "add":
+                r = a + b
+            elif tag == "sub":
+                r = a - b
+            elif tag == "mul":
+                r = a * b
+            else:
+                ok = ok & (b != 0)
+                safe = xp.where(b != 0, b, np.float32(1))
+                if tag == "truediv":
+                    r = a / safe
+                elif tag == "idiv":
+                    # host: int(a) // int(b) — trunc the operands, floor
+                    # the quotient (python // semantics on the ints)
+                    r = xp.floor_divide(xp.trunc(a), xp.trunc(safe))
+                else:
+                    r = xp.mod(xp.trunc(a), xp.trunc(safe))
+            stack.append(("n", r, ok))
+        elif tag == "neg":
+            _, a, va = stack.pop()
+            stack.append(("n", -a, va))
+        elif tag in ("eq", "ne"):
+            _, b, vb = stack.pop()
+            _, a, va = stack.pop()
+            # None = None is True; None = x is False (runtime._eq)
+            eq = xp.where(
+                va & vb, a == b, ~va & ~vb
+            )
+            stack.append(("b", eq if tag == "eq" else ~eq))
+        elif tag in ("gt", "lt", "ge", "le"):
+            _, b, vb = stack.pop()
+            _, a, va = stack.pop()
+            ok = va & vb
+            if tag == "gt":
+                r = a > b
+            elif tag == "lt":
+                r = a < b
+            elif tag == "ge":
+                r = a >= b
+            else:
+                r = a <= b
+            stack.append(("b", ok & r))
+        elif tag == "truthy":
+            _, a, va = stack.pop()
+            stack.append(("b", va & (a != 0)))
+        elif tag == "not":
+            _, m = stack.pop()
+            stack.append(("b", ~m))
+        elif tag == "and":
+            _, m2 = stack.pop()
+            _, m1 = stack.pop()
+            stack.append(("b", m1 & m2))
+        elif tag == "or":
+            _, m2 = stack.pop()
+            _, m1 = stack.pop()
+            stack.append(("b", m1 | m2))
+        else:  # pragma: no cover - compiler and interpreter co-evolve
+            raise ValueError(f"unknown rule op {tag!r}")
+    # the compiler leaves exactly one boolean on the stack
+    tag, *rest = stack[-1] if stack else ("b", ~tt)
+    if tag == "b":
+        return rest[0]
+    a, va = rest  # numeric top (bare `WHERE payload.x`): truthiness
+    return va & (a != 0)
+
+
+def eval_rule_masks(progs, feats, valid):
+    """Trace-time entry the serving step calls: stack every compiled
+    rule's mask into one bool [R, B] output (R = len(progs) >= 1)."""
+    import jax.numpy as jnp
+
+    return jnp.stack([eval_prog(p, feats, valid, jnp) for p in progs])
+
+
+# -- feature extraction ------------------------------------------------------
+
+
+def _mget(m, key, default=None):
+    """Feature source accessor: a Message object (broker batches) or an
+    event-context dict (rules/runtime.eval_where_rows) both work."""
+    if isinstance(m, dict):
+        return m.get(key, default)
+    return getattr(m, key, default)
+
+
+def extract_features(msgs, lanes: Dict[Tuple[str, str], int]):
+    """One f32 [B, F] matrix + validity mask + per-row SUSPECT flags
+    for a message batch (Message objects or event-context dicts).
+
+    Host-side, loop thread; the payload JSON decodes at most once per
+    message and only when some rule declared a payload lane. A numeric
+    lane is valid only for REAL numbers; a string/bool/structure value
+    marks the ROW suspect instead — the scalar evaluator's coercion
+    rules there (numeric strings compare numerically but poison
+    arithmetic, bools are identity-only) cannot be mirrored by one f32
+    lane, so suspect rows force a PASS and the engine re-verifies them
+    with the scalar authority. Well-typed rows (the overwhelming case)
+    keep the pure device-rate drop. Message objects additionally carry
+    the flag in ``headers["_rule_suspect"]`` so settle-time firing
+    needs no re-extraction.
+    """
+    B, F = len(msgs), len(lanes)
+    feats = np.zeros((B, F), np.float32)
+    valid = np.zeros((B, F), bool)
+    suspect = np.zeros(B, bool)
+    keys = list(lanes.items())
+    need_payload = any(
+        name.startswith("payload.") for (_k, name), _i in keys
+    )
+    for i, m in enumerate(msgs):
+        payload = None
+        decoded = False
+        for (kind, name), lane in keys:
+            if name == "qos":
+                q = _mget(m, "qos", 0)
+                if isinstance(q, bool) or not isinstance(
+                    q, (int, float)
+                ):
+                    continue
+                feats[i, lane] = float(q)
+                valid[i, lane] = True
+                continue
+            if name.startswith("topic."):
+                n = int(name[6:])
+                toks = str(_mget(m, "topic", "") or "").split("/")
+                if 1 <= n <= len(toks):
+                    feats[i, lane] = _shash(toks[n - 1])
+                    valid[i, lane] = True
+                continue
+            # payload.<key>
+            if need_payload and not decoded:
+                decoded = True
+                payload = _mget(m, "payload", None)
+                if isinstance(payload, (bytes, str)):
+                    try:
+                        payload = json.loads(payload or b"null")
+                    except (ValueError, TypeError):
+                        payload = None
+            if not isinstance(payload, dict):
+                continue
+            v = payload.get(name[8:])
+            if kind == "str":
+                if isinstance(v, (str, bytes)):
+                    feats[i, lane] = _shash(v)
+                    valid[i, lane] = True
+                continue
+            if v is None:
+                continue  # missing: exact None semantics in-program
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                feats[i, lane] = np.float32(v)
+                valid[i, lane] = True
+            else:
+                # string/bool/structure in a numeric lane: the scalar
+                # evaluator's coercion rules decide — flag the row
+                suspect[i] = True
+        if suspect[i] and not isinstance(m, dict):
+            m.headers["_rule_suspect"] = True
+    return feats, valid, suspect
+
+
+class CompiledRule:
+    __slots__ = ("rule", "prog", "exact")
+
+    def __init__(self, rule, prog, exact: bool):
+        self.rule = rule
+        self.prog = prog
+        self.exact = exact
+
+
+class DeviceRuleFilter:
+    """The rule set's device-resident half: compiled WHERE programs +
+    the shared feature-lane table, refreshed whenever the registry
+    changes (rule create/delete/enable toggles).
+
+    A rule compiles when: it is enabled, selects 'message.publish'
+    events through plain topic filters (no $events, no FOREACH), and
+    its WHERE fits the compilable subset. Everything else stays on the
+    scalar hook path untouched.
+    """
+
+    def __init__(self):
+        self.lanes: Dict[Tuple[str, str], int] = {}
+        self.compiled: List[CompiledRule] = []
+        self._ids: frozenset = frozenset()
+
+    def refresh(self, rules) -> None:
+        lanes: Dict[Tuple[str, str], int] = {}
+        out: List[CompiledRule] = []
+        for rule in rules:
+            q: Query = rule.query
+            if not rule.enabled or q.where is None:
+                continue
+            if q.foreach is not None:
+                continue
+            if any(t.startswith("$events/") for t in q.topics):
+                continue
+            res = compile_where(q.where, lanes)
+            if res is None:
+                continue
+            prog, exact = res
+            out.append(CompiledRule(rule, prog, exact))
+        self.lanes = lanes
+        self.compiled = out
+        self._ids = frozenset(c.rule.id for c in out)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.compiled)
+
+    @property
+    def progs(self) -> tuple:
+        """The serving jit's static argument (hashable; identity keys
+        the compiled program, so rule-set churn retraces exactly once)."""
+        return tuple(c.prog for c in self.compiled)
+
+    def covers(self, rule_id: str) -> bool:
+        return rule_id in self._ids
+
+    def features(self, msgs):
+        """(feats, valid) for the device launch; the per-row suspect
+        flags land in the message headers (see extract_features)."""
+        feats, valid, _suspect = extract_features(msgs, self.lanes)
+        return feats, valid
+
+    def host_masks(self, msgs) -> np.ndarray:
+        """Vectorized numpy evaluation — the CPU-degraded batch path
+        (and the differential reference for the device masks)."""
+        if not self.compiled:
+            return np.zeros((0, len(msgs)), bool)
+        feats, valid, _suspect = extract_features(msgs, self.lanes)
+        return np.stack([
+            np.asarray(eval_prog(c.prog, feats, valid, np))
+            for c in self.compiled
+        ])

@@ -136,6 +136,131 @@ class RuleEngine:
         self._lock = threading.Lock()
         self.console_log: List = []
         self._depth = threading.local()
+        # DeviceRuleFilter (rules/compile.py): compiled WHERE programs
+        # evaluated inside serving launches (docs/semantic_routing.md).
+        # None = every rule stays on the per-message hook path.
+        self.device_filter = None
+
+    # -- device-predicate plane (rules/compile.py) -------------------------
+    def attach_device(self) -> None:
+        """Enable device-compiled WHERE filtering: the broker batch
+        paths defer compiled rules to settle time, where they fire from
+        the in-launch masks (or the vectorized host ladder)."""
+        from emqx_tpu.rules.compile import DeviceRuleFilter
+
+        self.device_filter = DeviceRuleFilter()
+        self.device_filter.refresh(self.rules())
+        self.broker.rule_hook = self
+
+    def refresh_device(self) -> None:
+        """Recompile the device rule set (rule create/delete/enable).
+        The progs tuple is the serving jit's static key, so this is
+        also exactly when the launch program retraces."""
+        if self.device_filter is not None:
+            self.device_filter.refresh(self.rules())
+
+    def device_active(self) -> bool:
+        df = self.device_filter
+        return df is not None and df.active
+
+    def device_progs(self, msgs):
+        """(progs, feats [B,F], valid) for a batch about to launch, or
+        None — called by the broker right before the device dispatch."""
+        df = self.device_filter
+        if df is None or not df.active:
+            return None
+        feats, valid = df.features(msgs)
+        return df.progs, feats, valid
+
+    def fire_settled(self, msgs, masks=None) -> None:
+        """Fire deferred (device-compiled) rules for the marked
+        messages of a settled batch. `masks` [R, B] comes from the
+        launch readback; None (or a rule-set shape mismatch — the set
+        churned while the batch was in flight) drops to the vectorized
+        numpy twin. Passing rows re-run `apply_query` — the scalar host
+        stays the single authority for SELECT projection AND the final
+        WHERE word (hashed string lanes make the device mask a
+        superset filter; see rules/compile.py)."""
+        df = self.device_filter
+        marked = [
+            i for i, m in enumerate(msgs)
+            if m.headers.pop("_batch_rules", None) is not None
+        ]
+        if not marked or df is None or not df.compiled:
+            for m in msgs:
+                m.headers.pop("_rule_suspect", None)
+            return
+        mtr = self.broker.metrics
+        if masks is None or len(masks) != len(df.compiled):
+            masks = df.host_masks(msgs)
+            mtr.inc("rules.host.batches")
+        else:
+            mtr.inc("rules.device.batches")
+        if self._chain_depth() >= self.MAX_CHAIN_DEPTH:
+            return
+        self._depth.value = self._chain_depth() + 1
+        try:
+            memo: Dict = {}
+            for r, cr in enumerate(df.compiled):
+                rule = cr.rule
+                if not rule.enabled or self._rules.get(rule.id) is not rule:
+                    continue
+                row = masks[r]
+                for i in marked:
+                    msg = msgs[i]
+                    if msg.headers.get("from_rule") == rule.id:
+                        continue
+                    key = (rule.id, msg.topic)
+                    sel = memo.get(key)
+                    if sel is None:
+                        sel = any(
+                            T.match(msg.topic, t)
+                            for t in rule.query.topics
+                        )
+                        memo[key] = sel
+                    if not sel:
+                        continue
+                    rule.metrics.matched += 1
+                    mtr.inc("rules.matched")
+                    if not row[i] and not msg.headers.get(
+                        "_rule_suspect"
+                    ):
+                        # the device-rate drop: WHERE said no, the host
+                        # never builds a context for this row (suspect
+                        # rows — string/bool-typed numeric lanes — fall
+                        # through to the scalar re-verify below)
+                        rule.metrics.no_result += 1
+                        mtr.inc("rules.dropped")
+                        continue
+                    ctx = EV.message_publish(msg)
+                    try:
+                        rows = apply_query(rule.query, ctx)
+                    except Exception:
+                        rule.metrics.failed += 1
+                        mtr.inc("rules.failed")
+                        log.exception("rule %s SQL failed", rule.id)
+                        continue
+                    if not rows:
+                        rule.metrics.no_result += 1
+                        mtr.inc("rules.dropped")
+                        continue
+                    rule.metrics.passed += 1
+                    mtr.inc("rules.passed")
+                    for row_out in rows:
+                        for out in rule.outputs:
+                            try:
+                                out.run(self, rule, row_out, ctx)
+                                rule.metrics.outputs_success += 1
+                            except Exception:
+                                rule.metrics.outputs_failed += 1
+                                log.exception(
+                                    "rule %s output %s failed",
+                                    rule.id, out.name,
+                                )
+        finally:
+            self._depth.value = self._chain_depth() - 1
+            for m in msgs:
+                m.headers.pop("_rule_suspect", None)
 
     # -- registry ----------------------------------------------------------
     def create_rule(
@@ -151,11 +276,15 @@ class RuleEngine:
             if not replace and rule_id in self._rules:
                 raise ValueError(f"rule {rule_id!r} already exists")
             self._rules[rule_id] = rule
+        self.refresh_device()
         return rule
 
     def delete_rule(self, rule_id: str) -> bool:
         with self._lock:
-            return self._rules.pop(rule_id, None) is not None
+            existed = self._rules.pop(rule_id, None) is not None
+        if existed:
+            self.refresh_device()
+        return existed
 
     def get_rule(self, rule_id: str) -> Optional[Rule]:
         return self._rules.get(rule_id)
@@ -229,7 +358,18 @@ class RuleEngine:
             r.enabled for r in self._rules.values()
         ):
             return None
-        self._fire(EV.message_publish(msg), from_rule=msg.headers.get("from_rule"))
+        skip = None
+        df = self.device_filter
+        if df is not None and msg.headers.get("_batch_rules"):
+            # the broker marked this message for settle-time firing:
+            # device-compiled rules evaluate in the serving launch, the
+            # hook path keeps only the uncompilable remainder
+            skip = df._ids
+        self._fire(
+            EV.message_publish(msg),
+            from_rule=msg.headers.get("from_rule"),
+            skip_rules=skip,
+        )
         return None
 
     def _chain_depth(self) -> int:
@@ -246,7 +386,8 @@ class RuleEngine:
                 return True
         return False
 
-    def _fire(self, ctx: Dict, from_rule: Optional[str] = None) -> None:
+    def _fire(self, ctx: Dict, from_rule: Optional[str] = None,
+              skip_rules=None) -> None:
         # re-entrancy bound: outputs that publish re-enter _fire
         # synchronously (via broker hooks); cap the chain so a rule feeding
         # its own event class (e.g. $events/message_dropped -> republish to
@@ -255,26 +396,33 @@ class RuleEngine:
             log.warning("rule chain depth limit hit; dropping event %s", ctx.get("event"))
             return
         from_rule = from_rule or ctx.get("__from_rule")
+        mtr = self.broker.metrics
         self._depth.value = self._chain_depth() + 1
         try:
             for rule in list(self._rules.values()):
                 if not rule.enabled:
                     continue
+                if skip_rules is not None and rule.id in skip_rules:
+                    continue  # fires at settle from the device mask
                 if from_rule is not None and rule.id == from_rule:
                     continue  # self-republish loop guard
                 if not self._selects_event(rule.query, ctx):
                     continue
                 rule.metrics.matched += 1
+                mtr.inc("rules.matched")
                 try:
                     rows = apply_query(rule.query, ctx)
                 except Exception:
                     rule.metrics.failed += 1
+                    mtr.inc("rules.failed")
                     log.exception("rule %s SQL failed", rule.id)
                     continue
                 if rows is None or not rows:
                     rule.metrics.no_result += 1
+                    mtr.inc("rules.dropped")
                     continue
                 rule.metrics.passed += 1
+                mtr.inc("rules.passed")
                 for row in rows:
                     for out in rule.outputs:
                         try:

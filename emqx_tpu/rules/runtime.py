@@ -223,6 +223,48 @@ def _project(selects: Optional[List[SelectItem]], ctx: Dict) -> Dict:
     return out
 
 
+def eval_where_rows(q: Query, ctxs: List[Dict]):
+    """Vectorized batch WHERE: one bool mask for a whole dispatch batch
+    of event contexts, instead of per-message dict-row evaluation.
+
+    Compilable predicates (rules/compile.py) evaluate ONCE over numpy
+    feature columns — the host rung of the device/numpy/scalar degrade
+    ladder; rows a hashed (inexact) program passes re-verify with the
+    scalar evaluator, and uncompilable expressions fall back to the
+    scalar loop wholesale. Differential-tested against `eval_expr` in
+    tests/test_rule_compile.py.
+    """
+    import numpy as np
+
+    if q.where is None:
+        return np.ones(len(ctxs), bool)
+    from emqx_tpu.rules.compile import (
+        compile_where,
+        eval_prog,
+        extract_features,
+    )
+
+    lanes: Dict = {}
+    res = compile_where(q.where, lanes)
+    if res is None:
+        return np.fromiter(
+            (_truthy(eval_expr(q.where, c)) for c in ctxs),
+            bool, count=len(ctxs),
+        )
+    prog, exact = res
+    feats, valid, suspect = extract_features(ctxs, lanes)
+    mask = np.asarray(eval_prog(prog, feats, valid, np)).copy()
+    # suspect rows (string/bool-typed numeric lanes) and hashed-lane
+    # programs make the vector mask a SUPERSET filter — re-verify only
+    # the rows it passes (the rare case); well-typed exact rows stay
+    # pure-vector
+    mask |= suspect
+    verify = mask & (suspect if exact else np.ones_like(mask))
+    for i in np.nonzero(verify)[0]:
+        mask[i] = _truthy(eval_expr(q.where, ctxs[i]))
+    return mask
+
+
 def apply_query(q: Query, ctx: Dict) -> Optional[List[Dict]]:
     """Run the query against one event context.
 

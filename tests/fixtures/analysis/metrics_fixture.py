@@ -33,6 +33,10 @@ declare("session.redeliveries", COUNTER)
 declare("fabric.slab.pub.records", COUNTER)
 declare("ingest.zerocopy.records", COUNTER)
 declare("dispatch.serialize.frames", COUNTER)
+declare("semantic.filters", "gauge")
+declare("semantic.hits", COUNTER)
+declare("rules.matched", COUNTER)
+declare("rules.device.batches", COUNTER)
 
 
 class M:
@@ -73,6 +77,10 @@ def good(m: M):
     m.inc("fabric.slab.pub.records", 64)
     m.inc("ingest.zerocopy.records", 64)
     m.inc("dispatch.serialize.frames", 8)
+    m.gauge_set("semantic.filters", 4)
+    m.inc("semantic.hits", 3)
+    m.inc("rules.matched")
+    m.inc("rules.device.batches")
 
 
 def bad(m: M):
@@ -102,3 +110,7 @@ def bad(m: M):
     m.inc("fabric.slab.pub.recordz")  # MN001: typo'd slab counter
     m.inc("ingest.zerocopy.recordz")  # MN001: typo'd zerocopy counter
     m.inc("dispatch.serialize.framez")  # MN001: typo'd serializer counter
+    m.gauge_set("semantic.filterz", 1)  # MN001: typo'd semantic gauge
+    m.inc("semantic.hitz")  # MN001: typo'd semantic counter
+    m.inc("rules.matchd")  # MN001: typo'd rule counter
+    m.inc("rules.device.batchez")  # MN001: typo'd rule-ladder counter

@@ -1,0 +1,339 @@
+"""Rule-predicate compiler (rules/compile.py) + the vectorized host
+WHERE evaluator (rules/runtime.eval_where_rows).
+
+The degrade ladder is device mask -> numpy twin -> scalar evaluator;
+these tests pin every rung against the scalar authority:
+
+- randomized-expression fuzz: the compiled program under numpy equals
+  `eval_expr` row by row (exact programs), and hashed-string programs
+  are a SUPERSET filter whose re-verified result is exact;
+- the jax trace of the same program equals the numpy twin;
+- `eval_where_rows` (the batch evaluator the CPU-degraded settle path
+  uses) is differentially exact, including the scalar fallback for
+  uncompilable expressions;
+- the engine's settle-time firing: compiled rules fire exactly once
+  per passing message (device masks on the fused path, host masks on
+  the degraded path), never double with the hook path.
+"""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.rules.compile import (
+    DeviceRuleFilter,
+    compile_where,
+    eval_prog,
+    extract_features,
+)
+from emqx_tpu.rules.runtime import _truthy, eval_expr, eval_where_rows
+from emqx_tpu.rules.sql import parse_sql
+
+
+def _where(sql_where: str):
+    return parse_sql(f'SELECT * FROM "t/#" WHERE {sql_where}').where
+
+
+# -- fuzz generator ----------------------------------------------------------
+# integer-valued features and literals keep f32 exact (div excluded
+# from the generator; truediv gets its own dyadic-exact test)
+
+_NUM_TERMS = ("qos", "payload.a", "payload.b", "payload.c")
+_STR_EQ = (
+    "payload.s = 'alpha'", "payload.s = 'beta'",
+    "topic(1) = 't'", "payload.s != 'alpha'",
+)
+
+
+def _gen_num(rng, depth):
+    r = rng.random()
+    if depth <= 0 or r < 0.4:
+        if rng.random() < 0.5:
+            return str(int(rng.integers(-8, 9)))
+        return str(rng.choice(_NUM_TERMS))
+    op = rng.choice(["+", "-", "*", "div", "mod"])
+    return (
+        f"({_gen_num(rng, depth - 1)} {op} {_gen_num(rng, depth - 1)})"
+    )
+
+
+def _gen_bool(rng, depth):
+    r = rng.random()
+    if depth <= 0 or r < 0.35:
+        kind = rng.random()
+        if kind < 0.6:
+            op = rng.choice(["=", "!=", ">", "<", ">=", "<="])
+            return f"{_gen_num(rng, 1)} {op} {_gen_num(rng, 1)}"
+        if kind < 0.8:
+            vals = ", ".join(
+                str(int(v)) for v in rng.integers(-4, 5, size=3)
+            )
+            neg = "not " if rng.random() < 0.3 else ""
+            return f"{rng.choice(_NUM_TERMS)} {neg}in ({vals})"
+        return str(rng.choice(_STR_EQ))
+    op = rng.choice(["and", "or"])
+    left = _gen_bool(rng, depth - 1)
+    right = _gen_bool(rng, depth - 1)
+    e = f"({left} {op} {right})"
+    return f"not {e}" if rng.random() < 0.2 else e
+
+
+def _gen_ctx(rng):
+    payload = {}
+    for k in ("a", "b", "c"):
+        r = rng.random()
+        if r < 0.6:
+            payload[k] = int(rng.integers(-8, 9))
+        elif r < 0.7:
+            payload[k] = str(int(rng.integers(-8, 9)))  # numeric string
+        elif r < 0.8:
+            payload[k] = bool(rng.integers(0, 2))  # invalid numeric
+        # else missing
+    if rng.random() < 0.7:
+        payload["s"] = str(rng.choice(["alpha", "beta", "gamma"]))
+    import json
+
+    return {
+        "qos": int(rng.integers(0, 3)),
+        "topic": str(rng.choice(["t/1", "t/2", "u/3"])),
+        "payload": json.dumps(payload).encode(),
+    }
+
+
+def test_fuzz_compiled_numpy_equals_scalar():
+    rng = np.random.default_rng(0xC0)
+    checked = 0
+    for trial in range(150):
+        expr = _where(_gen_bool(rng, 3))
+        lanes = {}
+        res = compile_where(expr, lanes)
+        assert res is not None, "generator only emits compilable forms"
+        prog, exact = res
+        ctxs = [_gen_ctx(rng) for _ in range(16)]
+        feats, valid, suspect = extract_features(ctxs, lanes)
+        mask = np.asarray(eval_prog(prog, feats, valid, np))
+        ref = np.array(
+            [_truthy(eval_expr(expr, c)) for c in ctxs], bool
+        )
+        if exact:
+            # well-typed rows are EXACT; suspect rows (string/bool in
+            # a numeric lane) force a pass + scalar re-verify instead
+            ok = ~suspect
+            assert np.array_equal(mask[ok], ref[ok]), (trial, expr)
+            checked += int(ok.sum())
+        # the ladder invariant: the effective filter never drops a row
+        # the scalar authority would pass
+        assert not np.any(~(mask | suspect) & ref), (trial, expr)
+    assert checked > 500  # plenty of exact well-typed rows exercised
+
+
+def test_fuzz_jax_trace_equals_numpy_twin():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0xC1)
+    for _ in range(25):
+        expr = _where(_gen_bool(rng, 3))
+        lanes = {}
+        prog, _exact = compile_where(expr, lanes)
+        ctxs = [_gen_ctx(rng) for _ in range(8)]
+        feats, valid, _suspect = extract_features(ctxs, lanes)
+        np_mask = np.asarray(eval_prog(prog, feats, valid, np))
+        jx_mask = np.asarray(
+            eval_prog(prog, jnp.asarray(feats), jnp.asarray(valid), jnp)
+        )
+        assert np.array_equal(np_mask, jx_mask), expr
+
+
+def test_eval_where_rows_differential():
+    """Satellite: the batch evaluator == per-row scalar evaluation,
+    over compilable AND uncompilable (scalar-fallback) expressions."""
+    rng = np.random.default_rng(0xC2)
+    cases = [_gen_bool(rng, 3) for _ in range(30)]
+    # uncompilable shapes take the scalar fallback inside eval_where_rows
+    cases += [
+        "lower(payload.s) = 'alpha'",
+        "payload.a > 1 and is_num(payload.b)",
+        "case when qos = 1 then true else false end",
+    ]
+    for w in cases:
+        q = parse_sql(f'SELECT * FROM "t/#" WHERE {w}')
+        ctxs = [_gen_ctx(rng) for _ in range(24)]
+        mask = eval_where_rows(q, ctxs)
+        ref = np.array(
+            [_truthy(eval_expr(q.where, c)) for c in ctxs], bool
+        )
+        assert np.array_equal(np.asarray(mask), ref), w
+
+
+def test_truediv_and_null_semantics():
+    """Division by zero / undefined operands follow eval_expr: the row
+    drops (dyadic values keep f32 exact)."""
+    expr = _where("(payload.a / 2) > 0.5 and payload.b / payload.c = 4")
+    lanes = {}
+    prog, exact = compile_where(expr, lanes)
+    assert exact
+    ctxs = [
+        {"qos": 0, "topic": "t/1",
+         "payload": b'{"a": 3, "b": 8, "c": 2}'},  # True
+        {"qos": 0, "topic": "t/1",
+         "payload": b'{"a": 3, "b": 8, "c": 0}'},  # div0 -> drop
+        {"qos": 0, "topic": "t/1", "payload": b'{"b": 8, "c": 2}'},
+        {"qos": 0, "topic": "t/1", "payload": b"not json"},
+    ]
+    feats, valid, suspect = extract_features(ctxs, lanes)
+    mask = np.asarray(eval_prog(prog, feats, valid, np))
+    ref = [_truthy(eval_expr(expr, c)) for c in ctxs]
+    assert mask.tolist() == ref == [True, False, False, False]
+    assert not suspect.any()  # all rows well-typed or missing
+
+
+def test_null_equality_matches_scalar():
+    """None = None is True, None = x is False — on every rung."""
+    expr = _where("payload.a = payload.b")
+    lanes = {}
+    prog, _ = compile_where(expr, lanes)
+    ctxs = [
+        {"qos": 0, "topic": "t", "payload": b'{"a": 1, "b": 1}'},
+        {"qos": 0, "topic": "t", "payload": b'{"a": 1}'},
+        {"qos": 0, "topic": "t", "payload": b"{}"},  # both undefined
+    ]
+    feats, valid, _suspect = extract_features(ctxs, lanes)
+    mask = np.asarray(eval_prog(prog, feats, valid, np)).tolist()
+    ref = [_truthy(eval_expr(expr, c)) for c in ctxs]
+    assert mask == ref == [True, False, True]
+
+
+def test_uncompilable_returns_none_and_rolls_back_lanes():
+    lanes = {}
+    assert compile_where(_where("qos > 0"), lanes) is not None
+    n = len(lanes)
+    assert compile_where(
+        _where("lower(payload.s) = 'x' and payload.z > 1"), lanes
+    ) is None
+    assert len(lanes) == n  # the failed compile left no orphan lanes
+    assert compile_where(_where("clientid = 'c'"), lanes) is None
+    assert compile_where(
+        parse_sql(
+            'FOREACH payload.items FROM "t/#" WHERE qos > 0'
+        ).where, lanes
+    ) is not None  # WHERE itself compiles; the FILTER skips FOREACH
+
+
+def test_device_rule_filter_selects_eligible_rules():
+    from emqx_tpu.rules.engine import Console, Rule
+
+    rules = [
+        Rule("a", 'SELECT * FROM "t/#" WHERE qos > 0', [Console()]),
+        Rule("b", 'SELECT * FROM "t/#"', [Console()]),  # no WHERE
+        Rule("c", 'SELECT * FROM "$events/client_connected" '
+                  "WHERE qos > 0", [Console()]),  # event rule
+        Rule("d", 'SELECT * FROM "t/#" WHERE lower(payload.s) = \'x\'',
+             [Console()]),  # uncompilable
+        Rule("e", 'FOREACH payload.xs FROM "t/#" WHERE qos > 0',
+             [Console()]),  # FOREACH
+    ]
+    df = DeviceRuleFilter()
+    df.refresh(rules)
+    assert [c.rule.id for c in df.compiled] == ["a"]
+    assert df.covers("a") and not df.covers("d")
+    rules[0].enabled = False
+    df.refresh(rules)
+    assert not df.active
+
+
+# -- engine settle firing ----------------------------------------------------
+
+def _mk_rule_broker():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.broker.router import Router
+    from emqx_tpu.ops.matcher import MatcherConfig
+
+    return Broker(
+        router=Router(MatcherConfig(), min_tpu_batch=1), hooks=Hooks()
+    )
+
+
+def test_settle_fire_exactly_once_device_and_degraded():
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.rules.engine import FunctionOutput, RuleEngine
+
+    for enable_tpu in (True, False):
+        b = _mk_rule_broker()
+        b.router.enable_tpu = enable_tpu
+        eng = RuleEngine(b)
+        eng.attach(b.hooks)
+        fired = []
+        eng.create_rule(
+            "r1", 'SELECT qos FROM "t/#" WHERE payload.x >= 4',
+            [FunctionOutput(lambda row, ctx: fired.append(ctx["topic"]))],
+        )
+        eng.attach_device()
+        msgs = [
+            Message(topic=t, payload=pl, from_client="p")
+            for t, pl in [
+                ("t/hit", b'{"x": 5}'), ("t/miss", b'{"x": 1}'),
+                ("u/hit", b'{"x": 9}'),
+            ] * 2
+        ]
+        b.publish_batch(msgs)
+        assert fired == ["t/hit", "t/hit"], (enable_tpu, fired)
+        key = (
+            "rules.device.batches" if enable_tpu else "rules.host.batches"
+        )
+        assert b.metrics.get(key) == 1
+        assert b.metrics.get("rules.matched") == 4  # t/* rows only
+        assert b.metrics.get("rules.passed") == 2
+        assert b.metrics.get("rules.dropped") == 2
+        # no marker residue
+        assert not any("_batch_rules" in m.headers for m in msgs)
+
+
+def test_uncompilable_rules_stay_on_hook_path():
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.rules.engine import FunctionOutput, RuleEngine
+
+    b = _mk_rule_broker()
+    eng = RuleEngine(b)
+    eng.attach(b.hooks)
+    fired = []
+    eng.create_rule(
+        "host", "SELECT * FROM \"t/#\" WHERE lower(payload.s) = 'go'",
+        [FunctionOutput(lambda row, ctx: fired.append("host"))],
+    )
+    eng.create_rule(
+        "dev", 'SELECT * FROM "t/#" WHERE qos = 1',
+        [FunctionOutput(lambda row, ctx: fired.append("dev"))],
+    )
+    eng.attach_device()
+    assert [c.rule.id for c in eng.device_filter.compiled] == ["dev"]
+    msgs = [
+        Message(topic="t/1", qos=1, payload=b'{"s": "go"}',
+                from_client="p")
+        for _ in range(2)
+    ]
+    b.publish_batch(msgs)
+    # both rules fired once per message, through different paths
+    assert sorted(fired) == ["dev", "dev", "host", "host"]
+
+
+def test_sync_publish_path_fires_deferred_rules():
+    """A marked message that settles OUTSIDE the batch paths (sync
+    publish while ingest is 'running') still fires via the
+    per-message host rung in _route_dispatch."""
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.rules.engine import FunctionOutput, RuleEngine
+
+    b = _mk_rule_broker()
+    eng = RuleEngine(b)
+    eng.attach(b.hooks)
+    fired = []
+    eng.create_rule(
+        "r", 'SELECT * FROM "t/#" WHERE qos = 0',
+        [FunctionOutput(lambda row, ctx: fired.append(ctx["topic"]))],
+    )
+    eng.attach_device()
+    m = Message(topic="t/x", payload=b"{}", from_client="p")
+    m.headers["_batch_rules"] = True  # as the enqueue path would stamp
+    b._publish_folded(m)
+    assert fired == ["t/x"]
+    assert "_batch_rules" not in m.headers

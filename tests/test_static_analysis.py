@@ -150,6 +150,8 @@ def test_metric_checker_flags_undeclared_series():
         "session.sweep.dew", "session.redeliveriez",
         "fabric.slab.pub.recordz", "ingest.zerocopy.recordz",
         "dispatch.serialize.framez",
+        "semantic.filterz", "semantic.hitz",
+        "rules.matchd", "rules.device.batchez",
     }
 
 

@@ -222,6 +222,8 @@ def _configs_mesh() -> List[Dict]:
 
 def _cfg_key(cfg: Dict) -> str:
     parts = [f"B{cfg['B']}", f"k{cfg['kslot']}"]
+    if "D" in cfg:
+        parts.append(f"D{cfg['D']}")
     if "dp" in cfg:
         parts.append(f"dp{cfg['dp']}tp{cfg['tp']}")
     return "_".join(parts)
@@ -259,6 +261,21 @@ def _harness(name: str):
             {"B": 8, "kslot": 8},
             {"B": 16, "kslot": 8},
             {"B": 8, "kslot": 32},
+        ]
+    elif name == "semantic_match_step":
+        # kslot doubles as topk; the matrix pins the embedding-dim axis
+        # too (docs/semantic_routing.md)
+        configs = [
+            {"B": 8, "kslot": 4, "D": 16},
+            {"B": 8, "kslot": 8, "D": 16},
+            {"B": 8, "kslot": 4, "D": 32},
+        ]
+    elif name == "sem_dist_shape_step":
+        # the serving builder traced WITH a semantic table (+ one
+        # compiled rule predicate): 1x1 and 2x2 mesh rows
+        configs = [
+            {"B": 8, "kslot": 8, "D": 16, "dp": 1, "tp": 1},
+            {"B": 8, "kslot": 8, "D": 16, "dp": 2, "tp": 2},
         ]
     elif name == "sparse_shape_route_step":
         # the serving jit traced against a CSR subscriber table
@@ -365,6 +382,27 @@ def _harness(name: str):
                 }
 
             return sfn, (csr, matched)
+        if name == "semantic_match_step":
+            from emqx_tpu.ops.semantic_table import (
+                SemanticTable,
+                semantic_match_step,
+            )
+
+            sem = _sem_workload(cfg["D"], cfg["kslot"], shards=1)
+            st_sem = {
+                k: v.copy() for k, v in sem.device_snapshot().items()
+            }
+            matched = np.full((B, 8), -1, np.int32)
+            matched[:, 0] = np.arange(B, dtype=np.int32) % 4
+            qv = np.zeros((B, cfg["D"]), np.float32)
+
+            def qfn(st_sem, qv, matched):
+                sl, cnt = semantic_match_step(
+                    st_sem, qv, matched, cfg["kslot"]
+                )
+                return {"sem_slots": sl, "sem_count": cnt}
+
+            return qfn, (st_sem, qv, matched)
         if name == "sparse_shape_route_step":
             from emqx_tpu.models.router_model import shape_route_step
 
@@ -509,12 +547,46 @@ def _harness(name: str):
                 True,  # ret_narrow
             )
             return fn, (st, nt, None, None, None, None, bits, bytes_mat,
-                        lengths, rst, rnt, ret_bytes)
+                        lengths, rst, rnt, ret_bytes,
+                        None, None, None, None)
         from emqx_tpu.parallel.mesh import _dist_shape_step_fn
 
         with_nfa = index.residual_count > 0
         st = index.shapes.device_snapshot()
         nt = index.nfa.device_snapshot() if with_nfa else None
+        if name == "sem_dist_shape_step":
+            sem = _sem_workload(cfg["D"], cfg["kslot"], shards=cfg["tp"])
+            st_sem = {
+                k: v.copy() for k, v in sem.device_snapshot().items()
+            }
+            qv = np.zeros((B, cfg["D"]), np.float32)
+            # one compiled WHERE predicate rides the same golden: the
+            # in-launch rule-mask stage is pinned here too
+            prog = (("feat", 0), ("lit", 1.0), ("ge",))
+            rfeats = np.zeros((B, 1), np.float32)
+            rvalid = np.ones((B, 1), bool)
+            fn = _dist_shape_step_fn(
+                mesh,
+                tuple(sorted(st)),
+                tuple(sorted(nt)) if nt is not None else None,
+                None,  # group_keys
+                0,  # share_strategy
+                m_active,
+                salt,
+                kw["max_levels"],
+                kw["frontier"],
+                kw["max_matches"],
+                kw["probes"],
+                cfg["kslot"],
+                False,  # donate
+                None,  # sub_keys (dense fan-out)
+                0,  # kg
+                tuple(sorted(st_sem)),
+                cfg["kslot"],  # sem_topk
+                (prog,),
+            )
+            return fn, (st, nt, None, None, None, None, bits, bytes_mat,
+                        lengths, st_sem, qv, rfeats, rvalid)
         if name == "sparse_dist_shape_step":
             subs.set_mode("sparse")
             subs.set_shards(cfg["tp"])
@@ -540,7 +612,7 @@ def _harness(name: str):
                 0,  # kg (auto: 2 x kslot)
             )
             return fn, (st, nt, None, None, None, None, csr, bytes_mat,
-                        lengths)
+                        lengths, None, None, None, None)
         fn = _dist_shape_step_fn(
             mesh,
             tuple(sorted(st)),
@@ -556,9 +628,26 @@ def _harness(name: str):
             cfg["kslot"],
         )
         return fn, (st, nt, None, None, None, None, bits, bytes_mat,
-                    lengths)
+                    lengths, None, None, None, None)
 
     return configs, build
+
+
+def _sem_workload(dim: int, topk: int, shards: int = 1):
+    """Deterministic SemanticTable: scoped + unscoped + a tombstone."""
+    import numpy as np
+
+    from emqx_tpu.ops.semantic_table import SemanticTable
+
+    sem = SemanticTable(dim=dim, topk=topk, shards=shards)
+    rng = np.random.default_rng(0x5E)
+    for i in range(12):
+        sem.add(
+            64 + i, rng.normal(size=dim), 0.4,
+            fid=-1 if i % 3 == 0 else i % 4,
+        )
+    sem.remove(64 + 5)  # a tombstone lane in the golden
+    return sem
 
 
 class _SkipConfig(Exception):

@@ -41,6 +41,14 @@
 #                                           # microbench
 #                                           # (docs/protocol_plane.md,
 #                                           # serving_pipeline.md)
+#   python bench.py --configs agentic_fabric # semantic routing plane:
+#                                           # mixed topic+semantic
+#                                           # fan-in/fan-out scenarios,
+#                                           # device-fused similarity +
+#                                           # rule WHERE masks vs the
+#                                           # post-dispatch host filter
+#                                           # (~40s CPU —
+#                                           # docs/semantic_routing.md)
 #   python bench.py --configs mesh_serving  # scale-out sharded serving:
 #                                           # the four-scenario broker
 #                                           # matrix through the mesh
