@@ -525,6 +525,47 @@ class BrokerApp:
             self.broker.degrade = self.degrade
         else:
             self.degrade = None
+        # SLO-driven adaptive batching (broker/slo.py): the ingest
+        # window becomes a controlled variable holding a p99 target;
+        # the graded backpressure ladder (widen -> defer -> shed)
+        # replaces the binary shed cliff. Attached to BatchIngest (and
+        # the retained-storm feed) in start().
+        if c.slo.enable and c.router.ingest_enable and c.router.enable_tpu:
+            from emqx_tpu.broker.slo import SloController
+
+            self.slo = SloController(
+                metrics=self.broker.metrics,
+                target_p99_ms=c.slo.target_p99_ms,
+                min_window_us=c.slo.min_window_us,
+                max_window_us=c.slo.max_window_us,
+                initial_window_us=c.router.ingest_window_us,
+                eval_interval_s=c.slo.eval_interval_ms / 1e3,
+                min_samples=c.slo.min_samples,
+                gain=c.slo.gain,
+                hysteresis=c.slo.hysteresis,
+                ladder_patience=c.slo.ladder_patience,
+                defer_max_s=c.slo.defer_max_ms / 1e3,
+                starvation_s=c.slo.starvation_ms / 1e3,
+                shed_hard_mult=c.slo.shed_hard_mult,
+                olp=self.olp,
+                spans=self.spans,
+            )
+        else:
+            self.slo = None
+        self.slo_watch = None
+        if self.slo is not None and c.slo.alarm_enable:
+            from emqx_tpu.observe.alarm import SloViolationWatch
+
+            # level-triggered page on SUSTAINED target misses (the
+            # controller absorbs transient ones) — FallbackRateWatch's
+            # sibling, checked from housekeeping
+            self.slo_watch = SloViolationWatch(
+                self.alarms,
+                self.broker.metrics,
+                threshold=c.slo.alarm_threshold,
+                window=c.slo.alarm_window,
+                min_windows=c.slo.alarm_min_windows,
+            )
         # device runtime telemetry (observe/device_watch.py): compile /
         # retrace watch + HBM & transfer gauges, polled from housekeeping
         if c.router.enable_tpu:
@@ -767,6 +808,8 @@ class BrokerApp:
                 window_us=c.router.ingest_window_us,
                 pipeline=c.router.ingest_pipeline,
                 olp=self.olp,
+                slo=self.slo,
+                qos0_low=self.slo is not None and c.slo.qos0_low_lane,
             )
             self.broker.ingest.start()
             if c.retainer.enable and c.retainer.storm_ride:
@@ -785,6 +828,10 @@ class BrokerApp:
                         metrics=self.broker.metrics,
                         window_s=c.retainer.storm_window_us / 1e6,
                     )
+                    # retained replays are tagged low-priority: on the
+                    # SLO ladder's defer rung they sit launches out
+                    # instead of deepening an already-violating tail
+                    feed.slo = self.slo
                     self.retainer.storm_feed = feed
                     self.broker.retained_feed = feed
         # restore durable state BEFORE listeners accept clients
@@ -1121,8 +1168,11 @@ class BrokerApp:
             await asyncio.sleep(1.0)
             try:
                 now = time.time()
-                self.delayed.tick(now)
-                self.cm.sweep_expired(now)
+                # delayed dues + detached-session deadlines are
+                # MONOTONIC (clock-step immunity): let them read their
+                # own clock instead of passing wall time
+                self.delayed.tick()
+                self.cm.sweep_expired()
                 self.banned.sweep(now)
                 if self.flapping is not None:
                     self.flapping.sweep(now)
@@ -1139,6 +1189,8 @@ class BrokerApp:
                 self.alarms.sweep(now)
                 if self.fallback_watch is not None:
                     self.fallback_watch.check(now)
+                if self.slo_watch is not None:
+                    self.slo_watch.check(now)
                 if self.device_watch is not None:
                     self.device_watch.poll(now)
                 if self.retrace_watch is not None:

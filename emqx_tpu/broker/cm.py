@@ -162,7 +162,12 @@ class ChannelManager:
             store.unbind(sess.store_slot)
         expiry = sess.config.expiry_interval
         if expiry > 0:
-            self._detached[cid] = (sess, time.time() + expiry)
+            # monotonic deadline: a forward wall-clock step (NTP slew,
+            # suspend/resume) must not mass-expire every detached
+            # session — the inflight-window bug class PR 11 fixed.
+            # Persistence converts to a remaining-interval at snapshot
+            # time (persistent_session.py) so restarts still honor it.
+            self._detached[cid] = (sess, time.monotonic() + expiry)
             if store is not None and sess.store_slot is not None:
                 # arm the device expiry lane; the table rows stay put —
                 # resume is a rebind, never a rebuild
@@ -188,7 +193,10 @@ class ChannelManager:
         return True
 
     def sweep_expired(self, now: Optional[float] = None) -> int:
-        now = now or time.time()
+        """GC detached sessions past their expiry deadline. `now` is a
+        `time.monotonic()` value (tests patch it); wall time would make
+        every deadline hostage to clock steps."""
+        now = time.monotonic() if now is None else now
         gone = [cid for cid, (_, dl) in self._detached.items() if dl <= now]
         for cid in gone:
             self._drop_detached(cid)

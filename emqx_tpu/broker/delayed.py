@@ -58,13 +58,18 @@ class DelayedPublish:
         m = copy.copy(msg)
         m.topic = real_topic
         self._seq += 1
-        heapq.heappush(self._heap, (time.time() + delay, self._seq, m))
+        # monotonic deadline: a forward wall-clock step must not fire
+        # every delayed message at once (nor a backward one freeze them).
+        # DurableState persists the REMAINING interval and rebases here
+        # at restore (persistent_session.py).
+        heapq.heappush(self._heap, (time.monotonic() + delay, self._seq, m))
         # stop the fold with None acc => broker.publish drops the original
         return ("stop", None)
 
     def tick(self, now: Optional[float] = None) -> int:
-        """Publish all due messages; returns how many fired."""
-        now = now or time.time()
+        """Publish all due messages; returns how many fired. `now` is a
+        `time.monotonic()` value (tests patch it)."""
+        now = time.monotonic() if now is None else now
         n = 0
         while self._heap and self._heap[0][0] <= now:
             _, _, m = heapq.heappop(self._heap)
@@ -73,10 +78,14 @@ class DelayedPublish:
         return n
 
     def pending(self) -> List[Tuple[float, Message]]:
+        """[(monotonic due, msg)] — persistence converts to remaining
+        intervals before writing (a raw monotonic stamp is meaningless
+        in another process)."""
         return [(due, m) for due, _, m in sorted(self._heap)]
 
     def load(self, due: float, msg: Message) -> bool:
-        """Direct insert for durable-state restore; honors the cap."""
+        """Direct insert for durable-state restore (`due` is a
+        `time.monotonic()` deadline); honors the cap."""
         if self.max_messages and len(self._heap) >= self.max_messages:
             self.dropped += 1
             return False

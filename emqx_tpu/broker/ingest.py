@@ -1,12 +1,25 @@
-"""Ingest-side publish batch aggregation (adaptive batch window).
+"""Ingest-side publish batch aggregation (SLO-adaptive batch window).
 
 SURVEY.md §7 hard part (c): the device route path wants big batches, but a
 publishing client wants low latency. This aggregator sits between the
 channel's publish and the router: concurrent publishes from all connections
-collect into one list, flushed when either `max_batch` messages are pending
-or `window_us` has elapsed since the flusher woke — so a lone publisher
-pays at most one window of added latency while a firehose fills batches
-immediately and never sleeps.
+collect into priority lanes, flushed when either `max_batch` messages are
+pending or the window has elapsed since the flusher woke — so a lone
+publisher pays at most one window of added latency while a firehose fills
+batches immediately and never sleeps.
+
+The window is no longer a fixed policy: with an `SloController` attached
+(broker/slo.py), it adapts each flush cycle to hold a configured
+enqueue->settle p99 target — decaying toward zero when idle (immediate
+partial launches), deepening under storm, and walking the graded
+backpressure ladder (widen -> defer low lanes -> shed) instead of the old
+binary `IngestShed` cliff.
+
+Priority lanes: `control` (QoS2 control flow, $SYS) > `normal` (QoS1) >
+`low` (QoS0 firehose when `qos0_low`, explicitly tagged messages). The
+flusher assembles batches in lane order with an anti-starvation reserve,
+so a retained-storm or QoS0 flood can never queue a PUBREL or a $SYS
+heartbeat behind itself (docs/robustness.md "Priority lanes").
 
 The reference has no analog — its hot loop is per-message per-process
 (emqx_broker.erl:204-215); this is the TPU-era replacement for that regime,
@@ -14,14 +27,15 @@ turning N concurrent publishes into one route_step kernel launch
 (emqx_tpu.models.router_model.DeviceRouter).
 
 Backpressure: `submit` awaits the flush result, so a publisher's PUBACK
-reflects actual dispatch; the pending list is bounded only by connection
-count x inflight windows, which the per-connection limiters already cap.
+reflects actual dispatch; the pending lanes are bounded by the shed ladder
+(SLO mode) or the legacy overload gate.
 
 Flight recorder: every latency/throughput tradeoff this loop makes is
 recorded into the broker's metrics (docs/observability.md) — batch size and
-occupancy, window hold time, pipeline depth, per-message enqueue->settle
-latency, and launch/dispatch failures — plus `ingest.launch`/`ingest.settle`
-tracepoints keyed by batch seq for causal assertions in tests.
+occupancy, window hold time, pipeline depth, per-message AND per-lane
+enqueue->settle latency, lane depths, and launch/dispatch failures — plus
+`ingest.launch`/`ingest.settle` tracepoints keyed by batch seq for causal
+assertions in tests.
 """
 
 from __future__ import annotations
@@ -35,11 +49,23 @@ from typing import List, Optional, Tuple
 from emqx_tpu.broker.degrade import OPEN, IngestShed
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.broker.slo import (
+    LANE_CONTROL,
+    LANE_LOW,
+    LANE_NAMES,
+    LANE_NORMAL,
+    RUNG_NAMES,
+)
 from emqx_tpu.observe import faults as _faults
 from emqx_tpu.observe.spans import TRACE_HEADER
 from emqx_tpu.utils.tracepoints import tp
 
 log = logging.getLogger("emqx_tpu.ingest")
+
+LANE_DEPTH_SERIES = tuple(f"ingest.lane.depth.{n}" for n in LANE_NAMES)
+LANE_SETTLE_SERIES = tuple(
+    f"ingest.lane.settle.seconds.{n}" for n in LANE_NAMES
+)
 
 
 class BatchIngest:
@@ -50,16 +76,27 @@ class BatchIngest:
         window_us: int = 1000,
         pipeline: int = 2,
         olp=None,
+        slo=None,
+        qos0_low: bool = False,
     ):
         self.broker = broker
         self.max_batch = max_batch
         self.window_s = window_us / 1e6
         # overload-protection signal (broker/olp.py): with the broker's
-        # DegradeController attached, enqueues shed once the pending
-        # backlog passes the shed bound while olp.is_overloaded() holds
-        # or the device breaker is open — backpressure instead of
-        # unbounded queue growth behind a broken fast path
+        # DegradeController attached (and no SLO controller), enqueues
+        # shed once the pending backlog passes the shed bound while
+        # olp.is_overloaded() holds or the device breaker is open —
+        # backpressure instead of unbounded queue growth behind a broken
+        # fast path. With an SloController the graded ladder owns
+        # admission instead (shed is the LAST rung).
         self.olp = olp
+        # SLO-adaptive batching (broker/slo.py): adapts window_s each
+        # flush cycle + owns the defer/shed ladder. None = legacy fixed
+        # window (unit tests, knob off).
+        self.slo = slo
+        # lane policy: route QoS0 publishes to the low-priority lane
+        # (the firehose a $SYS heartbeat must never queue behind)
+        self.qos0_low = qos0_low
         # device dispatches in flight at once: batch N+1's table upload +
         # kernel launch overlaps batch N's readback round-trip (the
         # dominant per-batch wall when the chip sits behind a network
@@ -68,12 +105,20 @@ class BatchIngest:
         # delivery order holds across batches.
         self.pipeline = max(1, pipeline)
         self.metrics: Metrics = getattr(broker, "metrics", None) or Metrics()
-        # (msg, puback future, enqueue perf_counter timestamp)
-        self._pending: List[Tuple[Message, asyncio.Future, float]] = []
+        # per-lane pending lists of (msg, puback future, enqueue
+        # perf_counter timestamp, lane). `_pending` stays the NORMAL
+        # lane's list (the historical name — shed/backlog tests and the
+        # stop() drain reach it directly).
+        self._lane_hi: List[Tuple] = []
+        self._pending: List[Tuple] = []
+        self._lane_lo: List[Tuple] = []
         self._inflight: deque = deque()  # (seq, batch, pending, batch_span)
         self._event = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._seq = 0
+        # anti-starvation bound for the low lane under sustained
+        # control/normal pressure (SloController overrides from config)
+        self.starvation_s = slo.starvation_s if slo is not None else 0.05
         # perf_counter stamp of the moment the LAST in-flight dispatch's
         # device work completed (None = device busy or never launched);
         # the gap until the next launch is the ingest.device.idle series
@@ -95,59 +140,137 @@ class BatchIngest:
                 pass
             self._task = None
         # drain launched-but-unsettled batches first (FIFO), then
-        # anything still pending, so no publisher hangs on shutdown
+        # anything still pending (defer gates ignored: shutdown delivers
+        # everything), so no publisher hangs on shutdown
         while self._inflight:
             seq, batch, pd, bsp = self._inflight.popleft()
             await self._finish(seq, batch, pd.complete(), bsp)
-        while self._pending:
-            batch = self._pending[: self.max_batch]
-            del self._pending[: self.max_batch]
+        while self._backlog():
+            batch = self._take_batch(time.perf_counter(), force=True)
             await self._settle(batch)
 
-    def enqueue(self, msg: Message) -> asyncio.Future:
+    # -- lanes --------------------------------------------------------------
+    def _backlog(self) -> int:
+        return len(self._lane_hi) + len(self._pending) + len(self._lane_lo)
+
+    def lane_of(self, msg: Message) -> int:
+        """Priority-lane classification (docs/robustness.md): QoS2
+        control flow and $SYS ride the control lane (they must never
+        queue behind a firehose); QoS0 rides low when the lane policy is
+        armed; explicit `ingest_lane` headers win."""
+        ln = msg.headers.get("ingest_lane")
+        if ln == "control":
+            return LANE_CONTROL
+        if ln == "low":
+            return LANE_LOW
+        if msg.qos == 2 or msg.is_sys():
+            return LANE_CONTROL
+        if msg.qos == 0 and self.qos0_low:
+            return LANE_LOW
+        return LANE_NORMAL
+
+    def _lane_list(self, lane: int) -> List[Tuple]:
+        if lane == LANE_CONTROL:
+            return self._lane_hi
+        if lane == LANE_LOW:
+            return self._lane_lo
+        return self._pending
+
+    def enqueue(self, msg: Message, lane: Optional[int] = None) -> asyncio.Future:
         """Enqueue one folded message; the future resolves with its
         delivery count when the batch flushes.
 
-        Shed gate (docs/robustness.md): while the broker is overloaded
-        (olp) or the device breaker is open, a backlog past the shed
-        bound refuses new enqueues with `IngestShed` on the returned
-        future — the publisher's PUBACK fails (QoS>=1 clients retry)
-        instead of the pending list growing without bound behind a
-        degraded pipeline."""
+        Admission (docs/robustness.md): with an SloController attached,
+        the graded ladder decides — control never sheds, low sheds at
+        the queue bound on the `shed` rung, normal at twice the bound,
+        and `shed_hard_mult` x bound is the absolute valve. Without a
+        controller the legacy binary gate holds: while the broker is
+        overloaded (olp) or the device breaker is open, a backlog past
+        the shed bound refuses new enqueues with `IngestShed` on the
+        returned future — the publisher's PUBACK fails (QoS>=1 clients
+        retry) instead of the pending list growing without bound."""
         act = _faults.hit("ingest.enqueue")  # raise -> publisher's task
         fut = asyncio.get_running_loop().create_future()
+        if lane is None:
+            lane = self.lane_of(msg)
         shed = act == "drop"
         deg = getattr(self.broker, "degrade", None)
-        if (
-            not shed
-            and deg is not None
-            and len(self._pending)
-            >= deg.shed_queue_batches * self.max_batch
-            and (
-                (self.olp is not None and self.olp.is_overloaded())
-                or deg.device.state == OPEN
-            )
-        ):
-            shed = True
+        if not shed and deg is not None:
+            bound = deg.shed_queue_batches * self.max_batch
+            if self.slo is not None:
+                if self.slo.shed(lane, self._backlog(), bound):
+                    shed = True
+                    self.metrics.inc("slo.shed")
+            elif (
+                len(self._pending) >= bound
+                and (
+                    (self.olp is not None and self.olp.is_overloaded())
+                    or deg.device.state == OPEN
+                )
+            ):
+                shed = True
         if shed:
             self.metrics.inc("ingest.shed")
             fut.set_exception(
                 IngestShed("ingest backlog shed (overload/degraded)")
             )
             return fut
-        self._pending.append((msg, fut, time.perf_counter()))
+        self._lane_list(lane).append((msg, fut, time.perf_counter(), lane))
         self._event.set()
         return fut
 
     async def submit(self, msg: Message) -> int:
         return await self.enqueue(msg)
 
+    def _take_batch(self, now: float, force: bool = False) -> List[Tuple]:
+        """Assemble up to max_batch in lane-priority order. The low lane
+        joins unless the SLO ladder defers it (never past its defer age
+        bound); a starvation reserve guarantees the low lane slots once
+        its head has waited `starvation_s` behind full priority lanes.
+        `force` (shutdown drain) ignores the defer gate."""
+        cap = self.max_batch
+        batch: List[Tuple] = []
+        hi, no, lo = self._lane_hi, self._pending, self._lane_lo
+        if hi:
+            take = hi[:cap]
+            del hi[: len(take)]
+            batch.extend(take)
+        room = cap - len(batch)
+        if room > 0 and no:
+            # anti-starvation reserve: when the low lane's head already
+            # waited past the bound, hold slots open so a saturated
+            # normal lane cannot push it out forever
+            reserve = 0
+            if lo and len(no) >= room and (now - lo[0][2]) >= self.starvation_s:
+                reserve = max(1, cap // 16)
+                self.metrics.inc("ingest.lane.starvation.breaks")
+            n_take = min(len(no), max(0, room - reserve))
+            if n_take:
+                batch.extend(no[:n_take])
+                del no[:n_take]
+            room = cap - len(batch)
+        if room > 0 and lo:
+            slo = self.slo
+            if (
+                not force
+                and slo is not None
+                and slo.defer_low(now - lo[0][2])
+            ):
+                # `defer` rung: the low lane sits this launch out so the
+                # storm drains control/normal first (delayed, not lost)
+                self.metrics.inc("slo.deferrals")
+            else:
+                take = lo[:room]
+                del lo[: len(take)]
+                batch.extend(take)
+        return batch
+
     async def _settle(self, batch) -> None:
         seq, bsp = self._next_seq(batch)
         await self._finish(
             seq, batch,
             self.broker.adispatch_begin(
-                [m for m, _, _ in batch], batch_span=bsp
+                [m for m, _, _, _ in batch], batch_span=bsp
             ),
             bsp,
         )
@@ -166,10 +289,15 @@ class BatchIngest:
         tp("ingest.launch", batch=seq, n=n)
         rec = getattr(self.broker, "spans", None)
         bsp = (
-            rec.batch_begin(seq, [m for m, _, _ in batch], self.max_batch)
+            rec.batch_begin(seq, [m for m, _, _, _ in batch], self.max_batch)
             if rec is not None
             else None
         )
+        if bsp is not None and self.slo is not None:
+            # controller state rides the batch span: a trace shows the
+            # window/rung THIS batch launched under
+            bsp.attrs["slo.window_us"] = round(self.slo.window_s * 1e6, 1)
+            bsp.attrs["slo.rung"] = RUNG_NAMES[self.slo.rung]
         return seq, bsp
 
     async def _finish(self, seq: int, batch, aw, bsp=None) -> None:
@@ -179,7 +307,7 @@ class BatchIngest:
         except Exception as e:  # noqa: BLE001 — flusher must survive
             log.exception("batch dispatch failed; failing %d publishes", len(batch))
             self.metrics.inc("ingest.dispatch.errors")
-            for m, fut, _ in batch:
+            for m, fut, _, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
                 if rec is not None:
@@ -190,16 +318,23 @@ class BatchIngest:
                 rec.finish(bsp, {"error": str(e)}, status="error")
             return
         now = time.perf_counter()
-        for (m, fut, _), n in zip(batch, results):
+        lane_lats: List[List[float]] = [[], [], []]
+        for (m, fut, t0, lane), n in zip(batch, results):
             if not fut.done():
                 fut.set_result(n)
+            lane_lats[lane].append(now - t0)
             if rec is not None:
                 # settle the publish span by its context header (the
                 # fan-in edge back to the publisher's trace)
                 rec.publish_finish(m.headers.get(TRACE_HEADER), n)
         self.metrics.observe_many(
-            "ingest.settle.seconds", [now - t0 for _, _, t0 in batch]
+            "ingest.settle.seconds", [now - t0 for _, _, t0, _ in batch]
         )
+        for lane, lats in enumerate(lane_lats):
+            if lats:
+                # per-lane tails: the chaos/bench gates assert the
+                # control lane stays bounded while the low lane storms
+                self.metrics.observe_many(LANE_SETTLE_SERIES[lane], lats)
         if rec is not None and bsp is not None:
             rec.finish(bsp)
         tp("ingest.settle", batch=seq, n=len(batch))
@@ -223,16 +358,26 @@ class BatchIngest:
 
     async def _run(self) -> None:
         while True:
-            if not self._inflight and not self._pending:
+            slo = self.slo
+            if slo is not None:
+                deg = getattr(self.broker, "degrade", None)
+                self.window_s = slo.tick(
+                    backlog=self._backlog(),
+                    breaker_open=(
+                        deg is not None and deg.device.state == OPEN
+                    ),
+                )
+            if not self._inflight and not self._backlog():
                 await self._event.wait()
             # one loop tick: every connection task that is ready to publish
             # gets to enqueue before we decide whether a window is worth it
             await asyncio.sleep(0)
+            backlog = self._backlog()
             if (
                 self.window_s > 0
                 and not self._inflight
-                and len(self._pending) >= self._engage_threshold()
-                and len(self._pending) < self.max_batch
+                and backlog >= self._engage_threshold()
+                and backlog < self.max_batch
             ):
                 # real concurrency: hold the window open to fill the batch
                 t0 = time.perf_counter()
@@ -253,16 +398,19 @@ class BatchIngest:
             batch: List = []
             if (
                 not self._inflight
-                or len(self._pending) >= self.max_batch
+                or self._backlog() >= self.max_batch
                 or (
-                    self._pending
+                    self._backlog()
                     and len(self._inflight) < self.pipeline
                     and self._device_idle()
                 )
             ):
-                batch = self._pending[: self.max_batch]
-                del self._pending[: self.max_batch]
+                batch = self._take_batch(time.perf_counter())
             if batch:
+                for lane, series in enumerate(LANE_DEPTH_SERIES):
+                    self.metrics.gauge_set(
+                        series, len(self._lane_list(lane))
+                    )
                 if self._device_done_t is not None:
                     self.metrics.observe(
                         "ingest.device.idle.seconds",
@@ -278,13 +426,13 @@ class BatchIngest:
                 seq, bsp = self._next_seq(batch)
                 try:
                     pd = self.broker.adispatch_begin(
-                        [m for m, _, _ in batch], batch_span=bsp
+                        [m for m, _, _, _ in batch], batch_span=bsp
                     )
                 except Exception as e:  # noqa: BLE001 — flusher survives
                     log.exception("batch launch failed")
                     self.metrics.inc("ingest.launch.errors")
                     rec = getattr(self.broker, "spans", None)
-                    for m, fut, _ in batch:
+                    for m, fut, _, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
                         if rec is not None:
@@ -302,13 +450,18 @@ class BatchIngest:
                         "ingest.pipeline.depth", len(self._inflight)
                     )
             if not self._inflight:
-                if not self._pending:
+                if not self._backlog():
                     self._event.clear()
+                elif not batch:
+                    # everything pending is lane-deferred: nothing is
+                    # launchable until the defer age bound releases it —
+                    # bounded poll, never a busy spin
+                    await asyncio.sleep(max(self.window_s, 0.001))
                 continue
             if len(self._inflight) >= self.pipeline:
                 seq, b, pd, bsp = self._inflight.popleft()
                 await self._finish(seq, b, pd.complete(), bsp)
-            elif not batch or not self._pending:
+            elif not batch or not self._backlog():
                 # dispatch in flight, nothing launchable: settle when
                 # the device work completes OR re-check the moment new
                 # publishes arrive (they may fill a full batch). The
@@ -334,7 +487,7 @@ class BatchIngest:
                         await asyncio.gather(ev, return_exceptions=True)
                 if oldest_ready.done():
                     if (
-                        self._pending
+                        self._backlog()
                         and len(self._inflight) < self.pipeline
                         and self._device_idle()
                     ):

@@ -119,6 +119,14 @@ class SlabMessage(Message):
             return self._slab.topic_bytes(self._i)
         return self.topic.encode("utf-8", "surrogatepass")
 
+    def is_sys(self) -> bool:
+        # lane classification (broker/ingest.py lane_of) runs on every
+        # enqueue: answer from the slab view, never force a str decode
+        if self._slab is not None and self._topic is None:
+            tb = self._slab.topic_bytes(self._i)
+            return bytes(tb[:5]) == b"$SYS/"
+        return self.topic.startswith("$SYS/")
+
     def topic_key(self):
         if self._slab is not None and self._topic is None:
             from emqx_tpu.ops.tokenizer import TopicRef

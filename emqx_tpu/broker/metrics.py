@@ -327,6 +327,27 @@ declare("degrade.fallback.batches", COUNTER,
 declare("ingest.shed", COUNTER,
         "enqueues refused at the ingest gate (olp overloaded or device "
         "breaker open past the queue bound) — backpressure, not loss")
+# SLO-driven adaptive batching (broker/slo.py; docs/robustness.md) -------
+declare("slo.window_us", GAUGE,
+        "current adaptive ingest window (microseconds)")
+declare("slo.ladder.rung", GAUGE,
+        "backpressure ladder rung: 0 normal, 1 widen, 2 defer, 3 shed")
+declare("slo.p99.observed_ms", GAUGE,
+        "enqueue->settle p99 over the last SLO evaluation window")
+declare("slo.p99.target_ms", GAUGE,
+        "configured p99 target the controller holds")
+declare("slo.eval.windows", COUNTER,
+        "SLO controller evaluation windows closed")
+declare("slo.violations", COUNTER,
+        "evaluation windows whose observed p99 missed the target")
+declare("slo.adjustments", COUNTER,
+        "window-size changes the controller applied")
+declare("slo.deferrals", COUNTER,
+        "launches the low-priority lane sat out on the defer rung")
+declare("slo.shed", COUNTER,
+        "enqueues refused by the graded shed rung (subset of ingest.shed)")
+declare("retained.storm.deferred", COUNTER,
+        "storm fuses/flushes deferred by the SLO ladder")
 declare("router.sync.rollback", COUNTER,
         "dirty prepares that failed or tore and rolled back to the "
         "last good epoch snapshot")
@@ -412,6 +433,22 @@ declare("ingest.device.idle.seconds", HISTOGRAM,
         "gap between the pipeline's device side draining and the next "
         "launch (the wall the idle partial-batch launch rule closes)",
         buckets=LATENCY_BUCKETS, unit="seconds")
+declare("ingest.lane.depth.control", GAUGE,
+        "pending control-lane messages (QoS2 flow / $SYS) at launch")
+declare("ingest.lane.depth.normal", GAUGE,
+        "pending normal-lane messages at launch")
+declare("ingest.lane.depth.low", GAUGE,
+        "pending low-lane messages (QoS0 firehose / tagged) at launch")
+declare("ingest.lane.settle.seconds.control", HISTOGRAM,
+        "control-lane enqueue->settle latency (the bounded-tail gate)",
+        unit="seconds")
+declare("ingest.lane.settle.seconds.normal", HISTOGRAM,
+        "normal-lane enqueue->settle latency", unit="seconds")
+declare("ingest.lane.settle.seconds.low", HISTOGRAM,
+        "low-lane enqueue->settle latency (defer-eligible)",
+        unit="seconds")
+declare("ingest.lane.starvation.breaks", COUNTER,
+        "launches that reserved low-lane slots past the starvation bound")
 declare("ingest.launch.errors", COUNTER,
         "batch launches that raised before reaching the device")
 declare("ingest.dispatch.errors", COUNTER,

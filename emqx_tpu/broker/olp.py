@@ -49,6 +49,15 @@ class Olp:
     def is_overloaded(self) -> bool:
         return self.enable and time.monotonic() < self._overloaded_until
 
+    def pressure(self) -> float:
+        """Graded overload signal: last sampled loop lag as a fraction
+        of the watermark (1.0 = at the trip point). The SLO controller
+        and the hotpath REST read this — `is_overloaded()` is the binary
+        trip, this is the dial behind it."""
+        if not self.enable or self.lag_watermark_ms <= 0:
+            return 0.0
+        return self.last_lag_ms / self.lag_watermark_ms
+
     def note_lag(self, lag_ms: float) -> None:
         self.last_lag_ms = lag_ms
         if self.metrics is not None:

@@ -125,10 +125,15 @@ class SessionPersistence:
         if not (self._dirty or force or self.cm._detached):
             return False
         now = time.time()
+        mono = time.monotonic()
         sessions = {}
         for cid, (sess, deadline) in self.cm._detached.items():
             snap = session_to_json(sess)
-            snap["deadline"] = deadline
+            # deadlines are monotonic (cm.py): persist the REMAINING
+            # interval — a raw monotonic stamp means nothing after a
+            # restart, and a wall deadline re-imports the clock-step
+            # mass-expiry this snapshot format exists to avoid
+            snap["expiry_remaining_s"] = max(0.0, deadline - mono)
             sessions[cid] = snap
         self.kv.write(NS_SESSIONS, {"at": now, "sessions": sessions})
         if self.wal is not None:
@@ -144,16 +149,25 @@ class SessionPersistence:
         if not data:
             return 0
         now = time.time()
+        mono = time.monotonic()
         n = 0
         for cid, snap in data.get("sessions", {}).items():
-            deadline = snap.get("deadline", 0)
-            if deadline <= now:
+            if "expiry_remaining_s" in snap:
+                # downtime still counts against the interval: subtract
+                # the wall time elapsed since the snapshot was cut
+                remaining = float(snap["expiry_remaining_s"]) - max(
+                    0.0, now - float(data.get("at", now))
+                )
+            else:
+                # legacy snapshot: wall-clock deadline; rebase once
+                remaining = snap.get("deadline", 0) - now
+            if remaining <= 0:
                 continue  # expired while the broker was down
             sess = session_from_json(snap, self.session_config)
             deliver = make_detached_deliverer(sess, self.wal, cid)
             for f, opts in sess.subscriptions.items():
                 self.broker.subscribe(cid, cid, f, opts, deliver)
-            self.cm._detached[cid] = (sess, deadline)
+            self.cm._detached[cid] = (sess, mono + remaining)
             n += 1
         if self.wal is not None:
             # replay the post-snapshot suffix: messages banked after the
@@ -197,13 +211,21 @@ class DurableState:
                     msgs.append(msg_to_json(m))
             self.kv.write(NS_RETAINED, {"messages": msgs})
         if self.delayed is not None:
+            mono = time.monotonic()
             self.kv.write(
                 NS_DELAYED,
                 {
+                    # remaining intervals, not deadlines: delayed dues
+                    # are monotonic (broker/delayed.py) — `at` lets the
+                    # restore charge the downtime against them
+                    "at": time.time(),
                     "messages": [
-                        {"due": due, "msg": msg_to_json(m)}
+                        {
+                            "remaining_s": max(0.0, due - mono),
+                            "msg": msg_to_json(m),
+                        }
                         for due, m in self.delayed.pending()
-                    ]
+                    ],
                 },
             )
         if self.banned is not None:
@@ -242,11 +264,20 @@ class DurableState:
                     out["retained"] += 1
         if self.delayed is not None:
             data = self.kv.read(NS_DELAYED)
+            now = time.time()
+            mono = time.monotonic()
+            downtime = max(0.0, now - float((data or {}).get("at", now)))
             for d in (data or {}).get("messages", []):
                 m = msg_from_json(d["msg"])
                 if m.is_expired():
                     continue
-                if self.delayed.load(d["due"], m):
+                if "remaining_s" in d:
+                    due = mono + max(
+                        0.0, float(d["remaining_s"]) - downtime
+                    )
+                else:  # legacy wall-deadline snapshot: rebase once
+                    due = mono + max(0.0, float(d["due"]) - now)
+                if self.delayed.load(due, m):
                     out["delayed"] += 1
         if self.banned is not None:
             from emqx_tpu.broker.banned import BanEntry

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional
 
 from emqx_tpu.observe import faults as _faults
@@ -36,16 +37,37 @@ log = logging.getLogger("emqx_tpu.retained_feed")
 
 
 class RetainedStormFeed:
+    # the feed is LOW-priority work by construction: a retained replay
+    # is best-effort catch-up traffic, so under SLO backpressure it
+    # defers behind live control/normal publishes (broker/slo.py)
+    LANE = "low"
+
     def __init__(self, retained_index, metrics=None, window_s: float = 0.002):
         self.index = retained_index
         self.metrics = metrics
         self.window_s = window_s
+        # SloController (broker/slo.py), attached by the app: on the
+        # `defer` rung and above, pending storms sit launches out (and
+        # the standalone flush re-arms) until the defer age bound —
+        # a replay flood never deepens an already-violating tail
+        self.slo = None
         # filter -> [futures]; multiple subscribers to the same filter
         # share one lane in the storm's shape table
         self._pending: Dict[str, List[asyncio.Future]] = {}
+        self._oldest_t: Optional[float] = None  # first pending submit
         self._waiters: Dict[int, Dict] = {}  # id(job) -> waiters
         self._timer = None
         self._flushing = False  # a standalone match_many pass in flight
+
+    def head_age(self, now: Optional[float] = None) -> float:
+        """Seconds the OLDEST pending replay has waited (0 when none) —
+        the anti-starvation input to the SLO defer gate."""
+        if self._oldest_t is None:
+            return 0.0
+        return (time.monotonic() if now is None else now) - self._oldest_t
+
+    def _deferred(self) -> bool:
+        return self.slo is not None and self.slo.defer_low(self.head_age())
 
     def __len__(self) -> int:
         return len(self._pending)
@@ -56,6 +78,8 @@ class RetainedStormFeed:
         list (or an exception — callers fall back to the CPU walk)."""
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
+        if not self._pending:
+            self._oldest_t = time.monotonic()
         self._pending.setdefault(filter_, []).append(fut)
         if self.metrics is not None:
             self.metrics.inc("retained.storm.filters")
@@ -71,6 +95,13 @@ class RetainedStormFeed:
         fusable / a standalone flush already owns the pending set)."""
         if not self._pending or self._flushing:
             return None
+        if self._deferred():
+            # SLO `defer` rung: the replay storm is low-priority — let
+            # THIS launch carry only live traffic; the storm rides a
+            # later one (or the age bound forces it through)
+            if self.metrics is not None:
+                self.metrics.inc("retained.storm.deferred")
+            return None
         filters = list(self._pending)
         job = None
         try:
@@ -84,6 +115,7 @@ class RetainedStormFeed:
             # not fusable (empty index / over-budget filter): answer the
             # waiters with a CPU-fallback signal now
             waiters, self._pending = self._pending, {}
+            self._oldest_t = None
             self._cancel_timer()
             for futs in waiters.values():
                 for f in futs:
@@ -91,6 +123,7 @@ class RetainedStormFeed:
                         f.set_result(None)
             return None
         waiters, self._pending = self._pending, {}
+        self._oldest_t = None
         self._cancel_timer()
         self._waiters[id(job)] = waiters
         if self.metrics is not None:
@@ -151,8 +184,19 @@ class RetainedStormFeed:
 
     def _on_window(self) -> None:
         self._timer = None
-        if self._pending and not self._flushing:
-            asyncio.ensure_future(self._flush())
+        if not self._pending or self._flushing:
+            return
+        if self._deferred():
+            # deferred: re-arm instead of flushing — the standalone pass
+            # costs a launch train exactly when the ladder says the
+            # pipeline can't afford one. head_age bounds the wait.
+            if self.metrics is not None:
+                self.metrics.inc("retained.storm.deferred")
+            self._timer = asyncio.get_running_loop().call_later(
+                self.window_s, self._on_window
+            )
+            return
+        asyncio.ensure_future(self._flush())
 
     async def _flush(self) -> None:
         """No publish launch took the storm inside the window: answer it
@@ -164,6 +208,7 @@ class RetainedStormFeed:
         self._flushing = True
         try:
             waiters, self._pending = self._pending, {}
+            self._oldest_t = None
             filters = list(waiters)
             if self.metrics is not None:
                 self.metrics.inc("retained.storm.flushed")

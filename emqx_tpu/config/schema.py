@@ -330,6 +330,35 @@ class OlpConfig:
     cooldown: float = 5.0
 
 
+@dataclass
+class SloConfig:
+    """SLO-driven adaptive batching (broker/slo.py): the ingest window
+    as a controlled variable holding a p99 target, priority lanes, and
+    the graded backpressure ladder (widen -> defer -> shed) replacing
+    the binary shed cliff. docs/robustness.md "SLO controller"."""
+
+    enable: bool = True
+    target_p99_ms: float = 5.0
+    # window bounds the controller adapts inside; the initial value is
+    # router.ingest_window_us (continuity with the fixed-window era)
+    min_window_us: int = 0
+    max_window_us: int = 20000
+    eval_interval_ms: float = 50.0  # one look per flush-cycle stretch
+    min_samples: int = 32  # settles needed to judge a tail
+    gain: float = 0.25  # multiplicative widen/narrow step
+    hysteresis: float = 0.7  # hold inside [hysteresis*target, target]
+    ladder_patience: int = 3  # consecutive readings to move a rung
+    defer_max_ms: float = 250.0  # low-lane defer age bound (starvation)
+    starvation_ms: float = 50.0  # lane-fairness reserve trigger
+    shed_hard_mult: float = 4.0  # absolute backlog valve (x shed bound)
+    qos0_low_lane: bool = True  # QoS0 publishes ride the low lane
+    # sustained-miss alarm (observe/alarm.py SloViolationWatch)
+    alarm_enable: bool = True
+    alarm_threshold: float = 0.5  # violating fraction of eval windows
+    alarm_window: float = 10.0
+    alarm_min_windows: int = 4
+
+
 # Every injectable fault site (observe/faults.py). These literals MUST
 # stay in lockstep with faults.SITES — the FT checker in tools/analysis
 # statically cross-checks the two, so a site added to the injector
@@ -589,6 +618,7 @@ class AppConfig:
     # message_in, connection, message_routing (emqx_limiter schema analog)
     limiter: Dict[str, Any] = field(default_factory=dict)
     olp: OlpConfig = field(default_factory=OlpConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     degrade: DegradeConfig = field(default_factory=DegradeConfig)
     force_gc: ForceGcConfig = field(default_factory=ForceGcConfig)
@@ -821,6 +851,26 @@ def _validate(cfg: AppConfig) -> None:
         raise ConfigError("degrade.open_secs must be >= 0")
     if cfg.degrade.shed_queue_batches < 1:
         raise ConfigError("degrade.shed_queue_batches must be >= 1")
+    if cfg.slo.target_p99_ms <= 0:
+        raise ConfigError("slo.target_p99_ms must be > 0")
+    if cfg.slo.min_window_us < 0:
+        raise ConfigError("slo.min_window_us must be >= 0")
+    if cfg.slo.max_window_us < cfg.slo.min_window_us:
+        raise ConfigError(
+            "slo.max_window_us must be >= slo.min_window_us"
+        )
+    if not 0.0 < cfg.slo.gain < 1.0:
+        raise ConfigError("slo.gain must be in (0, 1)")
+    if not 0.0 <= cfg.slo.hysteresis <= 1.0:
+        raise ConfigError("slo.hysteresis must be in [0, 1]")
+    if cfg.slo.ladder_patience < 1:
+        raise ConfigError("slo.ladder_patience must be >= 1")
+    if cfg.slo.shed_hard_mult < 1.0:
+        raise ConfigError("slo.shed_hard_mult must be >= 1.0")
+    if cfg.slo.eval_interval_ms <= 0:
+        raise ConfigError("slo.eval_interval_ms must be > 0")
+    if not 0.0 < cfg.slo.alarm_threshold <= 1.0:
+        raise ConfigError("slo.alarm_threshold must be in (0, 1]")
     if cfg.cluster.send_retries < 0:
         raise ConfigError("cluster.send_retries must be >= 0")
     ss = cfg.cluster.shard_slice

@@ -339,6 +339,8 @@ class MgmtApi:
         routed_fb = m.get("messages.routed.device_fallback")
         routed_total = routed_dev + routed_fb
         occ = m.histogram("ingest.batch.occupancy")
+        ing = getattr(self.broker, "ingest", None)
+        slo = getattr(ing, "slo", None) if ing is not None else None
         out = {
             "ingest": {
                 "batch_size": hist("ingest.batch.size"),
@@ -351,6 +353,46 @@ class MgmtApi:
                 "launch_errors": m.get("ingest.launch.errors"),
                 "dispatch_errors": m.get("ingest.dispatch.errors"),
             },
+            "slo": (
+                {
+                    # live controller state (broker/slo.py): the window
+                    # it chose, the tail it observed, the ladder rung
+                    # it stands on, and the lane depths behind it
+                    **slo.to_json(),
+                    "eval_windows": m.get("slo.eval.windows"),
+                    "violations": m.get("slo.violations"),
+                    "adjustments": m.get("slo.adjustments"),
+                    "deferrals": m.get("slo.deferrals"),
+                    "sheds": m.get("slo.shed"),
+                    "olp_pressure": (
+                        ing.olp.pressure()
+                        if ing is not None and ing.olp is not None
+                        else None
+                    ),
+                    "lane_depth": {
+                        "control": m.gauge("ingest.lane.depth.control"),
+                        "normal": m.gauge("ingest.lane.depth.normal"),
+                        "low": m.gauge("ingest.lane.depth.low"),
+                    },
+                    "lane_settle_ms": {
+                        "control": hist(
+                            "ingest.lane.settle.seconds.control", 1e3
+                        ),
+                        "normal": hist(
+                            "ingest.lane.settle.seconds.normal", 1e3
+                        ),
+                        "low": hist(
+                            "ingest.lane.settle.seconds.low", 1e3
+                        ),
+                    },
+                    "starvation_breaks": m.get(
+                        "ingest.lane.starvation.breaks"
+                    ),
+                    "storm_deferred": m.get("retained.storm.deferred"),
+                }
+                if slo is not None
+                else None
+            ),
             "matcher": {
                 "device_ms": hist("matcher.device.seconds", 1e3),
                 "sync_ms": hist("matcher.sync.seconds", 1e3),
@@ -483,6 +525,9 @@ class MgmtApi:
                 ),
                 "tpu_retrace_storm_active": self.app.alarms.is_active(
                     "tpu_retrace_storm"
+                ),
+                "slo_p99_violation_active": self.app.alarms.is_active(
+                    "slo_p99_violation"
                 ),
             },
         }

@@ -202,6 +202,88 @@ class FallbackRateWatch:
         return rate
 
 
+class SloViolationWatch:
+    """Level-triggered alarm on sustained SLO p99 target misses.
+
+    The adaptive-batching controller (broker/slo.py) closes one
+    evaluation window per `slo.eval.interval` and counts a violation
+    when the observed enqueue->settle p99 missed the configured target.
+    One miss is the controller's job to absorb (widen the window, walk
+    the ladder); this watch pages only when the MISSES THEMSELVES are
+    sustained — the violation *rate* over its sliding window stays at or
+    above `threshold` — meaning the ladder ran out of rungs and the
+    broker is serving outside its latency contract.
+
+    Windows with fewer than `min_windows` controller evaluations are
+    ignored in BOTH directions (an idle broker, or one with the
+    controller off, must not flap an operator page) — the
+    FallbackRateWatch min-traffic convention."""
+
+    ALARM = "slo_p99_violation"
+
+    def __init__(
+        self,
+        alarms: AlarmManager,
+        metrics,
+        threshold: float = 0.5,
+        window: float = 10.0,
+        min_windows: int = 4,
+    ):
+        self.alarms = alarms
+        self.metrics = metrics
+        self.threshold = threshold
+        self.window = window
+        self.min_windows = max(1, int(min_windows))
+        self._last_at: Optional[float] = None
+        self._last_viol = 0
+        self._last_evals = 0
+
+    def check(self, now: Optional[float] = None) -> Optional[float]:
+        """Evaluate once per elapsed window; returns the window's
+        violation rate when a window closed (None otherwise). Call from
+        the housekeeping tick."""
+        now = now if now is not None else time.time()
+        m = self.metrics
+        if self._last_at is None:
+            self._last_at = now
+            self._last_viol = m.get("slo.violations")
+            self._last_evals = m.get("slo.eval.windows")
+            return None
+        if now - self._last_at < self.window:
+            return None
+        viol = m.get("slo.violations")
+        evals = m.get("slo.eval.windows")
+        d_viol = viol - self._last_viol
+        d_evals = evals - self._last_evals
+        self._last_at = now
+        self._last_viol, self._last_evals = viol, evals
+        if d_evals < self.min_windows:
+            return None
+        rate = d_viol / d_evals
+        self.alarms.ensure(
+            self.ALARM,
+            rate >= self.threshold,
+            details={
+                "violation_rate": round(rate, 4),
+                "threshold": self.threshold,
+                "window_seconds": self.window,
+                "violations": d_viol,
+                "eval_windows": d_evals,
+                "observed_p99_ms": m.gauge("slo.p99.observed_ms"),
+                "target_p99_ms": m.gauge("slo.p99.target_ms"),
+                "ladder_rung": m.gauge("slo.ladder.rung"),
+            },
+            message=(
+                f"ingest p99 missed the "
+                f"{m.gauge('slo.p99.target_ms'):g}ms SLO target in "
+                f"{rate:.0%} of controller windows over the last "
+                f"{self.window:g}s: the adaptive-batching ladder is "
+                "saturated (sustained overload or a degraded fast path)"
+            ),
+        )
+        return rate
+
+
 class RetraceStormWatch:
     """Level-triggered alarm on steady-state jit compile activity.
 

@@ -327,7 +327,9 @@ class WorkerFabric:
             deliver = make_detached_deliverer(sess, None, cid)
             for f, opts in subs.items():
                 self.broker.subscribe(cid, cid, f, opts, deliver)
-            cm._detached[cid] = (sess, _t.time() + expiry)
+            # monotonic like cm.on_channel_closed: detach deadlines must
+            # survive wall-clock steps
+            cm._detached[cid] = (sess, _t.monotonic() + expiry)
             self.broker.hooks.run("session.detached", cid)
             self.broker.metrics.inc("fabric.sess.crash_parked")
 
@@ -522,7 +524,7 @@ class WorkerFabric:
         import time as _t
 
         sess = session_from_json(d["sess"], scfg or SessionConfig())
-        deadline = _t.time() + float(d.get("expiry", 0))
+        deadline = _t.monotonic() + float(d.get("expiry", 0))
         # plain banker now; the persistence hook (if attached) replaces
         # it under the same (sid, filter) key with the WAL-backed one
         deliver = make_detached_deliverer(sess, None, cid)
@@ -615,7 +617,7 @@ class WorkerFabric:
 
                 sess = ent["sess"]
                 cm._detached[cid] = (
-                    sess, _t.time() + sess.config.expiry_interval
+                    sess, _t.monotonic() + sess.config.expiry_interval
                 )
 
     # -- publish side -----------------------------------------------------

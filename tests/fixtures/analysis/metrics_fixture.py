@@ -37,6 +37,13 @@ declare("semantic.filters", "gauge")
 declare("semantic.hits", COUNTER)
 declare("rules.matched", COUNTER)
 declare("rules.device.batches", COUNTER)
+declare("slo.window_us", "gauge")
+declare("slo.ladder.rung", "gauge")
+declare("slo.violations", COUNTER)
+declare("slo.deferrals", COUNTER)
+declare("ingest.lane.depth.control", "gauge")
+declare("ingest.lane.settle.seconds.control", "histogram")
+declare("retained.storm.deferred", COUNTER)
 
 
 class M:
@@ -81,6 +88,13 @@ def good(m: M):
     m.inc("semantic.hits", 3)
     m.inc("rules.matched")
     m.inc("rules.device.batches")
+    m.gauge_set("slo.window_us", 1000.0)
+    m.gauge_set("slo.ladder.rung", 1)
+    m.inc("slo.violations")
+    m.inc("slo.deferrals", 2)
+    m.gauge_set("ingest.lane.depth.control", 3)
+    m.observe("ingest.lane.settle.seconds.control", 0.002)
+    m.inc("retained.storm.deferred")
 
 
 def bad(m: M):
@@ -114,3 +128,9 @@ def bad(m: M):
     m.inc("semantic.hitz")  # MN001: typo'd semantic counter
     m.inc("rules.matchd")  # MN001: typo'd rule counter
     m.inc("rules.device.batchez")  # MN001: typo'd rule-ladder counter
+    m.gauge_set("slo.window_uz", 1)  # MN001: typo'd slo gauge
+    m.gauge_set("slo.ladder.wrung", 1)  # MN001: typo'd ladder gauge
+    m.inc("slo.violationz")  # MN001: typo'd violation counter
+    m.gauge_set("ingest.lane.depth.contrl", 1)  # MN001: typo'd lane gauge
+    m.observe("ingest.lane.settle.secondz.control", 1)  # MN001: typo'd lane histo
+    m.inc("retained.storm.deferd")  # MN001: typo'd defer counter

@@ -1,0 +1,607 @@
+"""SLO-driven adaptive batching (broker/slo.py, docs/robustness.md).
+
+The window as a controlled variable: idle decay to immediate launches,
+storm deepening, hysteresis (no oscillation between flush cycles), the
+graded backpressure ladder (widen -> defer -> shed, defer-before-drop),
+breaker-open widening, priority-lane ordering/fairness in BatchIngest,
+the retained-storm feed's low-priority defer gate, the sustained-miss
+alarm, the hotpath REST block — plus the monotonic-clock regressions
+this PR's satellites fix (detached-session expiry, delayed publish).
+"""
+
+import asyncio
+import functools
+import time
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.degrade import OPEN, DegradeController, IngestShed
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.ingest import BatchIngest
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.slo import (
+    LANE_CONTROL,
+    LANE_LOW,
+    LANE_NORMAL,
+    RUNG_DEFER,
+    RUNG_NORMAL,
+    RUNG_SHED,
+    RUNG_WIDEN,
+    SloController,
+    delta_percentile,
+)
+from emqx_tpu.mqtt import packet as pkt
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=30))
+
+    return wrapper
+
+
+def _mk_ctl(metrics=None, **kw):
+    kw.setdefault("target_p99_ms", 5.0)
+    kw.setdefault("eval_interval_s", 1.0)
+    kw.setdefault("min_samples", 4)
+    kw.setdefault("ladder_patience", 2)
+    kw.setdefault("initial_window_us", 1000)
+    kw.setdefault("max_window_us", 20_000)
+    return SloController(metrics if metrics is not None else Metrics(), **kw)
+
+
+def _feed(m, values):
+    m.observe_many("ingest.settle.seconds", values)
+
+
+# -- windowed percentile ------------------------------------------------------
+
+def test_delta_percentile_covers_only_the_new_window():
+    m = Metrics()
+    _feed(m, [0.001] * 100)  # old regime: 1ms
+    h = m.histogram("ingest.settle.seconds")
+    prev = h.snapshot()
+    _feed(m, [0.2] * 100)  # new regime: 200ms
+    p99, n = delta_percentile(prev, h.snapshot(), 0.99)
+    assert n == 100
+    assert p99 > 0.05  # the old 1ms mass is invisible to the window
+    # and the cumulative view would have hidden it:
+    p99_cum, n_cum = delta_percentile(None, h.snapshot(), 0.5)
+    assert n_cum == 200 and p99_cum < 0.05
+
+
+def test_delta_percentile_empty_window():
+    m = Metrics()
+    _feed(m, [0.001] * 10)
+    h = m.histogram("ingest.settle.seconds")
+    snap = h.snapshot()
+    assert delta_percentile(snap, snap, 0.99) == (0.0, 0)
+    assert delta_percentile(None, None, 0.99) == (0.0, 0)
+
+
+# -- controller: fake-clock window adaptation --------------------------------
+
+def test_idle_decays_window_to_min_for_immediate_launches():
+    ctl = _mk_ctl(min_window_us=0)
+    assert ctl.window_s == pytest.approx(1e-3)
+    ctl.tick(backlog=0, now=0.0)  # prime
+    ctl.tick(backlog=0, now=1.5)  # idle eval: nothing settled
+    assert ctl.window_s == 0.0  # immediate partial launches
+    assert ctl.rung == RUNG_NORMAL
+
+
+def test_storm_deepens_window_and_escalates_to_widen():
+    m = Metrics()
+    ctl = _mk_ctl(m)
+    ctl.tick(now=0.0)
+    _feed(m, [0.05] * 64)  # 50ms >> 5ms target
+    ctl.tick(backlog=500, now=1.5)
+    assert ctl.rung == RUNG_WIDEN
+    assert ctl.window_s > 1e-3  # deepened
+    assert m.get("slo.violations") == 1
+    assert m.gauge("slo.ladder.rung") == RUNG_WIDEN
+
+
+def test_hysteresis_band_holds_without_oscillation():
+    m = Metrics()
+    ctl = _mk_ctl(m, hysteresis=0.7)
+    ctl.tick(now=0.0)
+    w0 = ctl.window_s
+    for i in range(1, 6):
+        # 4ms: inside [0.7*5, 5] — neither violation nor clear
+        _feed(m, [0.004] * 64)
+        ctl.tick(backlog=100, now=float(i) * 1.5)
+    assert ctl.window_s == w0  # held every cycle: no oscillation
+    assert ctl.rung == RUNG_NORMAL
+    assert m.get("slo.adjustments") == 0
+
+
+def test_clear_narrows_window_below_hysteresis():
+    m = Metrics()
+    ctl = _mk_ctl(m)
+    ctl.tick(now=0.0)
+    _feed(m, [0.0005] * 64)  # 0.5ms << 0.7 * 5ms
+    ctl.tick(backlog=100, now=1.5)
+    assert ctl.window_s < 1e-3
+
+
+def test_ladder_escalates_in_order_and_deescalates_stepwise():
+    m = Metrics()
+    ctl = _mk_ctl(m, ladder_patience=2)
+    ctl.tick(now=0.0)
+    t = 0.0
+    rungs = []
+    for _ in range(6):
+        t += 1.5
+        _feed(m, [0.05] * 64)
+        ctl.tick(backlog=500, now=t)
+        rungs.append(ctl.rung)
+    # first violation jumps to widen; each 2 further misses move one
+    # rung; the ladder never skips and never passes shed
+    assert rungs == [
+        RUNG_WIDEN, RUNG_WIDEN, RUNG_DEFER,
+        RUNG_DEFER, RUNG_SHED, RUNG_SHED,
+    ]
+    # recovery walks back one rung per patience-span of clear readings
+    down = []
+    for _ in range(6):
+        t += 1.5
+        _feed(m, [0.0005] * 64)
+        ctl.tick(backlog=0, now=t)
+        down.append(ctl.rung)
+    assert down == [
+        RUNG_SHED, RUNG_DEFER, RUNG_DEFER,
+        RUNG_WIDEN, RUNG_WIDEN, RUNG_NORMAL,
+    ]
+
+
+def test_breaker_open_widens_before_anything_sheds():
+    ctl = _mk_ctl()
+    w0 = ctl.window_s
+    ctl.tick(backlog=0, breaker_open=True, now=0.0)
+    assert ctl.rung == RUNG_WIDEN  # immediate, no samples needed
+    assert ctl.window_s > w0
+    # widen alone never sheds: that's the LAST rung's job
+    assert not ctl.shed(LANE_LOW, backlog=10_000, bound=4096)
+
+
+# -- ladder queries: defer before drop ---------------------------------------
+
+def test_shed_ladder_ordering_defer_before_drop():
+    ctl = _mk_ctl()
+    bound = 1000
+    ctl.rung = RUNG_DEFER
+    # defer rung: low DEFERS (delayed) but is never dropped below the
+    # hard valve
+    assert ctl.defer_low(head_age_s=0.0)
+    assert not ctl.shed(LANE_LOW, backlog=2 * bound, bound=bound)
+    ctl.rung = RUNG_SHED
+    # shed rung: low drops at the bound, normal only at twice it,
+    # control NEVER
+    assert ctl.shed(LANE_LOW, backlog=bound, bound=bound)
+    assert not ctl.shed(LANE_NORMAL, backlog=bound, bound=bound)
+    assert ctl.shed(LANE_NORMAL, backlog=2 * bound, bound=bound)
+    assert not ctl.shed(LANE_CONTROL, backlog=100 * bound, bound=bound)
+
+
+def test_hard_valve_sheds_at_any_rung():
+    ctl = _mk_ctl(shed_hard_mult=4.0)
+    assert ctl.rung == RUNG_NORMAL
+    assert ctl.shed(LANE_NORMAL, backlog=4000, bound=1000)
+    assert ctl.shed(LANE_LOW, backlog=4000, bound=1000)
+    assert not ctl.shed(LANE_CONTROL, backlog=4000, bound=1000)
+
+
+def test_defer_low_respects_age_bound():
+    ctl = _mk_ctl(defer_max_s=0.25)
+    ctl.rung = RUNG_DEFER
+    assert ctl.defer_low(0.1)
+    assert not ctl.defer_low(0.3)  # starved past the bound: released
+    ctl.rung = RUNG_NORMAL
+    assert not ctl.defer_low(0.0)
+
+
+# -- BatchIngest lanes --------------------------------------------------------
+
+def _mk_broker(min_batch=1):
+    return Broker(router=Router(min_tpu_batch=min_batch), hooks=Hooks())
+
+
+def _sub(broker, sid, filt, sink, **opts):
+    broker.subscribe(
+        sid, sid, filt, pkt.SubOpts(**opts),
+        lambda m, o, _s=sink: _s.append(m.topic),
+    )
+
+
+@async_test
+async def test_lane_classification():
+    ing = BatchIngest(_mk_broker(), qos0_low=True)
+    assert ing.lane_of(Message(topic="a/b", qos=2)) == LANE_CONTROL
+    assert ing.lane_of(Message(topic="$SYS/x", qos=0)) == LANE_CONTROL
+    assert ing.lane_of(Message(topic="a/b", qos=1)) == LANE_NORMAL
+    assert ing.lane_of(Message(topic="a/b", qos=0)) == LANE_LOW
+    assert (
+        ing.lane_of(
+            Message(topic="a/b", qos=0, headers={"ingest_lane": "control"})
+        )
+        == LANE_CONTROL
+    )
+    assert (
+        ing.lane_of(
+            Message(topic="a/b", qos=1, headers={"ingest_lane": "low"})
+        )
+        == LANE_LOW
+    )
+    ing.qos0_low = False  # legacy policy: QoS0 stays on the normal lane
+    assert ing.lane_of(Message(topic="a/b", qos=0)) == LANE_NORMAL
+
+
+@async_test
+async def test_take_batch_lane_priority_ordering():
+    ing = BatchIngest(_mk_broker(), max_batch=4, qos0_low=True)
+    for i in range(3):
+        ing.enqueue(Message(topic=f"low/{i}", qos=0))
+    for i in range(3):
+        ing.enqueue(Message(topic=f"norm/{i}", qos=1))
+    ing.enqueue(Message(topic="ctl/0", qos=2))
+    batch = ing._take_batch(time.perf_counter())
+    topics = [m.topic for m, *_ in batch]
+    # control first, then normal, low squeezed to the leftover slot
+    assert topics == ["ctl/0", "norm/0", "norm/1", "norm/2"]
+    batch2 = ing._take_batch(time.perf_counter())
+    assert [m.topic for m, *_ in batch2] == ["low/0", "low/1", "low/2"]
+
+
+@async_test
+async def test_low_lane_not_starved_by_saturated_normal_lane():
+    ing = BatchIngest(_mk_broker(), max_batch=4, qos0_low=True)
+    ing.starvation_s = 0.0  # the low head is "old" immediately
+    ing.enqueue(Message(topic="low/0", qos=0))
+    for i in range(100):
+        ing.enqueue(Message(topic=f"norm/{i}", qos=1))
+    batch = ing._take_batch(time.perf_counter())
+    topics = [m.topic for m, *_ in batch]
+    # the reserve carved a slot for the starving low head even though
+    # the normal lane alone could fill the batch
+    assert "low/0" in topics
+    assert ing.metrics.get("ingest.lane.starvation.breaks") == 1
+
+
+@async_test
+async def test_take_batch_defers_low_on_defer_rung_force_overrides():
+    m = Metrics()
+    ctl = _mk_ctl(m)
+    ctl.rung = RUNG_DEFER
+    b = _mk_broker()
+    b.metrics = m
+    ing = BatchIngest(b, max_batch=8, slo=ctl, qos0_low=True)
+    ing.enqueue(Message(topic="low/0", qos=0))
+    ing.enqueue(Message(topic="norm/0", qos=1))
+    batch = ing._take_batch(time.perf_counter())
+    assert [m_.topic for m_, *_ in batch] == ["norm/0"]
+    assert m.get("slo.deferrals") == 1
+    assert len(ing._lane_lo) == 1  # deferred, NOT dropped
+    # shutdown drain ignores the gate: nothing may hang on stop()
+    forced = ing._take_batch(time.perf_counter(), force=True)
+    assert [m_.topic for m_, *_ in forced] == ["low/0"]
+
+
+@async_test
+async def test_lanes_settle_end_to_end_with_per_lane_series():
+    b = _mk_broker()
+    got = []
+    _sub(b, "s1", "#", got)
+    _sub(b, "s2", "$SYS/#", got)
+    ing = BatchIngest(b, max_batch=64, window_us=0, qos0_low=True)
+    b.ingest = ing
+    ing.start()
+    counts = await asyncio.gather(
+        ing.enqueue(Message(topic="t/a", qos=0)),
+        ing.enqueue(Message(topic="t/b", qos=1)),
+        ing.enqueue(Message(topic="$SYS/hb", qos=1)),
+        ing.enqueue(Message(topic="t/c", qos=2)),
+    )
+    await ing.stop()
+    assert all(c >= 1 for c in counts)
+    m = b.metrics
+    assert m.histogram("ingest.lane.settle.seconds.low").count == 1
+    assert m.histogram("ingest.lane.settle.seconds.normal").count == 1
+    assert m.histogram("ingest.lane.settle.seconds.control").count == 2
+
+
+@async_test
+async def test_control_lane_settles_while_low_lane_deferred():
+    m = Metrics()
+    ctl = _mk_ctl(m, defer_max_s=0.08)
+    ctl.rung = RUNG_DEFER
+    ctl.tick(now=0.0)  # prime so the flusher's ticks hold the rung
+    b = _mk_broker()
+    b.metrics = m
+    got = []
+    _sub(b, "s1", "#", got)
+    ing = BatchIngest(b, max_batch=64, window_us=0, slo=ctl, qos0_low=True)
+    b.ingest = ing
+    ing.start()
+    f_low = ing.enqueue(Message(topic="low/x", qos=0))
+    f_ctl = ing.enqueue(Message(topic="ctl/x", qos=2))
+    n_ctl = await asyncio.wait_for(f_ctl, 5)
+    assert n_ctl == 1
+    assert not f_low.done()  # still parked on the defer rung
+    # the age bound releases it: deferred is delayed, never dropped
+    n_low = await asyncio.wait_for(f_low, 5)
+    assert n_low == 1
+    await ing.stop()
+    assert got == ["ctl/x", "low/x"]
+
+
+@async_test
+async def test_shed_rung_drops_low_keeps_control_and_counts():
+    m = Metrics()
+    ctl = _mk_ctl(m)
+    ctl.rung = RUNG_SHED
+    b = _mk_broker()
+    b.metrics = m
+    b.degrade = DegradeController(metrics=m, shed_queue_batches=1)
+    ing = BatchIngest(b, max_batch=2, slo=ctl, qos0_low=True)
+    # backlog reaches the bound (2): the next LOW enqueue sheds
+    ing.enqueue(Message(topic="low/0", qos=0))
+    ing.enqueue(Message(topic="low/1", qos=0))
+    with pytest.raises(IngestShed):
+        await ing.enqueue(Message(topic="low/2", qos=0))
+    assert m.get("slo.shed") == 1 and m.get("ingest.shed") == 1
+    # normal still admits (sheds only at 2x bound), control always
+    f_n = ing.enqueue(Message(topic="n/0", qos=1))
+    f_c = ing.enqueue(Message(topic="c/0", qos=2))
+    assert not f_n.done() and not f_c.done()
+    ing.enqueue(Message(topic="n/1", qos=1))
+    with pytest.raises(IngestShed):
+        await ing.enqueue(Message(topic="n/2", qos=1))
+    f_c2 = ing.enqueue(Message(topic="c/1", qos=2))
+    assert not f_c2.done()
+
+
+@async_test
+async def test_breaker_open_widens_window_through_the_flusher():
+    m = Metrics()
+    ctl = _mk_ctl(m, eval_interval_s=0.005, initial_window_us=200)
+    b = _mk_broker()
+    b.metrics = m
+    b.degrade = DegradeController(metrics=m)
+    b.degrade.device.force(OPEN, 60.0)
+    got = []
+    _sub(b, "s1", "#", got)
+    ing = BatchIngest(b, max_batch=64, window_us=200, slo=ctl)
+    b.ingest = ing
+    ing.start()
+    await ing.enqueue(Message(topic="t/a", qos=1))
+    await asyncio.sleep(0.02)
+    await ing.stop()
+    # the flusher's tick saw the open breaker: ladder at widen+, window
+    # grew past the initial 200us — deep batches BEFORE any shedding
+    assert ctl.rung >= RUNG_WIDEN
+    assert ctl.window_s > 200e-6
+
+
+# -- retained-storm feed: low-priority defer gate ----------------------------
+
+class _StubIndex:
+    def prepare_storm(self, filters):
+        return object()
+
+    def topic_at(self, r):
+        return None
+
+
+@async_test
+async def test_storm_feed_defers_on_defer_rung_and_releases_by_age():
+    from emqx_tpu.broker.retained_feed import RetainedStormFeed
+
+    m = Metrics()
+    ctl = _mk_ctl(m, defer_max_s=0.25)
+    ctl.rung = RUNG_DEFER
+    feed = RetainedStormFeed(_StubIndex(), metrics=m, window_s=60.0)
+    feed.slo = ctl
+    feed.submit("a/#")
+    assert feed.take_job() is None  # deferred, pending kept
+    assert m.get("retained.storm.deferred") == 1
+    assert len(feed) == 1
+    feed._oldest_t -= 1.0  # starved past defer_max_s: released
+    assert feed.take_job() is not None
+    assert len(feed) == 0
+    feed._cancel_timer()
+
+
+@async_test
+async def test_storm_feed_untouched_without_controller():
+    from emqx_tpu.broker.retained_feed import RetainedStormFeed
+
+    feed = RetainedStormFeed(_StubIndex(), window_s=60.0)
+    feed.submit("a/#")
+    assert feed.take_job() is not None
+    feed._cancel_timer()
+
+
+# -- sustained-miss alarm -----------------------------------------------------
+
+def test_slo_violation_watch_level_triggered():
+    from emqx_tpu.observe.alarm import AlarmManager, SloViolationWatch
+
+    m = Metrics()
+    alarms = AlarmManager()
+    w = SloViolationWatch(alarms, m, threshold=0.5, window=10.0,
+                          min_windows=4)
+    assert w.check(0.0) is None  # prime
+    m.inc("slo.eval.windows", 10)
+    m.inc("slo.violations", 8)
+    assert w.check(11.0) == pytest.approx(0.8)
+    assert alarms.is_active("slo_p99_violation")
+    # a clean stretch clears it (level-triggered)
+    m.inc("slo.eval.windows", 10)
+    assert w.check(22.0) == pytest.approx(0.0)
+    assert not alarms.is_active("slo_p99_violation")
+    # too few controller windows: no judgement either way
+    m.inc("slo.eval.windows", 2)
+    m.inc("slo.violations", 2)
+    assert w.check(33.0) is None
+    assert not alarms.is_active("slo_p99_violation")
+
+
+# -- hotpath REST block -------------------------------------------------------
+
+@async_test
+async def test_hotpath_rest_grows_slo_block():
+    import json
+    import types
+
+    from emqx_tpu.mgmt.api import MgmtApi
+
+    b = _mk_broker()
+    ctl = _mk_ctl(b.metrics)
+    ing = BatchIngest(b, max_batch=64, slo=ctl, qos0_low=True)
+    b.ingest = ing
+
+    class _Alarms:
+        def is_active(self, name):
+            return False
+
+    stub = types.SimpleNamespace(
+        broker=b, app=types.SimpleNamespace(alarms=_Alarms())
+    )
+    resp = await MgmtApi.metrics_hotpath(stub, None)
+    doc = json.loads(resp.body.decode())
+    s = doc["slo"]
+    assert s["window_us"] == pytest.approx(1000.0)
+    assert s["target_p99_ms"] == 5.0
+    assert s["rung_name"] == "normal"
+    assert set(s["lane_depth"]) == {"control", "normal", "low"}
+    assert "lane_settle_ms" in s and "deferrals" in s
+    assert "slo_p99_violation_active" in doc["alarms"]
+    # no controller -> the block reports null, the endpoint still serves
+    b.ingest = None
+    doc2 = json.loads(
+        (await MgmtApi.metrics_hotpath(stub, None)).body.decode()
+    )
+    assert doc2["slo"] is None
+
+
+# -- satellite: monotonic-clock regressions ----------------------------------
+
+def test_detached_session_survives_forward_wall_clock_step(monkeypatch):
+    """cm.py armed expiry on time.time(): one NTP step forward used to
+    mass-expire every detached session (the PR 11 inflight bug class)."""
+    import types as _types
+
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.session import Session, SessionConfig
+
+    b = _mk_broker()
+    cm = ChannelManager(b)
+    sess = Session("c1", SessionConfig(expiry_interval=3600))
+    ch = _types.SimpleNamespace(client_id="c1", session=sess)
+    cm._channels["c1"] = ch
+    import emqx_tpu.broker.cm as cm_mod
+
+    real_time = time.time
+    monkeypatch.setattr(
+        cm_mod.time, "time", lambda: real_time() + 1e7
+    )  # wall leaps 115 days forward
+    cm.on_channel_closed(ch, "gone")
+    assert cm.detached_count() == 1
+    assert cm.sweep_expired() == 0  # monotonic deadline: unaffected
+    assert cm.detached_count() == 1
+    # and the real deadline still works on the monotonic axis
+    assert cm.sweep_expired(now=time.monotonic() + 3601) == 1
+    assert cm.detached_count() == 0
+
+
+def test_delayed_publish_survives_forward_wall_clock_step(monkeypatch):
+    from emqx_tpu.broker.delayed import DelayedPublish
+
+    fired = []
+    broker = type(
+        "B", (), {"publish": lambda self, m: fired.append(m.topic) or 1}
+    )()
+    d = DelayedPublish(broker)
+    import emqx_tpu.broker.delayed as dl_mod
+
+    real_time = time.time
+    monkeypatch.setattr(dl_mod.time, "time", lambda: real_time() + 1e7)
+    assert d.intercept(Message(topic="$delayed/3600/real/t")) == (
+        "stop", None,
+    )
+    assert len(d) == 1
+    assert d.tick() == 0  # wall step can't fire it early
+    assert d.tick(now=time.monotonic() + 3601) == 1
+    assert fired == ["real/t"]
+
+
+def test_delayed_durable_snapshot_stores_remaining_interval(tmp_path):
+    """Persistence round-trips REMAINING delay, not a deadline: a
+    monotonic due from one process means nothing in the next."""
+    from emqx_tpu.broker.delayed import DelayedPublish
+    from emqx_tpu.broker.persistent_session import DurableState
+    from emqx_tpu.storage.kv import FileKv
+
+    broker = type("B", (), {"publish": lambda self, m: 1})()
+    d = DelayedPublish(broker)
+    d.intercept(Message(topic="$delayed/500/real/t", payload=b"x"))
+    kv = FileKv(str(tmp_path))
+    DurableState(kv, delayed=d).flush()
+    raw = kv.read("delayed")
+    assert "remaining_s" in raw["messages"][0]
+    assert 0 < raw["messages"][0]["remaining_s"] <= 500
+    d2 = DelayedPublish(broker)
+    out = DurableState(FileKv(str(tmp_path)), delayed=d2).restore()
+    assert out["delayed"] == 1
+    due, _m = d2.pending()[0]
+    assert 400 < due - time.monotonic() <= 500
+
+
+def test_detached_snapshot_rebases_expiry_across_restart(tmp_path):
+    from emqx_tpu.broker.cm import ChannelManager
+    from emqx_tpu.broker.persistent_session import SessionPersistence
+    from emqx_tpu.broker.session import Session, SessionConfig
+    from emqx_tpu.storage.kv import FileKv
+
+    b = _mk_broker()
+    cm = ChannelManager(b)
+    sess = Session("c1", SessionConfig(expiry_interval=1800))
+    sess.subscriptions = {}
+    cm._detached["c1"] = (sess, time.monotonic() + 1800)
+    sp = SessionPersistence(b, cm, FileKv(str(tmp_path)), SessionConfig())
+    sp.flush(force=True)
+    snap = sp.kv.read("persistent_sessions")["sessions"]["c1"]
+    assert 0 < snap["expiry_remaining_s"] <= 1800
+
+    b2 = _mk_broker()
+    cm2 = ChannelManager(b2)
+    sp2 = SessionPersistence(
+        b2, cm2, FileKv(str(tmp_path)), SessionConfig()
+    )
+    assert sp2.restore() == 1
+    _s, deadline = cm2._detached["c1"]
+    assert 1700 < deadline - time.monotonic() <= 1800
+
+
+# -- config surface -----------------------------------------------------------
+
+def test_slo_config_keys_validate():
+    from emqx_tpu.config.schema import ConfigError, load_config
+
+    cfg = load_config(
+        {"slo": {"enable": True, "target_p99_ms": 2.5, "gain": 0.5}}
+    )
+    assert cfg.slo.target_p99_ms == 2.5
+    with pytest.raises(ConfigError):
+        load_config({"slo": {"target_p99_ms": 0}})
+    with pytest.raises(ConfigError):
+        load_config({"slo": {"gain": 1.5}})
+    with pytest.raises(ConfigError):
+        load_config({"slo": {"min_window_us": 100, "max_window_us": 10}})
+    with pytest.raises(ConfigError):
+        load_config({"slo": {"unknown_knob": 1}})

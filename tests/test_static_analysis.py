@@ -152,6 +152,9 @@ def test_metric_checker_flags_undeclared_series():
         "dispatch.serialize.framez",
         "semantic.filterz", "semantic.hitz",
         "rules.matchd", "rules.device.batchez",
+        "slo.window_uz", "slo.ladder.wrung", "slo.violationz",
+        "ingest.lane.depth.contrl", "ingest.lane.settle.secondz.control",
+        "retained.storm.deferd",
     }
 
 

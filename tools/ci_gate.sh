@@ -19,6 +19,18 @@
 # touches the paths they measure):
 #
 #   python bench.py --configs chaos_soak    # degradation ladder gate
+#                                           # (incl. the overload wave:
+#                                           # QoS0 firehose + open
+#                                           # breaker vs the control
+#                                           # lane, SLO ladder asserts)
+#   python bench.py --configs latency_frontier # SLO-adaptive batching:
+#                                           # measured latency-vs-
+#                                           # throughput frontier 10%->
+#                                           # 100% load; gates p99@10%
+#                                           # < 5ms, monotone frontier,
+#                                           # bounded control-lane p99
+#                                           # under a storm (~25s CPU —
+#                                           # docs/robustness.md)
 #   python bench.py churn_storm             # segmented update path at
 #                                           # 10M subs (~3-4 min): gates
 #                                           # >1M inserts/s and <10ms
