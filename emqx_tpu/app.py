@@ -286,6 +286,27 @@ class BrokerApp:
                     max_fires=fr.max_fires,
                     delay_ms=fr.delay_ms,
                 )
+        # device profiling + performance provenance (observe/profiler.py,
+        # observe/provenance.py): the process-wide profiler gets this
+        # broker's metrics; captures are REST-armed (POST /api/v5/profile)
+        # and the housekeeping tick enforces their duration/byte bounds.
+        # The hardware fingerprint gauges let dashboards refuse to
+        # overlay runs from different silicon (proxy=1 means non-TPU).
+        from emqx_tpu.observe import provenance
+        from emqx_tpu.observe.profiler import default_profiler
+
+        self.profiler = default_profiler
+        self.profiler.metrics = self.broker.metrics
+        self.profiler.trace_dir = c.observe.profile_trace_dir
+        self.profiler.max_seconds = float(c.observe.profile_max_seconds)
+        self.profiler.max_bytes = int(c.observe.profile_max_bytes)
+        fp = provenance.fingerprint()
+        self.broker.metrics.gauge_set(
+            "provenance.proxy", 1 if fp["proxy"] else 0
+        )
+        self.broker.metrics.gauge_set(
+            "provenance.device.count", fp["device_count"]
+        )
         if c.force_gc.enable:
             from emqx_tpu.transport.congestion import ForcedGC
 
@@ -1193,6 +1214,10 @@ class BrokerApp:
                     self.slo_watch.check(now)
                 if self.device_watch is not None:
                     self.device_watch.poll(now)
+                # bounded profile captures: auto-disarm past the
+                # deadline or the on-disk byte budget (profiler.tick
+                # is a no-op while disarmed)
+                self.profiler.tick()
                 if self.retrace_watch is not None:
                     self.retrace_watch.check(now)
                 dev = self.broker._device

@@ -17,6 +17,7 @@ the session's registered deliver callback. Shared-subscription groups
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -585,6 +586,7 @@ class Broker:
             # (half-open probes re-enter here one batch at a time)
             return _cpu_pending(degraded=True)
         dev = self._device_router()
+        t_prep = time.perf_counter()
         try:
             args = dev.prepare()
         except Exception:  # noqa: BLE001 — no good epoch: degrade
@@ -592,6 +594,11 @@ class Broker:
                 raise
             deg.device.record_failure("delta_sync")
             return _cpu_pending(degraded=True)
+        # waterfall `prepare` (observe/profiler.py): table snapshot +
+        # upload cost this launch paid before any device work
+        self.metrics.observe(
+            "profile.stage.prepare.seconds", time.perf_counter() - t_prep
+        )
         feed = self.retained_feed
         storm = None
         if feed is not None and dev.supports_retained_fusion:
@@ -708,9 +715,17 @@ class Broker:
                     else (),
                     extra=dev.span_attrs(),
                 )
-            return self._dispatch_device_results(
+            # waterfall `host_dispatch`: the settle-time fan-out of this
+            # device batch (delivery resolution + writes)
+            t_hd = time.perf_counter()
+            res = self._dispatch_device_results(
                 msgs, results, forward, device_span=dsp
             )
+            self.metrics.observe(
+                "profile.stage.host_dispatch.seconds",
+                time.perf_counter() - t_hd,
+            )
+            return res
 
         return PendingDispatch(fut, _complete)
 

@@ -286,6 +286,13 @@ class BatchIngest:
         self._seq += 1
         self.metrics.observe("ingest.batch.size", n)
         self.metrics.observe("ingest.batch.occupancy", n / self.max_batch)
+        # waterfall `queue_wait` (observe/profiler.py): per-message
+        # enqueue -> launch wait (window accumulation + lane queueing)
+        now = time.perf_counter()
+        self.metrics.observe_many(
+            "profile.stage.queue_wait.seconds",
+            [now - t0 for _, _, t0, _ in batch],
+        )
         tp("ingest.launch", batch=seq, n=n)
         rec = getattr(self.broker, "spans", None)
         bsp = (

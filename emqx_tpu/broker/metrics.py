@@ -683,3 +683,162 @@ declare("rules.device.batches", COUNTER,
 declare("rules.host.batches", COUNTER,
         "settled batches that fell back to the vectorized numpy WHERE "
         "evaluator (degraded/CPU batches, rule-set churn in flight)")
+
+# -- profiling plane (observe/profiler.py; docs/observability.md
+#    "Profiling & provenance") ---------------------------------------------
+# the per-launch stage waterfall: prepare -> queue_wait -> launch ->
+# device_execute -> readback -> host_dispatch. Observed per BATCH from
+# the serving hot path (a handful of perf_counter reads), so the sum of
+# stage means tracks the enqueue->settle latency the SLO controller
+# steers on — the decomposition says WHERE a regression lives.
+declare("profile.stage.prepare.seconds", HISTOGRAM,
+        "waterfall: table snapshot + upload before the launch "
+        "(Broker.adispatch_begin around dev.prepare)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("profile.stage.queue_wait.seconds", HISTOGRAM,
+        "waterfall: per-message enqueue -> batch-launch wait "
+        "(window accumulation + lane queueing, BatchIngest)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("profile.stage.launch.seconds", HISTOGRAM,
+        "waterfall: host-side batch encode + kernel enqueue "
+        "(DeviceRouter._route_prepared up to the readback boundary)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("profile.stage.device_execute.seconds", HISTOGRAM,
+        "waterfall: device program completion wait "
+        "(block_until_ready at the readback boundary)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("profile.stage.readback.seconds", HISTOGRAM,
+        "waterfall: the coalesced device_get + host-side decode",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("profile.stage.host_dispatch.seconds", HISTOGRAM,
+        "waterfall: settle-time host fan-out of one device batch "
+        "(Broker._dispatch_device_results)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+# on-demand jax.profiler capture (REST-armed, bounded duration + file
+# budget; disarmed cost is structurally zero — no hot-path hook exists)
+declare("profile.captures", COUNTER,
+        "completed jax.profiler trace captures (armed via "
+        "POST /api/v5/profile)")
+declare("profile.capture.seconds", HISTOGRAM,
+        "armed duration of each completed capture",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("profile.capture.bytes", HISTOGRAM,
+        "on-disk size of each completed capture (over-budget captures "
+        "are deleted and record the size that tripped the bound)",
+        buckets=READBACK_BUCKETS)
+declare("profile.cost.kernels", GAUGE,
+        "contract kernels covered by the last cost-analysis harvest "
+        "(14 = the full registry)")
+
+# -- hardware provenance (observe/provenance.py) ---------------------------
+declare("provenance.proxy", GAUGE,
+        "1 when the detected backend is NOT a TPU: every number this "
+        "process emits is a CPU/GPU proxy, never a number of record")
+declare("provenance.device.count", GAUGE,
+        "devices visible to the backend this process measured on")
+
+# -- per-kernel launch attribution (observe/profiler.py) -------------------
+# one seconds+bytes pair per @device_contract registry name: each device
+# launch observes its wall time + readback bytes into EVERY kernel that
+# rode the program (fused launches list all of them), so "what does this
+# kernel cost in production" is answerable per kernel without kernel-side
+# instrumentation. Observation sites compose the names dynamically
+# (f"device.kernel.{name}.seconds"); the declarations below are the
+# MN-checked universe those names must land in.
+declare("device.kernel.route_step.seconds", HISTOGRAM,
+        "launch wall time for programs carrying route_step "
+        "(match-only matcher path)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.route_step.bytes", HISTOGRAM,
+        "readback bytes attributed to route_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.shape_route_step.seconds", HISTOGRAM,
+        "launch wall time for programs carrying shape_route_step "
+        "(the serving-path flagship)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.shape_route_step.bytes", HISTOGRAM,
+        "readback bytes attributed to shape_route_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.sparse_shape_route_step.seconds", HISTOGRAM,
+        "launch wall time for the serving program against a CSR "
+        "subscriber table",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.sparse_shape_route_step.bytes", HISTOGRAM,
+        "readback bytes attributed to sparse_shape_route_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.fused_route_retained_step.seconds", HISTOGRAM,
+        "launch wall time for route launches fusing a retained-replay "
+        "storm",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.fused_route_retained_step.bytes", HISTOGRAM,
+        "readback bytes attributed to fused_route_retained_step "
+        "launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.session_ack_step.seconds", HISTOGRAM,
+        "launch wall time for route launches carrying the fused "
+        "session-ack stage",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.session_ack_step.bytes", HISTOGRAM,
+        "readback bytes attributed to session_ack_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.segment_scatter_insert.seconds", HISTOGRAM,
+        "launch wall time of the fused segment delta-scatter "
+        "(update path)",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.segment_scatter_insert.bytes", HISTOGRAM,
+        "readback bytes attributed to segment_scatter_insert launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.compact_fanout_slots.seconds", HISTOGRAM,
+        "launch wall time for programs carrying the dense fan-out "
+        "compaction stage",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.compact_fanout_slots.bytes", HISTOGRAM,
+        "readback bytes attributed to compact_fanout_slots launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.sparse_fanout_slots.seconds", HISTOGRAM,
+        "launch wall time for programs carrying the CSR fan-out "
+        "gather-union stage",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.sparse_fanout_slots.bytes", HISTOGRAM,
+        "readback bytes attributed to sparse_fanout_slots launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.semantic_match_step.seconds", HISTOGRAM,
+        "launch wall time for programs carrying the fused semantic "
+        "similarity + top-k stage",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.semantic_match_step.bytes", HISTOGRAM,
+        "readback bytes attributed to semantic_match_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.dist_step.seconds", HISTOGRAM,
+        "launch wall time for the SPMD match-only mesh program",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.dist_step.bytes", HISTOGRAM,
+        "readback bytes attributed to dist_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.dist_shape_step.seconds", HISTOGRAM,
+        "launch wall time for the SPMD serving mesh program",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.dist_shape_step.bytes", HISTOGRAM,
+        "readback bytes attributed to dist_shape_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.dist_fused_step.seconds", HISTOGRAM,
+        "launch wall time for the SPMD serving program fusing a "
+        "retained storm over the mesh",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.dist_fused_step.bytes", HISTOGRAM,
+        "readback bytes attributed to dist_fused_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.sem_dist_shape_step.seconds", HISTOGRAM,
+        "launch wall time for the SPMD serving program with the "
+        "semantic stage",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.sem_dist_shape_step.bytes", HISTOGRAM,
+        "readback bytes attributed to sem_dist_shape_step launches",
+        buckets=READBACK_BUCKETS)
+declare("device.kernel.sparse_dist_shape_step.seconds", HISTOGRAM,
+        "launch wall time for the SPMD serving program against CSR "
+        "shards",
+        buckets=LATENCY_BUCKETS, unit="seconds")
+declare("device.kernel.sparse_dist_shape_step.bytes", HISTOGRAM,
+        "readback bytes attributed to sparse_dist_shape_step launches",
+        buckets=READBACK_BUCKETS)

@@ -130,7 +130,7 @@ class SessionStore:
 
             placement = session_placement(mesh)
         self.manager = DeviceSegmentManager(
-            placement=placement, free_retired=True, name="sessions"
+            placement=placement, free_retired=True, metrics=metrics, name="sessions"
         )
         self.metrics = metrics
         self.sweep_slots = max(16, _next_pow2(sweep_slots))

@@ -500,6 +500,12 @@ class ObserveConfig:
     trace_sample_seed: int = 0
     trace_span_ring: int = 2048  # recent spans kept for /trace/spans
     trace_span_file: str = ""  # OTLP-shaped JSON lines sink ("" = off)
+    # on-demand device profiling (observe/profiler.py): REST-armed
+    # jax.profiler trace captures, bounded by wall clock AND by on-disk
+    # bytes — an armed capture can never fill the data disk
+    profile_trace_dir: str = "profile_traces"
+    profile_max_seconds: float = 30.0
+    profile_max_bytes: int = 64 << 20
     # device runtime telemetry (observe/device_watch.py): alarm when the
     # jit compile rate stays nonzero after warmup (retrace storm)
     retrace_alarm_enable: bool = True

@@ -90,6 +90,17 @@ ROUTES = [
      "Arm a fault rule at a registered site (soak testing)", "faults"),
     ("delete", "/api/v5/faults", "faults_disarm",
      "Disarm fault rules (?site= for one, all otherwise)", "faults"),
+    ("get", "/api/v5/profile", "profile_get",
+     "Profiler snapshot: stage waterfall, per-kernel attribution, "
+     "hardware fingerprint, cached roofline (docs/observability.md)",
+     "profile"),
+    ("post", "/api/v5/profile", "profile_arm",
+     "Arm a bounded jax.profiler trace capture {duration_s?, "
+     "max_bytes?}, or {action: 'cost_harvest'} to (re)build the static "
+     "cost matrix", "profile"),
+    ("delete", "/api/v5/profile", "profile_disarm",
+     "Stop the armed capture early (finalizes the trace directory)",
+     "profile"),
     ("get", "/api/v5/trace/spans", "trace_spans",
      "Recent causal trace spans (publish -> batch -> device -> deliver "
      "ring buffer, OTLP-shaped)", "trace"),
@@ -321,7 +332,15 @@ class MgmtApi:
         """Flight-recorder summary of the ingest -> matcher -> dispatch
         pipeline: histogram percentiles, fallback rates, batch occupancy
         (docs/observability.md). The before/after read for perf PRs."""
+        from emqx_tpu.observe import provenance as _provenance
+        from emqx_tpu.observe.profiler import (
+            kernel_summary as _kernel_summary,
+            roofline_summary as _roofline_summary,
+            waterfall as _waterfall,
+        )
+
         m = self.broker.metrics
+        _prof = getattr(self.app, "profiler", None)
 
         def hist(name, scale=1.0):
             h = m.histogram(name)
@@ -518,6 +537,17 @@ class MgmtApi:
             "trace": {
                 "spans_sampled": m.get("trace.spans.sampled"),
                 "spans_dropped": m.get("trace.spans.dropped"),
+            },
+            "profile": {
+                "waterfall": _waterfall(m),
+                "kernels": _kernel_summary(m),
+                "capture_armed": _prof.armed if _prof else False,
+                "captures": m.get("profile.captures"),
+                "fingerprint": _provenance.fingerprint_key(),
+                "proxy": _provenance.is_proxy(),
+                "roofline": _roofline_summary(
+                    _prof.cost_cached() if _prof else None
+                ),
             },
             "alarms": {
                 "tpu_fallback_rate_active": self.app.alarms.is_active(
@@ -932,6 +962,73 @@ class MgmtApi:
         site = request.query.get("site")
         self.app.faults.disarm(site)
         return web.Response(status=204)
+
+    # -- performance provenance & device profiling (observe/profiler.py,
+    #    observe/provenance.py; docs/observability.md) --------------------
+    async def profile_get(self, request):
+        from emqx_tpu.observe import provenance
+        from emqx_tpu.observe.profiler import kernel_summary, waterfall
+
+        prof = self.app.profiler
+        m = self.broker.metrics
+        out = prof.snapshot()
+        out["waterfall"] = waterfall(m)
+        out["kernels"] = kernel_summary(m)
+        out["fingerprint"] = provenance.fingerprint()
+        cost = prof.cost_cached()
+        if cost is not None:
+            out["cost"] = cost
+        return web.json_response(out)
+
+    async def profile_arm(self, request):
+        """Arm a bounded trace capture: {duration_s?, max_bytes?} (both
+        clamped against the profiler's configured ceilings), or run the
+        static cost harvest with {action: 'cost_harvest',
+        max_configs_per_kernel?, refresh?} — the harvest compiles every
+        contract kernel, so it runs on the executor, not the loop."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return web.json_response({"code": "BAD_REQUEST"}, status=400)
+        prof = self.app.profiler
+        if body.get("action") == "cost_harvest":
+            import asyncio
+
+            cap = body.get("max_configs_per_kernel")
+            result = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: prof.cost_harvest(
+                    int(cap) if cap else None,
+                    refresh=bool(body.get("refresh", False)),
+                ),
+            )
+            return web.json_response(
+                {
+                    "kernels": sorted({r["kernel"] for r in result["rows"]}),
+                    "rows": len(result["rows"]),
+                    "skipped": result["skipped"],
+                    "proxy": result["proxy"],
+                },
+                status=201,
+            )
+        try:
+            info = prof.arm(
+                duration_s=body.get("duration_s"),
+                max_bytes=body.get("max_bytes"),
+            )
+        except (RuntimeError, ValueError, TypeError) as e:
+            return web.json_response(
+                {"code": "BAD_REQUEST", "message": str(e)}, status=400
+            )
+        return web.json_response(info, status=201)
+
+    async def profile_disarm(self, request):
+        entry = self.app.profiler.disarm(reason="rest")
+        if entry is None:
+            return web.Response(status=204)
+        return web.json_response(entry)
 
     async def slow_subs_list(self, request):
         return web.json_response({"data": self.app.slow_subs.topk()})

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from emqx_tpu.observe import faults as _faults
+from emqx_tpu.observe.profiler import record_kernel_launch
 from emqx_tpu.ops.contract import device_contract
 from emqx_tpu.ops.csr_table import CsrSegmentOwner, CsrTable, sparse_fanout_slots
 from emqx_tpu.ops.matcher import batch_match_bytes_impl
@@ -1361,6 +1362,10 @@ class RouteResult(NamedTuple):
     # compiled rule-predicate masks [R, B] bool, in DeviceRuleFilter
     # order (rules/compile.py) — consumed by the settle-time rule fire
     rule_masks: Optional[np.ndarray] = None
+    # @device_contract registry names of every kernel that rode this
+    # launch's program (observe/profiler.py per-kernel attribution:
+    # `device.kernel.<name>.seconds/.bytes`); () on paths nobody times
+    kernels: Tuple[str, ...] = ()
 
 
 class _LazyDenseRows:
@@ -1471,26 +1476,26 @@ class DeviceRouter:
             self._table_placement = tplace
             self._bitmap_placement = bitmap_placement(mesh)
             self._shape_sync = DeviceSegmentManager(
-                placement=tplace, free_retired=True, name="shapes"
+                placement=tplace, free_retired=True, metrics=self.metrics, name="shapes"
             )
             self._nfa_sync = DeviceSegmentManager(
-                placement=tplace, free_retired=True, name="nfa"
+                placement=tplace, free_retired=True, metrics=self.metrics, name="nfa"
             )
             # group tables are replicated on the mesh like match tables
             self._group_sync = DeviceSegmentManager(
-                placement=tplace, free_retired=True, name="groups"
+                placement=tplace, free_retired=True, metrics=self.metrics, name="groups"
             )
         else:
             self._table_placement = None
             self._bitmap_placement = None
             self._shape_sync = DeviceSegmentManager(
-                free_retired=True, name="shapes"
+                free_retired=True, metrics=self.metrics, name="shapes"
             )
             self._nfa_sync = DeviceSegmentManager(
-                free_retired=True, name="nfa"
+                free_retired=True, metrics=self.metrics, name="nfa"
             )
             self._group_sync = DeviceSegmentManager(
-                free_retired=True, name="groups"
+                free_retired=True, metrics=self.metrics, name="groups"
             )
         # the subscriber-table mirror follows the table's ACTIVE
         # representation: dense lanes shard over 'tp', a CSR table's
@@ -1510,7 +1515,7 @@ class DeviceRouter:
 
             sem_place = semantic_placement(mesh)
         self._sem_sync = DeviceSegmentManager(
-            placement=sem_place, free_retired=True, name="semantic"
+            placement=sem_place, free_retired=True, metrics=self.metrics, name="semantic"
         )
         # per-batch entropy seed; itertools.count's next() is atomic
         # under the GIL, keeping route_prepared free of shared mutable
@@ -1547,7 +1552,7 @@ class DeviceRouter:
             else:
                 placement = self._bitmap_placement
         return DeviceSegmentManager(
-            placement=placement, free_retired=True, name="bitmaps"
+            placement=placement, free_retired=True, metrics=self.metrics, name="bitmaps"
         )
 
     # clean-table prepares re-check the auto-sized Kslot only every this
@@ -1921,14 +1926,19 @@ class DeviceRouter:
         t0 = time.perf_counter()
         out = self._route_prepared(
             args, topics, client_hashes, retained, session, embeds,
-            rules,
+            rules, t_launch0=t0,
         )
         if self.metrics is not None:
             # Histogram.observe is lock-safe: this runs on executor threads
-            self.metrics.observe(
-                "router.device.seconds", time.perf_counter() - t0
-            )
+            wall = time.perf_counter() - t0
+            self.metrics.observe("router.device.seconds", wall)
             self.metrics.observe("router.batch.size", len(topics))
+            # per-kernel launch attribution (observe/profiler.py): the
+            # whole launch's wall + readback into every contract kernel
+            # that rode the program
+            record_kernel_launch(
+                self.metrics, out.kernels, wall, out.readback_bytes
+            )
             # cumulative link-bandwidth accounting (device_watch.py)
             self.metrics.inc("device.transfer.bytes", out.readback_bytes)
             if out.bitmaps is not None or out.slots is not None:
@@ -1948,7 +1958,7 @@ class DeviceRouter:
 
     def _route_prepared(self, args, topics, client_hashes=None,
                         retained=None, session=None, embeds=None,
-                        rules=None):
+                        rules=None, t_launch0=None):
         from emqx_tpu.broker.shared_sub import stable_hash
         from emqx_tpu.ops import tokenizer as tok
 
@@ -2037,6 +2047,7 @@ class DeviceRouter:
                 retained=retained, kg=kg,
                 sem_tables=sem_tables, sem_topk=sem_topk, qv=qv,
                 rprogs=rprogs, rfeats=rfeats, rvalid=rvalid,
+                t_launch0=t_launch0,
             )
         step_kw = dict(
             m_active=m_active,
@@ -2066,7 +2077,9 @@ class DeviceRouter:
                 sweep_k=session.sweep_k, **step_kw,
             )
             return self._readback(
-                out, B, too_long, with_groups, kslot, session=session
+                out, B, too_long, with_groups, kslot, session=session,
+                kernels=("shape_route_step", "session_ack_step"),
+                t_launch0=t_launch0,
             )
         if retained is not None and retained.chunks:
             # one launch, one readback: the storm's chunk-0 match rides
@@ -2097,6 +2110,8 @@ class DeviceRouter:
             return self._readback(
                 out, B, too_long, with_groups, kslot,
                 retained=retained, extra_retained=extra,
+                kernels=("fused_route_retained_step",),
+                t_launch0=t_launch0,
             )
         step = (
             shape_route_step_donated
@@ -2119,11 +2134,15 @@ class DeviceRouter:
             rvalid,
             **step_kw,
         )
-        return self._readback(out, B, too_long, with_groups, kslot)
+        return self._readback(
+            out, B, too_long, with_groups, kslot,
+            kernels=("shape_route_step",), t_launch0=t_launch0,
+        )
 
     def _readback(  # readback-site
         self, out, B, too_long, with_groups, kslot, mesh=False,
         retained=None, extra_retained=None, session=None,
+        kernels=(), t_launch0=None,
     ):
         """Pull one batch's outputs to host -> `RouteResult`.
 
@@ -2150,6 +2169,28 @@ class DeviceRouter:
         # fault site: a wedged/failed device->host transfer (the other
         # half of the launch's round trip; same recovery ladder)
         _faults.hit("device.readback")
+        import time
+
+        # waterfall stages (observe/profiler.py): `launch` = host encode
+        # + kernel enqueue up to here; `device_execute` = program
+        # completion wait; `readback` = the coalesced device_get + host
+        # decode. Per-batch perf_counter reads, nothing per-message.
+        m = self.metrics
+        t_rb0 = time.perf_counter()
+        if m is not None:
+            if t_launch0 is not None:
+                m.observe(
+                    "profile.stage.launch.seconds", t_rb0 - t_launch0
+                )
+            # the program's outputs complete together: waiting on one
+            # output IS the device-execute boundary
+            jax.block_until_ready(out["matched"])
+            t_dev = time.perf_counter()
+            m.observe(
+                "profile.stage.device_execute.seconds", t_dev - t_rb0
+            )
+        else:
+            t_dev = t_rb0
         pulls = {
             "matched": out["matched"][:B],
             "mcount": out["mcount"][:B],
@@ -2191,6 +2232,11 @@ class DeviceRouter:
             pulls["session_expired"] = sess["expired"]
             pulls["session_expired_count"] = sess["expired_count"]
         host = jax.device_get(pulls)
+        if m is not None:
+            m.observe(
+                "profile.stage.readback.seconds",
+                time.perf_counter() - t_dev,
+            )
         matched = host["matched"]
         sem_count = host.get("sem_count")
         rule_masks = host.get("rule_masks")
@@ -2202,6 +2248,33 @@ class DeviceRouter:
         readback = 0
         for v in host.values():
             readback += v.nbytes
+        # refine the launch's kernel-attribution names from what the
+        # program actually carried: the CSR/semantic/compaction stages
+        # are registered contracts of their own, and the base serving
+        # program traces under a different registry name per table rep
+        kern = list(kernels)
+        if mesh:
+            if "dist_shape_step" in kern:
+                if sparse_fan:
+                    kern[kern.index("dist_shape_step")] = (
+                        "sparse_dist_shape_step"
+                    )
+                elif sem_count is not None:
+                    kern[kern.index("dist_shape_step")] = (
+                        "sem_dist_shape_step"
+                    )
+        else:
+            if sparse_fan:
+                if "shape_route_step" in kern:
+                    kern[kern.index("shape_route_step")] = (
+                        "sparse_shape_route_step"
+                    )
+                kern.append("sparse_fanout_slots")
+            elif kslot and host.get("slots") is not None:
+                kern.append("compact_fanout_slots")
+            if sem_count is not None:
+                kern.append("semantic_match_step")
+        kernels = tuple(kern)
         retained_res = None
         if retained is not None:
             chunks_m = [host["retained"]] + [
@@ -2229,7 +2302,7 @@ class DeviceRouter:
                 matched, mcount, flags, None, picks,
                 readback_bytes=readback, retained=retained_res,
                 session=sess_res, sem_count=sem_count,
-                rule_masks=rule_masks,
+                rule_masks=rule_masks, kernels=kernels,
             )
         if kslot:
             slots = host["slots"]
@@ -2274,7 +2347,7 @@ class DeviceRouter:
                 dense_rows=dense_rows, dense_index=dense_index,
                 readback_bytes=readback, retained=retained_res,
                 session=sess_res, sem_count=sem_count,
-                rule_masks=rule_masks,
+                rule_masks=rule_masks, kernels=kernels,
             )
         # ascontiguousarray: some backends (axon TPU) hand back strided
         # buffers, and the dispatch path reinterprets rows as uint8
@@ -2283,7 +2356,7 @@ class DeviceRouter:
             matched, mcount, flags, bitmaps, picks,
             readback_bytes=readback, retained=retained_res,
             session=sess_res, sem_count=sem_count,
-            rule_masks=rule_masks,
+            rule_masks=rule_masks, kernels=kernels,
         )
 
     # engine capability flag the broker gates storm fusion on: the
@@ -2310,6 +2383,7 @@ class DeviceRouter:
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
         rand=None, kslot=0, retained=None, kg=0, sem_tables=None,
         sem_topk=0, qv=None, rprogs=(), rfeats=None, rvalid=None,
+        t_launch0=None,
     ):
         """SPMD serving: the batch rides dist_shape_route_step over the
         device mesh (SURVEY §2.4 TPU mapping; the multi-chip layout the
@@ -2362,7 +2436,10 @@ class DeviceRouter:
             rule_progs=rprogs,
             donate=getattr(cfg, "donate_buffers", False),
         )
-        return self._readback(out, B, too_long, with_groups, kslot, mesh=True)
+        return self._readback(
+            out, B, too_long, with_groups, kslot, mesh=True,
+            kernels=("dist_shape_step",), t_launch0=t_launch0,
+        )
 
     @staticmethod
     def _mesh_pad_rows(mat, qv, rfeats, rvalid):
@@ -2518,6 +2595,7 @@ class MeshServingRouter(DeviceRouter):
         mat, lens, B, too_long, group_tables=None, ch=None, th=None,
         rand=None, kslot=0, retained=None, kg=0, sem_tables=None,
         sem_topk=0, qv=None, rprogs=(), rfeats=None, rvalid=None,
+        t_launch0=None,
     ):
         """SPMD serving with optional fused retained storm: chunk 0 of a
         prepared `StormJob` rides the SAME sharded program + readback
@@ -2530,6 +2608,7 @@ class MeshServingRouter(DeviceRouter):
                 mat, lens, B, too_long, group_tables, ch, th, rand, kslot,
                 kg=kg, sem_tables=sem_tables, sem_topk=sem_topk, qv=qv,
                 rprogs=rprogs, rfeats=rfeats, rvalid=rvalid,
+                t_launch0=t_launch0,
             )
         from emqx_tpu.parallel.mesh import (
             dist_fused_route_step,
@@ -2591,4 +2670,5 @@ class MeshServingRouter(DeviceRouter):
         return self._readback(
             out, B, too_long, with_groups, kslot, mesh=True,
             retained=retained, extra_retained=extra,
+            kernels=("dist_fused_step",), t_launch0=t_launch0,
         )

@@ -1,0 +1,172 @@
+"""Hardware provenance: the fingerprint every measurement is stamped with.
+
+ROADMAP's re-anchor names "hardware honesty" as the standing debt: every
+figure since BENCH_r05 was measured on a CPU box where jax-on-CPU is
+noise, and nothing in the repo could *tell* a CPU-proxy number from a
+number of record. This module is the fix's foundation: one dict —
+platform, device kind/count, host cores, jax/jaxlib versions, git sha,
+clock source — computed once per process and stamped into
+
+- every BENCH/MULTICHIP JSON bench.py emits (bench refuses to print a
+  headline without it),
+- the management REST hotpath summary (`profile.provenance`),
+- span resource attributes (observe/spans.py OTLP envelope),
+
+with ``proxy: true`` whenever the detected platform is not a TPU, so a
+CPU number can never again masquerade as a number of record.
+`tools/bench_trend.py` groups runs by `fingerprint_key()` and refuses
+cross-fingerprint comparisons.
+
+Import-light on purpose: jax is imported lazily inside `fingerprint()`
+(bench's parent process stamps its summary without paying a backend
+init; the child sweeps already own one).
+"""
+
+from __future__ import annotations
+
+import os
+import platform as _platform
+import subprocess
+import time
+from typing import Any, Dict, Optional
+
+# the fields two runs must share to be COMPARABLE (bench_trend's
+# grouping key). git sha is deliberately excluded — comparing across
+# commits on the same hardware is the whole point of a trend report —
+# and so is the clock source (informational, not a perf axis).
+KEY_FIELDS = (
+    "platform",
+    "device_kind",
+    "device_count",
+    "host_cores",
+    "jax",
+    "jaxlib",
+)
+
+# platforms that count as the accelerator of record. "tpu" is the stock
+# jax name; "axon" is the PJRT plugin name the chip registers under on
+# the capture boxes — a number taken there must NOT be flagged proxy.
+_RECORD_PLATFORMS = ("tpu", "axon")
+
+_CACHE: Optional[Dict[str, Any]] = None
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:  # noqa: BLE001 — provenance must never raise
+        pass
+    return ""
+
+
+def _clock_source() -> str:
+    """Which clock perf_counter timings actually stand on: the kernel's
+    clocksource when readable (tsc vs hpet/acpi_pm changes what a
+    microsecond histogram means), else python's perf_counter impl."""
+    try:
+        p = "/sys/devices/system/clocksource/clocksource0/current_clocksource"
+        with open(p) as f:
+            return f.read().strip()
+    except OSError:
+        pass
+    try:
+        return time.get_clock_info("perf_counter").implementation
+    except Exception:  # noqa: BLE001 — informational field only
+        return "unknown"
+
+
+def fingerprint(refresh: bool = False) -> Dict[str, Any]:
+    """The process-wide hardware fingerprint (computed once, cached).
+
+    Returns a fresh dict each call (callers stamp it into JSON docs they
+    then mutate). ``proxy`` is True on any non-TPU backend — the flag
+    bench.py threads into every emitter so dashboards and the trend
+    gate can refuse to headline a CPU number.
+    """
+    global _CACHE
+    if _CACHE is None or refresh:
+        info: Dict[str, Any] = {
+            "platform": "unknown",
+            "device_kind": "unknown",
+            "device_count": 0,
+            "host_cores": os.cpu_count() or 0,
+            "machine": _platform.machine(),
+            "python": _platform.python_version(),
+            "jax": "",
+            "jaxlib": "",
+            "git_sha": _git_sha(),
+            "clock_source": _clock_source(),
+        }
+        try:
+            import jax
+
+            info["jax"] = getattr(jax, "__version__", "")
+            try:
+                import jaxlib
+
+                info["jaxlib"] = getattr(jaxlib, "__version__", "") or ""
+            except Exception:  # noqa: BLE001 — version probe only
+                pass
+            devs = jax.devices()
+            if devs:
+                info["platform"] = devs[0].platform
+                info["device_kind"] = getattr(
+                    devs[0], "device_kind", devs[0].platform
+                )
+                info["device_count"] = len(devs)
+        except Exception:  # noqa: BLE001 — no backend: still a fingerprint
+            pass
+        info["proxy"] = info["platform"] not in _RECORD_PLATFORMS
+        _CACHE = info
+    return dict(_CACHE)
+
+
+def is_proxy() -> bool:
+    """True when the detected backend is NOT a TPU (the number is a
+    CPU/GPU proxy, never a number of record)."""
+    return bool(fingerprint().get("proxy", True))
+
+
+def fingerprint_key(fp: Optional[Dict[str, Any]] = None) -> str:
+    """Stable comparability key over KEY_FIELDS. Two runs with different
+    keys must never be compared (bench_trend rejects the pair)."""
+    if fp is None:
+        fp = fingerprint()
+    return "|".join(str(fp.get(k, "")) for k in KEY_FIELDS)
+
+
+def stamp(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp a JSON-bound dict in place: fingerprint + top-level proxy
+    flag (the flag rides at top level so a grep of any BENCH JSON
+    answers "is this a number of record?" without walking the nest)."""
+    fp = fingerprint()
+    doc["fingerprint"] = fp
+    doc["proxy"] = bool(fp["proxy"])
+    return doc
+
+
+def resource_attrs() -> Dict[str, Any]:
+    """Span resource attributes (OTLP envelope): the fingerprint fields
+    flattened under the `hw.` prefix, the idiomatic resource keys."""
+    fp = fingerprint()
+    return {
+        "hw.platform": fp["platform"],
+        "hw.device_kind": fp["device_kind"],
+        "hw.device_count": fp["device_count"],
+        "hw.host_cores": fp["host_cores"],
+        "hw.jax": fp["jax"],
+        "hw.jaxlib": fp["jaxlib"],
+        "hw.git_sha": fp["git_sha"],
+        "hw.clock_source": fp["clock_source"],
+        "hw.proxy": bool(fp["proxy"]),
+    }

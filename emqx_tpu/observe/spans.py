@@ -131,6 +131,12 @@ class OtlpFileExporter:
         self.path = path
         self.service_name = service_name
         self.flush_every = flush_every
+        # hardware provenance rides every envelope: a span file replayed
+        # months later still says what silicon produced the latencies
+        # (observe/provenance.py; hw.* resource attribute keys)
+        from emqx_tpu.observe.provenance import resource_attrs
+
+        self._resource_attrs = resource_attrs()
         self._buf: List[Dict] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         d = os.path.dirname(path)
@@ -157,6 +163,12 @@ class OtlpFileExporter:
                                 "key": "service.name",
                                 "value": {"stringValue": self.service_name},
                             }
+                        ]
+                        + [
+                            {"key": k, "value": _otlp_value(v)}
+                            for k, v in sorted(
+                                self._resource_attrs.items()
+                            )
                         ]
                     },
                     "scopeSpans": [

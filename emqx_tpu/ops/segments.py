@@ -108,6 +108,7 @@ class DeviceSegmentManager:
         placement=None,
         free_retired: bool = False,
         name: str = "",
+        metrics=None,
     ) -> None:
         """`placement`: optional fn(name, np_or_dev_array) -> device array
         applied to full uploads AND re-pinned after delta scatters — e.g.
@@ -121,6 +122,9 @@ class DeviceSegmentManager:
         executor batches still holding the previous snapshot stay valid.
         """
         self.name = name
+        # per-kernel attribution sink (observe/profiler.py); None keeps
+        # the manager usable as a bare library object
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._arrays: Optional[Dict] = None  # guarded-by: _lock
         self._epoch = -1  # guarded-by: _lock
@@ -301,7 +305,20 @@ class DeviceSegmentManager:
                 idxs[name] = jnp.asarray(ix)
                 vals[name] = jnp.asarray(vv)
             # every touched array updates in ONE device launch
+            t0 = time.perf_counter()
             out = _segment_scatter(flats, idxs, vals)
+            if self.metrics is not None:
+                # launch attribution (observe/profiler.py): the update
+                # path's one fused kernel, keyed by its contract name
+                from emqx_tpu.observe.profiler import (
+                    record_kernel_launch,
+                )
+
+                record_kernel_launch(
+                    self.metrics,
+                    ("segment_scatter_insert",),
+                    time.perf_counter() - t0,
+                )
             self.delta_launches += 1
             for name in flats:
                 new = out[name].reshape(shapes[name])

@@ -248,7 +248,17 @@ def dist_route_step(
         max_matches,
         probes,
     )
-    return fn(tables, sub_bitmaps, bytes_mat, lengths)
+    import time
+
+    from emqx_tpu.broker.metrics import default_metrics
+    from emqx_tpu.observe.profiler import record_kernel_launch
+
+    t0 = time.perf_counter()
+    out = fn(tables, sub_bitmaps, bytes_mat, lengths)
+    record_kernel_launch(
+        default_metrics, ("dist_step",), time.perf_counter() - t0
+    )
+    return out
 
 
 @device_contract(

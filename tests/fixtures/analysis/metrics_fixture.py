@@ -44,6 +44,12 @@ declare("slo.deferrals", COUNTER)
 declare("ingest.lane.depth.control", "gauge")
 declare("ingest.lane.settle.seconds.control", "histogram")
 declare("retained.storm.deferred", COUNTER)
+declare("profile.stage.queue_wait.seconds", "histogram")
+declare("profile.captures", COUNTER)
+declare("profile.cost.kernels", "gauge")
+declare("provenance.proxy", "gauge")
+declare("device.kernel.shape_route_step.seconds", "histogram")
+declare("device.kernel.shape_route_step.bytes", "histogram")
 
 
 class M:
@@ -95,6 +101,12 @@ def good(m: M):
     m.gauge_set("ingest.lane.depth.control", 3)
     m.observe("ingest.lane.settle.seconds.control", 0.002)
     m.inc("retained.storm.deferred")
+    m.observe("profile.stage.queue_wait.seconds", 0.001)
+    m.inc("profile.captures")
+    m.gauge_set("profile.cost.kernels", 14)
+    m.gauge_set("provenance.proxy", 1)
+    m.observe("device.kernel.shape_route_step.seconds", 0.002)
+    m.observe("device.kernel.shape_route_step.bytes", 4096)
 
 
 def bad(m: M):
@@ -134,3 +146,7 @@ def bad(m: M):
     m.gauge_set("ingest.lane.depth.contrl", 1)  # MN001: typo'd lane gauge
     m.observe("ingest.lane.settle.secondz.control", 1)  # MN001: typo'd lane histo
     m.inc("retained.storm.deferd")  # MN001: typo'd defer counter
+    m.observe("profile.stage.queue_wate.seconds", 1)  # MN001: typo'd stage histo
+    m.inc("profile.capturez")  # MN001: typo'd capture counter
+    m.gauge_set("provenance.proxi", 1)  # MN001: typo'd provenance gauge
+    m.observe("device.kernel.shape_root_step.seconds", 1)  # MN001: typo'd kernel series

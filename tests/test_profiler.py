@@ -1,0 +1,544 @@
+"""Performance provenance & device profiling plane (PR 16).
+
+Pins the observability contracts docs/observability.md ("Profiling &
+provenance") names:
+
+- the launch waterfall: every stage of the serving path (prepare ->
+  queue-wait -> launch -> device-execute -> readback -> host-dispatch)
+  records into its own histogram on the REAL BatchIngest path, and the
+  stage means tile the measured enqueue->settle latency;
+- per-kernel cost attribution: `device.kernel.<name>.*` series are
+  keyed to @device_contract REGISTRY names — the route, session-ride,
+  and semantic kernels each show up when their path runs;
+- the disarmed profiler is structurally zero (racetrack discipline):
+  no capture object, no trace directory, no series, no tick work;
+- the REST arm/capture/disarm lifecycle with a REAL on-disk byte
+  budget (an over-budget capture is deleted, not kept);
+- the static cost harvest covers the ENTIRE contract registry via the
+  audit's own config-matrix recipes;
+- hardware fingerprints are stable within a process, proxy-tagged off
+  TPU, and stamped into bench emitters;
+- tools/bench_trend.py flags same-fingerprint regressions and REFUSES
+  cross-fingerprint comparisons.
+"""
+
+import asyncio
+import functools
+import json
+import os
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.ingest import BatchIngest
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.metrics import Metrics
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.session import Session, SessionConfig
+from emqx_tpu.broker.session_store import SessionStore
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.observe import provenance
+from emqx_tpu.observe.profiler import (
+    STAGES,
+    Profiler,
+    harvest_cost,
+    kernel_summary,
+    record_kernel_launch,
+    roofline_summary,
+    waterfall,
+)
+from emqx_tpu.ops.contract import REGISTRY
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        asyncio.run(asyncio.wait_for(fn(*a, **kw), timeout=120))
+
+    return wrapper
+
+
+def _mk_broker(min_batch=1):
+    return Broker(router=Router(min_tpu_batch=min_batch), hooks=Hooks())
+
+
+def _sub_n(b, n, sink=None):
+    for i in range(n):
+        b.subscribe(
+            f"s{i}", f"c{i}", f"t/{i}/+", pkt.SubOpts(),
+            (lambda m, o: sink.append(m.topic)) if sink is not None
+            else (lambda m, o: None),
+        )
+
+
+def _msgs(n, qos=0):
+    return [
+        Message(topic=f"t/{i % 8}/x", payload=b"p", qos=qos,
+                from_client=f"pub{i}")
+        for i in range(n)
+    ]
+
+
+# -- launch waterfall on the real ingest path --------------------------------
+
+
+class TestWaterfall:
+    @async_test
+    async def test_stage_sums_tile_the_settle_latency(self):
+        """Every waterfall stage records on the real enqueue->settle
+        path, and the per-message stage means reconstruct the measured
+        `ingest.settle.seconds` mean to within tolerance: the waterfall
+        is an attribution of the SLO latency, not a parallel universe
+        of timers."""
+        b = _mk_broker(min_batch=8)
+        _sub_n(b, 8)
+        ing = BatchIngest(b, max_batch=64, window_us=500)
+        b.ingest = ing
+        ing.start()
+        # warm batch: the jit compile lands outside the measured window
+        await b.apublish_enqueue(Message(topic="t/0/w", payload=b"w"))
+        await asyncio.sleep(0.2)
+        rs = [await b.apublish_enqueue(m) for m in _msgs(256)]
+        await asyncio.gather(*[r for r in rs if not isinstance(r, int)])
+        await ing.stop()
+        m = b.metrics
+        wf = waterfall(m)
+        assert set(wf) == set(STAGES)
+        for stage in STAGES:
+            assert wf[stage] is not None, f"stage {stage} never observed"
+            assert wf[stage]["count"] > 0
+            assert wf[stage]["p99"] >= wf[stage]["p50"] >= 0.0
+        settle = m.histogram("ingest.settle.seconds")
+        assert settle is not None and settle.count > 0
+        settle_mean = settle.sum / settle.count
+        # queue_wait is per-message; the remaining stages are per-batch
+        # and shared by every message that rode the batch — their means
+        # add directly onto the per-message queue wait
+        stage_sum = sum(wf[s]["mean"] for s in STAGES)
+        # tolerant tiling: executor hops / loop scheduling live in the
+        # gaps, and histogram means are bucket-interpolated
+        assert stage_sum <= settle_mean * 2.0 + 0.05, (
+            stage_sum, settle_mean)
+        assert stage_sum >= settle_mean * 0.2, (stage_sum, settle_mean)
+
+
+# -- per-kernel attribution keyed to contract names --------------------------
+
+
+class TestKernelAttribution:
+    def test_route_kernels_attributed_under_registry_names(self):
+        b = _mk_broker()
+        _sub_n(b, 8)
+        dr = b._device_router()
+        res = dr.route_prepared(dr.prepare(),
+                                [m.topic for m in _msgs(16)])
+        assert res.kernels, "RouteResult.kernels must name the program"
+        for name in res.kernels:
+            assert name in REGISTRY, name
+        ks = kernel_summary(b.metrics)
+        hit = [k for k in res.kernels if k in ks]
+        assert hit, (res.kernels, sorted(ks))
+        for k in hit:
+            assert ks[k]["launches"] >= 1
+            assert ks[k]["mean_ms"] > 0.0
+        # the route program itself rode the launch
+        assert any(
+            k in ks for k in ("shape_route_step",
+                              "sparse_shape_route_step")
+        ), sorted(ks)
+
+    @async_test
+    async def test_session_ride_attributes_session_ack_step(self):
+        b = _mk_broker()
+        store = SessionStore(metrics=b.metrics, capacity=256,
+                             sweep_slots=64, retry_interval=30.0)
+        b.session_store = store
+        sess = Session("c0", SessionConfig(), store=store)
+        sent = []
+
+        def deliver(m, o):
+            sent.extend(sess.deliver(m, o))
+
+        b.subscribe("c0", "c0", "t/#", pkt.SubOpts(qos=1), deliver)
+        await b.adispatch_batch_folded(_msgs(8, qos=1))
+        for p in sent[:4]:
+            sess.puback(p.packet_id)
+        await b.adispatch_batch_folded(_msgs(8, qos=1))  # rider batch
+        ks = kernel_summary(b.metrics)
+        assert "session_ack_step" in ks, sorted(ks)
+        assert ks["session_ack_step"]["launches"] >= 1
+
+    def test_semantic_match_attributed(self):
+        from emqx_tpu.broker.semantic import SemanticRouting
+
+        rng = np.random.default_rng(7)
+        dim = 16
+
+        def unit():
+            v = rng.normal(size=dim).astype(np.float32)
+            return v / np.linalg.norm(v)
+
+        b = _mk_broker()
+        b.semantic = SemanticRouting(dim=dim, topk=4, threshold=0.3,
+                                     metrics=b.metrics)
+        opts = pkt.SubOpts(qos=0)
+        b.subscribe("p1", "p1", "a/#", opts, lambda m, o: None)
+        for i in range(4):
+            b.subscribe(f"m{i}", f"m{i}", "a/#", opts,
+                        lambda m, o: None,
+                        embedding=unit(), sem_threshold=0.3)
+        msgs = []
+        for i in range(8):
+            m = Message(topic=f"a/{i}", payload=b"{}",
+                        from_client="pub")
+            m.headers["semantic_embedding"] = unit()
+            msgs.append(m)
+        b.dispatch_batch_folded(msgs)
+        ks = kernel_summary(b.metrics)
+        assert "semantic_match_step" in ks, sorted(ks)
+        assert ks["semantic_match_step"]["launches"] >= 1
+
+    def test_record_kernel_launch_is_metrics_optional(self):
+        # bare-library semantics: no metrics registry, no crash
+        record_kernel_launch(None, ("shape_route_step",), 0.001, 64)
+
+
+# -- disarmed profiler: structurally zero ------------------------------------
+
+
+class TestDisarmedStructuralZero:
+    def test_disarmed_is_inert(self, tmp_path):
+        """Racetrack discipline: DISARMED means no capture object, no
+        trace directory on disk, a no-op tick, and a None disarm —
+        there is nothing for the hot path to even check."""
+        m = Metrics()
+        trace_dir = str(tmp_path / "captures")
+        p = Profiler(metrics=m, trace_dir=trace_dir)
+        assert p.capture is None
+        assert p.armed is False
+        assert not os.path.exists(trace_dir)  # nothing made eagerly
+        p.tick()  # no-op while disarmed
+        assert p.disarm() is None
+        assert not os.path.exists(trace_dir)
+        assert m.get("profile.captures") == 0
+        snap = p.snapshot()
+        assert snap["armed"] is False
+        assert snap["capture"] is None
+        assert snap["history"] == []
+        assert snap["cost_harvested"] is False
+        assert p.cost_cached() is None
+
+
+# -- capture lifecycle + file budget -----------------------------------------
+
+
+class TestCaptureLifecycle:
+    def test_arm_capture_disarm_with_budget_kept(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        m = Metrics()
+        p = Profiler(metrics=m, trace_dir=str(tmp_path))
+        try:
+            info = p.arm(duration_s=20.0)
+            assert p.armed and os.path.isdir(info["dir"])
+            with pytest.raises(RuntimeError):
+                p.arm()  # one capture at a time (process-global trace)
+            jax.block_until_ready(
+                jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            )
+        finally:
+            entry = p.disarm("test")
+        assert entry is not None
+        assert entry["bytes"] > 0, "capture files must be non-empty"
+        assert entry["deleted"] is False
+        assert os.path.isdir(entry["dir"])
+        assert p.capture is None
+        assert m.get("profile.captures") == 1
+        assert p.snapshot()["history"][-1]["reason"] == "test"
+
+    def test_over_budget_capture_is_deleted(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        p = Profiler(metrics=Metrics(), trace_dir=str(tmp_path))
+        try:
+            info = p.arm(duration_s=20.0, max_bytes=1)  # clamps to 64 KiB
+            assert info["max_bytes"] == 1 << 16
+            jax.block_until_ready(
+                jnp.ones((128, 128)) @ jnp.ones((128, 128))
+            )
+        finally:
+            entry = p.disarm("budget-test")
+        assert entry is not None and entry["bytes"] > 1 << 16
+        assert entry["over_budget"] is True and entry["deleted"] is True
+        assert not os.path.exists(entry["dir"]), (
+            "over-budget captures must be removed from disk"
+        )
+
+    def test_tick_auto_disarms_past_deadline(self, tmp_path):
+        import time as _time
+
+        p = Profiler(metrics=Metrics(), trace_dir=str(tmp_path))
+        p.arm(duration_s=0.1)
+        p.tick(now=_time.time() + 5.0)  # housekeeping past the deadline
+        assert p.capture is None
+        hist = p.snapshot()["history"]
+        assert hist and hist[-1]["reason"] == "deadline"
+
+    @async_test
+    async def test_rest_arm_capture_disarm_lifecycle(self, tmp_path):
+        import aiohttp
+
+        from emqx_tpu.app import BrokerApp
+        from emqx_tpu.config.schema import load_config
+
+        app = BrokerApp(load_config({
+            "listeners": [{"port": 0, "bind": "127.0.0.1"}],
+            "dashboard": {"port": 0, "bind": "127.0.0.1"},
+            "observe": {"profile_trace_dir": str(tmp_path)},
+        }))
+        await app.start()
+        try:
+            api = f"http://127.0.0.1:{app.mgmt_server.port}/api/v5"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{api}/profile") as r:
+                    assert r.status == 200
+                    snap = await r.json()
+                    assert snap["armed"] is False
+                    assert snap["fingerprint"]["proxy"] is True
+                    assert set(snap["waterfall"]) == set(STAGES)
+                async with s.post(
+                    f"{api}/profile", json={"duration_s": 20.0}
+                ) as r:
+                    assert r.status == 201
+                    info = await r.json()
+                    assert info["dir"].startswith(str(tmp_path))
+                async with s.post(f"{api}/profile", json={}) as r:
+                    assert r.status == 400  # already armed
+                # the armed state is visible in the hotpath block too
+                async with s.get(f"{api}/metrics/hotpath") as r:
+                    hp = await r.json()
+                    assert hp["profile"]["capture_armed"] is True
+                    assert hp["profile"]["proxy"] is True
+                    assert hp["profile"]["fingerprint"]
+                async with s.delete(f"{api}/profile") as r:
+                    assert r.status == 200
+                    entry = await r.json()
+                    assert entry["reason"] == "rest"
+                async with s.delete(f"{api}/profile") as r:
+                    assert r.status == 204  # idempotent when disarmed
+                async with s.get(f"{api}/profile") as r:
+                    snap = await r.json()
+                    assert snap["armed"] is False
+                    assert len(snap["history"]) == 1
+        finally:
+            await app.stop()
+
+
+# -- static cost harvest over the contract matrix ----------------------------
+
+
+class TestCostHarvest:
+    def test_harvest_covers_entire_contract_registry(self):
+        """Every @device_contract kernel compiles through the audit's
+        own harness recipes and yields a roofline row — a kernel the
+        harvest cannot reach lands in `skipped`, never silently."""
+        # populate the registry exactly as the audit does
+        import emqx_tpu.models.router_model  # noqa: F401
+        import emqx_tpu.ops.session_table  # noqa: F401
+        import emqx_tpu.parallel.mesh  # noqa: F401
+
+        assert len(REGISTRY) >= 14
+        out = harvest_cost(max_configs_per_kernel=1)
+        names = {r["kernel"] for r in out["rows"]}
+        assert names == set(REGISTRY), (
+            sorted(set(REGISTRY) - names), out["skipped"])
+        for r in out["rows"]:
+            assert r["flops"] >= 0.0
+            assert r["bytes_accessed"] >= 0.0
+            assert r["config"]
+            if r["arithmetic_intensity"] is not None:
+                assert r["bound"] in ("compute", "memory")
+                assert r["attainable_flops"] > 0.0
+        assert out["proxy"] is True  # CPU run: peaks are placeholders
+        roof = roofline_summary(out)
+        assert set(roof["kernels"]) == names
+        assert roofline_summary(None) is None
+
+    def test_profiler_caches_harvest(self):
+        p = Profiler(metrics=Metrics())
+        first = p.cost_harvest(max_configs_per_kernel=1)
+        assert p.cost_cached() is first
+        assert p.cost_harvest(max_configs_per_kernel=1) is first
+        assert p.metrics.gauge("profile.cost.kernels") >= 14
+
+
+# -- provenance fingerprints -------------------------------------------------
+
+
+class TestProvenance:
+    def test_fingerprint_is_stable_and_proxy_tagged(self):
+        fp1 = provenance.fingerprint()
+        fp2 = provenance.fingerprint()
+        assert fp1 == fp2
+        assert fp1 is not fp2  # callers get copies, not the cache
+        for key in provenance.KEY_FIELDS:
+            assert key in fp1, key
+        # the tier-1 environment is never a TPU: proxy MUST be true
+        assert fp1["platform"] != "tpu"
+        assert fp1["proxy"] is True
+        assert provenance.is_proxy() is True
+        assert provenance.fingerprint_key(fp1) == \
+            provenance.fingerprint_key(fp2)
+        assert str(fp1["platform"]) in provenance.fingerprint_key(fp1)
+
+    def test_stamp_and_resource_attrs(self):
+        doc = {"metric": "x", "value": 1.0}
+        out = provenance.stamp(doc)
+        assert out is doc
+        assert doc["proxy"] is True
+        assert doc["fingerprint"]["platform"] == \
+            provenance.fingerprint()["platform"]
+        attrs = provenance.resource_attrs()
+        assert attrs["hw.proxy"] is True
+        assert attrs["hw.platform"] == doc["fingerprint"]["platform"]
+
+    def test_span_exporter_carries_hw_resource_attrs(self, tmp_path):
+        from emqx_tpu.observe.spans import OtlpFileExporter, Span
+
+        path = str(tmp_path / "spans.jsonl")
+        exp = OtlpFileExporter(path, flush_every=1)
+        exp.export([Span(trace_id="t" * 32, span_id="s" * 16,
+                         name="probe", start_ns=1, end_ns=2)])
+        exp.flush()
+        with open(path) as f:
+            env = json.loads(f.readline())
+        attrs = {
+            a["key"]: a["value"]
+            for a in env["resourceSpans"][0]["resource"]["attributes"]
+        }
+        assert attrs["service.name"] == {"stringValue": "emqx_tpu"}
+        assert attrs["hw.proxy"] == {"boolValue": True}
+        assert "hw.platform" in attrs and "hw.git_sha" in attrs
+
+
+# -- bench trend: fingerprint-grouped regression gate ------------------------
+
+
+def _fp(**over):
+    fp = {
+        "platform": "cpu", "device_kind": "cpu", "device_count": 1,
+        "host_cores": 1, "jax": "0.0", "jaxlib": "0.0",
+        "git_sha": "abc", "clock_source": "tsc", "proxy": True,
+    }
+    fp.update(over)
+    return fp
+
+
+def _bench_wrapper(n, value, fp, metric="e2e_serving_msgs_per_s",
+                   detail=None):
+    doc = {"metric": metric, "value": value, "unit": "msgs/s",
+           "detail": detail or {}, "fingerprint": fp,
+           "proxy": fp["proxy"] if fp else True}
+    if fp is None:
+        doc.pop("fingerprint")
+        doc.pop("proxy")
+    return {"n": n, "cmd": "bench", "rc": 0, "parsed": None,
+            "tail": "noise line\n" + json.dumps(doc)}
+
+
+class TestBenchTrend:
+    def _write(self, tmp_path, runs):
+        for n, run in enumerate(runs, start=1):
+            (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+                json.dumps(run)
+            )
+
+    def test_same_fingerprint_regression_fails_check(self, tmp_path):
+        from tools import bench_trend
+
+        fp = _fp()
+        self._write(tmp_path, [
+            _bench_wrapper(1, 100_000.0, fp),
+            _bench_wrapper(2, 40_000.0, fp),  # -60% past any threshold
+        ])
+        rc = bench_trend.main(["--dir", str(tmp_path), "--check",
+                               "--out", str(tmp_path / "trend.md")])
+        assert rc == 1
+        report = (tmp_path / "trend.md").read_text()
+        assert "REGRESSIONS" in report
+        assert "e2e_serving_msgs_per_s" in report
+
+    def test_improvement_and_within_threshold_pass(self, tmp_path):
+        from tools import bench_trend
+
+        fp = _fp()
+        self._write(tmp_path, [
+            _bench_wrapper(1, 100_000.0, fp),
+            _bench_wrapper(2, 95_000.0, fp),   # -5%: inside threshold
+            _bench_wrapper(3, 200_000.0, fp),  # improvement
+        ])
+        rc = bench_trend.main(["--dir", str(tmp_path), "--check",
+                               "--out", str(tmp_path / "trend.md")])
+        assert rc == 0
+
+    def test_cross_fingerprint_comparison_rejected(self, tmp_path):
+        from tools import bench_trend
+
+        self._write(tmp_path, [
+            _bench_wrapper(1, 1_000_000.0, _fp(device_kind="tpu-v5p",
+                                               platform="tpu",
+                                               proxy=False)),
+            # same metric, 100x lower on different hardware: NOT a
+            # regression — the comparison itself must be refused
+            _bench_wrapper(2, 10_000.0, _fp()),
+        ])
+        runs = bench_trend.load_trajectory(str(tmp_path))
+        cmp = bench_trend.compare(runs, 0.25)
+        assert cmp["regressions"] == []
+        assert cmp["rejected"] >= 1
+        rc = bench_trend.main(["--dir", str(tmp_path), "--check",
+                               "--out", str(tmp_path / "trend.md")])
+        assert rc == 0
+
+    def test_legacy_runs_backfilled_and_never_compared(self, tmp_path):
+        from tools import bench_trend
+
+        self._write(tmp_path, [
+            _bench_wrapper(1, 100_000.0, None),  # pre-provenance
+            _bench_wrapper(2, 1_000.0, None),
+        ])
+        runs = bench_trend.load_trajectory(str(tmp_path))
+        assert all(r["fingerprint"] is None for r in runs)
+        assert all(r["proxy"] is True for r in runs)
+        assert all(r["key"] == bench_trend.LEGACY_KEY for r in runs)
+        cmp = bench_trend.compare(runs, 0.25)
+        assert cmp["regressions"] == []  # unattributable: no baseline
+        assert cmp["rejected"] >= 1
+
+    def test_lower_is_better_direction(self, tmp_path):
+        from tools import bench_trend
+
+        fp = _fp()
+        self._write(tmp_path, [
+            _bench_wrapper(1, 100_000.0, fp,
+                           detail={"e2e_paced_p99_ms": 1.0}),
+            _bench_wrapper(2, 100_000.0, fp,
+                           detail={"e2e_paced_p99_ms": 5.0}),
+        ])
+        rc = bench_trend.main(["--dir", str(tmp_path), "--check",
+                               "--out", str(tmp_path / "trend.md")])
+        assert rc == 1  # 5x the p99 latency IS a regression
+        assert not bench_trend.lower_is_better("e2e_serving_msgs_per_s")
+        assert bench_trend.lower_is_better("e2e_paced_p99_ms")
+
+    def test_committed_trajectory_passes_check(self):
+        from tools import bench_trend
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        rc = bench_trend.main(["--dir", root, "--check",
+                               "--out", os.devnull])
+        assert rc == 0

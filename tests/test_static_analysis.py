@@ -155,6 +155,8 @@ def test_metric_checker_flags_undeclared_series():
         "slo.window_uz", "slo.ladder.wrung", "slo.violationz",
         "ingest.lane.depth.contrl", "ingest.lane.settle.secondz.control",
         "retained.storm.deferd",
+        "profile.stage.queue_wate.seconds", "profile.capturez",
+        "provenance.proxi", "device.kernel.shape_root_step.seconds",
     }
 
 

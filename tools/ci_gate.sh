@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # ci_gate.sh — THE single pre-merge command (docs/concurrency.md,
-# docs/static_analysis.md). Four gates, in the order that fails fastest:
+# docs/static_analysis.md). Five gates, in the order that fails fastest:
 #
 #   1. tpu_lint, all checkers            (pure AST, ~8 s)
 #   2. the device-contract audit          (jaxpr tracing on CPU)
@@ -9,6 +9,10 @@
 #   4. the race suite alone, verbose      (`-m race`) — redundant with (3)
 #      but isolates the concurrency rig's verdict in its own section of
 #      the log, so a race report is never buried in a 500-test dot wall
+#   5. the bench-trend gate               (tools/bench_trend.py --check:
+#      the committed BENCH trajectory, grouped by hardware fingerprint —
+#      fails when a same-fingerprint metric regressed past threshold;
+#      run it again after any bench recipe below refreshes a capture)
 #
 # Fast mode for the inner loop (pre-push, not pre-merge):
 #
@@ -85,9 +89,54 @@ done
 
 banner() { printf '\n== %s ==\n' "$*"; }
 
+profile_smoke() {
+    # arm -> one real batch through ingest -> disarm -> assert the
+    # jax.profiler capture landed non-empty and under budget
+    python - <<'PY'
+import asyncio, tempfile
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.ingest import BatchIngest
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.router import Router
+from emqx_tpu.mqtt import packet as pkt
+from emqx_tpu.observe.profiler import Profiler
+
+
+async def main():
+    broker = Broker(router=Router(min_tpu_batch=8), hooks=Hooks())
+    prof = Profiler(metrics=broker.metrics, trace_dir=tempfile.mkdtemp())
+    sink = []
+    for i in range(8):
+        broker.subscribe(f"s{i}", f"c{i}", f"p/{i}", pkt.SubOpts(),
+                         lambda m, o: sink.append(m.topic))
+    ing = BatchIngest(broker, max_batch=64, window_us=500)
+    broker.ingest = ing
+    ing.start()
+    prof.arm(duration_s=20.0)
+    rs = [await broker.apublish_enqueue(
+        Message(topic=f"p/{i % 8}", payload=b"x", from_client=f"b{i}"))
+        for i in range(64)]
+    await asyncio.gather(*[r for r in rs if not isinstance(r, int)])
+    entry = prof.disarm("smoke")
+    await ing.stop()
+    assert entry is not None and entry["bytes"] > 0 \
+        and not entry["deleted"], entry
+    print(f"profile smoke ok: {entry['bytes']} bytes -> {entry['dir']}")
+
+
+asyncio.run(main())
+PY
+}
+
 if [ "$FAST" = 1 ]; then
     banner "tpu_lint (changed files)"
     python -m tools.analysis --changed-only --jobs 8
+    banner "profile smoke (arm -> batch -> disarm)"
+    profile_smoke
+    banner "bench trend gate (fingerprint-grouped)"
+    python -m tools.bench_trend --check > /dev/null
     banner "race suite (racetrack armed)"
     python -m pytest tests/ -q -m race -p no:cacheprovider
     exit 0
@@ -105,5 +154,8 @@ python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
 
 banner "race suite (racetrack armed)"
 python -m pytest tests/ -m race -p no:cacheprovider
+
+banner "bench trend gate (fingerprint-grouped)"
+python -m tools.bench_trend --check > /dev/null
 
 banner "ci_gate: all gates green"
