@@ -2435,6 +2435,28 @@ def bench_chaos_soak() -> dict:
             r.render() for r in races
         )
         m = b.metrics
+
+        # wave 5 (replication readiness, docs/static_analysis.md
+        # "Tier B"): the shadow-replica audit rides the soak — bounded
+        # randomized churn across all five mirrored owners with a
+        # compaction racing loop inserts, gated on array-exact
+        # convergence AND the seeded incomplete-log control detected
+        from emqx_tpu.observe.replay_check import run_replay_audit
+
+        replay = run_replay_audit(seed=2207, rounds=12, metrics=m)
+        assert not replay["divergence"], replay["divergence"]
+        assert replay["negative_detected"], (
+            "seeded incomplete-log write went undetected"
+        )
+        replay_probe = {
+            "owners": len(replay["owners"]),
+            "syncs": m.get("replay.syncs"),
+            "captures": m.get("replay.captures"),
+            "compactions": replay["compactions"],
+            "divergence": 0,
+            "negative_detected": True,
+        }
+        _mark(f"chaos_soak: replay {json.dumps(replay_probe)}")
         ratio = (
             round(recovered["rps"] / baseline["rps"], 3)
             if baseline["rps"]
@@ -2471,6 +2493,7 @@ def bench_chaos_soak() -> dict:
             "post_inflight_recovery": post_inflight,
             "fault_overload": overload,
             "post_overload_recovery": post_overload,
+            "replay_probe": replay_probe,
             "recovery_rps_ratio": ratio,
             "degrade": {
                 "trips": m.get("degrade.trips.device"),

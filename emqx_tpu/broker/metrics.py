@@ -639,6 +639,25 @@ declare("race.reports", COUNTER,
         "candidate data races reported by the armed lockset/HB detector "
         "(field + both stacks + locksets; zero unwaived is the gate)")
 
+# -- shadow-replica replication audit (observe/replay_check.py) ------------
+declare("replay.captures", COUNTER,
+        "sync records captured by armed replay taps (full epoch uploads "
+        "+ op-log delta suffixes; disarmed production cost is zero)")
+declare("replay.syncs", COUNTER,
+        "manager sync() calls observed while a replay tap is armed")
+declare("replay.offers", COUNTER,
+        "compaction offers observed while a replay tap is armed")
+declare("replay.divergence", COUNTER,
+        "owners whose shadow replica failed array-exact convergence "
+        "(zero is the gate; any count means the op-log stream a standby "
+        "would receive is incomplete)")
+declare("analysis.replay.runs", COUNTER,
+        "replication replay audits executed (ci_gate --replay and the "
+        "chaos_soak probe)")
+declare("analysis.replay.failures", COUNTER,
+        "replay audits that diverged or missed the seeded "
+        "incomplete-log negative control")
+
 # -- causal span tracing (observe/spans.py) --------------------------------
 declare("trace.spans.sampled", COUNTER,
         "spans recorded into the ring (head-based sampling accepted)")

@@ -139,7 +139,9 @@ class DeviceRetainedIndex:
         # change pays a full re-upload (epoch bump). The manager's lock +
         # torn-version guard covers storm uploads running on executor
         # threads while the loop thread inserts.
-        self._host_b: List[np.ndarray] = []  # [CHUNK, bucket] uint8
+        # device_snapshot builds the chunk_N names dynamically, so the
+        # OL checker discovers the backing store from this annotation:
+        self._host_b: List[np.ndarray] = []  # mirrored-array
         from emqx_tpu.ops.segments import DeviceSegmentManager
 
         if mesh is not None:

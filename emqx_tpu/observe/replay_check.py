@@ -1,0 +1,456 @@
+"""Shadow-replica divergence harness: replication readiness as a
+runtime check.
+
+`DeviceSegmentManager` is, structurally, a replication protocol: a
+standby broker that received every epoch upload, op-log suffix,
+`!resync` marker, and compaction offer MUST be able to reconstruct the
+exact host tables. Nothing in the repo exercised that end-to-end —
+op-log completeness was only ever checked statically (the OL/VC
+checkers in `tools/analysis`). This module closes the loop:
+
+- `ReplayCheck.arm(manager)` swaps the manager's `__class__` for a
+  generated subclass (the `racetrack`/`faults` idiom — ZERO cost while
+  disarmed, nothing is wrapped or patched globally) whose `sync`
+  captures, per call, exactly the record a standby would receive:
+
+    * a full-resync sync  -> ("full", epoch, host snapshot copy, pos)
+    * a delta sync        -> ("delta", op-log suffix, copies of the
+                              re-uploaded arrays for `!resync`-marked
+                              and newly-appearing names, pos)
+
+- `ShadowReplica` applies those records to plain host arrays with the
+  manager's own suffix semantics (resync supersedes suffix writes to
+  that array; last-write-wins per flat slot; values cast through the
+  destination dtype) — it never sees the live table object.
+
+- `ReplayTap.diverged()` compares the replica against the live
+  `device_snapshot()` array-exact (names, shapes, dtypes, values).
+
+The capture reads `_pos`/`full_resyncs` around the inner `sync` call
+without taking the manager lock, so the harness assumes the audited
+tables follow the documented single-writer discipline (the loop owns
+mutation + sync). That is the property being audited — a torn capture
+IS a finding, not a harness bug.
+
+`run_replay_audit()` is the batteries-included entry point used by
+`python -m tools.analysis --replay`, the race suite, and the
+chaos_soak probe: randomized churn across all five mirrored owners
+(shape index, sparse subscriber CSR, semantic table, session table,
+retained index), compaction cycles racing loop inserts through the
+journal-replay path, an array-exact convergence assertion, and a
+seeded incomplete-log negative control that MUST be detected.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from emqx_tpu.ops.segments import RESYNC
+
+Record = Tuple  # ("full", epoch, arrays, pos) | ("delta", ops, uploads, pos)
+
+
+class ShadowReplica:
+    """Offline standby: plain numpy arrays reconstructed purely from
+    captured sync records. Deliberately knows nothing about the live
+    source object — if the op-log stream is incomplete, this is where
+    it shows."""
+
+    def __init__(self) -> None:
+        self.arrays: Dict[str, np.ndarray] = {}
+        self.epoch = -1
+        self.pos = 0
+        self.applied = 0
+
+    def apply(self, record: Record) -> None:
+        kind = record[0]
+        if kind == "full":
+            _, epoch, arrays, pos = record
+            self.arrays = {k: np.array(v, copy=True) for k, v in arrays.items()}
+            self.epoch = epoch
+            self.pos = pos
+        else:
+            _, ops, uploads, pos = record
+            # re-uploaded arrays supersede every suffix write to them
+            # (the manager drops those ops on the floor; so do we)
+            superseded = set(uploads)
+            for name, arr in uploads.items():
+                if arr is None:  # resync'd name absent from snapshot
+                    self.arrays.pop(name, None)
+                else:
+                    self.arrays[name] = np.array(arr, copy=True)
+            for name, idx, val in ops:
+                if name == RESYNC or name in superseded:
+                    continue
+                arr = self.arrays.get(name)
+                if arr is None:
+                    # an op for an array the capture never shipped:
+                    # the stream itself is incomplete — surface it at
+                    # diverged() time rather than crashing mid-apply
+                    continue
+                arr.reshape(-1)[int(idx)] = arr.dtype.type(val)
+            self.pos = pos
+        self.applied += 1
+
+    def diverged(self, snapshot: Dict[str, np.ndarray]) -> List[str]:
+        """Array-exact comparison against a live host snapshot. Returns
+        human-readable divergence descriptions (empty == converged)."""
+        problems: List[str] = []
+        live = {k: np.asarray(v) for k, v in snapshot.items()}
+        for name in sorted(set(live) - set(self.arrays)):
+            problems.append(f"{name}: missing from replica")
+        for name in sorted(set(self.arrays) - set(live)):
+            problems.append(f"{name}: stale in replica (dropped live)")
+        for name in sorted(set(live) & set(self.arrays)):
+            a, b = live[name], self.arrays[name]
+            if a.shape != b.shape:
+                problems.append(f"{name}: shape {b.shape} != live {a.shape}")
+            elif a.dtype != b.dtype:
+                problems.append(f"{name}: dtype {b.dtype} != live {a.dtype}")
+            elif not np.array_equal(a, b):
+                flat_a, flat_b = a.reshape(-1), b.reshape(-1)
+                bad = np.nonzero(flat_a != flat_b)[0]
+                i = int(bad[0])
+                problems.append(
+                    f"{name}: {len(bad)} slot(s) differ, first flat[{i}] "
+                    f"replica={flat_b[i]!r} live={flat_a[i]!r}"
+                )
+        return problems
+
+
+class ReplayTap:
+    """Per-manager capture state. Created by `ReplayCheck.arm`; applies
+    each captured record to its `ShadowReplica` eagerly (a streaming
+    standby, not a batch importer)."""
+
+    def __init__(self, manager, metrics=None) -> None:
+        self.manager = manager
+        self.metrics = metrics
+        self.replica = ShadowReplica()
+        self.records: List[Record] = []
+        self.syncs = 0
+        self.offers = 0
+        self.src = None  # last source seen by sync()
+
+    def capture(self, manager, src, pos0: int, fulls0: int) -> None:
+        self.syncs += 1
+        self.src = src
+        if manager.full_resyncs > fulls0:
+            # epoch upload (possibly with an adopted compaction offer
+            # plus a delta on top) — the standby receives the whole
+            # post-sync host image
+            arrays = {
+                k: np.array(v, copy=True)
+                for k, v in src.device_snapshot().items()
+            }
+            rec: Record = ("full", src.epoch, arrays, manager._pos)
+        else:
+            ops = list(src.oplog[pos0:manager._pos])
+            needed = {a for name, a, _v in ops if name == RESYNC}
+            for name, _idx, _val in ops:
+                if name != RESYNC and name not in self.replica.arrays:
+                    needed.add(name)  # defensive re-upload of a new array
+            uploads: Dict[str, Optional[np.ndarray]] = {}
+            if needed:
+                snap = src.device_snapshot()
+                for name in needed:
+                    v = snap.get(name)
+                    uploads[name] = None if v is None else np.array(v, copy=True)
+            rec = ("delta", ops, uploads, manager._pos)
+        self.records.append(rec)
+        self.replica.apply(rec)
+        if self.metrics is not None:
+            self.metrics.inc("replay.captures")
+            self.metrics.inc("replay.syncs")
+
+    def diverged(self, src=None) -> List[str]:
+        src = src if src is not None else self.src
+        if src is None:
+            return ["no sync captured yet"]
+        return self.replica.diverged(src.device_snapshot())
+
+
+class ReplayCheck:
+    """Arm/disarm registry in the `racetrack`/`faults` idiom: swaps a
+    live manager's `__class__` for a capture subclass, restores it on
+    disarm. Zero cost while disarmed — no global patching, untapped
+    managers are untouched."""
+
+    def __init__(self, metrics=None) -> None:
+        self.metrics = metrics
+        self._armed: Dict[int, Tuple[Any, type, ReplayTap]] = {}
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._armed)
+
+    def arm(self, manager) -> ReplayTap:
+        if id(manager) in self._armed:
+            return self._armed[id(manager)][2]
+        tap = ReplayTap(manager, metrics=self.metrics)
+        orig = manager.__class__
+
+        class _Tapped(orig):  # type: ignore[misc, valid-type]
+            def sync(self, src):  # noqa: D102 - contract of orig
+                pos0, fulls0 = self._pos, self.full_resyncs
+                out = orig.sync(self, src)
+                tap.capture(self, src, pos0, fulls0)
+                return out
+
+            def offer(self, epoch, arrays, pos=0):  # noqa: D102
+                tap.offers += 1
+                if tap.metrics is not None:
+                    tap.metrics.inc("replay.offers")
+                return orig.offer(self, epoch, arrays, pos)
+
+        _Tapped.__name__ = orig.__name__
+        _Tapped.__qualname__ = orig.__qualname__
+        manager.__class__ = _Tapped
+        self._armed[id(manager)] = (manager, orig, tap)
+        return tap
+
+    def disarm(self) -> None:
+        for manager, orig, _tap in self._armed.values():
+            manager.__class__ = orig
+        self._armed.clear()
+
+    def taps(self) -> List[ReplayTap]:
+        return [t for _m, _c, t in self._armed.values()]
+
+
+# -- the audit: five owners, randomized churn, raced compaction --------------
+
+
+def _compact_racing(compactor, owner, race: Callable[[], None]) -> bool:
+    """One compaction cycle with loop inserts racing the background
+    build — `SegmentCompactor.compact_now` with churn injected between
+    `build` and `apply`, so `apply`'s journal replay has to absorb it."""
+    cap = owner.begin()
+    built = owner.build(cap)
+    race()  # loop mutations land while the "executor" holds the build
+    applied = owner.apply(built)
+    if applied is None:
+        compactor.aborted += 1
+        return False
+    epoch, bufs, pos, merged = applied
+    owner.manager.offer(epoch, bufs, pos)
+    compactor.runs += 1
+    return True
+
+
+class _Churn:
+    """One mirrored owner under audit: a source table, its manager,
+    a mutation step, and (optionally) a compaction owner."""
+
+    def __init__(self, name: str, src, manager, step, compact_owner=None,
+                 pre_sync: Optional[Callable[[], None]] = None):
+        self.name = name
+        self.src = src
+        self.manager = manager
+        self.step = step  # fn(rng, i) -> None
+        self.compact_owner = compact_owner
+        self.pre_sync = pre_sync  # e.g. retained match() drives sync itself
+
+    def sync(self):
+        if self.pre_sync is not None:
+            self.pre_sync()
+        else:
+            self.manager.sync(self.src)
+
+
+def _build_churns() -> List[_Churn]:
+    from emqx_tpu.models.retained_index import DeviceRetainedIndex
+    from emqx_tpu.models.router_model import SubscriberTable
+    from emqx_tpu.ops.csr_table import CsrSegmentOwner
+    from emqx_tpu.ops.segments import DeviceSegmentManager, ShapeSegmentOwner
+    from emqx_tpu.ops.semantic_table import (
+        SemanticSegmentOwner,
+        SemanticTable,
+    )
+    from emqx_tpu.ops.session_table import SessionSegmentOwner, SessionTable
+    from emqx_tpu.ops.shape_index import ShapeIndex
+
+    churns: List[_Churn] = []
+
+    # 1. shape index: subscribe/unsubscribe filter churn
+    si = ShapeIndex()
+    man_si = DeviceSegmentManager(name="shapes")
+    live_filters: List[str] = []
+
+    def step_shapes(rng: random.Random, i: int) -> None:
+        if live_filters and rng.random() < 0.3:
+            si.remove(live_filters.pop(rng.randrange(len(live_filters))))
+        else:
+            f = f"r/{i}/{rng.randrange(8)}/+"
+            si.add(f, i)
+            live_filters.append(f)
+
+    churns.append(_Churn(
+        "shapes", si, man_si, step_shapes,
+        ShapeSegmentOwner(si, man_si, hot_entries=1),
+    ))
+
+    # 2. sparse subscriber table (CSR representation behind the facade)
+    subs = SubscriberTable(max_subscribers=128, mode="sparse")
+    man_subs = DeviceSegmentManager(name="bitmaps")
+    live_subs: List[Tuple[int, int]] = []
+
+    def step_subs(rng: random.Random, i: int) -> None:
+        if live_subs and rng.random() < 0.3:
+            fid, slot = live_subs.pop(rng.randrange(len(live_subs)))
+            subs.remove(fid, slot)
+        else:
+            fid, slot = rng.randrange(32), rng.randrange(128)
+            subs.add(fid, slot)
+            live_subs.append((fid, slot))
+
+    churns.append(_Churn(
+        "bitmaps", subs, man_subs, step_subs,
+        CsrSegmentOwner(subs, man_subs, hot_entries=1),
+    ))
+
+    # 3. semantic table: embedding-filter churn
+    sem = SemanticTable(dim=8, topk=4)
+    man_sem = DeviceSegmentManager(name="semantic")
+    live_sem: List[int] = []
+
+    def step_sem(rng: random.Random, i: int) -> None:
+        if live_sem and rng.random() < 0.3:
+            sem.remove(live_sem.pop(rng.randrange(len(live_sem))))
+        else:
+            slot = rng.randrange(64)
+            vec = np.asarray(
+                [rng.uniform(-1, 1) for _ in range(8)], dtype=np.float32
+            )
+            if sem.add(slot, vec, threshold=0.5, fid=i):
+                live_sem.append(slot)
+
+    churns.append(_Churn(
+        "semantic", sem, man_sem, step_sem,
+        SemanticSegmentOwner(sem, man_sem, hot_entries=1),
+    ))
+
+    # 4. session table: insert/ack/expiry churn
+    st = SessionTable(capacity=64, slots=32)
+    man_st = DeviceSegmentManager(name="sessions")
+    live_rows: List[int] = []
+
+    def step_sessions(rng: random.Random, i: int) -> None:
+        r = rng.random()
+        if live_rows and r < 0.3:
+            st.clear(live_rows.pop(rng.randrange(len(live_rows))))
+        elif r < 0.4:
+            st.set_expiry(rng.randrange(32), 1000 + i)
+        else:
+            row = st.insert(
+                rng.randrange(32), (i % 65535) + 1, 1, i, i % 97
+            )
+            if row >= 0:
+                live_rows.append(row)
+
+    churns.append(_Churn(
+        "sessions", st, man_st, step_sessions,
+        SessionSegmentOwner(st, man_st, tombstone_frac=0.0),
+    ))
+
+    # 5. retained index: topic churn; match() drives its own sync
+    ret = DeviceRetainedIndex(max_bytes=32)
+    live_topics: List[str] = []
+
+    def step_retained(rng: random.Random, i: int) -> None:
+        if live_topics and rng.random() < 0.3:
+            ret.remove(live_topics.pop(rng.randrange(len(live_topics))))
+        else:
+            t = f"s/{i}/t"
+            ret.add(t)
+            live_topics.append(t)
+
+    churns.append(_Churn(
+        "retained", ret, ret._seg, step_retained,
+        pre_sync=lambda: ret.match("s/+/t"),
+    ))
+    return churns
+
+
+def run_replay_audit(seed: int = 0, rounds: int = 48,
+                     metrics=None) -> Dict[str, Any]:
+    """Randomized five-owner churn under armed taps; returns a report:
+
+      divergence        {owner: [problem, ...]} — MUST be empty
+      negative_control  description of the seeded incomplete-log write;
+                        `negative_detected` MUST be True
+      per-owner sync/record/compaction counts
+
+    Deterministic for a given (seed, rounds).
+    """
+    from emqx_tpu.ops.segments import SegmentCompactor
+
+    rng = random.Random(seed)
+    if metrics is not None:
+        metrics.inc("analysis.replay.runs")
+    churns = _build_churns()
+    compactor = SegmentCompactor()
+    check = ReplayCheck(metrics=metrics)
+    taps = {c.name: check.arm(c.manager) for c in churns}
+    try:
+        for i in range(rounds):
+            for c in churns:
+                for _ in range(rng.randrange(1, 4)):
+                    c.step(rng, i)
+                if rng.random() < 0.5:
+                    c.sync()
+            # compaction racing loop inserts, through the journal path
+            if i % 7 == 3:
+                c = churns[rng.randrange(len(churns))]
+                if c.compact_owner is not None:
+                    _compact_racing(
+                        compactor, c.compact_owner,
+                        lambda: [c.step(rng, i) for _ in range(3)],
+                    )
+                    c.sync()
+        # quiesce: final sync, then array-exact convergence per owner
+        divergence: Dict[str, List[str]] = {}
+        for c in churns:
+            c.sync()
+            problems = taps[c.name].diverged(c.src)
+            if problems:
+                divergence[c.name] = problems
+        # negative control: a write that skips the op-log entirely must
+        # surface as divergence (the mirror AND the standby both miss it)
+        st_churn = next(c for c in churns if c.name == "sessions")
+        st = st_churn.src
+        st.slot_expiry[0] = np.int64(123456789)  # deliberately unlogged
+        st_churn.sync()  # version unchanged -> sync ships nothing
+        neg_problems = taps["sessions"].diverged(st)
+        negative_detected = any("slot_expiry" in p for p in neg_problems)
+        report: Dict[str, Any] = {
+            "divergence": divergence,
+            "negative_control": "unlogged slot_expiry[0] write on sessions",
+            "negative_detected": negative_detected,
+            "owners": {
+                c.name: {
+                    "syncs": taps[c.name].syncs,
+                    "records": len(taps[c.name].records),
+                    "full": sum(
+                        1 for r in taps[c.name].records if r[0] == "full"
+                    ),
+                    "offers": taps[c.name].offers,
+                }
+                for c in churns
+            },
+            "compactions": compactor.runs,
+            "compactions_aborted": compactor.aborted,
+            "rounds": rounds,
+            "seed": seed,
+        }
+        if metrics is not None:
+            metrics.inc("replay.divergence", len(divergence))
+            failures = len(divergence) + (0 if negative_detected else 1)
+            if failures:
+                metrics.inc("analysis.replay.failures", failures)
+        return report
+    finally:
+        check.disarm()

@@ -592,6 +592,7 @@ class CsrTable:
             "max_slot": int(slots.max()) if len(slots) else -1,
         }
 
+    # oplog-covered-by: every caller bumps the epoch after install
     def _install(self, built: Dict) -> None:
         S = self.shards
         self._fcap = built["fcap"]

@@ -543,6 +543,7 @@ class SemanticTable:
             "sem_slot": slotl, "sem_thresh": thl, "reg": reg, "n": n,
         }
 
+    # oplog-covered-by: every caller bumps the epoch after install
     def _install(self, built: Dict) -> None:
         S = self.shards
         self._pcap = built["pcap"]

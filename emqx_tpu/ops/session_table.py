@@ -204,6 +204,17 @@ class SessionTable:
             return
         self.oplog.append((name, int(idx), int(val)))
 
+    def _log_resync(self, name: str) -> None:
+        """Per-array re-upload marker. Appending through `_log` and
+        rewriting `oplog[-1]` is NOT equivalent: at OPLOG_MAX `_log`
+        bumps the epoch and clears the log, so the rewrite would blow
+        up on an empty list (and the bump already covers the grow)."""
+        self.version += 1
+        if len(self.oplog) >= self.OPLOG_MAX:
+            self._bump()
+            return
+        self.oplog.append((RESYNC, name, 0))
+
     def device_snapshot(self) -> Dict[str, np.ndarray]:
         return {
             "sess_slot": self.sess_slot,
@@ -334,7 +345,18 @@ class SessionTable:
         self.oplog.extend(("sess_ts", int(r), t) for r in rows)
 
     def clear(self, row: int) -> int:
-        """Tombstone one row; returns the message id it carried."""
+        """Tombstone one row; returns the message id it carried.
+
+        Idempotent: clearing an EMPTY/TOMB row is a no-op returning -1.
+        Without the guard a duplicate clear (e.g. a redundant ack path
+        holding a stale row handle) double-decrements `live` AND — when
+        a compaction capture is open — journals the tombstone sentinel
+        as the slot, which a later `apply_compact` replay feeds to
+        `_find`/`_mix` where the negative value overflows uint64. The
+        crash fires an arbitrary number of mutations after the actual
+        bug, so it is stopped here at the source."""
+        if self.sess_slot[row] < 0:
+            return -1
         if self._journal is not None:
             self._journal.append(
                 ("clear", int(self.sess_slot[row]),
@@ -389,6 +411,7 @@ class SessionTable:
         self._bump()
         return rows
 
+    # oplog-covered-by: callers (_grow / bulk_insert) bump the epoch
     def _bulk_place(self, slots, pids, states, tss, mids) -> np.ndarray:
         mask = self._cap - 1
         n = len(slots)
@@ -453,8 +476,7 @@ class SessionTable:
         self._scap = new_scap
         # small lane: re-upload ALONE (never the row table) — the
         # per-array resync marker exists for exactly this
-        self._log(RESYNC, 0, 0)
-        self.oplog[-1] = (RESYNC, "slot_expiry", 0)
+        self._log_resync("slot_expiry")
 
     # -- host sweeps (authoritative; the device sweep mirrors these) -------
     def due_rows(self, now_ds: int, retry_ds: int) -> np.ndarray:

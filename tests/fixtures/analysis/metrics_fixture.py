@@ -48,6 +48,12 @@ declare("profile.stage.queue_wait.seconds", "histogram")
 declare("profile.captures", COUNTER)
 declare("profile.cost.kernels", "gauge")
 declare("provenance.proxy", "gauge")
+declare("replay.captures", COUNTER)
+declare("replay.syncs", COUNTER)
+declare("replay.offers", COUNTER)
+declare("replay.divergence", COUNTER)
+declare("analysis.replay.runs", COUNTER)
+declare("analysis.replay.failures", COUNTER)
 declare("device.kernel.shape_route_step.seconds", "histogram")
 declare("device.kernel.shape_route_step.bytes", "histogram")
 
@@ -107,6 +113,12 @@ def good(m: M):
     m.gauge_set("provenance.proxy", 1)
     m.observe("device.kernel.shape_route_step.seconds", 0.002)
     m.observe("device.kernel.shape_route_step.bytes", 4096)
+    m.inc("replay.captures")
+    m.inc("replay.syncs")
+    m.inc("replay.offers")
+    m.inc("replay.divergence")
+    m.inc("analysis.replay.runs")
+    m.inc("analysis.replay.failures")
 
 
 def bad(m: M):
@@ -150,3 +162,5 @@ def bad(m: M):
     m.inc("profile.capturez")  # MN001: typo'd capture counter
     m.gauge_set("provenance.proxi", 1)  # MN001: typo'd provenance gauge
     m.observe("device.kernel.shape_root_step.seconds", 1)  # MN001: typo'd kernel series
+    m.inc("replay.capturez")  # MN001: typo'd replay counter
+    m.inc("analysis.replay.runz")  # MN001: typo'd audit counter

@@ -1,0 +1,52 @@
+"""OL fixture: op-log completeness violations the checker must flag."""
+
+import numpy as np
+
+
+class LeakySource:
+    """Speaks the mirror-source protocol, then mutates off the log."""
+
+    def __init__(self):
+        self.arr_a = np.zeros(8, np.int32)
+        self.arr_b = np.zeros(8, np.int32)
+        self.arr_c = np.zeros(8, np.int32)
+        self.shadow = np.zeros(4, np.int32)  # mirrored-array
+        self.version = 0
+        self.epoch = 0
+        self.oplog = []
+
+    def _log(self, name, idx, val):
+        self.version += 1
+        self.oplog.append((name, idx, val))
+
+    def _bump(self):
+        self.epoch += 1
+        self.version += 1
+        self.oplog.clear()
+
+    def device_snapshot(self):
+        return {"arr_a": self.arr_a, "arr_b": self.arr_b,
+                "arr_c": self.arr_c}
+
+    def ol_logged(self, i, v):
+        self.arr_a[i] = v
+        self._log("arr_a", i, v)
+
+    def ol_silent_store(self, i, v):
+        self.arr_a[i] = v  # OL001: no log/resync/bump in this method
+
+    def ol_silent_fill(self):
+        self.arr_b.fill(0)  # OL001: in-place mutator off the log
+
+    def ol_silent_rebind(self):
+        self.arr_c = np.zeros(16, np.int32)  # OL001: rebind, no resync
+
+    def ol_silent_scatter(self, idxs):
+        np.add.at(self.arr_a, idxs, 1)  # OL001: ufunc scatter off-log
+
+
+class RottedAnnotation:
+    """Not a mirrored source at all: the annotation has rotted."""
+
+    def __init__(self):
+        self.orphan = np.zeros(4)  # mirrored-array   -> OL002

@@ -1,0 +1,72 @@
+"""OL fixture: compliant provenance disciplines that must stay silent."""
+
+import numpy as np
+
+RESYNC = "!resync"
+KEYS = ("k_rows", "k_vals")
+
+
+class CleanSource:
+    """Every mutation path carries same-method op-log provenance."""
+
+    def __init__(self):
+        self.k_rows = np.zeros(8, np.int32)
+        self.k_vals = np.zeros(8, np.int32)
+        self.version = 0
+        self.epoch = 0
+        self.oplog = []
+
+    def _log(self, name, idx, val):
+        self.version += 1
+        self.oplog.append((name, idx, val))
+
+    def _bump(self):
+        self.epoch += 1
+        self.version += 1
+        self.oplog.clear()
+
+    def device_snapshot(self):
+        return {k: getattr(self, k) for k in KEYS}
+
+    def ol_good_logged(self, i, v):
+        self.k_rows[i] = v
+        self._log("k_rows", i, v)
+
+    def ol_good_direct_append(self, i, v):
+        self.k_vals[i] = v
+        self.oplog.append(("k_vals", i, v))
+        self.version += 1
+
+    def ol_good_grow(self):
+        self.k_rows = np.zeros(16, np.int32)
+        self.oplog.append((RESYNC, "k_rows", 0))
+        self.version += 1
+
+    def ol_good_rebuild(self):
+        self.k_rows = np.zeros(32, np.int32)
+        self.k_vals = np.zeros(32, np.int32)
+        self.epoch += 1  # full re-upload covers both rebinds
+
+    # oplog-covered-by: every caller bumps the epoch after placing
+    def _ol_good_bulk_place(self, rows):
+        for i, v in rows:
+            self.k_rows[i] = v
+
+
+class DynamicSource:
+    """Chunked snapshot: the annotation is the discovery channel, and a
+    dynamic snapshot never rots it."""
+
+    def __init__(self):
+        self.chunks = [np.zeros(4, np.uint8)]  # mirrored-array
+        self.version = 0
+        self.epoch = 0
+        self.oplog = []
+
+    def device_snapshot(self):
+        return {f"chunk_{i}": c for i, c in enumerate(self.chunks)}
+
+    def ol_good_chunk_write(self, c, i, v):
+        self.chunks[c][i] = v
+        self.oplog.append((f"chunk_{c}", i, v))
+        self.version += 1

@@ -1,0 +1,57 @@
+"""VC fixture: version/epoch discipline violations."""
+
+import threading
+
+import numpy as np
+
+
+class VcLeaky:
+    """Public mutator that never moves version/epoch: the manager will
+    treat the mirror as already synced."""
+
+    def __init__(self):
+        self.rows = np.zeros(8, np.int32)
+        self.version = 0
+        self.epoch = 0
+        self.oplog = []
+
+    def _log(self, name, idx, val):
+        self.version += 1
+        self.oplog.append((name, idx, val))
+
+    def device_snapshot(self):
+        return {"rows": self.rows}
+
+    def vc_forget(self, i, v):
+        self.rows[i] = v  # VC001: no bump reachable from this method
+
+    def vc_counted(self, i, v):
+        self.rows[i] = v
+        self._log("rows", i, v)  # bump closure: fine
+
+
+class VcThreaded:
+    """Version discipline held, but the mutation runs off-loop with no
+    declared single-writer/guard: a second sync context."""
+
+    def __init__(self):
+        self.cells = np.zeros(8, np.int32)
+        self.version = 0
+        self.epoch = 0
+        self.oplog = []
+        self._t = None
+
+    def device_snapshot(self):
+        return {"cells": self.cells}
+
+    def vc_bg_store(self, i, v):
+        self.cells[i] = v  # VC002: runs on the vc-bg thread
+        self.version += 1
+        self.oplog.append(("cells", i, v))
+
+    def start(self):
+        self._t = threading.Thread(
+            target=self.vc_bg_store, args=(0, 1), name="vc-bg",
+            daemon=True,
+        )
+        self._t.start()

@@ -1,0 +1,51 @@
+"""VC fixture: compliant version/epoch disciplines that stay silent."""
+
+import threading
+
+import numpy as np
+
+
+class VcClean:
+    def __init__(self, log=None, bump=None):
+        self.slots = np.zeros(8, np.int32)
+        self.marks = np.zeros(8, np.int32)  # single-writer: vc-good-bg
+        self.version = 0
+        self.epoch = 0
+        self.oplog = []
+        # delegated-callback idiom: the facade injects the bump
+        self._log = log or (lambda name, idx, val: None)
+        self._bump = bump or (lambda: None)
+        self._t = None
+
+    def device_snapshot(self):
+        return {"slots": self.slots, "marks": self.marks}
+
+    def vc_good_store(self, i, v):
+        self.slots[i] = v
+        self._log("slots", i, v)  # injected callback counts as a bump
+
+    def vc_good_rebuild(self):
+        self.slots = np.zeros(16, np.int32)
+        self._bump_epoch()
+
+    def _bump_epoch(self):
+        self.epoch += 1
+        self.oplog.clear()
+
+    # oplog-covered-by: callers bump the epoch after bulk placement
+    def vc_good_bulk(self, rows):
+        for i, v in rows:
+            self.slots[i] = v
+
+    def vc_good_bg_mark(self, i):
+        # `marks` declares its single writer: the vc-good-bg thread
+        self.marks[i] = 1
+        self.version += 1
+        self.oplog.append(("marks", i, 1))
+
+    def start(self):
+        self._t = threading.Thread(
+            target=self.vc_good_bg_mark, args=(0,), name="vc-good-bg",
+            daemon=True,
+        )
+        self._t.start()

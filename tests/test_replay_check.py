@@ -1,0 +1,150 @@
+"""Shadow-replica divergence harness: replication readiness tests.
+
+The harness itself must be trustworthy before its verdicts mean
+anything, so this file pins three layers: `ShadowReplica`'s record
+semantics (resync supersedes suffix, dtype casts, full replaces),
+`ReplayCheck`'s arm/disarm lifecycle (class-swap is fully reversible,
+disarmed taps capture nothing), and the end-to-end audit (five-owner
+churn converges; the seeded incomplete-log negative control is caught).
+"""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.ops.segments import RESYNC, DeviceSegmentManager
+from emqx_tpu.ops.shape_index import ShapeIndex
+from emqx_tpu.observe.replay_check import (
+    ReplayCheck,
+    ShadowReplica,
+    run_replay_audit,
+)
+
+
+# -- replica record semantics ------------------------------------------------
+
+
+class TestShadowReplica:
+    def test_full_record_replaces_everything(self):
+        r = ShadowReplica()
+        r.apply(("full", 0, {"a": np.arange(4), "b": np.zeros(2)}, 3))
+        r.apply(("full", 1, {"c": np.ones(2, np.int32)}, 0))
+        assert set(r.arrays) == {"c"} and r.epoch == 1 and r.pos == 0
+
+    def test_full_record_copies_arrays(self):
+        src = np.arange(4)
+        r = ShadowReplica()
+        r.apply(("full", 0, {"a": src}, 0))
+        src[0] = 99  # later live mutation must not leak into the standby
+        assert r.arrays["a"][0] == 0
+
+    def test_delta_scatter_casts_through_destination_dtype(self):
+        r = ShadowReplica()
+        r.apply(("full", 0, {"a": np.zeros(4, np.int32)}, 0))
+        r.apply(("delta", [("a", 1, 7.9)], {}, 1))
+        assert r.arrays["a"].dtype == np.int32
+        assert r.arrays["a"][1] == 7  # manager cast semantics: truncate
+
+    def test_resync_upload_supersedes_suffix_writes(self):
+        # the manager drops suffix ops to a re-uploaded array (the live
+        # upload already contains them); the replica must match, or a
+        # stale op could overwrite the fresher full image
+        r = ShadowReplica()
+        r.apply(("full", 0, {"a": np.zeros(4, np.int32)}, 0))
+        fresh = np.full(8, 5, np.int32)
+        ops = [("a", 0, 111), (RESYNC, "a", 0), ("a", 1, 222)]
+        r.apply(("delta", ops, {"a": fresh}, 3))
+        assert r.arrays["a"].shape == (8,)
+        assert r.arrays["a"].tolist() == [5] * 8  # both suffix ops dropped
+
+    def test_resync_of_dropped_array_removes_it(self):
+        r = ShadowReplica()
+        r.apply(("full", 0, {"a": np.zeros(2), "b": np.ones(2)}, 0))
+        r.apply(("delta", [(RESYNC, "b", 0)], {"b": None}, 1))
+        assert set(r.arrays) == {"a"}
+
+    def test_diverged_reports_value_shape_and_missing(self):
+        r = ShadowReplica()
+        r.apply(("full", 0, {"a": np.zeros(4, np.int32)}, 0))
+        live = {"a": np.array([0, 9, 0, 0], np.int32), "b": np.zeros(2)}
+        problems = r.diverged(live)
+        assert any("a" in p and "flat[1]" in p for p in problems)
+        assert any(p.startswith("b: missing") for p in problems)
+        assert r.diverged({"a": np.zeros(4, np.int32)}) == []
+
+
+# -- arm/disarm lifecycle ----------------------------------------------------
+
+
+class TestArmDisarm:
+    def test_disarm_restores_class_and_stops_capturing(self):
+        si = ShapeIndex()
+        si.add("a/+", 1)
+        man = DeviceSegmentManager(name="shapes")
+        orig_cls = man.__class__
+        check = ReplayCheck()
+        tap = check.arm(man)
+        assert check.armed and man.__class__ is not orig_cls
+        assert man.__class__.__name__ == orig_cls.__name__  # cosmetic swap
+        man.sync(si)
+        assert tap.syncs == 1 and len(tap.records) == 1
+        check.disarm()
+        assert not check.armed and man.__class__ is orig_cls
+        si.add("b/+", 2)
+        man.sync(si)  # disarmed: the tap must see nothing
+        assert tap.syncs == 1 and len(tap.records) == 1
+
+    def test_arm_is_idempotent_per_manager(self):
+        man = DeviceSegmentManager(name="shapes")
+        check = ReplayCheck()
+        try:
+            assert check.arm(man) is check.arm(man)
+            assert len(check.taps()) == 1
+        finally:
+            check.disarm()
+
+    def test_tap_tracks_epoch_and_delta_records(self):
+        si = ShapeIndex()
+        man = DeviceSegmentManager(name="shapes")
+        check = ReplayCheck()
+        tap = check.arm(man)
+        try:
+            si.add("a/+", 1)
+            man.sync(si)  # first sync: full resync
+            si.add("b/#", 2)
+            man.sync(si)  # incremental: delta record
+            kinds = [r[0] for r in tap.records]
+            assert kinds[0] == "full" and "delta" in kinds
+            assert tap.diverged() == []  # standby tracks the live image
+        finally:
+            check.disarm()
+
+
+# -- the audit ---------------------------------------------------------------
+
+
+@pytest.mark.race
+class TestReplayAudit:
+    def test_five_owner_churn_converges_and_control_is_detected(self):
+        report = run_replay_audit(seed=11, rounds=16)
+        assert report["divergence"] == {}
+        assert report["negative_detected"]
+        assert set(report["owners"]) == {
+            "shapes", "bitmaps", "semantic", "sessions", "retained",
+        }
+        for name, stats in report["owners"].items():
+            assert stats["syncs"] > 0, name
+        assert report["compactions"] + report["compactions_aborted"] >= 1
+
+    def test_audit_is_deterministic_per_seed(self):
+        a = run_replay_audit(seed=7, rounds=10)
+        b = run_replay_audit(seed=7, rounds=10)
+        assert a["owners"] == b["owners"]
+        assert a["compactions"] == b["compactions"]
+
+    def test_audit_disarms_even_though_control_diverges(self):
+        # the negative control leaves the sessions table diverged; the
+        # finally-disarm must still restore every manager class
+        report = run_replay_audit(seed=3, rounds=8)
+        assert report["negative_detected"]
+        man = DeviceSegmentManager(name="shapes")
+        assert type(man).__mro__[0] is DeviceSegmentManager

@@ -40,6 +40,7 @@ from emqx_tpu.broker.session_store import PID_SPACE, SessionStore
 from emqx_tpu.mqtt import packet as pkt
 from emqx_tpu.observe.faults import default_faults
 from emqx_tpu.ops.session_table import (
+    RESYNC,
     ST_AWAIT_REL,
     ST_PUBLISH,
     ST_PUBREL,
@@ -205,6 +206,54 @@ class TestSessionTable:
         )
         built = SessionTable.build_compact(cap)
         assert t.apply_compact(built) is None
+
+    def test_slot_growth_at_oplog_capacity_resyncs_instead_of_crashing(self):
+        """Replay-audit finding: growing the expiry lane when the op-log
+        sits exactly at OPLOG_MAX used to rewrite `oplog[-1]` right after
+        `_log` bumped the epoch and CLEARED the log — IndexError on an
+        empty list. The grow must fall back to the epoch bump (which
+        already covers the re-upload)."""
+        t = SessionTable(capacity=64, slots=64)
+        t.OPLOG_MAX = 8
+        for i in range(t.OPLOG_MAX):
+            t._log("sess_ts", i, i)
+        assert len(t.oplog) == t.OPLOG_MAX
+        epoch0 = t.epoch
+        t.set_expiry(200, 555)  # forces _grow_slots past capacity
+        assert t.epoch == epoch0 + 1  # bump covered the grow
+        assert t._scap >= 256 and t.slot_expiry[200] == 555
+        # the post-grow write is the only delta the fresh epoch carries
+        assert t.oplog == [("slot_expiry", 200, 555)]
+        # below capacity the cheap path still rides the per-array marker
+        t2 = SessionTable(capacity=64, slots=64)
+        t2.set_expiry(100, 7)
+        assert (RESYNC, "slot_expiry", 0) in t2.oplog
+        assert t2.epoch == 0
+
+    def test_double_clear_is_idempotent_and_replay_safe(self):
+        """Replay-audit finding: clearing an already-tombstoned row used
+        to double-decrement `live` and — with a compaction capture open —
+        journal the TOMB sentinel as the slot, which `apply_compact`'s
+        replay fed to `_find`/`_mix` where the negative value overflows
+        uint64."""
+        t = SessionTable(capacity=64)
+        r = t.insert(3, 9, ST_PUBLISH, 10, 42)
+        assert t.clear(r) == 42
+        assert t.clear(r) == -1  # stale handle: no-op
+        assert (t.live, t.tombstones) == (0, 1)
+        assert t.oplog[-1] == ("sess_mid", r, -1)
+        ver = t.version
+        assert t.clear(r) == -1 and t.version == ver  # truly side-effect free
+        # raced variant: the duplicate clear lands inside a capture
+        for i in range(8):
+            t.insert(i + 10, 1, ST_PUBLISH, i, i)
+        cap = t.begin_compact()
+        row = t._find(12, 1)
+        assert t.clear(row) == 2
+        t.clear(row)  # duplicate ack path — journals nothing
+        built = SessionTable.build_compact(cap)
+        assert t.apply_compact(built) == t.epoch  # no uint64 overflow
+        assert t._find(12, 1) == -1 and t.live == 7
 
 
 # -- monotonic clock (satellite: inflight.py regression) ---------------------

@@ -157,6 +157,7 @@ def test_metric_checker_flags_undeclared_series():
         "retained.storm.deferd",
         "profile.stage.queue_wate.seconds", "profile.capturez",
         "provenance.proxi", "device.kernel.shape_root_step.seconds",
+        "replay.capturez", "analysis.replay.runz",
     }
 
 
@@ -327,6 +328,108 @@ def test_cx_repo_runs_clean():
     # every cross-context mutable field in emqx_tpu/ is locked, declared
     # single-writer, or explicitly waived — non-baseline zero
     report = run_analysis(ROOT / "emqx_tpu", checks=["cx"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+
+
+# -- op-log completeness (OL) -----------------------------------------------
+
+def test_oplog_checker_flags_unlogged_mirror_mutations():
+    report = run_fixtures(["oplog"])
+    bad = {
+        (f.code, f.symbol, f.detail)
+        for f in report.findings
+        if f.path.endswith("ol_bad.py")
+    }
+    assert bad == {
+        ("OL001", "LeakySource.ol_silent_store", "arr_a"),
+        ("OL001", "LeakySource.ol_silent_fill", "arr_b"),
+        ("OL001", "LeakySource.ol_silent_rebind", "arr_c"),
+        ("OL001", "LeakySource.ol_silent_scatter", "arr_a"),
+        # protocol class, annotation rotted out of the static snapshot
+        ("OL002", "LeakySource", "shadow"),
+        # `# mirrored-array` on a class with no source protocol at all
+        ("OL002", "RottedAnnotation", "orphan"),
+    }, sorted(bad)
+
+
+def test_oplog_checker_accepts_provenance_disciplines():
+    # same-method _log/_bump helpers, direct oplog.append, the `!resync`
+    # append, an epoch-bump rebuild, `# oplog-covered-by:` helpers, and
+    # dynamic (chunked) snapshots with a live `# mirrored-array`
+    report = run_fixtures(["oplog"])
+    good = [f for f in report.findings if f.path.endswith("ol_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+def test_oplog_repo_runs_clean():
+    # the replication-readiness gate: every mirrored-field mutation in
+    # emqx_tpu/ logs, resyncs, bumps, or declares its coverage
+    report = run_analysis(ROOT / "emqx_tpu", checks=["oplog"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+
+
+# -- version/epoch discipline (VC) ------------------------------------------
+
+def test_version_checker_flags_missing_bumps_and_offloop_writes():
+    report = run_fixtures(["version"])
+    bad = {
+        (f.code, f.symbol, f.detail)
+        for f in report.findings
+        if f.path.endswith("vc_bad.py")
+    }
+    assert ("VC001", "VcLeaky.vc_forget", "rows") in bad
+    # version moved, but from the vc-bg thread with no declaration
+    assert ("VC002", "VcThreaded.vc_bg_store", "cells") in bad
+    assert len(bad) == 2, sorted(bad)
+
+
+def test_version_checker_accepts_bump_closures_and_declared_writers():
+    # injected `self._log`/`self._bump` callbacks, self-call bump
+    # chains, `# oplog-covered-by:` helpers, and a `# single-writer:`
+    # declared off-loop writer all stay silent
+    report = run_fixtures(["version"])
+    good = [f for f in report.findings if f.path.endswith("vc_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+def test_version_repo_runs_clean():
+    report = run_analysis(ROOT / "emqx_tpu", checks=["version"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+
+
+# -- buffer-view escape (BV) ------------------------------------------------
+
+def test_bufview_checker_flags_escaping_views():
+    report = run_fixtures(["bufview"])
+    bad = {
+        (f.code, f.symbol, f.detail)
+        for f in report.findings
+        if f.path.endswith("bv_bad.py")
+    }
+    assert bad == {
+        ("BV001", "BvSink.bv_keep_view", "view"),
+        ("BV001", "BvSink.bv_keep_payload", "view"),
+        # taint through the call graph (bv_make_view returns a view)
+        ("BV001", "BvSink.bv_keep_indirect", "ref"),
+        # annotated `# slab-escape` sink storing an un-owned parameter
+        ("BV001", "BvSink.bv_park", "msg"),
+        ("BV002", "BvSink.bv_rotted", "slab-escape"),
+    }, sorted(bad)
+
+
+def test_bufview_checker_accepts_owning_disciplines():
+    # own-then-store, the getattr duck form, owning casts (bytes()),
+    # and transient local scratch all stay silent
+    report = run_fixtures(["bufview"])
+    good = [f for f in report.findings if f.path.endswith("bv_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+def test_bufview_repo_runs_clean():
+    # the five slab-escape sites (session_store, mqueue, inflight,
+    # retainer, workers) all own before storing; the slab accessor's
+    # own memoryview is waived with justification in fabric.py
+    report = run_analysis(ROOT / "emqx_tpu", checks=["bufview"])
     assert report.clean, "\n".join(f.render() for f in report.findings)
 
 

@@ -37,13 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--checks", default=None,
         help="comma-separated subset of checks to run "
              "(lock,async,jit,config,metrics,shard,transfer,retrace,"
-             "fault,cx)",
+             "fault,cx,oplog,version,bufview)",
     )
     p.add_argument(
         "--changed-only", action="store_true",
         help="only report findings in files touched per git (working "
         "tree vs HEAD, plus untracked); the whole tree is still parsed "
-        "so cross-module checks stay exact",
+        "so cross-module checks stay exact. Tier B audits (--contracts, "
+        "--replay) are whole-system checks with no per-file subset — "
+        "they are SKIPPED under --changed-only (noted on stderr); run "
+        "the full gate for them",
     )
     p.add_argument(
         "--jobs", type=int, default=0, metavar="N",
@@ -53,6 +56,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--contracts", action="store_true",
         help="additionally run the jaxpr-level device-contract audit "
         "(imports jax + kernel code; see tools/analysis/device_contract)",
+    )
+    p.add_argument(
+        "--replay", action="store_true",
+        help="additionally run the shadow-replica replication audit "
+        "(emqx_tpu/observe/replay_check.py): randomized churn across "
+        "the five mirrored owners with raced compaction must converge "
+        "array-exact, and the seeded incomplete-log control must be "
+        "detected",
+    )
+    p.add_argument(
+        "--replay-rounds", type=int, default=48, metavar="N",
+        help="churn rounds for --replay (default 48; CI --fast uses a "
+        "smaller bound)",
+    )
+    p.add_argument(
+        "--replay-seed", type=int, default=0, metavar="S",
+        help="RNG seed for --replay churn (default 0)",
     )
     p.add_argument(
         "--update-snapshots", action="store_true",
@@ -132,8 +152,20 @@ def main(argv=None) -> int:
         return 0
 
     rc = 0 if report.clean else 1
+    # Tier B audits are whole-system: there is no meaningful "changed
+    # files only" subset of a jaxpr contract or a replication replay,
+    # so --changed-only skips them instead of running a misleading
+    # partial audit (the full CI gate runs them unconditionally).
+    tier_b = args.contracts or args.update_snapshots or args.replay
+    if args.changed_only and tier_b:
+        print(
+            "note: --changed-only skips Tier B audits "
+            "(--contracts/--replay); run without --changed-only for "
+            "the whole-system gates",
+            file=sys.stderr,
+        )
     audit_doc = None
-    if args.contracts or args.update_snapshots:
+    if (args.contracts or args.update_snapshots) and not args.changed_only:
         from tools.analysis.device_contract import run_audit
 
         audit = run_audit(update_snapshots=args.update_snapshots)
@@ -141,10 +173,22 @@ def main(argv=None) -> int:
         if not audit.clean:
             rc = max(rc, 1)
 
+    replay_doc = None
+    if args.replay and not args.changed_only:
+        from emqx_tpu.observe.replay_check import run_replay_audit
+
+        replay_doc = run_replay_audit(
+            seed=args.replay_seed, rounds=args.replay_rounds
+        )
+        if replay_doc["divergence"] or not replay_doc["negative_detected"]:
+            rc = max(rc, 1)
+
     if args.format == "json":
         doc = report.to_json()
         if audit_doc is not None:
             doc["contract_audit"] = audit_doc
+        if replay_doc is not None:
+            doc["replay_audit"] = replay_doc
         print(json.dumps(doc, indent=2))
     else:
         print(report.render_text())
@@ -152,7 +196,35 @@ def main(argv=None) -> int:
             from tools.analysis.device_contract import render_audit
 
             print(render_audit(audit_doc))
+        if replay_doc is not None:
+            print(_render_replay(replay_doc))
     return rc
+
+
+def _render_replay(doc) -> str:
+    lines = [
+        f"replay audit: seed={doc['seed']} rounds={doc['rounds']} "
+        f"compactions={doc['compactions']} "
+        f"(aborted {doc['compactions_aborted']})"
+    ]
+    for name, o in sorted(doc["owners"].items()):
+        lines.append(
+            f"  {name:<9} syncs={o['syncs']:<3} full={o['full']:<2} "
+            f"offers={o['offers']}"
+        )
+    if doc["divergence"]:
+        lines.append("  DIVERGED:")
+        for name, problems in sorted(doc["divergence"].items()):
+            for p in problems:
+                lines.append(f"    {name}: {p}")
+    else:
+        lines.append("  converged: all owners array-exact")
+    lines.append(
+        "  negative control "
+        + ("DETECTED" if doc["negative_detected"] else "MISSED (BUG)")
+        + f" ({doc['negative_control']})"
+    )
+    return "\n".join(lines)
 
 
 def _git_changed_paths(root: Path):

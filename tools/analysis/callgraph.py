@@ -144,6 +144,22 @@ class ProjectGraph:
         return seen
 
 
+# -- per-run graph sharing --------------------------------------------------
+
+# Six checkers (shard, cx, retrace, transfer, version, bufview) need the
+# project graph; run_analysis hands every begin() hook the SAME parsed-
+# modules list object, so a one-slot identity-keyed cache dedupes the
+# builds with no invalidation hazard — a new run allocates a new list.
+_shared: Tuple[object, "ProjectGraph"] = (None, None)  # type: ignore
+
+
+def shared_graph(modules: Sequence[ParsedModule]) -> "ProjectGraph":
+    global _shared
+    if _shared[0] is not modules:
+        _shared = (modules, ProjectGraph(modules))
+    return _shared[1]
+
+
 # -- shared syntax helpers --------------------------------------------------
 
 def header_lines(info: FnInfo) -> Iterator[str]:

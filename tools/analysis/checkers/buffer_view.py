@@ -1,0 +1,385 @@
+"""BV: buffer-view escape — own_buffers() before any slab sink.
+
+The slab protocol plane (PR 12) parses a fabric read buffer into
+`SlabMessage`s whose topic/payload are *views* into that buffer, and
+`TopicRef`/`memoryview` values with the same lifetime. The moment the
+buffer is recycled (and item 2's shared-memory rings will recycle
+aggressively), any view that escaped into long-lived state reads
+garbage. The runtime discipline is `own_buffers()` — materialize and
+drop the slab reference — enforced today by convention at five
+`# slab-escape site:` comments and PR 12's recycle tests. This
+checker is the static twin: it taints view-producing expressions
+(`SlabMessage(...)`, `TopicRef(...)`, `memoryview(...)`,
+`.payload_view()`, `.topic_key()`, and project functions returning
+them, via a returns-taint fixpoint over the call graph) and flags
+
+  BV001  a tainted value stored into object state (`self.*` container
+         or attribute) without `own_buffers()` first; and, inside a
+         function annotated `# slab-escape`, any store of a
+         parameter-derived value that no preceding `own_buffers()`
+         call covers (the `getattr(msg, "own_buffers", None)` duck
+         form counts)
+  BV002  a rotted `# slab-escape` annotation: the enclosing function
+         no longer stores anything after the comment
+
+Deliberately under-approximate: locals appended to transient lists
+(codec pack scratch) are not flagged — only self-rooted state and
+declared sink functions, where a pinned view is a real failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.callgraph import (
+    FuncKey,
+    ProjectGraph,
+    module_dotted,
+    shared_graph,
+)
+from tools.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    enclosing_symbols,
+)
+
+_ESCAPE_RE = re.compile(r"#\s*slab-escape")
+_TAINT_CTORS = frozenset({"SlabMessage", "TopicRef", "memoryview"})
+_TAINT_METHODS = frozenset({"payload_view", "topic_key"})
+_OWNING_CASTS = frozenset({"bytes", "bytearray", "str", "len", "int"})
+# container method -> index of the *stored value* argument
+_STORE_ARG = {
+    "append": 0, "appendleft": 0, "add": 0, "put": 0, "put_nowait": 0,
+    "insert": -1, "setdefault": 1,
+}
+
+
+def _local_walk(fn: ast.AST):
+    """ast.walk that does not descend into nested def/class bodies."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_rooted(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _value_names(expr: ast.AST) -> List[str]:
+    """Names plausibly *stored* by this value expression: the name
+    itself, tuple/list elements, or the direct Name args of a wrapping
+    constructor call (`Entry(msg, ...)` stores msg inside the entry)."""
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in expr.elts:
+            out.extend(_value_names(e))
+        return out
+    if isinstance(expr, ast.Call):
+        out = []
+        for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+            if isinstance(a, ast.Name):
+                out.append(a.id)
+        return out
+    return []
+
+
+class _Event:
+    __slots__ = ("line", "kind", "data")
+
+    def __init__(self, line: int, kind: str, data):
+        self.line = line
+        self.kind = kind
+        self.data = data
+
+
+class BufferViewChecker(Checker):
+    name = "bufview"
+    codes = {
+        "BV001": "slab/buffer view escapes into long-lived state "
+                 "without own_buffers()",
+        "BV002": "stale `# slab-escape` annotation (no store follows)",
+    }
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._graph = shared_graph(modules)
+        self._returns_taint: Set[FuncKey] = set()
+        # fixpoint: a function returning a taint expr (or a tainted
+        # local) taints its callers' results
+        for _ in range(4):
+            new = set(self._returns_taint)
+            for info in self._graph.infos:
+                if info.key in new:
+                    continue
+                if self._fn_returns_taint(info.dn, info.node):
+                    new.add(info.key)
+            if new == self._returns_taint:
+                break
+            self._returns_taint = new
+
+    # -- taint expression evaluation ----------------------------------------
+    def _call_taints(self, dn: str, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _TAINT_METHODS:
+            return True
+        tail = self._graph.call_name(dn, f).rpartition(".")[2]
+        if tail in _TAINT_CTORS:
+            return True
+        for key in self._graph.ref_targets(dn, f):
+            if key in self._returns_taint:
+                return True
+        return False
+
+    def _expr_taints(self, dn: str, expr: ast.AST,
+                     tainted: Set[str]) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Call):
+            if (
+                isinstance(expr.func, ast.Name)
+                and expr.func.id in _OWNING_CASTS
+            ):
+                return False  # bytes(view) copies: the result is owned
+            if self._call_taints(dn, expr):
+                return True
+            return any(
+                self._expr_taints(dn, a, tainted)
+                for a in list(expr.args)
+                + [kw.value for kw in expr.keywords]
+            )
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(
+                self._expr_taints(dn, e, tainted) for e in expr.elts
+            )
+        if isinstance(expr, ast.Dict):
+            return any(
+                self._expr_taints(dn, v, tainted)
+                for v in expr.values if v is not None
+            )
+        if isinstance(expr, (ast.IfExp,)):
+            return self._expr_taints(dn, expr.body, tainted) or \
+                self._expr_taints(dn, expr.orelse, tainted)
+        if isinstance(expr, ast.BoolOp):
+            return any(
+                self._expr_taints(dn, v, tainted) for v in expr.values
+            )
+        if isinstance(expr, (ast.Await, ast.NamedExpr, ast.Starred)):
+            return self._expr_taints(dn, expr.value, tainted)
+        return False
+
+    def _fn_returns_taint(self, dn: str, fn: ast.AST) -> bool:
+        tainted: Set[str] = set()
+        nodes = sorted(
+            (
+                n for n in _local_walk(fn)
+                if isinstance(n, (ast.Assign, ast.Return))
+            ),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        for n in nodes:
+            if isinstance(n, ast.Assign):
+                names = [
+                    t.id for t in n.targets if isinstance(t, ast.Name)
+                ]
+                if self._expr_taints(dn, n.value, tainted):
+                    tainted.update(names)
+                else:
+                    tainted.difference_update(names)
+            elif n.value is not None and self._expr_taints(
+                dn, n.value, tainted
+            ):
+                return True
+        return False
+
+    # -- per module ---------------------------------------------------------
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        dn = module_dotted(mod.rel)
+        symbols = enclosing_symbols(mod.tree)
+        fns = [
+            node for node in symbols
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # each `# slab-escape` comment belongs to the INNERMOST def
+        # whose span contains it (nested defs are separate functions)
+        claimed: Dict[ast.AST, List[int]] = {}
+        for i, text in enumerate(mod.lines):
+            if not _ESCAPE_RE.search(text):
+                continue
+            ln = i + 1
+            best = None
+            for node in fns:
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno <= ln <= end and (
+                    best is None or node.lineno > best.lineno
+                ):
+                    best = node
+            if best is not None:
+                claimed.setdefault(best, []).append(ln)
+        for node in fns:
+            findings.extend(self._check_fn(
+                mod, dn, node, symbols[node], claimed.get(node, [])
+            ))
+        return findings
+
+    def _check_fn(self, mod: ParsedModule, dn: str, fn: ast.AST,
+                  sym: str, escape_lines: List[str]):
+        params = {
+            a.arg
+            for a in list(fn.args.args) + list(fn.args.posonlyargs)
+            + list(fn.args.kwonlyargs)
+            + ([fn.args.vararg] if fn.args.vararg else [])
+            + ([fn.args.kwarg] if fn.args.kwarg else [])
+            if a.arg not in ("self", "cls")
+        }
+        derived = set(params)
+        tainted: Set[str] = set()
+        owned: Set[str] = set()
+        own_alias: Dict[str, str] = {}  # getattr(m,"own_buffers") holder
+        escape_at = min(escape_lines) if escape_lines else None
+        stores_after_escape = 0
+        findings: List[Finding] = []
+
+        def emit(code: str, line: int, detail: str, message: str):
+            findings.append(Finding(
+                code=code, path=mod.rel, line=line, symbol=sym,
+                detail=detail, message=message,
+            ))
+
+        def handle_store(line: int, receiver: ast.AST,
+                         value: Optional[ast.AST],
+                         key: Optional[ast.AST] = None):
+            nonlocal stores_after_escape
+            if escape_at is not None and line > escape_at:
+                stores_after_escape += 1
+            cands = _value_names(value) if value is not None else []
+            live = [c for c in cands if c in tainted and c not in owned]
+            taints = value is not None and self._expr_taints(
+                dn, value, tainted - owned
+            )
+            key_taints = key is not None and self._expr_taints(
+                dn, key, tainted - owned
+            )
+            if _self_rooted(receiver) and (taints or key_taints):
+                what = live[0] if live else (
+                    _root_name(value) if value is not None else None
+                ) or "view"
+                emit(
+                    "BV001", line, what,
+                    f"slab/buffer view {what!r} escapes into self."
+                    f"{_attr_chain(receiver)} without own_buffers() — "
+                    "it dangles when the slab is recycled",
+                )
+                return
+            if escape_at is not None and line > escape_at:
+                hot = [c for c in cands if c in derived]
+                if hot and not (set(cands) & owned):
+                    emit(
+                        "BV001", line, hot[0],
+                        f"store of {hot[0]!r} in a `# slab-escape` "
+                        "sink with no preceding own_buffers() call on "
+                        "it — the declared discipline is own-then-"
+                        "store",
+                    )
+
+        nodes = sorted(
+            _local_walk(fn), key=lambda n: (
+                getattr(n, "lineno", 0), getattr(n, "col_offset", 0)
+            )
+        )
+        for n in nodes:
+            if isinstance(n, (ast.For, ast.AsyncFor)):
+                it = n.iter
+                root = _root_name(it) if not isinstance(it, ast.Call) \
+                    else None
+                if root in derived:
+                    for t in ast.walk(n.target):
+                        if isinstance(t, ast.Name):
+                            derived.add(t.id)
+            elif isinstance(n, ast.Assign):
+                names = [
+                    t.id for t in n.targets if isinstance(t, ast.Name)
+                ]
+                v = n.value
+                # getattr(m, "own_buffers", None) duck-typed own
+                if (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id == "getattr"
+                    and len(v.args) >= 2
+                    and isinstance(v.args[0], ast.Name)
+                    and isinstance(v.args[1], ast.Constant)
+                    and v.args[1].value == "own_buffers"
+                ):
+                    for name in names:
+                        own_alias[name] = v.args[0].id
+                if names:
+                    if self._expr_taints(dn, v, tainted):
+                        tainted.update(names)
+                    else:
+                        tainted.difference_update(names)
+                    vr = None if isinstance(v, ast.Call) else \
+                        _root_name(v)
+                    if vr in derived:
+                        derived.update(names)
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        handle_store(t.lineno, t.value, v, t.slice)
+                    elif isinstance(t, ast.Attribute):
+                        handle_store(t.lineno, t, v)
+            elif isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr == "own_buffers" and \
+                        isinstance(f.value, ast.Name):
+                    owned.add(f.value.id)
+                    tainted.discard(f.value.id)
+                elif isinstance(f, ast.Name) and f.id in own_alias:
+                    owner = own_alias[f.id]
+                    owned.add(owner)
+                    tainted.discard(owner)
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in _STORE_ARG and n.args:
+                    idx = _STORE_ARG[f.attr]
+                    if -len(n.args) <= idx < len(n.args):
+                        handle_store(n.lineno, f.value, n.args[idx])
+
+        if escape_at is not None and stores_after_escape == 0:
+            emit(
+                "BV002", escape_at, "slab-escape",
+                "`# slab-escape` annotation with no store following "
+                "it in this function — the sink moved or the "
+                "annotation rotted",
+            )
+        return findings
+
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+        else:
+            parts.append("[]")
+        node = node.value
+    return ".".join(reversed(parts)) or "<state>"

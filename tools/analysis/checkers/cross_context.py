@@ -42,9 +42,9 @@ import ast
 import re
 from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
-from tools.analysis.callgraph import ProjectGraph, module_dotted
+from tools.analysis.callgraph import ProjectGraph, module_dotted, shared_graph
 from tools.analysis.checkers.lock_discipline import guarded_attrs
-from tools.analysis.contexts import ContextMap
+from tools.analysis.contexts import ContextMap, shared_context_map
 from tools.analysis.core import Checker, Finding, ParsedModule
 
 _SINGLE_RE = re.compile(r"#\s*single-writer:\s*([\w.\-*:]+)")
@@ -127,8 +127,8 @@ class CrossContextChecker(Checker):
     }
 
     def begin(self, modules: Sequence[ParsedModule]) -> None:
-        self._graph = ProjectGraph(modules)
-        self._cmap = ContextMap(self._graph)
+        self._graph = shared_graph(modules)
+        self._cmap = shared_context_map(self._graph)
 
     def check(self, mod: ParsedModule) -> Iterable[Finding]:
         findings: List[Finding] = []

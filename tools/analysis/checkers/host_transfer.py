@@ -51,6 +51,7 @@ from tools.analysis.callgraph import (
     ProjectGraph,
     header_lines,
     module_dotted,
+    shared_graph,
 )
 from tools.analysis.core import Checker, Finding, ParsedModule
 
@@ -99,7 +100,7 @@ class HostTransferChecker(Checker):
     codes = dict(_MESSAGES)
 
     def begin(self, modules: Sequence[ParsedModule]) -> None:
-        g = self._graph = ProjectGraph(modules)
+        g = self._graph = shared_graph(modules)
         # module-level device callables: decorated jit fns + assignments
         # whose RHS contains a jit-wrap call anywhere (covers the
         # `device_contract(...)(partial(jax.jit, ...)(impl))` chain)

@@ -1,0 +1,354 @@
+"""OL: op-log completeness for device-mirrored tables.
+
+ROADMAP item 5 streams each shard's op-log suffix to a warm standby —
+which is only sound if the log is *complete*: every mutation of a
+device-mirrored array must land in the op-log, force a `!resync`
+marker, or ride an epoch bump (full re-upload). The invariant is
+maintained by convention across five hand-written segment owners;
+this checker makes it structural.
+
+A class is a *mirrored source* when it speaks the
+`DeviceSegmentManager` source protocol: it defines
+``device_snapshot()`` and owns a ``self.oplog``. Its mirrored fields
+are discovered from the snapshot body — the self-attributes inside a
+``return {...}`` dict literal, or the names of a
+``{k: getattr(self, k) for k in KEYS}`` comprehension resolved through
+a module-level tuple constant — plus any assignment carrying a
+trailing ``# mirrored-array`` annotation (for fields a snapshot builds
+dynamically).
+
+  OL001  a store / in-place mutation of a mirrored field in a method
+         with no sanctioned provenance path in the *same* method:
+         a `self._log*` / `self._bump*` call, a direct
+         `self.oplog.append/extend` (or oplog slot store — the
+         `!resync` rewrite idiom), or an epoch assignment. A helper
+         whose callers provide the coverage (e.g. a bulk-place loop
+         that every caller follows with an epoch bump) declares it
+         with `# oplog-covered-by: <why>` on its `def` header.
+  OL002  a stale `# mirrored-array` annotation — the attribute is
+         absent from a statically-readable `device_snapshot()`, or the
+         class is not a mirrored source at all (the way HT002/CX002
+         catch rotted annotations).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+_MIRROR_RE = re.compile(r"#\s*mirrored-array\b")
+_COVERED_RE = re.compile(r"#\s*oplog-covered-by:\s*(\S[^#]*)")
+
+# in-place ndarray mutators worth tracking on a mirrored field
+_INPLACE_METHODS = ("fill", "sort", "partition", "resize", "put")
+
+
+def _self_attr(node: ast.AST) -> str:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _assign_targets(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        return [node.target]
+    return []
+
+
+def _str_tuple_consts(tree: ast.Module) -> Dict[str, Tuple[str, ...]]:
+    """Module-level NAME = ("a", "b", ...) constants (SEM_KEYS idiom)."""
+    out: Dict[str, Tuple[str, ...]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            continue
+        elts = node.value.elts
+        if not elts or not all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in elts
+        ):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = tuple(e.value for e in elts)  # type: ignore
+    return out
+
+
+class MirrorSource:
+    """One mirrored-source class and what the analyzer knows about it."""
+
+    __slots__ = ("cls", "fields", "snapshot_fields", "annotated",
+                 "dynamic", "protocol")
+
+    def __init__(self, cls: ast.ClassDef, fields: Set[str],
+                 snapshot_fields: Set[str],
+                 annotated: Dict[str, int], dynamic: bool,
+                 protocol: bool):
+        self.cls = cls
+        self.fields = fields  # snapshot-discovered + annotated
+        self.snapshot_fields = snapshot_fields
+        self.annotated = annotated  # attr -> annotation lineno
+        self.dynamic = dynamic  # snapshot has a non-literal return
+        self.protocol = protocol  # device_snapshot() + self.oplog seen
+
+
+def _class_methods(cls: ast.ClassDef):
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield item
+
+
+def annotated_mirror_attrs(mod: ParsedModule,
+                           cls: ast.ClassDef) -> Dict[str, int]:
+    """attr -> lineno for `# mirrored-array` trailing annotations."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        if not _MIRROR_RE.search(mod.line_text(node.lineno)):
+            continue
+        for t in _assign_targets(node):
+            attr = _self_attr(t)
+            if attr:
+                out[attr] = node.lineno
+    return out
+
+
+def _snapshot_fields(tree: ast.Module,
+                     snap: ast.AST) -> Tuple[Set[str], bool]:
+    """Self-attrs a device_snapshot() statically exposes + dynamic flag."""
+    fields: Set[str] = set()
+    dynamic = False
+    consts: Optional[Dict[str, Tuple[str, ...]]] = None
+    # `out = {...}; ...; return out` — resolve the returned name through
+    # its local assignments (SemanticTable's dtype-cast copy idiom)
+    assigned: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(snap):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigned.setdefault(t.id, []).append(node.value)
+    for node in ast.walk(snap):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        v = node.value
+        if isinstance(v, ast.Name):
+            exprs = [
+                e for e in assigned.get(v.id, ())
+                if isinstance(e, (ast.Dict, ast.DictComp))
+            ]
+            if exprs:
+                v = exprs[0]
+            else:
+                dynamic = True
+                continue
+        if isinstance(v, ast.Dict):
+            for val in v.values:
+                if val is None:
+                    continue
+                for sub in ast.walk(val):
+                    attr = _self_attr(sub)
+                    if attr:
+                        fields.add(attr)
+        elif isinstance(v, ast.DictComp) and v.generators:
+            it = v.generators[0].iter
+            if consts is None:
+                consts = _str_tuple_consts(tree)
+            names = (
+                consts.get(it.id) if isinstance(it, ast.Name) else None
+            )
+            if names:
+                fields.update(names)
+            else:
+                dynamic = True
+        else:
+            # delegation (`return self._sp.device_snapshot()`) or any
+            # other computed shape: the static view is incomplete
+            dynamic = True
+    return fields, dynamic
+
+
+def mirror_source(mod: ParsedModule,
+                  cls: ast.ClassDef) -> Optional[MirrorSource]:
+    """The MirrorSource view of `cls`, or None if it does not speak the
+    DeviceSegmentManager source protocol (device_snapshot + oplog)."""
+    snap = None
+    has_oplog = False
+    for item in _class_methods(cls):
+        if item.name == "device_snapshot":
+            snap = item
+    for node in ast.walk(cls):
+        for t in _assign_targets(node):
+            # either the class owns the log, or it delegates the bump
+            # to its facade via an injected `self._bump` callback (the
+            # CsrTable idiom) — both speak the source protocol
+            if _self_attr(t) in ("oplog", "_bump"):
+                has_oplog = True
+    annotated = annotated_mirror_attrs(mod, cls)
+    if snap is None or not has_oplog:
+        if annotated:
+            # still materialize so OL002 can flag the rotted annotation
+            return MirrorSource(
+                cls, set(annotated), set(), annotated, False, False
+            )
+        return None
+    fields, dynamic = _snapshot_fields(mod.tree, snap)
+    return MirrorSource(
+        cls, fields | set(annotated), fields, annotated, dynamic, True
+    )
+
+
+def method_mutations(fields: Set[str],
+                     fn: ast.AST) -> List[Tuple[str, int, str]]:
+    """(attr, lineno, kind) mirrored-field mutations inside `fn`."""
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(fn):
+        for t in _assign_targets(node):
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Subscript):
+                    root = e.value
+                    while isinstance(root, ast.Subscript):
+                        root = root.value  # self._host_b[c][i] = v
+                    attr = _self_attr(root)
+                    if attr in fields:
+                        out.append((attr, e.lineno, "slot store"))
+                else:
+                    attr = _self_attr(e)
+                    if attr in fields:
+                        out.append((attr, e.lineno, "rebind"))
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            recv = _self_attr(node.func.value)
+            if recv in fields and node.func.attr in _INPLACE_METHODS:
+                out.append((recv, node.lineno, f".{node.func.attr}()"))
+            # ufunc scatter: np.add.at(self.arr, idx, v)
+            if node.func.attr == "at" and node.args:
+                a0 = _self_attr(node.args[0])
+                if a0 in fields:
+                    out.append((a0, node.lineno, "ufunc .at"))
+    return out
+
+
+def method_is_sanctioned(fn: ast.AST) -> bool:
+    """Does `fn` itself touch the provenance channel? (op-log append,
+    `!resync` rewrite, epoch bump, or a `self._log*`/`self._bump*`
+    helper call — the sanction must be in the SAME method so the log
+    records exactly the writes made.)"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            helper = _self_attr(node.func)
+            if helper.startswith("_log") or helper.startswith("_bump"):
+                return True
+            if (
+                node.func.attr in ("append", "extend")
+                and _self_attr(node.func.value) == "oplog"
+            ):
+                return True
+        for t in _assign_targets(node):
+            if _self_attr(t) in ("epoch", "oplog"):
+                return True
+            if isinstance(t, ast.Subscript) and \
+                    _self_attr(t.value) == "oplog":
+                return True
+    return False
+
+
+def covered_reason(mod: ParsedModule, fn: ast.AST) -> Optional[str]:
+    """`# oplog-covered-by: <why>` on the def header (or the comment
+    line directly above it, for long signatures), if any."""
+    body = getattr(fn, "body", None)
+    end = body[0].lineno if body else fn.lineno + 1
+    for ln in range(fn.lineno - 1, end):
+        m = _COVERED_RE.search(mod.line_text(ln))
+        if m:
+            return m.group(1).strip()
+    return None
+
+
+class OplogCompleteChecker(Checker):
+    name = "oplog"
+    codes = {
+        "OL001": "mirrored-field mutation bypasses the op-log "
+                 "(no same-method log append / resync / epoch bump)",
+        "OL002": "stale `# mirrored-array` annotation",
+    }
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(mod, node))
+        return findings
+
+    def _check_class(self, mod: ParsedModule,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        src = mirror_source(mod, cls)
+        if src is None:
+            return ()
+        findings: List[Finding] = []
+        # OL002: rotted annotations first — they also poison `fields`
+        for attr, line in sorted(src.annotated.items()):
+            if not src.protocol:
+                findings.append(Finding(
+                    code="OL002", path=mod.rel, line=line,
+                    symbol=cls.name, detail=attr,
+                    message=(
+                        f"`# mirrored-array` on {attr!r} but "
+                        f"{cls.name} is not a mirrored source (no "
+                        "device_snapshot()/oplog protocol)"
+                    ),
+                ))
+            elif not src.dynamic and attr not in src.snapshot_fields:
+                findings.append(Finding(
+                    code="OL002", path=mod.rel, line=line,
+                    symbol=cls.name, detail=attr,
+                    message=(
+                        f"`# mirrored-array` on {attr!r} but "
+                        "device_snapshot() does not expose it — the "
+                        "annotation rotted (or the snapshot lost a "
+                        "field)"
+                    ),
+                ))
+        if not src.protocol:
+            return findings
+        for item in _class_methods(src.cls):
+            if item.name == "__init__":
+                continue  # nothing is mirrored before first sync
+            muts = method_mutations(src.fields, item)
+            if not muts:
+                continue
+            if method_is_sanctioned(item):
+                continue
+            if covered_reason(mod, item) is not None:
+                continue
+            seen: Set[str] = set()
+            for attr, line, kind in muts:
+                if attr in seen:
+                    continue
+                seen.add(attr)
+                findings.append(Finding(
+                    code="OL001", path=mod.rel, line=line,
+                    symbol=f"{cls.name}.{item.name}", detail=attr,
+                    message=(
+                        f"{kind} of device-mirrored self.{attr} with no "
+                        "op-log provenance in this method (append, "
+                        "`!resync`, or epoch bump); a standby replaying "
+                        "the log would diverge — log it, or declare "
+                        "`# oplog-covered-by: <why>` on the def"
+                    ),
+                ))
+        return findings

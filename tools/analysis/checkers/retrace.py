@@ -33,6 +33,7 @@ from tools.analysis.callgraph import (
     FuncKey,
     ProjectGraph,
     module_dotted,
+    shared_graph,
 )
 from tools.analysis.core import Checker, Finding, ParsedModule
 
@@ -99,7 +100,7 @@ class RetraceChecker(Checker):
     codes = dict(_MESSAGES)
 
     def begin(self, modules: Sequence[ParsedModule]) -> None:
-        g = self._graph = ProjectGraph(modules)
+        g = self._graph = shared_graph(modules)
         # (func key) -> hazardous parameter names, grown to a fixpoint
         self._hazard: Dict[FuncKey, Set[str]] = {}
         self._roots: List[Tuple[FnInfo, Set[str]]] = []

@@ -32,6 +32,7 @@ from tools.analysis.callgraph import (
     ProjectGraph,
     is_literal_axes,
     module_dotted,
+    shared_graph,
     str_constants,
 )
 from tools.analysis.core import Checker, Finding, ParsedModule
@@ -79,7 +80,7 @@ class ShardingChecker(Checker):
     codes = dict(_MESSAGES)
 
     def begin(self, modules: Sequence[ParsedModule]) -> None:
-        self._graph = ProjectGraph(modules)
+        self._graph = shared_graph(modules)
         self._axes: Set[str] = set()
         roots: List[FuncKey] = []
         for mod in modules:

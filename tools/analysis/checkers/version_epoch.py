@@ -1,0 +1,170 @@
+"""VC: version/epoch discipline on device-mirrored tables.
+
+The DeviceSegmentManager sync contract keys everything off two
+monotonic counters per source: `version` (total mutation count — the
+delta path replays `oplog[pos:]` up to it) and `epoch` (generation —
+a bump clears the log and forces a full re-upload). A public mutating
+method that returns *without* moving either counter leaves the
+manager believing the device mirror is current — the standby replica
+silently misses the write. And because the mirror protocol is
+single-writer by design (the serving loop owns the tables), a
+mutation reachable from any *other* execution context needs the same
+declared discipline the CX checker enforces.
+
+Mirrored sources and their fields come from the OL checker's
+discovery (`tools/analysis/checkers/oplog_complete.py`); execution
+contexts come from the shared context map (`tools/analysis/
+contexts.py`).
+
+  VC001  a public (non-underscore) method of a mirrored source
+         mutates a mirrored field but cannot reach a
+         `self.version`/`self.epoch` bump through its intra-class
+         call closure before returning
+  VC002  a mirrored-field mutation runs under a non-loop execution
+         context with no `# guarded-by:`/GUARDED_BY or
+         `# single-writer:` declaration on the field (reuses the CX
+         discipline — CX only fires at >= 2 contexts; for mirror
+         state even ONE off-loop writer breaks the sync contract)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence, Set
+
+from tools.analysis.callgraph import ProjectGraph, module_dotted, shared_graph
+from tools.analysis.checkers.cross_context import single_writer_attrs
+from tools.analysis.checkers.lock_discipline import guarded_attrs
+from tools.analysis.checkers.oplog_complete import (
+    _class_methods,
+    _self_attr,
+    covered_reason,
+    method_mutations,
+    mirror_source,
+)
+from tools.analysis.contexts import LOOP, ContextMap, shared_context_map
+from tools.analysis.core import Checker, Finding, ParsedModule
+
+
+def _assign_targets(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        return [node.target]
+    return []
+
+
+def bump_closure(cls: ast.ClassDef) -> Set[str]:
+    """Method names that write self.version/self.epoch, directly or
+    through intra-class self-calls (fixpoint). A `self._log*`/
+    `self._bump*` attribute *assigned* in the class (the CsrTable
+    idiom: the facade injects version-bumping callbacks) counts as a
+    bumping callee too."""
+    methods = {m.name: m for m in _class_methods(cls)}
+    bumps: Set[str] = set()
+    # delegated-bump callbacks: self._log = log or ..., self._bump = ...
+    for node in ast.walk(cls):
+        for t in _assign_targets(node):
+            attr = _self_attr(t)
+            if attr and attr not in methods and (
+                attr.startswith("_log") or attr.startswith("_bump")
+            ):
+                bumps.add(attr)
+    for name, m in methods.items():
+        for node in ast.walk(m):
+            if any(
+                _self_attr(t) in ("version", "epoch")
+                for t in _assign_targets(node)
+            ):
+                bumps.add(name)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for name, m in methods.items():
+            if name in bumps:
+                continue
+            for node in ast.walk(m):
+                if (
+                    isinstance(node, ast.Call)
+                    and _self_attr(node.func) in bumps
+                ):
+                    bumps.add(name)
+                    changed = True
+                    break
+    return bumps
+
+
+class VersionDisciplineChecker(Checker):
+    name = "version"
+    codes = {
+        "VC001": "public mutating method of a mirrored source returns "
+                 "without a version/epoch bump",
+        "VC002": "mirrored-field mutation reachable from a non-loop "
+                 "context without guard/single-writer discipline",
+    }
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._graph = shared_graph(modules)
+        self._cmap = shared_context_map(self._graph)
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        dn = module_dotted(mod.rel)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(mod, dn, node))
+        return findings
+
+    def _check_class(self, mod: ParsedModule, dn: str,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        src = mirror_source(mod, cls)
+        if src is None or not src.protocol:
+            return ()
+        findings: List[Finding] = []
+        bumps = bump_closure(cls)
+        guarded = guarded_attrs(mod, cls)
+        declared_sw = single_writer_attrs(mod, cls)
+        for item in _class_methods(cls):
+            if item.name == "__init__":
+                continue
+            muts = method_mutations(src.fields, item)
+            if not muts:
+                continue
+            first_attr, first_line, _ = muts[0]
+            if (
+                not item.name.startswith("_")
+                and item.name not in bumps
+                and covered_reason(mod, item) is None
+            ):
+                findings.append(Finding(
+                    code="VC001", path=mod.rel, line=first_line,
+                    symbol=f"{cls.name}.{item.name}", detail=first_attr,
+                    message=(
+                        f"public method mutates mirrored self."
+                        f"{first_attr} but never bumps self.version/"
+                        "self.epoch (directly or via a self-call) — "
+                        "the segment manager will treat the mirror as "
+                        "already synced"
+                    ),
+                ))
+            ctxs = self._cmap.contexts((dn, item.name))
+            off_loop = sorted(c for c in ctxs if c != LOOP)
+            if not off_loop:
+                continue
+            seen: Set[str] = set()
+            for attr, line, _kind in muts:
+                if attr in seen or attr in guarded or attr in declared_sw:
+                    continue
+                seen.add(attr)
+                findings.append(Finding(
+                    code="VC002", path=mod.rel, line=line,
+                    symbol=f"{cls.name}.{item.name}", detail=attr,
+                    message=(
+                        f"mirrored self.{attr} is mutated under "
+                        f"context(s) [{', '.join(off_loop)}] — mirror "
+                        "tables are loop-owned; add `# guarded-by:` / "
+                        "`# single-writer:` or move the write"
+                    ),
+                ))
+        return findings

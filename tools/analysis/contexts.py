@@ -345,3 +345,19 @@ class ContextMap:
             if ctx.endswith("*") and name.startswith(ctx[:-1]):
                 return True
         return False
+
+
+# -- per-run map sharing -----------------------------------------------------
+
+# The CX and VC checkers both need the context map over the same shared
+# graph; propagation is the single most expensive step of a repo scan,
+# so it is built once per graph (identity-keyed, one slot — see
+# callgraph.shared_graph for the invalidation argument).
+_shared: Tuple[Optional[ProjectGraph], Optional["ContextMap"]] = (None, None)
+
+
+def shared_context_map(graph: ProjectGraph) -> "ContextMap":
+    global _shared
+    if _shared[0] is not graph:
+        _shared = (graph, ContextMap(graph))
+    return _shared[1]

@@ -268,6 +268,7 @@ def parse_modules(root: Path, jobs: int = 0) -> List[ParsedModule]:
 
 def default_checkers() -> List[Checker]:
     from tools.analysis.checkers.async_blocking import AsyncBlockingChecker
+    from tools.analysis.checkers.buffer_view import BufferViewChecker
     from tools.analysis.checkers.config_keys import ConfigKeyChecker
     from tools.analysis.checkers.cross_context import CrossContextChecker
     from tools.analysis.checkers.fault_contracts import FaultContractChecker
@@ -275,8 +276,10 @@ def default_checkers() -> List[Checker]:
     from tools.analysis.checkers.jit_purity import JitPurityChecker
     from tools.analysis.checkers.lock_discipline import LockDisciplineChecker
     from tools.analysis.checkers.metric_names import MetricNameChecker
+    from tools.analysis.checkers.oplog_complete import OplogCompleteChecker
     from tools.analysis.checkers.retrace import RetraceChecker
     from tools.analysis.checkers.sharding import ShardingChecker
+    from tools.analysis.checkers.version_epoch import VersionDisciplineChecker
 
     return [
         LockDisciplineChecker(),
@@ -289,6 +292,9 @@ def default_checkers() -> List[Checker]:
         RetraceChecker(),
         FaultContractChecker(),
         CrossContextChecker(),
+        OplogCompleteChecker(),
+        VersionDisciplineChecker(),
+        BufferViewChecker(),
     ]
 
 

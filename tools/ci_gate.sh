@@ -1,15 +1,18 @@
 #!/usr/bin/env bash
 # ci_gate.sh — THE single pre-merge command (docs/concurrency.md,
-# docs/static_analysis.md). Five gates, in the order that fails fastest:
+# docs/static_analysis.md). Six gates, in the order that fails fastest:
 #
-#   1. tpu_lint, all checkers            (pure AST, ~8 s)
+#   1. tpu_lint, all checkers            (pure AST, ~20 s)
 #   2. the device-contract audit          (jaxpr tracing on CPU)
-#   3. tier-1 pytest                      (`-m "not slow"`; the race-marked
+#   3. the replication replay audit       (--replay: shadow-replica
+#      convergence over five-owner churn + the seeded incomplete-log
+#      negative control — docs/static_analysis.md "Tier B")
+#   4. tier-1 pytest                      (`-m "not slow"`; the race-marked
 #      racetrack suite is part of tier-1 and runs with the detector armed)
-#   4. the race suite alone, verbose      (`-m race`) — redundant with (3)
+#   5. the race suite alone, verbose      (`-m race`) — redundant with (4)
 #      but isolates the concurrency rig's verdict in its own section of
 #      the log, so a race report is never buried in a 500-test dot wall
-#   5. the bench-trend gate               (tools/bench_trend.py --check:
+#   6. the bench-trend gate               (tools/bench_trend.py --check:
 #      the committed BENCH trajectory, grouped by hardware fingerprint —
 #      fails when a same-fingerprint metric regressed past threshold;
 #      run it again after any bench recipe below refreshes a capture)
@@ -17,7 +20,9 @@
 # Fast mode for the inner loop (pre-push, not pre-merge):
 #
 #   tools/ci_gate.sh --fast     # lint scoped to git-touched files
-#                               # (--changed-only --jobs 8) + race suite
+#                               # (--changed-only --jobs 8; Tier B
+#                               # audits are skipped by contract) +
+#                               # a bounded replay smoke + race suite
 #
 # Bench recipes (slow — NOT part of tier-1 or this gate; run when a PR
 # touches the paths they measure):
@@ -135,6 +140,8 @@ if [ "$FAST" = 1 ]; then
     python -m tools.analysis --changed-only --jobs 8
     banner "profile smoke (arm -> batch -> disarm)"
     profile_smoke
+    banner "replay smoke (bounded shadow-replica audit)"
+    python -m tools.analysis --replay --replay-rounds 8 --checks oplog
     banner "bench trend gate (fingerprint-grouped)"
     python -m tools.bench_trend --check > /dev/null
     banner "race suite (racetrack armed)"
@@ -147,6 +154,9 @@ python -m tools.analysis --jobs 8
 
 banner "device-contract audit"
 python -m tools.analysis --contracts
+
+banner "replication replay audit (shadow replica)"
+python -m tools.analysis --replay --checks oplog
 
 banner "tier-1 tests"
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
