@@ -657,6 +657,17 @@ declare("analysis.replay.runs", COUNTER,
 declare("analysis.replay.failures", COUNTER,
         "replay audits that diverged or missed the seeded "
         "incomplete-log negative control")
+declare("analysis.wirecompat.runs", COUNTER,
+        "wire-compatibility audits executed (ci_gate --audit replays "
+        "the golden byte corpus through current decoders)")
+declare("analysis.wirecompat.failures", COUNTER,
+        "wirecompat audits that failed: corpus divergence, live-layout "
+        "drift vs the format registry, an uncovered format, or a "
+        "missed drift control")
+declare("proto.registry.formats", GAUGE,
+        "externalized wire/snapshot formats declared in "
+        "emqx_tpu/proto/registry.py (each needs a version, a pinned "
+        "digest, and golden-corpus coverage)")
 
 # -- causal span tracing (observe/spans.py) --------------------------------
 declare("trace.spans.sampled", COUNTER,

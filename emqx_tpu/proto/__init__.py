@@ -1,0 +1,9 @@
+"""Wire-contract plane: the versioned format registry + digest canon.
+
+Every byte layout that crosses a process boundary (slab frames, cluster
+bus pickles, durable snapshots) is declared once in
+`emqx_tpu.proto.registry` with a name, a version, and a structural
+digest. The static checkers (tools/analysis: WF/SS/BP) and the tier-B
+wire-compat audit (`python -m tools.analysis --wirecompat`) both anchor
+on these declarations — see docs/static_analysis.md.
+"""

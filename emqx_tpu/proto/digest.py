@@ -1,0 +1,133 @@
+"""Structural digest canon for externalized formats.
+
+One canonical, human-readable string per format structure — NOT a hash:
+when a digest drifts, the diff in the golden pin file
+(tests/fixtures/analysis/wire/digests.json) reads as the actual field
+change, so review is "tlen moved from offset 0 to 2", never "sha256
+changed".
+
+This module is deliberately dependency-free (stdlib `struct` only): the
+tier-A checkers (tools/analysis WF/SS/BP, pure AST, no broker imports)
+recompute digests from AST-extracted literals with these exact
+functions, and the runtime registry computes them from the same literal
+declarations — one canonicalization, two call sites, zero drift between
+the static and runtime views. dtype itemsize/offsets are derived here
+from the type codes (packed layout, numpy `np.dtype([...])` default);
+the tier-B audit cross-checks the derivation against the LIVE
+`np.dtype` objects, so the shortcut cannot rot silently.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterable, Mapping, Sequence, Tuple
+
+
+def type_code_size(code: str) -> int:
+    """Byte size of a numpy-style scalar type code ('<u2', 'u1', '<f8').
+
+    Packed-layout helper for `dtype_digest`: endianness prefix optional,
+    kind letter(s), then the byte count. Raises on anything the wire
+    formats don't use (no sub-arrays, no strings, no objects).
+    """
+    c = code
+    if c and c[0] in "<>=|":
+        c = c[1:]
+    kind = ""
+    while c and c[0].isalpha():
+        kind += c[0]
+        c = c[1:]
+    if not kind or not c or not c.isdigit():
+        raise ValueError(f"unsupported dtype code {code!r}")
+    return int(c)
+
+
+def dtype_digest(fields: Sequence[Tuple[str, str]]) -> str:
+    """Canonical digest of a packed structured dtype.
+
+    `fields` is the literal `np.dtype([...])` field list:
+    (name, type_code) pairs in declaration order. Offsets are the packed
+    cumulative sizes — the layout `np.dtype(list)` produces.
+    """
+    parts = []
+    off = 0
+    for name, code in fields:
+        parts.append(f"{name}:{code}@{off}")
+        off += type_code_size(code)
+    return "dtype{" + ",".join(parts) + "}#" + str(off)
+
+
+def struct_digest(fmt: str) -> str:
+    """Canonical digest of a `struct.Struct` format string."""
+    return f"struct[{fmt}]#{struct.calcsize(fmt)}"
+
+
+def tag_digest(tags: Mapping[str, object]) -> str:
+    """Canonical digest of a tag table (frame types, message kinds).
+
+    Values may be ints (frame type bytes) or the tag string itself
+    (string-discriminated bus messages). Sorted by name so declaration
+    order never matters.
+    """
+    parts = [f"{k}={tags[k]}" for k in sorted(tags)]
+    return "tags{" + ",".join(parts) + "}"
+
+
+def schema_digest(groups: Iterable[Iterable[str]]) -> str:
+    """Canonical digest of a snapshot/capture schema: one key group per
+    dict shape the root emits, each a sorted key set. Groups are sorted
+    by their canonical form, so neither declaration order nor the
+    checker's AST walk order matters."""
+    canon = sorted("{" + ",".join(sorted(g)) + "}" for g in groups)
+    return "keys{" + ";".join(canon) + "}"
+
+
+def class_state_digest(
+    fields: Iterable[str], drops: Iterable[str] = ()
+) -> str:
+    """Canonical digest of a pickled class's `__getstate__`-visible
+    surface: the instance fields, minus the declared drops (fields the
+    `__getstate__` must null/remove — live device handles, meshes)."""
+    f = ",".join(sorted(fields))
+    d = ",".join(sorted(drops))
+    return f"state{{fields{{{f}}};drops{{{d}}}}}"
+
+
+def proto_digest(table: Mapping[str, Mapping[int, Iterable[str]]]) -> str:
+    """Canonical digest of a BPAPI proto table: api -> version ->
+    method names. Frozen-per-version is the whole point, so versions
+    render separately."""
+    apis = []
+    for api in sorted(table):
+        vers = []
+        for v in sorted(table[api]):
+            methods = ",".join(sorted(table[api][v]))
+            vers.append(f"v{v}{{{methods}}}")
+        apis.append(f"{api}:" + ",".join(vers))
+    return "bpapi{" + ";".join(apis) + "}"
+
+
+def digest_for(kind: str, structure) -> str:
+    """Dispatch: digest a structure literal by registry kind."""
+    if kind == "dtype":
+        return dtype_digest(structure)
+    if kind == "struct":
+        return struct_digest(structure)
+    if kind == "tags":
+        return tag_digest(structure)
+    if kind == "schema":
+        return schema_digest(structure)
+    if kind == "class_state":
+        fields, drops = structure
+        return class_state_digest(fields, drops)
+    if kind == "proto":
+        return proto_digest(structure)
+    raise ValueError(f"unknown format kind {kind!r}")
+
+
+def parse_pin(doc: Dict) -> Dict[str, Tuple[int, str]]:
+    """Golden pin file -> {name: (version, digest)}."""
+    out: Dict[str, Tuple[int, str]] = {}
+    for name, ent in doc.get("formats", {}).items():
+        out[name] = (int(ent["version"]), str(ent["digest"]))
+    return out

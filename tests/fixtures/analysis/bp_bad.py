@@ -1,0 +1,58 @@
+"""BP fixture: proto-table and tag-family symmetry violations.
+
+Parsed, never imported. One mini BPAPI ("fxbad") whose in-code table
+drifted and whose methods are variously unsent/unregistered, plus one
+position-0 tag family with every asymmetry the checker names.
+"""
+
+from emqx_tpu.proto.registry import register
+
+BP_BAD_API = {"fxbad": {1: ("ping", "pong", "orphan")}}
+BP_BAD_TAGS = {"fxhello": "fxhello", "fxdead": "fxdead",
+               "fxghost": "fxghost"}
+
+register("fix.bp.bad_proto", 1, "proto", BP_BAD_API,
+         "analysis/bp_bad.py:BadNode._protos")
+register("fix.bp.bad_tags", 1, "tags", BP_BAD_TAGS,
+         "analysis/bp_bad.py#pos0")
+
+
+class BadNode:
+    def __init__(self, rpc, bus):
+        self.rpc = rpc
+        self._bus = bus
+
+    def _protos(self):
+        # BP003 twice: v1 dropped "orphan"; v2 was never declared
+        self.rpc.registry.register("fxbad", 1, {
+            "ping": self._on_ping,
+            "pong": self._on_ping,
+        })
+        self.rpc.registry.register("fxbad", 2, {
+            "ping": self._on_ping,
+        })
+
+    def poke(self, peer):
+        self.rpc.call(peer, "fxbad", "ping")
+        self.rpc.cast(peer, "fxbad", "vanished")  # BP001: not in any table
+        self._indirect("pong", peer)
+        # "orphan" is never sent by anyone -> BP002
+
+    def _indirect(self, method, peer):
+        # the propagation seam: "pong" arrives via the parameter
+        self.rpc.cast(peer, "fxbad", method)
+
+    def gossip(self, peer):
+        self._bus.cast(self, peer, ("fxhello", 0))
+        self._bus.cast(self, peer, ("fxdead", 1))   # sent, no handler
+        self._bus.cast(self, peer, ("fxrogue", 2))  # head registered nowhere
+        # "fxghost" is registered but neither sent nor handled
+
+    def handle(self, payload):
+        kind = payload[0]
+        if kind == "fxhello":
+            return True
+        return None
+
+    def _on_ping(self):
+        return "ok"

@@ -1,0 +1,49 @@
+"""BP fixture, clean half: a symmetric mini BPAPI (with a justified
+serve-only method) and a key-discriminated tag family whose every tag
+is sent — directly or assigned-then-sent — and handled."""
+
+from emqx_tpu.proto.registry import register
+
+BP_GOOD_API = {"fxgood": {1: ("gping", "gserve")}}
+BP_GOOD_TAGS = {"gjoin": "gjoin", "gleave": "gleave"}
+
+# gserve is registered for REMOTE callers only (the fixture twin of
+# cm.lookup_channel): exempt from the sender-symmetry check, with the
+# justification living next to the table
+BPAPI_SERVE_ONLY = {("fxgood", "gserve")}
+
+register("fix.bp.good_proto", 1, "proto", BP_GOOD_API,
+         "analysis/bp_good.py:GoodNode._protos")
+register("fix.bp.good_tags", 1, "tags", BP_GOOD_TAGS,
+         "analysis/bp_good.py#key=fxg")
+
+
+class GoodNode:
+    def __init__(self, rpc, bus):
+        self.rpc = rpc
+        self._bus = bus
+
+    def _protos(self):
+        self.rpc.registry.register("fxgood", 1, {
+            "gping": self._on_gping,
+            "gserve": self._on_gping,
+        })
+
+    def poke(self, peer):
+        self.rpc.call(peer, "fxgood", "gping")
+
+    def gossip(self, peer):
+        self._bus.cast(self, peer, ("fxg", "gjoin", peer))
+        msg = ("fxg", "gleave")
+        self._bus.cast(self, peer, msg)  # assigned-then-sent variant
+
+    def handle(self, payload):
+        tag = payload[1]
+        if tag == "gjoin":
+            return True
+        if tag == "gleave":
+            return False
+        return None
+
+    def _on_gping(self):
+        return "ok"

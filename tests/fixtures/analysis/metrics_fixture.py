@@ -164,3 +164,5 @@ def bad(m: M):
     m.observe("device.kernel.shape_root_step.seconds", 1)  # MN001: typo'd kernel series
     m.inc("replay.capturez")  # MN001: typo'd replay counter
     m.inc("analysis.replay.runz")  # MN001: typo'd audit counter
+    m.inc("analysis.wirecompat.failurez")  # MN001: typo'd wirecompat counter
+    m.gauge_set("proto.registry.formatz", 1)  # MN001: typo'd registry gauge

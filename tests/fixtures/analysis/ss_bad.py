@@ -1,0 +1,35 @@
+"""SS fixture: snapshot-schema violations.
+
+Parsed, never imported. Three distinct failure modes: a snapshot root
+that grew a key behind the registry's back, a registration whose root
+rotted away, and the PR 10 bug class — a declared-dropped device handle
+that __getstate__ stopped nulling.
+"""
+
+from emqx_tpu.proto.registry import register
+
+register("fix.ss.snapshot", 1, "schema", (("a", "b"),),
+         "analysis/ss_bad.py:snap_func")
+register("fix.ss.gone", 1, "schema", (("x",),),
+         "analysis/ss_bad.py:missing_func")
+register("fix.ss.device_class", 1, "class_state",
+         (("table", "mesh"), ("mesh",)),
+         "analysis/ss_bad.py:DeviceThing")
+
+
+def snap_func():
+    # SS001: the registry pinned {a, b}; "c" shipped without a bump
+    return {"a": 1, "b": 2, "c": 3}
+
+
+class DeviceThing:
+    """Pickled by snapshots; the mesh is a live device handle."""
+
+    def __init__(self, mesh):
+        self.table = {}
+        self.mesh = mesh
+
+    def __getstate__(self):
+        # SS003: "mesh" is declared dropped but no longer nulled —
+        # the snapshot now pickles a live device object
+        return dict(self.__dict__)

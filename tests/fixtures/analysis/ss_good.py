@@ -1,0 +1,25 @@
+"""SS fixture, clean half: snapshot roots matching their registered
+shapes, drops enforced in __getstate__."""
+
+from emqx_tpu.proto.registry import register
+
+register("fix.ss.good_snap", 1, "schema", (("at", "rows"), ("k", "v")),
+         "analysis/ss_good.py:good_snap")
+register("fix.ss.good_class", 1, "class_state",
+         (("rows", "mesh"), ("mesh",)),
+         "analysis/ss_good.py:GoodThing")
+
+
+def good_snap(rows):
+    return {"at": 1.0, "rows": [{"k": r, "v": r} for r in rows]}
+
+
+class GoodThing:
+    def __init__(self, mesh):
+        self.rows = []
+        self.mesh = mesh
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["mesh"] = None  # live handle: restorer re-attaches its own
+        return d

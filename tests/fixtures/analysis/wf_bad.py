@@ -1,0 +1,47 @@
+"""WF fixture: wire-format registration + pin violations.
+
+Parsed by the analyzer, never imported. The mini-registrations below
+are extracted exactly like the real emqx_tpu/proto/registry.py ones;
+their golden pins live under tests/fixtures/analysis/wire/digests.json
+as fix.wf.* entries (fix.wf.drifted is pinned at a DIFFERENT digest on
+purpose, fix.wf.unpinned is deliberately absent).
+"""
+
+import socket
+import struct
+
+import numpy as np
+
+from emqx_tpu.proto.registry import register
+
+# WF001: a header layout at a send boundary with no registration
+BAD_HDR = struct.Struct("<HB")
+
+# WF002 — the acceptance-criteria reorder: the registry mirror says
+# (tlen, plen) but the defining dtype literal swapped the fields. No
+# broker code runs; the digests simply disagree.
+REORDERED_FIELDS = (("tlen", "<u2"), ("plen", "<u4"))
+REORDERED_DT = np.dtype([("plen", "<u4"), ("tlen", "<u2")])
+
+# WF003: registry and code agree, but the committed pin digests "<IH"
+# at the SAME version — a layout change shipped without a bump
+DRIFTED_S = struct.Struct("<IB")
+
+# WF004: registered, never pinned
+UNPINNED_S = struct.Struct("<Q")
+
+# WF004: version bumped to 2, pin still v1 — regeneration owed
+STALE_S = struct.Struct(">H")
+
+register("fix.wf.reordered", 1, "dtype", REORDERED_FIELDS,
+         "analysis/wf_bad.py:REORDERED_DT")
+register("fix.wf.drifted", 1, "struct", "<IB",
+         "analysis/wf_bad.py:DRIFTED_S")
+register("fix.wf.unpinned", 1, "struct", "<Q",
+         "analysis/wf_bad.py:UNPINNED_S")
+register("fix.wf.stale", 2, "struct", ">H",
+         "analysis/wf_bad.py:STALE_S")
+
+
+def wf_send(sock: socket.socket) -> None:
+    sock.sendall(BAD_HDR.pack(1, 2))

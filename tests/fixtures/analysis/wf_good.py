@@ -1,0 +1,22 @@
+"""WF fixture, clean half: every boundary literal registered, every
+registration matching its defining code AND its golden pin."""
+
+import socket
+import struct
+
+import numpy as np
+
+from emqx_tpu.proto.registry import register
+
+GOOD_HDR_FIELDS = (("alen", "<u2"), ("blen", "<u4"))
+GOOD_HDR_DT = np.dtype([("alen", "<u2"), ("blen", "<u4")])
+GOOD_LEN = struct.Struct(">I")
+
+register("fix.wf.good_hdr", 1, "dtype", GOOD_HDR_FIELDS,
+         "analysis/wf_good.py:GOOD_HDR_DT")
+register("fix.wf.good_len", 1, "struct", ">I",
+         "analysis/wf_good.py:GOOD_LEN")
+
+
+def wf_send(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(GOOD_LEN.pack(len(body)) + body)

@@ -363,6 +363,8 @@ def test_shard_slice_survives_join_bootstrap():
     try:
         a.attach_mesh_slice((2, 2), 0, 3)
         b.attach_mesh_slice((2, 2), 1, 3)
+        for n in (a, b):
+            n.flush()  # b's advertise must land on the seed pre-join
         from emqx_tpu.cluster.node import ClusterNode
 
         c = ClusterNode("late@cluster", bus)

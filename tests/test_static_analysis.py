@@ -158,6 +158,7 @@ def test_metric_checker_flags_undeclared_series():
         "profile.stage.queue_wate.seconds", "profile.capturez",
         "provenance.proxi", "device.kernel.shape_root_step.seconds",
         "replay.capturez", "analysis.replay.runz",
+        "analysis.wirecompat.failurez", "proto.registry.formatz",
     }
 
 
@@ -523,3 +524,108 @@ def test_cli_jobs_and_changed_only_flags():
     # be clean or dirty, but changed files must never violate the lint
     p = _cli("--changed-only")
     assert p.returncode == 0, p.stdout + p.stderr
+
+
+# -- wire-format registry discipline (WF) -----------------------------------
+
+def test_wire_checker_flags_unregistered_and_drifted_formats():
+    report = run_fixtures(["wire"])
+    bad = {
+        (f.code, f.detail)
+        for f in report.findings
+        if f.path.endswith("wf_bad.py")
+    }
+    # an unregistered struct at a serialize boundary
+    assert ("WF001", "BAD_HDR") in bad
+    # the acceptance-criteria case: a test-only FIELD REORDER in a
+    # registered dtype, caught without running any broker code
+    assert ("WF002", "fix.wf.reordered") in bad
+    # digest drifted from the golden pin without a version bump
+    assert ("WF003", "fix.wf.drifted") in bad
+    # registered but never pinned / pinned at a stale version
+    assert ("WF004", "fix.wf.unpinned:unpinned") in bad
+    assert ("WF004", "fix.wf.stale:stale-pin") in bad
+    assert len(bad) == 5, sorted(bad)
+
+
+def test_wire_checker_accepts_registered_and_pinned():
+    report = run_fixtures(["wire"])
+    good = [f for f in report.findings if f.path.endswith("wf_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+def test_wire_repo_runs_clean():
+    # every module-level wire literal at a serialize boundary in
+    # emqx_tpu/ is registered, digest-matched, and pinned
+    report = run_analysis(ROOT / "emqx_tpu", checks=["wire"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+
+
+# -- snapshot-schema discipline (SS) -----------------------------------------
+
+def test_snapshot_checker_flags_schema_and_getstate_drift():
+    report = run_fixtures(["snapshot"])
+    bad = {
+        (f.code, f.symbol, f.detail)
+        for f in report.findings
+        if f.path.endswith("ss_bad.py")
+    }
+    # a snapshot root emitting a key the registry never versioned
+    assert ("SS001", "snap_func", "fix.ss.snapshot") in bad
+    # registration whose source function rotted away
+    assert ("SS002", "<module>", "fix.ss.gone") in bad
+    # the PR 10 bug class: a declared-dropped device handle no longer
+    # nulled in __getstate__
+    assert ("SS003", "DeviceThing", "fix.ss.device_class:mesh") in bad
+    assert len(bad) == 3, sorted(bad)
+
+
+def test_snapshot_checker_accepts_matching_shapes():
+    report = run_fixtures(["snapshot"])
+    good = [f for f in report.findings if f.path.endswith("ss_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+def test_snapshot_repo_runs_clean():
+    report = run_analysis(ROOT / "emqx_tpu", checks=["snapshot"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+
+
+# -- BPAPI sender/receiver symmetry (BP) -------------------------------------
+
+def test_bpapi_checker_flags_every_asymmetry():
+    report = run_fixtures(["bpapi"])
+    bad = {
+        (f.code, f.detail)
+        for f in report.findings
+        if f.path.endswith("bp_bad.py")
+    }
+    # sent but in no registered proto table
+    assert ("BP001", "fxbad.vanished") in bad
+    # registered (and not serve-only) but never sent
+    assert ("BP002", "fxbad.orphan") in bad
+    # in-code table drifted from the declared one / undeclared version
+    assert ("BP003", "fxbad.v1") in bad
+    assert ("BP003", "fxbad.v2:undeclared") in bad
+    # tag-family asymmetries: sent-no-handler, registered-but-dead, and
+    # a boundary tuple whose head no family knows
+    assert ("BP004", "fix.bp.bad_tags:fxdead:no-handler") in bad
+    assert ("BP004", "fix.bp.bad_tags:fxghost:no-sender") in bad
+    assert ("BP004", "fix.bp.bad_tags:fxghost:no-handler") in bad
+    assert ("BP004", "head:fxrogue:sent-unregistered") in bad
+    assert len(bad) == 8, sorted(bad)
+
+
+def test_bpapi_checker_accepts_symmetric_tables():
+    # serve-only exemption, assigned-then-sent tuples, and propagation
+    # through parameter seams all stay silent
+    report = run_fixtures(["bpapi"])
+    good = [f for f in report.findings if f.path.endswith("bp_good.py")]
+    assert not good, [f.render() for f in good]
+
+
+def test_bpapi_repo_runs_clean():
+    # every cluster op tag sent in emqx_tpu/ has a handler and vice
+    # versa; the in-code rpc tables match the frozen BPAPI declaration
+    report = run_analysis(ROOT / "emqx_tpu", checks=["bpapi"])
+    assert report.clean, "\n".join(f.render() for f in report.findings)

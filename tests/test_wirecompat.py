@@ -1,0 +1,225 @@
+"""The wire-compat plane (PR 19): format registry digest semantics, the
+golden-corpus replay audit, its seeded drift control, and the
+--update-corpus version-bump enforcement."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from emqx_tpu.proto import digest, registry  # noqa: E402
+from tools.analysis import wirecompat  # noqa: E402
+
+CORPUS = ROOT / "tests" / "fixtures" / "wire_corpus"
+PINS = ROOT / "tests" / "fixtures" / "analysis" / "wire" / "digests.json"
+
+
+# -- digest canon ------------------------------------------------------------
+
+def test_digest_canonical_forms_are_stable_and_distinct():
+    d1 = digest.dtype_digest((("tlen", "<u2"), ("plen", "<u4")))
+    assert d1 == "dtype{tlen:<u2@0,plen:<u4@2}#6"
+    # a field REORDER changes the digest (offsets move)
+    d2 = digest.dtype_digest((("plen", "<u4"), ("tlen", "<u2")))
+    assert d1 != d2
+    assert digest.struct_digest("<IB") == "struct[<IB]#5"
+    assert digest.struct_digest(">I") != digest.struct_digest("<I")
+    # tag digests are order-insensitive over the mapping, value-sensitive
+    assert digest.tag_digest({"a": 1, "b": 2}) == digest.tag_digest(
+        {"b": 2, "a": 1}
+    )
+    assert digest.tag_digest({"a": 1}) != digest.tag_digest({"a": 2})
+    # schema groups are unordered sets of key-sets
+    assert digest.schema_digest((("b", "a"),)) == digest.schema_digest(
+        (("a", "b"),)
+    )
+    assert digest.schema_digest((("a",),)) != digest.schema_digest(
+        (("a", "b"),)
+    )
+    # class_state: fields + declared drops both matter
+    s1 = digest.class_state_digest(("x", "mesh"), ("mesh",))
+    assert s1 != digest.class_state_digest(("x", "mesh"), ())
+    assert s1 != digest.class_state_digest(("x",), ("mesh",))
+
+
+def test_registry_formats_are_versioned_pinned_and_unique():
+    fmts = registry.formats()
+    names = [f.name for f in fmts]
+    assert len(names) == len(set(names))
+    assert len(fmts) >= 25
+    pins = json.loads(PINS.read_text())["formats"]
+    for f in fmts:
+        assert f.version >= 1, f.name
+        assert f.digest, f.name
+        assert f.source, f.name
+        # acceptance criterion: every named format is registered with a
+        # version AND a pinned digest
+        assert f.name in pins, f"{f.name} has no golden pin"
+        assert pins[f.name]["version"] == f.version, f.name
+        assert pins[f.name]["digest"] == f.digest, f.name
+
+
+def test_registry_rejects_redeclaration():
+    with pytest.raises(registry.FormatError):
+        registry.register(
+            "fabric.frame_hdr", 2, "struct", "<IB", "x.py:_HDR"
+        )
+
+
+# -- corpus replay -----------------------------------------------------------
+
+def test_corpus_decodes_clean_and_drift_control_detected():
+    doc = wirecompat.run_wirecompat_audit()
+    assert doc["ok"], doc["failures"]
+    assert doc["cases"] and all(c["ok"] for c in doc["cases"])
+    assert doc["drift_control"]["detected"]
+    assert doc["registry"]["live_mismatches"] == []
+    assert doc["staleness"]["uncovered"] == []
+
+
+def test_every_registered_format_has_corpus_coverage():
+    manifest = json.loads((CORPUS / "manifest.json").read_text())
+    covered = set()
+    for c in manifest["cases"]:
+        covered.update(c["covers"])
+        assert (CORPUS / c["file"]).is_file(), c["file"]
+        assert (CORPUS / "expected" / f"{c['name']}.json").is_file()
+    repo = {f.name for f in registry.formats() if not f.name.startswith("fix.")}
+    assert repo <= covered, sorted(repo - covered)
+
+
+def test_legacy_snapshot_paths_still_decode():
+    """Satellite: the PR 11 raw-"ts" inflight shape and the PR 15
+    wall-"deadline" expiry shape are pinned as real corpus cases."""
+    manifest = json.loads((CORPUS / "manifest.json").read_text())
+    names = {c["name"] for c in manifest["cases"]}
+    assert {"session_legacy_ts", "sessions_kv_legacy_deadline",
+            "durable_kv_legacy"} <= names
+    # the legacy ts entries decode as age-0 inflight, not a crash
+    exp = json.loads(
+        (CORPUS / "expected" / "session_legacy_ts.json").read_text()
+    )
+    assert [e["age"] for e in exp["inflight"]] == [0.0, 0.0]
+    # the legacy wall-deadline case restores the live session and DROPS
+    # the expired one
+    exp = json.loads(
+        (CORPUS / "expected" / "sessions_kv_legacy_deadline.json").read_text()
+    )
+    assert exp["restored"] == 1 and "dev-42" in exp["sessions"]
+    # legacy "due" delayed entries both load — a past-due deadline is
+    # rebased to fire immediately, never dropped
+    exp = json.loads(
+        (CORPUS / "expected" / "durable_kv_legacy.json").read_text()
+    )
+    assert exp["delayed_topics"] == ["later/live", "later/past"]
+    assert exp["counts"]["retained"] == 1  # expired-message control dropped
+
+
+def test_mutated_corpus_byte_fails_the_audit(tmp_path):
+    """End to end: copy the corpus, corrupt ONE committed byte, and the
+    audit must exit dirty."""
+    import shutil
+
+    corpus2 = tmp_path / "wire_corpus"
+    shutil.copytree(CORPUS, corpus2)
+    ctl = json.loads((CORPUS / "manifest.json").read_text())["drift_control"]
+    case_file = next(
+        c["file"]
+        for c in json.loads((CORPUS / "manifest.json").read_text())["cases"]
+        if c["name"] == ctl["case"]
+    )
+    raw = bytearray((corpus2 / case_file).read_bytes())
+    raw[ctl["offset"]] ^= 0xFF
+    (corpus2 / case_file).write_bytes(bytes(raw))
+    doc = wirecompat.run_wirecompat_audit(corpus_dir=corpus2)
+    assert not doc["ok"]
+    assert any(ctl["case"] in f for f in doc["failures"])
+
+
+def test_update_corpus_is_idempotent_and_refuses_unbumped_drift(tmp_path):
+    """Regenerating with unchanged encoders rewrites nothing; a byte
+    change without a registry version bump is REFUSED."""
+    import shutil
+
+    corpus2 = tmp_path / "wire_corpus"
+    shutil.copytree(CORPUS, corpus2)
+    pins2 = tmp_path / "digests.json"
+    shutil.copyfile(PINS, pins2)
+
+    doc = wirecompat.run_wirecompat_audit(
+        update=True, corpus_dir=corpus2, pins_path=pins2
+    )
+    assert doc["ok"], doc["failures"]
+    assert doc["updated"] == [] and doc["refused"] == []
+
+    # simulate silent encoder drift: the on-disk case no longer matches
+    # what the current encoder emits, and no covered format was bumped
+    mf = json.loads((corpus2 / "manifest.json").read_text())
+    target = next(c for c in mf["cases"] if c["name"] == "misc_structs")
+    raw = bytearray((corpus2 / target["file"]).read_bytes())
+    raw[0] ^= 0xFF
+    (corpus2 / target["file"]).write_bytes(bytes(raw))
+    doc = wirecompat.run_wirecompat_audit(
+        update=True, corpus_dir=corpus2, pins_path=pins2
+    )
+    assert not doc["ok"]
+    assert "misc_structs" in doc["refused"]
+    assert any("version" in f for f in doc["failures"])
+    # the refusal wrote NOTHING: the corrupted file is untouched
+    assert (corpus2 / target["file"]).read_bytes() == bytes(raw)
+
+
+def test_update_corpus_accepts_drift_after_version_bump(tmp_path):
+    """The sanctioned path: bump the registry version (simulated by
+    aging the pin), regenerate, pins follow the registry."""
+    import shutil
+
+    corpus2 = tmp_path / "wire_corpus"
+    shutil.copytree(CORPUS, corpus2)
+    pins2 = tmp_path / "digests.json"
+    pin_doc = json.loads(PINS.read_text())
+    # age every format the case covers: the registry now looks "bumped"
+    # relative to the pins
+    for name in ("transport.dtls.record_hdr", "mqtt.slab_serializer.u16be",
+                 "fabric.u16", "fabric.u32"):
+        pin_doc["formats"][name]["version"] = 0
+    pins2.write_text(json.dumps(pin_doc))
+    mf = json.loads((corpus2 / "manifest.json").read_text())
+    target = next(c for c in mf["cases"] if c["name"] == "misc_structs")
+    raw = bytearray((corpus2 / target["file"]).read_bytes())
+    raw[0] ^= 0xFF
+    (corpus2 / target["file"]).write_bytes(bytes(raw))
+
+    doc = wirecompat.run_wirecompat_audit(
+        update=True, corpus_dir=corpus2, pins_path=pins2
+    )
+    assert doc["ok"], doc["failures"]
+    assert "misc_structs" in doc["updated"]
+    # the corpus was re-captured from the current encoder...
+    assert (corpus2 / target["file"]).read_bytes() == (
+        CORPUS / target["file"]
+    ).read_bytes()
+    # ...and the pins were rewritten back to the live registry versions
+    new_pins = json.loads(pins2.read_text())["formats"]
+    assert new_pins["fabric.u16"]["version"] == 1
+    # fixture pins (tier-A property) survive the rewrite untouched
+    assert any(k.startswith("fix.") for k in new_pins)
+
+
+def test_cli_wirecompat_flag(tmp_path):
+    import subprocess
+
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--wirecompat",
+         "--checks", "wire", "--format", "json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    doc = json.loads(p.stdout)
+    assert doc["wirecompat_audit"]["ok"]
+    assert doc["wirecompat_audit"]["drift_control"]["detected"]

@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--checks", default=None,
         help="comma-separated subset of checks to run "
              "(lock,async,jit,config,metrics,shard,transfer,retrace,"
-             "fault,cx,oplog,version,bufview)",
+             "fault,cx,oplog,version,bufview,wire,snapshot,bpapi)",
     )
     p.add_argument(
         "--changed-only", action="store_true",
@@ -78,6 +78,34 @@ def build_parser() -> argparse.ArgumentParser:
         "--update-snapshots", action="store_true",
         help="with --contracts: refresh the golden jaxpr snapshots "
         "instead of failing on a diff",
+    )
+    p.add_argument(
+        "--wirecompat", action="store_true",
+        help="additionally run the wire-compatibility audit "
+        "(tools/analysis/wirecompat.py): replay the committed golden "
+        "byte corpus through CURRENT decoders, cross-check live "
+        "struct/dtype layouts against the format registry, require the "
+        "seeded drift control to be detected, and fail any registered "
+        "format with no corpus coverage",
+    )
+    p.add_argument(
+        "--update-corpus", action="store_true",
+        help="with --wirecompat: regenerate the golden corpus with the "
+        "current encoders; REFUSES when bytes change without a registry "
+        "version bump, rewrites the digest pins otherwise",
+    )
+    p.add_argument(
+        "--audit", action="store_true",
+        help="the consolidated tier-B gate: --contracts + --replay + "
+        "--wirecompat in one run, shared report and exit contract "
+        "(rc = worst of the three)",
+    )
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="with --audit: the bounded ci_gate.sh --fast variant — "
+        "skips the jaxpr contract audit (compile-heavy) and caps "
+        "--replay-rounds at 8; the wirecompat corpus replay is cheap "
+        "and always runs in full",
     )
     p.add_argument(
         "--baseline", default=None,
@@ -152,16 +180,28 @@ def main(argv=None) -> int:
         return 0
 
     rc = 0 if report.clean else 1
+    # --audit is the consolidated tier-B entrypoint: one flag, every
+    # whole-system gate, one exit contract. --smoke bounds it for the
+    # fast CI lane (replay churn capped, compile-heavy contracts
+    # skipped; the corpus replay is cheap and stays full).
+    if args.audit:
+        args.replay = True
+        args.wirecompat = True
+        if args.smoke:
+            args.replay_rounds = min(args.replay_rounds, 8)
+        else:
+            args.contracts = True
     # Tier B audits are whole-system: there is no meaningful "changed
     # files only" subset of a jaxpr contract or a replication replay,
     # so --changed-only skips them instead of running a misleading
     # partial audit (the full CI gate runs them unconditionally).
-    tier_b = args.contracts or args.update_snapshots or args.replay
+    tier_b = (args.contracts or args.update_snapshots or args.replay
+              or args.wirecompat or args.update_corpus)
     if args.changed_only and tier_b:
         print(
             "note: --changed-only skips Tier B audits "
-            "(--contracts/--replay); run without --changed-only for "
-            "the whole-system gates",
+            "(--contracts/--replay/--wirecompat); run without "
+            "--changed-only for the whole-system gates",
             file=sys.stderr,
         )
     audit_doc = None
@@ -183,12 +223,23 @@ def main(argv=None) -> int:
         if replay_doc["divergence"] or not replay_doc["negative_detected"]:
             rc = max(rc, 1)
 
+    wirecompat_doc = None
+    if (args.wirecompat or args.update_corpus) and not args.changed_only:
+        from tools.analysis.wirecompat import run_wirecompat_audit
+
+        wirecompat_doc = run_wirecompat_audit(update=args.update_corpus)
+        if not wirecompat_doc["ok"]:
+            rc = max(rc, 1)
+        _emit_wirecompat_metrics(wirecompat_doc)
+
     if args.format == "json":
         doc = report.to_json()
         if audit_doc is not None:
             doc["contract_audit"] = audit_doc
         if replay_doc is not None:
             doc["replay_audit"] = replay_doc
+        if wirecompat_doc is not None:
+            doc["wirecompat_audit"] = wirecompat_doc
         print(json.dumps(doc, indent=2))
     else:
         print(report.render_text())
@@ -198,7 +249,28 @@ def main(argv=None) -> int:
             print(render_audit(audit_doc))
         if replay_doc is not None:
             print(_render_replay(replay_doc))
+        if wirecompat_doc is not None:
+            from tools.analysis.wirecompat import render_wirecompat_text
+
+            print(render_wirecompat_text(wirecompat_doc))
     return rc
+
+
+def _emit_wirecompat_metrics(doc) -> None:
+    """Best-effort metric stamps so audit runs show up on the
+    observability plane alongside broker series (declared in
+    broker/metrics.py: analysis.wirecompat.*, proto.registry.formats)."""
+    try:
+        from emqx_tpu.broker.metrics import Metrics
+        from emqx_tpu.proto.registry import formats
+
+        m = Metrics()
+        m.inc("analysis.wirecompat.runs")
+        if not doc.get("ok", False):
+            m.inc("analysis.wirecompat.failures")
+        m.gauge_set("proto.registry.formats", len(formats()))
+    except Exception:
+        pass  # metrics are an observability nicety, never a gate
 
 
 def _render_replay(doc) -> str:

@@ -1,0 +1,157 @@
+"""SS: snapshot/capture schema discipline.
+
+Durable snapshots and pickled captures are decoded by a DIFFERENT
+process version than the one that wrote them (restart, rolling upgrade,
+warm standby). The registry (emqx_tpu/proto/registry.py) pins each
+snapshot root's statically visible shape; this checker re-derives the
+shape from the defining code and flags drift — the static twin of the
+tier-B corpus replay, and the static catch for the PR 10 bug class
+(a live device handle reaching `pickle` because `__getstate__` stopped
+nulling it).
+
+- SS001 — the shape the root actually emits (the string-keyed dict
+  literals in a `schema` source, or the instance-field surface of a
+  `class_state` source) no longer digests to the registered structure.
+- SS002 — a registered source root that no longer exists (module or
+  symbol rot): the registry points at nothing, so nothing is guarded.
+- SS003 — a field the registration declares DROPPED (nulled/removed in
+  `__getstate__` — meshes, device buffers) is no longer dropped. This
+  is the unpicklable-mesh class caught without constructing a mesh.
+
+Shape extraction is deliberately syntactic: every non-empty dict
+literal whose keys are all string constants inside the source function
+is one key group (comprehensions and computed keys are invisible and
+intentionally excluded — the registry pins what can be pinned
+statically; the corpus replay covers the rest).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence
+
+from emqx_tpu.proto.digest import class_state_digest, schema_digest
+from tools.analysis.core import Checker, Finding, ParsedModule
+from tools.analysis.checkers.wire_common import (
+    Registration,
+    class_fields,
+    dict_key_groups,
+    extract_registrations,
+    find_def,
+    getstate_drops,
+    module_index,
+)
+
+
+class SnapshotSchemaChecker(Checker):
+    name = "snapshot"
+    codes = {
+        "SS001": "snapshot root shape drifted from its registered schema",
+        "SS002": "registered snapshot root no longer exists",
+        "SS003": "declared-dropped field no longer dropped in __getstate__",
+    }
+
+    def __init__(self):
+        self._regs: List[Registration] = []
+        self._by_rel: Dict[str, ParsedModule] = {}
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._regs = extract_registrations(modules)
+        self._by_rel = module_index(modules)
+
+    def finalize(self) -> Iterable[Finding]:
+        for reg in self._regs:
+            if reg.kind == "schema":
+                yield from self._check_schema(reg)
+            elif reg.kind == "class_state":
+                yield from self._check_class_state(reg)
+
+    def _rot(self, reg: Registration, what: str) -> Finding:
+        return Finding(
+            code="SS002",
+            path=reg.mod.rel,
+            line=reg.lineno,
+            symbol="<module>",
+            detail=reg.name,
+            message=(
+                f"snapshot format {reg.name!r}: registered root "
+                f"{reg.source} {what}"
+            ),
+        )
+
+    def _check_schema(self, reg: Registration) -> Iterable[Finding]:
+        path, symbol, _frag = reg.source_parts()
+        src_mod = self._by_rel.get(path)
+        if src_mod is None:
+            yield self._rot(reg, "points at a missing module")
+            return
+        func = find_def(src_mod, symbol)
+        if func is None or not isinstance(
+            func, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            yield self._rot(reg, "is not a function in the scanned tree")
+            return
+        groups = dict_key_groups(func)
+        if not groups:
+            yield self._rot(reg, "emits no statically visible dict shape")
+            return
+        code_digest = schema_digest(groups)
+        if reg.digest is not None and code_digest != reg.digest:
+            yield Finding(
+                code="SS001",
+                path=path,
+                line=func.lineno,
+                symbol=symbol,
+                detail=reg.name,
+                message=(
+                    f"snapshot shape of {symbol} drifted from registered "
+                    f"{reg.name!r}: registry={reg.digest} "
+                    f"code={code_digest} — bump the version and "
+                    "regenerate pins + corpus if intentional"
+                ),
+            )
+
+    def _check_class_state(self, reg: Registration) -> Iterable[Finding]:
+        path, symbol, _frag = reg.source_parts()
+        src_mod = self._by_rel.get(path)
+        if src_mod is None:
+            yield self._rot(reg, "points at a missing module")
+            return
+        cls = find_def(src_mod, symbol)
+        if not isinstance(cls, ast.ClassDef):
+            yield self._rot(reg, "is not a class in the scanned tree")
+            return
+        declared_drops: tuple = ()
+        if isinstance(reg.structure, (list, tuple)) and len(reg.structure) == 2:
+            declared_drops = tuple(reg.structure[1])
+        fields = class_fields(cls)
+        code_digest = class_state_digest(fields, declared_drops)
+        if reg.digest is not None and code_digest != reg.digest:
+            yield Finding(
+                code="SS001",
+                path=path,
+                line=cls.lineno,
+                symbol=symbol,
+                detail=reg.name,
+                message=(
+                    f"pickled surface of class {symbol} drifted from "
+                    f"registered {reg.name!r}: registry={reg.digest} "
+                    f"code={code_digest}"
+                ),
+            )
+        actual_drops = set(getstate_drops(cls))
+        for field in declared_drops:
+            if field not in actual_drops:
+                yield Finding(
+                    code="SS003",
+                    path=path,
+                    line=cls.lineno,
+                    symbol=symbol,
+                    detail=f"{reg.name}:{field}",
+                    message=(
+                        f"{reg.name!r} declares field {field!r} dropped "
+                        f"from pickles, but {symbol}.__getstate__ no "
+                        "longer nulls/removes it (live-handle leak — the "
+                        "unpicklable-mesh bug class)"
+                    ),
+                )

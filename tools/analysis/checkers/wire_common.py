@@ -1,0 +1,288 @@
+"""Shared extraction for the wire-contract checkers (WF/SS/BP).
+
+All three checkers anchor on `register(...)` calls in the scanned tree
+(the real registry is emqx_tpu/proto/registry.py; fixture trees carry
+their own mini-registries) and on the golden digest pins under
+tests/fixtures/analysis/wire/digests.json. Everything here is pure AST
+plus `emqx_tpu.proto.digest` — a stdlib-only leaf module, so tier A
+stays import-clean of broker/runtime code.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from emqx_tpu.proto.digest import digest_for, parse_pin
+from tools.analysis.core import ParsedModule
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+DEFAULT_PINS = (
+    REPO_ROOT / "tests" / "fixtures" / "analysis" / "wire" / "digests.json"
+)
+
+FORMAT_KINDS = (
+    "dtype", "struct", "tags", "schema", "class_state", "proto",
+)
+
+
+@dataclass
+class Registration:
+    """One AST-extracted `register(name, version, kind, structure,
+    source, ...)` call."""
+
+    name: str
+    version: int
+    kind: str
+    structure: object        # literal-eval'd; None when unresolvable
+    source: str              # "path.py[:SYMBOL][#fragment]"
+    mod: ParsedModule
+    lineno: int
+
+    @property
+    def digest(self) -> Optional[str]:
+        if self.structure is None:
+            return None
+        try:
+            return digest_for(self.kind, self.structure)
+        except Exception:
+            return None
+
+    def source_parts(self) -> Tuple[str, str, str]:
+        """-> (path, symbol, fragment)."""
+        src = self.source
+        frag = ""
+        if "#" in src:
+            src, frag = src.split("#", 1)
+        path, _, symbol = src.partition(":")
+        return path, symbol, frag
+
+
+def toplevel_assigns(mod: ParsedModule) -> Dict[str, ast.AST]:
+    """Module-level `NAME = <value>` nodes (last assignment wins)."""
+    out: Dict[str, ast.AST] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value
+    return out
+
+
+def resolve_literal(mod: ParsedModule, node: ast.AST, _depth: int = 0):
+    """literal_eval with one level of module-constant indirection:
+    `register(..., FIELDS, ...)` where FIELDS is a module-level literal
+    assignment resolves to its value. Returns None when not a literal."""
+    if isinstance(node, ast.Name) and _depth < 2:
+        target = toplevel_assigns(mod).get(node.id)
+        if target is None:
+            return None
+        return resolve_literal(mod, target, _depth + 1)
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def extract_registrations(
+    modules: Sequence[ParsedModule],
+) -> List[Registration]:
+    """Every wire-format `register(...)` call in the tree.
+
+    Matched by shape, not import provenance: func named `register` with
+    (str name, int version, str kind in FORMAT_KINDS, structure, str
+    source) positional args — BPAPI `registry.register("api", 1, {...})`
+    calls never match (their third arg is a dict, not a kind string)."""
+    regs: List[Registration] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 5):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if fname != "register":
+                continue
+            a = node.args
+            if not (
+                isinstance(a[0], ast.Constant)
+                and isinstance(a[0].value, str)
+                and isinstance(a[1], ast.Constant)
+                and isinstance(a[1].value, int)
+                and isinstance(a[2], ast.Constant)
+                and a[2].value in FORMAT_KINDS
+                and isinstance(a[4], ast.Constant)
+                and isinstance(a[4].value, str)
+            ):
+                continue
+            regs.append(Registration(
+                name=a[0].value,
+                version=a[1].value,
+                kind=a[2].value,
+                structure=resolve_literal(mod, a[3]),
+                source=a[4].value,
+                mod=mod,
+                lineno=node.lineno,
+            ))
+    return regs
+
+
+def load_pins(path: Optional[Path] = None) -> Dict[str, Tuple[int, str]]:
+    """Golden pins {name: (version, digest)}; {} when absent."""
+    p = path or DEFAULT_PINS
+    if not p.exists():
+        return {}
+    try:
+        return parse_pin(json.loads(p.read_text()))
+    except (ValueError, KeyError):
+        return {}
+
+
+def module_index(
+    modules: Sequence[ParsedModule],
+) -> Dict[str, ParsedModule]:
+    return {m.rel: m for m in modules}
+
+
+def find_def(
+    mod: ParsedModule, symbol: str
+) -> Optional[ast.AST]:
+    """Resolve 'Func' / 'Class' / 'Class.method' to its def node."""
+    want = symbol.split(".")
+    scope: List[ast.AST] = list(mod.tree.body)
+    node = None
+    for part in want:
+        node = None
+        for child in scope:
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ) and child.name == part:
+                node = child
+                break
+        if node is None:
+            return None
+        scope = list(getattr(node, "body", []))
+    return node
+
+
+def dict_key_groups(func: ast.AST) -> List[Tuple[str, ...]]:
+    """Key tuples of every non-empty all-string-keyed dict literal in a
+    function body — the statically visible snapshot shapes."""
+    groups: List[Tuple[str, ...]] = []
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.Dict) and node.keys):
+            continue
+        keys = []
+        ok = True
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.append(k.value)
+            else:
+                ok = False
+                break
+        if ok and keys:
+            groups.append(tuple(keys))
+    return groups
+
+
+def class_fields(cls: ast.ClassDef) -> List[str]:
+    """__getstate__-visible instance surface: dataclass-style annotated
+    class attrs + `self.X = ...` targets in __init__ (ordered, deduped).
+    """
+    out: List[str] = []
+    seen = set()
+
+    def add(name: str) -> None:
+        if name not in seen:
+            seen.add(name)
+            out.append(name)
+
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            add(node.target.id)
+    init = find_def_in(cls, "__init__")
+    if init is not None:
+        for node in ast.walk(init):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    add(t.attr)
+    return out
+
+
+def find_def_in(cls: ast.ClassDef, name: str) -> Optional[ast.AST]:
+    for node in cls.body:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and node.name == name:
+            return node
+    return None
+
+
+def getstate_drops(cls: ast.ClassDef) -> List[str]:
+    """Fields the class's __getstate__ nulls or removes from the pickled
+    dict: `d["x"] = None`, `d.pop("x", ...)`, `del d["x"]`."""
+    gs = find_def_in(cls, "__getstate__")
+    if gs is None:
+        return []
+    drops: List[str] = []
+    for node in ast.walk(gs):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is None
+                ):
+                    drops.append(t.slice.value)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "pop"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                drops.append(node.args[0].value)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    drops.append(t.slice.value)
+    return drops
+
+
+def prefix_constants(
+    mod: ParsedModule, prefix: str
+) -> Dict[str, object]:
+    """Module-level `<PREFIX><NAME> = <int|str>` constant groups (frame
+    type bytes, kv namespace names)."""
+    out: Dict[str, object] = {}
+    for name, value in toplevel_assigns(mod).items():
+        if not name.startswith(prefix):
+            continue
+        if isinstance(value, ast.Constant) and isinstance(
+            value.value, (int, str)
+        ) and not isinstance(value.value, bool):
+            out[name] = value.value
+    return out

@@ -1,0 +1,288 @@
+"""WF: wire-format registration + digest-pin discipline.
+
+The BPAPI rule for bytes (reference: apps/emqx/src/bpapi/ — every
+externalized layout is a frozen, versioned module). Here the registry is
+emqx_tpu/proto/registry.py and this checker closes the loop statically:
+
+- WF001 — a wire literal (module-level `struct.Struct`/`np.dtype`
+  constant, or a `T_*`/`NS_*` tag-constant group) in a module with a
+  serialize boundary (send/pack/pickle calls, pack_*/unpack_* defs)
+  that no registration's `source` covers. Unregistered layouts are
+  invisible to the version discipline and the corpus gate.
+- WF002 — a registered structure literal that drifted from the DEFINING
+  code (registry says one layout, the `np.dtype(...)` at the source
+  pointer says another), or a source pointer that rotted. This is what
+  catches a field reorder in `PUB_HDR_DT` without running any broker
+  code: the registry mirror no longer digests to the same string.
+- WF003 — a registered digest that drifted from the golden pin
+  (tests/fixtures/analysis/wire/digests.json) while the version stayed
+  put: a layout change shipping without a version bump.
+- WF004 — a registration with no pin, or a pin left stale after a
+  version bump: regenerate via
+  `python -m tools.analysis --wirecompat --update-corpus`.
+
+All structure comparison is digest-string equality, so messages show
+the actual field-level diff, not just "mismatch".
+"""
+
+from __future__ import annotations
+
+import ast
+import struct as _struct
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from emqx_tpu.proto.digest import dtype_digest, struct_digest, tag_digest
+from tools.analysis.core import Checker, Finding, ParsedModule
+from tools.analysis.checkers.wire_common import (
+    Registration,
+    extract_registrations,
+    load_pins,
+    module_index,
+    prefix_constants,
+    toplevel_assigns,
+)
+
+# call names that mark a module as a serialize boundary: its bytes
+# leave the process, so its layout constants must be registered
+BOUNDARY_CALLS = frozenset({
+    "send", "sendall", "sendto", "send_frame", "_send_frame",
+    "enqueue", "cast", "dumps", "dump", "pack", "pack_into",
+    "pack_frame", "tobytes",
+})
+
+# tag-constant group prefixes the registry covers with ":T_*" sources
+TAG_PREFIXES = ("T_", "NS_")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _has_serialize_boundary(mod: ParsedModule) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _call_name(node) in BOUNDARY_CALLS:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name.startswith("pack_") or node.name.startswith("unpack_")
+        ):
+            return True
+    return False
+
+
+def _wire_literal_kind(value: ast.AST) -> Optional[str]:
+    """'struct' for `struct.Struct(...)`, 'dtype' for `np.dtype(...)`."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _call_name(value)
+    if name == "Struct":
+        return "struct"
+    if name == "dtype":
+        return "dtype"
+    return None
+
+
+def _literal_digest(kind: str, value: ast.Call) -> Optional[str]:
+    """Digest of a defining-code wire literal, from its AST node."""
+    if not value.args:
+        return None
+    try:
+        arg = ast.literal_eval(value.args[0])
+    except (ValueError, SyntaxError):
+        return None
+    try:
+        if kind == "struct" and isinstance(arg, str):
+            return struct_digest(arg)
+        if kind == "dtype" and isinstance(arg, (list, tuple)):
+            return dtype_digest(list(arg))
+    except (ValueError, _struct.error):
+        return None
+    return None
+
+
+class WireFormatChecker(Checker):
+    name = "wire"
+    codes = {
+        "WF001": "wire literal at a serialize boundary is not registered",
+        "WF002": "registered structure drifted from the defining code",
+        "WF003": "registered digest drifted from pin without version bump",
+        "WF004": "registration has no golden pin / pin is stale",
+    }
+
+    def __init__(self, pins_path: Optional[Path] = None):
+        self._pins_path = pins_path
+        self._regs: List[Registration] = []
+        self._pins: Dict[str, Tuple[int, str]] = {}
+        self._by_rel: Dict[str, ParsedModule] = {}
+        # (module rel, symbol-or-prefix) pairs covered by a registration
+        self._covered: set = set()
+
+    def begin(self, modules: Sequence[ParsedModule]) -> None:
+        self._regs = extract_registrations(modules)
+        self._pins = load_pins(self._pins_path)
+        self._by_rel = module_index(modules)
+        self._covered = set()
+        for reg in self._regs:
+            path, symbol, _frag = reg.source_parts()
+            if symbol:
+                self._covered.add((path, symbol))
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        # WF001: unregistered boundary literals
+        if not _has_serialize_boundary(mod):
+            return
+        seen_prefixes = set()
+        for name, value in toplevel_assigns(mod).items():
+            kind = _wire_literal_kind(value)
+            if kind is not None:
+                if (mod.rel, name) not in self._covered:
+                    yield Finding(
+                        code="WF001",
+                        path=mod.rel,
+                        line=value.lineno,
+                        symbol="<module>",
+                        detail=name,
+                        message=(
+                            f"module-level {kind} literal {name} reaches a "
+                            "serialize boundary but has no "
+                            "proto.registry registration"
+                        ),
+                    )
+                continue
+            for prefix in TAG_PREFIXES:
+                if name.startswith(prefix) and prefix not in seen_prefixes:
+                    group = prefix_constants(mod, prefix)
+                    if len(group) < 2:
+                        continue  # one stray constant is not a tag table
+                    seen_prefixes.add(prefix)
+                    if (mod.rel, prefix + "*") not in self._covered:
+                        yield Finding(
+                            code="WF001",
+                            path=mod.rel,
+                            line=value.lineno,
+                            symbol="<module>",
+                            detail=prefix + "*",
+                            message=(
+                                f"tag-constant group {prefix}* "
+                                f"({len(group)} values) reaches a "
+                                "serialize boundary but has no "
+                                "proto.registry registration"
+                            ),
+                        )
+
+    def finalize(self) -> Iterable[Finding]:
+        for reg in self._regs:
+            yield from self._check_source(reg)
+            yield from self._check_pin(reg)
+
+    # -- WF002: registry literal vs defining code ------------------------
+    def _check_source(self, reg: Registration) -> Iterable[Finding]:
+        if reg.kind not in ("dtype", "struct", "tags"):
+            return  # schema/class_state are SS's, proto is BP's
+        path, symbol, _frag = reg.source_parts()
+        if not symbol:
+            return  # module-scope tag family: no single defining literal
+        src_mod = self._by_rel.get(path)
+        if src_mod is None:
+            yield Finding(
+                code="WF002",
+                path=reg.mod.rel,
+                line=reg.lineno,
+                symbol="<module>",
+                detail=f"{reg.name}:source",
+                message=(
+                    f"wire format {reg.name!r} points at missing source "
+                    f"module {path}"
+                ),
+            )
+            return
+        code_digest: Optional[str] = None
+        if symbol.endswith("*"):
+            group = prefix_constants(src_mod, symbol[:-1])
+            code_digest = tag_digest(group) if group else None
+        else:
+            value = toplevel_assigns(src_mod).get(symbol)
+            if value is not None:
+                kind = _wire_literal_kind(value)
+                if kind == reg.kind:
+                    code_digest = _literal_digest(kind, value)
+        if code_digest is None:
+            yield Finding(
+                code="WF002",
+                path=reg.mod.rel,
+                line=reg.lineno,
+                symbol="<module>",
+                detail=f"{reg.name}:source",
+                message=(
+                    f"wire format {reg.name!r}: source symbol "
+                    f"{path}:{symbol} not found or not a {reg.kind} literal"
+                ),
+            )
+            return
+        if reg.digest is not None and code_digest != reg.digest:
+            yield Finding(
+                code="WF002",
+                path=reg.mod.rel,
+                line=reg.lineno,
+                symbol="<module>",
+                detail=reg.name,
+                message=(
+                    f"wire format {reg.name!r} drifted from its defining "
+                    f"code: registry={reg.digest} code={code_digest} "
+                    f"({path}:{symbol})"
+                ),
+            )
+
+    # -- WF003/WF004: registry digest vs golden pin -----------------------
+    def _check_pin(self, reg: Registration) -> Iterable[Finding]:
+        if reg.digest is None:
+            return  # unresolvable structure; source check already fails
+        pin = self._pins.get(reg.name)
+        if pin is None:
+            yield Finding(
+                code="WF004",
+                path=reg.mod.rel,
+                line=reg.lineno,
+                symbol="<module>",
+                detail=f"{reg.name}:unpinned",
+                message=(
+                    f"wire format {reg.name!r} has no golden digest pin — "
+                    "run `python -m tools.analysis --wirecompat "
+                    "--update-corpus`"
+                ),
+            )
+            return
+        pin_version, pin_digest = pin
+        if reg.version == pin_version and reg.digest != pin_digest:
+            yield Finding(
+                code="WF003",
+                path=reg.mod.rel,
+                line=reg.lineno,
+                symbol="<module>",
+                detail=reg.name,
+                message=(
+                    f"wire format {reg.name!r} digest drifted without a "
+                    f"version bump (v{reg.version}): pin={pin_digest} "
+                    f"now={reg.digest} — bump the version and regenerate "
+                    "the pins + corpus"
+                ),
+            )
+        elif reg.version != pin_version:
+            yield Finding(
+                code="WF004",
+                path=reg.mod.rel,
+                line=reg.lineno,
+                symbol="<module>",
+                detail=f"{reg.name}:stale-pin",
+                message=(
+                    f"wire format {reg.name!r} is v{reg.version} but the "
+                    f"pin is v{pin_version} — regenerate via "
+                    "`python -m tools.analysis --wirecompat "
+                    "--update-corpus`"
+                ),
+            )

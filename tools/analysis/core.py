@@ -268,6 +268,7 @@ def parse_modules(root: Path, jobs: int = 0) -> List[ParsedModule]:
 
 def default_checkers() -> List[Checker]:
     from tools.analysis.checkers.async_blocking import AsyncBlockingChecker
+    from tools.analysis.checkers.bpapi_symmetry import BpapiSymmetryChecker
     from tools.analysis.checkers.buffer_view import BufferViewChecker
     from tools.analysis.checkers.config_keys import ConfigKeyChecker
     from tools.analysis.checkers.cross_context import CrossContextChecker
@@ -279,7 +280,9 @@ def default_checkers() -> List[Checker]:
     from tools.analysis.checkers.oplog_complete import OplogCompleteChecker
     from tools.analysis.checkers.retrace import RetraceChecker
     from tools.analysis.checkers.sharding import ShardingChecker
+    from tools.analysis.checkers.snapshot_schema import SnapshotSchemaChecker
     from tools.analysis.checkers.version_epoch import VersionDisciplineChecker
+    from tools.analysis.checkers.wire_format import WireFormatChecker
 
     return [
         LockDisciplineChecker(),
@@ -295,6 +298,9 @@ def default_checkers() -> List[Checker]:
         OplogCompleteChecker(),
         VersionDisciplineChecker(),
         BufferViewChecker(),
+        WireFormatChecker(),
+        SnapshotSchemaChecker(),
+        BpapiSymmetryChecker(),
     ]
 
 

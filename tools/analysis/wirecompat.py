@@ -1,0 +1,981 @@
+"""Tier-B wire-compatibility audit: golden-corpus replay through CURRENT
+decoders.
+
+Tier A (checkers/wire_format.py et al.) proves the *declared* formats
+haven't drifted from their defining code. This module proves the code
+still *reads old bytes*: a committed corpus of frames, snapshots and
+pickles — captured by the encoders of the version that wrote them —
+is replayed through today's decode paths and the result compared,
+deep-equal, against pinned JSON expectations.
+
+Four gates, one report:
+
+  1. live registry cross-check — every registered struct/dtype format is
+     imported and its LIVE object's digest recomputed against the
+     registry (the AST view can't see a runtime-constructed layout);
+  2. corpus replay — every case in tests/fixtures/wire_corpus/
+     manifest.json decodes clean and matches expected/<case>.json;
+  3. seeded drift control — one corpus byte is flipped IN MEMORY and the
+     decode MUST fail or diverge (a gate that can't catch its own
+     negative control is not a gate);
+  4. staleness — every repo-registered format must be covered by at
+     least one corpus case, so new formats can't ship corpus-less.
+
+`--update-corpus` regenerates the corpus with the current encoders but
+REFUSES when a case's bytes change while every format it covers still
+carries its pinned version — exactly the silent-break the audit exists
+to stop. A legitimate format change bumps the registry version first;
+the update then rewrites the golden pins alongside the corpus.
+
+Legacy cases (PR 11 raw-"ts" inflight snapshots, PR 15 wall-"deadline"
+expiry snapshots, pre-interval "due" delayed entries) are hand-crafted:
+their encoders no longer exist, which is the point — the current
+decoders must keep reading them.
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib
+import json
+import pickle
+import struct
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+CORPUS_DIR = REPO_ROOT / "tests" / "fixtures" / "wire_corpus"
+PINS_PATH = REPO_ROOT / "tests" / "fixtures" / "analysis" / "wire" / "digests.json"
+
+# fixed stamps: corpus bytes must be reproducible byte-for-byte so
+# --update-corpus can tell "format changed" from "regenerated"
+T_WALL = 1754000000.0  # 2025-08-01: a committed past instant
+T_FAR = 4102444800.0  # 2100-01-01: survives restore-time expiry math
+
+
+# -- canonicalization ---------------------------------------------------
+
+def _b64(b) -> str:
+    return base64.b64encode(bytes(b)).decode()
+
+
+def _canon(obj: Any) -> Any:
+    """JSON-safe canonical form of a decoded value (tuples -> lists,
+    bytes -> b64, Message -> its registered JSON shape)."""
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.storage.codec import msg_to_json
+
+    if isinstance(obj, Message):
+        return {"__msg__": _canon(msg_to_json(obj))}
+    if isinstance(obj, dict):
+        return {str(k): _canon(v) for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))}
+    if isinstance(obj, (list, tuple)):
+        return [_canon(v) for v in obj]
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return {"__b64__": _b64(obj)}
+    if isinstance(obj, float):
+        return round(obj, 6)
+    return obj
+
+
+def _canon_session(doc: Dict) -> Dict:
+    """session_to_json output, with clock-sensitive fields coarsened:
+    inflight ages re-read monotonic at encode time, so a decode->encode
+    round trip shifts them by scheduler noise."""
+    out = _canon(doc)
+    for e in out.get("inflight", []):
+        e["age"] = round(float(e.get("age", 0.0)), 1)
+    return out
+
+
+def _split_frames(data: bytes, hdr: struct.Struct, extra: int = 0) -> List[bytes]:
+    """Split a concatenation of length-prefixed frames. `extra` is the
+    prefix overhead beyond the length field (fabric: 1 type byte,
+    already inside hdr)."""
+    out = []
+    off = 0
+    while off < len(data):
+        fields = hdr.unpack_from(data, off)
+        length = fields[0]
+        end = off + hdr.size + extra + length
+        if end > len(data):
+            raise ValueError("torn frame in corpus stream")
+        out.append(data[off:end])
+        off = end
+    return out
+
+
+# -- stubs for the restore-path decoders --------------------------------
+
+class _DictKv:
+    """In-memory FileKv twin: the corpus file IS the namespace payload."""
+
+    def __init__(self, payloads: Dict[str, Dict]):
+        self._p = payloads
+
+    def read(self, namespace: str) -> Optional[Dict]:
+        return self._p.get(namespace)
+
+    def write(self, namespace: str, obj: Dict) -> None:
+        self._p[namespace] = obj
+
+
+class _StubCm:
+    def __init__(self):
+        self._detached: Dict[str, Tuple[Any, float]] = {}
+
+
+class _StubBroker:
+    def __init__(self):
+        self.routes: List[Tuple[str, str]] = []
+
+    def subscribe(self, node, cid, topic_filter, opts, deliver) -> None:
+        self.routes.append((cid, topic_filter))
+
+
+# -- decoders -----------------------------------------------------------
+# Each decoder: (data: bytes, params: dict) -> JSON-canonical object.
+# They call the repo's CURRENT decode paths — never a reimplementation.
+
+def _dec_pub_frame(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.transport import fabric
+
+    seq, records = fabric.unpack_pub_frame(data)
+    return {"seq": seq, "records": _canon(records)}
+
+
+def _dec_dlv_frames(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.transport import fabric
+
+    frames = _split_frames(data, fabric._HDR)
+    return {"frames": [_canon(fabric.unpack_dlv_frame(f)) for f in frames]}
+
+
+def _dec_raw_frame(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.transport import fabric
+
+    length, ftype = fabric._HDR.unpack_from(data, 0)
+    if ftype != fabric.T_RAW:
+        raise ValueError(f"expected T_RAW frame, got type {ftype}")
+    return {"records": _canon(fabric.unpack_raw_batch(data[5:]))}
+
+
+def _dec_pub_ack(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.transport import fabric
+
+    length, ftype = fabric._HDR.unpack_from(data, 0)
+    if ftype != fabric.T_PUBB_ACK:
+        raise ValueError(f"expected T_PUBB_ACK frame, got type {ftype}")
+    seq, counts = fabric.unpack_pub_ack(data[5:])
+    return {"seq": seq, "counts": counts}
+
+
+def _dec_cluster_bus(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.cluster import tcp_transport
+
+    out = []
+    off = 0
+    while off < len(data):
+        (n,) = tcp_transport._LEN.unpack_from(data, off)
+        off += tcp_transport._LEN.size
+        frame = pickle.loads(data[off : off + n])
+        off += n
+        out.append(_canon(frame))
+    return {"frames": out}
+
+
+def _dec_session_json(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.broker.session import SessionConfig
+    from emqx_tpu.storage.codec import session_from_json, session_to_json
+
+    doc = json.loads(data.decode())
+    sess = session_from_json(doc, SessionConfig())
+    return _canon_session(session_to_json(sess))
+
+
+def _dec_sessions_kv(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.broker.persistent_session import NS_SESSIONS, SessionPersistence
+    from emqx_tpu.broker.session import SessionConfig
+    from emqx_tpu.storage.codec import session_to_json
+
+    kv = _DictKv({NS_SESSIONS: json.loads(data.decode())})
+    cm, broker = _StubCm(), _StubBroker()
+    sp = SessionPersistence(broker, cm, kv, SessionConfig())
+    n = sp.restore()
+    sessions = {
+        cid: _canon_session(session_to_json(sess))
+        for cid, (sess, _deadline) in sorted(cm._detached.items())
+    }
+    return {
+        "restored": n,
+        "routes": sorted(broker.routes),
+        "sessions": sessions,
+    }
+
+
+def _dec_durable_kv(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.broker.banned import Banned
+    from emqx_tpu.broker.delayed import DelayedPublish
+    from emqx_tpu.broker.persistent_session import DurableState
+    from emqx_tpu.broker.retainer import Retainer
+
+    kv = _DictKv(json.loads(data.decode()))
+    retainer = Retainer()
+    delayed = DelayedPublish(broker=None)
+    banned = Banned()
+    out = DurableState(kv, retainer=retainer, delayed=delayed, banned=banned).restore()
+    return {
+        "counts": out,
+        "retained": sorted(
+            (t, _b64(retainer.get(t).payload)) for t in retainer.topics()
+        ),
+        "delayed_topics": sorted(m.topic for _due, m in delayed.pending()),
+        "banned": sorted((e.kind, e.value) for e in banned.entries()),
+    }
+
+
+def _dec_segment_snapshot(data: bytes, params: Dict) -> Any:
+    import io
+
+    import numpy as np
+
+    state = pickle.load(io.BytesIO(data))
+    out = {}
+    for k in sorted(state):
+        v = state[k]
+        if isinstance(v, np.ndarray):
+            out[k] = {
+                "dtype": str(v.dtype),
+                "shape": list(v.shape),
+                "values": _canon(v.tolist()),
+            }
+        else:
+            out[k] = _canon(v)
+    return {"keys": sorted(state), "state": out}
+
+
+def _dec_session_store(data: bytes, params: Dict) -> Any:
+    import io
+
+    from emqx_tpu.broker.session_store import SessionStore
+
+    state = pickle.load(io.BytesIO(data))
+    store = SessionStore(capacity=int(params.get("capacity", 64)), sweep_slots=16)
+    restored = store.install(state)
+    return {"keys": sorted(state), "restored": restored}
+
+
+def _dec_router_pickle(data: bytes, params: Dict) -> Any:
+    import io
+
+    router = pickle.load(io.BytesIO(data))
+    fields = vars(router)
+    return {
+        "fields": sorted(fields),
+        "device_handles_nulled": fields.get("_matcher") is None
+        and fields.get("mesh") is None,
+        "exact": _canon(fields.get("_exact", {})),
+    }
+
+
+def _dec_message_pickle(data: bytes, params: Dict) -> Any:
+    import io
+
+    from emqx_tpu.storage.codec import msg_to_json
+
+    return _canon(msg_to_json(pickle.load(io.BytesIO(data))))
+
+
+def _dec_misc_structs(data: bytes, params: Dict) -> Any:
+    from emqx_tpu.mqtt import slab_serializer
+    from emqx_tpu.transport import dtls, fabric
+
+    off = 0
+    rec = dtls._REC.unpack_from(data, off)
+    off += dtls._REC.size
+    (u16be,) = slab_serializer._U16BE.unpack_from(data, off)
+    off += slab_serializer._U16BE.size
+    (u16,) = fabric._U16.unpack_from(data, off)
+    off += fabric._U16.size
+    (u32,) = fabric._U32.unpack_from(data, off)
+    off += fabric._U32.size
+    if off != len(data):
+        raise ValueError("misc_structs corpus has trailing bytes")
+    return {"dtls_record": list(rec), "u16be": u16be, "u16": u16, "u32": u32}
+
+
+DECODERS: Dict[str, Callable[[bytes, Dict], Any]] = {
+    "pub_frame": _dec_pub_frame,
+    "dlv_frames": _dec_dlv_frames,
+    "raw_frame": _dec_raw_frame,
+    "pub_ack": _dec_pub_ack,
+    "cluster_bus": _dec_cluster_bus,
+    "session_json": _dec_session_json,
+    "sessions_kv": _dec_sessions_kv,
+    "durable_kv": _dec_durable_kv,
+    "segment_snapshot": _dec_segment_snapshot,
+    "session_store": _dec_session_store,
+    "router_pickle": _dec_router_pickle,
+    "message_pickle": _dec_message_pickle,
+    "misc_structs": _dec_misc_structs,
+}
+
+
+# -- generators ---------------------------------------------------------
+# Current-encoder corpus capture, deterministic byte-for-byte. Legacy
+# cases are hand-crafted: their writers no longer exist.
+
+def _mk_msg(i: int, topic: Optional[str] = None, **kw) -> Any:
+    from emqx_tpu.broker.message import Message
+
+    defaults = dict(
+        topic=topic or f"sensors/{i}/temp",
+        payload=(b"%d:" % i) + b"x" * (16 + 7 * i),
+        qos=i % 3,
+        retain=bool(i & 1),
+        from_client=f"dev-{i}",
+        mid=1000 + i,
+        timestamp=T_WALL + i,
+    )
+    defaults.update(kw)
+    return Message(**defaults)
+
+
+def _gen_pubb_slab() -> bytes:
+    from emqx_tpu.transport import fabric
+
+    msgs = [_mk_msg(i) for i in range(6)]
+    msgs[2].properties = {"Content-Type": "text/plain", "User-Property": [["k", "v"]]}
+    msgs[4].dup = True
+    return fabric.pack_pub_slab(msgs, seq=42)
+
+
+def _gen_pubb_legacy() -> bytes:
+    from emqx_tpu.transport import fabric
+
+    msgs = [_mk_msg(i) for i in range(4)]
+    msgs[1].properties = {"Message-Expiry-Interval": 3600}
+    return fabric.pack_pub_batch(msgs, seq=7)
+
+
+def _gen_dlv_slab_split() -> bytes:
+    from emqx_tpu.transport import fabric
+
+    records = [
+        (_mk_msg(i, headers={"retained": bool(i == 1)}), list(range(i * 3, i * 3 + 5)))
+        for i in range(8)
+    ]
+    # a tiny max_body forces the MAX_BODY split path with small files
+    return b"".join(fabric.pack_dlv_slabs(records, max_body=256))
+
+
+def _gen_dlv_legacy() -> bytes:
+    from emqx_tpu.transport import fabric
+
+    records = [(_mk_msg(i), [100 + i, 200 + i]) for i in range(3)]
+    records[1][0].properties = {"Response-Topic": "replies/1"}
+    return b"".join(fabric.pack_dlv_batches(records, max_body=128))
+
+
+def _gen_raw_legacy() -> bytes:
+    from emqx_tpu.transport import fabric
+
+    records = [(b"\x30\x0a\x00\x03abcHELLO", [1, 2, 3]), (b"\xd0\x00", [9])]
+    return b"".join(fabric.pack_raw_batches(records))
+
+
+def _gen_pub_ack() -> bytes:
+    from emqx_tpu.transport import fabric
+
+    return fabric.pack_pub_ack(42, [3, 0, -1, 7])
+
+
+def _gen_cluster_bus() -> bytes:
+    from emqx_tpu.cluster import tcp_transport
+
+    fwd = _mk_msg(
+        1,
+        topic="cluster/fwd",
+        headers={"traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"},
+    )
+    park = {
+        "client_id": "edge-9",
+        "session": {"client_id": "edge-9", "expiry_interval": 300},
+        "expiry_remaining_s": 120.0,
+    }
+    frames = [
+        ("hello", 0, ("node-a", "10.0.0.1", 7400)),
+        ("cast", 0, ("membership", "join", {"node": "node-a", "epoch": 3})),
+        ("cast", 0, ("membership", "heartbeat")),
+        ("call", 7, ("rpc", "call", "broker", 1, "route_publish", (fwd,))),
+        ("reply", 7, (True, "ok")),
+        ("call", 8, ("sess", "park_remote", park)),
+        ("cast", 0, ("rpc", "announce", {"node": "node-a", "apis": ["broker"]})),
+    ]
+    out = bytearray()
+    for f in frames:
+        blob = pickle.dumps(f, protocol=pickle.HIGHEST_PROTOCOL)
+        out += tcp_transport._LEN.pack(len(blob)) + blob
+    return bytes(out)
+
+
+def _session_doc_current() -> Dict:
+    from emqx_tpu.broker.session import SessionConfig
+    from emqx_tpu.storage.codec import (
+        msg_to_json,
+        session_from_json,
+        session_to_json,
+    )
+
+    doc = {
+        "client_id": "dev-42",
+        "created_at": T_WALL,
+        "expiry_interval": 3600,
+        "next_pid": 17,
+        "subscriptions": {
+            "sensors/#": {"qos": 1, "no_local": False,
+                          "retain_as_published": False, "retain_handling": 0},
+            "alerts/+/hi": {"qos": 2, "no_local": True,
+                            "retain_as_published": True, "retain_handling": 1},
+        },
+        "mqueue": [msg_to_json(_mk_msg(1)), msg_to_json(_mk_msg(2))],
+        "inflight": [
+            {"pid": 5, "phase": "pub", "age": 0.0, "msg": msg_to_json(_mk_msg(3))},
+            {"pid": 6, "phase": "rel", "age": 0.0, "msg": None},
+        ],
+        "awaiting_rel": [9, 11],
+    }
+    # round-trip through the CURRENT codec so the committed file is
+    # genuine encoder output, not a hand-approximation of it
+    sess = session_from_json(doc, SessionConfig())
+    out = session_to_json(sess)
+    for e in out["inflight"]:
+        e["age"] = 0.0  # strip decode->encode monotonic jitter
+    return out
+
+
+def _gen_session_current() -> bytes:
+    return json.dumps(_session_doc_current(), indent=1, sort_keys=True).encode()
+
+
+def _gen_session_legacy_ts() -> bytes:
+    """PR 11 legacy shape: inflight entries carried raw MONOTONIC "ts"
+    stamps (meaningless in this process). The current decoder must read
+    them as age-0 entries rather than crash or mis-age them."""
+    from emqx_tpu.storage.codec import msg_to_json
+
+    doc = {
+        "client_id": "old-node-client",
+        "created_at": T_WALL - 500.0,
+        "expiry_interval": 7200,
+        "next_pid": 3,
+        "subscriptions": {
+            "legacy/topic": {"qos": 1, "no_local": False,
+                             "retain_as_published": False, "retain_handling": 0},
+        },
+        "mqueue": [msg_to_json(_mk_msg(4, topic="legacy/q"))],
+        "inflight": [
+            {"pid": 1, "phase": "pub", "ts": 123456.789,
+             "msg": msg_to_json(_mk_msg(5, topic="legacy/infl"))},
+            {"pid": 2, "phase": "rel", "ts": 123460.0, "msg": None},
+        ],
+        "awaiting_rel": [2],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True).encode()
+
+
+def _gen_sessions_kv_current() -> bytes:
+    snap = _session_doc_current()
+    # interval must outlive (decode wall-now - T_WALL): ~32 years
+    snap["expiry_remaining_s"] = 1.0e9
+    stale = dict(_session_doc_current(), client_id="stale-1")
+    stale["expiry_remaining_s"] = 5.0  # expired during downtime -> dropped
+    return json.dumps(
+        {"at": T_WALL, "sessions": {"dev-42": snap, "stale-1": stale}},
+        indent=1, sort_keys=True,
+    ).encode()
+
+
+def _gen_sessions_kv_legacy_deadline() -> bytes:
+    """PR 15 legacy shape: per-session wall-clock "deadline" instead of
+    expiry_remaining_s. Restore must rebase it once (deadline - now)."""
+    snap = _session_doc_current()
+    snap["deadline"] = T_FAR  # 2100: survives the rebase
+    gone = dict(_session_doc_current(), client_id="gone-1")
+    gone["deadline"] = 1000.0  # 1970-adjacent: expired while down
+    return json.dumps(
+        {"at": T_WALL, "sessions": {"dev-42": snap, "gone-1": gone}},
+        indent=1, sort_keys=True,
+    ).encode()
+
+
+def _gen_durable_kv_current() -> bytes:
+    from emqx_tpu.broker.banned import BanEntry, Banned
+    from emqx_tpu.broker.delayed import DelayedPublish
+    from emqx_tpu.broker.persistent_session import (
+        NS_BANNED,
+        NS_DELAYED,
+        NS_RETAINED,
+        DurableState,
+    )
+    from emqx_tpu.broker.retainer import Retainer
+
+    retainer = Retainer()
+    for i in range(3):
+        retainer.on_publish(_mk_msg(i, topic=f"retained/{i}", retain=True))
+    delayed = DelayedPublish(broker=None)
+    delayed.load(1.0e9, _mk_msg(7, topic="later/a"))
+    delayed.load(2.0e9, _mk_msg(8, topic="later/b"))
+    banned = Banned()
+    banned.add(BanEntry(kind="clientid", value="evil-1", reason="abuse",
+                        until=T_FAR, by="admin"))
+    kv = _DictKv({})
+    DurableState(kv, retainer=retainer, delayed=delayed, banned=banned).flush()
+    doc = kv._p
+    doc[NS_DELAYED]["at"] = T_WALL
+    # remaining intervals must outlive decode-time downtime charging
+    for d in doc[NS_DELAYED]["messages"]:
+        d["remaining_s"] = 1.0e9
+    # a banned entry the restore must SKIP (until in the past)
+    doc[NS_BANNED]["entries"].append(
+        {"kind": "clientid", "value": "expired-ban", "reason": "old",
+         "until": 1000.0, "by": "admin"}
+    )
+    assert NS_RETAINED in doc
+    return json.dumps(doc, indent=1, sort_keys=True).encode()
+
+
+def _gen_durable_kv_legacy() -> bytes:
+    """Pre-interval delayed entries carried wall-clock "due" deadlines;
+    one is already past (dropped), one message carries an expired
+    Message-Expiry-Interval (dropped by is_expired)."""
+    from emqx_tpu.broker.persistent_session import (
+        NS_BANNED,
+        NS_DELAYED,
+        NS_RETAINED,
+    )
+    from emqx_tpu.storage.codec import msg_to_json
+
+    expired = _mk_msg(3, topic="retained/expired", retain=True,
+                      properties={"Message-Expiry-Interval": 10})
+    doc = {
+        NS_RETAINED: {
+            "messages": [
+                msg_to_json(_mk_msg(0, topic="retained/keep", retain=True)),
+                msg_to_json(expired),
+            ]
+        },
+        NS_DELAYED: {
+            "at": T_WALL,
+            "messages": [
+                {"due": T_FAR, "msg": msg_to_json(_mk_msg(5, topic="later/live"))},
+                {"due": 1000.0, "msg": msg_to_json(_mk_msg(6, topic="later/past"))},
+            ],
+        },
+        NS_BANNED: {
+            "entries": [
+                {"kind": "peerhost", "value": "10.9.9.9", "reason": "flood",
+                 "until": T_FAR, "by": "ops"},
+            ]
+        },
+    }
+    return json.dumps(doc, indent=1, sort_keys=True).encode()
+
+
+def _gen_segment_state() -> bytes:
+    import io
+
+    import numpy as np
+
+    state = {
+        "route_index": {"sensors/1/temp": 0, "alerts/+/hi": 1},
+        "hot_segments": np.arange(8, dtype=np.int32),
+        "sub_bitmap": np.array([1, 0, 1, 1], dtype=np.uint8),
+        "generation": 3,
+    }
+    buf = io.BytesIO()
+    pickle.dump(state, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def _gen_session_store() -> bytes:
+    import io
+
+    from emqx_tpu.broker.session_store import SessionStore
+
+    store = SessionStore(capacity=64, sweep_slots=16)
+    state = store.capture()
+    state["t0_age_ds"] = 0  # clock reading: normalize for reproducibility
+    buf = io.BytesIO()
+    pickle.dump(state, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def _gen_router_state() -> bytes:
+    from emqx_tpu.broker.router import Router
+
+    r = Router(enable_tpu=False)
+    return pickle.dumps(r, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _gen_message_pickle() -> bytes:
+    m = _mk_msg(
+        11,
+        topic="cluster/traced",
+        headers={"traceparent": "00-" + "12" * 16 + "-" + "34" * 8 + "-01",
+                 "retained": False},
+        properties={"Correlation-Data": b"\x01\x02"},
+    )
+    return pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _gen_misc_structs() -> bytes:
+    from emqx_tpu.mqtt import slab_serializer
+    from emqx_tpu.transport import dtls, fabric
+
+    return (
+        dtls._REC.pack(22, 0xFEFD, 1, 0x0002, 0x00000003, 48)
+        + slab_serializer._U16BE.pack(0x1234)
+        + fabric._U16.pack(0x2345)
+        + fabric._U32.pack(0xDEADBEEF)
+    )
+
+
+# (name, file, decoder, generator, covers, params)
+CASES: List[Tuple[str, str, str, Callable[[], bytes], List[str], Dict]] = [
+    ("pubb_slab", "pubb_slab.bin", "pub_frame", _gen_pubb_slab,
+     ["fabric.slab.pub_hdr", "fabric.frame_hdr", "fabric.frame_types"], {}),
+    ("pubb_legacy", "pubb_legacy.bin", "pub_frame", _gen_pubb_legacy,
+     ["fabric.u16", "fabric.u32", "fabric.frame_hdr", "fabric.frame_types"], {}),
+    ("dlv_slab_split", "dlv_slab_split.bin", "dlv_frames", _gen_dlv_slab_split,
+     ["fabric.slab.dlv_hdr", "fabric.frame_hdr", "fabric.frame_types"], {}),
+    ("dlv_legacy", "dlv_legacy.bin", "dlv_frames", _gen_dlv_legacy,
+     ["fabric.u16", "fabric.u32"], {}),
+    ("raw_legacy", "raw_legacy.bin", "raw_frame", _gen_raw_legacy,
+     ["fabric.u16", "fabric.u32", "fabric.frame_types"], {}),
+    ("pub_ack", "pub_ack.bin", "pub_ack", _gen_pub_ack,
+     ["fabric.u32", "fabric.frame_types"], {}),
+    ("cluster_bus", "cluster_bus.bin", "cluster_bus", _gen_cluster_bus,
+     ["cluster.bus.len_prefix", "cluster.bus.kinds", "cluster.payload.kinds",
+      "membership.tags", "cluster.rpc.kinds", "cluster.bpapi",
+      "cluster.sess.park", "message.pickle"], {}),
+    ("session_current", "session_current.json", "session_json",
+     _gen_session_current,
+     ["codec.session_json", "codec.msg_json", "codec.subopts_json"], {}),
+    ("session_legacy_ts", "session_legacy_ts.json", "session_json",
+     _gen_session_legacy_ts, ["codec.session_json"], {}),
+    ("sessions_kv_current", "sessions_kv_current.json", "sessions_kv",
+     _gen_sessions_kv_current, ["durable.sessions_ns", "codec.session_json"], {}),
+    ("sessions_kv_legacy_deadline", "sessions_kv_legacy_deadline.json",
+     "sessions_kv", _gen_sessions_kv_legacy_deadline,
+     ["durable.sessions_ns"], {}),
+    ("durable_kv_current", "durable_kv_current.json", "durable_kv",
+     _gen_durable_kv_current,
+     ["durable.kv.namespaces", "durable.state", "codec.msg_json"], {}),
+    ("durable_kv_legacy", "durable_kv_legacy.json", "durable_kv",
+     _gen_durable_kv_legacy, ["durable.kv.namespaces", "durable.state"], {}),
+    ("segment_state", "segment_state.pkl", "segment_snapshot",
+     _gen_segment_state, ["snapshot.segment_meta"], {}),
+    ("session_store", "session_store.pkl", "session_store",
+     _gen_session_store, ["snapshot.session_store"], {"capacity": 64}),
+    ("router_state", "router_state.pkl", "router_pickle", _gen_router_state,
+     ["router.pickle"], {}),
+    ("message_traced", "message_traced.pkl", "message_pickle",
+     _gen_message_pickle, ["message.pickle"], {}),
+    ("misc_structs", "misc_structs.bin", "misc_structs", _gen_misc_structs,
+     ["transport.dtls.record_hdr", "mqtt.slab_serializer.u16be",
+      "fabric.u16", "fabric.u32"], {}),
+]
+
+DRIFT_CASE = "pubb_slab"
+
+
+# -- registry live cross-check ------------------------------------------
+
+def _module_from_source(path: str):
+    mod_name = path[:-3].replace("/", ".")
+    return importlib.import_module(mod_name)
+
+
+def _live_digest_failures() -> List[Dict]:
+    """Recompute struct/dtype digests from the LIVE imported objects —
+    the runtime view the AST checkers cannot reach."""
+    import numpy as np
+
+    from emqx_tpu.proto import registry
+    from emqx_tpu.proto.digest import dtype_digest, struct_digest
+
+    out = []
+    for fmt in registry.formats():
+        if fmt.kind not in ("struct", "dtype"):
+            continue
+        src = fmt.source.split("#", 1)[0]
+        if ":" not in src:
+            continue
+        path, symbol = src.rsplit(":", 1)
+        if symbol.endswith("*"):
+            continue
+        try:
+            obj = getattr(_module_from_source(path), symbol)
+        except (ImportError, AttributeError) as e:
+            out.append({"format": fmt.name, "error": f"source rot: {e}"})
+            continue
+        if fmt.kind == "struct":
+            live = struct_digest(obj.format)
+        else:
+            # numpy canonicalizes byte-order-free single-byte codes as
+            # "|u1"; the registry declares them as written ("u1")
+            live = dtype_digest(tuple(
+                (n, c[1:] if c.startswith("|") else c)
+                for n, c in np.dtype(obj).descr
+            ))
+        if live != fmt.digest:
+            out.append({
+                "format": fmt.name,
+                "error": f"live {live} != registered {fmt.digest}",
+            })
+    return out
+
+
+# -- the audit ----------------------------------------------------------
+
+def _load_manifest(corpus_dir: Path) -> Dict:
+    with open(corpus_dir / "manifest.json", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _decode_case(case: Dict, data: bytes) -> Any:
+    dec = DECODERS.get(case["decoder"])
+    if dec is None:
+        raise ValueError(f"unknown decoder {case['decoder']!r}")
+    # round-trip through JSON so float/tuple canon matches what the
+    # expected files store
+    return json.loads(json.dumps(dec(data, case.get("params", {}))))
+
+
+def _expected_path(corpus_dir: Path, case: Dict) -> Path:
+    return corpus_dir / "expected" / f"{case['name']}.json"
+
+
+def _find_drift_offset(data: bytes, case: Dict, expected: Any) -> int:
+    """Deterministic search for a byte whose flip the decoder detects —
+    recorded in the manifest so the audit replays the same flip."""
+    for off in range(len(data) // 2, len(data)):
+        mutated = bytearray(data)
+        mutated[off] ^= 0xFF
+        try:
+            if _decode_case(case, bytes(mutated)) != expected:
+                return off
+        except Exception:
+            return off
+    raise RuntimeError("no detectable drift offset found (corpus too forgiving)")
+
+
+def run_wirecompat_audit(
+    update: bool = False,
+    corpus_dir: Optional[Path] = None,
+    pins_path: Optional[Path] = None,
+) -> Dict:
+    corpus_dir = Path(corpus_dir or CORPUS_DIR)
+    pins_path = Path(pins_path or PINS_PATH)
+    if update:
+        return _update_corpus(corpus_dir, pins_path)
+
+    doc: Dict[str, Any] = {"ok": True, "cases": [], "failures": []}
+
+    reg_fail = _live_digest_failures()
+    doc["registry"] = {"live_mismatches": reg_fail}
+    if reg_fail:
+        doc["ok"] = False
+        doc["failures"] += [f"registry: {f['format']}: {f['error']}" for f in reg_fail]
+
+    try:
+        manifest = _load_manifest(corpus_dir)
+    except (OSError, json.JSONDecodeError) as e:
+        doc["ok"] = False
+        doc["failures"].append(f"manifest unreadable: {e}")
+        return doc
+
+    expected_by_name: Dict[str, Any] = {}
+    for case in manifest.get("cases", []):
+        entry = {"name": case["name"], "ok": True}
+        try:
+            data = (corpus_dir / case["file"]).read_bytes()
+            with open(_expected_path(corpus_dir, case), encoding="utf-8") as f:
+                expected = json.load(f)
+            expected_by_name[case["name"]] = expected
+            got = _decode_case(case, data)
+            if got != expected:
+                entry["ok"] = False
+                entry["error"] = "decoded output diverged from pinned expectation"
+        except Exception as e:  # decode failure IS the finding
+            entry["ok"] = False
+            entry["error"] = f"{type(e).__name__}: {e}"
+        if not entry["ok"]:
+            doc["ok"] = False
+            doc["failures"].append(f"case {entry['name']}: {entry['error']}")
+        doc["cases"].append(entry)
+
+    # seeded drift negative control: the gate must catch its own plant
+    ctl = manifest.get("drift_control") or {}
+    drift = {"case": ctl.get("case"), "offset": ctl.get("offset"),
+             "detected": False}
+    case = next(
+        (c for c in manifest.get("cases", []) if c["name"] == ctl.get("case")),
+        None,
+    )
+    if case is not None and ctl.get("case") in expected_by_name:
+        data = bytearray((corpus_dir / case["file"]).read_bytes())
+        off = int(ctl["offset"])
+        data[off] ^= 0xFF
+        try:
+            drift["detected"] = (
+                _decode_case(case, bytes(data)) != expected_by_name[ctl["case"]]
+            )
+        except Exception:
+            drift["detected"] = True
+    doc["drift_control"] = drift
+    if not drift["detected"]:
+        doc["ok"] = False
+        doc["failures"].append(
+            "drift control NOT detected: the corpus gate cannot see byte-level "
+            "drift — it is not protecting anything"
+        )
+
+    # staleness: every repo format must have corpus coverage
+    from emqx_tpu.proto import registry
+
+    covered = set()
+    for c in manifest.get("cases", []):
+        covered.update(c.get("covers", []))
+    repo_formats = {f.name for f in registry.formats() if not f.name.startswith("fix.")}
+    uncovered = sorted(repo_formats - covered)
+    doc["staleness"] = {"formats": len(repo_formats), "uncovered": uncovered}
+    if uncovered:
+        doc["ok"] = False
+        doc["failures"].append(
+            "formats with no corpus coverage: " + ", ".join(uncovered)
+        )
+    return doc
+
+
+# -- corpus regeneration ------------------------------------------------
+
+def _update_corpus(corpus_dir: Path, pins_path: Path) -> Dict:
+    """Regenerate the corpus with the CURRENT encoders. Refuses when a
+    case's bytes change while every format it covers keeps its pinned
+    version — that is silent wire drift, the exact failure this audit
+    gates. Bump the registry version first; the pins follow."""
+    from emqx_tpu.proto import registry
+
+    try:
+        with open(pins_path, encoding="utf-8") as f:
+            pins = json.load(f).get("formats", {})
+    except (OSError, json.JSONDecodeError):
+        pins = {}
+
+    bumped = {
+        f.name
+        for f in registry.formats()
+        if f.name not in pins or pins[f.name].get("version") != f.version
+    }
+
+    doc: Dict[str, Any] = {"ok": True, "updated": [], "unchanged": [],
+                           "refused": [], "failures": []}
+    new_bytes: Dict[str, bytes] = {}
+    for name, fname, decoder, gen, covers, params in CASES:
+        data = gen()
+        new_bytes[name] = data
+        old = None
+        fpath = corpus_dir / fname
+        if fpath.exists():
+            old = fpath.read_bytes()
+        if old is not None and old != data and not (set(covers) & bumped):
+            doc["refused"].append(name)
+            doc["ok"] = False
+            doc["failures"].append(
+                f"case {name}: regenerated bytes differ but no covered format "
+                f"({', '.join(covers)}) bumped its registry version"
+            )
+    if not doc["ok"]:
+        return doc
+
+    corpus_dir.mkdir(parents=True, exist_ok=True)
+    (corpus_dir / "expected").mkdir(exist_ok=True)
+    cases_out = []
+    drift_ctl = None
+    for name, fname, decoder, gen, covers, params in CASES:
+        data = new_bytes[name]
+        case = {"name": name, "file": fname, "decoder": decoder,
+                "covers": covers, "params": params}
+        fpath = corpus_dir / fname
+        changed = not fpath.exists() or fpath.read_bytes() != data
+        if changed:
+            fpath.write_bytes(data)
+            doc["updated"].append(name)
+        else:
+            doc["unchanged"].append(name)
+        expected = _decode_case(case, data)
+        with open(_expected_path(corpus_dir, case), "w", encoding="utf-8") as f:
+            json.dump(expected, f, indent=1, sort_keys=True)
+            f.write("\n")
+        cases_out.append(case)
+        if name == DRIFT_CASE:
+            drift_ctl = {"case": name,
+                         "offset": _find_drift_offset(data, case, expected)}
+
+    manifest = {
+        "version": 1,
+        "note": "golden wire corpus: captured encoder output replayed "
+                "through CURRENT decoders by `python -m tools.analysis "
+                "--wirecompat`. Regenerate ONLY via --update-corpus, which "
+                "enforces registry version bumps.",
+        "cases": cases_out,
+        "drift_control": drift_ctl,
+    }
+    with open(corpus_dir / "manifest.json", "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    # pins follow the registry — fixture ("fix.*") pins are tier-A
+    # property and are preserved untouched
+    pin_doc = {"version": 1, "note": "", "formats": {}}
+    try:
+        with open(pins_path, encoding="utf-8") as f:
+            pin_doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    live = registry.pin_doc()["formats"]
+    kept = {k: v for k, v in pin_doc.get("formats", {}).items()
+            if k.startswith("fix.")}
+    kept.update(live)
+    pin_doc["formats"] = {k: kept[k] for k in sorted(kept)}
+    with open(pins_path, "w", encoding="utf-8") as f:
+        json.dump(pin_doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def render_wirecompat_text(doc: Dict) -> str:
+    lines = []
+    if "updated" in doc:  # --update-corpus report
+        lines.append(
+            f"wirecompat corpus update: {len(doc['updated'])} written, "
+            f"{len(doc['unchanged'])} unchanged, {len(doc['refused'])} refused"
+        )
+    else:
+        reg = doc.get("registry", {}).get("live_mismatches", [])
+        cases = doc.get("cases", [])
+        bad = [c for c in cases if not c["ok"]]
+        drift = doc.get("drift_control", {})
+        stale = doc.get("staleness", {})
+        lines.append(
+            f"wirecompat: {len(cases) - len(bad)}/{len(cases)} corpus cases "
+            f"clean, {len(reg)} live registry mismatch(es), drift control "
+            f"{'DETECTED' if drift.get('detected') else 'MISSED'}, "
+            f"{len(stale.get('uncovered', []))} uncovered format(s) "
+            f"of {stale.get('formats', 0)}"
+        )
+    for f in doc.get("failures", []):
+        lines.append(f"  FAIL {f}")
+    return "\n".join(lines)

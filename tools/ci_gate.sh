@@ -1,18 +1,20 @@
 #!/usr/bin/env bash
 # ci_gate.sh — THE single pre-merge command (docs/concurrency.md,
-# docs/static_analysis.md). Six gates, in the order that fails fastest:
+# docs/static_analysis.md). Five gates, in the order that fails fastest:
 #
-#   1. tpu_lint, all checkers            (pure AST, ~20 s)
-#   2. the device-contract audit          (jaxpr tracing on CPU)
-#   3. the replication replay audit       (--replay: shadow-replica
-#      convergence over five-owner churn + the seeded incomplete-log
-#      negative control — docs/static_analysis.md "Tier B")
-#   4. tier-1 pytest                      (`-m "not slow"`; the race-marked
+#   1. tpu_lint + the consolidated tier-B audit in ONE invocation
+#      (`--audit`): all 16 AST checkers, the device-contract audit
+#      (jaxpr tracing on CPU), the replication replay audit
+#      (shadow-replica convergence + seeded incomplete-log control),
+#      and the wire-compatibility audit (golden-corpus replay through
+#      current decoders + seeded drift control + live layout
+#      cross-check — docs/static_analysis.md "Tier B")
+#   2. tier-1 pytest                      (`-m "not slow"`; the race-marked
 #      racetrack suite is part of tier-1 and runs with the detector armed)
-#   5. the race suite alone, verbose      (`-m race`) — redundant with (4)
+#   3. the race suite alone, verbose      (`-m race`) — redundant with (2)
 #      but isolates the concurrency rig's verdict in its own section of
 #      the log, so a race report is never buried in a 500-test dot wall
-#   6. the bench-trend gate               (tools/bench_trend.py --check:
+#   4. the bench-trend gate               (tools/bench_trend.py --check:
 #      the committed BENCH trajectory, grouped by hardware fingerprint —
 #      fails when a same-fingerprint metric regressed past threshold;
 #      run it again after any bench recipe below refreshes a capture)
@@ -20,9 +22,11 @@
 # Fast mode for the inner loop (pre-push, not pre-merge):
 #
 #   tools/ci_gate.sh --fast     # lint scoped to git-touched files
-#                               # (--changed-only --jobs 8; Tier B
-#                               # audits are skipped by contract) +
-#                               # a bounded replay smoke + race suite
+#                               # (--changed-only --jobs 8) + the
+#                               # bounded tier-B smoke (`--audit
+#                               # --smoke`: replay capped at 8 rounds,
+#                               # full corpus replay, contracts
+#                               # skipped) + race suite
 #
 # Bench recipes (slow — NOT part of tier-1 or this gate; run when a PR
 # touches the paths they measure):
@@ -140,8 +144,8 @@ if [ "$FAST" = 1 ]; then
     python -m tools.analysis --changed-only --jobs 8
     banner "profile smoke (arm -> batch -> disarm)"
     profile_smoke
-    banner "replay smoke (bounded shadow-replica audit)"
-    python -m tools.analysis --replay --replay-rounds 8 --checks oplog
+    banner "tier-B smoke (bounded replay + full wirecompat corpus)"
+    python -m tools.analysis --audit --smoke --checks oplog
     banner "bench trend gate (fingerprint-grouped)"
     python -m tools.bench_trend --check > /dev/null
     banner "race suite (racetrack armed)"
@@ -149,14 +153,8 @@ if [ "$FAST" = 1 ]; then
     exit 0
 fi
 
-banner "tpu_lint (all checkers)"
-python -m tools.analysis --jobs 8
-
-banner "device-contract audit"
-python -m tools.analysis --contracts
-
-banner "replication replay audit (shadow replica)"
-python -m tools.analysis --replay --checks oplog
+banner "tpu_lint + tier-B audit (contracts, replay, wirecompat)"
+python -m tools.analysis --jobs 8 --audit
 
 banner "tier-1 tests"
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
